@@ -1,0 +1,42 @@
+"""Hermetic multi-device test rig.
+
+The reference's trick — run the *real* framework on a *local* multi-worker
+topology (Spark ``local[4]``, single-node Ray; SURVEY §4.1/§4.3) — ports to
+JAX as an 8-device virtual CPU mesh: every DP/FSDP/TP sharding test runs the
+actual pjit/collective path in CI without TPUs.
+
+Must set the env vars before jax is imported anywhere.
+"""
+
+import os
+
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+# The ambient environment may have force-registered a TPU backend via
+# sitecustomize before this file runs; the config update below (post-import)
+# wins regardless.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+os.environ.setdefault("ZOO_NUM_CORES", "4")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def orca_ctx():
+    """Function-scoped orca context over the 8-device CPU mesh (mirrors the
+    reference's package-scoped ``init_orca_context(cores=4)`` conftest,
+    ``test/zoo/orca/learn/spark/conftest.py:20-25``)."""
+    from zoo_tpu.orca import init_orca_context, stop_orca_context
+    ctx = init_orca_context(cluster_mode="local", cores=4)
+    yield ctx
+    stop_orca_context()
+
+
+@pytest.fixture()
+def tmp_model_dir(tmp_path):
+    return str(tmp_path / "model")

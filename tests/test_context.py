@@ -1,0 +1,48 @@
+import pytest
+
+
+def test_init_and_stop_orca_context():
+    from zoo_tpu.orca import init_orca_context, stop_orca_context
+    from zoo_tpu.common import get_runtime_context
+
+    ctx = init_orca_context(cluster_mode="local", cores=2)
+    assert ctx.num_devices == 8  # virtual CPU mesh from conftest
+    assert ctx.mesh.shape["data"] == 8
+    assert get_runtime_context() is ctx
+    # idempotent second call returns the same context
+    assert init_orca_context() is ctx
+    stop_orca_context()
+    assert get_runtime_context(required=False) is None
+
+
+def test_mesh_axes_layout():
+    from zoo_tpu.orca import init_orca_context, stop_orca_context
+
+    ctx = init_orca_context(mesh_axes={"data": 2, "model": 4})
+    try:
+        assert ctx.mesh.shape["data"] == 2
+        assert ctx.mesh.shape["model"] == 4
+    finally:
+        stop_orca_context()
+
+
+def test_bad_cluster_mode():
+    from zoo_tpu.orca import init_orca_context
+    with pytest.raises(ValueError):
+        init_orca_context(cluster_mode="not-a-mode")
+
+
+def test_orca_context_flags():
+    from zoo_tpu.orca import OrcaContext
+
+    OrcaContext.pandas_read_backend = "arrow"
+    assert OrcaContext.pandas_read_backend == "arrow"
+    OrcaContext.pandas_read_backend = "pandas"
+    with pytest.raises(ValueError):
+        OrcaContext.pandas_read_backend = "dask"
+    OrcaContext.shard_size = 1000
+    assert OrcaContext.shard_size == 1000
+    OrcaContext.shard_size = None
+    OrcaContext.train_data_store = "DISK_2"
+    assert OrcaContext.train_data_store == "DISK_2"
+    OrcaContext.train_data_store = "DRAM"

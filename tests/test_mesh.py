@@ -1,0 +1,79 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from zoo_tpu.parallel import (
+    batch_sharding,
+    build_mesh,
+    fsdp_param_sharding,
+    replicated_sharding,
+)
+from zoo_tpu.parallel.mesh import shard_params, validate_batch_size
+
+
+def test_build_default_mesh():
+    mesh = build_mesh()
+    assert mesh.shape["data"] == 8
+    assert mesh.shape["model"] == 1
+
+
+def test_build_mesh_wildcard_and_explicit():
+    mesh = build_mesh(axis_sizes={"data": -1, "model": 2})
+    assert mesh.shape["data"] == 4
+    assert mesh.shape["model"] == 2
+    with pytest.raises(ValueError):
+        build_mesh(axis_sizes={"data": 3})
+    with pytest.raises(ValueError):
+        build_mesh(axis_sizes={"bogus": 2})
+
+
+def test_batch_sharding_places_data():
+    mesh = build_mesh()
+    x = np.arange(32, dtype=np.float32).reshape(16, 2)
+    arr = jax.device_put(x, batch_sharding(mesh, ndim=2))
+    assert arr.sharding.is_equivalent_to(batch_sharding(mesh, 2), 2)
+    # each of the 8 devices holds 2 rows
+    assert arr.addressable_shards[0].data.shape == (2, 2)
+    np.testing.assert_array_equal(np.asarray(arr), x)
+
+
+def test_fsdp_param_sharding_picks_divisible_dim():
+    mesh = build_mesh(axis_sizes={"data": 2, "fsdp": 4})
+    s = fsdp_param_sharding(mesh, (12, 7))
+    assert s.spec[0] == "fsdp"  # 12 % 4 == 0 → dim 0
+    s = fsdp_param_sharding(mesh, (7, 16))
+    assert s.spec[1] == "fsdp"
+    # nothing divisible → replicated
+    s = fsdp_param_sharding(mesh, (7, 5))
+    assert s.spec == P()
+
+
+def test_shard_params_tree():
+    mesh = build_mesh(axis_sizes={"fsdp": 8})
+    params = {"w": jnp.ones((16, 4)), "b": jnp.ones((3,))}
+    sharded = shard_params(params, mesh)
+    assert sharded["w"].addressable_shards[0].data.shape == (2, 4)
+    np.testing.assert_array_equal(np.asarray(sharded["w"]), np.ones((16, 4)))
+
+
+def test_validate_batch_size():
+    mesh = build_mesh()
+    assert validate_batch_size(16, mesh) == 2
+    with pytest.raises(ValueError):
+        validate_batch_size(12, mesh)
+
+
+def test_psum_over_mesh_collective():
+    """Real allreduce over the virtual mesh via shard_map — the rebuild's
+    equivalent of the reference's DistriEstimatorSpec on local[4]."""
+    from jax import shard_map
+
+    mesh = build_mesh()
+    x = jnp.arange(8.0)
+
+    f = shard_map(lambda v: jax.lax.psum(v, "data"), mesh=mesh,
+                  in_specs=P("data"), out_specs=P("data"))
+    out = jax.jit(f)(x)
+    np.testing.assert_allclose(np.asarray(out), np.full((8,), x.sum()))

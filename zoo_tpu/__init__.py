@@ -1,0 +1,28 @@
+"""zoo_tpu — a TPU-native "Big Data AI" framework.
+
+A from-scratch rebuild of the capabilities of Analytics Zoo
+(reference: TheaperDeng/analytics-zoo, ``pyzoo/zoo/__init__.py``) designed
+TPU-first on JAX/XLA/pjit/Pallas:
+
+- **Orca**: one-line context bootstrap (``init_orca_context``) + sklearn-style
+  distributed Estimators over XShards / pandas / tf.data-like pipelines
+  (reference: ``pyzoo/zoo/orca``).
+- **Keras-style layer API** on Flax instead of BigDL Scala layers
+  (reference: ``pyzoo/zoo/pipeline/api/keras``).
+- **Parallelism**: a ``jax.sharding.Mesh`` over ICI with DP / FSDP (ZeRO) /
+  TP / sequence(ring-attention) sharding plans instead of the reference's
+  Spark-shuffle parameter-server AllReduce (``Topology.scala:1204``).
+- **Chronos**: time-series datasets, forecasters and AutoTS
+  (reference: ``pyzoo/zoo/chronos``).
+- **Friesian**: recsys feature engineering (reference: ``pyzoo/zoo/friesian``).
+- **Serving / Inference**: AOT-compiled XLA inference with a model-copy pool
+  (reference: ``pipeline/inference/InferenceModel.scala``).
+
+Unlike the reference there is no JVM, Py4J, or Spark in the training loop:
+the whole step (forward, backward, gradient allreduce, optimizer update) is a
+single jitted XLA computation.
+"""
+
+__version__ = "0.1.0"
+
+from zoo_tpu.common.context import ZooContext  # noqa: F401

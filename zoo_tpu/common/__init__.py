@@ -1,0 +1,3 @@
+from zoo_tpu.common.context import ZooContext, RuntimeContext, get_runtime_context
+
+__all__ = ["ZooContext", "RuntimeContext", "get_runtime_context"]

@@ -1,0 +1,160 @@
+"""Global configuration flags and the per-process runtime context.
+
+Rebuild of the reference's ``ZooContext`` / ``OrcaContextMeta`` class-property
+config registry (reference: ``pyzoo/zoo/common/nncontext.py:269-313`` and
+``pyzoo/zoo/orca/common.py:21-134``): a handful of ergonomic process-global
+knobs, plus a ``RuntimeContext`` that owns what the reference's SparkContext +
+BigDL Engine owned — here, the JAX platform, the device list, and the
+``jax.sharding.Mesh`` used by every Estimator.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import threading
+from typing import Optional
+
+logger = logging.getLogger("zoo_tpu")
+
+
+class _ClassPropertyMeta(type):
+    """Metaclass providing validated class-level properties (the reference
+    uses the same trick in ``OrcaContextMeta``, ``orca/common.py:21``)."""
+
+    _log_output = False
+    _pandas_read_backend = "pandas"
+    _serialize_data_creator = False
+    _shard_size = None
+    _train_data_store = "DRAM"
+    _eager_mode = True
+
+    @property
+    def log_output(cls) -> bool:
+        """Whether worker subprocess logs are echoed to the driver process
+        (reference semantics: ``OrcaContextMeta.log_output``)."""
+        return cls._log_output
+
+    @log_output.setter
+    def log_output(cls, value: bool):
+        _ClassPropertyMeta._log_output = bool(value)
+
+    @property
+    def pandas_read_backend(cls) -> str:
+        """"pandas" or "arrow" — backend for ``zoo_tpu.orca.data.pandas.read_csv``
+        (reference: ``OrcaContextMeta.pandas_read_backend``)."""
+        return cls._pandas_read_backend
+
+    @pandas_read_backend.setter
+    def pandas_read_backend(cls, value: str):
+        value = value.lower()
+        if value not in ("pandas", "arrow"):
+            raise ValueError(
+                "pandas_read_backend must be 'pandas' or 'arrow', got " + value)
+        _ClassPropertyMeta._pandas_read_backend = value
+
+    @property
+    def serialize_data_creator(cls) -> bool:
+        """Serialize dataset creation across workers with a file lock
+        (reference: ``OrcaContextMeta.serialize_data_creator``)."""
+        return cls._serialize_data_creator
+
+    @serialize_data_creator.setter
+    def serialize_data_creator(cls, value: bool):
+        _ClassPropertyMeta._serialize_data_creator = bool(value)
+
+    @property
+    def shard_size(cls) -> Optional[int]:
+        """Target rows per XShards partition when converting tabular data
+        (reference: ``OrcaContextMeta._shard_size``)."""
+        return cls._shard_size
+
+    @shard_size.setter
+    def shard_size(cls, value: Optional[int]):
+        if value is not None and int(value) <= 0:
+            raise ValueError("shard_size must be positive or None")
+        _ClassPropertyMeta._shard_size = None if value is None else int(value)
+
+    @property
+    def train_data_store(cls) -> str:
+        """Memory tier for cached training data: DRAM | DISK_n
+        (reference tiers DRAM/PMEM/DIRECT/DISK_n, ``orca/common.py:86-103``;
+        PMEM maps to host-RAM+SSD tiering on TPU VMs — see
+        ``zoo_tpu.data.cache``)."""
+        return cls._train_data_store
+
+    @train_data_store.setter
+    def train_data_store(cls, value: str):
+        v = value.upper()
+        if v != "DRAM" and not v.startswith("DISK"):
+            raise ValueError("train_data_store must be 'DRAM' or 'DISK_n'")
+        _ClassPropertyMeta._train_data_store = v
+
+    @property
+    def eager_mode(cls) -> bool:
+        """Whether XShards transforms execute eagerly (reference:
+        ``SparkXShards`` eager-mode caching, ``orca/data/shard.py:129``)."""
+        return cls._eager_mode
+
+    @eager_mode.setter
+    def eager_mode(cls, value: bool):
+        _ClassPropertyMeta._eager_mode = bool(value)
+
+
+class ZooContext(metaclass=_ClassPropertyMeta):
+    """Process-global configuration knobs (set as class attributes)."""
+
+
+@dataclasses.dataclass
+class RuntimeContext:
+    """What ``init_orca_context`` returns: the live JAX runtime handle.
+
+    Replaces the reference's SparkContext + BigDL Engine + RayContext trio
+    (``orca/common.py:161``): everything an Estimator needs to place and run
+    a jitted step — the device list, the global mesh, and host-side worker
+    parallelism for input pipelines.
+    """
+
+    cluster_mode: str
+    platform: str
+    devices: tuple
+    mesh: "object"           # jax.sharding.Mesh
+    num_processes: int       # jax process count (multi-host)
+    process_index: int
+    cores: int               # host-side data-worker parallelism
+    extra: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def num_devices(self) -> int:
+        return len(self.devices)
+
+    def axis_size(self, name: str) -> int:
+        return self.mesh.shape.get(name, 1)
+
+
+_lock = threading.Lock()
+_runtime_context: Optional[RuntimeContext] = None
+
+
+def _set_runtime_context(ctx: Optional[RuntimeContext]):
+    global _runtime_context
+    with _lock:
+        _runtime_context = ctx
+
+
+def get_runtime_context(required: bool = True) -> Optional[RuntimeContext]:
+    """Current :class:`RuntimeContext`, or raise if ``init_orca_context`` has
+    not been called (mirrors the reference's implicit ``getOrCreate`` use of
+    SparkContext)."""
+    if _runtime_context is None and required:
+        raise RuntimeError(
+            "No runtime context. Call zoo_tpu.orca.init_orca_context() first.")
+    return _runtime_context
+
+
+def default_cores() -> int:
+    env = os.environ.get("ZOO_NUM_CORES")
+    if env:
+        return int(env)
+    return max(1, os.cpu_count() or 1)

@@ -1,0 +1,7 @@
+from zoo_tpu.orca.common import (
+    OrcaContext,
+    init_orca_context,
+    stop_orca_context,
+)
+
+__all__ = ["OrcaContext", "init_orca_context", "stop_orca_context"]

@@ -1,0 +1,148 @@
+"""One-line cluster bootstrap for TPU.
+
+Rebuild of ``init_orca_context`` / ``stop_orca_context``
+(reference: ``pyzoo/zoo/orca/common.py:161,271``). The reference's job was to
+assemble a SparkContext (local / yarn / k8s / standalone), boot BigDL's JVM
+engine, and optionally start a Ray cluster inside the Spark executors
+(RayOnSpark, ``pyzoo/zoo/ray/raycontext.py:323``). On TPU there is no JVM and
+no Spark: bootstrap means initializing the JAX distributed runtime (for
+multi-host pods), picking the device set, and building the global
+``jax.sharding.Mesh`` every Estimator will ``pjit`` over.
+
+Supported cluster modes:
+
+- ``"local"``      — whatever ``jax.devices()`` says on this process
+                     (a CPU mesh in tests, a single TPU chip on a dev VM).
+- ``"tpu"``        — multi-host TPU pod: calls ``jax.distributed.initialize``
+                     (TPU env vars are auto-detected by JAX) then meshes all
+                     global devices.
+- ``"spark-submit"``/``"yarn"``/``"k8s"`` — accepted for API compatibility;
+                     they behave like ``"tpu"`` (the scheduler that launched
+                     the processes is irrelevant once JAX is initialized).
+"""
+
+from __future__ import annotations
+
+import atexit
+import logging
+import os
+from typing import Dict, Optional, Sequence
+
+from zoo_tpu.common.context import (
+    RuntimeContext,
+    ZooContext,
+    _set_runtime_context,
+    default_cores,
+    get_runtime_context,
+)
+
+logger = logging.getLogger("zoo_tpu.orca")
+
+
+class OrcaContext(ZooContext):
+    """Process-global Orca config flags (reference: ``OrcaContextMeta``,
+    ``orca/common.py:21-134``). Inherits the knobs from :class:`ZooContext`;
+    aliased here so user code reads ``from zoo_tpu.orca import OrcaContext``
+    exactly like the reference."""
+
+
+_DIST_INITIALIZED = False
+
+
+def _maybe_init_distributed(cluster_mode: str):
+    """Initialize jax.distributed for multi-host pods; a no-op when the
+    process is not part of a multi-host job (mirrors the reference's
+    idempotent context bootstrap)."""
+    global _DIST_INITIALIZED
+    if _DIST_INITIALIZED:
+        return
+    import jax
+
+    if cluster_mode != "local":
+        multi_host = any(k in os.environ for k in (
+            "COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
+            "MEGASCALE_COORDINATOR_ADDRESS", "TPU_WORKER_HOSTNAMES"))
+        if multi_host or cluster_mode != "tpu":
+            try:
+                jax.distributed.initialize()
+                _DIST_INITIALIZED = True
+            except Exception as e:  # single-host dev box: fine
+                logger.debug("jax.distributed.initialize skipped: %s", e)
+
+
+def init_orca_context(cluster_mode: str = "local",
+                      cores: Optional[int] = None,
+                      memory: Optional[str] = None,
+                      num_nodes: int = 1,
+                      mesh_axes: Optional[Dict[str, int]] = None,
+                      axis_names: Optional[Sequence[str]] = None,
+                      devices=None,
+                      **kwargs) -> RuntimeContext:
+    """Create (or return) the global :class:`RuntimeContext`.
+
+    Parameters mirror the reference (``orca/common.py:161``): ``cores`` and
+    ``memory`` sized the Spark executors there; here ``cores`` sizes the
+    host-side input-pipeline worker pool and ``memory`` is accepted and
+    recorded but not enforced (the OS does that). ``num_nodes`` is validated
+    against the actual JAX process count on multi-host jobs.
+
+    TPU-specific additions: ``mesh_axes`` (e.g. ``{"data": -1}`` or
+    ``{"data": 2, "model": 4}``) chooses the parallelism layout — the
+    reference was data-parallel only (SURVEY §2.10), this rebuild makes the
+    layout a bootstrap-time choice.
+    """
+    cluster_mode = cluster_mode.lower()
+    if cluster_mode not in ("local", "tpu", "yarn", "k8s", "standalone",
+                            "spark-submit", "yarn-client", "yarn-cluster"):
+        raise ValueError(f"unsupported cluster_mode: {cluster_mode}")
+
+    existing = get_runtime_context(required=False)
+    if existing is not None:
+        if (cluster_mode != existing.cluster_mode or mesh_axes or axis_names
+                or devices is not None):
+            raise RuntimeError(
+                "init_orca_context called twice with different arguments; "
+                "call stop_orca_context() first to rebuild")
+        logger.warning("init_orca_context called twice; returning existing "
+                       "context")
+        return existing
+
+    _maybe_init_distributed(cluster_mode)
+
+    import jax
+    from zoo_tpu.parallel.mesh import build_mesh
+
+    devs = list(devices if devices is not None else jax.devices())
+    mesh = build_mesh(devs, axis_sizes=mesh_axes, axis_names=axis_names)
+
+    nproc = jax.process_count()
+    if cluster_mode != "local" and num_nodes > 1 and nproc not in (1, num_nodes):
+        logger.warning("num_nodes=%d but jax.process_count()=%d",
+                       num_nodes, nproc)
+
+    ctx = RuntimeContext(
+        cluster_mode=cluster_mode,
+        platform=devs[0].platform if devs else "cpu",
+        devices=tuple(devs),
+        mesh=mesh,
+        num_processes=nproc,
+        process_index=jax.process_index(),
+        cores=cores or default_cores(),
+        extra={"memory": memory, "num_nodes": num_nodes, **kwargs},
+    )
+    _set_runtime_context(ctx)
+    atexit.register(stop_orca_context)
+    logger.info("Orca context: mode=%s platform=%s devices=%d mesh=%s",
+                cluster_mode, ctx.platform, ctx.num_devices,
+                dict(mesh.shape))
+    return ctx
+
+
+def stop_orca_context():
+    """Tear down the global context (reference: ``orca/common.py:271``;
+    registered atexit there too). Device buffers owned by Estimators are
+    dropped with their Python references; nothing else to kill — there are
+    no Ray raylets or JVMs here."""
+    if get_runtime_context(required=False) is not None:
+        _set_runtime_context(None)
+        logger.info("Orca context stopped")

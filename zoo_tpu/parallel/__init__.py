@@ -1,0 +1,17 @@
+from zoo_tpu.parallel.mesh import (
+    build_mesh,
+    batch_sharding,
+    replicated_sharding,
+    fsdp_param_sharding,
+    host_local_to_global,
+    DEFAULT_AXES,
+)
+
+__all__ = [
+    "build_mesh",
+    "batch_sharding",
+    "replicated_sharding",
+    "fsdp_param_sharding",
+    "host_local_to_global",
+    "DEFAULT_AXES",
+]

@@ -1,0 +1,168 @@
+"""Device-mesh construction and sharding helpers.
+
+This module is the rebuild's replacement for the reference's entire
+"communication backend" zoo — BigDL's Spark-shuffle parameter-server
+AllReduce (``Topology.scala:1204``, design ``docs/docs/wp-bigdl.md:140-160``),
+torch DDP over gloo (``torch_runner.py:136-149``), TF MultiWorkerMirrored
+(``tf_runner.py:280-313``), Horovod, MXNet kvstore and MPI. On TPU all of
+those collapse into one thing: a ``jax.sharding.Mesh`` over the ICI torus,
+with XLA emitting the collectives (psum / reduce-scatter / all-gather) from
+sharding annotations. The reference's slice-wise PS update *is*
+reduce-scatter + apply + all-gather, which is exactly what GSPMD emits for a
+batch-sharded grad + optionally ZeRO-sharded optimizer state.
+
+Axis-name convention (used by every sharding plan in zoo_tpu):
+
+- ``data``  — data parallel (batch axis)
+- ``fsdp``  — ZeRO-3 style parameter sharding (combines with ``data``)
+- ``model`` — tensor parallel (net-new vs the reference, SURVEY §2.10)
+- ``seq``   — sequence/context parallel (ring attention, net-new, SURVEY §5.7)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DEFAULT_AXES = ("data", "fsdp", "model", "seq")
+
+
+def _factor_shape(n_devices: int, axis_sizes: Dict[str, int],
+                  axis_names: Sequence[str]) -> Tuple[int, ...]:
+    """Resolve a full mesh shape: explicitly sized axes keep their size, at
+    most one ``-1`` axis absorbs the remaining devices, others default 1."""
+    shape = []
+    wildcard = None
+    used = 1
+    for i, name in enumerate(axis_names):
+        size = axis_sizes.get(name, 1)
+        if size != -1 and size <= 0:
+            raise ValueError(f"mesh axis {name!r} must have positive size "
+                             f"or -1, got {size}")
+        if size == -1:
+            if wildcard is not None:
+                raise ValueError("only one mesh axis may be -1")
+            wildcard = i
+            shape.append(1)
+        else:
+            shape.append(int(size))
+            used *= int(size)
+    if n_devices % used != 0:
+        raise ValueError(
+            f"{n_devices} devices not divisible by requested axes {axis_sizes}")
+    if wildcard is not None:
+        shape[wildcard] = n_devices // used
+    elif used != n_devices:
+        raise ValueError(
+            f"mesh axes {axis_sizes} cover {used} devices but {n_devices} present")
+    return tuple(shape)
+
+
+def build_mesh(devices=None,
+               axis_sizes: Optional[Dict[str, int]] = None,
+               axis_names: Sequence[str] = None) -> Mesh:
+    """Build a :class:`jax.sharding.Mesh`.
+
+    ``axis_sizes`` maps axis name -> size; one axis may be ``-1`` to absorb
+    all remaining devices. Default: pure data parallel over every device —
+    the reference's only strategy (SURVEY §2.10).
+
+    ``jax.make_mesh`` is used when available so that axis order is optimized
+    for ICI topology (data axis outermost rides the full torus).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    axis_names = tuple(axis_names or DEFAULT_AXES)
+    axis_sizes = dict(axis_sizes or {"data": -1})
+    for name in axis_sizes:
+        if name not in axis_names:
+            raise ValueError(f"unknown mesh axis {name!r}; known: {axis_names}")
+    shape = _factor_shape(len(devices), axis_sizes, axis_names)
+    # Auto axis types = classic GSPMD propagation. jax>=0.9 make_mesh defaults
+    # to Explicit sharding-in-types, which turns mixed dp/fsdp matmuls into
+    # hard sharding-conflict errors; the framework owns its shardings and
+    # wants the compiler to resolve intermediates.
+    try:
+        auto = (jax.sharding.AxisType.Auto,) * len(axis_names)
+        return jax.make_mesh(shape, axis_names, devices=devices,
+                             axis_types=auto)
+    except (TypeError, AttributeError):
+        arr = np.asarray(devices).reshape(shape)
+        return Mesh(arr, axis_names)
+
+
+def data_axes(mesh: Mesh) -> Tuple[str, ...]:
+    """Mesh axes the batch dimension is sharded over: data plus fsdp (ZeRO
+    shards params over the same replicas that shard the batch)."""
+    return tuple(a for a in ("data", "fsdp")
+                 if a in mesh.axis_names and mesh.shape.get(a, 1) > 1)
+
+
+def batch_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
+    """Sharding for a batch tensor: dim 0 split over (data, fsdp), rest
+    replicated. This is the rebuild of BigDL's "each worker gets its RDD
+    partition of the minibatch" (``wp-bigdl.md:131-145``)."""
+    axes = data_axes(mesh)
+    spec = [axes if axes else None] + [None] * (ndim - 1)
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def fsdp_param_sharding(mesh: Mesh, shape: Tuple[int, ...],
+                        axis: str = "fsdp") -> NamedSharding:
+    """ZeRO-3-style sharding for one parameter: split the largest divisible
+    dimension over ``axis``; replicate if nothing divides. The reference's
+    PS-style slice-wise update (``Topology.scala:1204``) sharded the *flat*
+    parameter vector N ways; on TPU we shard per-tensor so XLA can fuse the
+    all-gather into the consuming matmul."""
+    size = mesh.shape.get(axis, 1)
+    if size <= 1 or not shape:
+        return replicated_sharding(mesh)
+    # pick the largest dim divisible by the axis size
+    best = None
+    for i, d in enumerate(shape):
+        if d % size == 0 and (best is None or d > shape[best]):
+            best = i
+    if best is None:
+        return replicated_sharding(mesh)
+    spec = [None] * len(shape)
+    spec[best] = axis
+    return NamedSharding(mesh, P(*spec))
+
+
+def shard_params(params, mesh: Mesh, axis: str = "fsdp"):
+    """Apply :func:`fsdp_param_sharding` across a whole pytree of params."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, fsdp_param_sharding(mesh, x.shape, axis)),
+        params)
+
+
+def host_local_to_global(mesh: Mesh, pspec: P, host_local: "np.ndarray"):
+    """Assemble a globally-sharded jax.Array from per-process host data.
+
+    Rebuild of the reference's hard part #1 (SURVEY §7.4): Spark partitions →
+    executor-local BigDL tensors becomes per-host numpy shards →
+    ``jax.make_array_from_process_local_data`` (no driver-side collect)."""
+    if jax.process_count() == 1:
+        return jax.device_put(host_local, NamedSharding(mesh, pspec))
+    return jax.make_array_from_process_local_data(
+        NamedSharding(mesh, pspec), host_local)
+
+
+def validate_batch_size(batch_size: int, mesh: Mesh) -> int:
+    """Preserve the reference's invariant ``batch_size % total_cores == 0``
+    (``tf_dataset.py:188`` enforces it for TF1 feeds) as
+    ``batch_size % (data axes size) == 0``."""
+    denom = 1
+    for a in data_axes(mesh):
+        denom *= mesh.shape[a]
+    if batch_size % denom != 0:
+        raise ValueError(
+            f"batch_size ({batch_size}) must be divisible by the number of "
+            f"data-parallel shards ({denom})")
+    return batch_size // denom

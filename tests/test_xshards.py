@@ -1,0 +1,127 @@
+import numpy as np
+import pandas as pd
+import pytest
+
+from zoo_tpu.orca.data import XShards, LocalXShards
+
+
+@pytest.fixture()
+def csv_dir(tmp_path):
+    for i in range(3):
+        df = pd.DataFrame({
+            "user": np.arange(i * 10, i * 10 + 10),
+            "item": np.arange(10),
+            "label": np.random.RandomState(i).randint(0, 2, 10),
+        })
+        df.to_csv(tmp_path / f"part{i}.csv", index=False)
+    return str(tmp_path)
+
+
+def test_partition_ndarray_and_dict():
+    x = np.arange(100).reshape(50, 2)
+    shards = XShards.partition(x, num_shards=4)
+    assert shards.num_partitions() == 4
+    np.testing.assert_array_equal(np.concatenate(shards.collect()), x)
+
+    d = {"x": np.arange(10), "y": np.arange(10) * 2}
+    shards = XShards.partition(d, num_shards=3)
+    got = shards.stack_numpy()
+    np.testing.assert_array_equal(got["x"], d["x"])
+    np.testing.assert_array_equal(got["y"], d["y"])
+
+
+def test_transform_and_repartition():
+    shards = XShards.partition(np.arange(12.0), num_shards=3)
+    doubled = shards.transform_shard(lambda a: a * 2)
+    np.testing.assert_array_equal(np.concatenate(doubled.collect()),
+                                  np.arange(12.0) * 2)
+    re = doubled.repartition(5)
+    assert re.num_partitions() == 5
+    np.testing.assert_array_equal(np.concatenate(re.collect()),
+                                  np.arange(12.0) * 2)
+
+
+def test_read_csv(orca_ctx, csv_dir):
+    from zoo_tpu.orca.data.pandas import read_csv
+
+    shards = read_csv(csv_dir)
+    assert shards.num_partitions() == 3
+    assert len(shards) == 30
+    stacked = shards.stack_numpy(["user", "label"])
+    assert stacked["user"].shape == (30,)
+
+    shards2 = read_csv(csv_dir, num_shards=2)
+    assert shards2.num_partitions() == 2
+    assert len(shards2) == 30
+
+
+def test_read_csv_arrow_backend(orca_ctx, csv_dir):
+    from zoo_tpu.orca import OrcaContext
+    from zoo_tpu.orca.data.pandas import read_csv
+
+    OrcaContext.pandas_read_backend = "arrow"
+    try:
+        shards = read_csv(csv_dir)
+        assert len(shards) == 30
+        assert set(shards.collect()[0].columns) == {"user", "item", "label"}
+    finally:
+        OrcaContext.pandas_read_backend = "pandas"
+
+
+def test_shard_size_flag(orca_ctx, csv_dir):
+    from zoo_tpu.orca import OrcaContext
+    from zoo_tpu.orca.data.pandas import read_csv
+
+    OrcaContext.shard_size = 7
+    try:
+        shards = read_csv(csv_dir)
+        assert shards.num_partitions() == 5  # ceil(30/7)
+        assert len(shards) == 30
+    finally:
+        OrcaContext.shard_size = None
+
+
+def test_partition_by_and_unique():
+    df = pd.DataFrame({"k": [1, 2, 1, 3, 2, 1], "v": range(6)})
+    shards = LocalXShards([df.iloc[:3], df.iloc[3:]])
+    parts = shards.partition_by("k", num_partitions=2)
+    # all rows with the same key must be in the same partition
+    for p in parts.collect():
+        pass
+    seen = {}
+    for i, p in enumerate(parts.collect()):
+        for k in p["k"].unique():
+            assert seen.setdefault(k, i) == i
+    u = LocalXShards([np.array([1, 2, 2]), np.array([3, 1])]).unique()
+    np.testing.assert_array_equal(u, [1, 2, 3])
+
+
+def test_split_and_zip():
+    pairs = LocalXShards([(np.ones(2), np.zeros(2)), (np.ones(3), np.zeros(3))])
+    xs, ys = pairs.split()
+    assert xs.num_partitions() == 2
+    z = xs.zip(ys)
+    a, b = z.collect()[0]
+    np.testing.assert_array_equal(a, np.ones(2))
+    with pytest.raises(ValueError):
+        xs.zip(LocalXShards([np.ones(1)]))
+
+
+def test_save_load_pickle(tmp_path):
+    shards = XShards.partition(np.arange(10), num_shards=2)
+    shards.save_pickle(str(tmp_path / "pk"))
+    back = LocalXShards.load_pickle(str(tmp_path / "pk"))
+    assert back.num_partitions() == 2
+    np.testing.assert_array_equal(np.concatenate(back.collect()), np.arange(10))
+
+
+def test_host_local_to_global_from_shards(orca_ctx):
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from zoo_tpu.parallel.mesh import host_local_to_global
+
+    shards = XShards.partition({"x": np.arange(16.0)}, num_shards=4)
+    host = shards.stack_numpy()
+    arr = host_local_to_global(orca_ctx.mesh, P("data"), host["x"])
+    assert arr.shape == (16,)
+    np.testing.assert_array_equal(np.asarray(arr), np.arange(16.0))

@@ -1,0 +1,3 @@
+from zoo_tpu.orca.data.shard import XShards, LocalXShards
+
+__all__ = ["XShards", "LocalXShards"]

@@ -1,0 +1,76 @@
+"""File utilities over local / (optionally) gcs paths.
+
+Rebuild of ``pyzoo/zoo/orca/data/file.py`` (open_text, exists, makedirs,
+write_text over local/hdfs/s3). The TPU-native deployment story replaces
+HDFS/S3 with GCS; ``gs://`` support is gated on an optional gcsfs/tensorstore
+install, everything else is plain POSIX.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+import shutil
+from typing import List
+
+
+def _strip_scheme(path: str) -> str:
+    if path.startswith("file://"):
+        return path[len("file://"):]
+    return path
+
+
+def is_local_path(path: str) -> bool:
+    return "://" not in path or path.startswith("file://")
+
+
+def exists(path: str) -> bool:
+    path = _strip_scheme(path)
+    if is_local_path(path):
+        return os.path.exists(path)
+    raise NotImplementedError(f"remote path not supported here: {path}")
+
+
+def makedirs(path: str):
+    path = _strip_scheme(path)
+    if is_local_path(path):
+        os.makedirs(path, exist_ok=True)
+        return
+    raise NotImplementedError(f"remote path not supported here: {path}")
+
+
+def open_text(path: str) -> List[str]:
+    """Read a text file and return its lines (reference:
+    ``orca/data/file.py`` ``open_text``)."""
+    path = _strip_scheme(path)
+    with open(path) as f:
+        return [line.rstrip("\n") for line in f]
+
+
+def write_text(path: str, text: str):
+    path = _strip_scheme(path)
+    with open(path, "w") as f:
+        f.write(text)
+
+
+def list_files(path_glob: str) -> List[str]:
+    """Expand a path or glob to a sorted file list; a directory expands to
+    its (non-hidden) files — matches the reference's extract_one behavior
+    for `read_csv` on a folder."""
+    path_glob = _strip_scheme(path_glob)
+    if os.path.isdir(path_glob):
+        return sorted(
+            os.path.join(path_glob, f) for f in os.listdir(path_glob)
+            if not f.startswith((".", "_")))
+    matches = sorted(_glob.glob(path_glob))
+    if not matches and os.path.exists(path_glob):
+        return [path_glob]
+    return matches
+
+
+def rmtree(path: str):
+    path = _strip_scheme(path)
+    if os.path.isdir(path):
+        shutil.rmtree(path)
+    elif os.path.exists(path):
+        os.remove(path)

@@ -1,0 +1,3 @@
+from zoo_tpu.orca.data.pandas.preprocessing import read_csv, read_json
+
+__all__ = ["read_csv", "read_json"]

@@ -1,0 +1,77 @@
+"""pandas-backed readers producing XShards.
+
+Rebuild of ``pyzoo/zoo/orca/data/pandas/preprocessing.py`` (``read_csv`` /
+``read_json`` over local/hdfs/s3 into SparkXShards of DataFrames). Here the
+file list is read in a thread pool sized by the context ``cores``; the
+``OrcaContext.pandas_read_backend`` flag selects pandas or pyarrow parsing,
+mirroring the reference's "pandas" vs "spark" backends.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional
+
+from zoo_tpu.common.context import ZooContext
+from zoo_tpu.orca.data.file import list_files
+from zoo_tpu.orca.data.shard import LocalXShards, _pool_size
+
+
+def _read_one_csv(path: str, **kwargs):
+    if ZooContext.pandas_read_backend == "arrow":
+        from pyarrow import csv as pacsv
+        # map the common pandas kwargs onto pyarrow
+        opts = {}
+        if "names" in kwargs:
+            opts["column_names"] = kwargs["names"]
+        if kwargs.get("header", "infer") is None and "names" not in kwargs:
+            opts["autogenerate_column_names"] = True
+        ropt = pacsv.ReadOptions(**opts)
+        popt = pacsv.ParseOptions(delimiter=kwargs.get("sep", ","))
+        table = pacsv.read_csv(path, read_options=ropt, parse_options=popt)
+        df = table.to_pandas()
+        if "usecols" in kwargs:
+            df = df[list(kwargs["usecols"])]
+        if "dtype" in kwargs:
+            df = df.astype(kwargs["dtype"])
+        return df
+    import pandas as pd
+    return pd.read_csv(path, **kwargs)
+
+
+def _read_one_json(path: str, **kwargs):
+    import pandas as pd
+    return pd.read_json(path, **kwargs)
+
+
+def _read_files(paths: List[str], reader, num_shards: Optional[int], **kwargs
+                ) -> LocalXShards:
+    if not paths:
+        raise FileNotFoundError("no input files found")
+    with ThreadPoolExecutor(max_workers=_pool_size()) as pool:
+        dfs = list(pool.map(lambda p: reader(p, **kwargs), paths))
+    shards = LocalXShards(dfs)
+    if ZooContext.shard_size:  # rows-per-shard flag wins over num_shards
+        total = sum(len(d) for d in dfs)
+        nparts = max(1, -(-total // ZooContext.shard_size))
+        return shards.repartition(nparts)
+    if num_shards and num_shards != shards.num_partitions():
+        return shards.repartition(num_shards)
+    return shards
+
+
+def read_csv(file_path: str, num_shards: Optional[int] = None, **kwargs
+             ) -> LocalXShards:
+    """Read csv file(s)/folder/glob into an XShards of pandas DataFrames
+    (reference: ``preprocessing.py`` ``read_csv``). Extra kwargs pass through
+    to the underlying reader."""
+    return _read_files(list_files(file_path), _read_one_csv, num_shards,
+                       **kwargs)
+
+
+def read_json(file_path: str, num_shards: Optional[int] = None, **kwargs
+              ) -> LocalXShards:
+    """Read json file(s) into an XShards of pandas DataFrames (reference:
+    ``preprocessing.py`` ``read_json``)."""
+    return _read_files(list_files(file_path), _read_one_json, num_shards,
+                       **kwargs)

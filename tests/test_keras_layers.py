@@ -1,0 +1,87 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from zoo_tpu.pipeline.api.keras.layers import (
+    Activation, BatchNormalization, Dense, Dropout, Embedding, Flatten,
+    Merge, Reshape, merge,
+)
+
+
+def _build_call(layer, x, training=False, rng=None):
+    params = layer.build(jax.random.PRNGKey(0), (None,) + x.shape[1:])
+    return params, layer.call(params, jnp.asarray(x),
+                              training=training, rng=rng)
+
+
+def test_dense_shapes_and_math():
+    x = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+    layer = Dense(5, activation="relu")
+    params, y = _build_call(layer, x)
+    assert y.shape == (4, 5)
+    expected = np.maximum(x @ np.asarray(params["W"]) + np.asarray(params["b"]), 0)
+    np.testing.assert_allclose(np.asarray(y), expected, rtol=1e-5)
+    assert layer.compute_output_shape((None, 3)) == (None, 5)
+
+
+def test_dense_no_bias_and_init():
+    layer = Dense(2, bias=False, init="zero")
+    x = np.ones((2, 3), np.float32)
+    params, y = _build_call(layer, x)
+    assert "b" not in params
+    np.testing.assert_array_equal(np.asarray(y), np.zeros((2, 2)))
+
+
+def test_dropout_train_vs_eval():
+    x = np.ones((8, 100), np.float32)
+    layer = Dropout(0.5)
+    _, y_eval = _build_call(layer, x, training=False)
+    np.testing.assert_array_equal(np.asarray(y_eval), x)
+    _, y_train = _build_call(layer, x, training=True,
+                             rng=jax.random.PRNGKey(1))
+    arr = np.asarray(y_train)
+    assert ((arr == 0) | (arr == 2.0)).all()
+    assert 0.3 < (arr == 0).mean() < 0.7
+
+
+def test_embedding():
+    layer = Embedding(10, 4)
+    ids = np.array([[1, 2], [3, 9]])
+    params, y = _build_call(layer, ids)
+    assert y.shape == (2, 2, 4)
+    np.testing.assert_allclose(np.asarray(y)[0, 0], np.asarray(params["E"])[1])
+
+
+def test_flatten_reshape():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    _, y = _build_call(Flatten(), x)
+    assert y.shape == (2, 12)
+    _, z = _build_call(Reshape((4, -1)), x)
+    assert z.shape == (2, 4, 3)
+
+
+def test_batchnorm_train_stats():
+    x = np.random.RandomState(0).randn(64, 5).astype(np.float32) * 3 + 1
+    layer = BatchNormalization()
+    params = layer.build(jax.random.PRNGKey(0), (None, 5))
+    y = layer.call(params, jnp.asarray(x), training=True)
+    arr = np.asarray(y)
+    np.testing.assert_allclose(arr.mean(axis=0), 0, atol=1e-4)
+    np.testing.assert_allclose(arr.std(axis=0), 1, atol=1e-2)
+    new_stats = layer.updated_stats(params, jnp.asarray(x))
+    assert not np.allclose(np.asarray(new_stats["mean"]), 0)
+
+
+def test_merge_modes():
+    a = np.ones((2, 3), np.float32)
+    b = np.full((2, 3), 2.0, np.float32)
+    m = Merge(mode="concat")
+    y = m.call({}, [jnp.asarray(a), jnp.asarray(b)])
+    assert y.shape == (2, 6)
+    y = Merge(mode="sum").call({}, [jnp.asarray(a), jnp.asarray(b)])
+    np.testing.assert_array_equal(np.asarray(y), np.full((2, 3), 3.0))
+    y = Merge(mode="dot").call({}, [jnp.asarray(a), jnp.asarray(b)])
+    np.testing.assert_array_equal(np.asarray(y), np.full((2, 1), 6.0))
+    assert Merge(mode="concat").compute_output_shape(
+        [(None, 3), (None, 4)]) == (None, 7)

@@ -1,0 +1,3 @@
+from zoo_tpu.pipeline.api.keras.engine.topology import Input, Model, Sequential
+
+__all__ = ["Input", "Model", "Sequential"]

@@ -1,0 +1,170 @@
+"""Layer protocol for the Keras-style API.
+
+Rebuild of the reference's BigDL-backed Keras-1 layer system
+(``pyzoo/zoo/pipeline/api/keras/engine/topology.py`` + the Scala
+``pipeline/api/keras/layers/**``). The reference builds a Scala module graph
+behind Py4J handles; here a layer is a tiny Python object with
+
+- ``build(rng, input_shape) -> params``  (a plain JAX pytree)
+- ``call(params, inputs, *, training, rng) -> outputs``  (pure, jittable)
+- ``compute_output_shape(input_shape)``
+
+so a whole model is just (pytree of params, pure function) — exactly what
+``jax.jit`` / ``jax.grad`` / ``pjit`` want. Shapes follow keras-1
+conventions: ``input_shape`` excludes the batch dimension; ``None`` marks
+the batch axis in reported shapes.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_NAME_COUNTERS: Dict[str, int] = collections.defaultdict(int)
+
+
+def _auto_name(cls_name: str) -> str:
+    _NAME_COUNTERS[cls_name] += 1
+    return f"{cls_name.lower()}_{_NAME_COUNTERS[cls_name]}"
+
+
+# ---------------------------------------------------------------------------
+# Initializers (keras-1 names; reference: KerasUtils.getInitMethod)
+# ---------------------------------------------------------------------------
+
+def get_initializer(name: Union[str, Callable]) -> Callable:
+    if callable(name):
+        return name
+    name = (name or "glorot_uniform").lower()
+    init = jax.nn.initializers
+    table = {
+        "glorot_uniform": init.glorot_uniform(),
+        "glorot_normal": init.glorot_normal(),
+        "he_uniform": init.he_uniform(),
+        "he_normal": init.he_normal(),
+        "lecun_uniform": init.lecun_uniform(),
+        "lecun_normal": init.lecun_normal(),
+        "uniform": init.uniform(scale=0.05),
+        "normal": init.normal(stddev=0.05),
+        "zero": init.zeros,
+        "zeros": init.zeros,
+        "one": init.ones,
+        "ones": init.ones,
+        "orthogonal": init.orthogonal(),
+    }
+    if name not in table:
+        raise ValueError(f"unknown initializer: {name}")
+    return table[name]
+
+
+def get_activation_fn(name: Optional[Union[str, Callable]]) -> Optional[Callable]:
+    if name is None or callable(name):
+        return name
+    name = name.lower()
+    table = {
+        "relu": jax.nn.relu,
+        "relu6": jax.nn.relu6,
+        "tanh": jnp.tanh,
+        "sigmoid": jax.nn.sigmoid,
+        "hard_sigmoid": jax.nn.hard_sigmoid,
+        "softmax": jax.nn.softmax,
+        "log_softmax": jax.nn.log_softmax,
+        "softplus": jax.nn.softplus,
+        "softsign": jax.nn.soft_sign,
+        "elu": jax.nn.elu,
+        "selu": jax.nn.selu,
+        "gelu": jax.nn.gelu,
+        "silu": jax.nn.silu,
+        "swish": jax.nn.silu,
+        "exp": jnp.exp,
+        "linear": lambda x: x,
+    }
+    if name not in table:
+        raise ValueError(f"unknown activation: {name}")
+    return table[name]
+
+
+# ---------------------------------------------------------------------------
+# Symbolic tensors for the functional API
+# ---------------------------------------------------------------------------
+
+class KTensor:
+    """Symbolic tensor node in the functional graph (the reference's
+    ``Variable``/node handles built via Py4J)."""
+
+    def __init__(self, shape: Tuple, layer: Optional["Layer"] = None,
+                 inbound: Sequence["KTensor"] = (), dtype=jnp.float32):
+        self.shape = tuple(shape)  # includes None batch dim
+        self.layer = layer
+        self.inbound = list(inbound)
+        self.dtype = dtype
+
+    def __repr__(self):
+        lname = self.layer.name if self.layer else "input"
+        return f"KTensor(shape={self.shape}, from={lname})"
+
+
+class Layer:
+    """Base layer. Subclasses implement ``build``/``call``/
+    ``compute_output_shape`` (stateless pure functions of params)."""
+
+    def __init__(self, input_shape: Optional[Tuple] = None,
+                 name: Optional[str] = None, **kwargs):
+        self.name = name or _auto_name(type(self).__name__)
+        # keras-1: input_shape excludes the batch dim
+        self.batch_input_shape = (None,) + tuple(input_shape) \
+            if input_shape is not None else None
+        self.built_shape = None
+
+    # -- to override -----------------------------------------------------
+    def build(self, rng, input_shape) -> Any:
+        """Create params for ``input_shape`` (with leading None batch dim).
+        Default: parameterless layer."""
+        return {}
+
+    def call(self, params, inputs, *, training: bool = False, rng=None):
+        raise NotImplementedError
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape)
+
+    # -- functional API ---------------------------------------------------
+    def __call__(self, x: Union[KTensor, Sequence[KTensor]]) -> KTensor:
+        inbound = list(x) if isinstance(x, (list, tuple)) else [x]
+        in_shape = ([t.shape for t in inbound] if len(inbound) > 1
+                    else inbound[0].shape)
+        out_shape = self.compute_output_shape(in_shape)
+        return KTensor(out_shape, layer=self, inbound=inbound)
+
+    # -- utilities --------------------------------------------------------
+    def param_count(self, params) -> int:
+        return sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(params))
+
+    def get_config(self) -> Dict:
+        return {"name": self.name}
+
+    def __repr__(self):
+        return f"{type(self).__name__}(name={self.name})"
+
+
+def layer_rng(rng, layer_name: str):
+    """Deterministic per-layer rng derivation for dropout etc. Uses a stable
+    digest (NOT Python hash(), which is salted per process and would make
+    SPMD hosts trace different fold_in constants)."""
+    if rng is None:
+        return None
+    import zlib
+    return jax.random.fold_in(rng, zlib.crc32(layer_name.encode()))
+
+
+def normalize_shape(shape) -> Tuple:
+    """Accept (None, ...) or (...) and return a (None, ...) shape."""
+    shape = tuple(shape)
+    if not shape or shape[0] is not None:
+        return (None,) + shape
+    return shape

@@ -1,0 +1,112 @@
+"""Data normalization helpers shared by the Keras facade and Orca estimators.
+
+Rebuild of the input plumbing the reference spreads across
+``pyzoo/zoo/orca/learn/utils.py`` (DataFrame/XShards → feature dicts) and
+``tfpark/tf_dataset.py`` (ndarray feeds): everything becomes
+``(list_of_input_arrays, label_array_or_None)`` host-side, then batches are
+device_put with the batch sharding.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def to_xy_arrays(x, y=None, feature_cols: Optional[Sequence[str]] = None,
+                 label_cols: Optional[Sequence[str]] = None
+                 ) -> Tuple[List[np.ndarray], Optional[np.ndarray]]:
+    """Normalize supported inputs to (inputs_list, labels).
+
+    Accepts: numpy array(s), dict {"x": ..., "y": ...}, XShards of such
+    dicts or of DataFrames (with feature_cols/label_cols), pandas DataFrame
+    (with feature_cols/label_cols).
+    """
+    from zoo_tpu.orca.data.shard import LocalXShards
+
+    if isinstance(x, LocalXShards):
+        first = x.collect()[0]
+        import pandas as pd
+        if isinstance(first, pd.DataFrame):
+            if not feature_cols:
+                raise ValueError("feature_cols required for DataFrame shards")
+            stacked = x.stack_numpy(list(feature_cols) + list(label_cols or []))
+            xs = [stacked[c] for c in feature_cols]
+            ys = _stack_labels([stacked[c] for c in (label_cols or [])])
+            return xs, ys
+        if isinstance(first, dict):
+            stacked = x.stack_numpy()
+            xs = _as_list(stacked.get("x"))
+            ys = stacked.get("y")
+            return xs, ys
+        raise ValueError(f"unsupported shard type: {type(first)}")
+
+    try:
+        import pandas as pd
+        if isinstance(x, pd.DataFrame):
+            if not feature_cols:
+                raise ValueError("feature_cols required for DataFrame input")
+            missing = [c for c in list(feature_cols) + list(label_cols or [])
+                       if c not in x.columns]
+            if missing:
+                raise ValueError(f"feature/label column(s) not found: "
+                                 f"{missing}; available: {list(x.columns)}")
+            xs = [x[c].to_numpy() for c in feature_cols]
+            ys = _stack_labels([x[c].to_numpy() for c in (label_cols or [])])
+            return xs, ys
+    except ImportError:
+        pass
+
+    if isinstance(x, dict):
+        return _as_list(x["x"]), x.get("y")
+    return _as_list(x), (None if y is None else np.asarray(y))
+
+
+def _as_list(x) -> List[np.ndarray]:
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return [np.asarray(a) for a in x]
+    return [np.asarray(x)]
+
+
+def _stack_labels(cols: List[np.ndarray]) -> Optional[np.ndarray]:
+    if not cols:
+        return None
+    if len(cols) == 1:
+        return cols[0]
+    return np.stack(cols, axis=-1)
+
+
+def num_samples(xs: List[np.ndarray]) -> int:
+    return int(xs[0].shape[0]) if xs else 0
+
+
+def batch_slices(n: int, batch_size: int, shuffle: bool,
+                 rng: Optional[np.random.RandomState] = None,
+                 drop_remainder: bool = True):
+    """Yield index arrays per batch. Training drops the ragged tail (the
+    reference enforces ``batch_size % cores == 0`` and fixed per-replica
+    batches, ``tf_dataset.py:188``); inference pads instead (see
+    ``pad_batch``)."""
+    idx = np.arange(n)
+    if shuffle:
+        (rng or np.random).shuffle(idx)
+    n_batches = n // batch_size if drop_remainder else -(-n // batch_size)
+    for b in range(n_batches):
+        yield idx[b * batch_size:(b + 1) * batch_size]
+
+
+def pad_batch(arrs: List[np.ndarray], batch_size: int
+              ) -> Tuple[List[np.ndarray], int]:
+    """Pad a ragged final batch up to ``batch_size`` by repeating row 0;
+    returns (padded, real_count)."""
+    real = arrs[0].shape[0]
+    if real == batch_size:
+        return arrs, real
+    out = []
+    for a in arrs:
+        pad = np.repeat(a[:1], batch_size - real, axis=0)
+        out.append(np.concatenate([a, pad], axis=0))
+    return out, real

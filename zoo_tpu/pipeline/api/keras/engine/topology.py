@@ -1,0 +1,528 @@
+"""Sequential / functional Model with compile/fit/evaluate/predict.
+
+Rebuild of the reference's ``KerasNet`` (Scala
+``pipeline/api/keras/models/Topology.scala:139,347,504`` — compile/fit/
+evaluate/predict over FeatureSet + InternalDistriOptimizer) and the Python
+facade ``pyzoo/zoo/pipeline/api/keras/engine/topology.py``.
+
+The TPU re-architecture collapses the reference's per-iteration "2 Spark
+jobs + JNI weight push/pull + PS-shuffle allreduce" (``Topology.scala:1262``,
+``wp-bigdl.md:146-160``) into ONE jitted XLA computation per step: forward,
+backward, gradient allreduce over the mesh ``data`` axes, and the optimizer
+update are fused and scheduled by XLA; weights never leave the device.
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from zoo_tpu.common.context import get_runtime_context
+from zoo_tpu.pipeline.api.keras.engine.base import KTensor, Layer
+from zoo_tpu.pipeline.api.keras.engine import data_utils
+from zoo_tpu.pipeline.api.keras.metrics import Metric, get_metric
+from zoo_tpu.pipeline.api.keras.objectives import get_loss
+from zoo_tpu.pipeline.api.keras.optimizers import get_optimizer
+
+
+def _split_state(params: Dict) -> Tuple[Dict, Dict]:
+    """Separate non-trainable running stats (e.g. BatchNorm) from trainable
+    params so grads are only taken w.r.t. the latter."""
+    trainable, state = {}, {}
+    for lname, p in params.items():
+        if isinstance(p, dict) and "stats" in p:
+            state[lname] = {"stats": p["stats"]}
+            trainable[lname] = {k: v for k, v in p.items() if k != "stats"}
+        else:
+            trainable[lname] = p
+    return trainable, state
+
+
+def _merge_state(trainable: Dict, state: Dict) -> Dict:
+    out = dict(trainable)
+    for lname, st in state.items():
+        merged = dict(out.get(lname, {}))
+        merged.update(st)
+        out[lname] = merged
+    return out
+
+
+class TrainSummary:
+    """Scalar training summaries with read-back (reference: Scala
+    ``TrainSummary`` + ``get_train_summary(tag)`` surfaced at
+    ``orca/learn/tf/estimator.py:167-221``). Optionally tees into a
+    tensorboardX writer."""
+
+    def __init__(self, log_dir: Optional[str] = None, app_name: str = "zoo"):
+        self._scalars: Dict[str, List[Tuple[int, float]]] = {}
+        self._writer = None
+        if log_dir is not None:
+            try:
+                from tensorboardX import SummaryWriter
+                import os
+                self._writer = SummaryWriter(
+                    logdir=os.path.join(log_dir, app_name))
+            except ImportError:
+                pass
+
+    def add_scalar(self, tag: str, value: float, step: int):
+        self._scalars.setdefault(tag, []).append((step, float(value)))
+        if self._writer is not None:
+            self._writer.add_scalar(tag, value, step)
+
+    def read_scalar(self, tag: str) -> List[Tuple[int, float]]:
+        return list(self._scalars.get(tag, []))
+
+    def close(self):
+        if self._writer is not None:
+            self._writer.close()
+
+
+class KerasNet:
+    """Shared training engine for Sequential and Model."""
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name or type(self).__name__.lower()
+        self.params: Optional[Dict] = None
+        self.optimizer = None
+        self.loss_fn: Optional[Callable] = None
+        self.metrics: List[Metric] = []
+        self._opt_state = None
+        self._step = 0
+        self.train_summary = TrainSummary()
+        self.validation_summary = TrainSummary()
+        self._jit_train = None
+        self._jit_eval = None
+        self._jit_pred = None
+        self._built_shapes: Optional[List[Tuple]] = None
+
+    # -- param keys --------------------------------------------------------
+    def _param_keys(self) -> Dict[int, str]:
+        """Deterministic params keys by layer position+type (NOT the
+        process-global auto names) so checkpoints restore into fresh model
+        instances — the reference gets this for free from its Scala module
+        serialization; position-keying is our equivalent."""
+        return {id(layer): f"{i:03d}_{type(layer).__name__.lower()}"
+                for i, layer in enumerate(self.layers)}
+
+    def _key_of(self, layer) -> str:
+        return self._param_keys()[id(layer)]
+
+    # -- to be provided by subclasses ------------------------------------
+    def _init_params(self, rng, input_shapes) -> Dict:
+        raise NotImplementedError
+
+    def _forward(self, params, inputs: List, *, training: bool, rng,
+                 collect: Optional[Dict]):
+        raise NotImplementedError
+
+    def _input_shapes(self) -> Optional[List[Tuple]]:
+        raise NotImplementedError
+
+    @property
+    def layers(self) -> List[Layer]:
+        raise NotImplementedError
+
+    # -- public API (keras-1 names, reference Topology.scala) -------------
+    def compile(self, optimizer, loss, metrics=None):
+        """reference: ``KerasNet.compile`` ``Topology.scala:139``."""
+        self.optimizer = get_optimizer(optimizer)
+        self.loss_fn = get_loss(loss)
+        self.metrics = [get_metric(m) for m in (metrics or [])]
+        self._jit_train = self._jit_eval = self._jit_pred = None
+        self._opt_state = None  # a new optimizer cannot reuse old state
+        return self
+
+    def set_tensorboard(self, log_dir: str, app_name: str):
+        """reference: ``Topology.scala:162-168``."""
+        self.train_summary = TrainSummary(log_dir, app_name + "/train")
+        self.validation_summary = TrainSummary(log_dir, app_name + "/val")
+
+    def get_train_summary(self, tag: str = "Loss"):
+        return self.train_summary.read_scalar(tag)
+
+    def get_validation_summary(self, tag: str):
+        return self.validation_summary.read_scalar(tag)
+
+    def build(self, rng=None, input_shapes=None):
+        """Materialize params (idempotent)."""
+        if self.params is not None:
+            return self.params
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        shapes = input_shapes or self._input_shapes()
+        if shapes is None:
+            raise ValueError(
+                f"{self.name}: cannot infer input shape; pass input_shape to "
+                "the first layer or call build(input_shapes=...)")
+        self._built_shapes = [tuple(s) for s in shapes]
+        self.params = self._init_params(rng, shapes)
+        return self.params
+
+    def _n_inputs(self) -> int:
+        shapes = self._built_shapes or self._input_shapes()
+        return len(shapes) if shapes else 1
+
+    # -- devices / sharding ----------------------------------------------
+    def _mesh(self):
+        ctx = get_runtime_context(required=False)
+        return ctx.mesh if ctx is not None else None
+
+    def _place(self, params):
+        mesh = self._mesh()
+        if mesh is None:
+            return params
+        from zoo_tpu.parallel.mesh import replicated_sharding
+        sh = replicated_sharding(mesh)
+        return jax.tree_util.tree_map(lambda p: jax.device_put(p, sh), params)
+
+    def _put_batch(self, arrs: List[np.ndarray]):
+        mesh = self._mesh()
+        if mesh is None:
+            return [jnp.asarray(a) for a in arrs]
+        from zoo_tpu.parallel.mesh import batch_sharding
+        return [jax.device_put(a, batch_sharding(mesh, a.ndim)) for a in arrs]
+
+    def _adapt_inputs(self, xs: List[np.ndarray]) -> List[np.ndarray]:
+        """Single-input model fed k feature columns → stack into one
+        (batch, k) tensor (the reference's NNEstimator assembles feature
+        cols the same way via SeqToTensor, ``feature/common.py:94``)."""
+        shapes = self._input_shapes() or self._built_shapes
+        if shapes and len(shapes) == 1 and len(xs) > 1 \
+                and all(a.ndim == 1 for a in xs):
+            return [np.stack(xs, axis=1)]
+        return xs
+
+    # -- jitted steps -----------------------------------------------------
+    def _build_train_step(self):
+        tx = self.optimizer.make()
+        n_inputs = self._n_inputs()
+
+        def step(params, opt_state, rng, *batch):
+            xs, ys = list(batch[:n_inputs]), batch[n_inputs]
+            trainable, state = _split_state(params)
+
+            def loss_fn(tr):
+                collect = {}
+                preds = self._forward(_merge_state(tr, state), xs,
+                                      training=True, rng=rng, collect=collect)
+                return self.loss_fn(ys, preds), collect
+
+            (loss, collect), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(trainable)
+            updates, opt_state = tx.update(grads, opt_state, trainable)
+            import optax
+            trainable = optax.apply_updates(trainable, updates)
+            new_params = _merge_state(trainable, collect or state)
+            return new_params, opt_state, loss
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def _build_pred_step(self):
+        def step(params, *xs):
+            return self._forward(params, list(xs), training=False, rng=None,
+                                 collect=None)
+        return jax.jit(step)
+
+    # -- training loop ----------------------------------------------------
+    def fit(self, x, y=None, batch_size: int = 32, nb_epoch: int = 10,
+            validation_data=None, shuffle: bool = True,
+            feature_cols=None, label_cols=None, seed: int = 0,
+            verbose: int = 1) -> Dict[str, List[float]]:
+        """reference: ``KerasNet.fit`` ``Topology.scala:347`` (trains via
+        InternalDistriOptimizer there; a jitted step loop here)."""
+        if self.loss_fn is None:
+            raise RuntimeError("call compile() before fit()")
+        xs, ys = data_utils.to_xy_arrays(x, y, feature_cols, label_cols)
+        xs = self._adapt_inputs(xs)
+        if ys is None:
+            raise ValueError("fit requires labels")
+        n = data_utils.num_samples(xs)
+
+        mesh = self._mesh()
+        if mesh is not None:
+            from zoo_tpu.parallel.mesh import validate_batch_size
+            validate_batch_size(batch_size, mesh)
+        if n < batch_size:
+            raise ValueError(f"dataset ({n}) smaller than batch_size "
+                             f"({batch_size})")
+
+        self.build(jax.random.PRNGKey(seed),
+                   [(None,) + a.shape[1:] for a in xs])
+        params = self._place(self.params)
+        tx = self.optimizer.make()
+        trainable, _ = _split_state(params)
+        opt_state = self._opt_state or tx.init(trainable)
+        if self._jit_train is None:
+            self._jit_train = self._build_train_step()
+
+        rng = jax.random.PRNGKey(seed + 1)
+        nprng = np.random.RandomState(seed)
+        val_arrays = None
+        if validation_data is not None:
+            val_arrays = data_utils.to_xy_arrays(
+                validation_data[0] if isinstance(validation_data, tuple)
+                else validation_data,
+                validation_data[1] if isinstance(validation_data, tuple)
+                and len(validation_data) > 1 else None,
+                feature_cols, label_cols)
+            val_arrays = (self._adapt_inputs(val_arrays[0]), val_arrays[1])
+        history: Dict[str, List[float]] = {"loss": []}
+        for epoch in range(nb_epoch):
+            t0 = time.time()
+            losses = []
+            for idx in data_utils.batch_slices(n, batch_size, shuffle, nprng):
+                batch = self._put_batch([a[idx] for a in xs] + [ys[idx]])
+                rng, step_rng = jax.random.split(rng)
+                params, opt_state, loss = self._jit_train(
+                    params, opt_state, step_rng, *batch)
+                self._step += 1
+                losses.append(loss)
+            epoch_loss = float(np.mean([float(l) for l in losses]))
+            history["loss"].append(epoch_loss)
+            self.train_summary.add_scalar("Loss", epoch_loss, self._step)
+            self.train_summary.add_scalar(
+                "Throughput",
+                len(losses) * batch_size / max(time.time() - t0, 1e-9),
+                self._step)
+            if val_arrays is not None:
+                vx, vy = val_arrays
+                self.params = params  # evaluate on current params
+                val = self._evaluate_arrays(vx, vy, batch_size)
+                for k, v in val.items():
+                    history.setdefault("val_" + k, []).append(v)
+                    self.validation_summary.add_scalar(k, v, self._step)
+            if verbose:
+                extra = {k: v[-1] for k, v in history.items() if k != "loss"}
+                print(f"Epoch {epoch + 1}/{nb_epoch} - loss: "
+                      f"{epoch_loss:.4f}" +
+                      "".join(f" - {k}: {v:.4f}" for k, v in extra.items()))
+        self.params = jax.device_get(params) if mesh is None else params
+        self._opt_state = opt_state
+        return history
+
+    # -- evaluation / inference -------------------------------------------
+    def _shard_multiple(self) -> int:
+        mesh = self._mesh()
+        if mesh is None:
+            return 1
+        from zoo_tpu.parallel.mesh import data_axes
+        denom = 1
+        for a in data_axes(mesh):
+            denom *= mesh.shape[a]
+        return denom
+
+    def _predict_arrays(self, xs, batch_size: int) -> np.ndarray:
+        if self._jit_pred is None:
+            self._jit_pred = self._build_pred_step()
+        params = self._place(self.params)
+        n = data_utils.num_samples(xs)
+        mult = self._shard_multiple()
+        bs = max(mult, (min(batch_size, n) // mult) * mult)
+        outs = []
+        for idx in data_utils.batch_slices(n, bs, False,
+                                           drop_remainder=False):
+            chunk = [a[idx] for a in xs]
+            padded, real = data_utils.pad_batch(chunk, bs)
+            preds = self._jit_pred(params, *self._put_batch(padded))
+            outs.append(np.asarray(preds)[:real])
+        return np.concatenate(outs, axis=0)
+
+    def _evaluate_arrays(self, xs, ys, batch_size) -> Dict[str, float]:
+        """Exact (non-approximated) evaluation: predictions are computed in
+        sharded batches, loss/metrics reduced once over the full set."""
+        preds = jnp.asarray(self._predict_arrays(xs, batch_size))
+        yt = jnp.asarray(ys)
+        out = {}
+        if self.loss_fn is not None:
+            out["loss"] = float(self.loss_fn(yt, preds))
+        for m in self.metrics:
+            s, c = m.batch_eval(yt, preds)
+            out[m.name] = float(m.finalize(s, c))
+        return out
+
+    def evaluate(self, x, y=None, batch_size: int = 32,
+                 feature_cols=None, label_cols=None) -> Dict[str, float]:
+        """reference: ``KerasNet.evaluate`` ``Topology.scala:504``."""
+        xs, ys = data_utils.to_xy_arrays(x, y, feature_cols, label_cols)
+        xs = self._adapt_inputs(xs)
+        if ys is None:
+            raise ValueError("evaluate requires labels")
+        return self._evaluate_arrays(xs, ys, batch_size)
+
+    def predict(self, x, batch_size: int = 256, feature_cols=None
+                ) -> np.ndarray:
+        """reference: ``KerasNet.predict`` (distributed Predictor.scala).
+        Ragged tails are padded then trimmed (the reference pads per-thread
+        batches for inference, ``tf_dataset.py`` per-thread batch)."""
+        xs, _ = data_utils.to_xy_arrays(x, None, feature_cols, None)
+        xs = self._adapt_inputs(xs)
+        if self.params is None:
+            self.build(input_shapes=[(None,) + a.shape[1:] for a in xs])
+        return self._predict_arrays(xs, batch_size)
+
+    # -- persistence -------------------------------------------------------
+    def save_weights(self, path: str):
+        host = jax.tree_util.tree_map(np.asarray, self.params)
+        with open(path, "wb") as f:
+            pickle.dump({"params": host, "step": self._step}, f)
+
+    def load_weights(self, path: str):
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        self.params = blob["params"]
+        self._step = blob.get("step", 0)
+        return self
+
+    def summary(self):
+        lines = [f'Model: "{self.name}"', "-" * 60]
+        total = 0
+        params = self.params or {}
+        for layer in self.layers:
+            p = params.get(self._key_of(layer), {})
+            cnt = layer.param_count(p)
+            total += cnt
+            lines.append(f"{layer.name:<30}{type(layer).__name__:<20}{cnt}")
+        lines.append("-" * 60)
+        lines.append(f"Total params: {total}")
+        print("\n".join(lines))
+        return total
+
+
+class Sequential(KerasNet):
+    """Linear stack (reference: ``Sequential`` ``Topology.scala:1029``,
+    Python ``keras/engine/topology.py:49``)."""
+
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(name=name)
+        self._layers: List[Layer] = []
+
+    @property
+    def layers(self) -> List[Layer]:
+        return self._layers
+
+    def add(self, layer: Layer) -> "Sequential":
+        self._layers.append(layer)
+        self.params = None  # invalidate
+        return self
+
+    def _input_shapes(self):
+        if self._layers and self._layers[0].batch_input_shape is not None:
+            return [self._layers[0].batch_input_shape]
+        return None
+
+    def _init_params(self, rng, input_shapes) -> Dict:
+        shape = tuple(input_shapes[0])
+        params: Dict = {}
+        for layer in self._layers:
+            rng, sub = jax.random.split(rng)
+            params[self._key_of(layer)] = layer.build(sub, shape)
+            shape = layer.compute_output_shape(shape)
+        return params
+
+    def _forward(self, params, inputs: List, *, training, rng, collect):
+        h = inputs[0] if len(inputs) == 1 else inputs
+        for layer in self._layers:
+            key = self._key_of(layer)
+            p = params.get(key, {})
+            if collect is not None and hasattr(layer, "updated_stats") \
+                    and training:
+                collect[key] = {"stats": layer.updated_stats(p, h)}
+            h = layer.call(p, h, training=training, rng=rng)
+        return h
+
+    def get_output_shape(self):
+        shapes = self._input_shapes()
+        shape = shapes[0]
+        for layer in self._layers:
+            shape = layer.compute_output_shape(shape)
+        return shape
+
+
+def Input(shape: Tuple, name: Optional[str] = None) -> KTensor:
+    """Symbolic input (reference: ``Input`` in
+    ``keras/engine/topology.py``; shape excludes batch)."""
+    return KTensor((None,) + tuple(shape))
+
+
+class Model(KerasNet):
+    """Functional graph model (reference: ``Model`` ``Topology.scala:1145``
+    Python ``keras/models.py``)."""
+
+    def __init__(self, input: Union[KTensor, Sequence[KTensor]],
+                 output: Union[KTensor, Sequence[KTensor]],
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.inputs = list(input) if isinstance(input, (list, tuple)) \
+            else [input]
+        if isinstance(output, (list, tuple)):
+            raise NotImplementedError("multi-output Model not yet supported")
+        self.output = output
+        self._topo = self._toposort()
+
+    def _toposort(self) -> List[KTensor]:
+        seen, order = set(), []
+
+        def visit(node: KTensor):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            for parent in node.inbound:
+                visit(parent)
+            order.append(node)
+
+        visit(self.output)
+        for t in self.inputs:
+            if id(t) not in seen:
+                raise ValueError("an input tensor is not connected to output")
+        return order
+
+    @property
+    def layers(self) -> List[Layer]:
+        out, seen = [], set()
+        for node in self._topo:
+            if node.layer is not None and id(node.layer) not in seen:
+                seen.add(id(node.layer))
+                out.append(node.layer)
+        return out
+
+    def _input_shapes(self):
+        return [t.shape for t in self.inputs]
+
+    def _init_params(self, rng, input_shapes) -> Dict:
+        params: Dict = {}
+        shapes = {id(t): tuple(s) for t, s in zip(self.inputs, input_shapes)}
+        for node in self._topo:
+            if node.layer is None:
+                continue
+            in_shapes = [shapes[id(p)] for p in node.inbound]
+            arg = in_shapes if len(in_shapes) > 1 else in_shapes[0]
+            key = self._key_of(node.layer)
+            if key not in params:  # shared layers build once
+                rng, sub = jax.random.split(rng)
+                params[key] = node.layer.build(sub, arg)
+            shapes[id(node)] = node.layer.compute_output_shape(arg)
+        return params
+
+    def _forward(self, params, inputs: List, *, training, rng, collect):
+        values = {id(t): v for t, v in zip(self.inputs, inputs)}
+        for node in self._topo:
+            if node.layer is None:
+                if id(node) not in values:
+                    raise ValueError("missing input value")
+                continue
+            args = [values[id(p)] for p in node.inbound]
+            arg = args if len(args) > 1 else args[0]
+            key = self._key_of(node.layer)
+            p = params.get(key, {})
+            if collect is not None and hasattr(node.layer, "updated_stats") \
+                    and training:
+                collect[key] = {
+                    "stats": node.layer.updated_stats(p, arg)}
+            values[id(node)] = node.layer.call(p, arg, training=training,
+                                               rng=rng)
+        return values[id(self.output)]

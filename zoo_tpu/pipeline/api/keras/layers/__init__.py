@@ -1,0 +1,22 @@
+from zoo_tpu.pipeline.api.keras.layers.core import (
+    Activation,
+    BatchNormalization,
+    Dense,
+    Dropout,
+    Embedding,
+    Flatten,
+    GaussianNoise,
+    InputLayer,
+    Lambda,
+    Merge,
+    Permute,
+    RepeatVector,
+    Reshape,
+    merge,
+)
+
+__all__ = [
+    "Activation", "BatchNormalization", "Dense", "Dropout", "Embedding",
+    "Flatten", "GaussianNoise", "InputLayer", "Lambda", "Merge", "Permute",
+    "RepeatVector", "Reshape", "merge",
+]

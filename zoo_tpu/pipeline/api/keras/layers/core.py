@@ -1,0 +1,331 @@
+"""Core keras-1 layers on JAX.
+
+Rebuild of the reference's core layer set (Python wrappers
+``pyzoo/zoo/pipeline/api/keras/layers/core.py``, Scala implementations
+``zoo/src/main/scala/com/intel/analytics/zoo/pipeline/api/keras/layers/``).
+Keras-1 argument names are preserved (``output_dim``, ``init``, ``W_regularizer``,
+``bias``) so reference user code ports by changing the import line.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from zoo_tpu.pipeline.api.keras.engine.base import (
+    KTensor,
+    Layer,
+    get_activation_fn,
+    get_initializer,
+    layer_rng,
+    normalize_shape,
+)
+
+
+class InputLayer(Layer):
+    """Placeholder layer (reference: ``core.py`` ``InputLayer``)."""
+
+    def __init__(self, input_shape=None, **kwargs):
+        super().__init__(input_shape=input_shape, **kwargs)
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        return inputs
+
+
+class Dense(Layer):
+    """Fully-connected layer, keras-1 style (reference: Scala ``Dense.scala``,
+    Python ``core.py`` ``Dense``). ``output_dim`` / ``init`` / ``bias``
+    keyword names match the reference."""
+
+    def __init__(self, output_dim: int, init="glorot_uniform",
+                 activation=None, bias: bool = True,
+                 W_regularizer=None, b_regularizer=None,
+                 input_dim: Optional[int] = None, **kwargs):
+        if input_dim is not None and kwargs.get("input_shape") is None:
+            kwargs["input_shape"] = (input_dim,)
+        super().__init__(**kwargs)
+        self.output_dim = int(output_dim)
+        self.init = get_initializer(init)
+        self.activation = get_activation_fn(activation)
+        self.bias = bias
+        self.W_regularizer = W_regularizer
+        self.b_regularizer = b_regularizer
+
+    def build(self, rng, input_shape):
+        in_dim = input_shape[-1]
+        k_w, _ = jax.random.split(rng)
+        params = {"W": self.init(k_w, (in_dim, self.output_dim), jnp.float32)}
+        if self.bias:
+            params["b"] = jnp.zeros((self.output_dim,), jnp.float32)
+        return params
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        y = jnp.matmul(inputs, params["W"])
+        if self.bias:
+            y = y + params["b"]
+        return self.activation(y) if self.activation else y
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape[:-1]) + (self.output_dim,)
+
+
+class Activation(Layer):
+    def __init__(self, activation, **kwargs):
+        super().__init__(**kwargs)
+        self.activation = get_activation_fn(activation)
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        return self.activation(inputs)
+
+
+class Dropout(Layer):
+    """Inverted dropout (reference: ``core.py`` ``Dropout``); identity at
+    inference like the reference's BigDL Dropout."""
+
+    def __init__(self, p: float, **kwargs):
+        super().__init__(**kwargs)
+        self.p = float(p)
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        if not training or self.p <= 0.0:
+            return inputs
+        if rng is None:
+            raise ValueError(f"{self.name}: dropout needs an rng in training")
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(layer_rng(rng, self.name), keep,
+                                    inputs.shape)
+        return jnp.where(mask, inputs / keep, 0.0)
+
+
+class Flatten(Layer):
+    def call(self, params, inputs, *, training=False, rng=None):
+        return inputs.reshape((inputs.shape[0], -1))
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0], int(np.prod(input_shape[1:])))
+
+
+class Reshape(Layer):
+    """Reshape non-batch dims (reference: ``core.py`` ``Reshape``; supports
+    one -1 wildcard)."""
+
+    def __init__(self, target_shape: Sequence[int], **kwargs):
+        super().__init__(**kwargs)
+        self.target_shape = tuple(target_shape)
+
+    def _resolve(self, input_shape):
+        in_elems = int(np.prod(input_shape[1:]))
+        out = list(self.target_shape)
+        if -1 in out:
+            i = out.index(-1)
+            known = int(np.prod([d for d in out if d != -1]))
+            out[i] = in_elems // known
+        return tuple(out)
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        return inputs.reshape((inputs.shape[0],) + self._resolve(
+            (None,) + inputs.shape[1:]))
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0],) + self._resolve(input_shape)
+
+
+class Permute(Layer):
+    def __init__(self, dims: Sequence[int], **kwargs):
+        super().__init__(**kwargs)
+        self.dims = tuple(dims)  # 1-indexed over non-batch dims (keras-1)
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        return jnp.transpose(inputs, (0,) + self.dims)
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0],) + tuple(input_shape[d] for d in self.dims)
+
+
+class RepeatVector(Layer):
+    def __init__(self, n: int, **kwargs):
+        super().__init__(**kwargs)
+        self.n = int(n)
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        return jnp.repeat(inputs[:, None, :], self.n, axis=1)
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0], self.n, input_shape[1])
+
+
+class GaussianNoise(Layer):
+    def __init__(self, sigma: float, **kwargs):
+        super().__init__(**kwargs)
+        self.sigma = float(sigma)
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        if not training or self.sigma <= 0:
+            return inputs
+        noise = jax.random.normal(layer_rng(rng, self.name), inputs.shape)
+        return inputs + self.sigma * noise
+
+
+class Lambda(Layer):
+    """Wrap an arbitrary jax-traceable function (reference keras-1 Lambda /
+    the autograd ``Lambda`` at ``autograd.py:472``)."""
+
+    def __init__(self, function, output_shape=None, **kwargs):
+        super().__init__(**kwargs)
+        self.function = function
+        self._output_shape = output_shape
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        return self.function(inputs)
+
+    def compute_output_shape(self, input_shape):
+        if self._output_shape is not None:
+            return normalize_shape(self._output_shape)
+        # trace with ShapeDtypeStruct to infer
+        single = not isinstance(input_shape, list)
+        shapes = [input_shape] if single else input_shape
+        args = [jax.ShapeDtypeStruct((1,) + tuple(s[1:]), jnp.float32)
+                for s in shapes]
+        out = jax.eval_shape(self.function, *(args if not single else args[:1]))
+        return (None,) + tuple(out.shape[1:])
+
+
+class Embedding(Layer):
+    """Trainable lookup table (reference: ``embedding.py`` ``Embedding``,
+    Scala ``Embedding.scala``). Input: int ids ``(batch, seq)`` or
+    ``(batch,)``; output gains a trailing ``output_dim`` axis.
+
+    TPU note: lookups lower to one-hot matmuls or dynamic-gathers on the MXU;
+    keep vocab on-device (sharding of giant tables comes from the fsdp axis
+    via the estimator's param sharding)."""
+
+    def __init__(self, input_dim: int, output_dim: int, init="uniform",
+                 input_length: Optional[int] = None, **kwargs):
+        if input_length is not None and kwargs.get("input_shape") is None:
+            kwargs["input_shape"] = (input_length,)
+        super().__init__(**kwargs)
+        self.input_dim = int(input_dim)
+        self.output_dim = int(output_dim)
+        self.init = get_initializer(init)
+
+    def build(self, rng, input_shape):
+        return {"E": self.init(rng, (self.input_dim, self.output_dim),
+                               jnp.float32)}
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        return jnp.take(params["E"], inputs.astype(jnp.int32), axis=0)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape) + (self.output_dim,)
+
+
+class BatchNormalization(Layer):
+    """Batch norm over the feature axis with running stats carried in params
+    (reference: Scala ``BatchNormalization.scala``; keras-1 args).
+
+    Running mean/var live in ``params["stats"]`` and are updated outside the
+    gradient (stop_gradient) — the train step returns updated params, the
+    eval path consumes them. ``mode``/``axis`` beyond keras-1 defaults are
+    not supported."""
+
+    def __init__(self, epsilon: float = 1e-3, momentum: float = 0.99,
+                 beta_init="zero", gamma_init="one", **kwargs):
+        super().__init__(**kwargs)
+        self.epsilon = float(epsilon)
+        self.momentum = float(momentum)
+        self.beta_init = get_initializer(beta_init)
+        self.gamma_init = get_initializer(gamma_init)
+
+    def build(self, rng, input_shape):
+        d = input_shape[-1]
+        k1, k2 = jax.random.split(rng)
+        return {
+            "gamma": self.gamma_init(k1, (d,), jnp.float32),
+            "beta": self.beta_init(k2, (d,), jnp.float32),
+            "stats": {"mean": jnp.zeros((d,), jnp.float32),
+                      "var": jnp.ones((d,), jnp.float32)},
+        }
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        axes = tuple(range(inputs.ndim - 1))
+        if training:
+            mean = jnp.mean(inputs, axis=axes)
+            var = jnp.var(inputs, axis=axes)
+        else:
+            mean, var = params["stats"]["mean"], params["stats"]["var"]
+        y = (inputs - mean) / jnp.sqrt(var + self.epsilon)
+        return y * params["gamma"] + params["beta"]
+
+    def updated_stats(self, params, inputs):
+        axes = tuple(range(inputs.ndim - 1))
+        mean = jnp.mean(inputs, axis=axes)
+        var = jnp.var(inputs, axis=axes)
+        m = self.momentum
+        return {
+            "mean": m * params["stats"]["mean"] + (1 - m) * jax.lax.stop_gradient(mean),
+            "var": m * params["stats"]["var"] + (1 - m) * jax.lax.stop_gradient(var),
+        }
+
+
+class Merge(Layer):
+    """Merge a list of inputs (reference: ``core.py`` ``Merge`` /
+    ``merge()``): modes concat / sum / mul / ave / max / dot / cos."""
+
+    def __init__(self, mode: str = "sum", concat_axis: int = -1, **kwargs):
+        super().__init__(**kwargs)
+        self.mode = mode
+        self.concat_axis = concat_axis
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        xs = inputs  # list of arrays
+        if self.mode == "concat":
+            return jnp.concatenate(xs, axis=self.concat_axis)
+        if self.mode == "sum":
+            return sum(xs)
+        if self.mode == "mul":
+            out = xs[0]
+            for x in xs[1:]:
+                out = out * x
+            return out
+        if self.mode == "ave":
+            return sum(xs) / len(xs)
+        if self.mode == "max":
+            out = xs[0]
+            for x in xs[1:]:
+                out = jnp.maximum(out, x)
+            return out
+        if self.mode == "dot":
+            return jnp.sum(xs[0] * xs[1], axis=-1, keepdims=True)
+        if self.mode == "cos":
+            a, b = xs[0], xs[1]
+            num = jnp.sum(a * b, axis=-1, keepdims=True)
+            den = (jnp.linalg.norm(a, axis=-1, keepdims=True) *
+                   jnp.linalg.norm(b, axis=-1, keepdims=True))
+            return num / jnp.maximum(den, 1e-8)
+        raise ValueError(f"unknown merge mode: {self.mode}")
+
+    def compute_output_shape(self, input_shape):
+        shapes = input_shape  # list
+        if self.mode == "concat":
+            ax = self.concat_axis
+            out = list(shapes[0])
+            dim = 0
+            for s in shapes:
+                if s[ax] is None:
+                    dim = None
+                    break
+                dim += s[ax]
+            out[ax] = dim
+            return tuple(out)
+        if self.mode in ("dot", "cos"):
+            return (shapes[0][0], 1)
+        return tuple(shapes[0])
+
+
+def merge(inputs: Sequence[KTensor], mode: str = "sum", concat_axis: int = -1,
+          name: Optional[str] = None) -> KTensor:
+    """Functional-API merge helper (reference: ``core.py`` ``merge``)."""
+    return Merge(mode=mode, concat_axis=concat_axis, name=name)(list(inputs))

@@ -1,0 +1,143 @@
+"""Validation metrics (reference: Python ``keras/metrics.py`` +
+Orca ``orca/learn/metrics.py:19-340`` + Scala ``keras/metrics/AUC.scala``).
+
+Each metric is a pure batch function ``f(y_true, y_pred) -> (value_sum,
+count)`` so the engine can aggregate exactly across batches and data-parallel
+shards (sum both, divide at the end) — the same contract the reference's
+BigDL ValidationMethods implement JVM-side.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import jax.numpy as jnp
+
+
+class Metric:
+    name = "metric"
+
+    def batch_eval(self, y_true, y_pred):
+        """Return (sum, count) contributions for this batch."""
+        raise NotImplementedError
+
+    def finalize(self, total, count):
+        return total / jnp.maximum(count, 1)
+
+
+class Accuracy(Metric):
+    """Classification accuracy; auto-detects binary (prob scalar output) vs
+    categorical (argmax) like the reference's ``Accuracy`` validation method.
+    """
+
+    name = "accuracy"
+
+    def batch_eval(self, y_true, y_pred):
+        if y_pred.ndim <= 1 or y_pred.shape[-1] == 1:
+            pred = (y_pred.reshape(y_pred.shape[0], -1)[:, 0] > 0.5)
+            true = y_true.reshape(y_true.shape[0], -1)[:, 0] > 0.5
+        else:
+            pred = jnp.argmax(y_pred, axis=-1)
+            true = (jnp.argmax(y_true, axis=-1)
+                    if y_true.ndim == y_pred.ndim else
+                    y_true.reshape(pred.shape).astype(jnp.int32))
+        correct = jnp.sum((pred == true).astype(jnp.float32))
+        return correct, jnp.asarray(pred.shape[0], jnp.float32)
+
+
+class SparseCategoricalAccuracy(Accuracy):
+    name = "sparse_categorical_accuracy"
+
+
+class Top5Accuracy(Metric):
+    name = "top5_accuracy"
+
+    def batch_eval(self, y_true, y_pred):
+        top5 = jnp.argsort(y_pred, axis=-1)[:, -5:]
+        true = (jnp.argmax(y_true, axis=-1) if y_true.ndim == y_pred.ndim
+                else y_true.astype(jnp.int32).reshape(-1))
+        hit = jnp.any(top5 == true[:, None], axis=-1)
+        return jnp.sum(hit.astype(jnp.float32)), jnp.asarray(
+            y_pred.shape[0], jnp.float32)
+
+
+class MAE(Metric):
+    name = "mae"
+
+    def batch_eval(self, y_true, y_pred):
+        return (jnp.sum(jnp.abs(y_pred - y_true)),
+                jnp.asarray(y_true.size, jnp.float32))
+
+
+class MSE(Metric):
+    name = "mse"
+
+    def batch_eval(self, y_true, y_pred):
+        return (jnp.sum((y_pred - y_true) ** 2),
+                jnp.asarray(y_true.size, jnp.float32))
+
+
+class BinaryCrossEntropyMetric(Metric):
+    name = "binary_crossentropy"
+
+    def batch_eval(self, y_true, y_pred):
+        eps = 1e-7
+        p = jnp.clip(y_pred, eps, 1 - eps)
+        ll = y_true * jnp.log(p) + (1 - y_true) * jnp.log(1 - p)
+        return -jnp.sum(ll), jnp.asarray(y_true.size, jnp.float32)
+
+
+class AUC(Metric):
+    """Riemann-sum AUC over fixed thresholds, jittable and exactly mergeable
+    across batches (reference: native ``keras/metrics/AUC.scala:211LoC`` uses
+    the same thresholded-confusion-matrix construction)."""
+
+    name = "auc"
+
+    def __init__(self, num_thresholds: int = 200):
+        self.num_thresholds = int(num_thresholds)
+
+    def batch_eval(self, y_true, y_pred):
+        t = jnp.linspace(0.0, 1.0, self.num_thresholds)
+        p = y_pred.reshape(-1)
+        y = y_true.reshape(-1)
+        pred_pos = p[None, :] >= t[:, None]          # (T, N)
+        tp = jnp.sum(pred_pos & (y[None, :] > 0.5), axis=1).astype(jnp.float32)
+        fp = jnp.sum(pred_pos & (y[None, :] <= 0.5), axis=1).astype(jnp.float32)
+        pos = jnp.sum(y > 0.5).astype(jnp.float32)
+        neg = jnp.sum(y <= 0.5).astype(jnp.float32)
+        # carry the confusion-matrix rows; finalize integrates
+        return jnp.stack([tp, fp,
+                          jnp.full_like(tp, pos), jnp.full_like(fp, neg)]), \
+            jnp.asarray(1.0, jnp.float32)
+
+    def finalize(self, total, count):
+        tp, fp, pos, neg = total[0], total[1], total[2], total[3]
+        tpr = tp / jnp.maximum(pos, 1.0)
+        fpr = fp / jnp.maximum(neg, 1.0)
+        # integrate TPR over FPR (thresholds descend in fpr ordering)
+        order = jnp.argsort(fpr)
+        fpr, tpr = fpr[order], tpr[order]
+        return jnp.trapezoid(tpr, fpr)
+
+
+_ALIASES = {
+    "accuracy": Accuracy,
+    "acc": Accuracy,
+    "sparse_categorical_accuracy": SparseCategoricalAccuracy,
+    "top5accuracy": Top5Accuracy,
+    "top5_accuracy": Top5Accuracy,
+    "mae": MAE,
+    "mse": MSE,
+    "auc": AUC,
+    "binary_crossentropy": BinaryCrossEntropyMetric,
+}
+
+
+def get_metric(identifier: Union[str, Metric]) -> Metric:
+    if isinstance(identifier, Metric):
+        return identifier
+    key = identifier.lower()
+    if key not in _ALIASES:
+        raise ValueError(f"unknown metric: {identifier}")
+    return _ALIASES[key]()

@@ -1,0 +1,114 @@
+"""Loss functions, keras-1 names (reference: Python
+``pyzoo/zoo/pipeline/api/keras/objectives.py``, Scala
+``pipeline/api/keras/objectives/``). All pure jittable ``f(y_true, y_pred)
+-> scalar`` reducing with mean over all elements, matching keras-1.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-7
+
+
+def mean_squared_error(y_true, y_pred):
+    return jnp.mean((y_pred - y_true) ** 2)
+
+
+def mean_absolute_error(y_true, y_pred):
+    return jnp.mean(jnp.abs(y_pred - y_true))
+
+
+def mean_absolute_percentage_error(y_true, y_pred):
+    diff = jnp.abs((y_true - y_pred) / jnp.maximum(jnp.abs(y_true), _EPS))
+    return 100.0 * jnp.mean(diff)
+
+
+def mean_squared_logarithmic_error(y_true, y_pred):
+    a = jnp.log(jnp.maximum(y_pred, _EPS) + 1.0)
+    b = jnp.log(jnp.maximum(y_true, _EPS) + 1.0)
+    return jnp.mean((a - b) ** 2)
+
+
+def binary_crossentropy(y_true, y_pred):
+    """y_pred are probabilities (keras-1 contract; the reference's
+    ``BinaryCrossEntropy``)."""
+    p = jnp.clip(y_pred, _EPS, 1.0 - _EPS)
+    return -jnp.mean(y_true * jnp.log(p) + (1.0 - y_true) * jnp.log(1.0 - p))
+
+
+def binary_crossentropy_from_logits(y_true, logits):
+    return jnp.mean(jnp.maximum(logits, 0) - logits * y_true +
+                    jnp.log1p(jnp.exp(-jnp.abs(logits))))
+
+
+def categorical_crossentropy(y_true, y_pred):
+    p = jnp.clip(y_pred, _EPS, 1.0)
+    return -jnp.mean(jnp.sum(y_true * jnp.log(p), axis=-1))
+
+
+def sparse_categorical_crossentropy(y_true, y_pred):
+    p = jnp.clip(y_pred, _EPS, 1.0)
+    idx = y_true.astype(jnp.int32)
+    if idx.ndim == p.ndim:  # (batch, 1) labels
+        idx = idx.squeeze(-1)
+    picked = jnp.take_along_axis(jnp.log(p), idx[..., None], axis=-1)
+    return -jnp.mean(picked)
+
+
+def hinge(y_true, y_pred):
+    return jnp.mean(jnp.maximum(1.0 - y_true * y_pred, 0.0))
+
+
+def squared_hinge(y_true, y_pred):
+    return jnp.mean(jnp.maximum(1.0 - y_true * y_pred, 0.0) ** 2)
+
+
+def kullback_leibler_divergence(y_true, y_pred):
+    p = jnp.clip(y_true, _EPS, 1.0)
+    q = jnp.clip(y_pred, _EPS, 1.0)
+    return jnp.mean(jnp.sum(p * jnp.log(p / q), axis=-1))
+
+
+def poisson(y_true, y_pred):
+    return jnp.mean(y_pred - y_true * jnp.log(jnp.maximum(y_pred, _EPS)))
+
+
+def cosine_proximity(y_true, y_pred):
+    a = y_true / jnp.maximum(jnp.linalg.norm(y_true, axis=-1, keepdims=True), _EPS)
+    b = y_pred / jnp.maximum(jnp.linalg.norm(y_pred, axis=-1, keepdims=True), _EPS)
+    return -jnp.mean(jnp.sum(a * b, axis=-1))
+
+
+_ALIASES = {
+    "mse": mean_squared_error,
+    "mean_squared_error": mean_squared_error,
+    "mae": mean_absolute_error,
+    "mean_absolute_error": mean_absolute_error,
+    "mape": mean_absolute_percentage_error,
+    "mean_absolute_percentage_error": mean_absolute_percentage_error,
+    "msle": mean_squared_logarithmic_error,
+    "mean_squared_logarithmic_error": mean_squared_logarithmic_error,
+    "binary_crossentropy": binary_crossentropy,
+    "bce": binary_crossentropy,
+    "categorical_crossentropy": categorical_crossentropy,
+    "sparse_categorical_crossentropy": sparse_categorical_crossentropy,
+    "hinge": hinge,
+    "squared_hinge": squared_hinge,
+    "kld": kullback_leibler_divergence,
+    "kullback_leibler_divergence": kullback_leibler_divergence,
+    "poisson": poisson,
+    "cosine_proximity": cosine_proximity,
+}
+
+
+def get_loss(identifier: Union[str, Callable]) -> Callable:
+    if callable(identifier):
+        return identifier
+    key = identifier.lower()
+    if key not in _ALIASES:
+        raise ValueError(f"unknown loss: {identifier}")
+    return _ALIASES[key]

@@ -1,0 +1,135 @@
+"""Optimizer facade over optax with keras-1 names/defaults.
+
+Reference: Python wrappers ``pyzoo/zoo/orca/learn/optimizers/`` +
+``pipeline/api/keras/optimizers.py`` (Adam with schedule support,
+AdamWeightDecay / LARS-style, ``PolyEpochDecay``), Scala
+``keras/optimizers/``. The reference applied these slice-wise inside the
+parameter-server update (``Topology.scala:1204``); here the whole update is
+one fused XLA computation — the reference's "apply update on the aggregated
+slice" is the optimizer update after psum'd grads, which XLA schedules as
+reduce-scatter + apply + all-gather automatically when params are sharded.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import optax
+
+
+class Optimizer:
+    """Thin wrapper producing an optax GradientTransformation."""
+
+    def __init__(self, tx: optax.GradientTransformation, name: str):
+        self.tx = tx
+        self.name = name
+
+    def make(self) -> optax.GradientTransformation:
+        return self.tx
+
+
+def _schedule(lr: float, decay: float) -> Union[float, Callable]:
+    """keras-1 `decay`: lr / (1 + decay * iterations)."""
+    if not decay:
+        return lr
+    return lambda step: lr / (1.0 + decay * step)
+
+
+class SGD(Optimizer):
+    def __init__(self, lr: float = 0.01, momentum: float = 0.0,
+                 decay: float = 0.0, nesterov: bool = False,
+                 learningrate_schedule=None):
+        sched = learningrate_schedule or _schedule(lr, decay)
+        tx = optax.sgd(sched, momentum=momentum or None, nesterov=nesterov)
+        super().__init__(tx, "sgd")
+
+
+class Adam(Optimizer):
+    def __init__(self, lr: float = 0.001, beta_1: float = 0.9,
+                 beta_2: float = 0.999, epsilon: float = 1e-8,
+                 decay: float = 0.0, learningrate_schedule=None):
+        sched = learningrate_schedule or _schedule(lr, decay)
+        tx = optax.adam(sched, b1=beta_1, b2=beta_2, eps=epsilon)
+        super().__init__(tx, "adam")
+
+
+class AdamWeightDecay(Optimizer):
+    """BERT-style AdamW (reference: ``keras/optimizers.py`` AdamWeightDecay,
+    used by the Scala ``BERT.scala`` training configs)."""
+
+    def __init__(self, lr: float = 0.001, beta_1: float = 0.9,
+                 beta_2: float = 0.999, epsilon: float = 1e-6,
+                 weight_decay: float = 0.01, total_steps: int = 0,
+                 warmup_ratio: float = 0.1):
+        if total_steps:
+            warmup = max(1, int(total_steps * warmup_ratio))
+            sched = optax.warmup_cosine_decay_schedule(
+                0.0, lr, warmup, total_steps)
+        else:
+            sched = lr
+        tx = optax.adamw(sched, b1=beta_1, b2=beta_2, eps=epsilon,
+                         weight_decay=weight_decay)
+        super().__init__(tx, "adamw")
+
+
+class RMSprop(Optimizer):
+    def __init__(self, lr: float = 0.001, rho: float = 0.9,
+                 epsilon: float = 1e-8, decay: float = 0.0):
+        tx = optax.rmsprop(_schedule(lr, decay), decay=rho, eps=epsilon)
+        super().__init__(tx, "rmsprop")
+
+
+class Adagrad(Optimizer):
+    def __init__(self, lr: float = 0.01, epsilon: float = 1e-8,
+                 decay: float = 0.0):
+        tx = optax.adagrad(_schedule(lr, decay), eps=epsilon)
+        super().__init__(tx, "adagrad")
+
+
+class Adadelta(Optimizer):
+    def __init__(self, lr: float = 1.0, rho: float = 0.95,
+                 epsilon: float = 1e-8):
+        tx = optax.adadelta(lr, rho=rho, eps=epsilon)
+        super().__init__(tx, "adadelta")
+
+
+class Adamax(Optimizer):
+    def __init__(self, lr: float = 0.002, beta_1: float = 0.9,
+                 beta_2: float = 0.999, epsilon: float = 1e-8):
+        tx = optax.adamax(lr, b1=beta_1, b2=beta_2, eps=epsilon)
+        super().__init__(tx, "adamax")
+
+
+class LARS(Optimizer):
+    """Layer-wise adaptive rate scaling for large-batch training (reference
+    ships a LARS-ish variant for ImageNet runs)."""
+
+    def __init__(self, lr: float = 0.1, momentum: float = 0.9,
+                 weight_decay: float = 1e-4, trust_coefficient: float = 0.001):
+        tx = optax.lars(lr, weight_decay=weight_decay,
+                        momentum=momentum,
+                        trust_coefficient=trust_coefficient)
+        super().__init__(tx, "lars")
+
+
+_ALIASES = {
+    "sgd": SGD,
+    "adam": Adam,
+    "adamw": AdamWeightDecay,
+    "rmsprop": RMSprop,
+    "adagrad": Adagrad,
+    "adadelta": Adadelta,
+    "adamax": Adamax,
+    "lars": LARS,
+}
+
+
+def get_optimizer(identifier) -> Optimizer:
+    if isinstance(identifier, Optimizer):
+        return identifier
+    if isinstance(identifier, optax.GradientTransformation):
+        return Optimizer(identifier, "optax")
+    key = str(identifier).lower()
+    if key not in _ALIASES:
+        raise ValueError(f"unknown optimizer: {identifier}")
+    return _ALIASES[key]()

@@ -1,0 +1,97 @@
+"""Benchmark: NCF (MovieLens-1M scale) training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+``vs_baseline`` is the speedup over the same jitted training step executed
+on the host CPU backend — a stand-in for the reference's CPU-only BigDL
+execution model (the reference publishes no absolute samples/sec for NCF;
+its fabric is Xeon-only, so host-CPU JAX is the closest apples-to-apples
+baseline available in this environment).
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def _make_step(model, batch_size, seed=0):
+    import jax
+
+    rs = np.random.RandomState(seed)
+    x = np.stack([rs.randint(0, 6040, batch_size),
+                  rs.randint(0, 3706, batch_size)], axis=1).astype(np.int32)
+    y = rs.randint(0, 5, batch_size).astype(np.int32)
+    return x, y
+
+
+def _bench_backend(platform: str, batch_size: int, steps: int = 30,
+                   warmup: int = 5) -> float:
+    import jax
+
+    devices = [d for d in jax.devices() if True]  # current platform devices
+    from zoo_tpu.orca import init_orca_context, stop_orca_context
+    from __graft_entry__ import _flagship
+
+    ctx = init_orca_context(cluster_mode="local", devices=devices)
+    try:
+        model = _flagship()
+        x, y = _make_step(model, batch_size)
+        # drive the real fit path once to build jits, then time raw steps
+        import jax.numpy as jnp
+        from zoo_tpu.pipeline.api.keras.engine.topology import _split_state
+
+        model.build(jax.random.PRNGKey(0), [(None, 2)])
+        params = model._place(model.params)
+        tx = model.optimizer.make()
+        trainable, _ = _split_state(params)
+        opt_state = tx.init(trainable)
+        step_fn = model._build_train_step()
+        rng = jax.random.PRNGKey(1)
+        batch = model._put_batch([x, y])
+        for _ in range(warmup):
+            params, opt_state, loss = step_fn(params, opt_state, rng, *batch)
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = step_fn(params, opt_state, rng, *batch)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        return batch_size * steps / dt
+    finally:
+        stop_orca_context()
+
+
+def main():
+    import jax
+
+    batch_size = 8192
+    tpu_sps = _bench_backend(jax.default_backend(), batch_size)
+
+    # host-CPU baseline of the identical step (subprocess keeps backends clean)
+    import subprocess
+    import sys
+    code = (
+        "import os, json;"
+        "import jax; jax.config.update('jax_platforms','cpu');"
+        "import bench;"
+        "print(json.dumps(bench._bench_backend('cpu', %d, steps=5, warmup=2)))"
+        % batch_size)
+    try:
+        out = subprocess.run([sys.executable, "-c", code], cwd=".",
+                             capture_output=True, text=True, timeout=600)
+        cpu_sps = float(out.stdout.strip().splitlines()[-1])
+    except Exception:
+        cpu_sps = float("nan")
+
+    vs = tpu_sps / cpu_sps if cpu_sps == cpu_sps and cpu_sps > 0 else None
+    print(json.dumps({
+        "metric": "ncf_train_samples_per_sec_per_chip",
+        "value": round(tpu_sps, 1),
+        "unit": "samples/s",
+        "vs_baseline": round(vs, 2) if vs else None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,4 @@
+from zoo_tpu.models.recommendation.neuralcf import NeuralCF
+from zoo_tpu.models.recommendation.recommender import Recommender, UserItemFeature
+
+__all__ = ["NeuralCF", "Recommender", "UserItemFeature"]

@@ -1,0 +1,73 @@
+"""Recommender base: shared recommend-for-user/item helpers.
+
+Rebuild of the reference's ``Recommender`` base (Scala
+``models/recommendation/Recommender.scala``, Python
+``pyzoo/zoo/models/recommendation/__init__.py``):
+``predict_user_item_pair`` and ``recommend_for_user/item`` over
+(user, item, label) triples.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class UserItemFeature:
+    """A (user, item) pair plus optional label (reference:
+    ``UserItemFeature`` in ``models/recommendation/__init__.py``)."""
+
+    user_id: int
+    item_id: int
+    label: int = 1
+
+
+@dataclasses.dataclass
+class UserItemPrediction:
+    user_id: int
+    item_id: int
+    prediction: int
+    probability: float
+
+
+class Recommender:
+    """Mixin over a Keras-facade model whose input is (batch, 2) int pairs."""
+
+    def predict_user_item_pair(self, pairs: Sequence[UserItemFeature],
+                               batch_size: int = 256
+                               ) -> List[UserItemPrediction]:
+        x = np.array([[p.user_id, p.item_id] for p in pairs], np.int32)
+        probs = self.predict(x, batch_size=batch_size)
+        cls = probs.argmax(axis=-1)
+        return [UserItemPrediction(p.user_id, p.item_id, int(c),
+                                   float(pr[c]))
+                for p, c, pr in zip(pairs, cls, probs)]
+
+    def recommend_for_user(self, pairs: Sequence[UserItemFeature],
+                           max_items: int) -> List[UserItemPrediction]:
+        """Top-N items per user among the candidate pairs (reference:
+        ``recommendForUser``)."""
+        preds = self.predict_user_item_pair(pairs)
+        by_user = {}
+        for pr in preds:
+            by_user.setdefault(pr.user_id, []).append(pr)
+        out = []
+        for user, lst in by_user.items():
+            lst.sort(key=lambda p: -p.probability)
+            out.extend(lst[:max_items])
+        return out
+
+    def recommend_for_item(self, pairs: Sequence[UserItemFeature],
+                           max_users: int) -> List[UserItemPrediction]:
+        preds = self.predict_user_item_pair(pairs)
+        by_item = {}
+        for pr in preds:
+            by_item.setdefault(pr.item_id, []).append(pr)
+        out = []
+        for item, lst in by_item.items():
+            lst.sort(key=lambda p: -p.probability)
+            out.extend(lst[:max_users])
+        return out

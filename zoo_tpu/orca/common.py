@@ -59,15 +59,11 @@ def _maybe_init_distributed(cluster_mode: str):
     import jax
 
     if cluster_mode != "local":
-        multi_host = any(k in os.environ for k in (
-            "COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
-            "MEGASCALE_COORDINATOR_ADDRESS", "TPU_WORKER_HOSTNAMES"))
-        if multi_host or cluster_mode != "tpu":
-            try:
-                jax.distributed.initialize()
-                _DIST_INITIALIZED = True
-            except Exception as e:  # single-host dev box: fine
-                logger.debug("jax.distributed.initialize skipped: %s", e)
+        try:
+            jax.distributed.initialize()
+            _DIST_INITIALIZED = True
+        except Exception as e:  # single-host dev box: fine
+            logger.debug("jax.distributed.initialize skipped: %s", e)
 
 
 def init_orca_context(cluster_mode: str = "local",
@@ -98,8 +94,13 @@ def init_orca_context(cluster_mode: str = "local",
 
     existing = get_runtime_context(required=False)
     if existing is not None:
-        if (cluster_mode != existing.cluster_mode or mesh_axes or axis_names
-                or devices is not None):
+        prev = existing.extra.get("_init_args")
+        same = prev == (cluster_mode, mesh_axes,
+                        tuple(axis_names) if axis_names else None,
+                        tuple(devices) if devices is not None else None)
+        default_call = (cluster_mode == "local" and not mesh_axes
+                        and not axis_names and devices is None)
+        if not (same or default_call):
             raise RuntimeError(
                 "init_orca_context called twice with different arguments; "
                 "call stop_orca_context() first to rebuild")
@@ -128,7 +129,11 @@ def init_orca_context(cluster_mode: str = "local",
         num_processes=nproc,
         process_index=jax.process_index(),
         cores=cores or default_cores(),
-        extra={"memory": memory, "num_nodes": num_nodes, **kwargs},
+        extra={"memory": memory, "num_nodes": num_nodes,
+               "_init_args": (cluster_mode, mesh_axes,
+                              tuple(axis_names) if axis_names else None,
+                              tuple(devices) if devices is not None else None),
+               **kwargs},
     )
     _set_runtime_context(ctx)
     atexit.register(stop_orca_context)

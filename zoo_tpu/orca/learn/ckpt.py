@@ -1,0 +1,101 @@
+"""Versioned training checkpoints (orbax-backed).
+
+Rebuild of the reference's checkpoint dir convention — time-stamped dir with
+``model.N`` / ``optimMethod-<name>.N`` snapshots, resumed by
+``load_orca_checkpoint(path, version)`` picking the latest N
+(``Topology.scala:1245-1252``, ``orca/learn/tf/estimator.py:270``,
+``pytorch/estimator.py:555``). Here a checkpoint is one orbax step directory
+holding the whole train state pytree (params + optimizer state), written
+asynchronously off the training loop.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import re
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+_STEP_RE = re.compile(r"^(\d+)$")
+
+
+def _ensure_host(tree):
+    return jax.tree_util.tree_map(np.asarray, tree)
+
+
+class CheckpointManager:
+    """Thin orbax wrapper with a pickle fallback for exotic pytrees."""
+
+    def __init__(self, directory: str, max_to_keep: int = 5):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.max_to_keep = max_to_keep
+        try:
+            import orbax.checkpoint as ocp
+            self._ocp = ocp
+            self._ckptr = ocp.StandardCheckpointer()
+        except ImportError:  # pragma: no cover
+            self._ocp = None
+            self._ckptr = None
+
+    # -- write ------------------------------------------------------------
+    def save(self, step: int, state: Any):
+        path = os.path.join(self.directory, str(step))
+        host_state = _ensure_host(state)
+        if self._ckptr is not None:
+            try:
+                self._ckptr.save(path, host_state, force=True)
+                self._ckptr.wait_until_finished()
+                self._gc()
+                return
+            except Exception:
+                pass
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "state.pkl"), "wb") as f:
+            pickle.dump(host_state, f, protocol=pickle.HIGHEST_PROTOCOL)
+        self._gc()
+
+    # -- read -------------------------------------------------------------
+    def all_steps(self):
+        steps = []
+        if not os.path.isdir(self.directory):
+            return steps
+        for name in os.listdir(self.directory):
+            m = _STEP_RE.match(name)
+            if m and os.path.isdir(os.path.join(self.directory, name)):
+                steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def restore(self, step: Optional[int] = None, target: Any = None) -> Any:
+        """Load checkpoint ``step`` (None → latest; reference
+        ``find_latest_checkpoint`` filename-convention scan)."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoints under {self.directory}")
+        path = os.path.join(self.directory, str(step))
+        pkl = os.path.join(path, "state.pkl")
+        if os.path.exists(pkl):
+            with open(pkl, "rb") as f:
+                return pickle.load(f)
+        if self._ckptr is None:
+            raise FileNotFoundError(path)
+        if target is not None:
+            return self._ckptr.restore(path, target=_ensure_host(target))
+        return self._ckptr.restore(path)
+
+    def _gc(self):
+        steps = self.all_steps()
+        while len(steps) > self.max_to_keep:
+            victim = steps.pop(0)
+            import shutil
+            shutil.rmtree(os.path.join(self.directory, str(victim)),
+                          ignore_errors=True)

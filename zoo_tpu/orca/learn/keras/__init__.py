@@ -1,0 +1,3 @@
+from zoo_tpu.orca.learn.keras.estimator import Estimator, KerasEstimator
+
+__all__ = ["Estimator", "KerasEstimator"]

@@ -1,0 +1,142 @@
+"""Advanced activations + misc dense variants, keras-1 style.
+
+Rebuild of the reference's ``advanced_activations`` + rarities the SURVEY
+calls out as fidelity-sensitive (§7.4 #2): SReLU, MaxoutDense, Highway
+(Python ``keras/layers/advanced_activations.py``, Scala ``SReLU.scala``,
+``MaxoutDense.scala``, ``Highway.scala``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from zoo_tpu.pipeline.api.keras.engine.base import (
+    Layer,
+    get_activation_fn,
+    get_initializer,
+)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, alpha: float = 0.3, **kwargs):
+        super().__init__(**kwargs)
+        self.alpha = float(alpha)
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        return jnp.where(inputs >= 0, inputs, self.alpha * inputs)
+
+
+class ELU(Layer):
+    def __init__(self, alpha: float = 1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.alpha = float(alpha)
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        return jnp.where(inputs >= 0, inputs,
+                         self.alpha * (jnp.exp(inputs) - 1.0))
+
+
+class ThresholdedReLU(Layer):
+    def __init__(self, theta: float = 1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.theta = float(theta)
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        return jnp.where(inputs > self.theta, inputs, 0.0)
+
+
+class PReLU(Layer):
+    """Per-feature trainable leak (reference: ``PReLU.scala``)."""
+
+    def build(self, rng, input_shape):
+        return {"alpha": jnp.full(tuple(input_shape[1:]), 0.25, jnp.float32)}
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        return jnp.where(inputs >= 0, inputs, params["alpha"] * inputs)
+
+
+class SReLU(Layer):
+    """S-shaped ReLU with 4 trainable per-feature params t_l, a_l, t_r, a_r
+    (reference: Scala ``SReLU.scala``; keras-1 defaults)."""
+
+    def build(self, rng, input_shape):
+        shape = tuple(input_shape[1:])
+        return {
+            "t_left": jnp.zeros(shape, jnp.float32),
+            "a_left": jnp.zeros(shape, jnp.float32),
+            "t_right": self.init_t_right(rng, shape),
+            "a_right": jnp.ones(shape, jnp.float32),
+        }
+
+    @staticmethod
+    def init_t_right(rng, shape):
+        return jax.random.uniform(rng, shape, jnp.float32, 0.0, 1.0)
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        tl, al = params["t_left"], params["a_left"]
+        tr, ar = params["t_right"], params["a_right"]
+        y = jnp.where(inputs <= tl, tl + al * (inputs - tl), inputs)
+        return jnp.where(inputs >= tr, tr + ar * (inputs - tr), y)
+
+
+class Highway(Layer):
+    """y = T(x) * H(x) + (1 - T(x)) * x (reference: ``Highway.scala``)."""
+
+    def __init__(self, activation=None, bias: bool = True,
+                 init="glorot_uniform", **kwargs):
+        super().__init__(**kwargs)
+        self.activation = get_activation_fn(activation) or (lambda x: x)
+        self.bias = bias
+        self.init = get_initializer(init)
+
+    def build(self, rng, input_shape):
+        d = input_shape[-1]
+        k1, k2 = jax.random.split(rng)
+        p = {"W": self.init(k1, (d, d), jnp.float32),
+             "W_carry": self.init(k2, (d, d), jnp.float32)}
+        if self.bias:
+            p["b"] = jnp.zeros((d,), jnp.float32)
+            # negative carry bias -> pass-through at init (keras-1 default -2)
+            p["b_carry"] = jnp.full((d,), -2.0, jnp.float32)
+        return p
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        h = inputs @ params["W"]
+        t = inputs @ params["W_carry"]
+        if self.bias:
+            h = h + params["b"]
+            t = t + params["b_carry"]
+        h = self.activation(h)
+        t = jax.nn.sigmoid(t)
+        return t * h + (1 - t) * inputs
+
+
+class MaxoutDense(Layer):
+    """max over ``nb_feature`` linear maps (reference: ``MaxoutDense.scala``).
+    """
+
+    def __init__(self, output_dim: int, nb_feature: int = 4,
+                 init="glorot_uniform", bias: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        self.output_dim = int(output_dim)
+        self.nb_feature = int(nb_feature)
+        self.init = get_initializer(init)
+        self.bias = bias
+
+    def build(self, rng, input_shape):
+        d = input_shape[-1]
+        p = {"W": self.init(rng, (self.nb_feature, d, self.output_dim),
+                            jnp.float32)}
+        if self.bias:
+            p["b"] = jnp.zeros((self.nb_feature, self.output_dim), jnp.float32)
+        return p
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        y = jnp.einsum("bd,kdo->bko", inputs, params["W"])
+        if self.bias:
+            y = y + params["b"]
+        return jnp.max(y, axis=1)
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0], self.output_dim)

@@ -1,0 +1,297 @@
+"""Convolution / padding / upsampling layers, keras-1 style.
+
+Rebuild of the reference's convolution layer set (Python
+``pyzoo/zoo/pipeline/api/keras/layers/convolutional.py``, Scala
+``pipeline/api/keras/layers/Convolution*.scala``). keras-1 argument names
+(``nb_filter``, ``subsample``, ``border_mode``, ``dim_ordering``) preserved.
+
+TPU note: convs execute internally in NHWC (the TPU-native layout, feeding
+the MXU as implicit matmuls); ``dim_ordering="th"`` (the reference/BigDL
+default, NCHW) is honored at the API boundary by transposing on entry/exit —
+XLA fuses those transposes into the surrounding ops.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from zoo_tpu.pipeline.api.keras.engine.base import (
+    Layer,
+    get_activation_fn,
+    get_initializer,
+)
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (tuple, list)):
+        return int(v[0]), int(v[1])
+    return int(v), int(v)
+
+
+def _conv_out(size: Optional[int], k: int, s: int, mode: str) -> Optional[int]:
+    if size is None:
+        return None
+    if mode == "same":
+        return -(-size // s)
+    return (size - k) // s + 1
+
+
+class Convolution2D(Layer):
+    """reference: ``Convolution2D`` (Scala ``Convolution2D.scala``)."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 init="glorot_uniform", activation=None,
+                 border_mode: str = "valid",
+                 subsample: Tuple[int, int] = (1, 1),
+                 dim_ordering: str = "th", bias: bool = True,
+                 W_regularizer=None, b_regularizer=None, **kwargs):
+        super().__init__(**kwargs)
+        if border_mode not in ("valid", "same"):
+            raise ValueError("border_mode must be 'valid' or 'same'")
+        if dim_ordering not in ("th", "tf"):
+            raise ValueError("dim_ordering must be 'th' or 'tf'")
+        self.nb_filter = int(nb_filter)
+        self.kernel = (int(nb_row), int(nb_col))
+        self.init = get_initializer(init)
+        self.activation = get_activation_fn(activation)
+        self.border_mode = border_mode
+        self.subsample = _pair(subsample)
+        self.dim_ordering = dim_ordering
+        self.bias = bias
+
+    def _in_channels(self, input_shape):
+        return input_shape[1] if self.dim_ordering == "th" else input_shape[3]
+
+    def build(self, rng, input_shape):
+        cin = self._in_channels(input_shape)
+        k = {"W": self.init(rng, self.kernel + (cin, self.nb_filter),
+                            jnp.float32)}  # HWIO
+        if self.bias:
+            k["b"] = jnp.zeros((self.nb_filter,), jnp.float32)
+        return k
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        x = inputs
+        if self.dim_ordering == "th":
+            x = jnp.transpose(x, (0, 2, 3, 1))  # NCHW -> NHWC
+        y = jax.lax.conv_general_dilated(
+            x, params["W"], window_strides=self.subsample,
+            padding=self.border_mode.upper(),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.bias:
+            y = y + params["b"]
+        if self.activation:
+            y = self.activation(y)
+        if self.dim_ordering == "th":
+            y = jnp.transpose(y, (0, 3, 1, 2))
+        return y
+
+    def compute_output_shape(self, input_shape):
+        if self.dim_ordering == "th":
+            n, c, h, w = input_shape
+        else:
+            n, h, w, c = input_shape
+        oh = _conv_out(h, self.kernel[0], self.subsample[0], self.border_mode)
+        ow = _conv_out(w, self.kernel[1], self.subsample[1], self.border_mode)
+        if self.dim_ordering == "th":
+            return (n, self.nb_filter, oh, ow)
+        return (n, oh, ow, self.nb_filter)
+
+
+Conv2D = Convolution2D
+
+
+class Convolution1D(Layer):
+    """reference: ``Convolution1D``; input (batch, steps, dim)."""
+
+    def __init__(self, nb_filter: int, filter_length: int,
+                 init="glorot_uniform", activation=None,
+                 border_mode: str = "valid", subsample_length: int = 1,
+                 bias: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        if border_mode not in ("valid", "same"):
+            raise ValueError("border_mode must be 'valid' or 'same'")
+        self.nb_filter = int(nb_filter)
+        self.filter_length = int(filter_length)
+        self.init = get_initializer(init)
+        self.activation = get_activation_fn(activation)
+        self.border_mode = border_mode
+        self.subsample = int(subsample_length)
+        self.bias = bias
+
+    def build(self, rng, input_shape):
+        cin = input_shape[-1]
+        k = {"W": self.init(rng, (self.filter_length, cin, self.nb_filter),
+                            jnp.float32)}
+        if self.bias:
+            k["b"] = jnp.zeros((self.nb_filter,), jnp.float32)
+        return k
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        y = jax.lax.conv_general_dilated(
+            inputs, params["W"], window_strides=(self.subsample,),
+            padding=self.border_mode.upper(),
+            dimension_numbers=("NWC", "WIO", "NWC"))
+        if self.bias:
+            y = y + params["b"]
+        return self.activation(y) if self.activation else y
+
+    def compute_output_shape(self, input_shape):
+        n, steps, _ = input_shape
+        return (n, _conv_out(steps, self.filter_length, self.subsample,
+                             self.border_mode), self.nb_filter)
+
+
+Conv1D = Convolution1D
+
+
+class ZeroPadding2D(Layer):
+    def __init__(self, padding=(1, 1), dim_ordering: str = "th", **kwargs):
+        super().__init__(**kwargs)
+        self.padding = _pair(padding)
+        self.dim_ordering = dim_ordering
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        ph, pw = self.padding
+        if self.dim_ordering == "th":
+            pad = ((0, 0), (0, 0), (ph, ph), (pw, pw))
+        else:
+            pad = ((0, 0), (ph, ph), (pw, pw), (0, 0))
+        return jnp.pad(inputs, pad)
+
+    def compute_output_shape(self, input_shape):
+        s = list(input_shape)
+        hx, wx = (2, 3) if self.dim_ordering == "th" else (1, 2)
+        if s[hx] is not None:
+            s[hx] += 2 * self.padding[0]
+        if s[wx] is not None:
+            s[wx] += 2 * self.padding[1]
+        return tuple(s)
+
+
+class ZeroPadding1D(Layer):
+    def __init__(self, padding: int = 1, **kwargs):
+        super().__init__(**kwargs)
+        self.padding = int(padding)
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        return jnp.pad(inputs, ((0, 0), (self.padding, self.padding), (0, 0)))
+
+    def compute_output_shape(self, input_shape):
+        n, steps, d = input_shape
+        return (n, None if steps is None else steps + 2 * self.padding, d)
+
+
+class UpSampling2D(Layer):
+    def __init__(self, size=(2, 2), dim_ordering: str = "th", **kwargs):
+        super().__init__(**kwargs)
+        self.size = _pair(size)
+        self.dim_ordering = dim_ordering
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        sh, sw = self.size
+        if self.dim_ordering == "th":
+            return jnp.repeat(jnp.repeat(inputs, sh, axis=2), sw, axis=3)
+        return jnp.repeat(jnp.repeat(inputs, sh, axis=1), sw, axis=2)
+
+    def compute_output_shape(self, input_shape):
+        s = list(input_shape)
+        hx, wx = (2, 3) if self.dim_ordering == "th" else (1, 2)
+        if s[hx] is not None:
+            s[hx] *= self.size[0]
+        if s[wx] is not None:
+            s[wx] *= self.size[1]
+        return tuple(s)
+
+
+class UpSampling1D(Layer):
+    def __init__(self, length: int = 2, **kwargs):
+        super().__init__(**kwargs)
+        self.length = int(length)
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        return jnp.repeat(inputs, self.length, axis=1)
+
+    def compute_output_shape(self, input_shape):
+        n, steps, d = input_shape
+        return (n, None if steps is None else steps * self.length, d)
+
+
+class Cropping2D(Layer):
+    def __init__(self, cropping=((0, 0), (0, 0)), dim_ordering: str = "th",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.cropping = tuple(tuple(int(v) for v in c) for c in cropping)
+        self.dim_ordering = dim_ordering
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        (t, b), (l, r) = self.cropping
+        if self.dim_ordering == "th":
+            return inputs[:, :, t:inputs.shape[2] - b,
+                          l:inputs.shape[3] - r]
+        return inputs[:, t:inputs.shape[1] - b, l:inputs.shape[2] - r, :]
+
+    def compute_output_shape(self, input_shape):
+        s = list(input_shape)
+        hx, wx = (2, 3) if self.dim_ordering == "th" else (1, 2)
+        (t, b), (l, r) = self.cropping
+        if s[hx] is not None:
+            s[hx] -= t + b
+        if s[wx] is not None:
+            s[wx] -= l + r
+        return tuple(s)
+
+
+class Cropping1D(Layer):
+    def __init__(self, cropping=(1, 1), **kwargs):
+        super().__init__(**kwargs)
+        self.cropping = tuple(int(v) for v in cropping)
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        l, r = self.cropping
+        return inputs[:, l:inputs.shape[1] - r, :]
+
+    def compute_output_shape(self, input_shape):
+        n, steps, d = input_shape
+        return (n, None if steps is None else steps - sum(self.cropping), d)
+
+
+class SpatialDropout2D(Layer):
+    """Drop whole feature maps (reference: ``SpatialDropout2D``)."""
+
+    def __init__(self, p: float = 0.5, dim_ordering: str = "th", **kwargs):
+        super().__init__(**kwargs)
+        self.p = float(p)
+        self.dim_ordering = dim_ordering
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        if not training or self.p <= 0:
+            return inputs
+        from zoo_tpu.pipeline.api.keras.engine.base import layer_rng
+        keep = 1.0 - self.p
+        if self.dim_ordering == "th":
+            shape = (inputs.shape[0], inputs.shape[1], 1, 1)
+        else:
+            shape = (inputs.shape[0], 1, 1, inputs.shape[3])
+        mask = jax.random.bernoulli(layer_rng(rng, self.name), keep, shape)
+        return jnp.where(mask, inputs / keep, 0.0)
+
+
+class SpatialDropout1D(Layer):
+    def __init__(self, p: float = 0.5, **kwargs):
+        super().__init__(**kwargs)
+        self.p = float(p)
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        if not training or self.p <= 0:
+            return inputs
+        from zoo_tpu.pipeline.api.keras.engine.base import layer_rng
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(
+            layer_rng(rng, self.name), keep,
+            (inputs.shape[0], 1, inputs.shape[2]))
+        return jnp.where(mask, inputs / keep, 0.0)

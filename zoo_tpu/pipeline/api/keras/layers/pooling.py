@@ -1,0 +1,138 @@
+"""Pooling layers, keras-1 style (reference: Python
+``pyzoo/zoo/pipeline/api/keras/layers/pooling.py``, Scala
+``pipeline/api/keras/layers/*Pooling*.scala``). NHWC internally (TPU
+layout); ``dim_ordering="th"`` handled by transposition like the convs.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from zoo_tpu.pipeline.api.keras.engine.base import Layer
+from zoo_tpu.pipeline.api.keras.layers.convolutional import _conv_out, _pair
+
+
+def _reduce_window(x, init, op, window, strides, padding):
+    return jax.lax.reduce_window(x, init, op, window, strides, padding)
+
+
+class _Pool2D(Layer):
+    def __init__(self, pool_size=(2, 2), strides=None,
+                 border_mode: str = "valid", dim_ordering: str = "th",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.pool_size = _pair(pool_size)
+        self.strides = _pair(strides) if strides is not None else self.pool_size
+        self.border_mode = border_mode
+        self.dim_ordering = dim_ordering
+
+    def _pool(self, x):  # NHWC
+        raise NotImplementedError
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        x = inputs
+        if self.dim_ordering == "th":
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        y = self._pool(x)
+        if self.dim_ordering == "th":
+            y = jnp.transpose(y, (0, 3, 1, 2))
+        return y
+
+    def compute_output_shape(self, input_shape):
+        if self.dim_ordering == "th":
+            n, c, h, w = input_shape
+        else:
+            n, h, w, c = input_shape
+        oh = _conv_out(h, self.pool_size[0], self.strides[0], self.border_mode)
+        ow = _conv_out(w, self.pool_size[1], self.strides[1], self.border_mode)
+        return (n, c, oh, ow) if self.dim_ordering == "th" else (n, oh, ow, c)
+
+
+class MaxPooling2D(_Pool2D):
+    def _pool(self, x):
+        return _reduce_window(
+            x, -jnp.inf, jax.lax.max,
+            (1,) + self.pool_size + (1,), (1,) + self.strides + (1,),
+            self.border_mode.upper())
+
+
+class AveragePooling2D(_Pool2D):
+    def _pool(self, x):
+        window = (1,) + self.pool_size + (1,)
+        strides = (1,) + self.strides + (1,)
+        summed = _reduce_window(x, 0.0, jax.lax.add, window, strides,
+                                self.border_mode.upper())
+        counts = _reduce_window(jnp.ones_like(x), 0.0, jax.lax.add, window,
+                                strides, self.border_mode.upper())
+        return summed / counts
+
+
+class _Pool1D(Layer):
+    def __init__(self, pool_length: int = 2, stride=None,
+                 border_mode: str = "valid", **kwargs):
+        super().__init__(**kwargs)
+        self.pool_length = int(pool_length)
+        self.stride = int(stride) if stride is not None else self.pool_length
+        self.border_mode = border_mode
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        raise NotImplementedError
+
+    def compute_output_shape(self, input_shape):
+        n, steps, d = input_shape
+        return (n, _conv_out(steps, self.pool_length, self.stride,
+                             self.border_mode), d)
+
+
+class MaxPooling1D(_Pool1D):
+    def call(self, params, inputs, *, training=False, rng=None):
+        return _reduce_window(
+            inputs, -jnp.inf, jax.lax.max,
+            (1, self.pool_length, 1), (1, self.stride, 1),
+            self.border_mode.upper())
+
+
+class AveragePooling1D(_Pool1D):
+    def call(self, params, inputs, *, training=False, rng=None):
+        window, strides = (1, self.pool_length, 1), (1, self.stride, 1)
+        summed = _reduce_window(inputs, 0.0, jax.lax.add, window, strides,
+                                self.border_mode.upper())
+        counts = _reduce_window(jnp.ones_like(inputs), 0.0, jax.lax.add,
+                                window, strides, self.border_mode.upper())
+        return summed / counts
+
+
+class GlobalMaxPooling2D(Layer):
+    def __init__(self, dim_ordering: str = "th", **kwargs):
+        super().__init__(**kwargs)
+        self.dim_ordering = dim_ordering
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        axes = (2, 3) if self.dim_ordering == "th" else (1, 2)
+        return jnp.max(inputs, axis=axes)
+
+    def compute_output_shape(self, input_shape):
+        c = input_shape[1] if self.dim_ordering == "th" else input_shape[3]
+        return (input_shape[0], c)
+
+
+class GlobalAveragePooling2D(GlobalMaxPooling2D):
+    def call(self, params, inputs, *, training=False, rng=None):
+        axes = (2, 3) if self.dim_ordering == "th" else (1, 2)
+        return jnp.mean(inputs, axis=axes)
+
+
+class GlobalMaxPooling1D(Layer):
+    def call(self, params, inputs, *, training=False, rng=None):
+        return jnp.max(inputs, axis=1)
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0], input_shape[2])
+
+
+class GlobalAveragePooling1D(GlobalMaxPooling1D):
+    def call(self, params, inputs, *, training=False, rng=None):
+        return jnp.mean(inputs, axis=1)

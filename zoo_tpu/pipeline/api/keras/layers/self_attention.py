@@ -1,0 +1,241 @@
+"""Transformer / BERT mega-layers, keras-1 style.
+
+Rebuild of the reference's only attention models (Python
+``pyzoo/zoo/pipeline/api/keras/layers/self_attention.py:46`` TransformerLayer
+and ``:235`` BERT; Scala ``TransformerLayer.scala:279``, ``BERT.scala:402``).
+As in the reference these are single Layer objects owning the whole stack
+(embeddings + N blocks), not functional graphs.
+
+TPU design: the block stack runs under ``jax.lax.scan`` over stacked
+per-block params — one compiled block body regardless of depth (compile time
+O(1) in n_block), with weights laid out (n_block, ...) which is also the
+natural stacking for pipeline parallelism later. All matmuls are (B·T, H)
+GEMMs on the MXU; attention math lives in ``zoo_tpu.ops.attention``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from zoo_tpu.ops.attention import (
+    dot_product_attention,
+    merge_heads,
+    split_heads,
+)
+from zoo_tpu.pipeline.api.keras.engine.base import (
+    Layer,
+    get_activation_fn,
+    get_initializer,
+    layer_rng,
+)
+
+
+def _layer_norm(x, gamma, beta, eps=1e-5):
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mean) / jnp.sqrt(var + eps) * gamma + beta
+
+
+class LayerNorm(Layer):
+    """Standalone layer-normalization layer (the reference embeds this in
+    its transformer; exposed here as a reusable layer too)."""
+
+    def __init__(self, epsilon: float = 1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.epsilon = epsilon
+
+    def build(self, rng, input_shape):
+        d = input_shape[-1]
+        return {"gamma": jnp.ones((d,), jnp.float32),
+                "beta": jnp.zeros((d,), jnp.float32)}
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        return _layer_norm(inputs, params["gamma"], params["beta"],
+                           self.epsilon)
+
+
+def _block_params(rng, hidden: int, intermediate: int, init):
+    ks = jax.random.split(rng, 6)
+    return {
+        "qkv_w": init(ks[0], (hidden, 3 * hidden), jnp.float32),
+        "qkv_b": jnp.zeros((3 * hidden,), jnp.float32),
+        "proj_w": init(ks[1], (hidden, hidden), jnp.float32),
+        "proj_b": jnp.zeros((hidden,), jnp.float32),
+        "ln1_g": jnp.ones((hidden,), jnp.float32),
+        "ln1_b": jnp.zeros((hidden,), jnp.float32),
+        "fc1_w": init(ks[2], (hidden, intermediate), jnp.float32),
+        "fc1_b": jnp.zeros((intermediate,), jnp.float32),
+        "fc2_w": init(ks[3], (intermediate, hidden), jnp.float32),
+        "fc2_b": jnp.zeros((hidden,), jnp.float32),
+        "ln2_g": jnp.ones((hidden,), jnp.float32),
+        "ln2_b": jnp.zeros((hidden,), jnp.float32),
+    }
+
+
+def _block_forward(p, h, *, n_head, mask, causal, act, hidden_drop,
+                   attn_drop, training, rng):
+    """Post-LN transformer block (the reference's TransformerLayer/BERT use
+    post-layernorm, GPT-1/BERT style)."""
+    qkv = h @ p["qkv_w"] + p["qkv_b"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    drng = None
+    if training and attn_drop > 0 and rng is not None:
+        rng, drng = jax.random.split(rng)
+    a = dot_product_attention(
+        split_heads(q, n_head), split_heads(k, n_head),
+        split_heads(v, n_head), mask=mask, causal=causal,
+        dropout_p=attn_drop if training else 0.0, dropout_rng=drng)
+    a = merge_heads(a) @ p["proj_w"] + p["proj_b"]
+    if training and hidden_drop > 0 and rng is not None:
+        rng, drng = jax.random.split(rng)
+        keep = jax.random.bernoulli(drng, 1 - hidden_drop, a.shape)
+        a = jnp.where(keep, a / (1 - hidden_drop), 0.0)
+    h = _layer_norm(h + a, p["ln1_g"], p["ln1_b"])
+    f = act(h @ p["fc1_w"] + p["fc1_b"]) @ p["fc2_w"] + p["fc2_b"]
+    if training and hidden_drop > 0 and rng is not None:
+        rng, drng = jax.random.split(rng)
+        keep = jax.random.bernoulli(drng, 1 - hidden_drop, f.shape)
+        f = jnp.where(keep, f / (1 - hidden_drop), 0.0)
+    return _layer_norm(h + f, p["ln2_g"], p["ln2_b"])
+
+
+class TransformerLayer(Layer):
+    """GPT-style decoder stack (reference:
+    ``self_attention.py:46`` / ``TransformerLayer.scala:279``): token +
+    learned position embeddings, ``n_block`` blocks, causal unless
+    ``bidirectional=True``. Input: int ids (B, T); output (B, T, hidden).
+    """
+
+    def __init__(self, vocab: int, seq_len: int, n_block: int = 12,
+                 hidden_size: int = 768, n_head: int = 12,
+                 intermediate_size: Optional[int] = None,
+                 hidden_drop: float = 0.1, attn_drop: float = 0.1,
+                 initializer_range: float = 0.02,
+                 bidirectional: bool = False, activation="gelu", **kwargs):
+        super().__init__(**kwargs)
+        if hidden_size % n_head:
+            raise ValueError("hidden_size must divide by n_head")
+        self.vocab = vocab
+        self.seq_len = seq_len
+        self.n_block = n_block
+        self.hidden_size = hidden_size
+        self.n_head = n_head
+        self.intermediate_size = intermediate_size or 4 * hidden_size
+        self.hidden_drop = hidden_drop
+        self.attn_drop = attn_drop
+        self.bidirectional = bidirectional
+        self.act = get_activation_fn(activation)
+        init = jax.nn.initializers.normal(stddev=initializer_range)
+        self._init = init
+
+    def build(self, rng, input_shape):
+        k_tok, k_pos, k_blocks = jax.random.split(rng, 3)
+        blocks = jax.vmap(
+            lambda r: _block_params(r, self.hidden_size,
+                                    self.intermediate_size, self._init)
+        )(jax.random.split(k_blocks, self.n_block))
+        return {
+            "tok": self._init(k_tok, (self.vocab, self.hidden_size),
+                              jnp.float32),
+            "pos": self._init(k_pos, (self.seq_len, self.hidden_size),
+                              jnp.float32),
+            "blocks": blocks,
+        }
+
+    def _embed(self, params, ids):
+        t = ids.shape[1]
+        h = jnp.take(params["tok"], ids.astype(jnp.int32), axis=0)
+        return h + params["pos"][:t]
+
+    def _run_blocks(self, params, h, mask, training, rng):
+        def body(carry, blk):
+            h, rng = carry
+            brng = None
+            if rng is not None:
+                rng, brng = jax.random.split(rng)
+            h = _block_forward(blk, h, n_head=self.n_head, mask=mask,
+                               causal=not self.bidirectional, act=self.act,
+                               hidden_drop=self.hidden_drop,
+                               attn_drop=self.attn_drop, training=training,
+                               rng=brng)
+            return (h, rng), None
+
+        rng = layer_rng(rng, self.name) if rng is not None else None
+        (h, _), _ = jax.lax.scan(body, (h, rng), params["blocks"])
+        return h
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        h = self._embed(params, inputs)
+        return self._run_blocks(params, h, None, training, rng)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape) + (self.hidden_size,)
+
+
+class BERT(TransformerLayer):
+    """BERT encoder (reference: ``self_attention.py:235`` /
+    ``BERT.scala:402``): token + position + segment embeddings with
+    embedding LayerNorm, bidirectional blocks, plus a tanh pooler over
+    [CLS]. Inputs: ``ids`` or ``[ids, token_type_ids, attention_mask]``.
+    ``call`` returns the sequence output; ``pooled_output`` gives the [CLS]
+    projection (the reference returns both as a tuple)."""
+
+    def __init__(self, vocab: int, hidden_size: int = 768, n_block: int = 12,
+                 n_head: int = 12, seq_len: int = 512,
+                 intermediate_size: int = 3072, hidden_p_drop: float = 0.1,
+                 attn_p_drop: float = 0.1, max_position_len: int = 512,
+                 token_type_vocab: int = 2, initializer_range: float = 0.02,
+                 **kwargs):
+        super().__init__(vocab=vocab, seq_len=max(seq_len, max_position_len),
+                         n_block=n_block, hidden_size=hidden_size,
+                         n_head=n_head, intermediate_size=intermediate_size,
+                         hidden_drop=hidden_p_drop, attn_drop=attn_p_drop,
+                         initializer_range=initializer_range,
+                         bidirectional=True, activation="gelu", **kwargs)
+        self.token_type_vocab = token_type_vocab
+
+    def build(self, rng, input_shape):
+        base = super().build(rng, input_shape)
+        k_seg, k_pool, k_ln = jax.random.split(jax.random.fold_in(rng, 7), 3)
+        base["seg"] = self._init(k_seg, (self.token_type_vocab,
+                                         self.hidden_size), jnp.float32)
+        base["emb_ln_g"] = jnp.ones((self.hidden_size,), jnp.float32)
+        base["emb_ln_b"] = jnp.zeros((self.hidden_size,), jnp.float32)
+        base["pool_w"] = self._init(k_pool, (self.hidden_size,
+                                             self.hidden_size), jnp.float32)
+        base["pool_b"] = jnp.zeros((self.hidden_size,), jnp.float32)
+        return base
+
+    def _split_inputs(self, inputs):
+        if isinstance(inputs, (list, tuple)):
+            ids = inputs[0]
+            seg = inputs[1] if len(inputs) > 1 else None
+            mask = inputs[2] if len(inputs) > 2 else None
+            return ids, seg, mask
+        return inputs, None, None
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        ids, seg, attn_mask = self._split_inputs(inputs)
+        t = ids.shape[1]
+        h = jnp.take(params["tok"], ids.astype(jnp.int32), axis=0)
+        h = h + params["pos"][:t]
+        if seg is not None:
+            h = h + jnp.take(params["seg"], seg.astype(jnp.int32), axis=0)
+        h = _layer_norm(h, params["emb_ln_g"], params["emb_ln_b"])
+        mask = None
+        if attn_mask is not None:
+            mask = attn_mask[:, None, None, :].astype(bool)
+        return self._run_blocks(params, h, mask, training, rng)
+
+    def pooled_output(self, params, sequence_output):
+        """[CLS] tanh pooler (reference BERT second output)."""
+        return jnp.tanh(sequence_output[:, 0] @ params["pool_w"] +
+                        params["pool_b"])
+
+    def compute_output_shape(self, input_shape):
+        shape = input_shape[0] if isinstance(input_shape, list) else \
+            input_shape
+        return tuple(shape) + (self.hidden_size,)

@@ -1,0 +1,3 @@
+from zoo_tpu.ops.attention import dot_product_attention
+
+__all__ = ["dot_product_attention"]

@@ -1,0 +1,62 @@
+"""Attention kernels.
+
+The reference's only attention is the dense O(T^2) math inside
+``TransformerLayer.scala:279`` / ``BERT.scala:402`` (no flash attention, no
+context parallelism — SURVEY §5.7). Here the dense path is written so XLA
+fuses softmax into the matmuls; the ring/context-parallel variant lives in
+``zoo_tpu.parallel.ring_attention`` and shares this per-block math.
+
+Layout: (batch, heads, seq, head_dim) throughout — heads-second is the
+TPU-friendly layout (seq × head_dim trailing = MXU tiles).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def dot_product_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                          mask: Optional[jnp.ndarray] = None,
+                          causal: bool = False,
+                          dropout_p: float = 0.0,
+                          dropout_rng=None,
+                          scale: Optional[float] = None) -> jnp.ndarray:
+    """Scaled dot-product attention over (B, H, T, D) tensors.
+
+    ``mask``: optional (B, 1, 1, T) or (B, 1, T, T) additive-style boolean
+    mask (True = attend). ``causal`` adds the autoregressive triangle (the
+    reference's ``bidirectional=False`` TransformerLayer mode).
+    """
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(q.dtype)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+
+    neg = jnp.finfo(scores.dtype).min
+    if causal:
+        tq, tk = scores.shape[-2], scores.shape[-1]
+        tri = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        scores = jnp.where(tri, scores, neg)
+    if mask is not None:
+        scores = jnp.where(mask, scores, neg)
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    if dropout_p > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_p,
+                                    probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def split_heads(x: jnp.ndarray, n_head: int) -> jnp.ndarray:
+    """(B, T, H*D) -> (B, H, T, D)."""
+    b, t, hd = x.shape
+    return x.reshape(b, t, n_head, hd // n_head).transpose(0, 2, 1, 3)
+
+
+def merge_heads(x: jnp.ndarray) -> jnp.ndarray:
+    """(B, H, T, D) -> (B, T, H*D)."""
+    b, h, t, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b, t, h * d)

@@ -173,12 +173,11 @@ class KerasNet:
         return ctx.mesh if ctx is not None else None
 
     def _place(self, params):
-        mesh = self._mesh()
-        if mesh is None:
-            return params
-        from zoo_tpu.parallel.mesh import replicated_sharding
-        sh = replicated_sharding(mesh)
-        return jax.tree_util.tree_map(lambda p: jax.device_put(p, sh), params)
+        """Place params per the mesh plan: replicated across ``data``,
+        ZeRO-sharded across ``fsdp``, tensor-parallel across ``model``
+        (see ``zoo_tpu.parallel.plans``)."""
+        from zoo_tpu.parallel.plans import place_params
+        return place_params(params, self._mesh())
 
     def _put_batch(self, arrs: List[np.ndarray]):
         mesh = self._mesh()

@@ -1,0 +1,127 @@
+"""Parallelism specs on the virtual 8-device mesh: FSDP/TP placement,
+sharded training parity, ring attention vs dense attention."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from zoo_tpu.ops.attention import dot_product_attention
+from zoo_tpu.parallel import build_mesh
+from zoo_tpu.parallel.plans import leaf_sharding, place_params
+from zoo_tpu.parallel.ring_attention import ring_attention
+
+
+def test_leaf_sharding_plan():
+    mesh = build_mesh(axis_sizes={"data": 2, "fsdp": 2, "model": 2})
+    # 2-D weight: model on output dim, fsdp on input dim
+    s = leaf_sharding(mesh, (16, 8))
+    assert s.spec == P("fsdp", "model")
+    # output dim not divisible -> row parallel
+    s = leaf_sharding(mesh, (16, 7))
+    assert s.spec == P("model", None) or s.spec[0] == "model"
+    # bias vector: fsdp only
+    s = leaf_sharding(mesh, (8,))
+    assert s.spec == P("fsdp")
+    # nothing divisible
+    s = leaf_sharding(mesh, (3, 5))
+    assert s.spec == P()
+
+
+def test_fsdp_training_matches_dp(orca_ctx):
+    """Same seed, same data: pure-DP mesh and DP×FSDP mesh must produce the
+    same losses — ZeRO sharding is a layout, not a math change."""
+    from zoo_tpu.orca import init_orca_context, stop_orca_context
+    from zoo_tpu.pipeline.api.keras import Sequential
+    from zoo_tpu.pipeline.api.keras.layers import Dense
+    from zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(128, 8).astype(np.float32)
+    w = rs.randn(8, 1).astype(np.float32)
+    y = x @ w
+
+    def run():
+        m = Sequential()
+        m.add(Dense(16, activation="relu", input_shape=(8,)))
+        m.add(Dense(1))
+        m.compile(optimizer=Adam(lr=0.01), loss="mse")
+        return m.fit(x, y, batch_size=32, nb_epoch=3, verbose=0)["loss"]
+
+    loss_dp = run()  # orca_ctx fixture mesh: data=8
+
+    stop_orca_context()
+    init_orca_context(mesh_axes={"data": 2, "fsdp": 4})
+    try:
+        loss_fsdp = run()
+    finally:
+        stop_orca_context()
+        init_orca_context()  # restore for fixture teardown symmetry
+
+    np.testing.assert_allclose(loss_dp, loss_fsdp, rtol=2e-3)
+
+
+def test_tp_training_runs(orca_ctx):
+    from zoo_tpu.orca import init_orca_context, stop_orca_context
+    from zoo_tpu.pipeline.api.keras import Sequential
+    from zoo_tpu.pipeline.api.keras.layers import Dense
+
+    stop_orca_context()
+    init_orca_context(mesh_axes={"data": 2, "model": 4})
+    try:
+        rs = np.random.RandomState(0)
+        x = rs.randn(64, 8).astype(np.float32)
+        y = rs.randn(64, 4).astype(np.float32)
+        m = Sequential()
+        m.add(Dense(16, activation="relu", input_shape=(8,)))
+        m.add(Dense(4))
+        m.compile(optimizer="adam", loss="mse")
+        hist = m.fit(x, y, batch_size=16, nb_epoch=2, verbose=0)
+        assert np.isfinite(hist["loss"]).all()
+        # params actually carry the model axis
+        placed = m._place(m.params)
+        specs = [p.sharding.spec for p in jax.tree_util.tree_leaves(placed)
+                 if hasattr(p, "sharding")]
+        assert any("model" in str(s) for s in specs)
+    finally:
+        stop_orca_context()
+        init_orca_context()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_dense(causal):
+    mesh = build_mesh(axis_sizes={"seq": 8})
+    rs = np.random.RandomState(0)
+    B, H, T, D = 2, 2, 32, 8
+    q = rs.randn(B, H, T, D).astype(np.float32)
+    k = rs.randn(B, H, T, D).astype(np.float32)
+    v = rs.randn(B, H, T, D).astype(np.float32)
+
+    dense = np.asarray(dot_product_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=causal))
+
+    with jax.sharding.use_mesh(mesh) if hasattr(jax.sharding, "use_mesh") \
+            else _null():
+        ring = np.asarray(ring_attention(mesh, jnp.asarray(q),
+                                         jnp.asarray(k), jnp.asarray(v),
+                                         causal=causal))
+    np.testing.assert_allclose(ring, dense, atol=2e-5)
+
+
+class _null:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+def test_ring_attention_jit_under_mesh():
+    mesh = build_mesh(jax.devices()[:4], axis_sizes={"seq": 4})
+    rs = np.random.RandomState(1)
+    q = jnp.asarray(rs.randn(1, 2, 16, 4).astype(np.float32))
+
+    out = jax.jit(lambda q: ring_attention(mesh, q, q, q, causal=True))(q)
+    dense = dot_product_attention(q, q, q, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense), atol=2e-5)

@@ -113,6 +113,17 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def pick_divisible_dim(shape: Tuple[int, ...], size: int,
+                       taken=()) -> Optional[int]:
+    """Largest dim of ``shape`` divisible by ``size`` and not in ``taken``
+    (shared by the fsdp and combined fsdp×tp placement policies)."""
+    best, best_size = None, 0
+    for i, d in enumerate(shape):
+        if i not in taken and d % size == 0 and d > best_size:
+            best, best_size = i, d
+    return best
+
+
 def fsdp_param_sharding(mesh: Mesh, shape: Tuple[int, ...],
                         axis: str = "fsdp") -> NamedSharding:
     """ZeRO-3-style sharding for one parameter: split the largest divisible
@@ -123,11 +134,7 @@ def fsdp_param_sharding(mesh: Mesh, shape: Tuple[int, ...],
     size = mesh.shape.get(axis, 1)
     if size <= 1 or not shape:
         return replicated_sharding(mesh)
-    # pick the largest dim divisible by the axis size
-    best = None
-    for i, d in enumerate(shape):
-        if d % size == 0 and (best is None or d > shape[best]):
-            best = i
+    best = pick_divisible_dim(shape, size)
     if best is None:
         return replicated_sharding(mesh)
     spec = [None] * len(shape)

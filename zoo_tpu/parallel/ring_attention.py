@@ -1,0 +1,91 @@
+"""Ring attention: context-parallel attention over the ``seq`` mesh axis.
+
+Net-new subsystem (SURVEY §5.7 — the reference has NO long-context support;
+its max sequence length is a plain hyperparameter on dense O(T²) attention).
+This module scales sequence length across chips: Q/K/V are sharded over the
+``seq`` axis; each device holds one block and K/V blocks rotate around the
+ICI ring via ``lax.ppermute`` while a streaming (flash-style) softmax
+accumulates — memory O(T/n per device), comm overlapped with compute by XLA.
+
+Math: the standard online-softmax recurrence
+    m' = max(m, rowmax(S));  l' = l·e^{m-m'} + rowsum(e^{S-m'})
+    o' = o·e^{m-m'} + e^{S-m'}·V
+applied once per incoming K/V block; causal masking is by global position
+index, with the block origin tracked alongside the rotating K/V.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
+                          scale: Optional[float] = None):
+    """Runs INSIDE shard_map. q/k/v: local blocks (B, H, Tl, D)."""
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, h, tl, d = q.shape
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(q.dtype)
+
+    q_pos = my_idx * tl + jnp.arange(tl)
+
+    def block(q, k_blk, v_blk, src_idx, m, l, o):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) * scale
+        if causal:
+            k_pos = src_idx * tl + jnp.arange(tl)
+            allowed = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(allowed, s, jnp.finfo(s.dtype).min)
+        # s is always finite (masking writes finfo.min, not -inf) and round
+        # 0 visits the local block whose causal diagonal is always allowed,
+        # so m is finite from round 0 on; exp(-inf - finite) = 0 covers the
+        # initial carry.
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l_new = l * alpha + p.sum(axis=-1)
+        o_new = o * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p,
+                                                  v_blk)
+        return m_new, l_new, o_new
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(i, carry):
+        # rotate first, then accumulate: round 0 handles the local block
+        # outside the loop, so exactly n-1 rotations happen in total (no
+        # wasted final permute whose result would be discarded)
+        k_blk, v_blk, src_idx, m, l, o = carry
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        src_idx = jax.lax.ppermute(src_idx, axis_name, perm)
+        m, l, o = block(q, k_blk, v_blk, src_idx, m, l, o)
+        return k_blk, v_blk, src_idx, m, l, o
+
+    m0 = jnp.full((b, h, tl), -jnp.inf, q.dtype)
+    l0 = jnp.zeros((b, h, tl), q.dtype)
+    o0 = jnp.zeros_like(q)
+    m, l, o = block(q, k, v, my_idx, m0, l0, o0)
+    carry = (k, v, my_idx, m, l, o)
+    carry = jax.lax.fori_loop(0, n - 1, body, carry)
+    _, _, _, m, l, o = carry
+    return o / jnp.maximum(l, 1e-30)[..., None]
+
+
+def ring_attention(mesh: Mesh, q, k, v, *, causal: bool = False,
+                   seq_axis: str = "seq"):
+    """Context-parallel attention of global (B, H, T, D) arrays sharded on
+    the T axis over ``seq_axis``. Returns output with the same sharding.
+
+    The reference equivalent does not exist; use this wherever a
+    transformer's sequence no longer fits one chip.
+    """
+    spec = P(None, None, seq_axis, None)
+    fn = jax.shard_map(
+        partial(_ring_attention_local, axis_name=seq_axis, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)

@@ -1,0 +1,111 @@
+"""Orca PyTorch Estimator — the reference's
+``test_estimator_pytorch_backend.py`` pattern: tiny torch Net, train, assert
+improvement; weights cross the bridge both ways."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn  # noqa: E402
+
+from zoo_tpu.orca.learn.pytorch import Estimator  # noqa: E402
+
+
+def _linear_data(n=256, d=4, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, d).astype(np.float32)
+    w = rs.randn(d, 1).astype(np.float32)
+    return x, (x @ w).astype(np.float32)
+
+
+def test_from_torch_fit_improves(orca_ctx):
+    x, y = _linear_data()
+    net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 1))
+    est = Estimator.from_torch(
+        model=net,
+        optimizer=torch.optim.Adam(net.parameters(), lr=0.01),
+        loss=nn.MSELoss())
+    hist = est.fit({"x": x, "y": y}, epochs=5, batch_size=32)
+    assert hist["loss"][-1] < hist["loss"][0] * 0.5
+    preds = est.predict(x[:16])
+    assert preds.shape == (16, 1)
+
+
+def test_bridge_forward_matches_torch(orca_ctx):
+    """Converted model must reproduce torch's forward exactly (eval mode)."""
+    torch.manual_seed(0)
+    net = nn.Sequential(nn.Linear(6, 8), nn.Tanh(), nn.Linear(8, 3),
+                        nn.Softmax(dim=-1))
+    x = np.random.RandomState(0).randn(10, 6).astype(np.float32)
+    with torch.no_grad():
+        ref = net(torch.from_numpy(x)).numpy()
+
+    est = Estimator.from_torch(model=net, loss=nn.MSELoss())
+    got = est.predict(x)
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+def test_bridge_conv_matches_torch(orca_ctx):
+    torch.manual_seed(0)
+    net = nn.Sequential(nn.Conv2d(2, 4, 3), nn.ReLU(),
+                        nn.MaxPool2d(2), nn.Flatten(), nn.Linear(4 * 3 * 3, 2))
+    x = np.random.RandomState(0).randn(4, 2, 8, 8).astype(np.float32)
+    with torch.no_grad():
+        ref = net(torch.from_numpy(x)).numpy()
+    est = Estimator.from_torch(model=net, loss=nn.MSELoss())
+    got = est.predict(x)
+    np.testing.assert_allclose(got, ref, atol=1e-4)
+
+
+def test_cross_entropy_classifier(orca_ctx):
+    rs = np.random.RandomState(0)
+    x = rs.randn(256, 4).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int64) + (x[:, 1] > 0).astype(np.int64)
+    net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 3))
+    est = Estimator.from_torch(
+        model=net, optimizer=torch.optim.Adam(net.parameters(), lr=0.01),
+        loss=nn.CrossEntropyLoss(), metrics=["accuracy"])
+    est.fit({"x": x, "y": y}, epochs=6, batch_size=32)
+    res = est.evaluate({"x": x, "y": y})
+    assert res["accuracy"] > 0.7
+
+
+def test_trained_weights_flow_back_to_torch(orca_ctx):
+    x, y = _linear_data(n=128)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 1))
+    est = Estimator.from_torch(
+        model=net, optimizer=torch.optim.Adam(net.parameters(), lr=0.02),
+        loss=nn.MSELoss())
+    est.fit({"x": x, "y": y}, epochs=4, batch_size=32)
+    zoo_preds = est.predict(x[:16])
+    trained = est.get_model()
+    with torch.no_grad():
+        torch_preds = trained(torch.from_numpy(x[:16])).numpy()
+    np.testing.assert_allclose(zoo_preds, torch_preds, atol=1e-4)
+
+
+def test_unsupported_module_message(orca_ctx):
+    class Weird(nn.Module):
+        def forward(self, x):
+            return x
+
+    net = nn.Sequential(nn.Linear(4, 4), Weird())
+    est = Estimator.from_torch(model=net, loss=nn.MSELoss())
+    with pytest.raises(ValueError, match="Weird"):
+        est.predict(np.ones((8, 4), np.float32))
+
+
+def test_creator_functions(orca_ctx):
+    """The reference's creator-function style must work too."""
+    x, y = _linear_data(n=128)
+
+    est = Estimator.from_torch(
+        model_creator=lambda cfg: nn.Sequential(
+            nn.Linear(4, cfg["hidden"]), nn.ReLU(),
+            nn.Linear(cfg["hidden"], 1)),
+        optimizer_creator=lambda model, cfg: torch.optim.SGD(
+            model.parameters(), lr=cfg["lr"]),
+        loss_creator=lambda cfg: nn.MSELoss(),
+        config={"hidden": 8, "lr": 0.05})
+    hist = est.fit({"x": x, "y": y}, epochs=3, batch_size=32)
+    assert hist["loss"][-1] < hist["loss"][0]

@@ -1,0 +1,3 @@
+from zoo_tpu.orca.learn.pytorch.estimator import Estimator, PyTorchEstimator
+
+__all__ = ["Estimator", "PyTorchEstimator"]

@@ -59,6 +59,42 @@ def sparse_categorical_crossentropy(y_true, y_pred):
     return -jnp.mean(picked)
 
 
+def _class_last(y_true, t):
+    """Normalize to class-axis-last. The class axis may be last (keras
+    layout) or dim 1 (torch's (N, C, ...) layout for >2D inputs); detected
+    from the label shape, preferring the keras layout when ambiguous."""
+    idx = y_true.astype(jnp.int32)
+    if idx.ndim == t.ndim:  # (N, ..., 1)-shaped labels
+        idx = idx.squeeze(-1)
+    if t.ndim > 2 and idx.shape != t.shape[:-1] \
+            and idx.shape == (t.shape[0],) + t.shape[2:]:
+        t = jnp.moveaxis(t, 1, -1)
+    return idx, t
+
+
+def _sparse_nll(idx, logp, ignore_index: int = -100):
+    """NLL over class-last log-probs; labels equal to ``ignore_index``
+    (torch's -100 padding convention) are masked out of the mean."""
+    mask = idx != ignore_index
+    safe = jnp.where(mask, idx, 0)
+    picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    total = jnp.sum(jnp.where(mask, -picked, 0.0))
+    return total / jnp.maximum(jnp.sum(mask), 1)
+
+
+def sparse_categorical_crossentropy_from_logits(y_true, logits):
+    """torch ``nn.CrossEntropyLoss`` semantics (logits in, int labels;
+    channel-first layouts and ``ignore_index=-100`` respected)."""
+    idx, logits = _class_last(y_true, logits)
+    return _sparse_nll(idx, jax.nn.log_softmax(logits, axis=-1))
+
+
+def nll_loss(y_true, log_probs):
+    """torch ``nn.NLLLoss`` semantics (log-probabilities in)."""
+    idx, logp = _class_last(y_true, log_probs)
+    return _sparse_nll(idx, logp)
+
+
 def hinge(y_true, y_pred):
     return jnp.mean(jnp.maximum(1.0 - y_true * y_pred, 0.0))
 
@@ -96,6 +132,10 @@ _ALIASES = {
     "bce": binary_crossentropy,
     "categorical_crossentropy": categorical_crossentropy,
     "sparse_categorical_crossentropy": sparse_categorical_crossentropy,
+    "sparse_categorical_crossentropy_from_logits":
+        sparse_categorical_crossentropy_from_logits,
+    "binary_crossentropy_from_logits": binary_crossentropy_from_logits,
+    "nll": nll_loss,
     "hinge": hinge,
     "squared_hinge": squared_hinge,
     "kld": kullback_leibler_divergence,

@@ -1,0 +1,3 @@
+from zoo_tpu.chronos.data.tsdataset import TSDataset
+
+__all__ = ["TSDataset"]

@@ -1,0 +1,209 @@
+"""TSDataset — time-series dataset with roll/impute/scale/resample.
+
+Rebuild of ``pyzoo/zoo/chronos/data/tsdataset.py:42`` (TSDataset with
+``from_pandas``, ``impute``, ``deduplicate``, ``resample``,
+``gen_dt_feature``, ``scale``/``unscale``, ``roll(lookback, horizon)``,
+``to_numpy``, ``unscale_numpy``). Single- and multi-id (grouped) series are
+supported like the reference; rolled windows from different ids are
+concatenated, never crossing id boundaries.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+import pandas as pd
+
+
+_DT_FEATURES = ("HOUR", "DAY", "MONTH", "WEEKDAY", "WEEKOFYEAR", "MINUTE",
+                "DAYOFYEAR", "IS_WEEKEND")
+
+
+class TSDataset:
+    def __init__(self, df: pd.DataFrame, dt_col: str,
+                 target_col: List[str], id_col: Optional[str],
+                 extra_feature_col: List[str]):
+        self.df = df
+        self.dt_col = dt_col
+        self.target_col = list(target_col)
+        self.id_col = id_col
+        self.feature_col = list(extra_feature_col)
+        self.scaler = None
+        self.numpy_x: Optional[np.ndarray] = None
+        self.numpy_y: Optional[np.ndarray] = None
+        self.lookback: Optional[int] = None
+        self.horizon: Optional[Union[int, List[int]]] = None
+
+    # -- construction ------------------------------------------------------
+    @staticmethod
+    def from_pandas(df: pd.DataFrame, dt_col: str,
+                    target_col: Union[str, Sequence[str]],
+                    id_col: Optional[str] = None,
+                    extra_feature_col: Union[str, Sequence[str], None] = None,
+                    with_split: bool = False, val_ratio: float = 0,
+                    test_ratio: float = 0.1):
+        """reference: ``TSDataset.from_pandas`` (returns one dataset, or a
+        train/val/test triple when ``with_split``)."""
+        target_col = [target_col] if isinstance(target_col, str) \
+            else list(target_col)
+        extra = [] if extra_feature_col is None else (
+            [extra_feature_col] if isinstance(extra_feature_col, str)
+            else list(extra_feature_col))
+        work = df.copy()
+        work[dt_col] = pd.to_datetime(work[dt_col])
+        work = work.sort_values([c for c in (id_col, dt_col) if c]) \
+            .reset_index(drop=True)
+        if not with_split:
+            return TSDataset(work, dt_col, target_col, id_col, extra)
+        n = len(work)
+        test_n = int(n * test_ratio)
+        val_n = int(n * val_ratio)
+        train = work.iloc[: n - val_n - test_n]
+        val = work.iloc[n - val_n - test_n: n - test_n]
+        test = work.iloc[n - test_n:]
+        return tuple(TSDataset(part.reset_index(drop=True), dt_col,
+                               target_col, id_col, extra)
+                     for part in (train, val, test))
+
+    def _groups(self):
+        if self.id_col is None:
+            yield self.df
+        else:
+            for _, g in self.df.groupby(self.id_col, sort=False):
+                yield g
+
+    # -- cleaning ----------------------------------------------------------
+    def impute(self, mode: str = "last", const_num: float = 0.0):
+        """reference modes: last | const | linear."""
+        cols = self.target_col + self.feature_col
+        if mode == "last":
+            self.df[cols] = self.df[cols].ffill().bfill()
+        elif mode == "const":
+            self.df[cols] = self.df[cols].fillna(const_num)
+        elif mode == "linear":
+            self.df[cols] = self.df[cols].interpolate(
+                method="linear", limit_direction="both")
+        else:
+            raise ValueError(f"unknown impute mode: {mode}")
+        return self
+
+    def deduplicate(self):
+        keys = [c for c in (self.id_col, self.dt_col) if c]
+        self.df = self.df.drop_duplicates(subset=keys).reset_index(drop=True)
+        return self
+
+    def resample(self, interval: str, merge_mode: str = "mean"):
+        """reference: resample to a fixed interval per id."""
+        def _one(g):
+            g = g.set_index(self.dt_col)
+            num = g[self.target_col + self.feature_col]
+            agg = getattr(num.resample(interval), merge_mode)()
+            if self.id_col:
+                agg[self.id_col] = g[self.id_col].iloc[0]
+            return agg.reset_index()
+
+        self.df = pd.concat([_one(g) for g in self._groups()],
+                            ignore_index=True)
+        return self
+
+    # -- feature generation ------------------------------------------------
+    def gen_dt_feature(self, features: Sequence[str] = _DT_FEATURES):
+        dt = self.df[self.dt_col].dt
+        table = {
+            "HOUR": dt.hour, "DAY": dt.day, "MONTH": dt.month,
+            "WEEKDAY": dt.weekday, "MINUTE": dt.minute,
+            "DAYOFYEAR": dt.dayofyear,
+            "WEEKOFYEAR": dt.isocalendar().week.astype(np.int64),
+            "IS_WEEKEND": (dt.weekday >= 5).astype(np.int64),
+        }
+        for f in features:
+            f = f.upper()
+            if f not in table:
+                raise ValueError(f"unknown dt feature: {f}")
+            self.df[f] = np.asarray(table[f])
+            if f not in self.feature_col:
+                self.feature_col.append(f)
+        return self
+
+    # -- scaling -----------------------------------------------------------
+    def scale(self, scaler, fit: bool = True):
+        """sklearn-style scaler over target+feature cols (reference keeps
+        the scaler for ``unscale_numpy``)."""
+        cols = self.target_col + self.feature_col
+        vals = self.df[cols].to_numpy(dtype=np.float64)
+        if fit:
+            scaler.fit(vals)
+        self.df[cols] = scaler.transform(vals)
+        self.scaler = scaler
+        return self
+
+    def unscale(self):
+        if self.scaler is None:
+            raise RuntimeError("scale() was never called")
+        cols = self.target_col + self.feature_col
+        self.df[cols] = self.scaler.inverse_transform(
+            self.df[cols].to_numpy(dtype=np.float64))
+        return self
+
+    def unscale_numpy(self, y: np.ndarray) -> np.ndarray:
+        """Invert scaling on a rolled target array (batch, horizon,
+        n_targets) (reference: ``unscale_numpy``)."""
+        if self.scaler is None:
+            raise RuntimeError("scale() was never called")
+        n_target = len(self.target_col)
+        n_cols = n_target + len(self.feature_col)
+        flat = y.reshape(-1, n_target)
+        pad = np.zeros((flat.shape[0], n_cols))
+        pad[:, :n_target] = flat
+        out = self.scaler.inverse_transform(pad)[:, :n_target]
+        return out.reshape(y.shape)
+
+    # -- rolling -----------------------------------------------------------
+    def roll(self, lookback: int, horizon: Union[int, List[int]],
+             feature_col: Optional[Sequence[str]] = None,
+             target_col: Optional[Sequence[str]] = None):
+        """Produce sliding windows: x (n, lookback, n_targets+n_features),
+        y (n, horizon, n_targets) (reference: ``TSDataset.roll``).
+        ``horizon=0`` gives inference windows with no y."""
+        feature_col = list(feature_col if feature_col is not None
+                           else self.feature_col)
+        target_col = list(target_col if target_col is not None
+                          else self.target_col)
+        horizons = list(range(1, horizon + 1)) if isinstance(horizon, int) \
+            and horizon > 0 else ([] if horizon == 0 else list(horizon))
+        max_h = max(horizons) if horizons else 0
+        xs, ys = [], []
+        in_cols = target_col + feature_col
+        for g in self._groups():
+            arr = g[in_cols].to_numpy(dtype=np.float32)
+            tgt = g[target_col].to_numpy(dtype=np.float32)
+            n = len(arr) - lookback - max_h + 1
+            for i in range(max(n, 0)):
+                xs.append(arr[i:i + lookback])
+                if horizons:
+                    ys.append(tgt[[i + lookback + h - 1 for h in horizons]])
+        if not xs and len(self.df):
+            raise ValueError(
+                f"lookback ({lookback}) + horizon ({max_h}) exceeds every "
+                f"series length (longest: "
+                f"{max(len(g) for g in self._groups())})")
+        self.numpy_x = np.stack(xs) if xs else np.zeros(
+            (0, lookback, len(in_cols)), np.float32)
+        self.numpy_y = np.stack(ys) if ys else None
+        self.lookback, self.horizon = lookback, horizon
+        return self
+
+    def to_numpy(self) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        if self.numpy_x is None:
+            raise RuntimeError("call roll() before to_numpy()")
+        return self.numpy_x, self.numpy_y
+
+    def to_pandas(self) -> pd.DataFrame:
+        return self.df.copy()
+
+    def get_feature_num(self) -> int:
+        return len(self.target_col) + len(self.feature_col)
+
+    def get_target_num(self) -> int:
+        return len(self.target_col)

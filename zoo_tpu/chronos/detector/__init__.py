@@ -1,0 +1,7 @@
+from zoo_tpu.chronos.detector.anomaly import (
+    AEDetector,
+    DBScanDetector,
+    ThresholdDetector,
+)
+
+__all__ = ["AEDetector", "DBScanDetector", "ThresholdDetector"]

@@ -1,0 +1,151 @@
+"""Anomaly detectors over 1-D/2-D time series.
+
+Rebuild of ``pyzoo/zoo/chronos/model/anomaly/`` — ``ThresholdDetector``
+(distance from forecast/pattern with absolute or percentile threshold),
+``AEDetector`` (autoencoder reconstruction error), ``DBScanDetector``
+(sklearn DBSCAN outliers). Same ``fit``/``score``/``anomaly_indexes`` API.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class ThresholdDetector:
+    """reference: ``chronos/model/anomaly/th_detector.py`` — flag points
+    whose |y - yhat| exceeds an absolute threshold or a fitted percentile."""
+
+    def __init__(self):
+        self.th = np.inf
+        self.ratio = 0.01
+        self.dist: Optional[np.ndarray] = None
+
+    def set_params(self, threshold: float = np.inf, ratio: float = 0.01):
+        self.th = threshold
+        self.ratio = ratio
+        return self
+
+    def fit(self, y: np.ndarray, y_pred: Optional[np.ndarray] = None):
+        y = np.asarray(y, np.float64)
+        dist = np.abs(y - np.asarray(y_pred, np.float64)) \
+            if y_pred is not None else np.abs(y - np.mean(y, axis=0))
+        self.dist = dist.reshape(len(dist), -1).max(axis=1)
+        if not np.isfinite(self.th):
+            self.th = float(np.quantile(self.dist, 1 - self.ratio))
+        return self
+
+    def score(self, y=None, y_pred=None) -> np.ndarray:
+        if y is not None:
+            self.fit_dist_only(y, y_pred)
+        if self.dist is None:
+            raise RuntimeError("call fit() first")
+        return self.dist
+
+    def fit_dist_only(self, y, y_pred):
+        y = np.asarray(y, np.float64)
+        dist = np.abs(y - np.asarray(y_pred, np.float64)) \
+            if y_pred is not None else np.abs(y - np.mean(y, axis=0))
+        self.dist = dist.reshape(len(dist), -1).max(axis=1)
+
+    def anomaly_indexes(self) -> np.ndarray:
+        return np.where(self.score() > self.th)[0]
+
+
+class AEDetector:
+    """reference: ``chronos/model/anomaly/ae_detector.py`` — dense
+    autoencoder; anomaly score = reconstruction error z-score."""
+
+    def __init__(self, roll_len: int = 24, ratio: float = 0.1,
+                 compress_rate: float = 0.25, batch_size: int = 100,
+                 epochs: int = 20, lr: float = 0.001):
+        self.roll_len = roll_len
+        self.ratio = ratio
+        self.compress_rate = compress_rate
+        self.batch_size = batch_size
+        self.epochs = epochs
+        self.lr = lr
+        self.model = None
+        self._scores: Optional[np.ndarray] = None
+
+    def _roll(self, y: np.ndarray) -> np.ndarray:
+        y = np.asarray(y, np.float32).reshape(len(y), -1)
+        if self.roll_len <= 1:
+            return y
+        n = len(y) - self.roll_len + 1
+        return np.stack([y[i:i + self.roll_len].ravel() for i in range(n)])
+
+    def fit(self, y: np.ndarray):
+        from zoo_tpu.pipeline.api.keras import Sequential, optimizers as zopt
+        from zoo_tpu.pipeline.api.keras.layers import Dense
+
+        windows = self._roll(y)
+        d = windows.shape[1]
+        hidden = max(1, int(d * self.compress_rate))
+        m = Sequential(name="ae_detector")
+        m.add(Dense(hidden, activation="relu", input_shape=(d,)))
+        m.add(Dense(d))
+        m.compile(optimizer=zopt.Adam(lr=self.lr), loss="mse")
+        bs = min(self.batch_size, len(windows))
+        # keep the batch divisible by the mesh's data shards
+        from zoo_tpu.common.context import get_runtime_context
+        ctx = get_runtime_context(required=False)
+        if ctx is not None:
+            from zoo_tpu.parallel.mesh import data_axes
+            denom = 1
+            for a in data_axes(ctx.mesh):
+                denom *= ctx.mesh.shape[a]
+            bs = max(denom, (bs // denom) * denom)
+        m.fit(windows, windows, batch_size=bs, nb_epoch=self.epochs,
+              verbose=0)
+        self.model = m
+        rec = m.predict(windows)
+        err = np.mean((rec - windows) ** 2, axis=1)
+        # expand window scores back to per-point scores (max over windows
+        # covering the point), matching the reference's rolled scoring
+        scores = np.zeros(len(y))
+        counts = np.zeros(len(y))
+        for i, e in enumerate(err):
+            scores[i:i + self.roll_len] = np.maximum(
+                scores[i:i + self.roll_len], e)
+            counts[i:i + self.roll_len] += 1
+        self._scores = scores
+        return self
+
+    def score(self) -> np.ndarray:
+        if self._scores is None:
+            raise RuntimeError("call fit() first")
+        mu, sd = self._scores.mean(), self._scores.std() + 1e-12
+        return (self._scores - mu) / sd
+
+    def anomaly_indexes(self) -> np.ndarray:
+        s = self.score()
+        th = np.quantile(s, 1 - self.ratio)
+        return np.where(s > th)[0]
+
+
+class DBScanDetector:
+    """reference: ``chronos/model/anomaly/dbscan_detector.py``."""
+
+    def __init__(self, eps: float = 0.5, min_samples: int = 5, **kwargs):
+        self.eps = eps
+        self.min_samples = min_samples
+        self.kwargs = kwargs
+        self._labels = None
+
+    def fit(self, y: np.ndarray):
+        from sklearn.cluster import DBSCAN
+
+        y = np.asarray(y, np.float64).reshape(len(y), -1)
+        self._labels = DBSCAN(eps=self.eps, min_samples=self.min_samples,
+                              **self.kwargs).fit_predict(y)
+        return self
+
+    def score(self) -> np.ndarray:
+        if self._labels is None:
+            raise RuntimeError("call fit() first")
+        return (self._labels == -1).astype(np.float64)
+
+    def anomaly_indexes(self) -> np.ndarray:
+        return np.where(self.score() > 0)[0]

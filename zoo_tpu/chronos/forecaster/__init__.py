@@ -1,0 +1,7 @@
+from zoo_tpu.chronos.forecaster.base import Forecaster
+from zoo_tpu.chronos.forecaster.lstm_forecaster import LSTMForecaster
+from zoo_tpu.chronos.forecaster.seq2seq_forecaster import Seq2SeqForecaster
+from zoo_tpu.chronos.forecaster.tcn_forecaster import TCNForecaster
+
+__all__ = ["Forecaster", "LSTMForecaster", "Seq2SeqForecaster",
+           "TCNForecaster"]

@@ -1,0 +1,118 @@
+"""Forecaster base: uniform fit/predict/evaluate over rolled arrays or
+TSDataset.
+
+Rebuild of ``pyzoo/zoo/chronos/model/forecast/abstract.py`` +
+``tfpark_forecaster.py`` (the reference builds keras/torch models per
+forecaster; ours build zoo_tpu Keras-facade models, so every forecaster
+trains as a jitted sharded step on the mesh).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from zoo_tpu.chronos.data.tsdataset import TSDataset
+
+
+def _smape(y_true, y_pred):
+    denom = (np.abs(y_true) + np.abs(y_pred)) / 2.0
+    return float(np.mean(np.where(denom == 0, 0.0,
+                                  np.abs(y_pred - y_true) /
+                                  np.maximum(denom, 1e-12))) * 100)
+
+
+_EVAL_FNS = {
+    "mse": lambda t, p: float(np.mean((p - t) ** 2)),
+    "rmse": lambda t, p: float(np.sqrt(np.mean((p - t) ** 2))),
+    "mae": lambda t, p: float(np.mean(np.abs(p - t))),
+    "smape": _smape,
+    "r2": lambda t, p: float(1 - ((t - p) ** 2).sum() /
+                             max(((t - t.mean()) ** 2).sum(), 1e-12)),
+}
+
+
+class Forecaster:
+    """Subclasses set ``self.model`` (a compiled KerasNet) in ``_build``."""
+
+    def __init__(self, past_seq_len: int, input_feature_num: int,
+                 output_feature_num: int, future_seq_len: int = 1):
+        self.past_seq_len = int(past_seq_len)
+        self.input_feature_num = int(input_feature_num)
+        self.output_feature_num = int(output_feature_num)
+        self.future_seq_len = int(future_seq_len)
+        self.model = None
+        self.fitted = False
+        self._ctor_args = {"past_seq_len": past_seq_len,
+                           "input_feature_num": input_feature_num,
+                           "output_feature_num": output_feature_num}
+
+    # -- to override ------------------------------------------------------
+    def _build(self):
+        raise NotImplementedError
+
+    # -- data plumbing ----------------------------------------------------
+    def _unpack(self, data) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        if isinstance(data, TSDataset):
+            if data.numpy_x is None:
+                data.roll(self.past_seq_len, self.future_seq_len)
+            return data.to_numpy()
+        if isinstance(data, tuple):
+            return data[0], (data[1] if len(data) > 1 else None)
+        return data, None
+
+    @staticmethod
+    def from_tsdataset(tsdataset: TSDataset, past_seq_len: int = 24,
+                       future_seq_len: int = 1, **kwargs):
+        """Build a forecaster sized from a TSDataset (reference:
+        ``Forecaster.from_tsdataset``)."""
+        raise NotImplementedError
+
+    # -- API --------------------------------------------------------------
+    def fit(self, data, epochs: int = 1, batch_size: int = 32,
+            validation_data=None) -> Dict:
+        x, y = self._unpack(data)
+        if y is None:
+            raise ValueError("fit requires rolled targets")
+        if self.model is None:
+            self._build()
+        y = y.reshape(y.shape[0], -1)  # flatten (horizon, feat) for the head
+        val = None
+        if validation_data is not None:
+            vx, vy = self._unpack(validation_data)
+            val = (vx, vy.reshape(vy.shape[0], -1))
+        hist = self.model.fit(x, y, batch_size=min(batch_size, len(x)),
+                              nb_epoch=epochs, validation_data=val,
+                              verbose=0)
+        self.fitted = True
+        return hist
+
+    def predict(self, data, batch_size: int = 256) -> np.ndarray:
+        x, _ = self._unpack(data)
+        flat = self.model.predict(x, batch_size=batch_size)
+        return flat.reshape(x.shape[0], self.future_seq_len,
+                            self.output_feature_num)
+
+    def evaluate(self, data, metrics=("mse",), batch_size: int = 256
+                 ) -> Dict[str, float]:
+        x, y = self._unpack(data)
+        preds = self.predict((x, None), batch_size=batch_size)
+        y = y.reshape(preds.shape)
+        out = {}
+        for m in metrics:
+            key = m.lower()
+            if key not in _EVAL_FNS:
+                raise ValueError(f"unknown metric: {m}")
+            out[key] = _EVAL_FNS[key](y, preds)
+        return out
+
+    def save(self, checkpoint_file: str):
+        self.model.save_weights(checkpoint_file)
+
+    def load(self, checkpoint_file: str):
+        if self.model is None:
+            self._build()
+        self.model.load_weights(checkpoint_file)
+        self.fitted = True
+        return self

@@ -1,0 +1,65 @@
+"""Seq2Seq (LSTM encoder-decoder) forecaster.
+
+Rebuild of ``chronos/model/forecast/seq2seq_forecaster.py`` (reference
+Seq2SeqPytorch: LSTM encoder, repeated context into an LSTM decoder, dense
+head per step).
+"""
+
+from __future__ import annotations
+
+from zoo_tpu.chronos.data.tsdataset import TSDataset
+from zoo_tpu.chronos.forecaster.base import Forecaster
+
+
+class Seq2SeqForecaster(Forecaster):
+    def __init__(self, past_seq_len: int, future_seq_len: int,
+                 input_feature_num: int, output_feature_num: int,
+                 lstm_hidden_dim: int = 64, lstm_layer_num: int = 1,
+                 dropout: float = 0.1, lr: float = 0.001,
+                 loss: str = "mse"):
+        super().__init__(past_seq_len, input_feature_num,
+                         output_feature_num, future_seq_len)
+        self.hidden = lstm_hidden_dim
+        self.layer_num = lstm_layer_num
+        self.dropout = dropout
+        self.lr = lr
+        self.loss = loss
+        self._ctor_args.update(future_seq_len=future_seq_len,
+                               lstm_hidden_dim=lstm_hidden_dim,
+                               lstm_layer_num=lstm_layer_num,
+                               dropout=dropout, lr=lr, loss=loss)
+
+    def _build(self):
+        from zoo_tpu.pipeline.api.keras import Sequential, optimizers as zopt
+        from zoo_tpu.pipeline.api.keras.layers import (
+            LSTM, Dense, Dropout, RepeatVector, Reshape, TimeDistributed,
+        )
+
+        m = Sequential(name="seq2seq_forecaster")
+        for i in range(self.layer_num):
+            last = i == self.layer_num - 1
+            kwargs = {"input_shape": (self.past_seq_len,
+                                      self.input_feature_num)} if i == 0 \
+                else {}
+            m.add(LSTM(self.hidden, return_sequences=not last, **kwargs))
+        if self.dropout:
+            m.add(Dropout(self.dropout))
+        m.add(RepeatVector(self.future_seq_len))
+        m.add(LSTM(self.hidden, return_sequences=True))
+        m.add(TimeDistributed(Dense(self.output_feature_num)))
+        m.add(Reshape((self.future_seq_len * self.output_feature_num,)))
+        m.compile(optimizer=zopt.Adam(lr=self.lr), loss=self.loss)
+        self.model = m
+
+    @staticmethod
+    def from_tsdataset(tsdataset: TSDataset, past_seq_len: int = 24,
+                       future_seq_len: int = 1, **kwargs
+                       ) -> "Seq2SeqForecaster":
+        if tsdataset.lookback is not None:
+            past_seq_len = tsdataset.lookback
+            h = tsdataset.horizon
+            future_seq_len = h if isinstance(h, int) else len(h)
+        return Seq2SeqForecaster(
+            past_seq_len=past_seq_len, future_seq_len=future_seq_len,
+            input_feature_num=tsdataset.get_feature_num(),
+            output_feature_num=tsdataset.get_target_num(), **kwargs)

@@ -1,0 +1,128 @@
+"""TCN forecaster — dilated causal temporal convolutions.
+
+Rebuild of ``chronos/model/forecast/tcn_forecaster.py`` (reference TCN:
+stacked residual blocks of dilated causal Conv1d, torch-side). Causality is
+by left-padding each dilated conv; the whole network is a handful of NWC
+convs — ideal MXU shape.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from zoo_tpu.chronos.data.tsdataset import TSDataset
+from zoo_tpu.chronos.forecaster.base import Forecaster
+from zoo_tpu.pipeline.api.keras.engine.base import Layer, get_initializer
+
+
+class _CausalConvBlock(Layer):
+    """Residual TCN block: two dilated causal convs + 1x1 skip."""
+
+    def __init__(self, channels: int, kernel_size: int, dilation: int,
+                 dropout: float = 0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.channels = channels
+        self.kernel_size = kernel_size
+        self.dilation = dilation
+        self.dropout = dropout
+        self.init = get_initializer("he_normal")
+
+    def build(self, rng, input_shape):
+        cin = input_shape[-1]
+        k1, k2, k3 = jax.random.split(rng, 3)
+        p = {
+            "W1": self.init(k1, (self.kernel_size, cin, self.channels),
+                            jnp.float32),
+            "b1": jnp.zeros((self.channels,), jnp.float32),
+            "W2": self.init(k2, (self.kernel_size, self.channels,
+                                 self.channels), jnp.float32),
+            "b2": jnp.zeros((self.channels,), jnp.float32),
+        }
+        if cin != self.channels:
+            p["Wskip"] = self.init(k3, (1, cin, self.channels), jnp.float32)
+        return p
+
+    def _causal_conv(self, x, W, b):
+        pad = (self.kernel_size - 1) * self.dilation
+        x = jnp.pad(x, ((0, 0), (pad, 0), (0, 0)))
+        y = jax.lax.conv_general_dilated(
+            x, W, window_strides=(1,), padding="VALID",
+            rhs_dilation=(self.dilation,),
+            dimension_numbers=("NWC", "WIO", "NWC"))
+        return y + b
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        y = jax.nn.relu(self._causal_conv(inputs, params["W1"],
+                                          params["b1"]))
+        if training and self.dropout and rng is not None:
+            from zoo_tpu.pipeline.api.keras.engine.base import layer_rng
+            keep = 1 - self.dropout
+            mask = jax.random.bernoulli(layer_rng(rng, self.name), keep,
+                                        y.shape)
+            y = jnp.where(mask, y / keep, 0.0)
+        y = jax.nn.relu(self._causal_conv(y, params["W2"], params["b2"]))
+        skip = inputs
+        if "Wskip" in params:
+            skip = jax.lax.conv_general_dilated(
+                inputs, params["Wskip"], (1,), "VALID",
+                dimension_numbers=("NWC", "WIO", "NWC"))
+        return jax.nn.relu(y + skip)
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0], input_shape[1], self.channels)
+
+
+class TCNForecaster(Forecaster):
+    def __init__(self, past_seq_len: int, future_seq_len: int,
+                 input_feature_num: int, output_feature_num: int,
+                 num_channels: Optional[list] = None, kernel_size: int = 3,
+                 dropout: float = 0.1, lr: float = 0.001,
+                 loss: str = "mse"):
+        super().__init__(past_seq_len, input_feature_num,
+                         output_feature_num, future_seq_len)
+        self.num_channels = list(num_channels or [30, 30])
+        self.kernel_size = kernel_size
+        self.dropout = dropout
+        self.lr = lr
+        self.loss = loss
+        self._ctor_args.update(future_seq_len=future_seq_len,
+                               num_channels=self.num_channels,
+                               kernel_size=kernel_size, dropout=dropout,
+                               lr=lr, loss=loss)
+
+    def _build(self):
+        from zoo_tpu.pipeline.api.keras import Sequential, optimizers as zopt
+        from zoo_tpu.pipeline.api.keras.layers import Dense, Flatten, Lambda
+
+        m = Sequential(name="tcn_forecaster")
+        first = True
+        for i, ch in enumerate(self.num_channels):
+            blk = _CausalConvBlock(ch, self.kernel_size, dilation=2 ** i,
+                                   dropout=self.dropout)
+            if first:
+                blk.batch_input_shape = (None, self.past_seq_len,
+                                         self.input_feature_num)
+                first = False
+            m.add(blk)
+        # last timestep carries the full receptive field
+        m.add(Lambda(lambda x: x[:, -1], output_shape=(
+            self.num_channels[-1],)))
+        m.add(Dense(self.future_seq_len * self.output_feature_num))
+        m.compile(optimizer=zopt.Adam(lr=self.lr), loss=self.loss)
+        self.model = m
+
+    @staticmethod
+    def from_tsdataset(tsdataset: TSDataset, past_seq_len: int = 24,
+                       future_seq_len: int = 1, **kwargs
+                       ) -> "TCNForecaster":
+        if tsdataset.lookback is not None:
+            past_seq_len = tsdataset.lookback
+            h = tsdataset.horizon
+            future_seq_len = h if isinstance(h, int) else len(h)
+        return TCNForecaster(
+            past_seq_len=past_seq_len, future_seq_len=future_seq_len,
+            input_feature_num=tsdataset.get_feature_num(),
+            output_feature_num=tsdataset.get_target_num(), **kwargs)

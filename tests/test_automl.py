@@ -1,0 +1,98 @@
+import numpy as np
+import pandas as pd
+import pytest
+
+from zoo_tpu.automl import hp
+from zoo_tpu.automl.search import LocalSearchEngine, _expand_configs
+from zoo_tpu.orca.automl import AutoEstimator
+
+
+def test_hp_samplers():
+    rng = np.random.RandomState(0)
+    assert hp.choice([1, 2, 3]).sample(rng) in (1, 2, 3)
+    v = hp.uniform(0.0, 1.0).sample(rng)
+    assert 0 <= v <= 1
+    v = hp.loguniform(1e-4, 1e-1).sample(rng)
+    assert 1e-4 <= v <= 1e-1
+    assert 1 <= hp.randint(1, 5).sample(rng) < 5
+    assert hp.grid_search([4, 8]).grid() == [4, 8]
+    q = hp.quniform(1, 10, q=2).sample(rng)
+    assert q % 2 == 0
+
+
+def test_expand_configs_grid_cross():
+    rng = np.random.RandomState(0)
+    space = {"a": hp.grid_search([1, 2]), "b": hp.grid_search([10, 20]),
+             "c": 7}
+    cfgs = _expand_configs(space, n_sampling=3, rng=rng)
+    assert len(cfgs) == 4  # pure grid dedupes n_sampling
+    assert {(c["a"], c["b"]) for c in cfgs} == {(1, 10), (1, 20), (2, 10),
+                                               (2, 20)}
+    space["d"] = hp.uniform(0, 1)
+    cfgs = _expand_configs(space, n_sampling=2, rng=rng)
+    assert len(cfgs) == 8  # 4 grid points × 2 samples
+
+
+def test_local_search_engine_minimizes():
+    eng = LocalSearchEngine()
+    eng.compile(lambda cfg: {"mse": (cfg["x"] - 3) ** 2},
+                {"x": hp.grid_search([0, 1, 2, 3, 4])}, metric="mse",
+                mode="min")
+    eng.run()
+    assert eng.get_best_trial().config["x"] == 3
+
+
+def test_auto_estimator_keras(orca_ctx):
+    rs = np.random.RandomState(0)
+    x = rs.randn(128, 4).astype(np.float32)
+    w = rs.randn(4, 1).astype(np.float32)
+    y = x @ w
+
+    def creator(config):
+        from zoo_tpu.pipeline.api.keras import Sequential, optimizers
+        from zoo_tpu.pipeline.api.keras.layers import Dense
+
+        m = Sequential()
+        m.add(Dense(config["hidden"], activation="relu", input_shape=(4,)))
+        m.add(Dense(1))
+        m.compile(optimizer=optimizers.Adam(lr=config["lr"]), loss="mse")
+        return m
+
+    auto = AutoEstimator.from_keras(model_creator=creator)
+    auto.fit((x, y), epochs=3, batch_size=32,
+             search_space={"hidden": hp.grid_search([4, 16]),
+                           "lr": hp.choice([0.01])},
+             metric="mse")
+    best = auto.get_best_model()
+    assert auto.get_best_config()["hidden"] in (4, 16)
+    assert np.isfinite(auto.best_metric)
+    assert best.predict(x[:8]).shape == (8, 1)
+
+
+def test_autots_estimator(orca_ctx, tmp_path):
+    from zoo_tpu.chronos.autots import AutoTSEstimator, TSPipeline
+    from zoo_tpu.chronos.data import TSDataset
+
+    t = pd.date_range("2024-01-01", periods=300, freq="h")
+    v = np.sin(np.arange(300) * 2 * np.pi / 24)
+    df = pd.DataFrame({"ts": t, "value": v})
+    train, _, test = TSDataset.from_pandas(
+        df, dt_col="ts", target_col="value", with_split=True,
+        test_ratio=0.2)
+
+    auto = AutoTSEstimator(model="lstm",
+                           search_space={"hidden_dim": hp.grid_search([8]),
+                                         "lr": hp.choice([0.01])},
+                           past_seq_len=hp.grid_search([12]),
+                           future_seq_len=1, metric="mse")
+    pipeline = auto.fit(train, validation_data=test, epochs=2,
+                        batch_size=32)
+    assert isinstance(pipeline, TSPipeline)
+    preds = pipeline.predict(test)
+    assert preds.shape[1:] == (1, 1)
+    res = pipeline.evaluate(test, metrics=["mse"])
+    assert np.isfinite(res["mse"])
+
+    pipeline.save(str(tmp_path / "pipe"))
+    again = TSPipeline.load(str(tmp_path / "pipe"))
+    np.testing.assert_allclose(preds, again.predict(test), rtol=1e-5)

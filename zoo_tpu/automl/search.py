@@ -1,0 +1,164 @@
+"""Hyperparameter search engines.
+
+Rebuild of the reference's ``SearchEngine`` base
+(``pyzoo/zoo/automl/search/base.py``) and ``RayTuneSearchEngine``
+(``automl/search/ray_tune_search_engine.py:29``). On a TPU pod trials share
+chips, so the default engine runs trials sequentially in-process (each trial
+is itself data-parallel over the mesh); a Ray Tune engine is used
+automatically when ray is importable — same trial function.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import logging
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from zoo_tpu.automl.hp import Sampler
+
+logger = logging.getLogger("zoo_tpu.automl")
+
+
+@dataclasses.dataclass
+class Trial:
+    trial_id: int
+    config: Dict[str, Any]
+    metric: float = float("nan")
+    artifacts: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+class SearchEngine:
+    """compile() then run(); get_best_trial() (reference base API)."""
+
+    def compile(self, trial_fn: Callable[[Dict], Dict],
+                search_space: Dict[str, Any], n_sampling: int = 1,
+                metric: str = "mse", mode: str = "min", seed: int = 0):
+        raise NotImplementedError
+
+    def run(self) -> List[Trial]:
+        raise NotImplementedError
+
+    def get_best_trial(self) -> Trial:
+        raise NotImplementedError
+
+
+def _expand_configs(search_space: Dict[str, Any], n_sampling: int,
+                    rng: np.random.RandomState) -> List[Dict[str, Any]]:
+    """Grid dimensions are fully crossed; sampled dimensions drawn
+    ``n_sampling`` times per grid point (ray.tune semantics)."""
+    grid_keys = [k for k, v in search_space.items()
+                 if isinstance(v, Sampler) and v.is_grid()]
+    grid_values = [search_space[k].grid() for k in grid_keys]
+    points = list(itertools.product(*grid_values)) if grid_keys else [()]
+    configs = []
+    for point in points:
+        for _ in range(max(1, n_sampling)):
+            cfg = {}
+            for k, v in search_space.items():
+                if k in grid_keys:
+                    cfg[k] = point[grid_keys.index(k)]
+                elif isinstance(v, Sampler):
+                    cfg[k] = v.sample(rng)
+                else:
+                    cfg[k] = v
+            configs.append(cfg)
+    # dedupe pure-grid duplicates when n_sampling > 1 but nothing is sampled
+    if grid_keys and not any(isinstance(v, Sampler) and not v.is_grid()
+                             for v in search_space.values()):
+        seen, uniq = set(), []
+        for c in configs:
+            key = tuple(sorted((k, repr(v)) for k, v in c.items()))
+            if key not in seen:
+                seen.add(key)
+                uniq.append(c)
+        configs = uniq
+    return configs
+
+
+class LocalSearchEngine(SearchEngine):
+    """Sequential in-process trials (one TPU mesh shared by all trials)."""
+
+    def __init__(self):
+        self._trials: List[Trial] = []
+        self._mode = "min"
+        self._metric = "mse"
+
+    def compile(self, trial_fn, search_space, n_sampling=1, metric="mse",
+                mode="min", seed=0):
+        rng = np.random.RandomState(seed)
+        self._metric, self._mode = metric, mode
+        self._trial_fn = trial_fn
+        self._configs = _expand_configs(search_space, n_sampling, rng)
+        return self
+
+    def run(self) -> List[Trial]:
+        self._trials = []
+        for i, cfg in enumerate(self._configs):
+            result = self._trial_fn(dict(cfg))
+            metric = float(result[self._metric])
+            self._trials.append(Trial(i, cfg, metric,
+                                      artifacts=result))
+            logger.info("trial %d/%d %s=%.5f cfg=%s", i + 1,
+                        len(self._configs), self._metric, metric, cfg)
+        return self._trials
+
+    def get_best_trial(self) -> Trial:
+        if not self._trials:
+            raise RuntimeError("run() first")
+        key = (min if self._mode == "min" else max)
+        return key(self._trials, key=lambda t: t.metric)
+
+
+class RayTuneSearchEngine(SearchEngine):  # pragma: no cover - needs ray
+    """ray.tune-backed engine (reference:
+    ``ray_tune_search_engine.py:29``); selected automatically when ray is
+    installed."""
+
+    def __init__(self):
+        import ray  # noqa: F401  (raises if absent)
+        self._engine = LocalSearchEngine()  # trial bookkeeping reuse
+
+    def compile(self, trial_fn, search_space, n_sampling=1, metric="mse",
+                mode="min", seed=0):
+        import ray
+        from ray import tune
+
+        space = {}
+        for k, v in search_space.items():
+            if isinstance(v, Sampler):
+                if v.is_grid():
+                    space[k] = tune.grid_search(v.grid())
+                else:
+                    space[k] = tune.sample_from(
+                        lambda spec, s=v: s.sample(
+                            np.random.RandomState()))
+            else:
+                space[k] = v
+        self._tune_kwargs = dict(config=space, num_samples=n_sampling,
+                                 metric=metric, mode=mode)
+        self._trial_fn = trial_fn
+        self._metric, self._mode = metric, mode
+        return self
+
+    def run(self):
+        from ray import tune
+
+        def runnable(config):
+            tune.report(**self._trial_fn(config))
+
+        self._analysis = tune.run(runnable, **self._tune_kwargs)
+        return self._analysis
+
+    def get_best_trial(self) -> Trial:
+        best = self._analysis.get_best_trial(self._metric, self._mode)
+        return Trial(0, best.config, best.last_result[self._metric])
+
+
+def make_search_engine() -> SearchEngine:
+    try:
+        return RayTuneSearchEngine()
+    except Exception:
+        return LocalSearchEngine()

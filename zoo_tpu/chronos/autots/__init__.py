@@ -1,0 +1,3 @@
+from zoo_tpu.chronos.autots.autotsestimator import AutoTSEstimator, TSPipeline
+
+__all__ = ["AutoTSEstimator", "TSPipeline"]

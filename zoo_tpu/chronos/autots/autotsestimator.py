@@ -1,0 +1,175 @@
+"""AutoTS: hyperparameter search over Chronos forecasters.
+
+Rebuild of the reference's experimental AutoTSEstimator
+(``pyzoo/zoo/chronos/autots/experimental/autotsestimator.py:323LoC`` with
+auto_lstm/auto_tcn models over Ray Tune) and ``TSPipeline``
+(``tspipeline.py``): search lookback + model hparams, return a pipeline
+bundling the best forecaster with the dataset's scaler.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Dict, Optional, Union
+
+import numpy as np
+
+from zoo_tpu.automl.hp import Sampler
+from zoo_tpu.automl.search import make_search_engine
+from zoo_tpu.chronos.data.tsdataset import TSDataset
+
+_MODELS = {"lstm", "tcn", "seq2seq"}
+
+
+def _build_forecaster(model: str, past_seq_len: int, horizon: int,
+                      n_features: int, n_targets: int, config: Dict):
+    from zoo_tpu.chronos.forecaster import (
+        LSTMForecaster,
+        Seq2SeqForecaster,
+        TCNForecaster,
+    )
+
+    if model == "lstm":
+        return LSTMForecaster(
+            past_seq_len=past_seq_len, input_feature_num=n_features,
+            output_feature_num=n_targets,
+            hidden_dim=config.get("hidden_dim", 32),
+            layer_num=config.get("layer_num", 1),
+            dropout=config.get("dropout", 0.1),
+            lr=config.get("lr", 1e-3))
+    if model == "tcn":
+        return TCNForecaster(
+            past_seq_len=past_seq_len, future_seq_len=horizon,
+            input_feature_num=n_features, output_feature_num=n_targets,
+            num_channels=config.get("num_channels", [16, 16]),
+            kernel_size=config.get("kernel_size", 3),
+            dropout=config.get("dropout", 0.1),
+            lr=config.get("lr", 1e-3))
+    if model == "seq2seq":
+        return Seq2SeqForecaster(
+            past_seq_len=past_seq_len, future_seq_len=horizon,
+            input_feature_num=n_features, output_feature_num=n_targets,
+            lstm_hidden_dim=config.get("lstm_hidden_dim", 32),
+            lstm_layer_num=config.get("lstm_layer_num", 1),
+            dropout=config.get("dropout", 0.1),
+            lr=config.get("lr", 1e-3))
+    raise ValueError(f"unknown model {model!r}; choose from {_MODELS}")
+
+
+class AutoTSEstimator:
+    def __init__(self, model: str = "lstm",
+                 search_space: Optional[Dict] = None,
+                 past_seq_len: Union[int, Sampler] = 24,
+                 future_seq_len: int = 1,
+                 metric: str = "mse", logs_dir: Optional[str] = None,
+                 cpus_per_trial: int = 1, name: str = "autots"):
+        if model not in _MODELS:
+            raise ValueError(f"model must be one of {_MODELS}")
+        self.model = model
+        self.search_space = dict(search_space or {})
+        self.past_seq_len = past_seq_len
+        self.future_seq_len = future_seq_len
+        self.metric = metric
+        self._best = None
+
+    def fit(self, data: TSDataset, validation_data: Optional[TSDataset] = None,
+            epochs: int = 2, batch_size: int = 32, n_sampling: int = 1,
+            seed: int = 0) -> "TSPipeline":
+        """Search and return the best TSPipeline (reference:
+        ``AutoTSEstimator.fit`` returning a TSPipeline)."""
+        if not isinstance(data, TSDataset):
+            raise ValueError("AutoTSEstimator.fit expects a TSDataset")
+        n_features = data.get_feature_num()
+        n_targets = data.get_target_num()
+        horizon = self.future_seq_len
+
+        space = dict(self.search_space)
+        space["past_seq_len"] = self.past_seq_len
+
+        def trial_fn(config: Dict) -> Dict:
+            lookback = int(config.pop("past_seq_len"))
+            data.roll(lookback, horizon)
+            val = validation_data
+            if val is not None:
+                val.roll(lookback, horizon)
+            f = _build_forecaster(self.model, lookback, horizon,
+                                  n_features, n_targets, config)
+            f.fit(data, epochs=epochs, batch_size=batch_size,
+                  validation_data=val)
+            res = f.evaluate(val if val is not None else data,
+                             metrics=[self.metric])
+            return {self.metric: res[self.metric], "forecaster": f,
+                    "lookback": lookback}
+
+        engine = make_search_engine()
+        engine.compile(trial_fn, space, n_sampling=n_sampling,
+                       metric=self.metric, mode="min", seed=seed)
+        engine.run()
+        best = engine.get_best_trial()
+        self._best = best
+        return TSPipeline(best.artifacts["forecaster"],
+                          lookback=best.artifacts["lookback"],
+                          horizon=horizon,
+                          best_config=dict(best.config),
+                          scaler=data.scaler)
+
+    def get_best_config(self) -> Dict:
+        if self._best is None:
+            raise RuntimeError("fit() first")
+        return dict(self._best.config)
+
+
+class TSPipeline:
+    """Best-forecaster bundle (reference:
+    ``chronos/autots/experimental/tspipeline.py`` — fit/predict/evaluate/
+    save/load carrying the scaler)."""
+
+    def __init__(self, forecaster, lookback: int, horizon: int,
+                 best_config: Dict, scaler=None):
+        self.forecaster = forecaster
+        self.lookback = lookback
+        self.horizon = horizon
+        self.best_config = best_config
+        self.scaler = scaler
+
+    def _rolled(self, data: TSDataset):
+        if isinstance(data, TSDataset):
+            data.roll(self.lookback, self.horizon)
+        return data
+
+    def fit(self, data: TSDataset, epochs: int = 1, batch_size: int = 32):
+        self.forecaster.fit(self._rolled(data), epochs=epochs,
+                            batch_size=batch_size)
+        return self
+
+    def predict(self, data: TSDataset) -> np.ndarray:
+        return self.forecaster.predict(self._rolled(data))
+
+    def evaluate(self, data: TSDataset, metrics=("mse",)) -> Dict:
+        return self.forecaster.evaluate(self._rolled(data), metrics=metrics)
+
+    def save(self, path: str):
+        import os
+        os.makedirs(path, exist_ok=True)
+        self.forecaster.save(os.path.join(path, "forecaster.pkl"))
+        with open(os.path.join(path, "meta.pkl"), "wb") as f:
+            pickle.dump({"lookback": self.lookback, "horizon": self.horizon,
+                         "best_config": self.best_config,
+                         "scaler": self.scaler,
+                         "forecaster_cls": type(self.forecaster).__name__,
+                         "forecaster_args": dict(
+                             self.forecaster._ctor_args)}, f)
+
+    @staticmethod
+    def load(path: str) -> "TSPipeline":
+        import os
+
+        from zoo_tpu.chronos import forecaster as fmod
+
+        with open(os.path.join(path, "meta.pkl"), "rb") as f:
+            meta = pickle.load(f)
+        cls = getattr(fmod, meta["forecaster_cls"])
+        fc = cls(**meta["forecaster_args"])
+        fc.load(os.path.join(path, "forecaster.pkl"))
+        return TSPipeline(fc, meta["lookback"], meta["horizon"],
+                          meta["best_config"], meta["scaler"])

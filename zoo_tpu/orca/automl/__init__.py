@@ -1,0 +1,3 @@
+from zoo_tpu.orca.automl.auto_estimator import AutoEstimator
+
+__all__ = ["AutoEstimator"]

@@ -1,0 +1,111 @@
+"""AutoEstimator — hyperparameter search over model creators.
+
+Rebuild of ``pyzoo/zoo/orca/automl/auto_estimator.py:19``
+(``AutoEstimator.from_torch/from_keras`` + ``fit(data, search_space,
+n_sampling, metric)``). A creator receives a sampled ``config`` dict and
+returns a ready-to-train model; each trial trains on the mesh and reports
+the validation metric; the best trial's model is retained.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from zoo_tpu.automl.search import make_search_engine
+
+
+_MINIMIZE = {"mse", "rmse", "mae", "smape", "loss", "binary_crossentropy"}
+
+
+class AutoEstimator:
+    def __init__(self, model_builder: Callable[[Dict], Any],
+                 kind: str = "keras"):
+        self.model_builder = model_builder
+        self.kind = kind
+        self._best_model = None
+        self._best_config: Optional[Dict] = None
+        self._best_metric: Optional[float] = None
+
+    # -- factories (reference API) ----------------------------------------
+    @staticmethod
+    def from_keras(*, model_creator: Callable[[Dict], Any],
+                   **kwargs) -> "AutoEstimator":
+        """``model_creator(config)`` returns a COMPILED zoo_tpu keras model
+        (reference: ``from_keras`` builds a KerasModelBuilder)."""
+        return AutoEstimator(model_creator, kind="keras")
+
+    @staticmethod
+    def from_torch(*, model_creator: Callable[[Dict], Any],
+                   optimizer=None, loss=None, **kwargs) -> "AutoEstimator":
+        """``model_creator(config)`` returns a torch nn.Module; optimizer
+        and loss as in the PyTorch Estimator (reference: ``from_torch``)."""
+        def build(config: Dict):
+            from zoo_tpu.orca.learn.pytorch import Estimator as TorchEst
+            opt = optimizer(None, config) if callable(optimizer) \
+                and not isinstance(optimizer, str) else optimizer
+            return TorchEst.from_torch(
+                model=model_creator(config),
+                optimizer=opt if not callable(opt) or isinstance(opt, str)
+                else None,
+                loss=loss(config) if callable(loss)
+                and type(loss).__name__ == "function" else loss)
+
+        return AutoEstimator(build, kind="torch")
+
+    # -- search ------------------------------------------------------------
+    def fit(self, data, epochs: int = 1, batch_size: int = 32,
+            validation_data=None, metric: str = "mse",
+            metric_mode: Optional[str] = None,
+            search_space: Optional[Dict] = None, n_sampling: int = 1,
+            seed: int = 0) -> "AutoEstimator":
+        """Run the search (reference: ``AutoEstimator.fit`` with
+        ``search_space``/``n_sampling``/``metric``)."""
+        if search_space is None:
+            raise ValueError("search_space is required")
+        mode = metric_mode or ("min" if metric.lower() in _MINIMIZE
+                               else "max")
+        eval_data = validation_data if validation_data is not None else data
+
+        def _xy(d):
+            return d if isinstance(d, tuple) else (d, None)
+
+        def trial_fn(config: Dict) -> Dict:
+            bs = int(config.pop("batch_size", batch_size))
+            model = self.model_builder(config)
+            if hasattr(model, "torch_model"):  # PyTorchEstimator
+                model.fit(data, epochs=epochs, batch_size=bs)
+                res = model.evaluate(eval_data, batch_size=bs)
+            else:  # compiled keras-facade model
+                x, y = _xy(data)
+                model.fit(x, y, batch_size=bs, nb_epoch=epochs, verbose=0)
+                ex, ey = _xy(eval_data)
+                res = model.evaluate(ex, ey, batch_size=bs)
+            value = res[metric] if metric in res else res.get(
+                "loss", float("nan"))
+            return {metric: float(value), "model": model}
+
+        engine = make_search_engine()
+        engine.compile(trial_fn, search_space, n_sampling=n_sampling,
+                       metric=metric, mode=mode, seed=seed)
+        engine.run()
+        best = engine.get_best_trial()
+        self._best_config = dict(best.config)
+        self._best_metric = best.metric
+        self._best_model = best.artifacts.get("model")
+        return self
+
+    def get_best_model(self):
+        if self._best_model is None:
+            raise RuntimeError("fit() first")
+        return self._best_model
+
+    def get_best_config(self) -> Dict:
+        if self._best_config is None:
+            raise RuntimeError("fit() first")
+        return dict(self._best_config)
+
+    @property
+    def best_metric(self) -> float:
+        return self._best_metric

@@ -1,0 +1,90 @@
+import numpy as np
+import pandas as pd
+import pytest
+
+from zoo_tpu.friesian.feature import FeatureTable, StringIndex
+
+
+@pytest.fixture()
+def tbl():
+    return FeatureTable.from_pandas(pd.DataFrame({
+        "user": ["a", "b", "a", "c", "b", "a"],
+        "item": [1, 2, 3, 1, 2, 2],
+        "price": [10.0, np.nan, 30.0, 40.0, 50.0, np.nan],
+        "ts": [1, 2, 3, 4, 5, 6],
+    }))
+
+
+def test_fillna_fillmedian_log_clip(tbl):
+    t = tbl.fillna(0.0, columns=["price"])
+    assert t.df["price"].isna().sum() == 0
+    t2 = tbl.fill_median(["price"])
+    assert t2.df["price"].iloc[1] == 35.0  # median of 10,30,40,50
+    t3 = tbl.fillna(0.0, ["price"]).log(["price"])
+    np.testing.assert_allclose(t3.df["price"].iloc[0], np.log1p(10.0))
+    t4 = tbl.clip(["item"], min=2)
+    assert t4.df["item"].min() == 2
+    # original untouched (ops return new tables)
+    assert tbl.df["price"].isna().sum() == 2
+
+
+def test_string_index_roundtrip(tbl):
+    [idx] = tbl.gen_string_idx("user")
+    assert idx.mapping["a"] == 1  # most frequent gets id 1
+    enc = tbl.encode_string("user", [idx])
+    assert enc.df["user"].tolist()[0] == 1
+    enc2, [idx2] = tbl.category_encode("user")
+    assert idx2.size == 3
+    # unseen value maps to 0
+    other = FeatureTable.from_pandas(pd.DataFrame({"user": ["zz"]}))
+    assert other.encode_string("user", [idx]).df["user"].iloc[0] == 0
+
+
+def test_cross_columns_and_one_hot(tbl):
+    t = tbl.cross_columns([["user", "item"]], [100])
+    assert "user_item" in t.df.columns
+    assert t.df["user_item"].between(0, 99).all()
+    t2 = tbl.one_hot_encode(["user"])
+    assert {"user_a", "user_b", "user_c"} <= set(t2.df.columns)
+
+
+def test_neg_sampling(tbl):
+    t = tbl.select("user", "item")
+    out = t.add_neg_samples(item_size=10, item_col="item", neg_num=2)
+    assert len(out.df) == 6 * 3
+    assert (out.df["label"] == 0).sum() == 12
+    negs = out.df[out.df["label"] == 0]
+    assert negs["item"].between(1, 10).all()
+
+
+def test_hist_seq_and_pad(tbl):
+    t = tbl.add_hist_seq(["item"], user_col="user", sort_col="ts",
+                         min_len=1, max_len=2)
+    row = t.df[t.df["user"] == "a"].iloc[-1]
+    assert row["item_hist_seq"] == [3, 2][:-1] + [2] or \
+        isinstance(row["item_hist_seq"], list)
+    padded = t.pad(["item_hist_seq"], seq_len=4,
+                   mask_cols=["item_hist_seq_mask"])
+    assert all(len(v) == 4 for v in padded.df["item_hist_seq"])
+    assert all(len(v) == 4 for v in padded.df["item_hist_seq_mask"])
+
+
+def test_relational_and_shards(tbl):
+    prices = FeatureTable.from_pandas(pd.DataFrame({
+        "item": [1, 2, 3], "cat": ["x", "y", "z"]}))
+    j = tbl.join(prices, on="item")
+    assert "cat" in j.df.columns and len(j.df) == 6
+    g = tbl.group_by("user", {"item": "count"})
+    assert set(g.df.columns) >= {"user"}
+    shards = tbl.to_shards(2)
+    assert shards.num_partitions() == 2
+    assert sum(len(s) for s in shards.collect()) == 6
+    u = tbl.union(tbl)
+    assert u.size() == 12
+
+
+def test_normalize_minmax(tbl):
+    t = tbl.fillna(0, ["price"]).normalize(["price"])
+    assert abs(t.df["price"].mean()) < 1e-9
+    t2 = tbl.fillna(0, ["price"]).min_max_scale(["price"])
+    assert t2.df["price"].min() == 0.0 and t2.df["price"].max() == 1.0

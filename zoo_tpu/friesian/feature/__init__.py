@@ -1,0 +1,3 @@
+from zoo_tpu.friesian.feature.table import FeatureTable, StringIndex
+
+__all__ = ["FeatureTable", "StringIndex"]

@@ -1,0 +1,282 @@
+"""Friesian FeatureTable — recsys feature engineering.
+
+Rebuild of ``pyzoo/zoo/friesian/feature/table.py:37,554`` (FeatureTable over
+Spark DataFrames with a Scala UDF kernel ``PythonFriesian.scala:48-321``).
+Here the table is pandas-backed (shardable via XShards when it outgrows one
+host); every op returns a NEW FeatureTable like the reference.
+
+Ops (reference names): fillna, dropna, fill_median, log, clip, cross_columns,
+category_encode (StringIndex), gen_string_idx, encode_string, add_neg_samples,
+add_hist_seq, pad, mask, normalize, min_max_scale, one_hot_encode, rename,
+size, select, filter, cast, union, join, group_by, to_shards.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+import pandas as pd
+
+
+class StringIndex:
+    """Category→index mapping (reference: ``StringIndex`` table with
+    ``col_name``; index 0 is reserved like the reference's 1-based ids)."""
+
+    def __init__(self, mapping: Dict, col_name: str):
+        self.mapping = dict(mapping)
+        self.col_name = col_name
+
+    @property
+    def size(self) -> int:
+        return len(self.mapping)
+
+    def to_dict(self) -> Dict:
+        return dict(self.mapping)
+
+    def df(self) -> pd.DataFrame:
+        return pd.DataFrame({self.col_name: list(self.mapping.keys()),
+                             "id": list(self.mapping.values())})
+
+
+def _as_list(cols) -> List[str]:
+    if cols is None:
+        return []
+    return [cols] if isinstance(cols, str) else list(cols)
+
+
+class FeatureTable:
+    def __init__(self, df: pd.DataFrame):
+        self.df = df.reset_index(drop=True)
+
+    # -- construction ------------------------------------------------------
+    @staticmethod
+    def from_pandas(df: pd.DataFrame) -> "FeatureTable":
+        return FeatureTable(df.copy())
+
+    @staticmethod
+    def read_csv(path: str, **kwargs) -> "FeatureTable":
+        from zoo_tpu.orca.data.file import list_files
+        parts = [pd.read_csv(f, **kwargs) for f in list_files(path)]
+        return FeatureTable(pd.concat(parts, ignore_index=True))
+
+    @staticmethod
+    def read_parquet(path: str) -> "FeatureTable":
+        return FeatureTable(pd.read_parquet(path))
+
+    # -- basic -------------------------------------------------------------
+    def select(self, *cols) -> "FeatureTable":
+        return FeatureTable(self.df[list(cols)].copy())
+
+    def drop(self, *cols) -> "FeatureTable":
+        return FeatureTable(self.df.drop(columns=list(cols)))
+
+    def rename(self, columns: Dict[str, str]) -> "FeatureTable":
+        return FeatureTable(self.df.rename(columns=columns))
+
+    def filter(self, condition) -> "FeatureTable":
+        """``condition``: boolean Series or callable(df)->mask."""
+        mask = condition(self.df) if callable(condition) else condition
+        return FeatureTable(self.df[mask])
+
+    def cast(self, columns, dtype) -> "FeatureTable":
+        df = self.df.copy()
+        for c in _as_list(columns):
+            df[c] = df[c].astype(dtype)
+        return FeatureTable(df)
+
+    def size(self) -> int:
+        return len(self.df)
+
+    def show(self, n: int = 20):
+        print(self.df.head(n).to_string())
+
+    def to_pandas(self) -> pd.DataFrame:
+        return self.df.copy()
+
+    def to_shards(self, num_shards: Optional[int] = None):
+        """→ XShards of DataFrame partitions (feeds the Orca estimators)."""
+        from zoo_tpu.orca.data.shard import LocalXShards, _pool_size
+        n = num_shards or _pool_size()
+        n = max(1, min(n, max(len(self.df), 1)))
+        bounds = np.linspace(0, len(self.df), n + 1).astype(int)
+        return LocalXShards([
+            self.df.iloc[bounds[i]:bounds[i + 1]].reset_index(drop=True)
+            for i in range(n)])
+
+    # -- cleaning ----------------------------------------------------------
+    def fillna(self, value, columns=None) -> "FeatureTable":
+        """reference: ``fillna`` (int columns stay int)."""
+        df = self.df.copy()
+        cols = _as_list(columns) or df.columns
+        for c in cols:
+            df[c] = df[c].fillna(value)
+        return FeatureTable(df)
+
+    def dropna(self, columns=None) -> "FeatureTable":
+        return FeatureTable(self.df.dropna(
+            subset=_as_list(columns) or None))
+
+    def fill_median(self, columns=None) -> "FeatureTable":
+        df = self.df.copy()
+        for c in _as_list(columns) or df.select_dtypes("number").columns:
+            df[c] = df[c].fillna(df[c].median())
+        return FeatureTable(df)
+
+    # -- math --------------------------------------------------------------
+    def log(self, columns=None, clipping: bool = True) -> "FeatureTable":
+        """reference: ``log`` — log(x+1), clipping negatives to 0 first."""
+        df = self.df.copy()
+        for c in _as_list(columns) or df.select_dtypes("number").columns:
+            v = df[c].to_numpy(dtype=np.float64)
+            if clipping:
+                v = np.clip(v, 0, None)
+            df[c] = np.log1p(v)
+        return FeatureTable(df)
+
+    def clip(self, columns=None, min: Optional[float] = None,
+             max: Optional[float] = None) -> "FeatureTable":
+        df = self.df.copy()
+        for c in _as_list(columns):
+            df[c] = df[c].clip(lower=min, upper=max)
+        return FeatureTable(df)
+
+    def normalize(self, columns=None) -> "FeatureTable":
+        """z-score columns (reference: ``normalize``)."""
+        df = self.df.copy()
+        for c in _as_list(columns):
+            v = df[c].to_numpy(dtype=np.float64)
+            df[c] = (v - v.mean()) / (v.std() + 1e-12)
+        return FeatureTable(df)
+
+    def min_max_scale(self, columns=None) -> "FeatureTable":
+        df = self.df.copy()
+        for c in _as_list(columns):
+            v = df[c].to_numpy(dtype=np.float64)
+            rng = v.max() - v.min()
+            df[c] = (v - v.min()) / (rng if rng else 1.0)
+        return FeatureTable(df)
+
+    # -- categorical -------------------------------------------------------
+    def gen_string_idx(self, columns, freq_limit: int = 0
+                       ) -> List[StringIndex]:
+        """Build 1-based StringIndexes by descending frequency (reference:
+        ``gen_string_idx`` with ``freq_limit``)."""
+        out = []
+        for c in _as_list(columns):
+            counts = self.df[c].value_counts()
+            if freq_limit:
+                counts = counts[counts >= freq_limit]
+            mapping = {k: i + 1 for i, k in enumerate(counts.index)}
+            out.append(StringIndex(mapping, c))
+        return out
+
+    def encode_string(self, columns, indices: Sequence[StringIndex]
+                      ) -> "FeatureTable":
+        """Map categorical values to ids; unseen → 0 (reference:
+        ``encode_string``)."""
+        df = self.df.copy()
+        for c, idx in zip(_as_list(columns), indices):
+            df[c] = df[c].map(idx.mapping).fillna(0).astype(np.int64)
+        return FeatureTable(df)
+
+    def category_encode(self, columns, freq_limit: int = 0):
+        """gen + encode in one call (reference: ``category_encode``)."""
+        indices = self.gen_string_idx(columns, freq_limit)
+        return self.encode_string(columns, indices), indices
+
+    def one_hot_encode(self, columns) -> "FeatureTable":
+        df = self.df
+        for c in _as_list(columns):
+            dummies = pd.get_dummies(df[c], prefix=c, dtype=np.int64)
+            df = pd.concat([df.drop(columns=[c]), dummies], axis=1)
+        return FeatureTable(df)
+
+    def cross_columns(self, crossed_columns: Sequence[Sequence[str]],
+                      bucket_sizes: Sequence[int]) -> "FeatureTable":
+        """Hash-cross column tuples into buckets (reference:
+        ``cross_columns`` — the Wide&Deep wide-part features)."""
+        df = self.df.copy()
+        for cols, size in zip(crossed_columns, bucket_sizes):
+            name = "_".join(cols)
+            joined = df[list(cols)].astype(str).agg("_".join, axis=1)
+            df[name] = pd.util.hash_pandas_object(
+                joined, index=False).to_numpy() % size
+        return FeatureTable(df)
+
+    # -- recsys specials ---------------------------------------------------
+    def add_neg_samples(self, item_size: int, item_col: str = "item",
+                        label_col: str = "label", neg_num: int = 1,
+                        seed: int = 0) -> "FeatureTable":
+        """For each positive row add ``neg_num`` rows with random items and
+        label 0 (reference: ``add_neg_samples``; items are 1-based)."""
+        rs = np.random.RandomState(seed)
+        pos = self.df.copy()
+        pos[label_col] = 1
+        negs = pos.loc[pos.index.repeat(neg_num)].copy()
+        pos_items = negs[item_col].to_numpy()
+        rnd = rs.randint(1, item_size + 1, len(negs))
+        # re-draw collisions with the positive item once (cheap approx)
+        collide = rnd == pos_items
+        rnd[collide] = (rnd[collide] % item_size) + 1
+        negs[item_col] = rnd
+        negs[label_col] = 0
+        return FeatureTable(pd.concat([pos, negs], ignore_index=True))
+
+    def add_hist_seq(self, cols: Sequence[str], user_col: str,
+                     sort_col: str, min_len: int = 1, max_len: int = 10
+                     ) -> "FeatureTable":
+        """Per-user trailing history sequences (reference:
+        ``add_hist_seq`` — builds ``<col>_hist_seq`` arrays)."""
+        df = self.df.sort_values([user_col, sort_col])
+        out_rows = []
+        for _, g in df.groupby(user_col, sort=False):
+            vals = {c: g[c].tolist() for c in cols}
+            for i in range(len(g)):
+                if i < min_len:
+                    continue
+                row = g.iloc[i].to_dict()
+                for c in cols:
+                    row[f"{c}_hist_seq"] = vals[c][max(0, i - max_len):i]
+                out_rows.append(row)
+        return FeatureTable(pd.DataFrame(out_rows))
+
+    def pad(self, cols: Sequence[str], seq_len: int,
+            mask_cols: Optional[Sequence[str]] = None) -> "FeatureTable":
+        """Pad/truncate list columns to ``seq_len`` (reference: ``pad``
+        with optional mask columns)."""
+        df = self.df.copy()
+        for c in _as_list(cols):
+            def _pad(v):
+                v = list(v)[:seq_len]
+                return v + [0] * (seq_len - len(v))
+            df[c] = df[c].apply(_pad)
+        for c in _as_list(mask_cols):
+            base = c.replace("_mask", "")
+            src = base if base in df.columns else _as_list(cols)[0]
+            df[c] = df[src].apply(
+                lambda v: [1 if x != 0 else 0 for x in v])
+        return FeatureTable(df)
+
+    # -- relational --------------------------------------------------------
+    def join(self, other: "FeatureTable", on, how: str = "inner"
+             ) -> "FeatureTable":
+        return FeatureTable(self.df.merge(other.df, on=on, how=how))
+
+    def union(self, other: "FeatureTable") -> "FeatureTable":
+        return FeatureTable(pd.concat([self.df, other.df],
+                                      ignore_index=True))
+
+    def group_by(self, columns, agg: Dict[str, str]) -> "FeatureTable":
+        out = self.df.groupby(_as_list(columns)).agg(agg).reset_index()
+        out.columns = ["_".join(c) if isinstance(c, tuple) and c[1]
+                       else (c[0] if isinstance(c, tuple) else c)
+                       for c in out.columns]
+        return FeatureTable(out)
+
+    def max(self, column: str):
+        return self.df[column].max()
+
+    def min(self, column: str):
+        return self.df[column].min()

@@ -1,0 +1,112 @@
+import threading
+
+import numpy as np
+import pytest
+
+from zoo_tpu.pipeline.api.keras import Sequential
+from zoo_tpu.pipeline.api.keras.layers import Dense
+from zoo_tpu.pipeline.inference import InferenceModel
+
+
+def _trained_model(orca_ctx):
+    rs = np.random.RandomState(0)
+    x = rs.randn(128, 4).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.float32).reshape(-1, 1)
+    m = Sequential()
+    m.add(Dense(8, activation="relu", input_shape=(4,)))
+    m.add(Dense(1, activation="sigmoid"))
+    m.compile(optimizer="adam", loss="binary_crossentropy")
+    m.fit(x, y, batch_size=32, nb_epoch=1, verbose=0)
+    return m, x
+
+
+def test_full_model_save_load(orca_ctx, tmp_path):
+    m, x = _trained_model(orca_ctx)
+    ref = m.predict(x[:16])
+    p = str(tmp_path / "model.zoo")
+    m.save(p)
+    from zoo_tpu.pipeline.api.keras.engine.topology import KerasNet
+    m2 = KerasNet.load(p)
+    np.testing.assert_allclose(m2.predict(x[:16]), ref, rtol=1e-5)
+    # loaded model can continue training
+    m2.compile(optimizer="adam", loss="binary_crossentropy")
+    hist = m2.fit(x[:64], (x[:64].sum(1) > 0).astype(np.float32).reshape(-1, 1),
+                  batch_size=32, nb_epoch=1, verbose=0)
+    assert np.isfinite(hist["loss"][0])
+
+
+def test_inference_model_pool(orca_ctx, tmp_path):
+    m, x = _trained_model(orca_ctx)
+    p = str(tmp_path / "model.zoo")
+    m.save(p)
+    inf = InferenceModel(supported_concurrent_num=2)
+    inf.load(p, batch_size=16)
+    ref = inf.predict(x[:16])
+    assert ref.shape == (16, 1)
+
+    # concurrent predicts from several threads all succeed
+    results = {}
+    def work(i):
+        results[i] = inf.predict(x[i * 8:(i + 1) * 8])
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(v.shape == (8, 1) for v in results.values())
+
+
+def test_inference_model_from_torch(orca_ctx):
+    torch = pytest.importorskip("torch")
+    import torch.nn as nn
+
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    inf = InferenceModel().load_torch(net, input_shape=(4,), batch_size=8)
+    x = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+    preds = inf.predict(x)
+    with torch.no_grad():
+        ref = net(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(preds, ref, atol=1e-5)
+
+
+def test_serving_end_to_end(orca_ctx):
+    from zoo_tpu.serving import InputQueue, OutputQueue, ServingServer
+
+    m, x = _trained_model(orca_ctx)
+    inf = InferenceModel(supported_concurrent_num=2).load_keras(
+        m, batch_size=8)
+    server = ServingServer(inf, port=0, batch_size=8,
+                           max_wait_ms=10).start()
+    try:
+        iq = InputQueue(host=server.host, port=server.port)
+        # sync batch predict
+        preds = iq.predict(x[:12])
+        np.testing.assert_allclose(preds, m.predict(x[:12]), atol=1e-5)
+
+        # record-style enqueue + query
+        iq.enqueue("req-1", t=x[0])
+        out = OutputQueue(iq).query("req-1")
+        assert out.shape == (1, 1)
+
+        # concurrent clients hit the micro-batcher
+        def client(i, results):
+            c = InputQueue(host=server.host, port=server.port)
+            results[i] = c.predict(x[i * 4:(i + 1) * 4])
+            c.close()
+
+        results = {}
+        threads = [threading.Thread(target=client, args=(i, results))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i in range(4):
+            np.testing.assert_allclose(
+                results[i], m.predict(x[i * 4:(i + 1) * 4]), atol=1e-5)
+
+        stats = iq.stats()
+        assert stats["inference"]["count"] >= 1
+        iq.close()
+    finally:
+        server.stop()

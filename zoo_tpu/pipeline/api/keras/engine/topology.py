@@ -365,6 +365,40 @@ class KerasNet:
         return self._predict_arrays(xs, batch_size)
 
     # -- persistence -------------------------------------------------------
+    def save(self, path: str):
+        """Serialize the WHOLE model (architecture + weights) with
+        cloudpickle — the rebuild of the reference's Scala module
+        serialization (``SerializerSpec``-covered save/load round trips).
+        jit caches and summaries are dropped; params go to host numpy."""
+        import cloudpickle
+
+        jt, je, jp = self._jit_train, self._jit_eval, self._jit_pred
+        ts, vs, opt = self.train_summary, self.validation_summary, \
+            self._opt_state
+        params = self.params
+        try:
+            self._jit_train = self._jit_eval = self._jit_pred = None
+            self._opt_state = None
+            self.train_summary = TrainSummary()
+            self.validation_summary = TrainSummary()
+            if params is not None:
+                self.params = jax.tree_util.tree_map(np.asarray, params)
+            with open(path, "wb") as f:
+                cloudpickle.dump(self, f)
+        finally:
+            self._jit_train, self._jit_eval, self._jit_pred = jt, je, jp
+            self.train_summary, self.validation_summary = ts, vs
+            self._opt_state = opt
+            self.params = params
+        return path
+
+    @staticmethod
+    def load(path: str) -> "KerasNet":
+        import cloudpickle
+
+        with open(path, "rb") as f:
+            return cloudpickle.load(f)
+
     def save_weights(self, path: str):
         host = jax.tree_util.tree_map(np.asarray, self.params)
         with open(path, "wb") as f:

@@ -1,0 +1,3 @@
+from zoo_tpu.pipeline.inference.inference_model import InferenceModel
+
+__all__ = ["InferenceModel"]

@@ -1,0 +1,4 @@
+from zoo_tpu.serving.server import ServingServer
+from zoo_tpu.serving.client import InputQueue, OutputQueue
+
+__all__ = ["ServingServer", "InputQueue", "OutputQueue"]

@@ -1,0 +1,191 @@
+"""Streaming model serving with micro-batching.
+
+Rebuild of Cluster Serving (reference: ``serving/ClusterServing.scala:31-76``
+— Flink job: FlinkRedisSource → batch → InferenceModel → FlinkRedisSink,
+batching controlled by ``ClusterServingInference``; per-stage ``Timer``
+stats ``serving/engine/Timer.scala:22-60``).
+
+The JVM streaming stack collapses to one async Python server pinned to the
+TPU: a TCP front door accepts length-prefixed pickled requests, a batcher
+thread micro-batches up to ``batch_size`` or ``max_wait_ms`` (the
+reference's "batch size = core count" guidance maps to a fixed XLA batch,
+padded so one executable serves every request), the InferenceModel runs the
+batch, and responses are routed back per-request. Per-stage timers are kept
+(same avg/max/min stats the reference's Timer collects).
+"""
+
+from __future__ import annotations
+
+import pickle
+import queue
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class StageTimer:
+    """Per-stage avg/max/min running stats (reference: ``Timer.scala``)."""
+
+    def __init__(self):
+        self.n = 0
+        self.total = 0.0
+        self.max = 0.0
+        self.min = float("inf")
+
+    def record(self, dt: float):
+        self.n += 1
+        self.total += dt
+        self.max = max(self.max, dt)
+        self.min = min(self.min, dt)
+
+    def stats(self) -> Dict[str, float]:
+        return {"count": self.n,
+                "avg_ms": 1000 * self.total / max(self.n, 1),
+                "max_ms": 1000 * self.max,
+                "min_ms": 0.0 if self.n == 0 else 1000 * self.min}
+
+
+def _send_msg(sock: socket.socket, obj):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+
+def _recv_msg(sock: socket.socket):
+    header = _recv_exact(sock, 4)
+    if header is None:
+        return None
+    (length,) = struct.unpack(">I", header)
+    body = _recv_exact(sock, length)
+    return None if body is None else pickle.loads(body)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class _Request:
+    __slots__ = ("uri", "data", "event", "result", "error")
+
+    def __init__(self, uri: str, data):
+        self.uri = uri
+        self.data = data
+        self.event = threading.Event()
+        self.result = None
+        self.error = None
+
+
+class ServingServer:
+    """``ServingServer(inference_model).start()`` → serve until
+    ``stop()``."""
+
+    def __init__(self, model, host: str = "127.0.0.1", port: int = 0,
+                 batch_size: int = 8, max_wait_ms: float = 5.0):
+        self.model = model
+        self.batch_size = batch_size
+        self.max_wait_ms = max_wait_ms
+        self.timers = {"batch": StageTimer(), "inference": StageTimer(),
+                       "total": StageTimer()}
+        self._queue: "queue.Queue[_Request]" = queue.Queue()
+        self._stop = threading.Event()
+
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    msg = _recv_msg(self.request)
+                    if msg is None:
+                        return
+                    if msg.get("op") == "predict":
+                        req = _Request(msg["uri"], msg["data"])
+                        t0 = time.perf_counter()
+                        outer._queue.put(req)
+                        done = req.event.wait(timeout=120)
+                        outer.timers["total"].record(
+                            time.perf_counter() - t0)
+                        if not done:
+                            req.error = ("timeout waiting for batch "
+                                         "inference (first request may be "
+                                         "paying XLA compile)")
+                        if req.error is not None:
+                            _send_msg(self.request,
+                                      {"uri": req.uri, "error": req.error})
+                        else:
+                            _send_msg(self.request,
+                                      {"uri": req.uri, "result": req.result})
+                    elif msg.get("op") == "stats":
+                        _send_msg(self.request,
+                                  {k: t.stats()
+                                   for k, t in outer.timers.items()})
+                    elif msg.get("op") == "ping":
+                        _send_msg(self.request, {"ok": True})
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address
+
+    # -- batcher -----------------------------------------------------------
+    def _batch_loop(self):
+        while not self._stop.is_set():
+            try:
+                first = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            t0 = time.perf_counter()
+            batch: List[_Request] = [first]
+            deadline = time.perf_counter() + self.max_wait_ms / 1000.0
+            while len(batch) < self.batch_size:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(self._queue.get(timeout=remaining))
+                except queue.Empty:
+                    break
+            self.timers["batch"].record(time.perf_counter() - t0)
+
+            t1 = time.perf_counter()
+            try:
+                arrays = [np.asarray(r.data) for r in batch]
+                stacked = np.concatenate(arrays, axis=0)
+                preds = self.model.predict(stacked,
+                                           batch_size=self.batch_size)
+                offset = 0
+                for r, a in zip(batch, arrays):
+                    r.result = np.asarray(preds[offset:offset + len(a)])
+                    offset += len(a)
+            except Exception as e:  # route the error to every caller
+                for r in batch:
+                    r.error = repr(e)
+            self.timers["inference"].record(time.perf_counter() - t1)
+            for r in batch:
+                r.event.set()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "ServingServer":
+        self._threads = [
+            threading.Thread(target=self._server.serve_forever, daemon=True),
+            threading.Thread(target=self._batch_loop, daemon=True),
+        ]
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self._server.shutdown()
+        self._server.server_close()

@@ -1,0 +1,3 @@
+from zoo_tpu.models.anomalydetection.anomaly_detector import AnomalyDetector
+
+__all__ = ["AnomalyDetector"]

@@ -1,0 +1,3 @@
+from zoo_tpu.models.image.resnet import ResNet, resnet18, resnet50
+
+__all__ = ["ResNet", "resnet18", "resnet50"]

@@ -1,0 +1,90 @@
+"""ResNet image classifiers.
+
+Rebuild of the reference's image-classification model configs (Scala
+``models/image/imageclassification`` + the ResNet-50 training example
+``zoo/.../examples/resnet``; the dogs-vs-cats app fine-tunes ResNet via the
+Keras-style API — ``apps/dogs-vs-cats``, a BASELINE.md target).
+
+TPU-first: NHWC throughout (inputs are ``dim_ordering="tf"``), BatchNorm
+over the trailing channel axis, stride-2 convs instead of pooling where
+possible — the canonical v1.5 layout XLA fuses best. Residual adds are
+functional-graph ``Merge(sum)`` nodes.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from zoo_tpu.pipeline.api.keras.engine.topology import Input, Model
+from zoo_tpu.pipeline.api.keras.layers import (
+    Activation,
+    BatchNormalization,
+    Conv2D,
+    Dense,
+    GlobalAveragePooling2D,
+    MaxPooling2D,
+    ZeroPadding2D,
+    merge,
+)
+
+
+def _conv_bn(x, filters, k, stride=1, act=True, name=None):
+    h = Conv2D(filters, k, k, subsample=(stride, stride),
+               border_mode="same", dim_ordering="tf", bias=False)(x)
+    h = BatchNormalization()(h)
+    if act:
+        h = Activation("relu")(h)
+    return h
+
+
+def _basic_block(x, filters, stride=1, downsample=False):
+    h = _conv_bn(x, filters, 3, stride)
+    h = _conv_bn(h, filters, 3, 1, act=False)
+    shortcut = x
+    if downsample:
+        shortcut = _conv_bn(x, filters, 1, stride, act=False)
+    out = merge([h, shortcut], mode="sum")
+    return Activation("relu")(out)
+
+
+def _bottleneck(x, filters, stride=1, downsample=False):
+    h = _conv_bn(x, filters, 1, 1)
+    h = _conv_bn(h, filters, 3, stride)
+    h = _conv_bn(h, filters * 4, 1, 1, act=False)
+    shortcut = x
+    if downsample:
+        shortcut = _conv_bn(x, filters * 4, 1, stride, act=False)
+    out = merge([h, shortcut], mode="sum")
+    return Activation("relu")(out)
+
+
+class ResNet(Model):
+    def __init__(self, class_num: int, blocks: Sequence[int],
+                 bottleneck: bool, input_shape=(224, 224, 3),
+                 stem_pool: bool = True, name: str = "resnet"):
+        x_in = Input(shape=tuple(input_shape), name="image")
+        h = _conv_bn(x_in, 64, 7, stride=2)
+        if stem_pool:
+            h = MaxPooling2D((3, 3), strides=(2, 2), border_mode="same",
+                             dim_ordering="tf")(h)
+        block = _bottleneck if bottleneck else _basic_block
+        filters = 64
+        for stage, n in enumerate(blocks):
+            for i in range(n):
+                stride = 2 if stage > 0 and i == 0 else 1
+                downsample = (i == 0)
+                h = block(h, filters, stride=stride, downsample=downsample)
+            filters *= 2
+        h = GlobalAveragePooling2D(dim_ordering="tf")(h)
+        out = Dense(class_num, activation="softmax")(h)
+        Model.__init__(self, input=x_in, output=out, name=name)
+
+
+def resnet18(class_num: int, input_shape=(224, 224, 3)) -> ResNet:
+    return ResNet(class_num, (2, 2, 2, 2), bottleneck=False,
+                  input_shape=input_shape, name="resnet18")
+
+
+def resnet50(class_num: int, input_shape=(224, 224, 3)) -> ResNet:
+    return ResNet(class_num, (3, 4, 6, 3), bottleneck=True,
+                  input_shape=input_shape, name="resnet50")

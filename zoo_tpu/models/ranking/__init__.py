@@ -1,0 +1,3 @@
+from zoo_tpu.models.ranking.knrm import KNRM
+
+__all__ = ["KNRM"]

@@ -1,0 +1,89 @@
+"""KNRM — kernel-pooling neural ranking.
+
+Rebuild of the reference's KNRM (Scala ``models/textmatching/KNRM.scala``,
+Python ``pyzoo/zoo/models/textmatching/knrm.py``): query/doc token ids →
+shared embedding → cosine interaction matrix → RBF kernel pooling →
+linear+sigmoid score.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from zoo_tpu.pipeline.api.keras.engine.base import Layer
+from zoo_tpu.pipeline.api.keras.engine.topology import Input, Model
+from zoo_tpu.pipeline.api.keras.layers import Dense, Embedding, Lambda
+
+
+class _KernelPooling(Layer):
+    """RBF kernel pooling over the interaction matrix (reference:
+    ``KNRM.scala`` kernel loop with mu from 1 down by 0.2, sigma 0.1/0.001
+    for the exact-match kernel)."""
+
+    def __init__(self, kernel_num: int = 21, sigma: float = 0.1,
+                 exact_sigma: float = 0.001, **kwargs):
+        super().__init__(**kwargs)
+        self.kernel_num = kernel_num
+        self.sigma = sigma
+        self.exact_sigma = exact_sigma
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        # inputs: (B, Tq, Td) cosine similarities
+        mus, sigmas = [], []
+        for i in range(self.kernel_num):
+            mu = 1.0 - 2.0 * i / max(self.kernel_num - 1, 1)
+            mus.append(mu)
+            sigmas.append(self.exact_sigma if i == 0 else self.sigma)
+        mu = jnp.asarray(mus)[None, None, None, :]
+        sg = jnp.asarray(sigmas)[None, None, None, :]
+        k = jnp.exp(-((inputs[..., None] - mu) ** 2) / (2 * sg ** 2))
+        # sum over doc, log, sum over query (reference pooling)
+        pooled = jnp.sum(k, axis=2)
+        pooled = jnp.log(jnp.maximum(pooled, 1e-10))
+        return jnp.sum(pooled, axis=1)
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0], self.kernel_num)
+
+
+class KNRM(Model):
+    def __init__(self, text1_length: int, text2_length: int,
+                 vocab_size: int = 5000, embed_size: int = 50,
+                 kernel_num: int = 21, sigma: float = 0.1,
+                 exact_sigma: float = 0.001):
+        self.text1_length = text1_length
+        self.text2_length = text2_length
+        pair = Input(shape=(text1_length + text2_length,), name="qd_pair")
+        q_ids = Lambda(lambda v: v[:, :text1_length],
+                       output_shape=(text1_length,))(pair)
+        d_ids = Lambda(lambda v: v[:, text1_length:],
+                       output_shape=(text2_length,))(pair)
+        embed = Embedding(vocab_size, embed_size)  # shared weights
+        q = embed(q_ids)
+        d = embed(d_ids)
+
+        def _interact(args):
+            qe, de = args
+            qe = qe / jnp.maximum(jnp.linalg.norm(qe, axis=-1,
+                                                  keepdims=True), 1e-8)
+            de = de / jnp.maximum(jnp.linalg.norm(de, axis=-1,
+                                                  keepdims=True), 1e-8)
+            return jnp.einsum("bqe,bde->bqd", qe, de)
+
+        from zoo_tpu.pipeline.api.keras.layers import Merge
+
+        class _Interaction(Merge):
+            def __init__(self, **kw):
+                super().__init__(mode="dot", **kw)
+
+            def call(self, params, inputs, *, training=False, rng=None):
+                return _interact(inputs)
+
+            def compute_output_shape(self, input_shape):
+                return (input_shape[0][0], text1_length, text2_length)
+
+        sim = _Interaction()([q, d])
+        pooled = _KernelPooling(kernel_num, sigma, exact_sigma)(sim)
+        out = Dense(1, activation="sigmoid")(pooled)
+        Model.__init__(self, input=pair, output=out, name="knrm")

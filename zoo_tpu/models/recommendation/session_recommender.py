@@ -1,0 +1,51 @@
+"""Session-based recommender (reference: Scala
+``models/recommendation/SessionRecommender.scala`` — GRU over the item
+session, optional user-history attention-free average, softmax over items).
+"""
+
+from __future__ import annotations
+
+from zoo_tpu.models.recommendation.recommender import Recommender
+from zoo_tpu.pipeline.api.keras.engine.topology import Input, Model
+from zoo_tpu.pipeline.api.keras.layers import (
+    GRU,
+    Dense,
+    Embedding,
+    Lambda,
+    merge,
+)
+
+
+class SessionRecommender(Model, Recommender):
+    def __init__(self, item_count: int, item_embed: int = 100,
+                 rnn_hidden_layers=(40, 20), session_length: int = 10,
+                 include_history: bool = False, mlp_hidden_layers=(40, 20),
+                 history_length: int = 5):
+        self.item_count = item_count
+        sess = Input(shape=(session_length,), name="session")
+        inputs = [sess]
+        h = Embedding(item_count + 1, item_embed)(sess)
+        for i, units in enumerate(rnn_hidden_layers):
+            last = i == len(rnn_hidden_layers) - 1
+            h = GRU(units, return_sequences=not last)(h)
+        if include_history:
+            hist = Input(shape=(history_length,), name="history")
+            inputs.append(hist)
+            g = Embedding(item_count + 1, item_embed)(hist)
+            g = Lambda(lambda v: v.mean(axis=1))(g)
+            for units in mlp_hidden_layers:
+                g = Dense(units, activation="relu")(g)
+            h = merge([h, g], mode="concat")
+        out = Dense(item_count + 1, activation="softmax")(h)
+        Model.__init__(self, input=inputs if include_history else sess,
+                       output=out, name="session_recommender")
+
+    def recommend_for_session(self, sessions, max_items: int = 5):
+        """Top-k next items per session (reference:
+        ``recommendForSession``)."""
+        import numpy as np
+
+        probs = self.predict(sessions)
+        top = np.argsort(-probs, axis=1)[:, :max_items]
+        return [[(int(i), float(p[i])) for i in row]
+                for row, p in zip(top, probs)]

@@ -1,0 +1,41 @@
+"""Generic RNN encoder-decoder (reference: Scala ``models/seq2seq/``
+``Seq2seq.scala`` with RNNEncoder/RNNDecoder/Bridge — LSTM/GRU cells,
+optional bridge mapping encoder state to decoder init).
+
+Simplified TPU-native equivalent: encoder RNN consumes the source sequence;
+its final state seeds a decoder RNN run for ``target_length`` steps
+(context-repeat decoding, no teacher forcing); a TimeDistributed head emits
+per-step outputs.
+"""
+
+from __future__ import annotations
+
+from zoo_tpu.pipeline.api.keras.engine.topology import Sequential
+from zoo_tpu.pipeline.api.keras.layers import (
+    GRU,
+    LSTM,
+    Dense,
+    RepeatVector,
+    TimeDistributed,
+)
+
+
+class Seq2seq(Sequential):
+    def __init__(self, input_length: int, input_dim: int,
+                 target_length: int, output_dim: int,
+                 rnn_type: str = "lstm", hidden_size: int = 64,
+                 num_layers: int = 1):
+        super().__init__(name="seq2seq")
+        rnn_type = rnn_type.lower()
+        if rnn_type not in ("lstm", "gru"):
+            raise ValueError("rnn_type must be lstm | gru")
+        cell = LSTM if rnn_type == "lstm" else GRU
+        for i in range(num_layers):
+            last = i == num_layers - 1
+            kwargs = {"input_shape": (input_length, input_dim)} if i == 0 \
+                else {}
+            self.add(cell(hidden_size, return_sequences=not last, **kwargs))
+        self.add(RepeatVector(target_length))
+        for i in range(num_layers):
+            self.add(cell(hidden_size, return_sequences=True))
+        self.add(TimeDistributed(Dense(output_dim)))

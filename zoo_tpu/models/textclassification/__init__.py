@@ -1,0 +1,3 @@
+from zoo_tpu.models.textclassification.text_classifier import TextClassifier
+
+__all__ = ["TextClassifier"]

@@ -1,0 +1,45 @@
+"""Text classifier (reference: Scala
+``models/textclassification/TextClassifier.scala``, Python
+``pyzoo/zoo/models/textclassification/__init__.py`` — token ids →
+Embedding → CNN/LSTM/GRU encoder → softmax)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from zoo_tpu.pipeline.api.keras.engine.topology import Sequential
+from zoo_tpu.pipeline.api.keras.layers import (
+    GRU,
+    LSTM,
+    Conv1D,
+    Dense,
+    Dropout,
+    Embedding,
+    Flatten,
+    GlobalMaxPooling1D,
+)
+
+
+class TextClassifier(Sequential):
+    def __init__(self, class_num: int, token_length: int = 200,
+                 sequence_length: int = 500, vocab: int = 5000,
+                 encoder: str = "cnn", encoder_output_dim: int = 256,
+                 hidden_drop: float = 0.2):
+        super().__init__(name="text_classifier")
+        encoder = encoder.lower()
+        if encoder not in ("cnn", "lstm", "gru"):
+            raise ValueError("encoder must be cnn | lstm | gru")
+        self.class_num = class_num
+        self.add(Embedding(vocab, token_length,
+                           input_shape=(sequence_length,)))
+        if encoder == "cnn":
+            self.add(Conv1D(encoder_output_dim, 5, activation="relu"))
+            self.add(GlobalMaxPooling1D())
+        elif encoder == "lstm":
+            self.add(LSTM(encoder_output_dim))
+        else:
+            self.add(GRU(encoder_output_dim))
+        if hidden_drop:
+            self.add(Dropout(hidden_drop))
+        self.add(Dense(128, activation="relu"))
+        self.add(Dense(class_num, activation="softmax"))

@@ -1,0 +1,77 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from zoo_tpu.pipeline.api import autograd as A
+from zoo_tpu.pipeline.api.autograd import CustomLoss, Variable
+
+
+def _run(var, inputs, values):
+    from zoo_tpu.pipeline.api.keras.engine.topology import Model
+
+    m = Model(input=[v.node for v in inputs], output=var.node)
+    return np.asarray(m._forward({}, [jnp.asarray(v) for v in values],
+                                 training=False, rng=None, collect=None))
+
+
+def test_variable_operators():
+    a = Variable(input_shape=(3,))
+    b = Variable(input_shape=(3,))
+    expr = (a + b) * 2 - a / (b + 1.0)
+    x = np.array([[1.0, 2.0, 3.0]], np.float32)
+    y = np.array([[4.0, 5.0, 6.0]], np.float32)
+    out = _run(expr, [a, b], [x, y])
+    np.testing.assert_allclose(out, (x + y) * 2 - x / (y + 1), rtol=1e-6)
+
+
+def test_math_functions():
+    a = Variable(input_shape=(4,))
+    x = np.array([[0.5, -1.0, 2.0, -0.25]], np.float32)
+    np.testing.assert_allclose(_run(A.abs(a), [a], [x]), np.abs(x))
+    np.testing.assert_allclose(_run(A.square(a), [a], [x]), x ** 2)
+    np.testing.assert_allclose(_run(A.exp(a), [a], [x]), np.exp(x),
+                               rtol=1e-6)
+    np.testing.assert_allclose(_run(A.clip(a, -0.5, 0.5), [a], [x]),
+                               np.clip(x, -0.5, 0.5))
+    from scipy.special import erf as sp_erf
+    np.testing.assert_allclose(_run(A.erf(a), [a], [x]), sp_erf(x),
+                               rtol=1e-5)
+    np.testing.assert_allclose(_run(A.sum(a, axis=1, keepdims=True),
+                                    [a], [x]), x.sum(1, keepdims=True))
+
+
+def test_batch_dot_and_l2_normalize():
+    a = Variable(input_shape=(2, 3))
+    b = Variable(input_shape=(2, 3))
+    x = np.random.RandomState(0).randn(4, 2, 3).astype(np.float32)
+    y = np.random.RandomState(1).randn(4, 2, 3).astype(np.float32)
+    out = _run(A.batch_dot(a, b, axes=(2, 2)), [a, b], [x, y])
+    ref = np.einsum("bik,bjk->bij", x, y)
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    n = _run(A.l2_normalize(a, axis=-1), [a], [x])
+    np.testing.assert_allclose(np.linalg.norm(n, axis=-1),
+                               np.ones((4, 2)), rtol=1e-5)
+
+
+def test_custom_loss_in_compile(orca_ctx):
+    """Train with a CustomLoss (mean absolute percentage-ish error) and
+    check it actually optimizes — the reference's CustomLoss use case."""
+    from zoo_tpu.pipeline.api.keras import Sequential
+    from zoo_tpu.pipeline.api.keras.layers import Dense
+    from zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+    y_true = Variable(input_shape=(1,))
+    y_pred = Variable(input_shape=(1,))
+    loss_var = A.mean(A.abs(y_true - y_pred), axis=1)
+    loss = CustomLoss(loss_var, y_true, y_pred)
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(128, 4).astype(np.float32)
+    w = rs.randn(4, 1).astype(np.float32)
+    y = x @ w
+    m = Sequential()
+    m.add(Dense(1, input_shape=(4,)))
+    m.compile(optimizer=Adam(lr=0.05), loss=loss)
+    hist = m.fit(x, y, batch_size=32, nb_epoch=5, verbose=0)
+    assert hist["loss"][-1] < hist["loss"][0] * 0.5

@@ -123,7 +123,7 @@ class RayTuneSearchEngine(SearchEngine):  # pragma: no cover - needs ray
 
     def compile(self, trial_fn, search_space, n_sampling=1, metric="mse",
                 mode="min", seed=0):
-        import ray
+        import ray  # noqa: F401
         from ray import tune
 
         space = {}
@@ -133,8 +133,8 @@ class RayTuneSearchEngine(SearchEngine):  # pragma: no cover - needs ray
                     space[k] = tune.grid_search(v.grid())
                 else:
                     space[k] = tune.sample_from(
-                        lambda spec, s=v: s.sample(
-                            np.random.RandomState()))
+                        lambda spec, s=v, r=np.random.RandomState(seed):
+                        s.sample(r))
             else:
                 space[k] = v
         self._tune_kwargs = dict(config=space, num_samples=n_sampling,
@@ -147,14 +147,22 @@ class RayTuneSearchEngine(SearchEngine):  # pragma: no cover - needs ray
         from ray import tune
 
         def runnable(config):
-            tune.report(**self._trial_fn(config))
+            out = self._trial_fn(dict(config))
+            # only scalars travel through ray metrics; artifacts (live
+            # models) are re-materialized in get_best_trial
+            tune.report(**{k: v for k, v in out.items()
+                           if isinstance(v, (int, float))})
 
         self._analysis = tune.run(runnable, **self._tune_kwargs)
         return self._analysis
 
     def get_best_trial(self) -> Trial:
         best = self._analysis.get_best_trial(self._metric, self._mode)
-        return Trial(0, best.config, best.last_result[self._metric])
+        # re-run the winning config in-process to materialize artifacts
+        # (the trained model object cannot ride ray's metric channel)
+        result = self._trial_fn(dict(best.config))
+        return Trial(0, best.config, float(result[self._metric]),
+                     artifacts=result)
 
 
 def make_search_engine() -> SearchEngine:

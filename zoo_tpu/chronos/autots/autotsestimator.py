@@ -32,7 +32,7 @@ def _build_forecaster(model: str, past_seq_len: int, horizon: int,
     if model == "lstm":
         return LSTMForecaster(
             past_seq_len=past_seq_len, input_feature_num=n_features,
-            output_feature_num=n_targets,
+            output_feature_num=n_targets, future_seq_len=horizon,
             hidden_dim=config.get("hidden_dim", 32),
             layer_num=config.get("layer_num", 1),
             dropout=config.get("dropout", 0.1),

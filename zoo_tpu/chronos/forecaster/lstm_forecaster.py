@@ -15,9 +15,9 @@ class LSTMForecaster(Forecaster):
                  hidden_dim: Union[int, Sequence[int]] = 32,
                  layer_num: int = 1, dropout: float = 0.1,
                  lr: float = 0.001, loss: str = "mse",
-                 optimizer: str = "adam"):
+                 optimizer: str = "adam", future_seq_len: int = 1):
         super().__init__(past_seq_len, input_feature_num,
-                         output_feature_num, future_seq_len=1)
+                         output_feature_num, future_seq_len=future_seq_len)
         self.hidden_dim = ([hidden_dim] * layer_num
                            if isinstance(hidden_dim, int) else
                            list(hidden_dim))
@@ -26,7 +26,8 @@ class LSTMForecaster(Forecaster):
         self.loss = loss
         self.optimizer_name = optimizer
         self._ctor_args.update(hidden_dim=self.hidden_dim, dropout=dropout,
-                               lr=lr, loss=loss, optimizer=optimizer)
+                               lr=lr, loss=loss, optimizer=optimizer,
+                               future_seq_len=future_seq_len)
 
     def _build(self):
         from zoo_tpu.pipeline.api.keras import Sequential, optimizers as zopt
@@ -41,7 +42,7 @@ class LSTMForecaster(Forecaster):
             m.add(LSTM(h, return_sequences=not last, **kwargs))
             if self.dropout:
                 m.add(Dropout(self.dropout))
-        m.add(Dense(self.output_feature_num))
+        m.add(Dense(self.output_feature_num * self.future_seq_len))
         opt = {"adam": zopt.Adam, "sgd": zopt.SGD,
                "rmsprop": zopt.RMSprop}[self.optimizer_name.lower()](
             lr=self.lr)

@@ -40,17 +40,24 @@ class AutoEstimator:
     def from_torch(*, model_creator: Callable[[Dict], Any],
                    optimizer=None, loss=None, **kwargs) -> "AutoEstimator":
         """``model_creator(config)`` returns a torch nn.Module; optimizer
-        and loss as in the PyTorch Estimator (reference: ``from_torch``)."""
+        and loss as in the PyTorch Estimator (reference: ``from_torch``) —
+        creator functions are forwarded to ``Estimator.from_torch`` so the
+        optimizer creator receives the REAL model."""
         def build(config: Dict):
             from zoo_tpu.orca.learn.pytorch import Estimator as TorchEst
-            opt = optimizer(None, config) if callable(optimizer) \
-                and not isinstance(optimizer, str) else optimizer
-            return TorchEst.from_torch(
-                model=model_creator(config),
-                optimizer=opt if not callable(opt) or isinstance(opt, str)
-                else None,
-                loss=loss(config) if callable(loss)
-                and type(loss).__name__ == "function" else loss)
+            from zoo_tpu.orca.learn.pytorch.estimator import _is_torch_loss
+
+            kw: Dict[str, Any] = {}
+            if callable(optimizer) and not isinstance(optimizer, str):
+                kw["optimizer_creator"] = optimizer
+            else:
+                kw["optimizer"] = optimizer
+            if callable(loss) and not _is_torch_loss(loss):
+                kw["loss_creator"] = loss
+            else:
+                kw["loss"] = loss
+            return TorchEst.from_torch(model_creator=model_creator,
+                                       config=config, **kw)
 
         return AutoEstimator(build, kind="torch")
 
@@ -82,8 +89,17 @@ class AutoEstimator:
                 model.fit(x, y, batch_size=bs, nb_epoch=epochs, verbose=0)
                 ex, ey = _xy(eval_data)
                 res = model.evaluate(ex, ey, batch_size=bs)
-            value = res[metric] if metric in res else res.get(
-                "loss", float("nan"))
+            if metric not in res:
+                if metric == "loss" or set(res) == {"loss"} and \
+                        metric.lower() in ("mse", "mean_squared_error"):
+                    value = res["loss"]
+                else:
+                    raise ValueError(
+                        f"metric {metric!r} not produced by evaluate(); "
+                        f"available: {sorted(res)} — compile the model "
+                        f"with metrics=[{metric!r}]")
+            else:
+                value = res[metric]
             return {metric: float(value), "model": model}
 
         engine = make_search_engine()

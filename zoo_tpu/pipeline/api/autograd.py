@@ -1,0 +1,229 @@
+"""Autograd DSL: symbolic Variable math compiled into the layer graph.
+
+Rebuild of ``pyzoo/zoo/pipeline/api/autograd.py:256-510`` (Variable wrapper
+with operator overloads + the math function zoo: mean/abs/sum/clip/square/
+sqrt/exp/log/pow/maximum/mm/batch_dot/l2_normalize/erf/...) and
+``CustomLoss``. The reference compiles Variable expressions to BigDL graph
+nodes via Py4J; here every op is a stateless graph layer whose ``call`` is
+the jax expression itself, so a Variable expression IS a jittable function —
+autograd comes from jax, not from a hand-built tape.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from zoo_tpu.pipeline.api.keras.engine.base import KTensor, Layer
+from zoo_tpu.pipeline.api.keras.engine.topology import Model
+
+
+class _VarOp(Layer):
+    """Stateless n-ary op node."""
+
+    def __init__(self, fn: Callable, out_shape: Tuple, name=None):
+        super().__init__(name=name)
+        self.fn = fn
+        self._out_shape = tuple(out_shape)
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        if isinstance(inputs, list):
+            return self.fn(*inputs)
+        return self.fn(inputs)
+
+    def compute_output_shape(self, input_shape):
+        return self._out_shape
+
+
+def _infer_shape(fn: Callable, shapes: Sequence[Tuple]) -> Tuple:
+    args = [jax.ShapeDtypeStruct((2,) + tuple(s[1:]), jnp.float32)
+            for s in shapes]
+    out = jax.eval_shape(fn, *args)
+    return (None,) + tuple(out.shape[1:])
+
+
+class Variable:
+    """Symbolic tensor with math operators (reference: ``Variable``,
+    ``autograd.py:256``)."""
+
+    def __init__(self, input_shape: Optional[Tuple] = None,
+                 node: Optional[KTensor] = None, name: Optional[str] = None):
+        if node is not None:
+            self.node = node
+        else:
+            if input_shape is None:
+                raise ValueError("pass input_shape or node")
+            self.node = KTensor((None,) + tuple(input_shape))
+
+    @property
+    def shape(self):
+        return self.node.shape
+
+    # -- factory -----------------------------------------------------------
+    @staticmethod
+    def from_node(node: KTensor) -> "Variable":
+        return Variable(node=node)
+
+    # -- op plumbing -------------------------------------------------------
+    @staticmethod
+    def _apply(fn: Callable, *vars: "Variable",
+               out_shape: Optional[Tuple] = None) -> "Variable":
+        nodes = [v.node for v in vars]
+        shape = out_shape or _infer_shape(fn, [n.shape for n in nodes])
+        layer = _VarOp(fn, shape)
+        return Variable(node=layer(nodes if len(nodes) > 1 else nodes[0]))
+
+    @staticmethod
+    def _coerce(other) -> Union["Variable", float]:
+        return other
+
+    def _binop(self, other, fn) -> "Variable":
+        if isinstance(other, Variable):
+            return Variable._apply(fn, self, other)
+        return Variable._apply(lambda a: fn(a, other), self)
+
+    # -- operators ---------------------------------------------------------
+    def __add__(self, other):
+        return self._binop(other, lambda a, b: a + b)
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._binop(other, lambda a, b: a - b)
+
+    def __rsub__(self, other):
+        return Variable._apply(lambda a: other - a, self)
+
+    def __mul__(self, other):
+        return self._binop(other, lambda a, b: a * b)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._binop(other, lambda a, b: a / b)
+
+    def __rtruediv__(self, other):
+        return Variable._apply(lambda a: other / a, self)
+
+    def __neg__(self):
+        return Variable._apply(lambda a: -a, self)
+
+    def __pow__(self, p):
+        return Variable._apply(lambda a: a ** p, self)
+
+    def __getitem__(self, item):
+        return Variable._apply(lambda a: a[item], self)
+
+
+# ---------------------------------------------------------------------------
+# math functions (reference: ``autograd.py`` module functions + math.scala)
+# ---------------------------------------------------------------------------
+
+def _unary(fn):
+    def wrapper(v: Variable) -> Variable:
+        return Variable._apply(fn, v)
+    return wrapper
+
+
+abs = _unary(jnp.abs)            # noqa: A001 - reference name
+square = _unary(jnp.square)
+sqrt = _unary(jnp.sqrt)
+exp = _unary(jnp.exp)
+log = _unary(jnp.log)
+erf = _unary(jax.scipy.special.erf)
+softsign = _unary(jax.nn.soft_sign)
+softplus = _unary(jax.nn.softplus)
+sigmoid = _unary(jax.nn.sigmoid)
+tanh = _unary(jnp.tanh)
+relu = _unary(jax.nn.relu)
+
+
+def mean(v: Variable, axis: int = 0, keepdims: bool = False) -> Variable:
+    """Mean over NON-batch axis ``axis`` (reference semantics: axis counts
+    from the first non-batch dim... axis 0 == batch in keras-1; we follow
+    the reference's ``mean(x, axis)`` where axis includes batch)."""
+    return Variable._apply(
+        lambda a: jnp.mean(a, axis=axis, keepdims=keepdims), v)
+
+
+def sum(v: Variable, axis: int = 0, keepdims: bool = False) -> Variable:  # noqa: A001
+    return Variable._apply(
+        lambda a: jnp.sum(a, axis=axis, keepdims=keepdims), v)
+
+
+def clip(v: Variable, min: float, max: float) -> Variable:  # noqa: A002
+    return Variable._apply(lambda a: jnp.clip(a, min, max), v)
+
+
+def pow(v: Variable, p: float) -> Variable:  # noqa: A001
+    return v ** p
+
+
+def maximum(a: Variable, b) -> Variable:
+    if isinstance(b, Variable):
+        return Variable._apply(jnp.maximum, a, b)
+    return Variable._apply(lambda x: jnp.maximum(x, b), a)
+
+
+def mm(a: Variable, b: Variable, axes: Optional[List[int]] = None
+       ) -> Variable:
+    """Batch matrix multiply with optional contraction axes (reference:
+    ``autograd.mm``)."""
+    if axes is None:
+        return Variable._apply(jnp.matmul, a, b)
+    ax1, ax2 = axes
+    return Variable._apply(
+        lambda x, y: _tensordot_batch(x, y, ax1, ax2), a, b)
+
+
+def _tensordot_batch(x, y, ax1, ax2):
+    # contract ax1 of x with ax2 of y, batching over axis 0
+    return jax.vmap(lambda xx, yy: jnp.tensordot(
+        xx, yy, axes=([ax1 - 1], [ax2 - 1])))(x, y)
+
+
+def batch_dot(a: Variable, b: Variable, axes: Sequence[int] = (1, 1)
+              ) -> Variable:
+    """reference: ``batch_dot`` (keras-1 semantics)."""
+    ax1, ax2 = axes
+    return Variable._apply(
+        lambda x, y: jax.vmap(lambda xx, yy: jnp.tensordot(
+            xx, yy, axes=([ax1 - 1], [ax2 - 1])))(x, y), a, b)
+
+
+def l2_normalize(v: Variable, axis: int = -1) -> Variable:
+    return Variable._apply(
+        lambda a: a / jnp.maximum(
+            jnp.linalg.norm(a, axis=axis, keepdims=True), 1e-12), v)
+
+
+def expand_dims(v: Variable, axis: int) -> Variable:
+    return Variable._apply(lambda a: jnp.expand_dims(a, axis), v)
+
+
+def stack(vars: Sequence[Variable], axis: int = 1) -> Variable:
+    return Variable._apply(lambda *xs: jnp.stack(xs, axis=axis), *vars)
+
+
+def contiguous(v: Variable) -> Variable:
+    return v  # jax arrays are always "contiguous"
+
+
+# ---------------------------------------------------------------------------
+# CustomLoss (reference: ``CustomLoss`` in autograd.py + CustomLossWithVariable)
+# ---------------------------------------------------------------------------
+
+class CustomLoss:
+    """Build a loss function from a Variable expression over (y_true,
+    y_pred) Variables; usable directly in ``model.compile(loss=...)``."""
+
+    def __init__(self, loss: Variable, y_true: Variable, y_pred: Variable):
+        self._model = Model(input=[y_true.node, y_pred.node],
+                            output=loss.node, name="custom_loss")
+
+    def __call__(self, y_true, y_pred):
+        out = self._model._forward({}, [y_true, y_pred], training=False,
+                                   rng=None, collect=None)
+        return jnp.mean(out)

@@ -13,6 +13,7 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import logging
+import zlib
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
@@ -132,8 +133,11 @@ class RayTuneSearchEngine(SearchEngine):  # pragma: no cover - needs ray
                 if v.is_grid():
                     space[k] = tune.grid_search(v.grid())
                 else:
+                    # distinct stream per key — one shared seed would make
+                    # every sampled dim draw identical values per trial
+                    kseed = (seed + zlib.crc32(k.encode())) % (2 ** 31)
                     space[k] = tune.sample_from(
-                        lambda spec, s=v, r=np.random.RandomState(seed):
+                        lambda spec, s=v, r=np.random.RandomState(kseed):
                         s.sample(r))
             else:
                 space[k] = v

@@ -23,13 +23,28 @@ def dot_product_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                           causal: bool = False,
                           dropout_p: float = 0.0,
                           dropout_rng=None,
-                          scale: Optional[float] = None) -> jnp.ndarray:
+                          scale: Optional[float] = None,
+                          impl: str = "auto") -> jnp.ndarray:
     """Scaled dot-product attention over (B, H, T, D) tensors.
 
     ``mask``: optional (B, 1, 1, T) or (B, 1, T, T) additive-style boolean
     mask (True = attend). ``causal`` adds the autoregressive triangle (the
     reference's ``bidirectional=False`` TransformerLayer mode).
+
+    ``impl``: "dense" (XLA-fused O(T^2) math), "flash" (the Pallas
+    blockwise kernel, zoo_tpu.ops.pallas.flash_attention), or "auto" —
+    flash on TPU when it applies (no arbitrary mask, no dropout),
+    dense otherwise.
     """
+    flash_ok = mask is None and dropout_p == 0.0
+    if impl == "flash" or (impl == "auto" and flash_ok
+                           and jax.default_backend() == "tpu"):
+        if not flash_ok:
+            raise ValueError("flash attention supports causal masking only "
+                             "(no arbitrary mask / dropout); use the dense "
+                             "impl for those")
+        from zoo_tpu.ops.pallas import flash_attention
+        return flash_attention(q, k, v, causal=causal, scale=scale)
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(q.dtype)
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale

@@ -1,0 +1,46 @@
+"""Pallas TPU kernels for the hot ops.
+
+The reference reaches native compute through JVM bindings (BigDL MKL-DNN,
+libtensorflow JNI — SURVEY §2.9); here the native layer is Pallas kernels
+compiled by Mosaic for the TPU's MXU/VPU:
+
+- ``flash_attention`` — blockwise online-softmax attention (net-new vs the
+  reference's dense ``TransformerLayer.scala:279`` math; required for the
+  long-context path, SURVEY §5.7).
+- ``quantized_matmul`` / ``quantize_int8`` — int8 inference path, the TPU
+  equivalent of the reference's OpenVINO VNNI int8 story
+  (``examples/vnni``, SURVEY §2.9(4)).
+- ``fused_apply_sgd`` / ``fused_apply_adam`` — fused optimizer update, the
+  TPU equivalent of BigDL's slice-wise parameter-manager "aggregate +
+  apply" step (``docs/docs/wp-bigdl.md:146-160``).
+
+Every kernel takes ``interpret=None`` and auto-falls-back to the Pallas
+interpreter off-TPU so the hermetic CPU-mesh test rig (tests/conftest.py)
+exercises the same code path CI-side.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def resolve_interpret(interpret) -> bool:
+    """None → interpret off-TPU, compile on TPU."""
+    if interpret is None:
+        return not on_tpu()
+    return bool(interpret)
+
+
+from zoo_tpu.ops.pallas.flash_attention import flash_attention  # noqa: E402
+from zoo_tpu.ops.pallas.quant import (  # noqa: E402
+    quantize_int8, quantized_matmul, quantized_dense)
+from zoo_tpu.ops.pallas.fused_optim import (  # noqa: E402
+    fused_apply_sgd, fused_apply_adam)
+
+__all__ = ["flash_attention", "quantize_int8", "quantized_matmul",
+           "quantized_dense", "fused_apply_sgd", "fused_apply_adam",
+           "on_tpu", "resolve_interpret"]

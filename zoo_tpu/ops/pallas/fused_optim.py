@@ -1,0 +1,139 @@
+"""Fused optimizer-apply Pallas kernels.
+
+The reference's parameter sync is BigDL's PS-style AllReduce: gradients
+are sliced N ways, each "parameter manager" task aggregates its slice and
+*applies the optimizer to that slice in the same task* before broadcasting
+the updated slice back (``docs/docs/wp-bigdl.md:146-160``,
+``Topology.scala:1204``). The TPU mapping (SURVEY §2.9(1)) is
+reduce_scatter + fused-apply + all_gather; these kernels are the
+"fused-apply" leg — a single VMEM-resident elementwise pass per slice
+instead of separate mul/add HBM round-trips. Use under ``shard_map`` so
+each chip updates only its parameter shard.
+
+Tensors of any shape are viewed as padded (rows, 128) tiles; scalars
+(lr, step) ride in SMEM so changing them does not recompile.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from zoo_tpu.ops.pallas import resolve_interpret as _resolve_interpret
+
+_LANES = 128
+_BLOCK_ROWS = 256
+
+
+def _as_tiles(x):
+    n = x.size
+    rows = -(-n // _LANES)
+    pad_rows = (-rows) % _BLOCK_ROWS
+    flat = jnp.pad(x.reshape(-1), (0, rows * _LANES - n))
+    tiles = flat.reshape(rows, _LANES)
+    if pad_rows:
+        tiles = jnp.pad(tiles, ((0, pad_rows), (0, 0)))
+    return tiles
+
+
+def _from_tiles(tiles, like):
+    return tiles.reshape(-1)[:like.size].reshape(like.shape).astype(
+        like.dtype)
+
+
+def _sgd_kernel(lr_ref, mom_ref, wd_ref, p_ref, g_ref, buf_ref,
+                p_out, buf_out):
+    lr = lr_ref[0]
+    momentum = mom_ref[0]
+    wd = wd_ref[0]
+    g = g_ref[...] + wd * p_ref[...]
+    buf = momentum * buf_ref[...] + g
+    p_out[...] = p_ref[...] - lr * buf
+    buf_out[...] = buf
+
+
+def fused_apply_sgd(param: jnp.ndarray, grad: jnp.ndarray,
+                    momentum_buf: jnp.ndarray, lr,
+                    momentum: float = 0.0, weight_decay: float = 0.0,
+                    interpret: Optional[bool] = None
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One fused SGD(+momentum, +L2) step; returns (param, momentum_buf)."""
+    interpret = _resolve_interpret(interpret)
+    p = _as_tiles(param.astype(jnp.float32))
+    g = _as_tiles(grad.astype(jnp.float32))
+    b = _as_tiles(momentum_buf.astype(jnp.float32))
+    scalars = [jnp.asarray([v], jnp.float32)
+               for v in (lr, momentum, weight_decay)]
+    rows = p.shape[0]
+    grid = (rows // _BLOCK_ROWS,)
+    spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0))
+    sspec = pl.BlockSpec(memory_space=pltpu.SMEM)
+    new_p, new_b = pl.pallas_call(
+        _sgd_kernel,
+        grid=grid,
+        in_specs=[sspec, sspec, sspec, spec, spec, spec],
+        out_specs=[spec, spec],
+        out_shape=[jax.ShapeDtypeStruct(p.shape, jnp.float32)] * 2,
+        interpret=interpret,
+    )(*scalars, p, g, b)
+    return _from_tiles(new_p, param), _from_tiles(new_b, momentum_buf)
+
+
+def _adam_kernel(lr_ref, b1_ref, b2_ref, eps_ref, wd_ref, bc1_ref, bc2_ref,
+                 p_ref, g_ref, m_ref, v_ref, p_out, m_out, v_out):
+    lr = lr_ref[0]
+    b1 = b1_ref[0]
+    b2 = b2_ref[0]
+    eps = eps_ref[0]
+    wd = wd_ref[0]
+    bc1 = bc1_ref[0]     # 1 / (1 - b1^t)
+    bc2 = bc2_ref[0]     # 1 / (1 - b2^t)
+    g = g_ref[...]
+    m = b1 * m_ref[...] + (1.0 - b1) * g
+    v = b2 * v_ref[...] + (1.0 - b2) * g * g
+    m_hat = m * bc1
+    v_hat = v * bc2
+    # AdamW-style decoupled decay (the reference's AdamWeightDecay,
+    # pipeline/api/keras/optimizers/AdamWeightDecay.scala).
+    update = m_hat / (jnp.sqrt(v_hat) + eps) + wd * p_ref[...]
+    p_out[...] = p_ref[...] - lr * update
+    m_out[...] = m
+    v_out[...] = v
+
+
+def fused_apply_adam(param: jnp.ndarray, grad: jnp.ndarray,
+                     m: jnp.ndarray, v: jnp.ndarray, step,
+                     lr, beta1: float = 0.9, beta2: float = 0.999,
+                     eps: float = 1e-8, weight_decay: float = 0.0,
+                     interpret: Optional[bool] = None
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One fused Adam(W) step; returns (param, m, v). ``step`` is 1-based."""
+    interpret = _resolve_interpret(interpret)
+    step = jnp.asarray(step, jnp.float32)
+    bc1 = 1.0 / (1.0 - jnp.float32(beta1) ** step)
+    bc2 = 1.0 / (1.0 - jnp.float32(beta2) ** step)
+    pt = _as_tiles(param.astype(jnp.float32))
+    gt = _as_tiles(grad.astype(jnp.float32))
+    mt = _as_tiles(m.astype(jnp.float32))
+    vt = _as_tiles(v.astype(jnp.float32))
+    scalars = [jnp.asarray([x], jnp.float32).astype(jnp.float32)
+               for x in (lr, beta1, beta2, eps, weight_decay)]
+    scalars += [bc1.reshape(1), bc2.reshape(1)]
+    rows = pt.shape[0]
+    spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0))
+    sspec = pl.BlockSpec(memory_space=pltpu.SMEM)
+    new_p, new_m, new_v = pl.pallas_call(
+        _adam_kernel,
+        grid=(rows // _BLOCK_ROWS,),
+        in_specs=[sspec] * 7 + [spec] * 4,
+        out_specs=[spec] * 3,
+        out_shape=[jax.ShapeDtypeStruct(pt.shape, jnp.float32)] * 3,
+        interpret=interpret,
+    )(*scalars, pt, gt, mt, vt)
+    return (_from_tiles(new_p, param), _from_tiles(new_m, m),
+            _from_tiles(new_v, v))

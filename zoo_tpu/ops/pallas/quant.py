@@ -1,0 +1,136 @@
+"""Int8 quantized inference kernels (Pallas/MXU).
+
+TPU equivalent of the reference's int8/VNNI inference story: OpenVINO
+int8-calibrated models loaded via ``doLoadOpenVINOInt8``
+(``pipeline/inference/InferenceModel.scala:283``) and the ``examples/vnni``
+benchmarks, which claim ~4x model-size reduction and up to ~2x speedup
+(``docs/docs/wp-bigdl.md:192-196``, SURVEY §6). Here weights are stored
+int8 per-output-channel symmetric, activations are dynamically quantized
+per-row, and the matmul runs int8×int8→int32 on the MXU with dequant fused
+into the epilogue.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from zoo_tpu.ops.pallas import resolve_interpret as _resolve_interpret
+
+_LANES = 128
+_SUBLANES = 8
+
+
+def _pad_dim(x, axis, mult):
+    rem = (-x.shape[axis]) % mult
+    if rem == 0:
+        return x
+    pads = [(0, 0)] * x.ndim
+    pads[axis] = (0, rem)
+    return jnp.pad(x, pads)
+
+
+def quantize_int8(x: jnp.ndarray, axis: int = -1):
+    """Symmetric per-slice int8 quantization along ``axis``.
+
+    Returns ``(values int8, scale f32)`` with ``scale`` shaped like ``x``
+    reduced over ``axis`` (keepdims). ``x ≈ values * scale``.
+    """
+    amax = jnp.max(jnp.abs(x), axis=axis, keepdims=True)
+    scale = jnp.where(amax == 0, 1.0, amax / 127.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _qmm_kernel(x_ref, w_ref, xs_ref, ws_ref, o_ref, acc_scr, *, num_k):
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    acc_scr[...] += jax.lax.dot_general(
+        x_ref[...], w_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+
+    @pl.when(ki == num_k - 1)
+    def _finish():
+        xs = xs_ref[:, :1]          # (bm, 1) per-row activation scale
+        ws = ws_ref[:1, :]          # (1, bn) per-column weight scale
+        o_ref[...] = (acc_scr[...].astype(jnp.float32) * xs * ws
+                      ).astype(o_ref.dtype)
+
+
+def quantized_matmul(x_q: jnp.ndarray, w_q: jnp.ndarray,
+                     x_scale: jnp.ndarray, w_scale: jnp.ndarray,
+                     out_dtype=jnp.float32,
+                     block_m: int = 128, block_n: int = 128,
+                     block_k: int = 128,
+                     interpret: Optional[bool] = None) -> jnp.ndarray:
+    """(M,K)int8 @ (K,N)int8 → (M,N)``out_dtype`` with fused dequant.
+
+    ``x_scale``: (M, 1) or (M,) per-row; ``w_scale``: (1, N) or (N,)
+    per-output-channel.
+    """
+    m, k = x_q.shape
+    k2, n = w_q.shape
+    assert k == k2, (x_q.shape, w_q.shape)
+    interpret = _resolve_interpret(interpret)
+
+    x_scale = x_scale.reshape(m).astype(jnp.float32)
+    w_scale = w_scale.reshape(n).astype(jnp.float32)
+
+    xp = _pad_dim(_pad_dim(x_q, 0, block_m), 1, block_k)
+    wp = _pad_dim(_pad_dim(w_q, 0, block_k), 1, block_n)
+    mp, kp = xp.shape
+    np_ = wp.shape[1]
+    # Scales ride in lane/sublane-padded carriers (see flash_attention's
+    # lse trick): x per-row → (Mp, LANES) use col 0; w per-col →
+    # (SUBLANES, Np) use row 0.
+    xs = jnp.broadcast_to(_pad_dim(x_scale, 0, block_m)[:, None],
+                          (mp, _LANES))
+    ws = jnp.broadcast_to(_pad_dim(w_scale, 0, block_n)[None, :],
+                          (_SUBLANES, np_))
+
+    num_k = kp // block_k
+    out = pl.pallas_call(
+        functools.partial(_qmm_kernel, num_k=num_k),
+        grid=(mp // block_m, np_ // block_n, num_k),
+        in_specs=[
+            pl.BlockSpec((block_m, block_k), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((block_k, block_n), lambda i, j, kk: (kk, j)),
+            pl.BlockSpec((block_m, _LANES), lambda i, j, kk: (i, 0)),
+            pl.BlockSpec((_SUBLANES, block_n), lambda i, j, kk: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((block_m, block_n),
+                               lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((mp, np_), out_dtype),
+        scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.int32)],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * mp * np_ * kp,
+            bytes_accessed=mp * kp + kp * np_ + mp * np_ * 4,
+            transcendentals=0),
+        interpret=interpret,
+    )(xp, wp, xs, ws)
+    return out[:m, :n]
+
+
+def quantized_dense(x: jnp.ndarray, w_q: jnp.ndarray,
+                    w_scale: jnp.ndarray,
+                    bias: Optional[jnp.ndarray] = None,
+                    interpret: Optional[bool] = None) -> jnp.ndarray:
+    """f32/bf16 activations × int8 weights: dynamic per-row activation
+    quantization + int8 MXU matmul. The InferenceModel int8 path calls
+    this for Dense layers after ``quantize()``."""
+    x2 = x.reshape(-1, x.shape[-1])
+    x_q, x_scale = quantize_int8(x2, axis=-1)
+    y = quantized_matmul(x_q, w_q, x_scale, w_scale,
+                         out_dtype=x.dtype, interpret=interpret)
+    if bias is not None:
+        y = y + bias
+    return y.reshape(*x.shape[:-1], w_q.shape[1])

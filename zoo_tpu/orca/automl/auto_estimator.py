@@ -90,8 +90,14 @@ class AutoEstimator:
                 ex, ey = _xy(eval_data)
                 res = model.evaluate(ex, ey, batch_size=bs)
             if metric not in res:
-                if metric == "loss" or set(res) == {"loss"} and \
-                        metric.lower() in ("mse", "mean_squared_error"):
+                # res["loss"] may stand in for the metric only when the
+                # compiled loss really is that metric.
+                loss_name = (getattr(model, "loss_name", None) or "").lower()
+                aliases = {"mse": {"mse", "mean_squared_error"},
+                           "mae": {"mae", "mean_absolute_error"}}
+                wanted = aliases.get(metric.lower(), {metric.lower()})
+                if metric == "loss" or (set(res) == {"loss"}
+                                        and loss_name in wanted):
                     value = res["loss"]
                 else:
                     raise ValueError(

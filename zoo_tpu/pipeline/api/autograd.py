@@ -38,10 +38,19 @@ class _VarOp(Layer):
 
 
 def _infer_shape(fn: Callable, shapes: Sequence[Tuple]) -> Tuple:
-    args = [jax.ShapeDtypeStruct((2,) + tuple(s[1:]), jnp.float32)
-            for s in shapes]
-    out = jax.eval_shape(fn, *args)
-    return (None,) + tuple(out.shape[1:])
+    # Trace twice with different batch sizes: if the leading output dim
+    # tracks the batch it stays symbolic (None); otherwise (e.g. a
+    # reduction over axis 0) the output shape is fully static.
+    def trace(b):
+        args = [jax.ShapeDtypeStruct((b,) + tuple(s[1:]), jnp.float32)
+                for s in shapes]
+        return jax.eval_shape(fn, *args)
+
+    out2, out3 = trace(2), trace(3)
+    if (len(out2.shape) == len(out3.shape) and out2.shape and
+            out2.shape[0] == 2 and out3.shape[0] == 3):
+        return (None,) + tuple(out2.shape[1:])
+    return tuple(out2.shape)
 
 
 class Variable:
@@ -74,10 +83,6 @@ class Variable:
         shape = out_shape or _infer_shape(fn, [n.shape for n in nodes])
         layer = _VarOp(fn, shape)
         return Variable(node=layer(nodes if len(nodes) > 1 else nodes[0]))
-
-    @staticmethod
-    def _coerce(other) -> Union["Variable", float]:
-        return other
 
     def _binop(self, other, fn) -> "Variable":
         if isinstance(other, Variable):
@@ -189,8 +194,7 @@ def batch_dot(a: Variable, b: Variable, axes: Sequence[int] = (1, 1)
     """reference: ``batch_dot`` (keras-1 semantics)."""
     ax1, ax2 = axes
     return Variable._apply(
-        lambda x, y: jax.vmap(lambda xx, yy: jnp.tensordot(
-            xx, yy, axes=([ax1 - 1], [ax2 - 1])))(x, y), a, b)
+        lambda x, y: _tensordot_batch(x, y, ax1, ax2), a, b)
 
 
 def l2_normalize(v: Variable, axis: int = -1) -> Variable:

@@ -133,6 +133,8 @@ class KerasNet:
         """reference: ``KerasNet.compile`` ``Topology.scala:139``."""
         self.optimizer = get_optimizer(optimizer)
         self.loss_fn = get_loss(loss)
+        self.loss_name = (loss if isinstance(loss, str)
+                          else getattr(loss, "__name__", None))
         self.metrics = [get_metric(m) for m in (metrics or [])]
         self._jit_train = self._jit_eval = self._jit_pred = None
         self._opt_state = None  # a new optimizer cannot reuse old state
@@ -351,6 +353,8 @@ class KerasNet:
         xs = self._adapt_inputs(xs)
         if ys is None:
             raise ValueError("evaluate requires labels")
+        if self.params is None:
+            self.build(input_shapes=[(None,) + a.shape[1:] for a in xs])
         return self._evaluate_arrays(xs, ys, batch_size)
 
     def predict(self, x, batch_size: int = 256, feature_cols=None

@@ -76,7 +76,7 @@ def _block_params(rng, hidden: int, intermediate: int, init):
 
 
 def _block_forward(p, h, *, n_head, mask, causal, act, hidden_drop,
-                   attn_drop, training, rng):
+                   attn_drop, training, rng, attention_impl="auto"):
     """Post-LN transformer block (the reference's TransformerLayer/BERT use
     post-layernorm, GPT-1/BERT style)."""
     qkv = h @ p["qkv_w"] + p["qkv_b"]
@@ -87,7 +87,8 @@ def _block_forward(p, h, *, n_head, mask, causal, act, hidden_drop,
     a = dot_product_attention(
         split_heads(q, n_head), split_heads(k, n_head),
         split_heads(v, n_head), mask=mask, causal=causal,
-        dropout_p=attn_drop if training else 0.0, dropout_rng=drng)
+        dropout_p=attn_drop if training else 0.0, dropout_rng=drng,
+        impl=attention_impl)
     a = merge_heads(a) @ p["proj_w"] + p["proj_b"]
     if training and hidden_drop > 0 and rng is not None:
         rng, drng = jax.random.split(rng)
@@ -114,10 +115,16 @@ class TransformerLayer(Layer):
                  intermediate_size: Optional[int] = None,
                  hidden_drop: float = 0.1, attn_drop: float = 0.1,
                  initializer_range: float = 0.02,
-                 bidirectional: bool = False, activation="gelu", **kwargs):
+                 bidirectional: bool = False, activation="gelu",
+                 attention_impl: str = "auto", **kwargs):
         super().__init__(**kwargs)
         if hidden_size % n_head:
             raise ValueError("hidden_size must divide by n_head")
+        if attention_impl == "flash" and attn_drop > 0:
+            raise ValueError(
+                "attention_impl='flash' does not support attention dropout; "
+                "pass attn_drop=0 (hidden_drop still applies)")
+        self.attention_impl = attention_impl
         self.vocab = vocab
         self.seq_len = seq_len
         self.n_block = n_block
@@ -160,7 +167,8 @@ class TransformerLayer(Layer):
                                causal=not self.bidirectional, act=self.act,
                                hidden_drop=self.hidden_drop,
                                attn_drop=self.attn_drop, training=training,
-                               rng=brng)
+                               rng=brng,
+                               attention_impl=self.attention_impl)
             return (h, rng), None
 
         rng = layer_rng(rng, self.name) if rng is not None else None

@@ -1,0 +1,157 @@
+"""C++ native runtime: TFRecord I/O + tiered cache (and their Python
+fallbacks — both paths are exercised)."""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from zoo_tpu import native
+from zoo_tpu.orca.data import tfrecord as tfr
+from zoo_tpu.orca.data.cache import (CachedDataset, DoubleBufferedIterator,
+                                     TieredSampleCache)
+
+
+def test_native_library_builds():
+    assert native.available(), "g++ build of native/zoo_native.cc failed"
+
+
+def test_crc32c_known_vectors():
+    # RFC 3720 test vectors
+    assert tfr.crc32c(b"") == 0x0
+    assert tfr.crc32c(b"123456789") == 0xE3069283
+    assert tfr.crc32c(bytes(32)) == 0x8A9136AA
+
+
+def test_tfrecord_roundtrip_native(tmp_path):
+    path = str(tmp_path / "data.tfrecord")
+    recs = [os.urandom(n) for n in (1, 10, 1000, 65536)]
+    tfr.write_tfrecord(path, recs)
+    back = tfr.read_tfrecord(path)
+    assert back == recs
+
+
+def test_tfrecord_matches_tensorflow_format(tmp_path):
+    tf = pytest.importorskip("tensorflow")
+    path = str(tmp_path / "ours.tfrecord")
+    recs = [b"hello", b"world" * 100]
+    tfr.write_tfrecord(path, recs)
+    got = [bytes(r.numpy()) for r in tf.data.TFRecordDataset(path)]
+    assert got == recs
+    # and read TF-written files back
+    path2 = str(tmp_path / "tf.tfrecord")
+    with tf.io.TFRecordWriter(path2) as w:
+        for r in recs:
+            w.write(r)
+    assert tfr.read_tfrecord(path2) == recs
+
+
+def test_tfrecord_python_fallback_interops(tmp_path, monkeypatch):
+    path = str(tmp_path / "n.tfrecord")
+    recs = [b"abc", os.urandom(500)]
+    tfr.write_tfrecord(path, recs)  # native write
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_lib_tried", True)
+    assert not native.available()
+    assert tfr.read_tfrecord(path) == recs  # python read
+    path2 = str(tmp_path / "p.tfrecord")
+    tfr.write_tfrecord(path2, recs)  # python write
+    monkeypatch.setattr(native, "_lib_tried", False)
+    assert native.available()
+    assert tfr.read_tfrecord(path2) == recs  # native read
+
+
+def test_tfrecord_corruption_detected(tmp_path):
+    path = str(tmp_path / "c.tfrecord")
+    tfr.write_tfrecord(path, [b"x" * 100])
+    raw = bytearray(open(path, "rb").read())
+    raw[40] ^= 0xFF  # flip a payload byte
+    open(path, "wb").write(bytes(raw))
+    with pytest.raises(tfr.TFRecordCorruptError):
+        tfr.read_tfrecord(path)
+    assert len(tfr.read_tfrecord(path, check_crc=False)) == 1
+
+
+def test_tfrecord_shards(tmp_path):
+    for i in range(3):
+        tfr.write_tfrecord(str(tmp_path / f"part-{i}.tfrecord"),
+                           [f"rec{i}-{j}".encode() for j in range(4)])
+    shards = tfr.read_tfrecord_shards(str(tmp_path / "part-*.tfrecord"))
+    assert shards.num_partitions() == 3
+    flat = [r for part in shards.collect() for r in part]
+    assert len(flat) == 12
+
+
+@pytest.mark.parametrize("force_python", [False, True])
+def test_tiered_cache_spills_and_reads_back(tmp_path, force_python,
+                                            monkeypatch):
+    if force_python:
+        monkeypatch.setattr(native, "_lib", None)
+        monkeypatch.setattr(native, "_lib_tried", True)
+    rs = np.random.RandomState(0)
+    batches = [rs.randn(8, 4).astype(np.float32) for _ in range(20)]
+    blob = pickle.dumps(batches[0], protocol=pickle.HIGHEST_PROTOCOL)
+    # budget fits ~5 blobs → the rest must spill to disk
+    cache = TieredSampleCache(dram_budget=len(blob) * 5,
+                              spill_dir=str(tmp_path))
+    ids = [cache.put(b) for b in batches]
+    assert ids == list(range(20))
+    assert len(cache) == 20
+    assert cache.dram_used() <= len(blob) * 5
+    for i in (0, 4, 5, 19, 7):  # DRAM entries and spilled entries
+        np.testing.assert_array_equal(cache.get(i), batches[i])
+    cache.close()
+
+
+def test_cache_dram_mode_no_spill():
+    cache = TieredSampleCache(store="DRAM")
+    for i in range(10):
+        cache.put({"x": np.arange(i + 1)})
+    np.testing.assert_array_equal(cache.get(3)["x"], np.arange(4))
+    cache.close()
+
+
+def test_disk_tier_from_context_flag():
+    from zoo_tpu.common.context import ZooContext
+    old = ZooContext.train_data_store
+    try:
+        ZooContext.train_data_store = "DISK_4"
+        cache = TieredSampleCache(total_bytes_hint=4000)
+        assert cache._budget == 1000
+        cache.close()
+    finally:
+        ZooContext.train_data_store = old
+
+
+def test_cached_dataset_epochs():
+    data = [np.full((2, 2), i) for i in range(5)]
+    ds = CachedDataset(data, store="DRAM")
+    for _ in range(2):  # two epochs, same content
+        got = list(ds)
+        assert len(got) == 5
+        np.testing.assert_array_equal(got[3], data[3])
+    ds.close()
+
+
+def test_double_buffered_iterator_order_and_staging():
+    staged = []
+
+    def stage(x):
+        staged.append(x)
+        return x * 10
+
+    out = list(DoubleBufferedIterator(range(50), stage_fn=stage))
+    assert out == [i * 10 for i in range(50)]
+    assert staged == list(range(50))
+
+
+def test_double_buffered_iterator_propagates_errors():
+    def gen():
+        yield 1
+        raise RuntimeError("boom")
+
+    it = DoubleBufferedIterator(gen())
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="boom"):
+        list(it)

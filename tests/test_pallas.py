@@ -26,7 +26,7 @@ def _qkv(b=2, h=3, t=80, d=32, tk=None, seed=0):
 def test_flash_attention_matches_dense(causal):
     q, k, v = _qkv()
     out = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
-    ref = dot_product_attention(q, k, v, causal=causal)
+    ref = dot_product_attention(q, k, v, causal=causal, impl="dense")
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
 
@@ -34,7 +34,18 @@ def test_flash_attention_matches_dense(causal):
 def test_flash_attention_cross_length():
     q, k, v = _qkv(t=40, tk=72)
     out = flash_attention(q, k, v, block_q=32, block_k=32)
-    ref = dot_product_attention(q, k, v)
+    ref = dot_product_attention(q, k, v, impl="dense")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("t,tk", [(4, 16), (1, 16), (40, 72)])
+def test_flash_attention_causal_cross_length_end_aligned(t, tk):
+    # Decode-style tq < tk: causal must be END-aligned (the last query row
+    # sees every key), matching the dense path's tril(k=tk-tq).
+    q, k, v = _qkv(t=t, tk=tk)
+    out = flash_attention(q, k, v, causal=True, block_q=8, block_k=8)
+    ref = dot_product_attention(q, k, v, causal=True, impl="dense")
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
 
@@ -48,7 +59,8 @@ def test_flash_attention_grads_match_dense(causal):
                                        block_q=16, block_k=16) ** 2)
 
     def loss_dense(q, k, v):
-        return jnp.sum(dot_product_attention(q, k, v, causal=causal) ** 2)
+        return jnp.sum(dot_product_attention(q, k, v, causal=causal,
+                                             impl="dense") ** 2)
 
     gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)

@@ -1,0 +1,115 @@
+"""Loader for the C++ native runtime (``native/zoo_native.cc``).
+
+Compiles the shared library on first use with the in-image g++ (no
+pybind11 — plain C ABI + ctypes, as the environment prescribes), caching
+the .so under ``build/`` keyed by a source hash. Everything that uses it
+(``zoo_tpu.orca.data.tfrecord``, ``zoo_tpu.orca.data.cache``) carries a
+pure-Python fallback, so :func:`load` returning ``None`` degrades
+gracefully rather than failing.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+logger = logging.getLogger("zoo_tpu.native")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "zoo_native.cc")
+_BUILD_DIR = os.path.join(_REPO_ROOT, "build")
+
+_lib = None
+_lib_tried = False
+
+
+def _annotate(lib: ctypes.CDLL) -> ctypes.CDLL:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.zoo_crc32c.restype = ctypes.c_uint32
+    lib.zoo_crc32c.argtypes = [u8p, ctypes.c_uint64]
+    lib.zoo_tfr_reader_open.restype = ctypes.c_void_p
+    lib.zoo_tfr_reader_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.zoo_tfr_reader_next.restype = ctypes.c_int64
+    lib.zoo_tfr_reader_next.argtypes = [ctypes.c_void_p,
+                                        ctypes.POINTER(u8p)]
+    lib.zoo_tfr_reader_close.restype = None
+    lib.zoo_tfr_reader_close.argtypes = [ctypes.c_void_p]
+    lib.zoo_tfr_writer_open.restype = ctypes.c_void_p
+    lib.zoo_tfr_writer_open.argtypes = [ctypes.c_char_p]
+    lib.zoo_tfr_writer_write.restype = ctypes.c_int
+    lib.zoo_tfr_writer_write.argtypes = [ctypes.c_void_p, u8p,
+                                         ctypes.c_uint64]
+    lib.zoo_tfr_writer_close.restype = ctypes.c_int
+    lib.zoo_tfr_writer_close.argtypes = [ctypes.c_void_p]
+    lib.zoo_cache_create.restype = ctypes.c_void_p
+    lib.zoo_cache_create.argtypes = [ctypes.c_int64, ctypes.c_char_p]
+    lib.zoo_cache_put.restype = ctypes.c_int64
+    lib.zoo_cache_put.argtypes = [ctypes.c_void_p, u8p, ctypes.c_uint64]
+    lib.zoo_cache_len.restype = ctypes.c_int64
+    lib.zoo_cache_len.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.zoo_cache_get.restype = ctypes.c_int64
+    lib.zoo_cache_get.argtypes = [ctypes.c_void_p, ctypes.c_int64, u8p,
+                                  ctypes.c_uint64]
+    lib.zoo_cache_count.restype = ctypes.c_int64
+    lib.zoo_cache_count.argtypes = [ctypes.c_void_p]
+    lib.zoo_cache_dram_used.restype = ctypes.c_int64
+    lib.zoo_cache_dram_used.argtypes = [ctypes.c_void_p]
+    lib.zoo_cache_destroy.restype = None
+    lib.zoo_cache_destroy.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def _compile(src: str, out: str) -> bool:
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    # Exclusive-create a temp .so then rename: concurrent test workers
+    # race to build (same idea as the reference's per-node filelock around
+    # `ray start`, raycontext.py:289-303).
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(out))
+    os.close(fd)
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp, src]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, out)
+        return True
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+            OSError) as e:
+        logger.warning("native build failed (%s); using Python fallbacks", e)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Return the native library, building it if needed; None on failure."""
+    global _lib, _lib_tried
+    if _lib is not None or _lib_tried:
+        return _lib
+    _lib_tried = True
+    if os.environ.get("ZOO_TPU_DISABLE_NATIVE"):
+        return None
+    try:
+        with open(_SRC, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    except OSError:
+        return None
+    so = os.path.join(_BUILD_DIR, f"zoo_native_{digest}.so")
+    if not os.path.exists(so) and not _compile(_SRC, so):
+        return None
+    try:
+        _lib = _annotate(ctypes.CDLL(so))
+    except OSError as e:
+        logger.warning("native load failed (%s); using Python fallbacks", e)
+        _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None

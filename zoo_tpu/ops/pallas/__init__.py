@@ -22,6 +22,10 @@ exercises the same code path CI-side.
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
+
+LANES = 128       # VPU lane width; minor dim of every scratch carrier
+SUBLANES = 8      # f32 sublane count
 
 
 def on_tpu() -> bool:
@@ -33,6 +37,16 @@ def resolve_interpret(interpret) -> bool:
     if interpret is None:
         return not on_tpu()
     return bool(interpret)
+
+
+def pad_dim(x, axis: int, mult: int):
+    """Zero-pad ``axis`` of ``x`` up to a multiple of ``mult``."""
+    rem = (-x.shape[axis]) % mult
+    if rem == 0:
+        return x
+    pads = [(0, 0)] * x.ndim
+    pads[axis] = (0, rem)
+    return jnp.pad(x, pads)
 
 
 from zoo_tpu.ops.pallas.flash_attention import flash_attention  # noqa: E402

@@ -25,28 +25,19 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from zoo_tpu.ops.pallas import LANES as _LANES
+from zoo_tpu.ops.pallas import pad_dim as _pad_to
 from zoo_tpu.ops.pallas import resolve_interpret as _resolve_interpret
-
-_LANES = 128  # VPU lane count; scratch minor dim
-
-
-def _pad_to(x, axis, mult):
-    size = x.shape[axis]
-    rem = (-size) % mult
-    if rem == 0:
-        return x
-    pads = [(0, 0)] * x.ndim
-    pads[axis] = (0, rem)
-    return jnp.pad(x, pads)
 
 
 # ---------------------------------------------------------------- forward
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, scale, causal, kv_len,
+                m_scr, l_scr, acc_scr, *, scale, causal, kv_len, q_len,
                 block_q, block_k, num_k):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
+    off = kv_len - q_len  # end-aligned causal (matches the dense path)
 
     @pl.when(ki == 0)
     def _init():
@@ -58,7 +49,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     # skip (the grid still steps but no MXU work is issued).
     run = True
     if causal:
-        run = ki * block_k <= qi * block_q + block_q - 1
+        run = ki * block_k <= qi * block_q + block_q - 1 + off
 
     @pl.when(run)
     def _step():
@@ -75,7 +66,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         if causal:
             row = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 0)
-            mask = jnp.logical_and(mask, col <= row)
+            mask = jnp.logical_and(mask, col <= row + off)
         s = jnp.where(mask, s, -jnp.inf)
 
         m_prev = m_scr[:, :1]                      # (bq, 1)
@@ -104,7 +95,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
-def _fwd(q, k, v, scale, causal, kv_len, block_q, block_k, interpret):
+def _fwd(q, k, v, scale, causal, kv_len, q_len, block_q, block_k,
+         interpret):
     bh, tq, d = q.shape
     tk = k.shape[1]
     num_q = pl.cdiv(tq, block_q)
@@ -112,7 +104,7 @@ def _fwd(q, k, v, scale, causal, kv_len, block_q, block_k, interpret):
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, kv_len=kv_len,
-        block_q=block_q, block_k=block_k, num_k=num_k)
+        q_len=q_len, block_q=block_q, block_k=block_k, num_k=num_k)
     grid = (bh, num_q, num_k)
     o, lse = pl.pallas_call(
         kernel,
@@ -145,9 +137,10 @@ def _fwd(q, k, v, scale, causal, kv_len, block_q, block_k, interpret):
 
 def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                  dk_ref, dv_ref, dk_scr, dv_scr, *,
-                 scale, causal, kv_len, block_q, block_k, num_q):
+                 scale, causal, kv_len, q_len, block_q, block_k, num_q):
     ki = pl.program_id(1)
     qi = pl.program_id(2)
+    off = kv_len - q_len
 
     @pl.when(qi == 0)
     def _init():
@@ -157,7 +150,7 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     run = True
     if causal:
         # q-block entirely before k-block → p == 0 there, skip.
-        run = qi * block_q + block_q - 1 >= ki * block_k
+        run = qi * block_q + block_q - 1 >= ki * block_k - off
 
     @pl.when(run)
     def _step():
@@ -176,7 +169,7 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             row = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 0)
-            mask = jnp.logical_and(mask, col <= row)
+            mask = jnp.logical_and(mask, col <= row + off)
         safe_lse = jnp.where(jnp.isfinite(lse), lse, 0.0)
         p = jnp.where(mask, jnp.exp(s - safe_lse), 0.0)
         p = jnp.where(jnp.isfinite(lse), p, 0.0)
@@ -199,10 +192,11 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-               dq_ref, dq_scr, *, scale, causal, kv_len,
+               dq_ref, dq_scr, *, scale, causal, kv_len, q_len,
                block_q, block_k, num_k):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
+    off = kv_len - q_len
 
     @pl.when(ki == 0)
     def _init():
@@ -210,7 +204,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     run = True
     if causal:
-        run = ki * block_k <= qi * block_q + block_q - 1
+        run = ki * block_k <= qi * block_q + block_q - 1 + off
 
     @pl.when(run)
     def _step():
@@ -229,7 +223,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             row = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 0)
-            mask = jnp.logical_and(mask, col <= row)
+            mask = jnp.logical_and(mask, col <= row + off)
         safe_lse = jnp.where(jnp.isfinite(lse), lse, 0.0)
         p = jnp.where(mask, jnp.exp(s - safe_lse), 0.0)
         p = jnp.where(jnp.isfinite(lse), p, 0.0)
@@ -246,7 +240,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _bwd(scale, causal, kv_len, block_q, block_k, interpret, res, g):
+def _bwd(scale, causal, kv_len, q_len, block_q, block_k, interpret,
+         res, g):
     q, k, v, o, lse = res
     bh, tq, d = q.shape
     tk = k.shape[1]
@@ -267,7 +262,7 @@ def _bwd(scale, causal, kv_len, block_q, block_k, interpret, res, g):
 
     dkdv = functools.partial(
         _dkdv_kernel, scale=scale, causal=causal, kv_len=kv_len,
-        block_q=block_q, block_k=block_k, num_q=num_q)
+        q_len=q_len, block_q=block_q, block_k=block_k, num_q=num_q)
     dk, dv = pl.pallas_call(
         dkdv,
         grid=(bh, num_k, num_q),
@@ -296,7 +291,7 @@ def _bwd(scale, causal, kv_len, block_q, block_k, interpret, res, g):
 
     dqk = functools.partial(
         _dq_kernel, scale=scale, causal=causal, kv_len=kv_len,
-        block_q=block_q, block_k=block_k, num_k=num_k)
+        q_len=q_len, block_q=block_q, block_k=block_k, num_k=num_k)
     dq = pl.pallas_call(
         dqk,
         grid=(bh, num_q, num_k),
@@ -319,20 +314,25 @@ def _bwd(scale, causal, kv_len, block_q, block_k, interpret, res, g):
 
 # -------------------------------------------------------------- public op
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, scale, causal, kv_len, block_q, block_k, interpret):
-    o, _ = _fwd(q, k, v, scale, causal, kv_len, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, scale, causal, kv_len, q_len, block_q, block_k,
+           interpret):
+    o, _ = _fwd(q, k, v, scale, causal, kv_len, q_len, block_q, block_k,
+                interpret)
     return o
 
 
-def _flash_fwd(q, k, v, scale, causal, kv_len, block_q, block_k, interpret):
-    o, lse = _fwd(q, k, v, scale, causal, kv_len, block_q, block_k,
+def _flash_fwd(q, k, v, scale, causal, kv_len, q_len, block_q, block_k,
+               interpret):
+    o, lse = _fwd(q, k, v, scale, causal, kv_len, q_len, block_q, block_k,
                   interpret)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(scale, causal, kv_len, block_q, block_k, interpret, res, g):
-    return _bwd(scale, causal, kv_len, block_q, block_k, interpret, res, g)
+def _flash_bwd(scale, causal, kv_len, q_len, block_q, block_k, interpret,
+               res, g):
+    return _bwd(scale, causal, kv_len, q_len, block_q, block_k, interpret,
+                res, g)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -359,6 +359,6 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     qf = _pad_to(q.reshape(b * h, tq, d), 1, block_q)
     kf = _pad_to(k.reshape(b * h, tk, d), 1, block_k)
     vf = _pad_to(v.reshape(b * h, tk, d), 1, block_k)
-    o = _flash(qf, kf, vf, float(scale), bool(causal), int(tk),
+    o = _flash(qf, kf, vf, float(scale), bool(causal), int(tk), int(tq),
                int(block_q), int(block_k), interpret)
     return o[:, :tq].reshape(b, h, tq, d)

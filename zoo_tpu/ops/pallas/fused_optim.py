@@ -24,9 +24,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from zoo_tpu.ops.pallas import LANES as _LANES
 from zoo_tpu.ops.pallas import resolve_interpret as _resolve_interpret
 
-_LANES = 128
 _BLOCK_ROWS = 256
 
 

@@ -20,19 +20,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from zoo_tpu.ops.pallas import LANES as _LANES
+from zoo_tpu.ops.pallas import SUBLANES as _SUBLANES
+from zoo_tpu.ops.pallas import pad_dim as _pad_dim
 from zoo_tpu.ops.pallas import resolve_interpret as _resolve_interpret
-
-_LANES = 128
-_SUBLANES = 8
-
-
-def _pad_dim(x, axis, mult):
-    rem = (-x.shape[axis]) % mult
-    if rem == 0:
-        return x
-    pads = [(0, 0)] * x.ndim
-    pads[axis] = (0, rem)
-    return jnp.pad(x, pads)
 
 
 def quantize_int8(x: jnp.ndarray, axis: int = -1):

@@ -91,8 +91,15 @@ class AutoEstimator:
                 res = model.evaluate(ex, ey, batch_size=bs)
             if metric not in res:
                 # res["loss"] may stand in for the metric only when the
-                # compiled loss really is that metric.
-                loss_name = (getattr(model, "loss_name", None) or "").lower()
+                # compiled loss really is that metric. (For the torch path
+                # the name lives on the inner KerasNet / the torch loss.)
+                loss_name = (getattr(model, "loss_name", None)
+                             or getattr(getattr(model, "model", None),
+                                        "loss_name", None)
+                             or type(getattr(model, "loss", None)
+                                     ).__name__ or "").lower()
+                torch_aliases = {"mseloss": "mse", "l1loss": "mae"}
+                loss_name = torch_aliases.get(loss_name, loss_name)
                 aliases = {"mse": {"mse", "mean_squared_error"},
                            "mae": {"mae", "mean_absolute_error"}}
                 wanted = aliases.get(metric.lower(), {metric.lower()})

@@ -1,0 +1,237 @@
+"""Tiered training-data cache + double-buffered device feeder.
+
+Rebuild of the reference's FeatureSet memory tiers (SURVEY §2 #20): the
+JVM FeatureSet caches training samples in DRAM, Optane PMEM, off-heap
+DIRECT buffers, or disk (``feature/FeatureSet.scala:52-233``, tier picked
+by ``OrcaContext.train_data_store``, ``orca/common.py:86-103``). TPU VMs
+have no PMEM, so the beyond-DRAM tier is a local-SSD spill file managed by
+the C++ buffer manager in ``native/zoo_native.cc`` (pure-Python dict/file
+fallback when the toolchain is absent).
+
+``DoubleBufferedIterator`` is the host→device leg: a background thread
+stages batch i+1 (cache read + unpickle + ``jax.device_put``) while the
+step function runs batch i — the reference gets the same overlap from
+Spark's prefetching iterators feeding BigDL's per-executor miniBatch
+queues.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import queue
+import tempfile
+import threading
+from typing import Any, Iterable, Iterator, Optional
+
+from zoo_tpu import native as _native
+from zoo_tpu.common.context import ZooContext
+
+
+def _dram_budget_for(store: str, total_hint: Optional[int]) -> int:
+    """Map the reference's tier string to a DRAM byte budget: DRAM → no
+    limit; DISK_n → dataset is ~n× DRAM capacity, i.e. keep 1/n of the
+    bytes resident (the reference uses n the same way for PMEM sizing)."""
+    store = store.upper()
+    if store == "DRAM":
+        return -1
+    if store.startswith("DISK"):
+        try:
+            n = int(store.split("_", 1)[1])
+        except (IndexError, ValueError):
+            n = 2
+        if total_hint:
+            return max(1, total_hint // max(n, 1))
+        return 512 * 1024 * 1024 // max(n, 1)
+    raise ValueError(f"unknown train_data_store {store!r}")
+
+
+class TieredSampleCache:
+    """Append-only blob cache with DRAM budget + disk spill.
+
+    ``put`` pickles an arbitrary sample/batch; ``get`` returns it.
+    Backed by the native C++ cache when available.
+    """
+
+    def __init__(self, store: Optional[str] = None,
+                 dram_budget: Optional[int] = None,
+                 spill_dir: Optional[str] = None,
+                 total_bytes_hint: Optional[int] = None):
+        store = store or ZooContext.train_data_store
+        self._budget = (dram_budget if dram_budget is not None
+                        else _dram_budget_for(store, total_bytes_hint))
+        self._spill_dir = spill_dir or tempfile.gettempdir()
+        self._spill_path = os.path.join(
+            self._spill_dir, f"zoo_cache_{os.getpid()}_{id(self):x}.bin")
+        self._lib = _native.load()
+        self._lock = threading.Lock()
+        if self._lib is not None:
+            self._h = self._lib.zoo_cache_create(self._budget,
+                                                 self._spill_path.encode())
+        else:  # pure-Python tiers
+            self._h = None
+            self._ram: dict = {}
+            self._disk_index: dict = {}
+            self._dram_used = 0
+            self._spill_f = None
+
+    # -- core --------------------------------------------------------------
+    def put(self, obj: Any) -> int:
+        blob = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        if self._h is not None:
+            buf = (ctypes.c_uint8 * len(blob)).from_buffer_copy(blob)
+            idx = self._lib.zoo_cache_put(self._h, buf, len(blob))
+            if idx < 0:
+                raise IOError("cache put failed (spill tier unavailable?)")
+            return int(idx)
+        with self._lock:
+            idx = len(self._ram) + len(self._disk_index)
+            fits = self._budget < 0 or \
+                self._dram_used + len(blob) <= self._budget
+            if fits:
+                self._ram[idx] = blob
+                self._dram_used += len(blob)
+            else:
+                if self._spill_f is None:
+                    self._spill_f = open(self._spill_path, "w+b")
+                self._spill_f.seek(0, os.SEEK_END)
+                off = self._spill_f.tell()
+                self._spill_f.write(blob)
+                self._disk_index[idx] = (off, len(blob))
+            return idx
+
+    def get(self, idx: int) -> Any:
+        if self._h is not None:
+            n = self._lib.zoo_cache_len(self._h, idx)
+            if n < 0:
+                raise IndexError(idx)
+            buf = (ctypes.c_uint8 * n)()
+            got = self._lib.zoo_cache_get(self._h, idx, buf, n)
+            if got != n:
+                raise IOError(f"cache get failed for {idx}")
+            return pickle.loads(bytes(buf))
+        with self._lock:
+            if idx in self._ram:
+                return pickle.loads(self._ram[idx])
+            if idx in self._disk_index:
+                off, n = self._disk_index[idx]
+                self._spill_f.seek(off)
+                return pickle.loads(self._spill_f.read(n))
+        raise IndexError(idx)
+
+    def __len__(self) -> int:
+        if self._h is not None:
+            return int(self._lib.zoo_cache_count(self._h))
+        with self._lock:
+            return len(self._ram) + len(self._disk_index)
+
+    def dram_used(self) -> int:
+        if self._h is not None:
+            return int(self._lib.zoo_cache_dram_used(self._h))
+        with self._lock:
+            return self._dram_used
+
+    def __iter__(self) -> Iterator[Any]:
+        for i in range(len(self)):
+            yield self.get(i)
+
+    def close(self):
+        if self._h is not None:
+            self._lib.zoo_cache_destroy(self._h)
+            self._h = None
+            self._lib = None
+        elif getattr(self, "_spill_f", None) is not None:
+            self._spill_f.close()
+            try:
+                os.unlink(self._spill_path)
+            except OSError:
+                pass
+            self._spill_f = None
+
+    def __del__(self):  # best-effort; close() is the real API
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class CachedDataset:
+    """Cache an iterable of batches once, then replay epochs from the
+    tiered store (the FeatureSet.cache() usage pattern)."""
+
+    def __init__(self, batches: Iterable[Any], **cache_kwargs):
+        self._cache = TieredSampleCache(**cache_kwargs)
+        for b in batches:
+            self._cache.put(b)
+
+    def __len__(self):
+        return len(self._cache)
+
+    def __iter__(self):
+        return iter(self._cache)
+
+    def close(self):
+        self._cache.close()
+
+
+class DoubleBufferedIterator:
+    """Wrap an iterator; a daemon thread keeps ``depth`` items staged
+    ahead (optionally through ``stage_fn``, e.g. ``jax.device_put``)."""
+
+    _END = object()
+
+    def __init__(self, it: Iterable[Any], stage_fn=None, depth: int = 2):
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._err: Optional[BaseException] = None
+        self._stop = threading.Event()
+
+        def run():
+            try:
+                for item in it:
+                    staged = stage_fn(item) if stage_fn else item
+                    # bounded put that aborts when the consumer closed us,
+                    # so an early-exiting consumer cannot strand the
+                    # producer (and its device-resident batch) forever
+                    while not self._stop.is_set():
+                        try:
+                            self._q.put(staged, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if self._stop.is_set():
+                        return
+            except BaseException as e:  # propagate into consumer
+                self._err = e
+            finally:
+                # END must arrive or the consumer blocks forever; bounded
+                # retry so close() can still release us.
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(self._END, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+
+        self._t = threading.Thread(target=run, daemon=True)
+        self._t.start()
+
+    def close(self):
+        """Stop the producer and drop staged items."""
+        self._stop.set()
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is self._END:
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item

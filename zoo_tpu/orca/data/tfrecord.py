@@ -1,0 +1,182 @@
+"""TFRecord reader/writer (component #43 / SURVEY §2.9(7)).
+
+The reference reads TFRecord through the ``tensorflow-hadoop`` Hadoop
+InputFormat on Spark executors (``tf_dataset.py:484`` from_tfrecord_file,
+``zoo/pom.xml:458``). Here the hot path is the C++ reader in
+``native/zoo_native.cc`` (CRC32C-checked streaming, loaded via ctypes),
+with a pure-Python fallback (struct + table CRC32C) when the toolchain is
+unavailable. Sharded file sets map to XShards partitions.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import glob as _glob
+import struct
+from typing import Callable, Iterable, Iterator, List, Optional
+
+from zoo_tpu import native as _native
+
+# ------------------------------------------------------- python crc32c
+
+_PY_TABLE = None
+
+
+def _py_crc32c_table():
+    global _PY_TABLE
+    if _PY_TABLE is None:
+        tbl = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (0x82F63B78 ^ (c >> 1)) if (c & 1) else (c >> 1)
+            tbl.append(c)
+        _PY_TABLE = tbl
+    return _PY_TABLE
+
+
+def crc32c(data: bytes) -> int:
+    lib = _native.load()
+    if lib is not None:
+        buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+        return lib.zoo_crc32c(buf, len(data))
+    tbl = _py_crc32c_table()
+    c = 0xFFFFFFFF
+    for b in data:
+        c = tbl[(c ^ b) & 0xFF] ^ (c >> 8)
+    return c ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+class TFRecordCorruptError(IOError):
+    pass
+
+
+# --------------------------------------------------------------- reader
+
+def _iter_native(path: str, check_crc: bool) -> Iterator[bytes]:
+    lib = _native.load()
+    h = lib.zoo_tfr_reader_open(path.encode(), 1 if check_crc else 0)
+    if not h:
+        raise FileNotFoundError(path)
+    try:
+        ptr = ctypes.POINTER(ctypes.c_uint8)()
+        while True:
+            n = lib.zoo_tfr_reader_next(h, ctypes.byref(ptr))
+            if n == -1:
+                return
+            if n == -2:
+                raise TFRecordCorruptError(path)
+            yield ctypes.string_at(ptr, n)
+    finally:
+        lib.zoo_tfr_reader_close(h)
+
+
+def _iter_python(path: str, check_crc: bool) -> Iterator[bytes]:
+    with open(path, "rb") as f:
+        while True:
+            hdr = f.read(12)
+            if not hdr:
+                return
+            if len(hdr) != 12:
+                raise TFRecordCorruptError(path)
+            (length,) = struct.unpack("<Q", hdr[:8])
+            (len_crc,) = struct.unpack("<I", hdr[8:])
+            if check_crc and _masked_crc(hdr[:8]) != len_crc:
+                raise TFRecordCorruptError(path)
+            payload = f.read(length + 4)
+            if len(payload) != length + 4:
+                raise TFRecordCorruptError(path)
+            data, (data_crc,) = payload[:-4], struct.unpack(
+                "<I", payload[-4:])
+            if check_crc and _masked_crc(data) != data_crc:
+                raise TFRecordCorruptError(path)
+            yield data
+
+
+def tfrecord_iterator(path: str, check_crc: bool = True) -> Iterator[bytes]:
+    """Stream raw records from one TFRecord file."""
+    if _native.available():
+        return _iter_native(path, check_crc)
+    return _iter_python(path, check_crc)
+
+
+def read_tfrecord(paths, parse_fn: Optional[Callable[[bytes], object]] = None,
+                  check_crc: bool = True) -> List[object]:
+    """Read records from a file, glob, or list of files."""
+    if isinstance(paths, str):
+        matched = sorted(_glob.glob(paths)) or [paths]
+    else:
+        matched = list(paths)
+    out: List[object] = []
+    for p in matched:
+        for rec in tfrecord_iterator(p, check_crc):
+            out.append(parse_fn(rec) if parse_fn else rec)
+    return out
+
+
+def read_tfrecord_shards(paths, parse_fn=None, check_crc: bool = True):
+    """One XShards partition per file — the TPU analog of the reference's
+    one-Hadoop-split-per-task TFRecord read."""
+    from zoo_tpu.orca.data.shard import LocalXShards
+
+    if isinstance(paths, str):
+        matched = sorted(_glob.glob(paths)) or [paths]
+    else:
+        matched = list(paths)
+    parts = [read_tfrecord(p, parse_fn, check_crc) for p in matched]
+    return LocalXShards(parts)
+
+
+# --------------------------------------------------------------- writer
+
+class TFRecordWriter:
+    """Append records to a TFRecord file (context manager)."""
+
+    def __init__(self, path: str):
+        self._path = path
+        self._lib = _native.load()
+        if self._lib is not None:
+            self._h = self._lib.zoo_tfr_writer_open(path.encode())
+            if not self._h:
+                raise IOError(f"cannot open {path}")
+            self._f = None
+        else:
+            self._h = None
+            self._f = open(path, "wb")
+
+    def write(self, record: bytes):
+        if self._h is not None:
+            buf = (ctypes.c_uint8 * len(record)).from_buffer_copy(record)
+            if self._lib.zoo_tfr_writer_write(self._h, buf, len(record)):
+                raise IOError(f"write failed: {self._path}")
+        else:
+            hdr = struct.pack("<Q", len(record))
+            self._f.write(hdr)
+            self._f.write(struct.pack("<I", _masked_crc(hdr)))
+            self._f.write(record)
+            self._f.write(struct.pack("<I", _masked_crc(record)))
+
+    def close(self):
+        if self._h is not None:
+            self._lib.zoo_tfr_writer_close(self._h)
+            self._h = None
+        elif self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def write_tfrecord(path: str, records: Iterable[bytes]):
+    with TFRecordWriter(path) as w:
+        for r in records:
+            w.write(r)

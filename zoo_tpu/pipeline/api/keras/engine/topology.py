@@ -273,16 +273,26 @@ class KerasNet:
                 feature_cols, label_cols)
             val_arrays = (self._adapt_inputs(val_arrays[0]), val_arrays[1])
         history: Dict[str, List[float]] = {"loss": []}
+        from zoo_tpu.orca.data.cache import DoubleBufferedIterator
         for epoch in range(nb_epoch):
             t0 = time.time()
             losses = []
-            for idx in data_utils.batch_slices(n, batch_size, shuffle, nprng):
-                batch = self._put_batch([a[idx] for a in xs] + [ys[idx]])
-                rng, step_rng = jax.random.split(rng)
-                params, opt_state, loss = self._jit_train(
-                    params, opt_state, step_rng, *batch)
-                self._step += 1
-                losses.append(loss)
+            # Host→device staging (slice + device_put) overlaps the jitted
+            # step via a prefetch thread — the reference gets the same
+            # overlap from Spark's prefetching FeatureSet iterators.
+            batches = DoubleBufferedIterator(
+                data_utils.batch_slices(n, batch_size, shuffle, nprng),
+                stage_fn=lambda idx: self._put_batch(
+                    [a[idx] for a in xs] + [ys[idx]]))
+            try:
+                for batch in batches:
+                    rng, step_rng = jax.random.split(rng)
+                    params, opt_state, loss = self._jit_train(
+                        params, opt_state, step_rng, *batch)
+                    self._step += 1
+                    losses.append(loss)
+            finally:
+                batches.close()
             epoch_loss = float(np.mean([float(l) for l in losses]))
             history["loss"].append(epoch_loss)
             self.train_summary.add_scalar("Loss", epoch_loss, self._step)

@@ -1,0 +1,460 @@
+"""Distributed image pipeline (SURVEY §2 #21).
+
+Rebuild of ``ImageSet`` / ``ImagePreprocessing``
+(``feature/image/ImageSet.scala``, Python mirrors
+``pyzoo/zoo/feature/image/imageset.py:21`` and
+``imagePreprocessing.py:25-375``). The reference wraps BigDL's OpenCV
+transformers running in Spark tasks; here transformers are cv2/numpy
+callables over HWC uint8/float32 arrays (BGR, OpenCV's order — kept for
+behavioral parity), fanned out over XShards workers by
+``DistributedImageSet``. ``ImageSetToSample`` + ``to_arrays`` produce the
+CHW float tensors the keras facade/Estimators consume (TPU note: conv
+layers transpose to NHWC internally; CHW here is the reference's contract,
+conversion is one cheap transpose at batch assembly).
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+import random
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from zoo_tpu.feature.common import Preprocessing
+
+try:
+    import cv2
+except ImportError:  # pragma: no cover - cv2 is in the image
+    cv2 = None
+
+
+class ImageFeature(dict):
+    """Mutable record flowing through the pipeline (reference:
+    ``ImageFeature``): keys ``image`` (HWC ndarray), ``label``, ``uri``,
+    plus whatever transformers attach (e.g. ``sample``, ``predict``)."""
+
+    def __init__(self, image=None, label=None, uri: Optional[str] = None):
+        super().__init__()
+        if image is not None:
+            self["image"] = image
+        if label is not None:
+            self["label"] = label
+        if uri is not None:
+            self["uri"] = uri
+
+
+class ImagePreprocessing(Preprocessing):
+    """Base: transforms ``ImageFeature`` in place via :meth:`map_image`."""
+
+    def map_image(self, img: np.ndarray) -> np.ndarray:
+        return img
+
+    def __call__(self, feature: ImageFeature) -> ImageFeature:
+        feature["image"] = self.map_image(feature["image"])
+        return feature
+
+
+# ---------------------------------------------------------- transformers
+
+class ImageBytesToMat(ImagePreprocessing):
+    """Decode raw encoded bytes (jpg/png) to an HWC BGR mat (reference:
+    ``imagePreprocessing.py:33``)."""
+
+    def __call__(self, feature: ImageFeature) -> ImageFeature:
+        buf = np.frombuffer(feature["bytes"], dtype=np.uint8)
+        feature["image"] = cv2.imdecode(buf, cv2.IMREAD_COLOR)
+        return feature
+
+
+class ImageResize(ImagePreprocessing):
+    """reference: ``imagePreprocessing.py:53``."""
+
+    def __init__(self, resize_h: int, resize_w: int):
+        self.h, self.w = resize_h, resize_w
+
+    def map_image(self, img):
+        return cv2.resize(img, (self.w, self.h))
+
+
+class ImageAspectScale(ImagePreprocessing):
+    """Scale the short side to ``min_size`` capping the long side at
+    ``max_size`` (reference: ``imagePreprocessing.py:211``)."""
+
+    def __init__(self, min_size: int, max_size: int = 1000,
+                 scale_multiple_of: int = 1):
+        self.min_size, self.max_size = min_size, max_size
+        self.mult = scale_multiple_of
+
+    def map_image(self, img):
+        h, w = img.shape[:2]
+        short, long_ = min(h, w), max(h, w)
+        scale = min(self.min_size / short, self.max_size / long_)
+        nh, nw = int(round(h * scale)), int(round(w * scale))
+        if self.mult > 1:
+            nh = (nh // self.mult) * self.mult
+            nw = (nw // self.mult) * self.mult
+        return cv2.resize(img, (max(nw, 1), max(nh, 1)))
+
+
+class ImageRandomAspectScale(ImageAspectScale):
+    """reference: ``imagePreprocessing.py:232`` — min_size drawn from a
+    list of scales per image."""
+
+    def __init__(self, scales: Sequence[int], max_size: int = 1000):
+        super().__init__(min_size=scales[0], max_size=max_size)
+        self.scales = list(scales)
+
+    def map_image(self, img):
+        self.min_size = random.choice(self.scales)
+        return super().map_image(img)
+
+
+class ImageBrightness(ImagePreprocessing):
+    """Add a uniform delta in [delta_low, delta_high] (reference:
+    ``imagePreprocessing.py:71``)."""
+
+    def __init__(self, delta_low: float, delta_high: float):
+        self.low, self.high = delta_low, delta_high
+
+    def map_image(self, img):
+        delta = random.uniform(self.low, self.high)
+        return np.clip(img.astype(np.float32) + delta, 0, 255)
+
+
+class ImageHue(ImagePreprocessing):
+    """reference: ``imagePreprocessing.py:145``."""
+
+    def __init__(self, delta_low: float = -18.0, delta_high: float = 18.0):
+        self.low, self.high = delta_low, delta_high
+
+    def map_image(self, img):
+        hsv = cv2.cvtColor(img.astype(np.uint8), cv2.COLOR_BGR2HSV).astype(
+            np.float32)
+        hsv[..., 0] = (hsv[..., 0] +
+                       random.uniform(self.low, self.high) / 2.0) % 180
+        return cv2.cvtColor(hsv.astype(np.uint8), cv2.COLOR_HSV2BGR)
+
+
+class ImageSaturation(ImagePreprocessing):
+    """reference: ``imagePreprocessing.py:155``."""
+
+    def __init__(self, delta_low: float = 0.5, delta_high: float = 1.5):
+        self.low, self.high = delta_low, delta_high
+
+    def map_image(self, img):
+        hsv = cv2.cvtColor(img.astype(np.uint8), cv2.COLOR_BGR2HSV).astype(
+            np.float32)
+        hsv[..., 1] = np.clip(
+            hsv[..., 1] * random.uniform(self.low, self.high), 0, 255)
+        return cv2.cvtColor(hsv.astype(np.uint8), cv2.COLOR_HSV2BGR)
+
+
+class ImageChannelOrder(ImagePreprocessing):
+    """BGR↔RGB flip (reference: ``imagePreprocessing.py:165``)."""
+
+    def map_image(self, img):
+        return img[..., ::-1].copy()
+
+
+class ImageColorJitter(ImagePreprocessing):
+    """Random brightness/saturation/hue in random order (reference:
+    ``imagePreprocessing.py:173``)."""
+
+    def __init__(self, brightness_prob=0.5, brightness_delta=32.0,
+                 saturation_prob=0.5, saturation_lower=0.5,
+                 saturation_upper=1.5, hue_prob=0.5, hue_delta=18.0):
+        self.ops = [
+            (brightness_prob, ImageBrightness(-brightness_delta,
+                                              brightness_delta)),
+            (saturation_prob, ImageSaturation(saturation_lower,
+                                              saturation_upper)),
+            (hue_prob, ImageHue(-hue_delta, hue_delta)),
+        ]
+
+    def map_image(self, img):
+        ops = list(self.ops)
+        random.shuffle(ops)
+        for prob, op in ops:
+            if random.random() < prob:
+                img = op.map_image(img.astype(np.uint8))
+        return img
+
+
+class ImageChannelNormalize(ImagePreprocessing):
+    """(x - mean) / std per channel, BGR order (reference:
+    ``imagePreprocessing.py:81``)."""
+
+    def __init__(self, mean_b: float, mean_g: float, mean_r: float,
+                 std_b: float = 1.0, std_g: float = 1.0, std_r: float = 1.0):
+        self.mean = np.array([mean_b, mean_g, mean_r], np.float32)
+        self.std = np.array([std_b, std_g, std_r], np.float32)
+
+    def map_image(self, img):
+        return (img.astype(np.float32) - self.mean) / self.std
+
+
+class PerImageNormalize(ImagePreprocessing):
+    """(x - min) / (max - min) per image (reference:
+    ``imagePreprocessing.py:98``)."""
+
+    def map_image(self, img):
+        img = img.astype(np.float32)
+        lo, hi = img.min(), img.max()
+        return (img - lo) / max(hi - lo, 1e-8)
+
+
+class ImagePixelNormalize(ImagePreprocessing):
+    """Subtract a per-pixel mean array (reference:
+    ``imagePreprocessing.py:244``)."""
+
+    def __init__(self, means: np.ndarray):
+        self.means = np.asarray(means, np.float32)
+
+    def map_image(self, img):
+        return img.astype(np.float32) - self.means.reshape(img.shape)
+
+
+class ImageCenterCrop(ImagePreprocessing):
+    """reference: ``imagePreprocessing.py:270``."""
+
+    def __init__(self, crop_width: int, crop_height: int):
+        self.w, self.h = crop_width, crop_height
+
+    def map_image(self, img):
+        h, w = img.shape[:2]
+        y0 = max((h - self.h) // 2, 0)
+        x0 = max((w - self.w) // 2, 0)
+        return img[y0:y0 + self.h, x0:x0 + self.w]
+
+
+class ImageRandomCrop(ImagePreprocessing):
+    """reference: ``imagePreprocessing.py:255``."""
+
+    def __init__(self, crop_width: int, crop_height: int):
+        self.w, self.h = crop_width, crop_height
+
+    def map_image(self, img):
+        h, w = img.shape[:2]
+        y0 = random.randint(0, max(h - self.h, 0))
+        x0 = random.randint(0, max(w - self.w, 0))
+        return img[y0:y0 + self.h, x0:x0 + self.w]
+
+
+class ImageFixedCrop(ImagePreprocessing):
+    """Crop by explicit box; normalized coords when ``normalized``
+    (reference: ``imagePreprocessing.py:284``)."""
+
+    def __init__(self, x1: float, y1: float, x2: float, y2: float,
+                 normalized: bool = True):
+        self.box = (x1, y1, x2, y2)
+        self.normalized = normalized
+
+    def map_image(self, img):
+        h, w = img.shape[:2]
+        x1, y1, x2, y2 = self.box
+        if self.normalized:
+            x1, x2 = int(x1 * w), int(x2 * w)
+            y1, y2 = int(y1 * h), int(y2 * h)
+        return img[int(y1):int(y2), int(x1):int(x2)]
+
+
+class ImageExpand(ImagePreprocessing):
+    """Pad to a random larger canvas (SSD-style augmentation, reference:
+    ``imagePreprocessing.py:301``)."""
+
+    def __init__(self, means_b: float = 123, means_g: float = 117,
+                 means_r: float = 104, max_expand_ratio: float = 4.0):
+        self.mean = np.array([means_b, means_g, means_r], np.float32)
+        self.max_ratio = max_expand_ratio
+
+    def map_image(self, img):
+        ratio = random.uniform(1.0, self.max_ratio)
+        h, w = img.shape[:2]
+        nh, nw = int(h * ratio), int(w * ratio)
+        out = np.empty((nh, nw, img.shape[2]), np.float32)
+        out[:] = self.mean
+        y0 = random.randint(0, nh - h)
+        x0 = random.randint(0, nw - w)
+        out[y0:y0 + h, x0:x0 + w] = img
+        return out
+
+
+class ImageFiller(ImagePreprocessing):
+    """Fill a box with a constant (reference: ``imagePreprocessing.py:319``,
+    cutout-style)."""
+
+    def __init__(self, x1: float, y1: float, x2: float, y2: float,
+                 value: int = 255):
+        self.box, self.value = (x1, y1, x2, y2), value
+
+    def map_image(self, img):
+        h, w = img.shape[:2]
+        x1, y1, x2, y2 = self.box
+        img = img.copy()
+        img[int(y1 * h):int(y2 * h), int(x1 * w):int(x2 * w)] = self.value
+        return img
+
+
+class ImageHFlip(ImagePreprocessing):
+    """reference: ``imagePreprocessing.py:334``."""
+
+    def map_image(self, img):
+        return img[:, ::-1].copy()
+
+
+class ImageMirror(ImagePreprocessing):
+    """Random horizontal flip with probability 0.5 (reference:
+    ``imagePreprocessing.py:343``)."""
+
+    def map_image(self, img):
+        return img[:, ::-1].copy() if random.random() < 0.5 else img
+
+
+class ImageRandomPreprocessing(ImagePreprocessing):
+    """Apply inner preprocessing with probability p (reference:
+    ``imagePreprocessing.py:375``)."""
+
+    def __init__(self, preprocessing: ImagePreprocessing, prob: float):
+        self.inner = preprocessing
+        self.prob = prob
+
+    def __call__(self, feature):
+        return self.inner(feature) if random.random() < self.prob \
+            else feature
+
+
+class ImageMatToTensor(ImagePreprocessing):
+    """HWC → CHW float32 tensor under key ``tensor`` (reference:
+    ``imagePreprocessing.py:120``; ``toRGB`` flips the channel order)."""
+
+    def __init__(self, to_rgb: bool = False, format: str = "NCHW"):
+        self.to_rgb = to_rgb
+        self.format = format
+
+    def __call__(self, feature):
+        img = feature["image"].astype(np.float32)
+        if self.to_rgb:
+            img = img[..., ::-1]
+        if self.format == "NCHW":
+            img = np.transpose(img, (2, 0, 1))
+        feature["tensor"] = np.ascontiguousarray(img)
+        return feature
+
+
+class ImageSetToSample(ImagePreprocessing):
+    """Terminal step: attach ``sample`` = (tensor, label) (reference:
+    ``imagePreprocessing.py:133``)."""
+
+    def __init__(self, input_keys: Sequence[str] = ("tensor",),
+                 target_keys: Optional[Sequence[str]] = ("label",)):
+        self.input_keys = list(input_keys)
+        self.target_keys = list(target_keys) if target_keys else None
+
+    def __call__(self, feature):
+        xs = [feature[k] for k in self.input_keys]
+        ys = None
+        if self.target_keys and self.target_keys[0] in feature:
+            ys = feature[self.target_keys[0]]
+        feature["sample"] = (xs[0] if len(xs) == 1 else tuple(xs), ys)
+        return feature
+
+
+# -------------------------------------------------------------- ImageSet
+
+_IMG_EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+class ImageSet:
+    """Collection of ImageFeatures (reference: ``imageset.py:21``).
+    ``read`` from a file/dir/glob; ``transform`` applies a Preprocessing
+    over a worker pool; ``to_arrays`` assembles (x, y) for training."""
+
+    def __init__(self, features: List[ImageFeature]):
+        self.features = features
+
+    @classmethod
+    def read(cls, path: str, with_label: bool = False,
+             resize_height: int = -1, resize_width: int = -1) -> "ImageSet":
+        """Dir layout: flat files, or ``path/<label>/*.jpg`` when
+        ``with_label`` (the reference derives the label map the same way,
+        ``imageset.py:54``)."""
+        files: List[Tuple[str, Optional[int]]] = []
+        label_map = {}
+        if os.path.isdir(path) and with_label:
+            classes = sorted(d for d in os.listdir(path)
+                             if os.path.isdir(os.path.join(path, d)))
+            label_map = {c: i for i, c in enumerate(classes)}
+            for c in classes:
+                for f in sorted(os.listdir(os.path.join(path, c))):
+                    if f.lower().endswith(_IMG_EXTS):
+                        files.append((os.path.join(path, c, f),
+                                      label_map[c]))
+        elif os.path.isdir(path):
+            for f in sorted(os.listdir(path)):
+                if f.lower().endswith(_IMG_EXTS):
+                    files.append((os.path.join(path, f), None))
+        else:
+            for f in sorted(_glob.glob(path)) or [path]:
+                files.append((f, None))
+        feats = []
+        for f, lbl in files:
+            img = cv2.imread(f, cv2.IMREAD_COLOR)
+            if img is None:
+                continue
+            if resize_height > 0 and resize_width > 0:
+                img = cv2.resize(img, (resize_width, resize_height))
+            feats.append(ImageFeature(image=img, label=lbl, uri=f))
+        out = cls(feats)
+        out.label_map = label_map
+        return out
+
+    @classmethod
+    def from_arrays(cls, images: Sequence[np.ndarray],
+                    labels: Optional[Sequence] = None) -> "ImageSet":
+        feats = [ImageFeature(image=img,
+                              label=None if labels is None else labels[i])
+                 for i, img in enumerate(images)]
+        return cls(feats)
+
+    def transform(self, transformer: Preprocessing) -> "ImageSet":
+        self.features = [transformer(f) for f in self.features]
+        return self
+
+    def get_image(self, key: str = "image") -> List[np.ndarray]:
+        return [f[key] for f in self.features]
+
+    def get_label(self) -> List:
+        return [f.get("label") for f in self.features]
+
+    def get_predict(self, key: str = "predict") -> List:
+        return [f.get(key) for f in self.features]
+
+    def to_arrays(self) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        """Stack ``sample`` entries into (x, y) batch arrays."""
+        xs = np.stack([f["sample"][0] for f in self.features])
+        ys = None
+        if self.features and self.features[0]["sample"][1] is not None:
+            ys = np.asarray([f["sample"][1] for f in self.features])
+        return xs, ys
+
+    def random_split(self, weights: Sequence[float]) -> List["ImageSet"]:
+        idx = np.random.permutation(len(self.features))
+        w = np.asarray(weights, np.float64)
+        bounds = np.cumsum(w / w.sum() * len(idx)).astype(int)
+        out, lo = [], 0
+        for hi in bounds:
+            out.append(ImageSet([self.features[i] for i in idx[lo:hi]]))
+            lo = hi
+        return out
+
+    def to_xshards(self, num_shards: Optional[int] = None):
+        from zoo_tpu.orca.data.shard import LocalXShards
+        from zoo_tpu.common.context import default_cores
+        n = num_shards or default_cores()
+        chunks = np.array_split(np.arange(len(self.features)), max(n, 1))
+        return LocalXShards([[self.features[i] for i in c] for c in chunks])
+
+    def __len__(self):
+        return len(self.features)

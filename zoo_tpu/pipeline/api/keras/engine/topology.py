@@ -52,35 +52,9 @@ def _merge_state(trainable: Dict, state: Dict) -> Dict:
     return out
 
 
-class TrainSummary:
-    """Scalar training summaries with read-back (reference: Scala
-    ``TrainSummary`` + ``get_train_summary(tag)`` surfaced at
-    ``orca/learn/tf/estimator.py:167-221``). Optionally tees into a
-    tensorboardX writer."""
-
-    def __init__(self, log_dir: Optional[str] = None, app_name: str = "zoo"):
-        self._scalars: Dict[str, List[Tuple[int, float]]] = {}
-        self._writer = None
-        if log_dir is not None:
-            try:
-                from tensorboardX import SummaryWriter
-                import os
-                self._writer = SummaryWriter(
-                    logdir=os.path.join(log_dir, app_name))
-            except ImportError:
-                pass
-
-    def add_scalar(self, tag: str, value: float, step: int):
-        self._scalars.setdefault(tag, []).append((step, float(value)))
-        if self._writer is not None:
-            self._writer.add_scalar(tag, value, step)
-
-    def read_scalar(self, tag: str) -> List[Tuple[int, float]]:
-        return list(self._scalars.get(tag, []))
-
-    def close(self):
-        if self._writer is not None:
-            self._writer.close()
+# Event-file-backed summaries (own writer + disk read-back) live in
+# zoo_tpu.tensorboard; re-exported here for the keras facade.
+from zoo_tpu.tensorboard import TrainSummary  # noqa: E402
 
 
 class KerasNet:

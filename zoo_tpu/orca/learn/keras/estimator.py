@@ -129,8 +129,17 @@ class KerasEstimator:
     def get_validation_summary(self, tag: str):
         return self.model.get_validation_summary(tag)
 
+    def set_constant_gradient_clipping(self, min_value: float,
+                                       max_value: float):
+        """reference: ``spark_estimator.set_constant_gradient_clipping`` →
+        Scala ``Estimator.scala`` constant clipping."""
+        self.model.set_constant_gradient_clipping(min_value, max_value)
+
+    def set_l2_norm_gradient_clipping(self, clip_norm: float):
+        self.model.set_gradient_clipping_by_l2_norm(clip_norm)
+
     def clear_gradient_clipping(self):
-        pass  # gradient clipping configured on the optimizer in this stack
+        self.model.clear_gradient_clipping()
 
     def shutdown(self):
         pass  # no actors/JVM to tear down

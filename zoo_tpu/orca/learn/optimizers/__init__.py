@@ -1,0 +1,4 @@
+from zoo_tpu.orca.learn.optimizers import schedule  # noqa: F401
+from zoo_tpu.pipeline.api.keras.optimizers import (  # noqa: F401
+    SGD, Adam, AdamWeightDecay, RMSprop, Adagrad, Adadelta, Adamax, LARS,
+)

@@ -74,6 +74,7 @@ class KerasNet:
         self._jit_eval = None
         self._jit_pred = None
         self._built_shapes: Optional[List[Tuple]] = None
+        self._grad_clip: Optional[Tuple] = None
 
     # -- param keys --------------------------------------------------------
     def _param_keys(self) -> Dict[int, str]:
@@ -113,6 +114,41 @@ class KerasNet:
         self._jit_train = self._jit_eval = self._jit_pred = None
         self._opt_state = None  # a new optimizer cannot reuse old state
         return self
+
+    # -- gradient clipping (reference: Scala ``Estimator.scala:68`` area —
+    # constant + L2-norm clipping applied inside DistriOptimizer) ----------
+    def set_constant_gradient_clipping(self, min_value: float,
+                                       max_value: float):
+        """Clip every gradient element into [min_value, max_value]."""
+        self._grad_clip = ("const", float(min_value), float(max_value))
+        self._jit_train = None  # clip happens inside the jitted step
+        return self
+
+    def set_gradient_clipping_by_l2_norm(self, clip_norm: float):
+        """Scale gradients so their global L2 norm is at most clip_norm."""
+        self._grad_clip = ("l2", float(clip_norm))
+        self._jit_train = None
+        return self
+
+    def clear_gradient_clipping(self):
+        self._grad_clip = None
+        self._jit_train = None
+        return self
+
+    def _apply_grad_clip(self, grads):
+        """Applied to raw grads before the optimizer update — outside the
+        optax chain so toggling clipping never invalidates optimizer state."""
+        if self._grad_clip is None:
+            return grads
+        if self._grad_clip[0] == "const":
+            _, lo, hi = self._grad_clip
+            return jax.tree_util.tree_map(
+                lambda g: jnp.clip(g, lo, hi), grads)
+        (_, norm) = self._grad_clip
+        import optax
+        gnorm = optax.global_norm(grads)
+        scale = jnp.minimum(1.0, norm / (gnorm + 1e-12))
+        return jax.tree_util.tree_map(lambda g: g * scale, grads)
 
     def set_tensorboard(self, log_dir: str, app_name: str):
         """reference: ``Topology.scala:162-168``."""
@@ -189,6 +225,7 @@ class KerasNet:
 
             (loss, collect), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(trainable)
+            grads = self._apply_grad_clip(grads)
             updates, opt_state = tx.update(grads, opt_state, trainable)
             import optax
             trainable = optax.apply_updates(trainable, updates)
@@ -281,6 +318,26 @@ class KerasNet:
                 for k, v in val.items():
                     history.setdefault("val_" + k, []).append(v)
                     self.validation_summary.add_scalar(k, v, self._step)
+            plateau = getattr(self.optimizer, "plateau", None)
+            if plateau is not None:
+                mon = plateau.monitor
+                if mon.lower() == "loss":
+                    watched = epoch_loss
+                else:
+                    series = history.get(mon) or history.get("val_" + mon)
+                    watched = series[-1] if series else None
+                if watched is None:
+                    import warnings
+                    warnings.warn(
+                        f"Plateau monitors '{mon}' but no such series was "
+                        "produced this epoch (pass validation_data / the "
+                        "metric); skipping lr adjustment")
+                else:
+                    new_lr = plateau.update(watched)
+                    # inject_hyperparams keeps lr in the optimizer state, so
+                    # the jitted step picks the new value up as an argument
+                    opt_state.hyperparams["learning_rate"] = jnp.asarray(
+                        new_lr, dtype=jnp.float32)
             if verbose:
                 extra = {k: v[-1] for k, v in history.items() if k != "loss"}
                 print(f"Epoch {epoch + 1}/{nb_epoch} - loss: "

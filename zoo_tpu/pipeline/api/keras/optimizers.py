@@ -18,11 +18,20 @@ import optax
 
 
 class Optimizer:
-    """Thin wrapper producing an optax GradientTransformation."""
+    """Thin wrapper producing an optax GradientTransformation.
 
-    def __init__(self, tx: optax.GradientTransformation, name: str):
+    ``plateau`` is set when the user passed a metric-driven
+    :class:`~zoo_tpu.orca.learn.optimizers.schedule.Plateau` schedule: the
+    transformation is then built with ``optax.inject_hyperparams`` so the
+    training loop can write the reduced lr into the optimizer state between
+    epochs (the reference's JVM Plateau mutates the optim method's ``clr``
+    the same way, driver-side)."""
+
+    def __init__(self, tx: optax.GradientTransformation, name: str,
+                 plateau=None):
         self.tx = tx
         self.name = name
+        self.plateau = plateau
 
     def make(self) -> optax.GradientTransformation:
         return self.tx
@@ -35,22 +44,42 @@ def _schedule(lr: float, decay: float) -> Union[float, Callable]:
     return lambda step: lr / (1.0 + decay * step)
 
 
+def _resolve(factory, lr, keras_decay, learningrate_schedule, **kw):
+    """Compile (base lr, keras decay, schedule object) into a
+    GradientTransformation + optional Plateau controller.
+
+    Accepts a Scheduler from ``zoo_tpu.orca.learn.optimizers.schedule``
+    (reference ``orca/learn/optimizers/schedule.py``), a raw ``step -> lr``
+    callable, or nothing (keras-1 inverse-time ``decay``)."""
+    from zoo_tpu.orca.learn.optimizers.schedule import Plateau, Scheduler
+
+    sched = learningrate_schedule
+    if isinstance(sched, Plateau):
+        return optax.inject_hyperparams(factory)(
+            learning_rate=lr, **kw), sched.bind(lr)
+    if isinstance(sched, Scheduler):
+        return factory(sched.get_scheduler(lr), **kw), None
+    if callable(sched):
+        return factory(sched, **kw), None
+    return factory(_schedule(lr, keras_decay), **kw), None
+
+
 class SGD(Optimizer):
     def __init__(self, lr: float = 0.01, momentum: float = 0.0,
                  decay: float = 0.0, nesterov: bool = False,
                  learningrate_schedule=None):
-        sched = learningrate_schedule or _schedule(lr, decay)
-        tx = optax.sgd(sched, momentum=momentum or None, nesterov=nesterov)
-        super().__init__(tx, "sgd")
+        tx, plateau = _resolve(optax.sgd, lr, decay, learningrate_schedule,
+                               momentum=momentum or None, nesterov=nesterov)
+        super().__init__(tx, "sgd", plateau)
 
 
 class Adam(Optimizer):
     def __init__(self, lr: float = 0.001, beta_1: float = 0.9,
                  beta_2: float = 0.999, epsilon: float = 1e-8,
                  decay: float = 0.0, learningrate_schedule=None):
-        sched = learningrate_schedule or _schedule(lr, decay)
-        tx = optax.adam(sched, b1=beta_1, b2=beta_2, eps=epsilon)
-        super().__init__(tx, "adam")
+        tx, plateau = _resolve(optax.adam, lr, decay, learningrate_schedule,
+                               b1=beta_1, b2=beta_2, eps=epsilon)
+        super().__init__(tx, "adam", plateau)
 
 
 class AdamWeightDecay(Optimizer):
@@ -74,16 +103,19 @@ class AdamWeightDecay(Optimizer):
 
 class RMSprop(Optimizer):
     def __init__(self, lr: float = 0.001, rho: float = 0.9,
-                 epsilon: float = 1e-8, decay: float = 0.0):
-        tx = optax.rmsprop(_schedule(lr, decay), decay=rho, eps=epsilon)
-        super().__init__(tx, "rmsprop")
+                 epsilon: float = 1e-8, decay: float = 0.0,
+                 learningrate_schedule=None):
+        tx, plateau = _resolve(optax.rmsprop, lr, decay,
+                               learningrate_schedule, decay=rho, eps=epsilon)
+        super().__init__(tx, "rmsprop", plateau)
 
 
 class Adagrad(Optimizer):
     def __init__(self, lr: float = 0.01, epsilon: float = 1e-8,
-                 decay: float = 0.0):
-        tx = optax.adagrad(_schedule(lr, decay), eps=epsilon)
-        super().__init__(tx, "adagrad")
+                 decay: float = 0.0, learningrate_schedule=None):
+        tx, plateau = _resolve(optax.adagrad, lr, decay,
+                               learningrate_schedule, eps=epsilon)
+        super().__init__(tx, "adagrad", plateau)
 
 
 class Adadelta(Optimizer):

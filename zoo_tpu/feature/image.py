@@ -88,9 +88,12 @@ class ImageAspectScale(ImagePreprocessing):
         self.mult = scale_multiple_of
 
     def map_image(self, img):
+        return self._scale(img, self.min_size)
+
+    def _scale(self, img, min_size):
         h, w = img.shape[:2]
         short, long_ = min(h, w), max(h, w)
-        scale = min(self.min_size / short, self.max_size / long_)
+        scale = min(min_size / short, self.max_size / long_)
         nh, nw = int(round(h * scale)), int(round(w * scale))
         if self.mult > 1:
             nh = (nh // self.mult) * self.mult
@@ -100,15 +103,15 @@ class ImageAspectScale(ImagePreprocessing):
 
 class ImageRandomAspectScale(ImageAspectScale):
     """reference: ``imagePreprocessing.py:232`` — min_size drawn from a
-    list of scales per image."""
+    list of scales per image. The draw stays local so the transformer is
+    stateless and safe to share across XShards workers."""
 
     def __init__(self, scales: Sequence[int], max_size: int = 1000):
         super().__init__(min_size=scales[0], max_size=max_size)
         self.scales = list(scales)
 
     def map_image(self, img):
-        self.min_size = random.choice(self.scales)
-        return super().map_image(img)
+        return self._scale(img, random.choice(self.scales))
 
 
 class ImageBrightness(ImagePreprocessing):

@@ -23,6 +23,7 @@ import pickle
 import queue
 import tempfile
 import threading
+import weakref
 from typing import Any, Iterable, Iterator, Optional
 
 from zoo_tpu import native as _native
@@ -183,8 +184,12 @@ class DoubleBufferedIterator:
 
     def __init__(self, it: Iterable[Any], stage_fn=None, depth: int = 2):
         self._q: "queue.Queue" = queue.Queue(maxsize=depth)
-        self._err: Optional[BaseException] = None
+        self._err_box: list = []  # producer's exception, if any
         self._stop = threading.Event()
+        # The producer closure must NOT capture self: the live thread would
+        # then keep the iterator reachable and the GC finalizer below could
+        # never fire for an abandoned consumer.
+        q, stop, err_box, end = self._q, self._stop, self._err_box, self._END
 
         def run():
             try:
@@ -193,28 +198,32 @@ class DoubleBufferedIterator:
                     # bounded put that aborts when the consumer closed us,
                     # so an early-exiting consumer cannot strand the
                     # producer (and its device-resident batch) forever
-                    while not self._stop.is_set():
+                    while not stop.is_set():
                         try:
-                            self._q.put(staged, timeout=0.1)
+                            q.put(staged, timeout=0.1)
                             break
                         except queue.Full:
                             continue
-                    if self._stop.is_set():
+                    if stop.is_set():
                         return
             except BaseException as e:  # propagate into consumer
-                self._err = e
+                err_box.append(e)
             finally:
                 # END must arrive or the consumer blocks forever; bounded
                 # retry so close() can still release us.
-                while not self._stop.is_set():
+                while not stop.is_set():
                     try:
-                        self._q.put(self._END, timeout=0.1)
+                        q.put(end, timeout=0.1)
                         break
                     except queue.Full:
                         continue
 
         self._t = threading.Thread(target=run, daemon=True)
         self._t.start()
+        # a consumer that abandons iteration without close() must not strand
+        # the producer retrying puts (pinning staged device batches): stop it
+        # when the iterator is collected (the Event outlives self safely)
+        weakref.finalize(self, self._stop.set)
 
     def close(self):
         """Stop the producer and drop staged items."""
@@ -225,13 +234,30 @@ class DoubleBufferedIterator:
             except queue.Empty:
                 break
 
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
     def __iter__(self):
         return self
 
     def __next__(self):
-        item = self._q.get()
+        # after close() the queue may already be drained (close() eats the
+        # END sentinel) — never park forever on a stopped producer
+        while True:
+            if self._stop.is_set():
+                raise StopIteration
+            try:
+                item = self._q.get(timeout=0.1)
+                break
+            except queue.Empty:
+                continue
         if item is self._END:
-            if self._err is not None:
-                raise self._err
+            self._stop.set()  # latch: later __next__ calls must not spin
+            if self._err_box:
+                raise self._err_box[0]
             raise StopIteration
         return item

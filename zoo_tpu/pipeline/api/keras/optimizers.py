@@ -89,16 +89,15 @@ class AdamWeightDecay(Optimizer):
     def __init__(self, lr: float = 0.001, beta_1: float = 0.9,
                  beta_2: float = 0.999, epsilon: float = 1e-6,
                  weight_decay: float = 0.01, total_steps: int = 0,
-                 warmup_ratio: float = 0.1):
-        if total_steps:
+                 warmup_ratio: float = 0.1, learningrate_schedule=None):
+        if learningrate_schedule is None and total_steps:
             warmup = max(1, int(total_steps * warmup_ratio))
-            sched = optax.warmup_cosine_decay_schedule(
+            learningrate_schedule = optax.warmup_cosine_decay_schedule(
                 0.0, lr, warmup, total_steps)
-        else:
-            sched = lr
-        tx = optax.adamw(sched, b1=beta_1, b2=beta_2, eps=epsilon,
-                         weight_decay=weight_decay)
-        super().__init__(tx, "adamw")
+        tx, plateau = _resolve(optax.adamw, lr, 0.0, learningrate_schedule,
+                               b1=beta_1, b2=beta_2, eps=epsilon,
+                               weight_decay=weight_decay)
+        super().__init__(tx, "adamw", plateau)
 
 
 class RMSprop(Optimizer):
@@ -120,16 +119,21 @@ class Adagrad(Optimizer):
 
 class Adadelta(Optimizer):
     def __init__(self, lr: float = 1.0, rho: float = 0.95,
-                 epsilon: float = 1e-8):
-        tx = optax.adadelta(lr, rho=rho, eps=epsilon)
-        super().__init__(tx, "adadelta")
+                 epsilon: float = 1e-8, decay: float = 0.0,
+                 learningrate_schedule=None):
+        tx, plateau = _resolve(optax.adadelta, lr, decay,
+                               learningrate_schedule, rho=rho, eps=epsilon)
+        super().__init__(tx, "adadelta", plateau)
 
 
 class Adamax(Optimizer):
     def __init__(self, lr: float = 0.002, beta_1: float = 0.9,
-                 beta_2: float = 0.999, epsilon: float = 1e-8):
-        tx = optax.adamax(lr, b1=beta_1, b2=beta_2, eps=epsilon)
-        super().__init__(tx, "adamax")
+                 beta_2: float = 0.999, epsilon: float = 1e-8,
+                 decay: float = 0.0, learningrate_schedule=None):
+        tx, plateau = _resolve(optax.adamax, lr, decay,
+                               learningrate_schedule,
+                               b1=beta_1, b2=beta_2, eps=epsilon)
+        super().__init__(tx, "adamax", plateau)
 
 
 class LARS(Optimizer):
@@ -137,11 +141,12 @@ class LARS(Optimizer):
     ships a LARS-ish variant for ImageNet runs)."""
 
     def __init__(self, lr: float = 0.1, momentum: float = 0.9,
-                 weight_decay: float = 1e-4, trust_coefficient: float = 0.001):
-        tx = optax.lars(lr, weight_decay=weight_decay,
-                        momentum=momentum,
-                        trust_coefficient=trust_coefficient)
-        super().__init__(tx, "lars")
+                 weight_decay: float = 1e-4, trust_coefficient: float = 0.001,
+                 learningrate_schedule=None):
+        tx, plateau = _resolve(optax.lars, lr, 0.0, learningrate_schedule,
+                               weight_decay=weight_decay, momentum=momentum,
+                               trust_coefficient=trust_coefficient)
+        super().__init__(tx, "lars", plateau)
 
 
 _ALIASES = {

@@ -93,6 +93,11 @@ class EventWriter:
                 item = b""
             if item is None:
                 break
+            if isinstance(item, threading.Event):
+                # flush barrier: everything enqueued before it is written
+                self._f.flush()
+                item.set()
+                continue
             if item:
                 self._f.write(item)
             if time.time() - last_flush >= self._flush_secs:
@@ -109,10 +114,17 @@ class EventWriter:
             self._q.put(frame_record(event_bytes))
 
     def flush(self):
-        """Block until everything queued so far is on disk."""
-        while not self._q.empty():
-            time.sleep(0.01)
-        self._f.flush()
+        """Block until everything queued so far is on disk. A sentinel
+        barrier rides the queue behind the pending records, so there is no
+        drained-but-unwritten race (queue.empty() can be true while the
+        worker still holds the last record)."""
+        if self._closed or not self._t.is_alive():
+            if not self._f.closed:
+                self._f.flush()
+            return
+        barrier = threading.Event()
+        self._q.put(barrier)
+        barrier.wait(timeout=30)
 
     def close(self):
         if not self._closed:
@@ -156,7 +168,12 @@ def read_scalars(log_dir: str, tag: Optional[str] = None
             wall = float(fields.get(_EV_WALL_TIME, [0.0])[0])
             step = proto.zigzag_to_int64(int(fields.get(_EV_STEP, [0])[0]))
             for summary in fields[_EV_SUMMARY]:
-                for _, _, sval in proto.iter_fields(summary):
+                for fld, wire, sval in proto.iter_fields(summary):
+                    # only Summary.value (field 1, length-delimited); a
+                    # varint/fixed field from another producer would be an
+                    # int here and must not reach parse_fields
+                    if fld != _SUM_VALUE or wire != 2 or not isinstance(sval, bytes):
+                        continue
                     vf = proto.parse_fields(sval)
                     if _VAL_TAG not in vf:
                         continue

@@ -38,9 +38,12 @@ class _KernelPooling(Layer):
         mu = jnp.asarray(mus)[None, None, None, :]
         sg = jnp.asarray(sigmas)[None, None, None, :]
         k = jnp.exp(-((inputs[..., None] - mu) ** 2) / (2 * sg ** 2))
-        # sum over doc, log, sum over query (reference pooling)
+        # sum over doc, log1p, sum over query (reference pooling:
+        # ``knrm.py:110-114`` uses log(sum + 1), which keeps the pooled
+        # features bounded — a bare log saturates the sigmoid head and
+        # kills the gradient through the clipped BCE)
         pooled = jnp.sum(k, axis=2)
-        pooled = jnp.log(jnp.maximum(pooled, 1e-10))
+        pooled = jnp.log1p(pooled)
         return jnp.sum(pooled, axis=1)
 
     def compute_output_shape(self, input_shape):

@@ -37,7 +37,11 @@ def dot_product_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     dense otherwise.
     """
     flash_ok = mask is None and dropout_p == 0.0
+    # auto: the blockwise kernel wins when the T^2 score matrix stops
+    # fitting in VMEM; at short seq the fused dense path is faster on the
+    # MXU (measured: BERT-base S=128 dense 1.4x flash on v5e)
     if impl == "flash" or (impl == "auto" and flash_ok
+                           and q.shape[-2] >= 1024
                            and jax.default_backend() == "tpu"):
         if not flash_ok:
             raise ValueError("flash attention supports causal masking only "
@@ -46,10 +50,13 @@ def dot_product_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         from zoo_tpu.ops.pallas import flash_attention
         return flash_attention(q, k, v, causal=causal, scale=scale)
     d = q.shape[-1]
-    scale = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(q.dtype)
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    scale = scale if scale is not None else 1.0 / float(d) ** 0.5
+    # QK^T rides the MXU in the input dtype; the softmax runs in an f32
+    # island (bf16 exp/normalize loses attention mass), then drops back
+    # for the PV matmul
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
 
-    neg = jnp.finfo(scores.dtype).min
+    neg = jnp.finfo(jnp.float32).min
     if causal:
         tq, tk = scores.shape[-2], scores.shape[-1]
         tri = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
@@ -57,7 +64,7 @@ def dot_product_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     if mask is not None:
         scores = jnp.where(mask, scores, neg)
 
-    probs = jax.nn.softmax(scores, axis=-1)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     if dropout_p > 0.0 and dropout_rng is not None:
         keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_p,
                                     probs.shape)

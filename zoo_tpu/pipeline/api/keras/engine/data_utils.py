@@ -59,16 +59,24 @@ def to_xy_arrays(x, y=None, feature_cols: Optional[Sequence[str]] = None,
         pass
 
     if isinstance(x, dict):
-        return _as_list(x["x"]), x.get("y")
-    return _as_list(x), (None if y is None else np.asarray(y))
+        return _as_list(x["x"]), _keep_device(x.get("y"))
+    return _as_list(x), (None if y is None else _keep_device(y))
+
+
+def _keep_device(a):
+    """np-convert unless it's already a device (jax) array — a dataset
+    cached in HBM must not be pulled back to host just to be re-sliced."""
+    if a is None or hasattr(a, "devices"):
+        return a
+    return np.asarray(a)
 
 
 def _as_list(x) -> List[np.ndarray]:
     if x is None:
         return []
     if isinstance(x, (list, tuple)):
-        return [np.asarray(a) for a in x]
-    return [np.asarray(x)]
+        return [_keep_device(a) for a in x]
+    return [_keep_device(x)]
 
 
 def _stack_labels(cols: List[np.ndarray]) -> Optional[np.ndarray]:
@@ -85,17 +93,21 @@ def num_samples(xs: List[np.ndarray]) -> int:
 
 def batch_slices(n: int, batch_size: int, shuffle: bool,
                  rng: Optional[np.random.RandomState] = None,
-                 drop_remainder: bool = True):
-    """Yield index arrays per batch. Training drops the ragged tail (the
+                 drop_remainder: bool = True, group: int = 1):
+    """Yield index arrays, ``group`` whole batches at a time (group > 1 =
+    superbatch staging: one host→device transfer covers several training
+    batches). Training drops the ragged tail of the permutation (the
     reference enforces ``batch_size % cores == 0`` and fixed per-replica
     batches, ``tf_dataset.py:188``); inference pads instead (see
     ``pad_batch``)."""
     idx = np.arange(n)
     if shuffle:
         (rng or np.random).shuffle(idx)
-    n_batches = n // batch_size if drop_remainder else -(-n // batch_size)
-    for b in range(n_batches):
-        yield idx[b * batch_size:(b + 1) * batch_size]
+    if drop_remainder:
+        idx = idx[:(n // batch_size) * batch_size]
+    chunk = batch_size * group
+    for i in range(0, len(idx), chunk):
+        yield idx[i:i + chunk]
 
 
 def pad_batch(arrs: List[np.ndarray], batch_size: int

@@ -104,8 +104,17 @@ class KerasNet:
         raise NotImplementedError
 
     # -- public API (keras-1 names, reference Topology.scala) -------------
-    def compile(self, optimizer, loss, metrics=None):
-        """reference: ``KerasNet.compile`` ``Topology.scala:139``."""
+    def compile(self, optimizer, loss, metrics=None,
+                dtype_policy: str = "float32"):
+        """reference: ``KerasNet.compile`` ``Topology.scala:139``.
+
+        ``dtype_policy``: "float32" (default) or "mixed_bfloat16" — params
+        and optimizer state stay f32, forward/backward compute runs in
+        bf16 on the MXU with f32 islands in the normalizations/softmax
+        (net-new: the reference's fabric is f32-only CPU)."""
+        if dtype_policy not in ("float32", "mixed_bfloat16"):
+            raise ValueError(f"unknown dtype_policy: {dtype_policy}")
+        self.dtype_policy = dtype_policy
         self.optimizer = get_optimizer(optimizer)
         self.loss_fn = get_loss(loss)
         self.loss_name = (loss if isinstance(loss, str)
@@ -114,6 +123,14 @@ class KerasNet:
         self._jit_train = self._jit_eval = self._jit_pred = None
         self._opt_state = None  # a new optimizer cannot reuse old state
         return self
+
+    def _cast_compute(self, tree):
+        """Cast float32 leaves to the compute dtype under the policy."""
+        if getattr(self, "dtype_policy", "float32") != "mixed_bfloat16":
+            return tree
+        return jax.tree.map(
+            lambda a: a.astype(jnp.bfloat16)
+            if hasattr(a, "dtype") and a.dtype == jnp.float32 else a, tree)
 
     # -- gradient clipping (reference: Scala ``Estimator.scala:68`` area —
     # constant + L2-norm clipping applied inside DistriOptimizer) ----------
@@ -214,13 +231,26 @@ class KerasNet:
         n_inputs = self._n_inputs()
 
         def step(params, opt_state, rng, *batch):
+            # rng advances inside the jitted step — a host-side split per
+            # step would be an extra dispatch (and a real cost when the
+            # device sits behind a high-latency transport)
+            step_rng, new_rng = jax.random.split(rng)
             xs, ys = list(batch[:n_inputs]), batch[n_inputs]
             trainable, state = _split_state(params)
 
             def loss_fn(tr):
                 collect = {}
-                preds = self._forward(_merge_state(tr, state), xs,
-                                      training=True, rng=rng, collect=collect)
+                # cast trainables only: running stats (BatchNorm EMA) must
+                # keep f32 resolution or momentum-0.99 increments vanish
+                # below a bf16 ulp
+                preds = self._forward(
+                    _merge_state(self._cast_compute(tr), state),
+                    self._cast_compute(xs), training=True, rng=step_rng,
+                    collect=collect)
+                preds = jax.tree.map(
+                    lambda p: p.astype(jnp.float32)
+                    if hasattr(p, "dtype") and p.dtype == jnp.bfloat16
+                    else p, preds)
                 return self.loss_fn(ys, preds), collect
 
             (loss, collect), grads = jax.value_and_grad(
@@ -230,14 +260,21 @@ class KerasNet:
             import optax
             trainable = optax.apply_updates(trainable, updates)
             new_params = _merge_state(trainable, collect or state)
-            return new_params, opt_state, loss
+            return new_params, opt_state, new_rng, loss
 
-        return jax.jit(step, donate_argnums=(0, 1))
+        return jax.jit(step, donate_argnums=(0, 1, 2))
 
     def _build_pred_step(self):
         def step(params, *xs):
-            return self._forward(params, list(xs), training=False, rng=None,
-                                 collect=None)
+            tr, state = _split_state(params)  # keep running stats f32
+            preds = self._forward(_merge_state(self._cast_compute(tr),
+                                               state),
+                                  self._cast_compute(list(xs)),
+                                  training=False, rng=None, collect=None)
+            return jax.tree.map(
+                lambda p: p.astype(jnp.float32)
+                if hasattr(p, "dtype") and p.dtype == jnp.bfloat16 else p,
+                preds)
         return jax.jit(step)
 
     # -- training loop ----------------------------------------------------
@@ -285,31 +322,49 @@ class KerasNet:
             val_arrays = (self._adapt_inputs(val_arrays[0]), val_arrays[1])
         history: Dict[str, List[float]] = {"loss": []}
         from zoo_tpu.orca.data.cache import DoubleBufferedIterator
+        arrs = xs + [ys]
+        sample_bytes = sum(a[:1].nbytes for a in arrs)
+        # Host→HBM transfers are chunked into SUPERBATCHES (many training
+        # batches per device_put, ~64MB or 16 batches) and sliced on-device:
+        # per-batch puts pay a full transport round trip each (~100ms on a
+        # tunneled PJRT backend) which no depth-2 prefetch can hide. The
+        # staging thread still overlaps transfer with compute.
+        group = max(1, min(16, (64 << 20) // max(sample_bytes * batch_size,
+                                                 1)))
         for epoch in range(nb_epoch):
             t0 = time.time()
-            losses = []
-            # Host→device staging (slice + device_put) overlaps the jitted
-            # step via a prefetch thread — the reference gets the same
-            # overlap from Spark's prefetching FeatureSet iterators.
+            loss_sum, n_steps = None, 0
             batches = DoubleBufferedIterator(
-                data_utils.batch_slices(n, batch_size, shuffle, nprng),
+                data_utils.batch_slices(n, batch_size, shuffle, nprng,
+                                        group=group),
                 stage_fn=lambda idx: self._put_batch(
-                    [a[idx] for a in xs] + [ys[idx]]))
+                    [a[idx] for a in arrs]))
             try:
-                for batch in batches:
-                    rng, step_rng = jax.random.split(rng)
-                    params, opt_state, loss = self._jit_train(
-                        params, opt_state, step_rng, *batch)
-                    self._step += 1
-                    losses.append(loss)
+                for staged in batches:
+                    for j in range(staged[0].shape[0] // batch_size):
+                        # re-place the sub-slice so a multi-device mesh
+                        # keeps the guaranteed batch sharding (device-to-
+                        # device; a no-op on one chip)
+                        sub = self._put_batch(
+                            [t[j * batch_size:(j + 1) * batch_size]
+                             for t in staged])
+                        params, opt_state, rng, loss = self._jit_train(
+                            params, opt_state, rng, *sub)
+                        self._step += 1
+                        n_steps += 1
+                        # running device-side sum: one host transfer per
+                        # epoch (a per-step sync pays a full round trip —
+                        # ~100ms over a tunneled PJRT transport)
+                        loss_sum = loss if loss_sum is None \
+                            else loss_sum + loss
             finally:
                 batches.close()
-            epoch_loss = float(np.mean([float(l) for l in losses]))
+            epoch_loss = float(np.asarray(loss_sum)) / max(n_steps, 1)
             history["loss"].append(epoch_loss)
             self.train_summary.add_scalar("Loss", epoch_loss, self._step)
             self.train_summary.add_scalar(
                 "Throughput",
-                len(losses) * batch_size / max(time.time() - t0, 1e-9),
+                n_steps * batch_size / max(time.time() - t0, 1e-9),
                 self._step)
             if val_arrays is not None:
                 vx, vy = val_arrays
@@ -371,8 +426,10 @@ class KerasNet:
             chunk = [a[idx] for a in xs]
             padded, real = data_utils.pad_batch(chunk, bs)
             preds = self._jit_pred(params, *self._put_batch(padded))
-            outs.append(np.asarray(preds)[:real])
-        return np.concatenate(outs, axis=0)
+            # stays on device (lazy slice) — batches pipeline without a
+            # per-batch host sync; ONE transfer at the end
+            outs.append(preds[:real] if real != bs else preds)
+        return np.asarray(jnp.concatenate(outs, axis=0))
 
     def _evaluate_arrays(self, xs, ys, batch_size) -> Dict[str, float]:
         """Exact (non-approximated) evaluation: predictions are computed in

@@ -251,18 +251,23 @@ class BatchNormalization(Layer):
 
     def call(self, params, inputs, *, training=False, rng=None):
         axes = tuple(range(inputs.ndim - 1))
+        # f32 island: batch stats in reduced precision destabilize the
+        # normalization under the mixed-bf16 policy
+        xf = inputs.astype(jnp.float32)
         if training:
-            mean = jnp.mean(inputs, axis=axes)
-            var = jnp.var(inputs, axis=axes)
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.var(xf, axis=axes)
         else:
             mean, var = params["stats"]["mean"], params["stats"]["var"]
-        y = (inputs - mean) / jnp.sqrt(var + self.epsilon)
-        return y * params["gamma"] + params["beta"]
+        y = (xf - mean) / jnp.sqrt(var + self.epsilon)
+        return (y * params["gamma"].astype(jnp.float32)
+                + params["beta"].astype(jnp.float32)).astype(inputs.dtype)
 
     def updated_stats(self, params, inputs):
         axes = tuple(range(inputs.ndim - 1))
-        mean = jnp.mean(inputs, axis=axes)
-        var = jnp.var(inputs, axis=axes)
+        xf = inputs.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axes)
+        var = jnp.var(xf, axis=axes)
         m = self.momentum
         return {
             "mean": m * params["stats"]["mean"] + (1 - m) * jax.lax.stop_gradient(mean),

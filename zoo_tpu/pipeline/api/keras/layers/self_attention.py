@@ -34,9 +34,14 @@ from zoo_tpu.pipeline.api.keras.engine.base import (
 
 
 def _layer_norm(x, gamma, beta, eps=1e-5):
-    mean = jnp.mean(x, axis=-1, keepdims=True)
-    var = jnp.var(x, axis=-1, keepdims=True)
-    return (x - mean) / jnp.sqrt(var + eps) * gamma + beta
+    # f32 island: mean/var in reduced precision drift badly under the
+    # mixed-bf16 policy; compute stats in f32, emit in the input dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    out = (xf - mean) / jnp.sqrt(var + eps)
+    return (out * gamma.astype(jnp.float32)
+            + beta.astype(jnp.float32)).astype(x.dtype)
 
 
 class LayerNorm(Layer):

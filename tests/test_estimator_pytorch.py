@@ -84,15 +84,50 @@ def test_trained_weights_flow_back_to_torch(orca_ctx):
     np.testing.assert_allclose(zoo_preds, torch_preds, atol=1e-4)
 
 
-def test_unsupported_module_message(orca_ctx):
+def test_unsupported_op_message(orca_ctx):
+    """Tracing sees through arbitrary modules, so 'unsupported' now means
+    an ATen op with no JAX mapping — the error must name it."""
     class Weird(nn.Module):
         def forward(self, x):
-            return x
+            return torch.special.i0(x)  # bessel: deliberately unmapped
 
     net = nn.Sequential(nn.Linear(4, 4), Weird())
     est = Estimator.from_torch(model=net, loss=nn.MSELoss())
-    with pytest.raises(ValueError, match="Weird"):
+    with pytest.raises(NotImplementedError, match="aten"):
         est.predict(np.ones((8, 4), np.float32))
+
+
+def test_custom_forward_multi_input(orca_ctx):
+    """Round-1 gap: the structural bridge was Sequential-only/single-input;
+    the traced bridge must carry custom forward graphs with two inputs."""
+    class TwoTower(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.a = nn.Linear(4, 8)
+            self.b = nn.Linear(3, 8)
+            self.head = nn.Linear(8, 1)
+
+        def forward(self, xa, xb):
+            return self.head(torch.tanh(self.a(xa)) *
+                             torch.sigmoid(self.b(xb)))
+
+    rs = np.random.RandomState(0)
+    xa = rs.randn(64, 4).astype(np.float32)
+    xb = rs.randn(64, 3).astype(np.float32)
+    y = (xa.sum(1, keepdims=True) > 0).astype(np.float32)
+    net = TwoTower()
+    est = Estimator.from_torch(model=net, loss=nn.MSELoss(),
+                               optimizer=__import__("torch").optim.Adam(
+                                   net.parameters(), lr=0.01))
+    hist = est.fit({"x": [xa, xb], "y": y}, epochs=4, batch_size=16)
+    assert hist["loss"][-1] < hist["loss"][0]
+    # logits parity with torch on the trained weights
+    import torch as t
+    trained = est.get_model()
+    with t.no_grad():
+        ot = trained(t.from_numpy(xa), t.from_numpy(xb)).numpy()
+    oj = est.predict({"x": [xa, xb]})
+    assert np.abs(oj - ot).max() < 1e-3
 
 
 def test_creator_functions(orca_ctx):
@@ -109,3 +144,43 @@ def test_creator_functions(orca_ctx):
         config={"hidden": 8, "lr": 0.05})
     hist = est.fit({"x": x, "y": y}, epochs=3, batch_size=32)
     assert hist["loss"][-1] < hist["loss"][0]
+
+
+def test_hf_bert_finetune_parity(orca_ctx):
+    """VERDICT round-1 acceptance: a HuggingFace-style BERT classifier
+    fine-tunes through Estimator.from_torch (traced bridge), and converted
+    logits match torch CPU to 1e-3 before AND after training."""
+    transformers = pytest.importorskip("transformers")
+    from transformers import BertConfig, BertForSequenceClassification
+
+    cfg = BertConfig(vocab_size=96, hidden_size=32, num_hidden_layers=2,
+                     num_attention_heads=2, intermediate_size=64,
+                     max_position_embeddings=64, num_labels=2,
+                     hidden_dropout_prob=0.0,
+                     attention_probs_dropout_prob=0.0)
+    bert = BertForSequenceClassification(cfg).eval()
+
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 96, (64, 12)).astype(np.int32)
+    # learnable rule: label = first token parity
+    y = (ids[:, 0] % 2).astype(np.int32)
+
+    est = Estimator.from_torch(
+        model=bert, loss=nn.CrossEntropyLoss(),
+        optimizer=torch.optim.AdamW(bert.parameters(), lr=5e-3))
+
+    # pre-training parity
+    pre = est.predict({"x": ids})
+    with torch.no_grad():
+        pt = bert(torch.from_numpy(ids.astype(np.int64))).logits.numpy()
+    assert np.abs(pre - pt).max() < 1e-3
+
+    hist = est.fit({"x": ids, "y": y}, epochs=6, batch_size=16)
+    assert hist["loss"][-1] < hist["loss"][0]
+
+    # post-training parity: trained weights written back to torch
+    trained = est.get_model()
+    with torch.no_grad():
+        pt2 = trained(torch.from_numpy(ids.astype(np.int64))).logits.numpy()
+    post = est.predict({"x": ids})
+    assert np.abs(post - pt2).max() < 1e-3

@@ -1,0 +1,772 @@
+"""torch → JAX bridge via ``torch.export`` graph tracing.
+
+Rebuild of the reference's "any torch module" ingestion contract
+(``pipeline/api/net/TorchModel.scala:34`` ships the live module to
+executors and runs it under jep per step). Here the module is traced ONCE
+with ``torch.export`` into a core-ATen graph, and that graph is
+*interpreted in JAX*: every ATen op maps to a jax/lax equivalent, weights
+come across as a pytree keyed by the torch parameter FQNs, and the whole
+thing jits/differentiates/shards like any native model — torch never runs
+on the hot path.
+
+Compared to the round-1 structural bridge (isinstance-walk over
+``nn.Sequential``), tracing supports arbitrary ``forward`` code,
+multi-input models, attention blocks, and HuggingFace-style transformers.
+
+Notes / contract:
+  * The module is exported in ``eval()`` mode: dropout layers drop out of
+    the graph and BatchNorm uses (frozen) running statistics. Gradients
+    still flow to all parameters, so fine-tuning works; stochastic-depth
+    style regularization does not.
+  * int64 tensors are computed as int32 (JAX default; indices and masks at
+    model scale fit comfortably).
+  * Unsupported ATen ops raise ``NotImplementedError`` naming the op.
+"""
+
+from __future__ import annotations
+
+import operator
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_INT64_MAX = 2 ** 63 - 1
+
+
+def _torch_dtype_to_jnp(tdtype):
+    import torch
+    table = {
+        torch.float32: jnp.float32, torch.float64: jnp.float32,
+        torch.float16: jnp.float16, torch.bfloat16: jnp.bfloat16,
+        torch.int64: jnp.int32, torch.int32: jnp.int32,
+        torch.int16: jnp.int16, torch.int8: jnp.int8,
+        torch.uint8: jnp.uint8, torch.bool: jnp.bool_,
+    }
+    return table[tdtype]
+
+
+def _t2j(t) -> jnp.ndarray:
+    """torch tensor -> jnp array (f64->f32, i64->i32, bf16 preserved)."""
+    import torch
+    if t.dtype == torch.bfloat16:  # .numpy() rejects bf16
+        return jnp.asarray(t.detach().cpu().float().numpy(),
+                           dtype=jnp.bfloat16)
+    a = t.detach().cpu().numpy()
+    if a.dtype == np.float64:
+        a = a.astype(np.float32)
+    elif a.dtype == np.int64:
+        a = a.astype(np.int32)
+    return jnp.asarray(a)
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v, v)
+
+
+# --------------------------------------------------------------------- ops
+
+_OPS: Dict[str, Callable] = {}
+
+
+def _op(*names):
+    def deco(fn):
+        for n in names:
+            _OPS[n] = fn
+        return fn
+    return deco
+
+
+def _alpha_add(x, y, alpha=1):
+    return x + (y * alpha if alpha != 1 else y)
+
+
+_op("aten.add.Tensor", "aten.add.Scalar")(
+    lambda x, y, alpha=1: _alpha_add(x, y, alpha))
+_op("aten.sub.Tensor", "aten.sub.Scalar")(
+    lambda x, y, alpha=1: x - (y * alpha if alpha != 1 else y))
+_op("aten.rsub.Scalar", "aten.rsub.Tensor")(
+    lambda x, y, alpha=1: y - (x * alpha if alpha != 1 else x))
+_op("aten.mul.Tensor", "aten.mul.Scalar")(lambda x, y: x * y)
+_op("aten.div.Tensor", "aten.div.Scalar")(lambda x, y: x / y)
+_op("aten.pow.Tensor_Scalar", "aten.pow.Tensor_Tensor")(jnp.power)
+_op("aten.neg.default")(jnp.negative)
+_op("aten.abs.default")(jnp.abs)
+_op("aten.exp.default")(jnp.exp)
+_op("aten.log.default")(jnp.log)
+_op("aten.sqrt.default")(jnp.sqrt)
+_op("aten.rsqrt.default")(lambda x: lax.rsqrt(x))
+_op("aten.erf.default")(lax.erf)
+_op("aten.tanh.default")(jnp.tanh)
+_op("aten.sin.default")(jnp.sin)
+_op("aten.cos.default")(jnp.cos)
+_op("aten.reciprocal.default")(lambda x: 1.0 / x)
+_op("aten.relu.default", "aten.relu_.default")(jax.nn.relu)
+_op("aten.sigmoid.default")(jax.nn.sigmoid)
+_op("aten.silu.default", "aten.silu_.default")(jax.nn.silu)
+_op("aten.maximum.default")(jnp.maximum)
+_op("aten.minimum.default")(jnp.minimum)
+_op("aten.floor.default")(jnp.floor)
+_op("aten.round.default")(jnp.round)
+_op("aten.logical_not.default")(jnp.logical_not)
+_op("aten.logical_and.default")(jnp.logical_and)
+_op("aten.logical_or.default")(jnp.logical_or)
+_op("aten.bitwise_not.default")(
+    lambda x: jnp.logical_not(x) if x.dtype == jnp.bool_
+    else jnp.bitwise_not(x))
+_op("aten.eq.Scalar", "aten.eq.Tensor")(lambda x, y: x == y)
+_op("aten.ne.Scalar", "aten.ne.Tensor")(lambda x, y: x != y)
+_op("aten.lt.Scalar", "aten.lt.Tensor")(lambda x, y: x < y)
+_op("aten.le.Scalar", "aten.le.Tensor")(lambda x, y: x <= y)
+_op("aten.gt.Scalar", "aten.gt.Tensor")(lambda x, y: x > y)
+_op("aten.ge.Scalar", "aten.ge.Tensor")(lambda x, y: x >= y)
+_op("aten.where.self")(jnp.where)
+_op("aten.clone.default")(lambda x, memory_format=None: x)
+_op("aten.alias.default", "aten.detach.default", "aten.lift_fresh.default",
+    "aten.contiguous.default")(lambda x, *a, **k: x)
+_op("aten._assert_tensor_metadata.default")(lambda *a, **k: None)
+_op("aten.sym_size.int")(lambda x, d: x.shape[d])
+
+
+@_op("aten.clamp.default")
+def _clamp(x, mn=None, mx=None):
+    return jnp.clip(x, mn, mx)
+
+
+@_op("aten.hardtanh.default", "aten.hardtanh_.default")
+def _hardtanh(x, mn=-1.0, mx=1.0):
+    return jnp.clip(x, mn, mx)
+
+
+@_op("aten.leaky_relu.default")
+def _leaky_relu(x, slope=0.01):
+    return jax.nn.leaky_relu(x, slope)
+
+
+@_op("aten.elu.default")
+def _elu(x, alpha=1.0, scale=1.0, input_scale=1.0):
+    return scale * jax.nn.elu(x * input_scale, alpha)
+
+
+@_op("aten.gelu.default", "aten.gelu_.default")
+def _gelu(x, approximate="none"):
+    return jax.nn.gelu(x, approximate=(approximate == "tanh"))
+
+
+@_op("aten.mm.default")
+def _mm(a, b):
+    return a @ b
+
+
+@_op("aten.bmm.default")
+def _bmm(a, b):
+    return jnp.einsum("bij,bjk->bik", a, b)
+
+
+@_op("aten.matmul.default")
+def _matmul(a, b):
+    return a @ b
+
+
+@_op("aten.addmm.default")
+def _addmm(bias, a, b, beta=1, alpha=1):
+    out = a @ b
+    if alpha != 1:
+        out = out * alpha
+    return out + (bias * beta if beta != 1 else bias)
+
+
+@_op("aten.baddbmm.default")
+def _baddbmm(bias, a, b, beta=1, alpha=1):
+    out = jnp.einsum("bij,bjk->bik", a, b)
+    if alpha != 1:
+        out = out * alpha
+    return out + (bias * beta if beta != 1 else bias)
+
+
+@_op("aten.t.default")
+def _t(x):
+    return x.T
+
+
+@_op("aten.view.default", "aten.reshape.default", "aten._unsafe_view.default")
+def _view(x, shape):
+    return jnp.reshape(x, [int(s) for s in shape])
+
+
+@_op("aten.permute.default")
+def _permute(x, dims):
+    return jnp.transpose(x, dims)
+
+
+@_op("aten.transpose.int")
+def _transpose(x, d0, d1):
+    return jnp.swapaxes(x, d0, d1)
+
+
+@_op("aten.unsqueeze.default")
+def _unsqueeze(x, dim):
+    return jnp.expand_dims(x, dim)
+
+
+@_op("aten.squeeze.dim", "aten.squeeze.dims")
+def _squeeze(x, dim):
+    dims = (dim,) if isinstance(dim, int) else tuple(dim)
+    dims = tuple(d for d in dims if x.shape[d] == 1)
+    return jnp.squeeze(x, dims) if dims else x
+
+
+@_op("aten.expand.default")
+def _expand(x, sizes, implicit=False):
+    # -1 keeps the existing dim; leading new axes broadcast
+    nd_new = len(sizes) - x.ndim
+    shape = []
+    for i, s in enumerate(sizes):
+        if int(s) == -1:
+            shape.append(x.shape[i - nd_new] if i >= nd_new else 1)
+        else:
+            shape.append(int(s))
+    return jnp.broadcast_to(x, shape)
+
+
+@_op("aten.cat.default")
+def _cat(tensors, dim=0):
+    return jnp.concatenate(tensors, axis=dim)
+
+
+@_op("aten.stack.default")
+def _stack(tensors, dim=0):
+    return jnp.stack(tensors, axis=dim)
+
+
+@_op("aten.split.Tensor", "aten.split_with_sizes.default")
+def _split(x, sizes, dim=0):
+    if isinstance(sizes, int):
+        n = x.shape[dim]
+        sizes = [sizes] * (n // sizes) + ([n % sizes] if n % sizes else [])
+    idx = np.cumsum(sizes)[:-1].tolist()
+    return tuple(jnp.split(x, idx, axis=dim))
+
+
+@_op("aten.slice.Tensor")
+def _slice(x, dim=0, start=None, end=None, step=1):
+    start = 0 if start is None else start
+    end = x.shape[dim] if end is None or end >= _INT64_MAX else end
+    ix = [slice(None)] * x.ndim
+    ix[dim] = slice(start, end, step)
+    return x[tuple(ix)]
+
+
+@_op("aten.select.int")
+def _select(x, dim, index):
+    return lax.index_in_dim(x, index, axis=dim, keepdims=False)
+
+
+@_op("aten.index_select.default")
+def _index_select(x, dim, index):
+    return jnp.take(x, index, axis=dim)
+
+
+@_op("aten.gather.default")
+def _gather(x, dim, index, sparse_grad=False):
+    return jnp.take_along_axis(x, index, axis=dim)
+
+
+@_op("aten.embedding.default")
+def _embedding(weight, indices, padding_idx=-1, scale_grad_by_freq=False,
+               sparse=False):
+    return jnp.take(weight, indices, axis=0)
+
+
+@_op("aten.masked_fill.Scalar", "aten.masked_fill.Tensor")
+def _masked_fill(x, mask, value):
+    return jnp.where(mask, jnp.asarray(value, x.dtype), x)
+
+
+@_op("aten.cumsum.default")
+def _cumsum(x, dim, dtype=None):
+    out = jnp.cumsum(x, axis=dim)
+    return out.astype(_torch_dtype_to_jnp(dtype)) if dtype else out
+
+
+@_op("aten.tril.default")
+def _tril(x, diagonal=0):
+    return jnp.tril(x, diagonal)
+
+
+@_op("aten.triu.default")
+def _triu(x, diagonal=0):
+    return jnp.triu(x, diagonal)
+
+
+@_op("aten.sum.dim_IntList", "aten.sum.default")
+def _sum(x, dim=None, keepdim=False, dtype=None):
+    out = jnp.sum(x, axis=tuple(dim) if isinstance(dim, (list, tuple))
+                  else dim, keepdims=keepdim)
+    return out.astype(_torch_dtype_to_jnp(dtype)) if dtype else out
+
+
+@_op("aten.mean.dim", "aten.mean.default")
+def _mean(x, dim=None, keepdim=False, dtype=None):
+    out = jnp.mean(x, axis=tuple(dim) if isinstance(dim, (list, tuple))
+                   else dim, keepdims=keepdim)
+    return out.astype(_torch_dtype_to_jnp(dtype)) if dtype else out
+
+
+@_op("aten.var.correction")
+def _var(x, dim=None, correction=1, keepdim=False):
+    return jnp.var(x, axis=tuple(dim) if isinstance(dim, (list, tuple))
+                   else dim, ddof=int(correction), keepdims=keepdim)
+
+
+@_op("aten.amax.default")
+def _amax(x, dim=None, keepdim=False):
+    return jnp.max(x, axis=tuple(dim) if isinstance(dim, (list, tuple))
+                   else dim, keepdims=keepdim)
+
+
+@_op("aten.amin.default")
+def _amin(x, dim=None, keepdim=False):
+    return jnp.min(x, axis=tuple(dim) if isinstance(dim, (list, tuple))
+                   else dim, keepdims=keepdim)
+
+
+@_op("aten.argmax.default")
+def _argmax(x, dim=None, keepdim=False):
+    return jnp.argmax(x, axis=dim, keepdims=keepdim).astype(jnp.int32)
+
+
+@_op("aten.any.dim", "aten.any.default")
+def _any(x, dim=None, keepdim=False):
+    return jnp.any(x, axis=dim, keepdims=keepdim)
+
+
+@_op("aten.all.dim", "aten.all.default")
+def _all(x, dim=None, keepdim=False):
+    return jnp.all(x, axis=dim, keepdims=keepdim)
+
+
+@_op("aten._softmax.default", "aten.softmax.int")
+def _softmax(x, dim, half_to_float=False):
+    return jax.nn.softmax(x, axis=dim)
+
+
+@_op("aten._log_softmax.default", "aten.log_softmax.int")
+def _log_softmax(x, dim, half_to_float=False):
+    return jax.nn.log_softmax(x, axis=dim)
+
+
+@_op("aten.native_layer_norm.default")
+def _native_layer_norm(x, normalized_shape, weight, bias, eps):
+    axes = tuple(range(x.ndim - len(normalized_shape), x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    rstd = lax.rsqrt(var + eps)
+    out = (xf - mean) * rstd
+    if weight is not None:
+        out = out * weight.astype(jnp.float32)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out.astype(x.dtype), mean, rstd
+
+
+@_op("aten._native_batch_norm_legit_no_training.default")
+def _bn_eval(x, weight, bias, running_mean, running_var, momentum, eps):
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    mean = running_mean.reshape(shape)
+    var = running_var.reshape(shape)
+    out = (x - mean) * lax.rsqrt(var + eps)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out, jnp.zeros((0,), x.dtype), jnp.zeros((0,), x.dtype)
+
+
+@_op("aten.native_group_norm.default")
+def _group_norm(x, weight, bias, n, c, hw, group, eps):
+    b = x.shape[0]
+    xg = x.reshape((b, group, -1))
+    mean = jnp.mean(xg, axis=-1, keepdims=True)
+    var = jnp.var(xg, axis=-1, keepdims=True)
+    out = ((xg - mean) * lax.rsqrt(var + eps)).reshape(x.shape)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out, mean, var
+
+
+@_op("aten.convolution.default")
+def _convolution(x, weight, bias, stride, padding, dilation, transposed,
+                 output_padding, groups):
+    stride, padding = tuple(stride), tuple(padding)
+    dilation = tuple(dilation)
+    nd = len(stride)
+    if transposed:
+        pads = tuple((p, p) for p in padding)
+        out = lax.conv_transpose(
+            x, weight, strides=stride, padding=pads,
+            rhs_dilation=dilation,
+            dimension_numbers=_conv_dims(nd), transpose_kernel=True)
+    else:
+        out = lax.conv_general_dilated(
+            x, weight, window_strides=stride,
+            padding=tuple((p, p) for p in padding),
+            rhs_dilation=dilation, feature_group_count=groups,
+            dimension_numbers=_conv_dims(nd))
+    if bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+def _conv_dims(nd: int):
+    sp = "DHW"[-nd:]
+    return (f"NC{sp}", f"OI{sp}", f"NC{sp}")
+
+
+@_op("aten.max_pool2d.default")
+def _max_pool2d_single(x, kernel, stride=None, padding=0, dilation=1,
+                       ceil_mode=False):
+    return _max_pool2d(x, kernel, stride, padding, dilation, ceil_mode)[0]
+
+
+@_op("aten.max_pool2d_with_indices.default")
+def _max_pool2d(x, kernel, stride=None, padding=0, dilation=1,
+                ceil_mode=False):
+    k = _pair(kernel)
+    s = _pair(stride) if stride not in (None, []) else k
+    p = _pair(padding)
+    if _pair(dilation) != (1, 1):
+        raise NotImplementedError("dilated max_pool2d")
+    out = lax.reduce_window(
+        x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+        else jnp.iinfo(x.dtype).min,
+        lax.max, (1, 1) + k, (1, 1) + s,
+        ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])))
+    return out, None  # indices not materialized; loud failure if consumed
+
+
+@_op("aten.avg_pool2d.default")
+def _avg_pool2d(x, kernel, stride=None, padding=0, ceil_mode=False,
+                count_include_pad=True, divisor_override=None):
+    k = _pair(kernel)
+    s = _pair(stride) if stride not in (None, []) else k
+    p = _pair(padding)
+    summed = lax.reduce_window(
+        x, 0.0, lax.add, (1, 1) + k, (1, 1) + s,
+        ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])))
+    if divisor_override:
+        return summed / divisor_override
+    if count_include_pad or p == (0, 0):
+        return summed / (k[0] * k[1])
+    ones = jnp.ones_like(x)
+    counts = lax.reduce_window(
+        ones, 0.0, lax.add, (1, 1) + k, (1, 1) + s,
+        ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])))
+    return summed / counts
+
+
+@_op("aten._adaptive_avg_pool2d.default", "aten.adaptive_avg_pool2d.default")
+def _adaptive_avg_pool2d(x, output_size):
+    oh, ow = _pair(output_size)
+    h, w = x.shape[-2], x.shape[-1]
+    if h % oh or w % ow:
+        raise NotImplementedError("adaptive_avg_pool2d with non-divisible "
+                                  f"output {output_size} from {(h, w)}")
+    kh, kw = h // oh, w // ow
+    return _avg_pool2d(x, (kh, kw), (kh, kw))
+
+
+@_op("aten.full.default")
+def _full(size, fill_value, dtype=None, layout=None, device=None,
+          pin_memory=None):
+    dt = _torch_dtype_to_jnp(dtype) if dtype is not None else None
+    return jnp.full([int(s) for s in size], fill_value, dtype=dt)
+
+
+@_op("aten.full_like.default")
+def _full_like(x, fill_value, dtype=None, **kw):
+    dt = _torch_dtype_to_jnp(dtype) if dtype is not None else x.dtype
+    return jnp.full(x.shape, fill_value, dtype=dt)
+
+
+@_op("aten.zeros.default")
+def _zeros(size, dtype=None, **kw):
+    dt = _torch_dtype_to_jnp(dtype) if dtype is not None else jnp.float32
+    return jnp.zeros([int(s) for s in size], dtype=dt)
+
+
+@_op("aten.ones.default")
+def _ones(size, dtype=None, **kw):
+    dt = _torch_dtype_to_jnp(dtype) if dtype is not None else jnp.float32
+    return jnp.ones([int(s) for s in size], dtype=dt)
+
+
+@_op("aten.zeros_like.default")
+def _zeros_like(x, dtype=None, **kw):
+    dt = _torch_dtype_to_jnp(dtype) if dtype is not None else x.dtype
+    return jnp.zeros(x.shape, dtype=dt)
+
+
+@_op("aten.ones_like.default")
+def _ones_like(x, dtype=None, **kw):
+    dt = _torch_dtype_to_jnp(dtype) if dtype is not None else x.dtype
+    return jnp.ones(x.shape, dtype=dt)
+
+
+@_op("aten.scalar_tensor.default")
+def _scalar_tensor(value, dtype=None, **kw):
+    dt = _torch_dtype_to_jnp(dtype) if dtype is not None else None
+    return jnp.asarray(value, dtype=dt)
+
+
+@_op("aten.arange.default", "aten.arange.start", "aten.arange.start_step")
+def _arange(*args, dtype=None, **kw):
+    dt = _torch_dtype_to_jnp(dtype) if dtype is not None else None
+    if dt is None and all(isinstance(a, int) for a in args):
+        dt = jnp.int32
+    return jnp.arange(*args, dtype=dt)
+
+
+@_op("aten._to_copy.default", "aten.to.dtype")
+def _to_copy(x, dtype=None, layout=None, device=None, pin_memory=None,
+             non_blocking=False, memory_format=None):
+    if dtype is None:
+        return x
+    return x.astype(_torch_dtype_to_jnp(dtype))
+
+
+@_op("aten.type_as.default")
+def _type_as(x, other):
+    return x.astype(other.dtype)
+
+
+@_op("aten.dropout.default", "aten.native_dropout.default")
+def _dropout(x, p, train=None):
+    # exported in eval mode; if a train-mode graph slips through, dropout
+    # is identity (documented contract)
+    return x
+
+
+@_op("aten.repeat.default")
+def _repeat(x, repeats):
+    return jnp.tile(x, [int(r) for r in repeats])
+
+
+@_op("aten.flatten.using_ints")
+def _flatten(x, start_dim=0, end_dim=-1):
+    end = end_dim if end_dim >= 0 else x.ndim + end_dim
+    shape = x.shape[:start_dim] + (-1,) + x.shape[end + 1:]
+    return jnp.reshape(x, shape)
+
+
+@_op("aten.constant_pad_nd.default")
+def _constant_pad_nd(x, pad, value=0.0):
+    # torch pad: last dim first, (l, r) pairs
+    cfg = [(0, 0, 0)] * x.ndim
+    for i in range(len(pad) // 2):
+        cfg[x.ndim - 1 - i] = (pad[2 * i], pad[2 * i + 1], 0)
+    return lax.pad(x, jnp.asarray(value, x.dtype), cfg)
+
+
+def _getitem(obj, idx):
+    return operator.getitem(obj, idx)
+
+
+# ----------------------------------------------------------- converter
+
+class ConvertedModule:
+    """A torch module lowered to a pure JAX callable.
+
+    ``fn(params, buffers, *inputs)`` where params/buffers are dicts keyed
+    by torch FQN. Outputs follow the module's flattened output order
+    (single tensor unwrapped)."""
+
+    def __init__(self, graph_module, input_specs, output_specs,
+                 params: Dict[str, jnp.ndarray],
+                 buffers: Dict[str, jnp.ndarray],
+                 constants: Dict[str, jnp.ndarray],
+                 n_user_inputs: int,
+                 input_shapes: List[Tuple]):
+        self.gm = graph_module
+        self.input_specs = input_specs
+        self.output_specs = output_specs
+        self.params = params
+        self.buffers = buffers
+        self.constants = constants
+        self.n_user_inputs = n_user_inputs
+        self.input_shapes = input_shapes
+
+    def __call__(self, params: Dict[str, Any], buffers: Dict[str, Any],
+                 *user_inputs):
+        from torch.export.graph_signature import InputKind, OutputKind
+
+        env: Dict[str, Any] = {}
+        it_user = iter(user_inputs)
+        placeholders = [n for n in self.gm.graph.nodes
+                        if n.op == "placeholder"]
+        for node, spec in zip(placeholders, self.input_specs):
+            if spec.kind == InputKind.PARAMETER:
+                env[node.name] = params[spec.target]
+            elif spec.kind == InputKind.BUFFER:
+                env[node.name] = buffers[spec.target]
+            elif spec.kind == InputKind.CONSTANT_TENSOR:
+                env[node.name] = self.constants[spec.target]
+            elif spec.kind == InputKind.USER_INPUT:
+                env[node.name] = next(it_user)
+            else:
+                raise NotImplementedError(f"input kind {spec.kind}")
+
+        def resolve(a):
+            import torch.fx
+            if isinstance(a, torch.fx.Node):
+                return env[a.name]
+            if isinstance(a, (list, tuple)):
+                return type(a)(resolve(x) for x in a) \
+                    if not isinstance(a, tuple) else tuple(resolve(x)
+                                                           for x in a)
+            return a
+
+        import torch.fx  # noqa: F401 — resolve() uses it
+
+        result = None
+        for node in self.gm.graph.nodes:
+            if node.op == "placeholder":
+                continue
+            if node.op == "output":
+                result = resolve(node.args[0])
+                break
+            if node.op != "call_function":
+                raise NotImplementedError(f"fx node op {node.op}")
+            args = [resolve(a) for a in node.args]
+            kwargs = {k: resolve(v) for k, v in node.kwargs.items()}
+            target = node.target
+            if target is operator.getitem:
+                env[node.name] = _getitem(*args, **kwargs)
+                continue
+            # symbolic-shape arithmetic (dynamic batch dim) lowers to plain
+            # operator/math calls on python ints
+            if getattr(target, "__module__", None) in ("operator",
+                                                       "_operator", "math"):
+                env[node.name] = target(*args, **kwargs)
+                continue
+            key = str(target)
+            fn = _OPS.get(key)
+            if fn is None:
+                # try without the overload suffix
+                fn = _OPS.get(key.rsplit(".", 1)[0] + ".default")
+            if fn is None:
+                raise NotImplementedError(
+                    f"ATen op {key} has no JAX mapping in the bridge; "
+                    "add a handler to zoo_tpu.bridges.fx_bridge._OPS")
+            env[node.name] = fn(*args, **kwargs)
+
+        outs = []
+        for spec, val in zip(self.output_specs, result):
+            if spec.kind == OutputKind.USER_OUTPUT:
+                outs.append(val)
+        if len(outs) == 1:
+            return outs[0]
+        return tuple(outs)
+
+
+def convert_torch_export(module, example_args: Sequence,
+                         example_kwargs: Optional[dict] = None
+                         ) -> ConvertedModule:
+    """Trace ``module`` with torch.export (eval mode) and return a
+    :class:`ConvertedModule`."""
+    import torch
+
+    was_training = getattr(module, "training", False)
+    module = module.eval()
+    args = tuple(
+        torch.as_tensor(np.asarray(a)) if not isinstance(a, torch.Tensor)
+        else a for a in example_args)
+    # a shared symbolic batch dim keeps the traced graph batch-size
+    # polymorphic (otherwise view/expand bake in the example batch);
+    # fall back to a static trace for modules whose forward constrains it
+    try:
+        batch = torch.export.Dim("batch", min=1)
+        dyn = tuple({0: batch} if a.ndim > 0 else None for a in args)
+        ep = torch.export.export(module, args,
+                                 kwargs=example_kwargs or None,
+                                 dynamic_shapes=dyn)
+    except Exception:
+        ep = torch.export.export(module, args,
+                                 kwargs=example_kwargs or None)
+    ep = ep.run_decompositions()
+    sig = ep.graph_signature
+    params = {k: _t2j(v) for k, v in ep.state_dict.items()
+              if k in set(sig.parameters)}
+    buffers = {k: _t2j(v) for k, v in ep.state_dict.items()
+               if k in set(sig.buffers)}
+    constants = {k: _t2j(v) for k, v in ep.constants.items()
+                 if hasattr(v, "detach")}
+    # non-persistent buffers (e.g. HF position_ids) are excluded from
+    # state_dict and carried in ep.constants instead
+    for k in sig.buffers:
+        if k not in buffers and k in constants:
+            buffers[k] = constants[k]
+    n_user = sum(1 for s in sig.input_specs
+                 if s.kind.name == "USER_INPUT")
+    if was_training:
+        module.train()
+    return ConvertedModule(ep.graph_module, sig.input_specs,
+                           sig.output_specs, params, buffers, constants,
+                           n_user, [tuple(a.shape) for a in args])
+
+
+# ------------------------------------------------------ KerasNet adapter
+
+from zoo_tpu.pipeline.api.keras.engine.topology import KerasNet  # noqa: E402
+
+
+class TorchGraphNet(KerasNet):
+    """A :class:`ConvertedModule` presented through the KerasNet surface so
+    the whole estimator machinery (jitted sharded train step, superbatch
+    staging, checkpoints, summaries, triggers) drives a traced torch model
+    unchanged. Buffers ride in the ``stats`` subtree, which the train step
+    already treats as non-trainable state."""
+
+    def __init__(self, converted: ConvertedModule, output_index: int = 0,
+                 name: Optional[str] = None):
+        super().__init__(name=name or "torch_graph")
+        self.converted = converted
+        self.output_index = output_index
+        self.params = {"torch_graph": {"w": dict(converted.params),
+                                       "stats": dict(converted.buffers)}}
+        self._built_shapes = [(None,) + tuple(s[1:])
+                              for s in converted.input_shapes]
+
+    @property
+    def layers(self):
+        return []
+
+    def _input_shapes(self):
+        return self._built_shapes
+
+    def _init_params(self, rng, input_shapes):
+        return self.params
+
+    def _forward(self, params, inputs, *, training, rng, collect):
+        g = params["torch_graph"]
+        out = self.converted(g["w"], g.get("stats", {}), *inputs)
+        if isinstance(out, tuple):
+            out = out[self.output_index]
+        return out
+
+
+def torch_to_graph_net(module, example_inputs: Sequence,
+                       output_index: int = 0) -> TorchGraphNet:
+    """One-call torch module → trainable KerasNet (traced, weights
+    imported)."""
+    cm = convert_torch_export(module, example_inputs)
+    return TorchGraphNet(cm, output_index=output_index)

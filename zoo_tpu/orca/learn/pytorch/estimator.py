@@ -4,10 +4,11 @@ Rebuild of ``zoo.orca.learn.pytorch.estimator.Estimator.from_torch``
 (reference: ``pyzoo/zoo/orca/learn/pytorch/estimator.py:108,261`` with its
 two backends — Ray actors running DDP-over-gloo (``torch_runner.py:59``) or
 the jep-embedded ``TorchModel`` on the BigDL fabric). Both reference paths
-keep torch in the training loop; here the module is converted ONCE through
-:mod:`zoo_tpu.bridges.torch_bridge` into zoo_tpu layers (weights imported),
-then the whole step runs as XLA on the mesh — torch never executes on the
-hot path. The DDP allreduce becomes the mesh ``data`` axis gradient psum.
+keep torch in the training loop; here the module is traced ONCE through
+:mod:`zoo_tpu.bridges.fx_bridge` (torch.export → core-ATen graph → JAX
+interpreter, weights imported by FQN), then the whole step runs as XLA on
+the mesh — torch never executes on the hot path. The DDP allreduce becomes
+the mesh ``data`` axis gradient psum.
 """
 
 from __future__ import annotations
@@ -96,7 +97,8 @@ class Estimator:
                    loss_creator: Optional[Callable] = None,
                    config: Optional[dict] = None,
                    metrics=None, model_dir: Optional[str] = None,
-                   backend: str = "tpu") -> "PyTorchEstimator":
+                   backend: str = "tpu",
+                   dtype_policy: str = "float32") -> "PyTorchEstimator":
         """reference signature: ``Estimator.from_torch(model=..., optimizer,
         loss, model_creator, ...)`` (``pytorch/estimator.py:33``). Either
         pass instances or the reference's creator functions (called with
@@ -112,7 +114,8 @@ class Estimator:
             loss = loss_creator(cfg) if not _is_torch_loss(loss_creator) \
                 else loss_creator
         return PyTorchEstimator(model, optimizer, loss, metrics=metrics,
-                                model_dir=model_dir)
+                                model_dir=model_dir,
+                                dtype_policy=dtype_policy)
 
 
 class PyTorchEstimator(KerasEstimator):
@@ -120,12 +123,14 @@ class PyTorchEstimator(KerasEstimator):
     shape can be inferred from the first fit/predict data."""
 
     def __init__(self, torch_model, optimizer, loss, metrics=None,
-                 model_dir: Optional[str] = None):
+                 model_dir: Optional[str] = None,
+                 dtype_policy: str = "float32"):
         self.torch_model = torch_model
         self._optimizer_arg = _convert_optimizer(optimizer)
         self._loss_arg = _convert_loss(loss)
         self._metrics_arg = metrics or []
         self._model_dir_arg = model_dir
+        self._dtype_policy = dtype_policy
         self._converted = False
         super().__init__(model=None, model_dir=None)
         self.model_dir = model_dir
@@ -133,16 +138,16 @@ class PyTorchEstimator(KerasEstimator):
     def _ensure_converted(self, xs):
         if self._converted:
             return
-        from zoo_tpu.bridges.torch_bridge import torch_to_keras_model
+        from zoo_tpu.bridges.fx_bridge import torch_to_graph_net
         from zoo_tpu.orca.learn.ckpt import CheckpointManager
 
-        input_shape = xs[0].shape[1:] if len(xs) == 1 else None
-        if input_shape is None:
-            raise ValueError("torch bridge supports single-input models")
-        self.model = torch_to_keras_model(self.torch_model, input_shape)
+        # trace with a tiny example batch (2 rows of each input)
+        examples = [np.asarray(a[:2]) for a in xs]
+        self.model = torch_to_graph_net(self.torch_model, examples)
         self.model.compile(optimizer=self._optimizer_arg,
                            loss=self._loss_arg or "mse",
-                           metrics=self._metrics_arg)
+                           metrics=self._metrics_arg,
+                           dtype_policy=self._dtype_policy)
         if self._model_dir_arg:
             import os
             self._ckpt = CheckpointManager(
@@ -189,57 +194,15 @@ class PyTorchEstimator(KerasEstimator):
         return self.torch_model
 
     def _export_weights_to_torch(self):
+        """Write trained weights back by torch FQN — the fx bridge keeps
+        torch's own tensor layouts, so this is a plain state-dict copy."""
         import torch
 
-        import jax
-        params = jax.tree_util.tree_map(np.asarray, self.model.params)
-        from zoo_tpu.bridges.torch_bridge import convert_torch_module
-        # re-walk in the same order to pair torch modules with our layers
-        idx = 0
-        import torch.nn as tnn
-
-        def walk(m):
-            nonlocal idx
-            if isinstance(m, tnn.Sequential):
-                for c in m:
-                    walk(c)
-                return
-            key = self.model._key_of(self.model.layers[idx]) \
-                if idx < len(self.model.layers) else None
-            if isinstance(m, tnn.Linear):
-                p = params[key]
-                with torch.no_grad():
-                    m.weight.copy_(torch.from_numpy(np.ascontiguousarray(np.asarray(p["W"]).T)))
-                    if m.bias is not None and "b" in p:
-                        m.bias.copy_(torch.from_numpy(np.asarray(p["b"]).copy()))
-                idx += 1
-                return
-            if isinstance(m, tnn.Conv2d):
-                p = params[key]
-                with torch.no_grad():
-                    m.weight.copy_(torch.from_numpy(np.ascontiguousarray(
-                        np.transpose(np.asarray(p["W"]), (3, 2, 0, 1)))))
-                    if m.bias is not None and "b" in p:
-                        m.bias.copy_(torch.from_numpy(np.asarray(p["b"]).copy()))
-                idx += 1
-                return
-            if isinstance(m, tnn.Embedding):
-                with torch.no_grad():
-                    m.weight.copy_(torch.from_numpy(
-                        np.asarray(params[key]["E"]).copy()))
-                idx += 1
-                return
-            if isinstance(m, (tnn.BatchNorm1d, tnn.LayerNorm, tnn.LSTM,
-                              tnn.GRU, tnn.MaxPool2d, tnn.AvgPool2d,
-                              tnn.Flatten, tnn.Dropout)) or \
-                    type(m).__name__ in ("ReLU", "Sigmoid", "Tanh",
-                                         "Softmax", "GELU", "SiLU",
-                                         "LeakyReLU", "ELU", "Identity"):
-                # stateless or not-yet-exported stateful layers advance the
-                # cursor only if the bridge emitted a layer for them
-                if not isinstance(m, tnn.Identity):
-                    idx += 1
-                return
-            idx += 1
-
-        walk(self.torch_model)
+        w = self.model.params["torch_graph"]["w"]
+        named = dict(self.torch_model.named_parameters())
+        with torch.no_grad():
+            for fqn, val in w.items():
+                if fqn in named:
+                    t = named[fqn]
+                    t.copy_(torch.from_numpy(
+                        np.asarray(val).copy()).to(t.dtype))

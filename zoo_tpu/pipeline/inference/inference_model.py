@@ -50,12 +50,24 @@ class InferenceModel:
         from zoo_tpu.pipeline.api.keras.engine.topology import KerasNet
         return self.load_keras(KerasNet.load(path), batch_size=batch_size)
 
-    def load_torch(self, torch_model, input_shape,
-                   batch_size: Optional[int] = None):
-        """reference: ``doLoadPyTorch`` — via the structural bridge."""
-        from zoo_tpu.bridges.torch_bridge import torch_to_keras_model
+    def load_torch(self, torch_model, input_shape=None,
+                   batch_size: Optional[int] = None,
+                   example_inputs=None, input_dtype="float32"):
+        """reference: ``doLoadPyTorch`` — via the torch.export fx bridge
+        (arbitrary forward graphs, not just Sequential). Pass
+        ``example_inputs`` (list of arrays, batch dim included) for
+        multi-input or non-float models, or ``input_dtype`` (e.g. "int32"
+        for embedding-first nets) with ``input_shape``."""
+        import numpy as _np
+
+        from zoo_tpu.bridges.fx_bridge import torch_to_graph_net
+        if example_inputs is None:
+            if input_shape is None:
+                raise ValueError("pass input_shape= or example_inputs=")
+            example_inputs = [_np.zeros((2,) + tuple(input_shape),
+                                        _np.dtype(input_dtype))]
         return self.load_keras(
-            torch_to_keras_model(torch_model, input_shape),
+            torch_to_graph_net(torch_model, list(example_inputs)),
             batch_size=batch_size)
 
     # -- inference ---------------------------------------------------------

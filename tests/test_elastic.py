@@ -1,0 +1,119 @@
+"""Elastic training: retry-from-latest-checkpoint supervision.
+
+Reference semantics: ``Topology.scala:1255-1337`` — on any Throwable the
+optimizer reloads the newest ``model.N``/``optimMethod-*.N`` snapshot and
+continues, bounded by ``bigdl.failure.retryTimes`` within a sliding time
+window. The fault here is injected by sabotaging the jitted train step
+mid-epoch — the supervisor must restore and finish with a decreasing loss
+trajectory.
+"""
+
+import numpy as np
+import pytest
+
+from zoo_tpu.orca.learn.keras import Estimator
+from zoo_tpu.pipeline.api.keras.engine.topology import Sequential
+from zoo_tpu.pipeline.api.keras.layers import Dense
+
+
+def _make_model():
+    m = Sequential()
+    m.add(Dense(16, input_shape=(8,), activation="relu"))
+    m.add(Dense(1))
+    m.compile(optimizer="adam", loss="mse")
+    return m
+
+
+def _data(n=512, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, 8).astype(np.float32)
+    w = rs.randn(8, 1).astype(np.float32)
+    return {"x": x, "y": (x @ w).astype(np.float32)}
+
+
+class _SabotagedStep:
+    """Wraps the jitted train step; raises once at a given global call."""
+
+    def __init__(self, real, fail_at_call: int):
+        self.real = real
+        self.calls = 0
+        self.fail_at = fail_at_call
+        self.fired = False
+
+    def __call__(self, *args, **kwargs):
+        self.calls += 1
+        if self.calls == self.fail_at and not self.fired:
+            self.fired = True
+            raise RuntimeError("injected mid-epoch fault")
+        return self.real(*args, **kwargs)
+
+
+def test_elastic_retry_resumes_training(orca_ctx, tmp_path):
+    data = _data()
+    est = Estimator.from_keras(_make_model(), model_dir=str(tmp_path))
+
+    # epoch 1 clean (checkpoint written), then sabotage epoch 2 mid-way
+    h1 = est.fit(data, epochs=1, batch_size=64)
+    assert est._ckpt.latest_step() == 1
+
+    est.model.build()
+    if est.model._jit_train is None:
+        est.model._jit_train = est.model._build_train_step()
+    sab = _SabotagedStep(est.model._jit_train, fail_at_call=3)
+    est.model._jit_train = sab
+
+    h2 = est.fit(data, epochs=2, batch_size=64)
+    assert sab.fired  # the fault actually happened mid-epoch
+    # supervisor restored and completed both epochs
+    assert len(h2["loss"]) == 2
+    assert est._epoch == 3
+    # loss trajectory continues downward across the fault
+    assert h2["loss"][-1] < h1["loss"][0]
+    # post-fault the model is usable
+    preds = est.predict(data["x"][:8])
+    assert np.isfinite(preds).all()
+
+
+def test_elastic_retries_exhaust(orca_ctx, tmp_path):
+    data = _data(n=128)
+    est = Estimator.from_keras(_make_model(), model_dir=str(tmp_path))
+    est.fit(data, epochs=1, batch_size=64)
+
+    class _AlwaysFail:
+        def __call__(self, *a, **k):
+            raise RuntimeError("permanent fault")
+
+    est.model._jit_train = _AlwaysFail()
+    with pytest.raises(RuntimeError, match="permanent fault"):
+        est.fit(data, epochs=1, batch_size=64, max_failure_retries=2)
+
+
+def test_failure_without_checkpoint_dir_propagates(orca_ctx):
+    data = _data(n=128)
+    est = Estimator.from_keras(_make_model())  # no model_dir → no ckpts
+    est.fit(data, epochs=1, batch_size=64)
+
+    class _AlwaysFail:
+        def __call__(self, *a, **k):
+            raise RuntimeError("no restore possible")
+
+    est.model._jit_train = _AlwaysFail()
+    with pytest.raises(RuntimeError, match="no restore possible"):
+        est.fit(data, epochs=1, batch_size=64)
+
+
+def test_optimizer_state_restored(orca_ctx, tmp_path):
+    """The snapshot must carry optimizer state (momentum etc.), not just
+    params — the reference reloads ``optimMethod-*.N`` too."""
+    data = _data(n=128)
+    est = Estimator.from_keras(_make_model(), model_dir=str(tmp_path))
+    est.fit(data, epochs=2, batch_size=64)
+    assert est.model._opt_state is not None
+    est._restore_latest()
+    restored = est.model._opt_state
+    assert restored is not None
+    # adam state: step count reflects training progress
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(restored)
+    assert any(np.asarray(l).size > 0 for l in leaves)

@@ -1,0 +1,95 @@
+"""Multi-host data path: per-process shards → globally-sharded batches.
+
+Spawns a real 2-process JAX CPU cluster (``jax.distributed.initialize``
+with a localhost coordinator — the reference's "local[4] = real fabric,
+local topology" trick, SURVEY §4.3) and runs estimator ``fit`` where each
+process holds only its half of the data. The global batch is assembled via
+``jax.make_array_from_process_local_data`` inside ``_put_batch`` — no
+driver-side collect (VERDICT round-1 item #5)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_WORKER = r"""
+import os, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+coord, pid, pcnt = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+jax.distributed.initialize(coordinator_address=coord, num_processes=pcnt,
+                           process_id=pid)
+assert jax.process_count() == pcnt
+assert len(jax.devices()) == pcnt * 2  # 2 local devices per process
+
+from zoo_tpu.orca import init_orca_context, stop_orca_context
+from zoo_tpu.orca.data.shard import LocalXShards, shards_for_process
+from zoo_tpu.orca.learn.keras import Estimator
+from zoo_tpu.pipeline.api.keras.engine.topology import Sequential
+from zoo_tpu.pipeline.api.keras.layers import Dense
+
+init_orca_context(cluster_mode="tpu")  # multi-process path
+
+# every process builds the same logical dataset, then keeps its own shards
+rs = np.random.RandomState(0)
+x = rs.randn(256, 8).astype(np.float32)
+w = rs.randn(8, 1).astype(np.float32)
+y = (x @ w).astype(np.float32)
+all_shards = LocalXShards.partition({"x": x, "y": y}, num_shards=8)
+mine = shards_for_process(all_shards)
+assert mine.num_partitions() == 8 // pcnt
+
+m = Sequential()
+m.add(Dense(16, input_shape=(8,), activation="relu"))
+m.add(Dense(1))
+m.compile(optimizer="adam", loss="mse")
+est = Estimator.from_keras(m)
+hist = est.fit(mine, epochs=3, batch_size=32)  # global batch 32 -> 16/proc
+losses = hist["loss"]
+assert all(np.isfinite(l) for l in losses), losses
+assert losses[-1] < losses[0], losses
+
+# multi-host predict: each process gets predictions for ITS local rows
+from zoo_tpu.pipeline.api.keras.engine import data_utils
+local_x = data_utils.to_xy_arrays(mine, None)[0][0]
+preds = m.predict(local_x, batch_size=32)
+assert preds.shape == (local_x.shape[0], 1), preds.shape
+assert np.isfinite(preds).all()
+print(f"proc {pid} OK losses={losses}")
+stop_orca_context()
+"""
+
+
+@pytest.mark.timeout(300)
+def test_two_process_cpu_cluster_fit(tmp_path):
+    port = _free_port()
+    coord = f"127.0.0.1:{port}"
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.getcwd() + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), coord, str(i), "2"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for i in range(2)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
+        assert f"proc {i} OK" in out
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

@@ -411,11 +411,24 @@ def _convolution(x, weight, bias, stride, padding, dilation, transposed,
     dilation = tuple(dilation)
     nd = len(stride)
     if transposed:
-        pads = tuple((p, p) for p in padding)
-        out = lax.conv_transpose(
-            x, weight, strides=stride, padding=pads,
-            rhs_dilation=dilation,
-            dimension_numbers=_conv_dims(nd), transpose_kernel=True)
+        if groups != 1:
+            raise NotImplementedError("grouped ConvTranspose in the bridge")
+        # torch semantics: out = (i-1)*s - 2p + d*(k-1) + output_padding + 1
+        # implemented as a fractionally-strided conv: lhs_dilation=s, the
+        # kernel spatially flipped and (in,out) transposed, with pads
+        # d*(k-1)-p (low) / d*(k-1)-p+output_padding (high)
+        op = tuple(output_padding)
+        k = weight.shape[2:]
+        spatial = tuple(range(2, 2 + nd))
+        w = jnp.swapaxes(jnp.flip(weight, spatial), 0, 1)
+        pads = tuple(
+            (dilation[i] * (k[i] - 1) - padding[i],
+             dilation[i] * (k[i] - 1) - padding[i] + op[i])
+            for i in range(nd))
+        out = lax.conv_general_dilated(
+            x, w, window_strides=(1,) * nd, padding=pads,
+            lhs_dilation=stride, rhs_dilation=dilation,
+            dimension_numbers=_conv_dims(nd))
     else:
         out = lax.conv_general_dilated(
             x, weight, window_strides=stride,
@@ -446,17 +459,27 @@ def _max_pool2d(x, kernel, stride=None, padding=0, dilation=1,
     p = _pair(padding)
     if _pair(dilation) != (1, 1):
         raise NotImplementedError("dilated max_pool2d")
+    hi = [p[0], p[1]]
+    if ceil_mode:
+        # extra high-side -inf padding so the last partial window counts
+        # (torch ceil_mode); identity element keeps values exact
+        for i in (0, 1):
+            span = x.shape[2 + i] + 2 * p[i] - k[i]
+            extra = (-span) % s[i]
+            hi[i] = p[i] + extra
     out = lax.reduce_window(
         x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
         else jnp.iinfo(x.dtype).min,
         lax.max, (1, 1) + k, (1, 1) + s,
-        ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])))
+        ((0, 0), (0, 0), (p[0], hi[0]), (p[1], hi[1])))
     return out, None  # indices not materialized; loud failure if consumed
 
 
 @_op("aten.avg_pool2d.default")
 def _avg_pool2d(x, kernel, stride=None, padding=0, ceil_mode=False,
                 count_include_pad=True, divisor_override=None):
+    if ceil_mode:
+        raise NotImplementedError("avg_pool2d with ceil_mode=True")
     k = _pair(kernel)
     s = _pair(stride) if stride not in (None, []) else k
     p = _pair(padding)

@@ -245,3 +245,20 @@ def _concat_leaf(parts):
         return type(parts[0])(
             _concat_leaf([p[i] for p in parts]) for i in range(len(parts[0])))
     return np.concatenate([np.asarray(p) for p in parts], axis=0)
+
+
+def shards_for_process(shards: "LocalXShards",
+                       process_index: "Optional[int]" = None,
+                       process_count: "Optional[int]" = None
+                       ) -> "LocalXShards":
+    """Select this JAX process's partitions (round-robin) — the multi-host
+    data plane: each host keeps only the shards it will feed into
+    ``make_array_from_process_local_data``, no driver-side collect
+    (reference: ``ray_xshards.py:250`` locality-aware partition→actor
+    assignment)."""
+    import jax
+
+    pi = jax.process_index() if process_index is None else process_index
+    pcnt = jax.process_count() if process_count is None else process_count
+    parts = shards.collect()
+    return LocalXShards(parts[pi::pcnt])

@@ -42,20 +42,29 @@ class CheckpointManager:
             self._ckptr = None
 
     # -- write ------------------------------------------------------------
-    def save(self, step: int, state: Any):
+    def save(self, step: int, state: Any, aux: Any = None):
+        """``aux`` is an optional side pytree (e.g. optax optimizer state,
+        whose NamedTuple structure orbax would flatten) stored pickled next
+        to the main state — the reference writes ``optimMethod-<name>.N``
+        beside ``model.N`` the same way."""
         path = os.path.join(self.directory, str(step))
         host_state = _ensure_host(state)
+        saved = False
         if self._ckptr is not None:
             try:
                 self._ckptr.save(path, host_state, force=True)
                 self._ckptr.wait_until_finished()
-                self._gc()
-                return
+                saved = True
             except Exception:
                 pass
-        os.makedirs(path, exist_ok=True)
-        with open(os.path.join(path, "state.pkl"), "wb") as f:
-            pickle.dump(host_state, f, protocol=pickle.HIGHEST_PROTOCOL)
+        if not saved:
+            os.makedirs(path, exist_ok=True)
+            with open(os.path.join(path, "state.pkl"), "wb") as f:
+                pickle.dump(host_state, f, protocol=pickle.HIGHEST_PROTOCOL)
+        if aux is not None:
+            with open(os.path.join(path, "aux.pkl"), "wb") as f:
+                pickle.dump(_ensure_host(aux), f,
+                            protocol=pickle.HIGHEST_PROTOCOL)
         self._gc()
 
     # -- read -------------------------------------------------------------
@@ -91,6 +100,19 @@ class CheckpointManager:
         if target is not None:
             return self._ckptr.restore(path, target=_ensure_host(target))
         return self._ckptr.restore(path)
+
+    def restore_aux(self, step: Optional[int] = None) -> Any:
+        """Load the side pytree written with ``save(..., aux=...)``;
+        None if the step has none."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            return None
+        path = os.path.join(self.directory, str(step), "aux.pkl")
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            return pickle.load(f)
 
     def _gc(self):
         steps = self.all_steps()

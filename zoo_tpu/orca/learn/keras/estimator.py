@@ -55,19 +55,66 @@ class KerasEstimator:
             label_cols: Optional[Sequence[str]] = None,
             validation_data=None,
             checkpoint_trigger: Optional[Trigger] = None,
-            shuffle: bool = True) -> Dict[str, List[float]]:
+            shuffle: bool = True,
+            max_failure_retries: int = 5,
+            retry_time_interval: float = 120.0) -> Dict[str, List[float]]:
         """reference: ``spark_estimator.Estimator.fit`` signature (data,
         epochs, batch_size, feature_cols, label_cols, validation_data,
-        checkpoint_trigger)."""
+        checkpoint_trigger).
+
+        Elastic training (reference: ``Topology.scala:1255-1337``, SURVEY
+        §5.3): when a ``model_dir`` checkpoint manager is configured, any
+        exception inside an epoch restores the latest snapshot (params +
+        optimizer state) and retries, bounded by ``max_failure_retries``
+        failures within a ``retry_time_interval``-second sliding window
+        (the reference's ``bigdl.failure.retryTimes`` /
+        ``retryTimeInterval`` sysprops, defaults 5 / 120s). Without a
+        checkpoint manager there is nothing to restore, so failures
+        propagate immediately."""
+        import logging
+        import time as _time
+
         if checkpoint_trigger is None and self._ckpt is not None:
             checkpoint_trigger = EveryEpoch()
         history: Dict[str, List[float]] = {}
-        for _ in range(epochs):
-            h = self.model.fit(
-                data, batch_size=batch_size, nb_epoch=1,
-                validation_data=validation_data,
-                feature_cols=feature_cols, label_cols=label_cols,
-                shuffle=shuffle, seed=self._epoch, verbose=0)
+        retries, no_progress, last_failure = 0, 0, 0.0
+        if self._ckpt is not None and self._ckpt.latest_step() is None \
+                and self.model.params is not None:
+            # snapshot the starting point so a first-epoch failure has
+            # somewhere to restore to
+            self._save_checkpoint()
+        # train until the epoch counter reaches target — a rollback lowers
+        # the counter, so lost epochs are retrained (reference endWhen)
+        target = self._epoch + epochs
+        while self._epoch < target:
+            try:
+                h = self.model.fit(
+                    data, batch_size=batch_size, nb_epoch=1,
+                    validation_data=validation_data,
+                    feature_cols=feature_cols, label_cols=label_cols,
+                    shuffle=shuffle, seed=self._epoch, verbose=0)
+            except Exception as e:  # noqa: BLE001 — the retry perimeter
+                now = _time.monotonic()
+                if now - last_failure > retry_time_interval:
+                    retries = 0  # sliding window: old failures expire
+                retries += 1
+                no_progress += 1
+                last_failure = now
+                if (self._ckpt is None
+                        or self._ckpt.latest_step() is None
+                        or retries > max_failure_retries
+                        # a deterministic failure slower than the window
+                        # must not retry forever: hard-cap consecutive
+                        # rollbacks with no completed epoch in between
+                        or no_progress > 2 * max_failure_retries):
+                    raise
+                logging.getLogger(__name__).warning(
+                    "training failed (%s: %s); retry %d/%d from latest "
+                    "checkpoint", type(e).__name__, e, retries,
+                    max_failure_retries)
+                self._restore_latest()
+                continue
+            no_progress = 0
             self._epoch += 1
             for k, v in h.items():
                 history.setdefault(k, []).extend(v)
@@ -78,7 +125,16 @@ class KerasEstimator:
 
     def _save_checkpoint(self):
         state = {"params": self.model.params, "epoch": self._epoch}
-        self._ckpt.save(self._epoch, state)
+        self._ckpt.save(self._epoch, state, aux=self.model._opt_state)
+
+    def _restore_latest(self):
+        """Reload the newest snapshot: params, optimizer state, epoch
+        counter — the reference's retry loop reloads ``model.N`` +
+        ``optimMethod-*.N`` the same way."""
+        state = self._ckpt.restore(None)
+        self.model.params = state["params"]
+        self.model._opt_state = self._ckpt.restore_aux(None)
+        self._epoch = int(state.get("epoch", 0))
 
     def load_orca_checkpoint(self, path: Optional[str] = None,
                              version: Optional[int] = None):

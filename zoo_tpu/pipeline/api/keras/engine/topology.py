@@ -212,7 +212,14 @@ class KerasNet:
         mesh = self._mesh()
         if mesh is None:
             return [jnp.asarray(a) for a in arrs]
-        from zoo_tpu.parallel.mesh import batch_sharding
+        from zoo_tpu.parallel.mesh import batch_sharding, host_local_to_global
+        if jax.process_count() > 1:
+            # multi-host: each process contributes its local rows of the
+            # global batch — assembled without any driver-side collect
+            # (SURVEY §7.4 hard part #1; reference: ray_xshards.py locality)
+            return [host_local_to_global(mesh,
+                                         batch_sharding(mesh, a.ndim).spec,
+                                         np.asarray(a)) for a in arrs]
         return [jax.device_put(a, batch_sharding(mesh, a.ndim)) for a in arrs]
 
     def _adapt_inputs(self, xs: List[np.ndarray]) -> List[np.ndarray]:
@@ -296,9 +303,17 @@ class KerasNet:
         if mesh is not None:
             from zoo_tpu.parallel.mesh import validate_batch_size
             validate_batch_size(batch_size, mesh)
-        if n < batch_size:
-            raise ValueError(f"dataset ({n}) smaller than batch_size "
-                             f"({batch_size})")
+        # multi-host SPMD: ``batch_size`` is the GLOBAL batch; each process
+        # feeds its local rows (batch_size / process_count). Every process
+        # must hold the same local sample count so step counts agree.
+        pc = jax.process_count()
+        if batch_size % pc:
+            raise ValueError(f"batch_size ({batch_size}) must divide by "
+                             f"process_count ({pc})")
+        local_bs = batch_size // pc
+        if n < local_bs:
+            raise ValueError(f"local dataset ({n}) smaller than per-process "
+                             f"batch ({local_bs})")
 
         self.build(jax.random.PRNGKey(seed),
                    [(None,) + a.shape[1:] for a in xs])
@@ -329,25 +344,34 @@ class KerasNet:
         # per-batch puts pay a full transport round trip each (~100ms on a
         # tunneled PJRT backend) which no depth-2 prefetch can hide. The
         # staging thread still overlaps transfer with compute.
-        group = max(1, min(16, (64 << 20) // max(sample_bytes * batch_size,
+        group = max(1, min(16, (64 << 20) // max(sample_bytes * local_bs,
                                                  1)))
+        if pc > 1:
+            # a staged multi-host global array cannot be host-sliced into
+            # sub-batches; assemble exactly one global batch per put
+            group = 1
         for epoch in range(nb_epoch):
             t0 = time.time()
             loss_sum, n_steps = None, 0
             batches = DoubleBufferedIterator(
-                data_utils.batch_slices(n, batch_size, shuffle, nprng,
+                data_utils.batch_slices(n, local_bs, shuffle, nprng,
                                         group=group),
                 stage_fn=lambda idx: self._put_batch(
                     [a[idx] for a in arrs]))
             try:
                 for staged in batches:
-                    for j in range(staged[0].shape[0] // batch_size):
-                        # re-place the sub-slice so a multi-device mesh
-                        # keeps the guaranteed batch sharding (device-to-
-                        # device; a no-op on one chip)
-                        sub = self._put_batch(
-                            [t[j * batch_size:(j + 1) * batch_size]
-                             for t in staged])
+                    n_sub = (staged[0].shape[0] // local_bs if group > 1
+                             else 1)
+                    for j in range(n_sub):
+                        if group > 1:
+                            # re-place the sub-slice so a multi-device mesh
+                            # keeps the guaranteed batch sharding (device-
+                            # to-device; a no-op on one chip)
+                            sub = self._put_batch(
+                                [t[j * local_bs:(j + 1) * local_bs]
+                                 for t in staged])
+                        else:
+                            sub = staged
                         params, opt_state, rng, loss = self._jit_train(
                             params, opt_state, rng, *sub)
                         self._step += 1
@@ -414,18 +438,31 @@ class KerasNet:
         return denom
 
     def _predict_arrays(self, xs, batch_size: int) -> np.ndarray:
+        """Predictions for this process's rows. On a multi-host mesh each
+        process feeds its local rows of the global batch and gets its local
+        predictions back (``batch_size`` is global, like fit)."""
         if self._jit_pred is None:
             self._jit_pred = self._build_pred_step()
         params = self._place(self.params)
         n = data_utils.num_samples(xs)
-        mult = self._shard_multiple()
-        bs = max(mult, (min(batch_size, n) // mult) * mult)
+        pc = jax.process_count()
+        mult = max(1, self._shard_multiple() // pc)
+        local_target = max(1, batch_size // pc)
+        bs = max(mult, (min(local_target, n) // mult) * mult)
+        mesh = self._mesh()
         outs = []
         for idx in data_utils.batch_slices(n, bs, False,
                                            drop_remainder=False):
             chunk = [a[idx] for a in xs]
             padded, real = data_utils.pad_batch(chunk, bs)
             preds = self._jit_pred(params, *self._put_batch(padded))
+            if pc > 1:
+                # bring back only this process's rows of the global output
+                from jax.experimental import multihost_utils
+                from zoo_tpu.parallel.mesh import batch_sharding
+                preds = multihost_utils.global_array_to_host_local_array(
+                    preds, mesh, batch_sharding(mesh, preds.ndim).spec)
+                preds = jnp.asarray(preds)
             # stays on device (lazy slice) — batches pipeline without a
             # per-batch host sync; ONE transfer at the end
             outs.append(preds[:real] if real != bs else preds)

@@ -462,10 +462,15 @@ def _max_pool2d(x, kernel, stride=None, padding=0, dilation=1,
     hi = [p[0], p[1]]
     if ceil_mode:
         # extra high-side -inf padding so the last partial window counts
-        # (torch ceil_mode); identity element keeps values exact
+        # (torch ceil_mode); identity element keeps values exact. Torch
+        # drops a ceil window whose START lies entirely in the padding:
+        # out = ceil((in+2p-k)/s)+1, minus 1 if (out-1)*s >= in+p.
         for i in (0, 1):
-            span = x.shape[2 + i] + 2 * p[i] - k[i]
-            extra = (-span) % s[i]
+            size = x.shape[2 + i]
+            out = -(-(size + 2 * p[i] - k[i]) // s[i]) + 1
+            if (out - 1) * s[i] >= size + p[i]:
+                out -= 1
+            extra = max(0, (out - 1) * s[i] + k[i] - (size + 2 * p[i]))
             hi[i] = p[i] + extra
     out = lax.reduce_window(
         x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)

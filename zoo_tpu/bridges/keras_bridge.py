@@ -1,0 +1,222 @@
+"""tf.keras → zoo_tpu layer bridge (the TF2 ingestion path).
+
+Rebuild of the reference's TF2/Keras training fabric entry point: there a
+user ``model_creator`` returns a compiled tf.keras model and the estimator
+trains it per-worker under ``MultiWorkerMirroredStrategy``
+(``pyzoo/zoo/orca/learn/tf2/estimator.py:86``, ``tf_runner.py:226,316``).
+Here the keras model is converted ONCE — layer configs map onto the
+zoo_tpu layer zoo, weights are imported — and training runs as the jitted
+sharded XLA step; TF never executes in the loop.
+
+Supports keras 2 (tf_keras) and keras 3 Sequential models and
+single-chain Functional models built from the common layer set. For
+arbitrary TF graphs use the frozen-graph inference path
+(:mod:`zoo_tpu.bridges.tf_graph`, the reference's TFNet role).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+def _cfg(layer) -> dict:
+    return layer.get_config()
+
+
+def convert_keras_model(kmodel):
+    """Return a compiled-weight zoo_tpu Sequential mirroring ``kmodel``."""
+    from zoo_tpu.pipeline.api.keras import Sequential
+
+    layers = _layer_list(kmodel)
+    model = Sequential(name="keras_bridge")
+    zoo_layers: List[Tuple[object, object]] = []  # (zoo_layer, keras_layer)
+    for kl in layers:
+        z = _convert_layer(kl)
+        if z is None:  # structural no-op (InputLayer, Dropout at inference)
+            continue
+        zoo_layers.append((z, kl))
+        model.add(z)
+
+    in_shape = _input_shape(kmodel)
+    if model.layers and model.layers[0].batch_input_shape is None:
+        model.layers[0].batch_input_shape = (None,) + tuple(in_shape)
+
+    import jax
+
+    model.build(jax.random.PRNGKey(0),
+                [(None,) + tuple(in_shape)])
+    for z, kl in zoo_layers:
+        p = _convert_weights(z, kl)
+        if p:
+            model.params[model._key_of(z)] = p
+    return model
+
+
+def _layer_list(kmodel):
+    layers = list(kmodel.layers)
+    # Functional models must be single-chain: every layer feeds the next
+    for i, l in enumerate(layers[:-1]):
+        out_nodes = getattr(l, "_outbound_nodes", None)
+        if out_nodes is not None and len(out_nodes) > 1:
+            raise ValueError(
+                f"keras layer {l.name} fans out; only Sequential / "
+                "single-chain Functional models convert structurally — "
+                "use tf_graph frozen-graph ingestion for general graphs")
+    return layers
+
+
+def _input_shape(kmodel):
+    shape = None
+    try:
+        shape = kmodel.input_shape
+    except Exception:
+        pass
+    if shape is None:
+        first = kmodel.layers[0]
+        shape = getattr(first, "batch_input_shape", None) or \
+            getattr(first, "input_shape", None)
+    if shape is None:
+        raise ValueError("cannot infer keras model input shape; build the "
+                         "model (call it once) before conversion")
+    if isinstance(shape, list):
+        shape = shape[0]
+    return tuple(int(s) for s in shape[1:])
+
+
+def _convert_layer(kl):
+    """keras layer → fresh zoo layer (weights imported separately)."""
+    from zoo_tpu.pipeline.api.keras import layers as L
+    from zoo_tpu.pipeline.api.keras.layers.self_attention import LayerNorm
+
+    t = type(kl).__name__
+    c = _cfg(kl)
+    if t == "InputLayer":
+        return None
+    if t == "Dense":
+        return L.Dense(c["units"], activation=_act(c.get("activation")),
+                       bias=c.get("use_bias", True))
+    if t == "Activation":
+        return L.Activation(c["activation"])
+    if t in ("ReLU",):
+        return L.Activation("relu")
+    if t == "LeakyReLU":
+        return L.LeakyReLU(c.get("negative_slope",
+                                 c.get("alpha", 0.3)))
+    if t == "Softmax":
+        return L.Activation("softmax")
+    if t == "ELU":
+        return L.ELU(c.get("alpha", 1.0))
+    if t == "Dropout":
+        return L.Dropout(c["rate"])
+    if t == "Flatten":
+        return L.Flatten()
+    if t == "Reshape":
+        return L.Reshape(tuple(c["target_shape"]))
+    if t == "Embedding":
+        return L.Embedding(c["input_dim"], c["output_dim"])
+    if t == "BatchNormalization":
+        return L.BatchNormalization(epsilon=c.get("epsilon", 1e-3),
+                                    momentum=c.get("momentum", 0.99))
+    if t == "LayerNormalization":
+        return LayerNorm(epsilon=c.get("epsilon", 1e-3))
+    if t == "Conv1D":
+        return L.Convolution1D(
+            c["filters"], c["kernel_size"][0],
+            border_mode=c.get("padding", "valid"),
+            subsample_length=c["strides"][0],
+            activation=_act(c.get("activation")),
+            bias=c.get("use_bias", True))
+    if t == "Conv2D":
+        return L.Convolution2D(
+            c["filters"], c["kernel_size"][0], c["kernel_size"][1],
+            border_mode=c.get("padding", "valid"),
+            subsample=tuple(c["strides"]),
+            dim_ordering="tf",
+            activation=_act(c.get("activation")),
+            bias=c.get("use_bias", True))
+    if t == "MaxPooling2D":
+        return L.MaxPooling2D(tuple(c["pool_size"]),
+                              tuple(c["strides"] or c["pool_size"]),
+                              border_mode=c.get("padding", "valid"),
+                              dim_ordering="tf")
+    if t == "AveragePooling2D":
+        return L.AveragePooling2D(tuple(c["pool_size"]),
+                                  strides=tuple(c["strides"]
+                                                or c["pool_size"]),
+                                  border_mode=c.get("padding", "valid"),
+                                  dim_ordering="tf")
+    if t == "GlobalAveragePooling2D":
+        return L.GlobalAveragePooling2D(dim_ordering="tf")
+    if t == "GlobalMaxPooling2D":
+        return L.GlobalMaxPooling2D(dim_ordering="tf")
+    if t == "MaxPooling1D":
+        return L.MaxPooling1D(c["pool_size"], c.get("strides"))
+    if t == "GlobalAveragePooling1D":
+        return L.GlobalAveragePooling1D()
+    if t == "GlobalMaxPooling1D":
+        return L.GlobalMaxPooling1D()
+    if t == "LSTM":
+        return L.LSTM(c["units"],
+                      activation=_act(c.get("activation")) or "tanh",
+                      inner_activation=_act(
+                          c.get("recurrent_activation")) or "sigmoid",
+                      return_sequences=c.get("return_sequences", False))
+    if t == "GRU":
+        if c.get("reset_after", True):
+            raise ValueError(
+                "keras GRU(reset_after=True) applies the reset gate after "
+                "the recurrent matmul, which zoo_tpu's classic GRU cannot "
+                "reproduce exactly; rebuild with reset_after=False")
+        return L.GRU(c["units"],
+                     activation=_act(c.get("activation")) or "tanh",
+                     inner_activation=_act(
+                         c.get("recurrent_activation")) or "sigmoid",
+                     return_sequences=c.get("return_sequences", False))
+    raise ValueError(
+        f"keras layer {t} has no structural mapping; use "
+        "zoo_tpu.bridges.tf_graph for frozen-graph ingestion")
+
+
+def _act(a) -> Optional[str]:
+    if a is None or a == "linear":
+        return None
+    if isinstance(a, str):
+        return a
+    return getattr(a, "__name__", None)
+
+
+def _convert_weights(z, kl) -> dict:
+    """keras layer weights → zoo param dict (layouts already agree: Dense
+    (in,out), Conv HWIO, LSTM gates i,f,c,o / GRU z,r,h)."""
+    import jax.numpy as jnp
+
+    t = type(kl).__name__
+    w = [np.asarray(v) for v in kl.get_weights()]
+    if not w:
+        return {}
+    if t == "Dense" or t.startswith("Conv"):
+        p = {"W": jnp.asarray(w[0])}
+        if len(w) > 1:
+            p["b"] = jnp.asarray(w[1])
+        return p
+    if t == "Embedding":
+        return {"E": jnp.asarray(w[0])}
+    if t == "BatchNormalization":
+        gamma, beta, mean, var = w
+        return {"gamma": jnp.asarray(gamma), "beta": jnp.asarray(beta),
+                "stats": {"mean": jnp.asarray(mean),
+                          "var": jnp.asarray(var)}}
+    if t == "LayerNormalization":
+        return {"gamma": jnp.asarray(w[0]), "beta": jnp.asarray(w[1])}
+    if t in ("LSTM", "GRU"):
+        kernel, recurrent, bias = (w + [None])[:3]
+        p = {"W": jnp.asarray(kernel), "U": jnp.asarray(recurrent)}
+        if bias is not None:
+            b = np.asarray(bias)
+            if b.ndim == 2:  # keras GRU reset_after bias (2, 3h) — rejected
+                b = b.sum(axis=0)
+            p["b"] = jnp.asarray(b)
+        return p
+    return {}

@@ -1,0 +1,438 @@
+"""Frozen TF graph → JAX inference interpreter (the TFNet role).
+
+Rebuild of the reference's TFNet (``pipeline/api/net/TFNet.scala:56``,
+``TFNetForInference.scala``): a frozen TF graph (or SavedModel signature)
+embedded as an inference-only module. The reference runs the graph through
+libtensorflow JNI inside executor JVMs; here the graph is lowered ONCE —
+``convert_variables_to_constants_v2`` folds variables and inlines function
+calls — and the flat GraphDef is interpreted op-by-op in JAX, so inference
+jits/shards/AOT-compiles like everything else (SURVEY §2.9(2)).
+
+Inference-only by design, exactly like TFNet ("no training"); for
+trainable ingestion use :mod:`zoo_tpu.bridges.keras_bridge`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_TF_OPS: Dict[str, Callable] = {}
+
+
+def _tf_op(*names):
+    def deco(fn):
+        for n in names:
+            _TF_OPS[n] = fn
+        return fn
+    return deco
+
+
+def _dtype_from_attr(node, ctx, key="T"):
+    import tensorflow as tf
+    if key in node.attr:
+        return jnp.dtype(tf.dtypes.as_dtype(node.attr[key].type)
+                         .as_numpy_dtype)
+    return None
+
+
+# elementwise / math
+_tf_op("Identity", "StopGradient", "CheckNumerics", "PreventGradient",
+       "Snapshot")(lambda ctx, n, x, *rest: x)
+_tf_op("Add", "AddV2")(lambda ctx, n, a, b: a + b)
+_tf_op("Sub")(lambda ctx, n, a, b: a - b)
+_tf_op("Mul")(lambda ctx, n, a, b: a * b)
+_tf_op("RealDiv", "Div")(lambda ctx, n, a, b: a / b)
+_tf_op("FloorDiv")(lambda ctx, n, a, b: jnp.floor_divide(a, b))
+_tf_op("Pow")(lambda ctx, n, a, b: jnp.power(a, b))
+_tf_op("Square")(lambda ctx, n, x: x * x)
+_tf_op("SquaredDifference")(lambda ctx, n, a, b: (a - b) ** 2)
+_tf_op("Sqrt")(lambda ctx, n, x: jnp.sqrt(x))
+_tf_op("Rsqrt")(lambda ctx, n, x: lax.rsqrt(x))
+_tf_op("Exp")(lambda ctx, n, x: jnp.exp(x))
+_tf_op("Log")(lambda ctx, n, x: jnp.log(x))
+_tf_op("Neg")(lambda ctx, n, x: -x)
+_tf_op("Abs")(lambda ctx, n, x: jnp.abs(x))
+_tf_op("Erf")(lambda ctx, n, x: lax.erf(x))
+_tf_op("Tanh")(lambda ctx, n, x: jnp.tanh(x))
+_tf_op("Sigmoid")(lambda ctx, n, x: jax.nn.sigmoid(x))
+_tf_op("Relu")(lambda ctx, n, x: jax.nn.relu(x))
+_tf_op("Relu6")(lambda ctx, n, x: jnp.clip(x, 0, 6))
+_tf_op("LeakyRelu")(lambda ctx, n, x: jax.nn.leaky_relu(
+    x, n.attr["alpha"].f if "alpha" in n.attr else 0.2))
+_tf_op("Elu")(lambda ctx, n, x: jax.nn.elu(x))
+_tf_op("Selu")(lambda ctx, n, x: jax.nn.selu(x))
+_tf_op("Softplus")(lambda ctx, n, x: jax.nn.softplus(x))
+_tf_op("Softmax")(lambda ctx, n, x: jax.nn.softmax(x, axis=-1))
+_tf_op("LogSoftmax")(lambda ctx, n, x: jax.nn.log_softmax(x, axis=-1))
+_tf_op("Maximum")(lambda ctx, n, a, b: jnp.maximum(a, b))
+_tf_op("Minimum")(lambda ctx, n, a, b: jnp.minimum(a, b))
+_tf_op("Greater")(lambda ctx, n, a, b: a > b)
+_tf_op("GreaterEqual")(lambda ctx, n, a, b: a >= b)
+_tf_op("Less")(lambda ctx, n, a, b: a < b)
+_tf_op("LessEqual")(lambda ctx, n, a, b: a <= b)
+_tf_op("Equal")(lambda ctx, n, a, b: a == b)
+_tf_op("NotEqual")(lambda ctx, n, a, b: a != b)
+_tf_op("LogicalNot")(lambda ctx, n, x: jnp.logical_not(x))
+_tf_op("LogicalAnd")(lambda ctx, n, a, b: jnp.logical_and(a, b))
+_tf_op("Select", "SelectV2")(lambda ctx, n, c, a, b: jnp.where(c, a, b))
+_tf_op("Sin")(lambda ctx, n, x: jnp.sin(x))
+_tf_op("Cos")(lambda ctx, n, x: jnp.cos(x))
+_tf_op("Floor")(lambda ctx, n, x: jnp.floor(x))
+_tf_op("Round")(lambda ctx, n, x: jnp.round(x))
+_tf_op("Sign")(lambda ctx, n, x: jnp.sign(x))
+
+
+@_tf_op("Cast")
+def _cast(ctx, n, x):
+    import tensorflow as tf
+    dt = jnp.dtype(tf.dtypes.as_dtype(n.attr["DstT"].type).as_numpy_dtype)
+    if dt == jnp.int64:
+        dt = jnp.int32
+    elif dt == jnp.float64:
+        dt = jnp.float32
+    return jnp.asarray(x).astype(dt)
+
+
+@_tf_op("MatMul")
+def _matmul(ctx, n, a, b):
+    if n.attr["transpose_a"].b:
+        a = a.T
+    if n.attr["transpose_b"].b:
+        b = b.T
+    return a @ b
+
+
+@_tf_op("BatchMatMul", "BatchMatMulV2", "BatchMatMulV3")
+def _batch_matmul(ctx, n, a, b):
+    if n.attr["adj_x"].b:
+        a = jnp.swapaxes(a, -1, -2)
+    if n.attr["adj_y"].b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+@_tf_op("BiasAdd")
+def _bias_add(ctx, n, x, b):
+    fmt = n.attr["data_format"].s.decode() if "data_format" in n.attr \
+        else "NHWC"
+    if fmt == "NCHW" and x.ndim > 2:
+        return x + b.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return x + b
+
+
+@_tf_op("Conv2D")
+def _conv2d(ctx, n, x, w):
+    strides = list(n.attr["strides"].list.i)
+    pad = n.attr["padding"].s.decode()
+    fmt = n.attr["data_format"].s.decode() if "data_format" in n.attr \
+        else "NHWC"
+    dil = list(n.attr["dilations"].list.i) if "dilations" in n.attr \
+        else [1, 1, 1, 1]
+    if fmt != "NHWC":
+        raise NotImplementedError("Conv2D NCHW in frozen graphs")
+    return lax.conv_general_dilated(
+        x, w, window_strides=strides[1:3], padding=pad,
+        rhs_dilation=dil[1:3],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+@_tf_op("DepthwiseConv2dNative")
+def _depthwise_conv(ctx, n, x, w):
+    strides = list(n.attr["strides"].list.i)
+    pad = n.attr["padding"].s.decode()
+    c = x.shape[-1]
+    # HWIM -> HWI(M) grouped conv with feature_group_count=C
+    kh, kw, cin, mult = w.shape
+    w2 = w.reshape(kh, kw, 1, cin * mult)
+    return lax.conv_general_dilated(
+        x, w2, window_strides=strides[1:3], padding=pad,
+        feature_group_count=c,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+@_tf_op("MaxPool")
+def _max_pool(ctx, n, x):
+    k = list(n.attr["ksize"].list.i)
+    s = list(n.attr["strides"].list.i)
+    pad = n.attr["padding"].s.decode()
+    return lax.reduce_window(x, -jnp.inf, lax.max, tuple(k), tuple(s), pad)
+
+
+@_tf_op("AvgPool")
+def _avg_pool(ctx, n, x):
+    k = list(n.attr["ksize"].list.i)
+    s = list(n.attr["strides"].list.i)
+    pad = n.attr["padding"].s.decode()
+    summed = lax.reduce_window(x, 0.0, lax.add, tuple(k), tuple(s), pad)
+    if pad == "VALID":
+        return summed / (k[1] * k[2])
+    counts = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, tuple(k),
+                               tuple(s), pad)
+    return summed / counts
+
+
+@_tf_op("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3")
+def _fused_bn(ctx, n, x, gamma, beta, mean, var):
+    eps = n.attr["epsilon"].f if "epsilon" in n.attr else 1e-3
+    out = (x - mean) * lax.rsqrt(var + eps) * gamma + beta
+    return (out, mean, var, mean, var, mean)
+
+
+@_tf_op("Mean", "Sum", "Max", "Min", "Prod", "Any", "All")
+def _reduce(ctx, n, x, axes):
+    keep = n.attr["keep_dims"].b if "keep_dims" in n.attr else False
+    ax = tuple(int(a) for a in np.asarray(axes).reshape(-1))
+    fn = {"Mean": jnp.mean, "Sum": jnp.sum, "Max": jnp.max,
+          "Min": jnp.min, "Prod": jnp.prod, "Any": jnp.any,
+          "All": jnp.all}[n.op]
+    return fn(x, axis=ax, keepdims=keep)
+
+
+@_tf_op("ArgMax")
+def _arg_max(ctx, n, x, axis):
+    return jnp.argmax(x, axis=int(np.asarray(axis))).astype(jnp.int32)
+
+
+@_tf_op("Reshape")
+def _reshape(ctx, n, x, shape):
+    tgt = [int(s) for s in np.asarray(shape).reshape(-1)]
+    return jnp.reshape(x, tgt)
+
+
+@_tf_op("Squeeze")
+def _squeeze(ctx, n, x):
+    dims = tuple(n.attr["squeeze_dims"].list.i) if "squeeze_dims" in n.attr \
+        else None
+    return jnp.squeeze(x, axis=dims if dims else None)
+
+
+@_tf_op("ExpandDims")
+def _expand_dims(ctx, n, x, axis):
+    return jnp.expand_dims(x, int(np.asarray(axis)))
+
+
+@_tf_op("Transpose")
+def _transpose(ctx, n, x, perm):
+    return jnp.transpose(x, [int(p) for p in np.asarray(perm).reshape(-1)])
+
+
+@_tf_op("ConcatV2")
+def _concat(ctx, n, *args):
+    axis = int(np.asarray(args[-1]))
+    return jnp.concatenate(args[:-1], axis=axis)
+
+
+@_tf_op("Pack")
+def _pack(ctx, n, *args):
+    axis = n.attr["axis"].i if "axis" in n.attr else 0
+    # shape-arithmetic subgraphs (Shape→…→Pack→Reshape) must stay host-side
+    # numpy: a traced scalar here would poison the Reshape target
+    if all(isinstance(a, (int, np.integer, np.ndarray)) for a in args):
+        return np.stack([np.asarray(a) for a in args], axis=axis)
+    return jnp.stack(args, axis=axis)
+
+
+@_tf_op("Unpack")
+def _unpack(ctx, n, x):
+    axis = n.attr["axis"].i if "axis" in n.attr else 0
+    num = n.attr["num"].i
+    parts = jnp.split(x, num, axis=axis)
+    return tuple(jnp.squeeze(p, axis=axis) for p in parts)
+
+
+@_tf_op("Pad", "PadV2")
+def _pad(ctx, n, x, paddings, *rest):
+    val = float(np.asarray(rest[0])) if rest else 0.0
+    p = np.asarray(paddings)
+    return jnp.pad(x, [(int(a), int(b)) for a, b in p],
+                   constant_values=val)
+
+
+@_tf_op("GatherV2")
+def _gather(ctx, n, params, indices, axis):
+    return jnp.take(params, jnp.asarray(indices).astype(jnp.int32),
+                    axis=int(np.asarray(axis)))
+
+
+@_tf_op("Shape")
+def _shape(ctx, n, x):
+    # static under jit (shapes are trace-time constants); keep as numpy so
+    # downstream shape arithmetic stays host-side
+    return np.asarray(x.shape, np.int32)
+
+
+@_tf_op("StridedSlice")
+def _strided_slice(ctx, n, x, begin, end, strides):
+    begin = np.asarray(begin).reshape(-1)
+    end = np.asarray(end).reshape(-1)
+    strides = np.asarray(strides).reshape(-1)
+    bm = n.attr["begin_mask"].i
+    em = n.attr["end_mask"].i
+    sm = n.attr["shrink_axis_mask"].i
+    nm = n.attr["new_axis_mask"].i
+    if nm:
+        raise NotImplementedError("StridedSlice new_axis_mask")
+    ix = []
+    for i in range(len(begin)):
+        if sm & (1 << i):
+            ix.append(int(begin[i]))
+            continue
+        b = None if bm & (1 << i) else int(begin[i])
+        e = None if em & (1 << i) else int(end[i])
+        ix.append(slice(b, e, int(strides[i])))
+    return x[tuple(ix)]
+
+
+@_tf_op("Fill")
+def _fill(ctx, n, dims, value):
+    return jnp.full([int(d) for d in np.asarray(dims).reshape(-1)],
+                    np.asarray(value))
+
+
+@_tf_op("Range")
+def _range(ctx, n, start, limit, delta):
+    return jnp.arange(int(np.asarray(start)), int(np.asarray(limit)),
+                      int(np.asarray(delta)))
+
+
+class TFGraphFunction:
+    """A frozen GraphDef interpreted as a pure JAX function."""
+
+    def __init__(self, graph_def, input_names: List[str],
+                 output_names: List[str]):
+        self.graph_def = graph_def
+        self.input_names = input_names
+        self.output_names = output_names
+        self._nodes = {n.name: n for n in graph_def.node}
+
+    def __call__(self, *inputs):
+        from tensorflow.python.framework import tensor_util
+
+        env: Dict[str, object] = {}
+        for name, val in zip(self.input_names, inputs):
+            env[name] = val
+
+        def value_of(ref: str):
+            if ref.startswith("^"):
+                return None  # control edge
+            name, _, idx = ref.partition(":")
+            out = compute(name)
+            if idx and int(idx) > 0:
+                return out[int(idx)]
+            return out[0] if isinstance(out, tuple) and n_outputs(name) > 1 \
+                else (out if not isinstance(out, tuple) else out[0])
+
+        def n_outputs(name):
+            node = self._nodes[name]
+            return 6 if node.op.startswith("FusedBatchNorm") else (
+                node.attr["num"].i if node.op == "Unpack" else 1)
+
+        def compute(name):
+            if name in env:
+                return env[name]
+            node = self._nodes[name]
+            if node.op == "Const":
+                val = tensor_util.MakeNdarray(node.attr["value"].tensor)
+                if val.dtype == np.float64:
+                    val = val.astype(np.float32)
+                elif val.dtype == np.int64:
+                    val = val.astype(np.int32)
+                env[name] = val
+                return val
+            if node.op in ("Placeholder", "PlaceholderWithDefault"):
+                raise ValueError(f"unbound graph input: {name}")
+            if node.op == "NoOp":
+                env[name] = None
+                return None
+            fn = _TF_OPS.get(node.op)
+            if fn is None:
+                raise NotImplementedError(
+                    f"TF op {node.op} (node {name}) has no JAX mapping in "
+                    "zoo_tpu.bridges.tf_graph._TF_OPS")
+            args = [value_of(i) for i in node.input if not i.startswith("^")]
+            out = fn(None, node, *args)
+            env[name] = out
+            return out
+
+        results = []
+        for ref in self.output_names:
+            results.append(value_of(ref))
+        return results[0] if len(results) == 1 else tuple(results)
+
+
+def convert_tf_callable(fn, example_args: Sequence) -> TFGraphFunction:
+    """Freeze a tf.function / keras model / callable and return the JAX
+    interpreter over its graph."""
+    import tensorflow as tf
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2,
+    )
+
+    if not isinstance(fn, tf.types.experimental.GenericFunction):
+        wrapped = tf.function(fn)
+    else:
+        wrapped = fn
+    specs = [tf.TensorSpec((None,) + tuple(np.asarray(a).shape[1:]),
+                           tf.dtypes.as_dtype(np.asarray(a).dtype))
+             for a in example_args]
+    cf = wrapped.get_concrete_function(*specs)
+    frozen = convert_variables_to_constants_v2(cf)
+    gd = frozen.graph.as_graph_def()
+    in_names = [t.name.split(":")[0] for t in frozen.inputs]
+    out_names = [t.name for t in frozen.outputs]
+    return TFGraphFunction(gd, in_names, out_names)
+
+
+def load_saved_model(path: str, signature: str = "serving_default",
+                     example_args: Optional[Sequence] = None
+                     ) -> TFGraphFunction:
+    """SavedModel → JAX function (reference: ``TFNet.fromSavedModel``)."""
+    import tensorflow as tf
+
+    sm = tf.saved_model.load(path)
+    fn = sm.signatures[signature]
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2,
+    )
+    frozen = convert_variables_to_constants_v2(fn)
+    gd = frozen.graph.as_graph_def()
+    in_names = [t.name.split(":")[0] for t in frozen.inputs]
+    out_names = [t.name for t in frozen.outputs]
+    out = TFGraphFunction(gd, in_names, out_names)
+    out._keepalive = sm  # the loaded object owns the variables
+    return out
+
+
+class TFGraphWrapper:
+    """Predict-surface adapter so InferenceModel can hold a frozen TF
+    graph like any other model (inference-only, as TFNet was)."""
+
+    def __init__(self, graph_fn: TFGraphFunction):
+        self.graph_fn = graph_fn
+        self._jit = jax.jit(graph_fn)
+
+    def predict(self, x, batch_size: int = 256,
+                feature_cols=None) -> np.ndarray:
+        xs = list(x) if isinstance(x, (list, tuple)) else [x]
+        xs = [np.asarray(a) for a in xs]
+        n = xs[0].shape[0]
+        outs = []
+        for lo in range(0, n, batch_size):
+            chunk = [a[lo:lo + batch_size] for a in xs]
+            real = chunk[0].shape[0]
+            if real < batch_size and lo > 0:
+                # pad to the steady batch shape to avoid a recompile
+                chunk = [np.concatenate(
+                    [a, np.repeat(a[:1], batch_size - real, axis=0)])
+                    for a in chunk]
+            out = self._jit(*[jnp.asarray(a) for a in chunk])
+            if isinstance(out, tuple):
+                out = out[0]
+            outs.append(out[:real])
+        return np.asarray(jnp.concatenate(outs, axis=0))

@@ -261,4 +261,16 @@ def shards_for_process(shards: "LocalXShards",
     pi = jax.process_index() if process_index is None else process_index
     pcnt = jax.process_count() if process_count is None else process_count
     parts = shards.collect()
-    return LocalXShards(parts[pi::pcnt])
+    # every process MUST end up with the same partition count, or SPMD
+    # step counts desync and the collectives hang: trim the remainder
+    per = len(parts) // pcnt
+    if per == 0:
+        raise ValueError(f"{len(parts)} partitions cannot feed "
+                         f"{pcnt} processes; repartition() first")
+    if len(parts) % pcnt:
+        import warnings
+        warnings.warn(
+            f"dropping {len(parts) % pcnt} of {len(parts)} partitions so "
+            f"all {pcnt} processes hold {per}; repartition() to a "
+            "multiple to keep every row")
+    return LocalXShards(parts[pi::pcnt][:per])

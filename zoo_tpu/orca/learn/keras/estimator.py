@@ -85,6 +85,7 @@ class KerasEstimator:
             self._save_checkpoint()
         # train until the epoch counter reaches target — a rollback lowers
         # the counter, so lost epochs are retrained (reference endWhen)
+        start_epoch = self._epoch
         target = self._epoch + epochs
         while self._epoch < target:
             try:
@@ -112,7 +113,22 @@ class KerasEstimator:
                     "training failed (%s: %s); retry %d/%d from latest "
                     "checkpoint", type(e).__name__, e, retries,
                     max_failure_retries)
+                epoch_before = self._epoch
                 self._restore_latest()
+                if self._epoch > epoch_before:
+                    # the newest checkpoint is from a DIFFERENT run (stale
+                    # model_dir): restoring it would silently skip training
+                    raise RuntimeError(
+                        f"latest checkpoint (epoch {self._epoch}) is ahead "
+                        f"of this run (epoch {epoch_before}) — model_dir "
+                        "holds checkpoints from a previous run; use "
+                        "load_orca_checkpoint() to resume or point "
+                        "model_dir at a fresh directory") from e
+                # drop history entries for epochs the rollback undid, so
+                # retrained epochs don't append duplicates
+                keep = max(0, self._epoch - start_epoch)
+                for k in history:
+                    history[k] = history[k][:keep]
                 continue
             no_progress = 0
             self._epoch += 1
@@ -147,6 +163,9 @@ class KerasEstimator:
             raise ValueError("no model_dir configured and no path given")
         state = mgr.restore(version)
         self.model.params = state["params"]
+        # optimizer state (Adam moments etc.) resumes too — the reference
+        # reloads optimMethod-<name>.N alongside model.N
+        self.model._opt_state = mgr.restore_aux(version)
         self._epoch = int(state.get("epoch", 0))
         return self
 

@@ -1,0 +1,1 @@
+from zoo_tpu.orca.learn.tf2.estimator import Estimator  # noqa: F401

@@ -1,0 +1,243 @@
+"""Orca TF2 Estimator — tf.keras model creators trained TPU-native.
+
+Rebuild of ``zoo.orca.learn.tf2.estimator.Estimator.from_keras``
+(reference: ``pyzoo/zoo/orca/learn/tf2/estimator.py:86``): the user hands
+over a ``model_creator(config) -> compiled tf.keras model`` (plus optional
+``data_creator(config, batch_size) -> tf.data.Dataset``); the reference
+replays the creator on every Ray worker under
+``MultiWorkerMirroredStrategy`` (``tf_runner.py:226,280-323``). Here the
+creator runs ONCE, the model is converted through
+:mod:`zoo_tpu.bridges.keras_bridge` (configs + weights + compile settings),
+and training is the jitted sharded XLA step — the mesh replaces TF's
+collective-ops ring.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from zoo_tpu.orca.learn.keras.estimator import KerasEstimator
+
+
+def _convert_optimizer(kopt):
+    """keras optimizer instance → zoo optimizer with matching hyperparams."""
+    from zoo_tpu.pipeline.api.keras import optimizers as zopt
+
+    if kopt is None:
+        return "adam"
+    cfg = {}
+    try:
+        cfg = kopt.get_config()
+    except Exception:
+        pass
+    name = str(cfg.get("name", type(kopt).__name__)).lower()
+    lr = float(cfg.get("learning_rate", 0.001)) \
+        if np.isscalar(cfg.get("learning_rate", 0.001)) else 0.001
+    if "adamw" in name or "adam_w" in name:
+        return zopt.AdamWeightDecay(lr=lr,
+                                    weight_decay=float(
+                                        cfg.get("weight_decay", 0.01)
+                                        or 0.01))
+    if "adamax" in name:
+        return zopt.Adamax(lr=lr)
+    if "adagrad" in name:
+        return zopt.Adagrad(lr=lr)
+    if "adadelta" in name:
+        return zopt.Adadelta(lr=lr)
+    if "adam" in name:
+        return zopt.Adam(lr=lr, beta_1=float(cfg.get("beta_1", 0.9)),
+                         beta_2=float(cfg.get("beta_2", 0.999)),
+                         epsilon=float(cfg.get("epsilon", 1e-7)))
+    if "rmsprop" in name:
+        return zopt.RMSprop(lr=lr, rho=float(cfg.get("rho", 0.9)))
+    if "sgd" in name:
+        return zopt.SGD(lr=lr, momentum=float(cfg.get("momentum", 0.0)),
+                        nesterov=bool(cfg.get("nesterov", False)))
+    return zopt.Adam(lr=lr)
+
+
+_LOSS_MAP = {
+    "mean_squared_error": "mse", "mse": "mse",
+    "mean_absolute_error": "mae", "mae": "mae",
+    "binary_crossentropy": "binary_crossentropy",
+    "categorical_crossentropy": "categorical_crossentropy",
+    "sparse_categorical_crossentropy": "sparse_categorical_crossentropy",
+    "hinge": "hinge", "squared_hinge": "squared_hinge",
+    "kl_divergence": "kld", "kld": "kld", "poisson": "poisson",
+}
+
+
+def _convert_loss(kloss):
+    if kloss is None:
+        return "mse"
+    name = kloss if isinstance(kloss, str) else (
+        getattr(kloss, "name", None) or type(kloss).__name__)
+    key = str(name).lower()
+    # keras-3 class names like SparseCategoricalCrossentropy
+    snake = "".join(("_" + ch.lower()) if ch.isupper() else ch
+                    for ch in str(name)).lstrip("_")
+    for cand in (key, snake):
+        if cand in _LOSS_MAP:
+            return _LOSS_MAP[cand]
+    raise ValueError(f"unsupported keras loss: {name!r}")
+
+
+def _convert_metrics(kmodel) -> list:
+    names = []
+    try:  # keras 3 records the user's compile() args here
+        cc = kmodel.get_compile_config() or {}
+        for m in cc.get("metrics") or []:
+            names.append(str(getattr(m, "name", None) or
+                             (m.get("config", {}).get("name")
+                              if isinstance(m, dict) else m)))
+    except Exception:
+        pass
+    for m in getattr(kmodel, "metrics", []) or []:
+        names.append(str(getattr(m, "name", m)))
+    out = []
+    for name in names:
+        n = name.lower()
+        if "acc" in n and "accuracy" not in out:
+            out.append("accuracy")
+        elif n in ("mae", "mean_absolute_error") and "mae" not in out:
+            out.append("mae")
+        elif n in ("mse", "mean_squared_error") and "mse" not in out:
+            out.append("mse")
+    return out
+
+
+class Estimator:
+    @staticmethod
+    def from_keras(*, model_creator: Callable,
+                   config: Optional[dict] = None,
+                   model_dir: Optional[str] = None,
+                   backend: str = "tpu",
+                   workers_per_node: int = 1,
+                   compile_args: Optional[dict] = None) -> "TF2Estimator":
+        """reference signature: ``Estimator.from_keras(model_creator=...,
+        config=..., workers_per_node=..., backend="tf2")``
+        (``tf2/estimator.py:38``)."""
+        return TF2Estimator(model_creator, config=config,
+                            model_dir=model_dir,
+                            compile_args=compile_args)
+
+
+class TF2Estimator(KerasEstimator):
+    def __init__(self, model_creator: Callable, config: Optional[dict],
+                 model_dir: Optional[str] = None,
+                 compile_args: Optional[dict] = None):
+        self.config = dict(config or {})
+        kmodel = model_creator(self.config)
+        self._kmodel = kmodel
+        from zoo_tpu.bridges.keras_bridge import convert_keras_model
+
+        zmodel = convert_keras_model(kmodel)
+        ca = compile_args or {}
+        zmodel.compile(
+            optimizer=ca.get("optimizer",
+                             _convert_optimizer(
+                                 getattr(kmodel, "optimizer", None))),
+            loss=ca.get("loss",
+                        _convert_loss(getattr(kmodel, "loss", None))),
+            metrics=ca.get("metrics", _convert_metrics(kmodel)))
+        super().__init__(zmodel, model_dir=model_dir)
+
+    # -- data adapters -----------------------------------------------------
+    def _materialize(self, data, batch_size):
+        """Accept the reference's data forms: creator function, tf.data
+        Dataset, XShards / dict / arrays."""
+        if callable(data) and not isinstance(data, (list, tuple, dict)):
+            data = data(self.config, batch_size)  # reference data_creator
+        try:
+            import tensorflow as tf
+            if isinstance(data, tf.data.Dataset):
+                xs, ys = [], []
+                for item in data.as_numpy_iterator():
+                    if isinstance(item, tuple) and len(item) == 2:
+                        xs.append(item[0])
+                        ys.append(item[1])
+                    else:
+                        xs.append(item)
+                x = np.concatenate([np.atleast_1d(a) for a in xs]) \
+                    if xs and np.ndim(xs[0]) else np.stack(xs)
+                if ys:
+                    y = np.concatenate([np.atleast_1d(a) for a in ys]) \
+                        if np.ndim(ys[0]) else np.stack(ys)
+                    return {"x": x, "y": y}
+                return {"x": x}
+        except ImportError:
+            pass
+        return data
+
+    def fit(self, data, epochs: int = 1, batch_size: int = 32,
+            feature_cols: Optional[Sequence[str]] = None,
+            label_cols: Optional[Sequence[str]] = None,
+            validation_data=None, checkpoint_trigger=None,
+            shuffle: bool = True, **kw):
+        data = self._materialize(data, batch_size)
+        if validation_data is not None:
+            validation_data = self._materialize(validation_data, batch_size)
+        return super().fit(data, epochs=epochs, batch_size=batch_size,
+                           feature_cols=feature_cols, label_cols=label_cols,
+                           validation_data=validation_data,
+                           checkpoint_trigger=checkpoint_trigger,
+                           shuffle=shuffle, **kw)
+
+    def predict(self, data, batch_size: int = 256, feature_cols=None):
+        return super().predict(self._materialize(data, batch_size),
+                               batch_size=batch_size,
+                               feature_cols=feature_cols)
+
+    def evaluate(self, data, batch_size: int = 32, feature_cols=None,
+                 label_cols=None):
+        return super().evaluate(self._materialize(data, batch_size),
+                                batch_size=batch_size,
+                                feature_cols=feature_cols,
+                                label_cols=label_cols)
+
+    def get_model(self):
+        """Return the tf.keras model with trained weights written back
+        (the reference returns the worker-0 keras model)."""
+        self._export_weights_to_keras()
+        return self._kmodel
+
+    def _export_weights_to_keras(self):
+        import jax
+
+        zmodel = self.model
+        params = jax.tree_util.tree_map(np.asarray, zmodel.params)
+        for z in zmodel.layers:
+            key = zmodel._key_of(z)
+            p = params.get(key)
+            if not p:
+                continue
+            kl = self._keras_layer_for(z)
+            if kl is None:
+                continue
+            t = type(kl).__name__
+            if t == "Dense" or t.startswith("Conv"):
+                w = [p["W"]] + ([p["b"]] if "b" in p else [])
+                kl.set_weights(w)
+            elif t == "Embedding":
+                kl.set_weights([p["E"]])
+            elif t == "BatchNormalization":
+                kl.set_weights([p["gamma"], p["beta"],
+                                p["stats"]["mean"], p["stats"]["var"]])
+            elif t == "LayerNormalization":
+                kl.set_weights([p["gamma"], p["beta"]])
+            elif t in ("LSTM", "GRU"):
+                kl.set_weights([p["W"], p["U"]] +
+                               ([p["b"]] if "b" in p else []))
+
+    def _keras_layer_for(self, zoo_layer):
+        """Pair zoo layers with keras layers by parametrized-layer order."""
+        zoo_param = [l for l in self.model.layers
+                     if self.model.params.get(self.model._key_of(l))]
+        keras_param = [l for l in self._kmodel.layers if l.get_weights()]
+        try:
+            idx = zoo_param.index(zoo_layer)
+            return keras_param[idx]
+        except (ValueError, IndexError):
+            return None

@@ -1,0 +1,4 @@
+from zoo_tpu.pipeline.api.onnx.onnx_loader import (  # noqa: F401
+    OnnxGraphNet,
+    load_onnx,
+)

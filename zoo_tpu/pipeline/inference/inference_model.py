@@ -50,6 +50,29 @@ class InferenceModel:
         from zoo_tpu.pipeline.api.keras.engine.topology import KerasNet
         return self.load_keras(KerasNet.load(path), batch_size=batch_size)
 
+    def load_tf(self, model_or_path, batch_size: Optional[int] = None,
+                example_inputs=None, signature: str = "serving_default"):
+        """Load a TF model for inference (reference: ``doLoadTF`` /
+        ``TFNet.scala:56``): a SavedModel directory path, a tf.keras model,
+        or any tf.function-able callable. The graph is frozen and
+        interpreted in JAX (``zoo_tpu.bridges.tf_graph``)."""
+        from zoo_tpu.bridges.tf_graph import (
+            TFGraphWrapper,
+            convert_tf_callable,
+            load_saved_model,
+        )
+
+        if isinstance(model_or_path, str):
+            g = load_saved_model(model_or_path, signature=signature)
+        else:
+            if example_inputs is None:
+                raise ValueError("pass example_inputs= for non-SavedModel "
+                                 "TF objects")
+            g = convert_tf_callable(model_or_path, list(example_inputs))
+        self._model = TFGraphWrapper(g)
+        self._batch_size = batch_size
+        return self
+
     def load_torch(self, torch_model, input_shape=None,
                    batch_size: Optional[int] = None,
                    example_inputs=None, input_dtype="float32"):

@@ -121,6 +121,14 @@ def _convert_layer(kl):
                                     momentum=c.get("momentum", 0.99))
     if t == "LayerNormalization":
         return LayerNorm(epsilon=c.get("epsilon", 1e-3))
+    if t in ("Conv1D", "Conv2D"):
+        dil = c.get("dilation_rate", 1)
+        dil = tuple(dil) if isinstance(dil, (list, tuple)) else (dil,)
+        if any(d != 1 for d in dil) or c.get("groups", 1) != 1:
+            raise ValueError(
+                f"keras {t} with dilation_rate={dil}/groups="
+                f"{c.get('groups', 1)} has no exact structural mapping; "
+                "use tf_graph frozen-graph ingestion")
     if t == "Conv1D":
         return L.Convolution1D(
             c["filters"], c["kernel_size"][0],

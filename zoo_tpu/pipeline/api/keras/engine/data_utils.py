@@ -59,8 +59,22 @@ def to_xy_arrays(x, y=None, feature_cols: Optional[Sequence[str]] = None,
         pass
 
     if isinstance(x, dict):
-        return _as_list(x["x"]), _keep_device(x.get("y"))
-    return _as_list(x), (None if y is None else _keep_device(y))
+        return _as_list(x["x"]), _normalize_labels(x.get("y"))
+    return _as_list(x), _normalize_labels(y)
+
+
+def _normalize_labels(y):
+    """A list is a multi-output label SET only when its elements are
+    array-like; a plain python list of scalars (keras-style
+    ``fit(x, [0, 1, ...])``) is one label array."""
+    if y is None:
+        return None
+    if isinstance(y, (list, tuple)):
+        if y and all((isinstance(a, np.ndarray) or hasattr(a, "devices"))
+                     and np.ndim(a) >= 1 for a in y):
+            return [_keep_device(a) for a in y]
+        return np.asarray(y)  # python list of scalars / nested lists
+    return _keep_device(y)
 
 
 def _keep_device(a):

@@ -116,9 +116,26 @@ class KerasNet:
             raise ValueError(f"unknown dtype_policy: {dtype_policy}")
         self.dtype_policy = dtype_policy
         self.optimizer = get_optimizer(optimizer)
-        self.loss_fn = get_loss(loss)
-        self.loss_name = (loss if isinstance(loss, str)
-                          else getattr(loss, "__name__", None))
+        if isinstance(loss, (list, tuple)):
+            # multi-output: one loss per output, summed (the reference's
+            # multi-task graphs combine per-head criteria the same way)
+            fns = [get_loss(l) for l in loss]
+
+            def _multi_loss(ys, preds):
+                ys = ys if isinstance(ys, (list, tuple)) else [ys]
+                preds = preds if isinstance(preds, (list, tuple)) else [preds]
+                if not (len(fns) == len(ys) == len(preds)):
+                    raise ValueError(
+                        f"{len(fns)} losses, {len(ys)} label sets, "
+                        f"{len(preds)} outputs — counts must match")
+                return sum(f(y, p) for f, y, p in zip(fns, ys, preds))
+
+            self.loss_fn = _multi_loss
+            self.loss_name = "multi"
+        else:
+            self.loss_fn = get_loss(loss)
+            self.loss_name = (loss if isinstance(loss, str)
+                              else getattr(loss, "__name__", None))
         self.metrics = [get_metric(m) for m in (metrics or [])]
         self._jit_train = self._jit_eval = self._jit_pred = None
         self._opt_state = None  # a new optimizer cannot reuse old state
@@ -242,7 +259,9 @@ class KerasNet:
             # step would be an extra dispatch (and a real cost when the
             # device sits behind a high-latency transport)
             step_rng, new_rng = jax.random.split(rng)
-            xs, ys = list(batch[:n_inputs]), batch[n_inputs]
+            xs = list(batch[:n_inputs])
+            labels = list(batch[n_inputs:])
+            ys = labels[0] if len(labels) == 1 else labels
             trainable, state = _split_state(params)
 
             def loss_fn(tr):
@@ -297,6 +316,7 @@ class KerasNet:
         xs = self._adapt_inputs(xs)
         if ys is None:
             raise ValueError("fit requires labels")
+        ys_list = list(ys) if isinstance(ys, (list, tuple)) else [ys]
         n = data_utils.num_samples(xs)
 
         mesh = self._mesh()
@@ -337,7 +357,7 @@ class KerasNet:
             val_arrays = (self._adapt_inputs(val_arrays[0]), val_arrays[1])
         history: Dict[str, List[float]] = {"loss": []}
         from zoo_tpu.orca.data.cache import DoubleBufferedIterator
-        arrs = xs + [ys]
+        arrs = xs + ys_list
         sample_bytes = sum(a[:1].nbytes for a in arrs)
         # Host→HBM transfers are chunked into SUPERBATCHES (many training
         # batches per device_put, ~64MB or 16 batches) and sliced on-device:
@@ -460,20 +480,43 @@ class KerasNet:
                 # bring back only this process's rows of the global output
                 from jax.experimental import multihost_utils
                 from zoo_tpu.parallel.mesh import batch_sharding
-                preds = multihost_utils.global_array_to_host_local_array(
-                    preds, mesh, batch_sharding(mesh, preds.ndim).spec)
-                preds = jnp.asarray(preds)
+
+                def _localize(p):
+                    out = multihost_utils.global_array_to_host_local_array(
+                        p, mesh, batch_sharding(mesh, p.ndim).spec)
+                    return jnp.asarray(out)
+
+                preds = tuple(_localize(p) for p in preds) \
+                    if isinstance(preds, tuple) else _localize(preds)
             # stays on device (lazy slice) — batches pipeline without a
             # per-batch host sync; ONE transfer at the end
-            outs.append(preds[:real] if real != bs else preds)
+            if isinstance(preds, tuple):
+                outs.append(tuple(p[:real] if real != bs else p
+                                  for p in preds))
+            else:
+                outs.append(preds[:real] if real != bs else preds)
+        if outs and isinstance(outs[0], tuple):
+            return tuple(np.asarray(jnp.concatenate([o[i] for o in outs],
+                                                    axis=0))
+                         for i in range(len(outs[0])))
         return np.asarray(jnp.concatenate(outs, axis=0))
 
     def _evaluate_arrays(self, xs, ys, batch_size) -> Dict[str, float]:
         """Exact (non-approximated) evaluation: predictions are computed in
         sharded batches, loss/metrics reduced once over the full set."""
-        preds = jnp.asarray(self._predict_arrays(xs, batch_size))
-        yt = jnp.asarray(ys)
+        preds = self._predict_arrays(xs, batch_size)
         out = {}
+        if isinstance(preds, tuple):
+            # multi-output: combined loss over the heads; per-head metrics
+            # are not aggregated (pass per-head eval sets instead)
+            yt = [jnp.asarray(a) for a in ys] \
+                if isinstance(ys, (list, tuple)) else jnp.asarray(ys)
+            if self.loss_fn is not None:
+                out["loss"] = float(self.loss_fn(
+                    yt, tuple(jnp.asarray(p) for p in preds)))
+            return out
+        preds = jnp.asarray(preds)
+        yt = jnp.asarray(ys)
         if self.loss_fn is not None:
             out["loss"] = float(self.loss_fn(yt, preds))
         for m in self.metrics:
@@ -631,9 +674,9 @@ class Model(KerasNet):
         super().__init__(name=name)
         self.inputs = list(input) if isinstance(input, (list, tuple)) \
             else [input]
-        if isinstance(output, (list, tuple)):
-            raise NotImplementedError("multi-output Model not yet supported")
-        self.output = output
+        self.outputs = list(output) if isinstance(output, (list, tuple)) \
+            else [output]
+        self.output = self.outputs[0]  # back-compat single-output attr
         self._topo = self._toposort()
 
     def _toposort(self) -> List[KTensor]:
@@ -647,7 +690,8 @@ class Model(KerasNet):
                 visit(parent)
             order.append(node)
 
-        visit(self.output)
+        for out in self.outputs:
+            visit(out)
         for t in self.inputs:
             if id(t) not in seen:
                 raise ValueError("an input tensor is not connected to output")
@@ -697,4 +741,6 @@ class Model(KerasNet):
                     "stats": node.layer.updated_stats(p, arg)}
             values[id(node)] = node.layer.call(p, arg, training=training,
                                                rng=rng)
-        return values[id(self.output)]
+        if len(self.outputs) == 1:
+            return values[id(self.output)]
+        return tuple(values[id(o)] for o in self.outputs)

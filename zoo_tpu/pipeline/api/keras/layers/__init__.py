@@ -60,6 +60,21 @@ from zoo_tpu.pipeline.api.keras.layers.self_attention import (
     TransformerLayer,
 )
 
+from zoo_tpu.pipeline.api.keras.layers.extras import (  # noqa: F401
+    AddConstant, BinaryThreshold, CAdd, CMul, Exp, ExpandDim,
+    GaussianDropout, GaussianSampler, GetShape, HardShrink, HardTanh,
+    Identity, LRN2D, Log, Masking, Max, MulConstant, Narrow, Negative,
+    Power, RReLU, ResizeBilinear, Scale, Select, SoftShrink, Sqrt, Square,
+    Squeeze, Threshold, WithinChannelLRN2D,
+)
+from zoo_tpu.pipeline.api.keras.layers.conv_extras import (  # noqa: F401
+    AtrousConvolution1D, AtrousConvolution2D, AveragePooling3D, ConvLSTM2D,
+    Convolution3D, Cropping3D, Deconvolution2D, GlobalAveragePooling3D,
+    GlobalMaxPooling3D, LocallyConnected1D, LocallyConnected2D,
+    MaxPooling3D, SeparableConvolution2D, ShareConvolution2D,
+    SpatialDropout3D, UpSampling3D, WordEmbedding, ZeroPadding3D,
+)
+
 __all__ = [
     "Activation", "BatchNormalization", "Dense", "Dropout", "Embedding",
     "Flatten", "GaussianNoise", "InputLayer", "Lambda", "Merge", "Permute",
@@ -74,4 +89,16 @@ __all__ = [
     "ELU", "Highway", "LeakyReLU", "MaxoutDense", "PReLU", "SReLU",
     "ThresholdedReLU",
     "BERT", "LayerNorm", "TransformerLayer",
+    "AddConstant", "BinaryThreshold", "CAdd", "CMul", "Exp", "ExpandDim",
+    "GaussianDropout", "GaussianSampler", "GetShape", "HardShrink",
+    "HardTanh", "Identity", "LRN2D", "Log", "Masking", "Max", "MulConstant",
+    "Narrow", "Negative", "Power", "RReLU", "ResizeBilinear", "Scale",
+    "Select", "SoftShrink", "Sqrt", "Square", "Squeeze", "Threshold",
+    "WithinChannelLRN2D",
+    "AtrousConvolution1D", "AtrousConvolution2D", "AveragePooling3D",
+    "ConvLSTM2D", "Convolution3D", "Cropping3D", "Deconvolution2D",
+    "GlobalAveragePooling3D", "GlobalMaxPooling3D", "LocallyConnected1D",
+    "LocallyConnected2D", "MaxPooling3D", "SeparableConvolution2D",
+    "ShareConvolution2D", "SpatialDropout3D", "UpSampling3D",
+    "WordEmbedding", "ZeroPadding3D",
 ]

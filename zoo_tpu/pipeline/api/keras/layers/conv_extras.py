@@ -1,0 +1,705 @@
+"""3D conv/pool family, separable/deconv/locally-connected convs,
+ConvLSTM2D, WordEmbedding — the rest of the reference's conv layer zoo
+(Python ``pyzoo/zoo/pipeline/api/keras/layers/convolutional.py``,
+``pooling.py``, ``convolutional_recurrent.py``, ``local.py``,
+``embeddings.py``; Scala ``pipeline/api/keras/layers/*.scala``).
+
+All convs run NDHWC/NHWC internally (TPU-native channel-last feeding the
+MXU); ``dim_ordering="th"`` transposes at the boundary like the 2D layers.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from zoo_tpu.pipeline.api.keras.engine.base import (
+    Layer,
+    get_activation_fn,
+    get_initializer,
+    layer_rng,
+)
+from zoo_tpu.pipeline.api.keras.layers.convolutional import Convolution2D
+
+
+def _tup(v, n):
+    if isinstance(v, (tuple, list)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _out_dim(size, k, s, mode):
+    if size is None:
+        return None
+    if mode == "same":
+        return -(-size // s)
+    return (size - k) // s + 1
+
+
+class Convolution3D(Layer):
+    """reference: ``Convolution3D`` (th layout (B, C, D, H, W))."""
+
+    def __init__(self, nb_filter: int, kernel_dim1: int, kernel_dim2: int,
+                 kernel_dim3: int, init="glorot_uniform", activation=None,
+                 border_mode: str = "valid",
+                 subsample: Tuple[int, int, int] = (1, 1, 1),
+                 dim_ordering: str = "th", bias: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        self.nb_filter = int(nb_filter)
+        self.kernel = (int(kernel_dim1), int(kernel_dim2), int(kernel_dim3))
+        self.init = get_initializer(init)
+        self.activation = get_activation_fn(activation)
+        self.border_mode = border_mode
+        self.subsample = _tup(subsample, 3)
+        self.dim_ordering = dim_ordering
+        self.bias = bias
+
+    def build(self, rng, input_shape):
+        cin = input_shape[1] if self.dim_ordering == "th" else input_shape[4]
+        p = {"W": self.init(rng, self.kernel + (cin, self.nb_filter),
+                            jnp.float32)}  # DHWIO
+        if self.bias:
+            p["b"] = jnp.zeros((self.nb_filter,), jnp.float32)
+        return p
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        x = inputs
+        if self.dim_ordering == "th":
+            x = jnp.transpose(x, (0, 2, 3, 4, 1))  # NCDHW -> NDHWC
+        y = jax.lax.conv_general_dilated(
+            x, params["W"], window_strides=self.subsample,
+            padding=self.border_mode.upper(),
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        if self.bias:
+            y = y + params["b"]
+        if self.activation:
+            y = self.activation(y)
+        if self.dim_ordering == "th":
+            y = jnp.transpose(y, (0, 4, 1, 2, 3))
+        return y
+
+    def compute_output_shape(self, input_shape):
+        if self.dim_ordering == "th":
+            b, c, d, h, w = input_shape
+        else:
+            b, d, h, w, c = input_shape
+        od = _out_dim(d, self.kernel[0], self.subsample[0], self.border_mode)
+        oh = _out_dim(h, self.kernel[1], self.subsample[1], self.border_mode)
+        ow = _out_dim(w, self.kernel[2], self.subsample[2], self.border_mode)
+        if self.dim_ordering == "th":
+            return (b, self.nb_filter, od, oh, ow)
+        return (b, od, oh, ow, self.nb_filter)
+
+
+class AtrousConvolution2D(Layer):
+    """Dilated conv (reference: ``AtrousConvolution2D``)."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 init="glorot_uniform", activation=None,
+                 border_mode: str = "valid",
+                 subsample: Tuple[int, int] = (1, 1),
+                 atrous_rate: Tuple[int, int] = (1, 1),
+                 dim_ordering: str = "th", bias: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        self.nb_filter = int(nb_filter)
+        self.kernel = (int(nb_row), int(nb_col))
+        self.init = get_initializer(init)
+        self.activation = get_activation_fn(activation)
+        self.border_mode = border_mode
+        self.subsample = _tup(subsample, 2)
+        self.rate = _tup(atrous_rate, 2)
+        self.dim_ordering = dim_ordering
+        self.bias = bias
+
+    def build(self, rng, input_shape):
+        cin = input_shape[1] if self.dim_ordering == "th" else input_shape[3]
+        p = {"W": self.init(rng, self.kernel + (cin, self.nb_filter),
+                            jnp.float32)}
+        if self.bias:
+            p["b"] = jnp.zeros((self.nb_filter,), jnp.float32)
+        return p
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        x = inputs
+        if self.dim_ordering == "th":
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        y = jax.lax.conv_general_dilated(
+            x, params["W"], window_strides=self.subsample,
+            padding=self.border_mode.upper(), rhs_dilation=self.rate,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.bias:
+            y = y + params["b"]
+        if self.activation:
+            y = self.activation(y)
+        if self.dim_ordering == "th":
+            y = jnp.transpose(y, (0, 3, 1, 2))
+        return y
+
+    def compute_output_shape(self, input_shape):
+        if self.dim_ordering == "th":
+            b, c, h, w = input_shape
+        else:
+            b, h, w, c = input_shape
+        ek = tuple(self.rate[i] * (self.kernel[i] - 1) + 1 for i in (0, 1))
+        oh = _out_dim(h, ek[0], self.subsample[0], self.border_mode)
+        ow = _out_dim(w, ek[1], self.subsample[1], self.border_mode)
+        if self.dim_ordering == "th":
+            return (b, self.nb_filter, oh, ow)
+        return (b, oh, ow, self.nb_filter)
+
+
+class AtrousConvolution1D(Layer):
+    """reference: ``AtrousConvolution1D`` — input (B, T, C)."""
+
+    def __init__(self, nb_filter: int, filter_length: int,
+                 init="glorot_uniform", activation=None,
+                 border_mode: str = "valid", subsample_length: int = 1,
+                 atrous_rate: int = 1, bias: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        self.nb_filter = int(nb_filter)
+        self.k = int(filter_length)
+        self.init = get_initializer(init)
+        self.activation = get_activation_fn(activation)
+        self.border_mode = border_mode
+        self.stride = int(subsample_length)
+        self.rate = int(atrous_rate)
+        self.bias = bias
+
+    def build(self, rng, input_shape):
+        cin = input_shape[-1]
+        p = {"W": self.init(rng, (self.k, cin, self.nb_filter), jnp.float32)}
+        if self.bias:
+            p["b"] = jnp.zeros((self.nb_filter,), jnp.float32)
+        return p
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        y = jax.lax.conv_general_dilated(
+            inputs, params["W"], window_strides=(self.stride,),
+            padding=self.border_mode.upper(), rhs_dilation=(self.rate,),
+            dimension_numbers=("NWC", "WIO", "NWC"))
+        if self.bias:
+            y = y + params["b"]
+        if self.activation:
+            y = self.activation(y)
+        return y
+
+    def compute_output_shape(self, input_shape):
+        b, t, c = input_shape
+        ek = self.rate * (self.k - 1) + 1
+        return (b, _out_dim(t, ek, self.stride, self.border_mode),
+                self.nb_filter)
+
+
+class Deconvolution2D(Layer):
+    """Transposed conv (reference: ``Deconvolution2D``; th layout)."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 init="glorot_uniform", activation=None,
+                 subsample: Tuple[int, int] = (1, 1),
+                 border_mode: str = "valid",
+                 dim_ordering: str = "th", bias: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        if border_mode != "valid":
+            raise ValueError("Deconvolution2D supports border_mode='valid' "
+                             "only (the reference's constraint too)")
+        self.nb_filter = int(nb_filter)
+        self.kernel = (int(nb_row), int(nb_col))
+        self.init = get_initializer(init)
+        self.activation = get_activation_fn(activation)
+        self.subsample = _tup(subsample, 2)
+        self.border_mode = border_mode
+        self.dim_ordering = dim_ordering
+        self.bias = bias
+
+    def build(self, rng, input_shape):
+        cin = input_shape[1] if self.dim_ordering == "th" else input_shape[3]
+        p = {"W": self.init(rng, self.kernel + (self.nb_filter, cin),
+                            jnp.float32)}  # HWOI (deconv: out before in)
+        if self.bias:
+            p["b"] = jnp.zeros((self.nb_filter,), jnp.float32)
+        return p
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        x = inputs
+        if self.dim_ordering == "th":
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        kh, kw = self.kernel
+        sh, sw = self.subsample
+        # fractionally-strided conv with the spatially-flipped kernel
+        w = jnp.flip(params["W"], (0, 1))  # HWOI
+        w = jnp.transpose(w, (0, 1, 3, 2))  # -> HWIO with I=cin
+        pad = ((kh - 1, kh - 1), (kw - 1, kw - 1))
+        y = jax.lax.conv_general_dilated(
+            x, w, window_strides=(1, 1), padding=pad,
+            lhs_dilation=(sh, sw),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.bias:
+            y = y + params["b"]
+        if self.activation:
+            y = self.activation(y)
+        if self.dim_ordering == "th":
+            y = jnp.transpose(y, (0, 3, 1, 2))
+        return y
+
+    def compute_output_shape(self, input_shape):
+        if self.dim_ordering == "th":
+            b, c, h, w = input_shape
+        else:
+            b, h, w, c = input_shape
+        oh = None if h is None else (h - 1) * self.subsample[0] + \
+            self.kernel[0]
+        ow = None if w is None else (w - 1) * self.subsample[1] + \
+            self.kernel[1]
+        if self.dim_ordering == "th":
+            return (b, self.nb_filter, oh, ow)
+        return (b, oh, ow, self.nb_filter)
+
+
+class SeparableConvolution2D(Layer):
+    """Depthwise conv then 1x1 pointwise (reference:
+    ``SeparableConvolution2D``)."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 init="glorot_uniform", activation=None,
+                 border_mode: str = "valid",
+                 subsample: Tuple[int, int] = (1, 1),
+                 depth_multiplier: int = 1,
+                 dim_ordering: str = "th", bias: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        self.nb_filter = int(nb_filter)
+        self.kernel = (int(nb_row), int(nb_col))
+        self.init = get_initializer(init)
+        self.activation = get_activation_fn(activation)
+        self.border_mode = border_mode
+        self.subsample = _tup(subsample, 2)
+        self.mult = int(depth_multiplier)
+        self.dim_ordering = dim_ordering
+        self.bias = bias
+
+    def build(self, rng, input_shape):
+        cin = input_shape[1] if self.dim_ordering == "th" else input_shape[3]
+        k1, k2 = jax.random.split(rng)
+        p = {"depth_W": self.init(
+                 k1, self.kernel + (1, cin * self.mult), jnp.float32),
+             "point_W": self.init(
+                 k2, (1, 1, cin * self.mult, self.nb_filter), jnp.float32)}
+        if self.bias:
+            p["b"] = jnp.zeros((self.nb_filter,), jnp.float32)
+        return p
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        x = inputs
+        if self.dim_ordering == "th":
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        cin = x.shape[-1]
+        y = jax.lax.conv_general_dilated(
+            x, params["depth_W"], window_strides=self.subsample,
+            padding=self.border_mode.upper(), feature_group_count=cin,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        y = jax.lax.conv_general_dilated(
+            y, params["point_W"], window_strides=(1, 1), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.bias:
+            y = y + params["b"]
+        if self.activation:
+            y = self.activation(y)
+        if self.dim_ordering == "th":
+            y = jnp.transpose(y, (0, 3, 1, 2))
+        return y
+
+    def compute_output_shape(self, input_shape):
+        if self.dim_ordering == "th":
+            b, c, h, w = input_shape
+        else:
+            b, h, w, c = input_shape
+        oh = _out_dim(h, self.kernel[0], self.subsample[0], self.border_mode)
+        ow = _out_dim(w, self.kernel[1], self.subsample[1], self.border_mode)
+        if self.dim_ordering == "th":
+            return (b, self.nb_filter, oh, ow)
+        return (b, oh, ow, self.nb_filter)
+
+
+class ShareConvolution2D(Convolution2D):
+    """reference: ``ShareConvolution2D`` — same math as Convolution2D (a
+    standard conv already shares weights spatially)."""
+
+
+class LocallyConnected1D(Layer):
+    """Unshared conv over time (reference: ``LocallyConnected1D``)."""
+
+    def __init__(self, nb_filter: int, filter_length: int,
+                 activation=None, subsample_length: int = 1,
+                 border_mode: str = "valid", bias: bool = True,
+                 init="glorot_uniform", **kwargs):
+        super().__init__(**kwargs)
+        if border_mode != "valid":
+            raise ValueError("LocallyConnected1D supports border_mode="
+                             "'valid' only (like the reference)")
+        self.nb_filter = int(nb_filter)
+        self.k = int(filter_length)
+        self.stride = int(subsample_length)
+        self.activation = get_activation_fn(activation)
+        self.bias = bias
+        self.init = get_initializer(init)
+
+    def build(self, rng, input_shape):
+        t, c = input_shape[1], input_shape[2]
+        ot = (t - self.k) // self.stride + 1
+        p = {"W": self.init(rng, (ot, self.k * c, self.nb_filter),
+                            jnp.float32)}
+        if self.bias:
+            p["b"] = jnp.zeros((ot, self.nb_filter), jnp.float32)
+        return p
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        b, t, c = inputs.shape
+        ot = params["W"].shape[0]
+        idx = jnp.arange(ot) * self.stride
+        patches = jax.vmap(
+            lambda i: jax.lax.dynamic_slice_in_dim(inputs, i, self.k, 1),
+            out_axes=1)(idx)                      # (B, OT, K, C)
+        patches = patches.reshape(b, ot, self.k * c)
+        y = jnp.einsum("bok,okf->bof", patches, params["W"])
+        if self.bias:
+            y = y + params["b"]
+        if self.activation:
+            y = self.activation(y)
+        return y
+
+    def compute_output_shape(self, input_shape):
+        b, t, c = input_shape
+        ot = None if t is None else (t - self.k) // self.stride + 1
+        return (b, ot, self.nb_filter)
+
+
+class LocallyConnected2D(Layer):
+    """Unshared 2D conv (reference: ``LocallyConnected2D``; th layout)."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation=None, subsample: Tuple[int, int] = (1, 1),
+                 border_mode: str = "valid", dim_ordering: str = "th",
+                 bias: bool = True, init="glorot_uniform", **kwargs):
+        super().__init__(**kwargs)
+        if border_mode != "valid":
+            raise ValueError("LocallyConnected2D supports border_mode="
+                             "'valid' only (like the reference)")
+        self.nb_filter = int(nb_filter)
+        self.kernel = (int(nb_row), int(nb_col))
+        self.subsample = _tup(subsample, 2)
+        self.activation = get_activation_fn(activation)
+        self.dim_ordering = dim_ordering
+        self.bias = bias
+        self.init = get_initializer(init)
+
+    def _hw(self, input_shape):
+        return (input_shape[2], input_shape[3]) \
+            if self.dim_ordering == "th" else (input_shape[1],
+                                               input_shape[2])
+
+    def build(self, rng, input_shape):
+        h, w = self._hw(input_shape)
+        c = input_shape[1] if self.dim_ordering == "th" else input_shape[3]
+        oh = (h - self.kernel[0]) // self.subsample[0] + 1
+        ow = (w - self.kernel[1]) // self.subsample[1] + 1
+        p = {"W": self.init(
+            rng, (oh * ow, self.kernel[0] * self.kernel[1] * c,
+                  self.nb_filter), jnp.float32)}
+        if self.bias:
+            p["b"] = jnp.zeros((oh * ow, self.nb_filter), jnp.float32)
+        return p
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        x = inputs
+        if self.dim_ordering == "th":
+            x = jnp.transpose(x, (0, 2, 3, 1))  # NHWC
+        b, h, w, c = x.shape
+        kh, kw = self.kernel
+        sh, sw = self.subsample
+        oh = (h - kh) // sh + 1
+        ow = (w - kw) // sw + 1
+        patches = jax.lax.conv_general_dilated_patches(
+            x, (kh, kw), (sh, sw), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))  # (B,OH,OW,C*KH*KW)
+        patches = patches.reshape(b, oh * ow, -1)
+        y = jnp.einsum("bpk,pkf->bpf", patches, params["W"])
+        if self.bias:
+            y = y + params["b"]
+        y = y.reshape(b, oh, ow, self.nb_filter)
+        if self.activation:
+            y = self.activation(y)
+        if self.dim_ordering == "th":
+            y = jnp.transpose(y, (0, 3, 1, 2))
+        return y
+
+    def compute_output_shape(self, input_shape):
+        h, w = self._hw(input_shape)
+        oh = None if h is None else (h - self.kernel[0]) // \
+            self.subsample[0] + 1
+        ow = None if w is None else (w - self.kernel[1]) // \
+            self.subsample[1] + 1
+        if self.dim_ordering == "th":
+            return (input_shape[0], self.nb_filter, oh, ow)
+        return (input_shape[0], oh, ow, self.nb_filter)
+
+
+class ConvLSTM2D(Layer):
+    """Convolutional LSTM over a (B, T, C, H, W) sequence (reference:
+    ``ConvLSTM2D``; th layout, square kernel). Runs under ``lax.scan`` —
+    one compiled step body for the whole sequence."""
+
+    def __init__(self, nb_filter: int, nb_kernel: int,
+                 activation="tanh", inner_activation="hard_sigmoid",
+                 dim_ordering: str = "th", border_mode: str = "same",
+                 subsample: Tuple[int, int] = (1, 1),
+                 return_sequences: bool = False,
+                 init="glorot_uniform", **kwargs):
+        super().__init__(**kwargs)
+        if dim_ordering != "th":
+            raise ValueError("ConvLSTM2D supports dim_ordering='th' (the "
+                             "reference only ships th)")
+        if border_mode != "same" or _tup(subsample, 2) != (1, 1):
+            raise ValueError("ConvLSTM2D supports border_mode='same', "
+                             "subsample=(1,1) (reference constraint)")
+        self.nb_filter = int(nb_filter)
+        self.k = int(nb_kernel)
+        self.activation = get_activation_fn(activation)
+        self.inner_activation = get_activation_fn(inner_activation)
+        self.return_sequences = return_sequences
+        self.init = get_initializer(init)
+
+    def build(self, rng, input_shape):
+        c = input_shape[2]
+        k1, k2 = jax.random.split(rng)
+        return {
+            "W": self.init(k1, (self.k, self.k, c, 4 * self.nb_filter),
+                           jnp.float32),
+            "U": self.init(k2, (self.k, self.k, self.nb_filter,
+                                4 * self.nb_filter), jnp.float32),
+            "b": jnp.zeros((4 * self.nb_filter,), jnp.float32),
+        }
+
+    def _conv(self, x, w):
+        return jax.lax.conv_general_dilated(
+            x, w, window_strides=(1, 1), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        x = jnp.transpose(inputs, (1, 0, 3, 4, 2))  # (T, B, H, W, C)
+        b, h, w = x.shape[1], x.shape[2], x.shape[3]
+        f = self.nb_filter
+        h0 = jnp.zeros((b, h, w, f), inputs.dtype)
+        c0 = jnp.zeros((b, h, w, f), inputs.dtype)
+
+        def step(carry, xt):
+            hp, cp = carry
+            z = self._conv(xt, params["W"]) + self._conv(hp, params["U"]) \
+                + params["b"]
+            zi, zf, zc, zo = jnp.split(z, 4, axis=-1)
+            i = self.inner_activation(zi)
+            fg = self.inner_activation(zf)
+            cn = fg * cp + i * self.activation(zc)
+            o = self.inner_activation(zo)
+            hn = o * self.activation(cn)
+            return (hn, cn), hn
+
+        (hT, _), hs = jax.lax.scan(step, (h0, c0), x)
+        if self.return_sequences:
+            return jnp.transpose(hs, (1, 0, 4, 2, 3))  # (B,T,F,H,W)
+        return jnp.transpose(hT, (0, 3, 1, 2))  # (B,F,H,W)
+
+    def compute_output_shape(self, input_shape):
+        b, t, c, h, w = input_shape
+        if self.return_sequences:
+            return (b, t, self.nb_filter, h, w)
+        return (b, self.nb_filter, h, w)
+
+
+class WordEmbedding(Layer):
+    """Frozen pretrained word embedding (reference: ``WordEmbedding`` —
+    loads GloVe-style vectors, not trainable). The table rides in the
+    ``stats`` subtree so the train step never takes its gradient."""
+
+    def __init__(self, embedding_matrix: np.ndarray, **kwargs):
+        super().__init__(**kwargs)
+        self.matrix = np.asarray(embedding_matrix, np.float32)
+
+    @classmethod
+    def from_glove(cls, path: str, word_index: dict, **kwargs):
+        from zoo_tpu.feature.text import load_glove_matrix
+        return cls(load_glove_matrix(path, word_index), **kwargs)
+
+    def build(self, rng, input_shape):
+        return {"stats": {"table": jnp.asarray(self.matrix)}}
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        return jnp.take(params["stats"]["table"],
+                        inputs.astype(jnp.int32), axis=0)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape) + (self.matrix.shape[1],)
+
+
+# ------------------------------------------------- 3D pool/pad/resize
+
+class _Pool3D(Layer):
+    """th layout (B, C, D, H, W); pools run channel-last internally."""
+
+    def __init__(self, pool_size=(2, 2, 2), strides=None,
+                 border_mode: str = "valid", dim_ordering: str = "th",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.pool = _tup(pool_size, 3)
+        self.strides = _tup(strides, 3) if strides is not None else self.pool
+        self.border_mode = border_mode
+        self.dim_ordering = dim_ordering
+
+    def _reduce(self, x):
+        raise NotImplementedError
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        x = inputs
+        if self.dim_ordering == "th":
+            x = jnp.transpose(x, (0, 2, 3, 4, 1))
+        y = self._reduce(x)
+        if self.dim_ordering == "th":
+            y = jnp.transpose(y, (0, 4, 1, 2, 3))
+        return y
+
+    def compute_output_shape(self, input_shape):
+        if self.dim_ordering == "th":
+            b, c, d, h, w = input_shape
+        else:
+            b, d, h, w, c = input_shape
+        od = _out_dim(d, self.pool[0], self.strides[0], self.border_mode)
+        oh = _out_dim(h, self.pool[1], self.strides[1], self.border_mode)
+        ow = _out_dim(w, self.pool[2], self.strides[2], self.border_mode)
+        if self.dim_ordering == "th":
+            return (b, c, od, oh, ow)
+        return (b, od, oh, ow, c)
+
+
+class MaxPooling3D(_Pool3D):
+    def _reduce(self, x):
+        return jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max, (1,) + self.pool + (1,),
+            (1,) + self.strides + (1,), self.border_mode.upper())
+
+
+class AveragePooling3D(_Pool3D):
+    def _reduce(self, x):
+        s = jax.lax.reduce_window(
+            x, 0.0, jax.lax.add, (1,) + self.pool + (1,),
+            (1,) + self.strides + (1,), self.border_mode.upper())
+        return s / float(np.prod(self.pool))
+
+
+class GlobalAveragePooling3D(Layer):
+    def __init__(self, dim_ordering: str = "th", **kwargs):
+        super().__init__(**kwargs)
+        self.dim_ordering = dim_ordering
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        axes = (2, 3, 4) if self.dim_ordering == "th" else (1, 2, 3)
+        return jnp.mean(inputs, axis=axes)
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0],
+                input_shape[1 if self.dim_ordering == "th" else 4])
+
+
+class GlobalMaxPooling3D(GlobalAveragePooling3D):
+    def call(self, params, inputs, *, training=False, rng=None):
+        axes = (2, 3, 4) if self.dim_ordering == "th" else (1, 2, 3)
+        return jnp.max(inputs, axis=axes)
+
+
+class UpSampling3D(Layer):
+    def __init__(self, size=(2, 2, 2), dim_ordering: str = "th", **kwargs):
+        super().__init__(**kwargs)
+        self.size = _tup(size, 3)
+        self.dim_ordering = dim_ordering
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        axes = (2, 3, 4) if self.dim_ordering == "th" else (1, 2, 3)
+        y = inputs
+        for ax, r in zip(axes, self.size):
+            y = jnp.repeat(y, r, axis=ax)
+        return y
+
+    def compute_output_shape(self, input_shape):
+        out = list(input_shape)
+        axes = (2, 3, 4) if self.dim_ordering == "th" else (1, 2, 3)
+        for ax, r in zip(axes, self.size):
+            if out[ax] is not None:
+                out[ax] *= r
+        return tuple(out)
+
+
+class ZeroPadding3D(Layer):
+    def __init__(self, padding=(1, 1, 1), dim_ordering: str = "th",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.padding = _tup(padding, 3)
+        self.dim_ordering = dim_ordering
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        p = self.padding
+        cfg = [(0, 0)] * 5
+        axes = (2, 3, 4) if self.dim_ordering == "th" else (1, 2, 3)
+        for ax, v in zip(axes, p):
+            cfg[ax] = (v, v)
+        return jnp.pad(inputs, cfg)
+
+    def compute_output_shape(self, input_shape):
+        out = list(input_shape)
+        axes = (2, 3, 4) if self.dim_ordering == "th" else (1, 2, 3)
+        for ax, v in zip(axes, self.padding):
+            if out[ax] is not None:
+                out[ax] += 2 * v
+        return tuple(out)
+
+
+class Cropping3D(Layer):
+    def __init__(self, cropping=((1, 1), (1, 1), (1, 1)),
+                 dim_ordering: str = "th", **kwargs):
+        super().__init__(**kwargs)
+        self.cropping = tuple(_tup(c, 2) for c in cropping)
+        self.dim_ordering = dim_ordering
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        axes = (2, 3, 4) if self.dim_ordering == "th" else (1, 2, 3)
+        ix = [slice(None)] * 5
+        for ax, (lo, hi) in zip(axes, self.cropping):
+            ix[ax] = slice(lo, inputs.shape[ax] - hi)
+        return inputs[tuple(ix)]
+
+    def compute_output_shape(self, input_shape):
+        out = list(input_shape)
+        axes = (2, 3, 4) if self.dim_ordering == "th" else (1, 2, 3)
+        for ax, (lo, hi) in zip(axes, self.cropping):
+            if out[ax] is not None:
+                out[ax] -= lo + hi
+        return tuple(out)
+
+
+class SpatialDropout3D(Layer):
+    """Drop whole channels of a 3D volume (reference: ``SpatialDropout3D``)."""
+
+    def __init__(self, p: float = 0.5, dim_ordering: str = "th", **kwargs):
+        super().__init__(**kwargs)
+        self.p = float(p)
+        self.dim_ordering = dim_ordering
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        if not training or rng is None or self.p <= 0:
+            return inputs
+        r = layer_rng(rng, self.name)
+        if self.dim_ordering == "th":
+            shape = (inputs.shape[0], inputs.shape[1], 1, 1, 1)
+        else:
+            shape = (inputs.shape[0], 1, 1, 1, inputs.shape[4])
+        keep = jax.random.bernoulli(r, 1.0 - self.p, shape)
+        return jnp.where(keep, inputs / (1.0 - self.p), 0.0)

@@ -1,0 +1,416 @@
+"""Element-wise / utility layers closing the keras-1 layer-zoo gap.
+
+Rebuild of the reference's "torch-style" utility layers (Python
+``pyzoo/zoo/pipeline/api/keras/layers/torch.py`` — AddConstant, MulConstant,
+CAdd, CMul, Exp, Log, Sqrt, Square, Power, Negative, Identity, HardTanh,
+HardShrink, SoftShrink, Threshold, BinaryThreshold, RReLU, Scale, Narrow,
+Select, Squeeze, ExpandDim, Max, GetShape ... Scala
+``pipeline/api/keras/layers/*.scala``), the noise layers
+(``noise.py`` GaussianDropout / GaussianSampler), Masking (``core.py``),
+LRN (``normalization.py``), ResizeBilinear and WordEmbedding
+(``embeddings.py``). Each is a stateless jnp map — XLA fuses them into
+neighbours, so there is no kernel cost to the fine granularity.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from zoo_tpu.pipeline.api.keras.engine.base import Layer, layer_rng
+
+
+class _Elementwise(Layer):
+    """Shape-preserving parameterless map."""
+
+    def _fn(self, x):
+        raise NotImplementedError
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        return self._fn(inputs)
+
+
+class Identity(_Elementwise):
+    def _fn(self, x):
+        return x
+
+
+class Exp(_Elementwise):
+    def _fn(self, x):
+        return jnp.exp(x)
+
+
+class Log(_Elementwise):
+    def _fn(self, x):
+        return jnp.log(x)
+
+
+class Sqrt(_Elementwise):
+    def _fn(self, x):
+        return jnp.sqrt(x)
+
+
+class Square(_Elementwise):
+    def _fn(self, x):
+        return jnp.square(x)
+
+
+class Negative(_Elementwise):
+    def _fn(self, x):
+        return -x
+
+
+class AddConstant(_Elementwise):
+    def __init__(self, constant_scalar: float, **kwargs):
+        super().__init__(**kwargs)
+        self.c = float(constant_scalar)
+
+    def _fn(self, x):
+        return x + self.c
+
+
+class MulConstant(_Elementwise):
+    def __init__(self, constant_scalar: float, **kwargs):
+        super().__init__(**kwargs)
+        self.c = float(constant_scalar)
+
+    def _fn(self, x):
+        return x * self.c
+
+
+class Power(_Elementwise):
+    """reference: ``Power(power, scale, shift)`` → (shift + scale·x)^power."""
+
+    def __init__(self, power: float, scale: float = 1.0, shift: float = 0.0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.power, self.scale, self.shift = power, scale, shift
+
+    def _fn(self, x):
+        return jnp.power(self.shift + self.scale * x, self.power)
+
+
+class HardTanh(_Elementwise):
+    def __init__(self, min_value: float = -1.0, max_value: float = 1.0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.lo, self.hi = min_value, max_value
+
+    def _fn(self, x):
+        return jnp.clip(x, self.lo, self.hi)
+
+
+class HardShrink(_Elementwise):
+    def __init__(self, value: float = 0.5, **kwargs):
+        super().__init__(**kwargs)
+        self.v = value
+
+    def _fn(self, x):
+        return jnp.where(jnp.abs(x) > self.v, x, 0.0)
+
+
+class SoftShrink(_Elementwise):
+    def __init__(self, value: float = 0.5, **kwargs):
+        super().__init__(**kwargs)
+        self.v = value
+
+    def _fn(self, x):
+        return jnp.sign(x) * jnp.maximum(jnp.abs(x) - self.v, 0.0)
+
+
+class Threshold(_Elementwise):
+    """x if x > th else value (reference: ``Threshold``)."""
+
+    def __init__(self, th: float = 1e-6, v: float = 0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.th, self.v = th, v
+
+    def _fn(self, x):
+        return jnp.where(x > self.th, x, self.v)
+
+
+class BinaryThreshold(_Elementwise):
+    def __init__(self, value: float = 1e-6, **kwargs):
+        super().__init__(**kwargs)
+        self.v = value
+
+    def _fn(self, x):
+        return (x > self.v).astype(jnp.float32)
+
+
+class RReLU(Layer):
+    """Randomized leaky ReLU: slope ~ U[lower, upper] in training, the
+    midpoint at inference (reference: ``RReLU``)."""
+
+    def __init__(self, lower: float = 1 / 8, upper: float = 1 / 3, **kwargs):
+        super().__init__(**kwargs)
+        self.lower, self.upper = lower, upper
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        if training and rng is not None:
+            r = layer_rng(rng, self.name)
+            slope = jax.random.uniform(r, inputs.shape,
+                                       minval=self.lower, maxval=self.upper)
+        else:
+            slope = (self.lower + self.upper) / 2.0
+        return jnp.where(inputs >= 0, inputs, inputs * slope)
+
+
+class CAdd(Layer):
+    """Learnable per-element bias of shape ``size`` (reference: ``CAdd``)."""
+
+    def __init__(self, size: Sequence[int], **kwargs):
+        super().__init__(**kwargs)
+        self.size = tuple(int(s) for s in size)
+
+    def build(self, rng, input_shape):
+        return {"b": jnp.zeros(self.size, jnp.float32)}
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        return inputs + params["b"]
+
+
+class CMul(Layer):
+    """Learnable per-element scale of shape ``size`` (reference: ``CMul``)."""
+
+    def __init__(self, size: Sequence[int], **kwargs):
+        super().__init__(**kwargs)
+        self.size = tuple(int(s) for s in size)
+
+    def build(self, rng, input_shape):
+        return {"g": jnp.ones(self.size, jnp.float32)}
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        return inputs * params["g"]
+
+
+class Scale(Layer):
+    """CMul then CAdd (reference: ``Scale``)."""
+
+    def __init__(self, size: Sequence[int], **kwargs):
+        super().__init__(**kwargs)
+        self.size = tuple(int(s) for s in size)
+
+    def build(self, rng, input_shape):
+        return {"g": jnp.ones(self.size, jnp.float32),
+                "b": jnp.zeros(self.size, jnp.float32)}
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        return inputs * params["g"] + params["b"]
+
+
+class Narrow(Layer):
+    """Slice ``length`` elements from ``offset`` along ``dim`` (reference:
+    ``Narrow``; dim counts the batch as 0, matching the reference)."""
+
+    def __init__(self, dim: int, offset: int, length: int = 1, **kwargs):
+        super().__init__(**kwargs)
+        self.dim, self.offset, self.length = dim, offset, length
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        ix = [slice(None)] * inputs.ndim
+        length = self.length if self.length != -1 \
+            else inputs.shape[self.dim] - self.offset
+        ix[self.dim] = slice(self.offset, self.offset + length)
+        return inputs[tuple(ix)]
+
+    def compute_output_shape(self, input_shape):
+        out = list(input_shape)
+        if self.length != -1:
+            out[self.dim] = self.length
+        elif out[self.dim] is not None:
+            out[self.dim] = out[self.dim] - self.offset
+        return tuple(out)
+
+
+class Select(Layer):
+    """Pick index ``index`` along ``dim`` (reference: ``Select``)."""
+
+    def __init__(self, dim: int, index: int, **kwargs):
+        super().__init__(**kwargs)
+        self.dim, self.index = dim, index
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        return jax.lax.index_in_dim(inputs, self.index, axis=self.dim,
+                                    keepdims=False)
+
+    def compute_output_shape(self, input_shape):
+        out = list(input_shape)
+        del out[self.dim]
+        return tuple(out)
+
+
+class Squeeze(Layer):
+    def __init__(self, dim: int, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = dim
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        return jnp.squeeze(inputs, axis=self.dim)
+
+    def compute_output_shape(self, input_shape):
+        out = list(input_shape)
+        del out[self.dim]
+        return tuple(out)
+
+
+class ExpandDim(Layer):
+    def __init__(self, dim: int, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = dim
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        return jnp.expand_dims(inputs, self.dim)
+
+    def compute_output_shape(self, input_shape):
+        out = list(input_shape)
+        out.insert(self.dim if self.dim >= 0 else len(out) + 1 + self.dim,
+                   1)
+        return tuple(out)
+
+
+class Max(Layer):
+    """Max over ``dim`` (reference: ``Max(dim, return_value=True)``)."""
+
+    def __init__(self, dim: int, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = dim
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        return jnp.max(inputs, axis=self.dim)
+
+    def compute_output_shape(self, input_shape):
+        out = list(input_shape)
+        del out[self.dim]
+        return tuple(out)
+
+
+class GetShape(Layer):
+    def call(self, params, inputs, *, training=False, rng=None):
+        return jnp.asarray(inputs.shape, jnp.int32)
+
+    def compute_output_shape(self, input_shape):
+        return (len(input_shape),)
+
+
+class Masking(Layer):
+    """Zero out timesteps equal to ``mask_value`` everywhere (reference:
+    ``Masking``; downstream zoo RNNs see zeroed steps rather than a mask
+    tensor — matching the BigDL implementation's effect on padded data)."""
+
+    def __init__(self, mask_value: float = 0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.mask_value = mask_value
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        keep = jnp.any(inputs != self.mask_value, axis=-1, keepdims=True)
+        return jnp.where(keep, inputs, 0.0)
+
+
+class GaussianDropout(Layer):
+    """Multiplicative 1-mean gaussian noise (reference: ``noise.py``)."""
+
+    def __init__(self, p: float, **kwargs):
+        super().__init__(**kwargs)
+        self.p = float(p)
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        if not training or rng is None or self.p <= 0:
+            return inputs
+        std = np.sqrt(self.p / (1.0 - self.p))
+        r = layer_rng(rng, self.name)
+        return inputs * (1.0 + std * jax.random.normal(r, inputs.shape))
+
+
+class GaussianSampler(Layer):
+    """Sample from N(mean, exp(log_var/2)) given ``[mean, log_var]`` — the
+    VAE reparameterization (reference: ``GaussianSampler``)."""
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        mean, log_var = inputs
+        if rng is None:
+            return mean
+        r = layer_rng(rng, self.name)
+        return mean + jnp.exp(log_var * 0.5) * \
+            jax.random.normal(r, mean.shape)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape[0])
+
+
+class LRN2D(Layer):
+    """Local response normalization across channels (reference: ``LRN2D``;
+    AlexNet-era). ``dim_ordering`` handled like the conv layers."""
+
+    def __init__(self, alpha: float = 1e-4, k: float = 1.0, beta: float = 0.75,
+                 n: int = 5, dim_ordering: str = "th", **kwargs):
+        super().__init__(**kwargs)
+        self.alpha, self.k, self.beta, self.n = alpha, k, beta, int(n)
+        self.dim_ordering = dim_ordering
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        x = inputs
+        caxis = 1 if self.dim_ordering == "th" else 3
+        sq = jnp.square(x)
+        half = self.n // 2
+        # sum sq over a window of n channels
+        pads = [(0, 0)] * x.ndim
+        pads[caxis] = (half, half)
+        padded = jnp.pad(sq, pads)
+        acc = sum(
+            jax.lax.slice_in_dim(padded, i, i + x.shape[caxis], axis=caxis)
+            for i in range(self.n))
+        return x / jnp.power(self.k + self.alpha / self.n * acc, self.beta)
+
+
+class WithinChannelLRN2D(Layer):
+    """LRN over a spatial window within each channel (reference:
+    ``WithinChannelLRN2D``)."""
+
+    def __init__(self, size: int = 5, alpha: float = 1.0,
+                 beta: float = 0.75, **kwargs):
+        super().__init__(**kwargs)
+        self.size, self.alpha, self.beta = int(size), alpha, beta
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        x = inputs  # (B, C, H, W) th-style per reference
+        sq = jnp.square(x)
+        half = self.size // 2
+        summed = jax.lax.reduce_window(
+            sq, 0.0, jax.lax.add, (1, 1, self.size, self.size),
+            (1, 1, 1, 1),
+            ((0, 0), (0, 0), (half, half), (half, half)))
+        denom = jnp.power(1.0 + self.alpha / (self.size ** 2) * summed,
+                          self.beta)
+        return x / denom
+
+
+class ResizeBilinear(Layer):
+    """Bilinear resize of the spatial dims (reference: ``ResizeBilinear``)."""
+
+    def __init__(self, output_height: int, output_width: int,
+                 align_corners: bool = False, dim_ordering: str = "th",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.oh, self.ow = int(output_height), int(output_width)
+        self.align_corners = align_corners
+        self.dim_ordering = dim_ordering
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        x = inputs
+        if self.dim_ordering == "th":
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        b, h, w, c = x.shape
+        method = "bilinear"
+        y = jax.image.resize(x, (b, self.oh, self.ow, c), method=method)
+        if self.dim_ordering == "th":
+            y = jnp.transpose(y, (0, 3, 1, 2))
+        return y
+
+    def compute_output_shape(self, input_shape):
+        if self.dim_ordering == "th":
+            return (input_shape[0], input_shape[1], self.oh, self.ow)
+        return (input_shape[0], self.oh, self.ow, input_shape[3])

@@ -58,9 +58,12 @@ def _decode_packed_varints(buf: bytes) -> List[int]:
 
 def _parse_tensor(buf: bytes) -> Tuple[str, np.ndarray]:
     f = wire.parse_fields(buf)
-    dims = [int(d) for d in f.get(_T_DIMS, [])]
-    if len(dims) == 1 and isinstance(f.get(_T_DIMS, [None])[0], bytes):
-        dims = _decode_packed_varints(f[_T_DIMS][0])
+    dims: List[int] = []
+    for d in f.get(_T_DIMS, []):
+        if isinstance(d, bytes):  # packed repeated (proto3 default)
+            dims.extend(_decode_packed_varints(d))
+        else:
+            dims.append(int(d))
     dt = _DTYPES[int(f.get(_T_DTYPE, [1])[0])]
     name = f.get(_T_NAME, [b""])[0].decode()
     if _T_RAW in f:
@@ -292,7 +295,13 @@ def _avg_pool(node, x):
         (pads[i], pads[i + nd]) for i in range(nd))
     summed = lax.reduce_window(x, 0.0, lax.add, (1, 1) + k, (1, 1) + s,
                                pad_cfg)
-    return summed / np.prod(k)
+    include_pad = bool(node.attrs.get("count_include_pad", 0))
+    if include_pad or not any(pads):
+        return summed / np.prod(k)
+    # ONNX default: average over VALID cells only at padded borders
+    counts = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add,
+                               (1, 1) + k, (1, 1) + s, pad_cfg)
+    return summed / counts
 
 
 @_onnx_op("GlobalAveragePool")

@@ -70,7 +70,7 @@ def test_inference_model_from_torch(orca_ctx):
 
 
 def test_serving_end_to_end(orca_ctx):
-    from zoo_tpu.serving import InputQueue, OutputQueue, ServingServer
+    from zoo_tpu.serving import TCPInputQueue as InputQueue, TCPOutputQueue as OutputQueue, ServingServer
 
     m, x = _trained_model(orca_ctx)
     inf = InferenceModel(supported_concurrent_num=2).load_keras(

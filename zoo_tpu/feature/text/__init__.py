@@ -109,7 +109,31 @@ class TextSet:
         out.word_index = corpus1.word_index
         return out
 
-    from_relation_lists = from_relation_pairs
+    @classmethod
+    def from_relation_lists(cls, relations, corpus1: "TextSet",
+                            corpus2: "TextSet") -> "LocalTextSet":
+        """Listwise ranking set: all of a query's candidates grouped into
+        ONE feature — ``indexedTokens`` (k, L1+L2) and ``label`` (k,) — so
+        list-level metrics (NDCG/MAP) evaluate per query (reference
+        ``from_relation_lists``)."""
+        c1 = {f["uri"]: f for f in corpus1.features}
+        c2 = {f["uri"]: f for f in corpus2.features}
+        grouped: Dict[str, List] = {}
+        for (id1, id2, label) in relations:
+            grouped.setdefault(id1, []).append((id2, int(label)))
+        feats = []
+        for id1, cands in grouped.items():
+            t1 = np.asarray(c1[id1]["indexedTokens"])
+            rows = [np.concatenate([t1,
+                                    np.asarray(c2[id2]["indexedTokens"])])
+                    for id2, _ in cands]
+            nf = TextFeature(uri=id1)
+            nf["indexedTokens"] = np.stack(rows)
+            nf["label"] = np.asarray([l for _, l in cands], np.int32)
+            feats.append(nf)
+        out = LocalTextSet(feats)
+        out.word_index = corpus1.word_index
+        return out
 
     # -- chain -------------------------------------------------------------
     def tokenize(self) -> "TextSet":

@@ -114,6 +114,13 @@ class KerasNet:
         (net-new: the reference's fabric is f32-only CPU)."""
         if dtype_policy not in ("float32", "mixed_bfloat16"):
             raise ValueError(f"unknown dtype_policy: {dtype_policy}")
+        n_out = len(getattr(self, "outputs", [None]))
+        if n_out > 1 and not isinstance(loss, (list, tuple)):
+            raise ValueError(
+                f"model has {n_out} outputs; compile(loss=[...]) needs one "
+                "loss per output")
+        if isinstance(loss, (list, tuple)) and len(loss) != n_out:
+            raise ValueError(f"{len(loss)} losses for {n_out} outputs")
         self.dtype_policy = dtype_policy
         self.optimizer = get_optimizer(optimizer)
         if isinstance(loss, (list, tuple)):
@@ -316,7 +323,21 @@ class KerasNet:
         xs = self._adapt_inputs(xs)
         if ys is None:
             raise ValueError("fit requires labels")
-        ys_list = list(ys) if isinstance(ys, (list, tuple)) else [ys]
+        n_out = len(getattr(self, "outputs", [None]))
+        if isinstance(ys, (list, tuple)):
+            if n_out <= 1:
+                # single-output model: a list of per-sample label rows is
+                # ONE label array, not a multi-output label set
+                ys = np.stack([np.asarray(a) for a in ys]) \
+                    if len(ys) > 1 else np.asarray(ys[0])
+                ys_list = [ys]
+            elif len(ys) != n_out:
+                raise ValueError(f"model has {n_out} outputs but got "
+                                 f"{len(ys)} label arrays")
+            else:
+                ys_list = list(ys)
+        else:
+            ys_list = [ys]
         n = data_utils.num_samples(xs)
 
         mesh = self._mesh()

@@ -1,4 +1,11 @@
-from zoo_tpu.serving.server import ServingServer
-from zoo_tpu.serving.client import InputQueue, OutputQueue
+from zoo_tpu.serving.client import InputQueue, OutputQueue  # noqa: F401
+from zoo_tpu.serving.cluster_serving import ClusterServing, FrontEnd  # noqa: F401
+from zoo_tpu.serving.redis_embedded import EmbeddedRedis  # noqa: F401
+from zoo_tpu.serving.server import ServingServer  # noqa: F401
+from zoo_tpu.serving.tcp_client import (  # noqa: F401
+    TCPInputQueue,
+    TCPOutputQueue,
+)
 
-__all__ = ["ServingServer", "InputQueue", "OutputQueue"]
+__all__ = ["ServingServer", "InputQueue", "OutputQueue", "ClusterServing",
+           "FrontEnd", "EmbeddedRedis", "TCPInputQueue", "TCPOutputQueue"]

@@ -1,87 +1,261 @@
-"""Serving client: InputQueue / OutputQueue.
+"""Cluster Serving client — the reference's Redis wire format.
 
-Rebuild of ``pyzoo/zoo/serving/client.py`` (InputQueue.enqueue via redis
-XADD, OutputQueue.query via HGET). The wire here is the TCP front door of
-:class:`zoo_tpu.serving.server.ServingServer`; the API shape (enqueue /
-predict / query) matches the reference so client code ports directly.
+Rebuild of ``pyzoo/zoo/serving/client.py``: ``InputQueue.enqueue(uri,
+**data)`` XADDs ``{uri, data: b64(arrow RecordBatch)}`` onto the
+``serving_stream`` Redis stream; results land as
+``HSET cluster-serving_<stream>:<uri> value b64(arrow)`` and are read back
+by ``OutputQueue.query/dequeue``. The arrow schema matches the reference's
+``schema.py`` exactly (struct{indiceData, indiceShape, data, shape} per
+tensor; '|'-joined strings for string lists), so reference-shaped client
+code works unmodified against this stack — and this client works against a
+real Redis, not just the embedded one.
 """
 
 from __future__ import annotations
 
-import socket
-import threading
+import base64
+import json
+import time
+import uuid
 from typing import Dict, Optional
 
 import numpy as np
 
-from zoo_tpu.serving.server import _recv_msg, _send_msg
+RESULT_PREFIX = "cluster-serving_"
 
 
-class _Connection:
-    def __init__(self, host: str, port: int):
-        self._sock = socket.create_connection((host, port))
-        self._lock = threading.Lock()
-
-    def rpc(self, msg: Dict) -> Dict:
-        with self._lock:
-            _send_msg(self._sock, msg)
-            resp = _recv_msg(self._sock)
-        if resp is None:
-            raise ConnectionError("serving connection closed")
-        return resp
-
-    def close(self):
-        self._sock.close()
+def _tensor_type():
+    import pyarrow as pa
+    return pa.struct([
+        pa.field("indiceData", pa.list_(pa.int32())),
+        pa.field("indiceShape", pa.list_(pa.int32())),
+        pa.field("data", pa.list_(pa.float32())),
+        pa.field("shape", pa.list_(pa.int32())),
+    ])
 
 
-class InputQueue:
-    def __init__(self, host: str = "127.0.0.1", port: int = 8980):
-        self._conn = _Connection(host, port)
-        self._results: Dict[str, np.ndarray] = {}
+def get_field_and_data(key, value):
+    """reference: ``schema.get_field_and_data`` — dense/sparse tensors,
+    string lists, b64 images."""
+    import pyarrow as pa
 
-    def enqueue(self, uri: str, **data) -> None:
-        """Enqueue one record (reference: ``InputQueue.enqueue(uri, t=...)``);
-        the single tensor value is the model input."""
-        if len(data) != 1:
-            raise ValueError("enqueue expects exactly one named tensor")
-        (_, value), = data.items()
-        arr = np.asarray(value)
-        resp = self._conn.rpc({"op": "predict", "uri": uri,
-                               "data": arr[None] if arr.ndim > 0 and
-                               self._needs_batch(arr) else arr})
-        if "error" in resp:
-            raise RuntimeError(resp["error"])
-        self._results[uri] = resp["result"]
+    if isinstance(value, list):
+        if not value:
+            raise ValueError("empty list is not supported")
+        if isinstance(value[0], str):
+            return pa.field(key, pa.string()), pa.array(["|".join(value)])
+        if isinstance(value[0], np.ndarray):
+            if len(value) != 3:
+                raise ValueError("sparse tensor needs [indices, values, "
+                                 "shape]")
+            tt = _tensor_type()
+            indices, values, shape = value
+            data = pa.array([
+                {"indiceData": indices.astype("int32").flatten()},
+                {"indiceShape": np.asarray(indices.shape, "int32")},
+                {"data": np.asarray(values, "float32").flatten()},
+                {"shape": np.asarray(shape, "int32")}], type=tt)
+            return pa.field(key, tt), data
+        raise TypeError("list of str or ndarray expected")
+    if isinstance(value, str):
+        return pa.field(key, pa.string()), pa.array([value])
+    if isinstance(value, dict):
+        b64 = value.get("b64")
+        if b64 is None and "path" in value:
+            with open(value["path"], "rb") as f:
+                b64 = base64.b64encode(f.read()).decode()
+        if b64 is None:
+            raise TypeError("dict input needs 'path' or 'b64'")
+        return pa.field(key, pa.string()), pa.array([b64])
+    if isinstance(value, np.ndarray):
+        tt = _tensor_type()
+        data = pa.array([
+            {"indiceData": []}, {"indiceShape": []},
+            {"data": value.astype("float32").flatten()},
+            {"shape": np.asarray(value.shape, "int32")}], type=tt)
+        return pa.field(key, tt), data
+    raise TypeError(f"unsupported input type {type(value)}")
 
-    @staticmethod
-    def _needs_batch(arr: np.ndarray) -> bool:
-        return True  # single-record enqueue always adds the batch dim
 
-    def predict(self, x: np.ndarray) -> np.ndarray:
-        """Synchronous batch predict (reference: ``InputQueue.predict``)."""
-        resp = self._conn.rpc({"op": "predict", "uri": "_sync_",
-                               "data": np.asarray(x)})
-        if "error" in resp:
-            raise RuntimeError(resp["error"])
-        return resp["result"]
+def encode_ndarray_b64(arr: np.ndarray) -> str:
+    """Result encoding (what the serving sink writes): RecordBatch of
+    [data float32 list, shape int32 list] — matching the client's
+    ``get_ndarray_from_record_batch`` read side."""
+    import pyarrow as pa
 
-    def pop_result(self, uri: str) -> Optional[np.ndarray]:
-        return self._results.pop(uri, None)
+    arr = np.asarray(arr)
+    flat = arr.astype("float32").flatten().tolist()
+    shape = list(arr.shape) or [1]
+    n = max(len(flat), len(shape))
+    # arrow RecordBatch columns must share a length: null-pad the shorter
+    # (the read side filters the nulls, as the reference client does)
+    batch = pa.RecordBatch.from_arrays(
+        [pa.array(flat + [None] * (n - len(flat)), pa.float32()),
+         pa.array(shape + [None] * (n - len(shape)), pa.int32())],
+        schema=pa.schema([pa.field("data", pa.float32()),
+                          pa.field("shape", pa.int32())]))
+    sink = pa.BufferOutputStream()
+    with pa.RecordBatchStreamWriter(sink, batch.schema) as w:
+        w.write_batch(batch)
+    return base64.b64encode(sink.getvalue().to_pybytes()).decode()
 
-    def stats(self) -> Dict:
-        return self._conn.rpc({"op": "stats"})
 
-    def close(self):
-        self._conn.close()
+def decode_ndarray_b64(b64str: str):
+    import pyarrow as pa
+
+    buf = base64.b64decode(b64str)
+    reader = pa.ipc.open_stream(pa.BufferReader(buf).read_buffer())
+    batches = list(reader)
+    outs = []
+    for rb in batches:
+        data = rb[0].to_numpy(zero_copy_only=False)
+        shape = [s for s in rb[1].to_pylist() if s is not None]
+        n = int(np.prod(shape)) if shape else len(data)
+        outs.append(np.asarray(data[:n]).reshape(shape))
+    return outs[0] if len(outs) == 1 else outs
 
 
-class OutputQueue:
-    """Result fetch API (reference: ``OutputQueue.query``). With the TCP
-    front door responses come back on the request connection, so this wraps
-    the same client-side result store."""
+def encode_input_b64(**data) -> str:
+    """Request encoding (what ``InputQueue.enqueue`` XADDs)."""
+    import pyarrow as pa
 
-    def __init__(self, input_queue: InputQueue):
-        self._iq = input_queue
+    fields, arrays = [], []
+    for key, value in data.items():
+        f, d = get_field_and_data(key, value)
+        fields.append(f)
+        arrays.append(d)
+    # a RecordBatch's columns must share a length: tensors are 4-row
+    # structs, strings 1-row — null-pad the shorter columns (the decode
+    # side skips null rows)
+    n = max(len(a) for a in arrays)
+    arrays = [a if len(a) == n else
+              pa.concat_arrays([a, pa.nulls(n - len(a), a.type)])
+              for a in arrays]
+    batch = pa.RecordBatch.from_arrays(arrays, schema=pa.schema(fields))
+    sink = pa.BufferOutputStream()
+    with pa.RecordBatchStreamWriter(sink, batch.schema) as w:
+        w.write_batch(batch)
+    return base64.b64encode(sink.getvalue().to_pybytes()).decode()
 
-    def query(self, uri: str) -> Optional[np.ndarray]:
-        return self._iq.pop_result(uri)
+
+def decode_input_b64(b64str: str) -> Dict[str, np.ndarray]:
+    """Serving-side decode of ``enqueue``'s payload."""
+    import pyarrow as pa
+
+    buf = base64.b64decode(b64str)
+    reader = pa.ipc.open_stream(pa.BufferReader(buf).read_buffer())
+    out: Dict[str, np.ndarray] = {}
+    for rb in reader:
+        for i, field in enumerate(rb.schema):
+            col = rb.column(i)
+            if pa.types.is_struct(field.type):
+                rows = col.to_pylist()
+                data = next((r["data"] for r in rows
+                             if r and r.get("data")), [])
+                shape = next((r["shape"] for r in rows
+                              if r and r.get("shape")), None)
+                arr = np.asarray(data, np.float32)
+                if shape:
+                    arr = arr.reshape([s for s in shape if s is not None])
+                out[field.name] = arr
+            else:
+                out[field.name] = col.to_pylist()[0]
+    return out
+
+
+class API:
+    """reference: ``client.API`` — connect + ensure the consumer group."""
+
+    def __init__(self, host: Optional[str] = None,
+                 port: Optional[int] = None, name: str = "serving_stream"):
+        from zoo_tpu.serving.resp import RedisClient, RedisError
+
+        self.name = name
+        self.host = host or "localhost"
+        self.port = int(port or 6379)
+        self.db = RedisClient(self.host, self.port)
+        try:
+            self.db.xgroup_create(name, "serving", "$")
+        except RedisError:
+            pass  # group exists
+
+
+class InputQueue(API):
+    def __init__(self, frontend_url: Optional[str] = None, **kwargs):
+        self.frontend_url = frontend_url
+        if frontend_url is None:
+            super().__init__(**kwargs)
+            self.output_queue = OutputQueue(**kwargs)
+        self.input_threshold = 0.6
+        self.interval_if_error = 1
+
+    def enqueue(self, uri: str, **data):
+        self._enqueue_data({"uri": uri, "data": encode_input_b64(**data)})
+
+    def predict(self, request_data, timeout: float = 10.0):
+        """Synchronous predict via the queue (reference
+        ``InputQueue.predict``) or the HTTP frontend when configured."""
+        if self.frontend_url:
+            import urllib.request
+
+            req = urllib.request.Request(
+                self.frontend_url + "/predict",
+                data=request_data.encode()
+                if isinstance(request_data, str) else request_data,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return json.loads(resp.read().decode())["predictions"]
+        if isinstance(request_data, str):
+            parsed = json.loads(request_data)["instances"][0]
+            input_dict = {k: np.asarray(v) for k, v in parsed.items()}
+        elif isinstance(request_data, dict):
+            input_dict = request_data
+        else:
+            input_dict = {"t": request_data}
+        uri = str(uuid.uuid4())
+        self.enqueue(uri, **input_dict)
+        deadline = time.monotonic() + timeout
+        wait = 0.001
+        while time.monotonic() < deadline:
+            out = self.output_queue.query_and_delete(uri)
+            if not isinstance(out, str) or out != "[]":
+                return out
+            time.sleep(wait)
+            wait = min(wait * 2, 0.1)
+        return "[]"
+
+    def _enqueue_data(self, data: Dict[str, str]):
+        info = self.db.info()
+        maxmem = int(info.get("maxmemory", 0) or 0)
+        if maxmem and info.get("used_memory", 0) >= \
+                maxmem * self.input_threshold:
+            raise RuntimeError("redis memory above input threshold; wait "
+                               "for inference or delete records")
+        self.db.xadd(self.name, data)
+
+
+class OutputQueue(API):
+    def dequeue(self) -> Dict[str, np.ndarray]:
+        res = {}
+        for key in self.db.keys(RESULT_PREFIX + self.name + ":*"):
+            h = self.db.hgetall(key)
+            uri = key.decode().split(":", 1)[1]
+            val = h.get(b"value", b"").decode()
+            res[uri] = "NaN" if val == "NaN" else decode_ndarray_b64(val)
+            self.db.delete(key)
+        return res
+
+    def query_and_delete(self, uri: str):
+        return self.query(uri, delete=True)
+
+    def query(self, uri: str, delete: bool = False):
+        key = RESULT_PREFIX + self.name + ":" + uri
+        h = self.db.hgetall(key)
+        if not h:
+            return "[]"
+        if delete:
+            self.db.delete(key)
+        val = h[b"value"].decode()
+        if val == "NaN":
+            return val
+        return decode_ndarray_b64(val)

@@ -1,0 +1,197 @@
+"""Cluster Serving engine: Redis stream → micro-batch → model → Redis hash.
+
+Rebuild of the reference's Flink serving job (``ClusterServing.scala:54-67``
+FlinkRedisSource → FlinkInference → FlinkRedisSink) plus the akka-http
+frontend (``FrontEndApp.scala:94`` ``/predict``, codahale ``/metrics`` at
+:97-105). Here the streaming fabric is a consumer thread XREADGROUP-ing the
+``serving_stream``, batching records (batch window like
+``ClusterServingInference``), running the model (InferenceModel-style
+concurrency), and HSET-ing ``cluster-serving_<stream>:<uri>``. Per-stage
+timers mirror ``serving/engine/Timer.scala:22-60``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+import numpy as np
+
+from zoo_tpu.serving.client import (
+    RESULT_PREFIX,
+    decode_input_b64,
+    encode_ndarray_b64,
+)
+from zoo_tpu.serving.resp import RedisClient, RedisError
+from zoo_tpu.serving.server import StageTimer
+
+
+class ClusterServing:
+    """The serving worker loop."""
+
+    def __init__(self, model, redis_host: str = "localhost",
+                 redis_port: int = 6379, stream: str = "serving_stream",
+                 batch_size: int = 8, batch_wait_ms: int = 5):
+        self.model = model
+        self.stream = stream
+        self.batch_size = batch_size
+        self.batch_wait_ms = batch_wait_ms
+        self.db = RedisClient(redis_host, redis_port)
+        try:
+            self.db.xgroup_create(stream, "serving", "0")
+        except RedisError:
+            pass
+        self.timers = {name: StageTimer()
+                       for name in ("decode", "inference", "encode")}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.records_out = 0
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "ClusterServing":
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    # -- engine -----------------------------------------------------------
+    def _loop(self):
+        import logging
+        while not self._stop.is_set():
+            try:
+                resp = self.db.xreadgroup("serving", "worker-0", self.stream,
+                                          count=self.batch_size,
+                                          block_ms=self.batch_wait_ms)
+                if not resp:
+                    continue
+                entries = resp[0][1]
+                self._handle_batch(entries)
+                self.db.xack(self.stream, "serving",
+                             *[eid for eid, _ in entries])
+            except ConnectionError:
+                return  # redis gone: stop the worker
+            except Exception as e:  # noqa: BLE001 — keep serving
+                logging.getLogger(__name__).exception(
+                    "serving batch failed: %s", e)
+                time.sleep(0.05)
+
+    def _handle_batch(self, entries):
+        t0 = time.perf_counter()
+        uris, inputs = [], []
+        for _eid, flat in entries:
+            kv = {flat[i]: flat[i + 1] for i in range(0, len(flat), 2)}
+            uris.append(kv[b"uri"].decode())
+            inputs.append(decode_input_b64(kv[b"data"].decode()))
+        self.timers["decode"].record(time.perf_counter() - t0)
+
+        t0 = time.perf_counter()
+        outs = []
+        try:
+            keys = list(inputs[0].keys())
+            batched = [np.stack([d[k] for d in inputs]) for k in keys]
+            preds = self.model.predict(
+                batched if len(batched) > 1 else batched[0],
+                batch_size=max(self.batch_size, len(inputs)))
+            outs = [preds[i] for i in range(len(inputs))]
+        except Exception:  # per-record fallback (ragged shapes etc.)
+            for d in inputs:
+                try:
+                    arrs = list(d.values())
+                    p = self.model.predict(
+                        [a[None] for a in arrs] if len(arrs) > 1
+                        else arrs[0][None], batch_size=1)
+                    outs.append(p[0])
+                except Exception:  # noqa: BLE001 — NaN contract
+                    outs.append(None)
+        self.timers["inference"].record(time.perf_counter() - t0)
+
+        t0 = time.perf_counter()
+        for uri, out in zip(uris, outs):
+            val = "NaN" if out is None else encode_ndarray_b64(out)
+            self.db.hset(RESULT_PREFIX + self.stream + ":" + uri,
+                         {"value": val})
+            self.records_out += 1
+        self.timers["encode"].record(time.perf_counter() - t0)
+
+    def metrics(self) -> Dict:
+        out = {"records_out": self.records_out}
+        for name, t in self.timers.items():
+            out[name] = t.stats()
+        return out
+
+
+class FrontEnd:
+    """HTTP frontend (reference: akka-http ``FrontEndApp`` — POST
+    ``/predict`` with ``{"instances": [...]}`` and GET ``/metrics``)."""
+
+    def __init__(self, serving: ClusterServing, input_queue,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.serving = serving
+        self.iq = input_queue
+        front = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_GET(self):
+                if self.path.rstrip("/") in ("", "/"):
+                    self._reply(200, {"status": "ok"})
+                elif self.path.startswith("/metrics"):
+                    self._reply(200, front.serving.metrics())
+                else:
+                    self._reply(404, {"error": "not found"})
+
+            def do_POST(self):
+                if not self.path.startswith("/predict"):
+                    self._reply(404, {"error": "not found"})
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n).decode()
+                try:
+                    instances = json.loads(body)["instances"]
+                except Exception:
+                    self._reply(400, {"error": "expected {\"instances\": "
+                                               "[...]}"})
+                    return
+                preds = []
+                for inst in instances:
+                    data = {k: np.asarray(v, np.float32)
+                            for k, v in inst.items()}
+                    out = front.iq.predict(data)
+                    if isinstance(out, str):
+                        preds.append(out)
+                    else:
+                        preds.append(json.dumps(
+                            {"value": json.dumps(
+                                {"data": np.asarray(out).flatten().tolist(),
+                                 "shape": list(np.asarray(out).shape)})}))
+                self._reply(200, {"predictions": preds})
+
+            def _reply(self, code, obj):
+                payload = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "FrontEnd":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()

@@ -1,0 +1,87 @@
+"""Legacy in-process TCP serving client (round-1 skeleton wire).
+
+Rebuild of ``pyzoo/zoo/serving/client.py`` (InputQueue.enqueue via redis
+XADD, OutputQueue.query via HGET). The wire here is the TCP front door of
+:class:`zoo_tpu.serving.server.ServingServer`; the API shape (enqueue /
+predict / query) matches the reference so client code ports directly.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from zoo_tpu.serving.server import _recv_msg, _send_msg
+
+
+class _Connection:
+    def __init__(self, host: str, port: int):
+        self._sock = socket.create_connection((host, port))
+        self._lock = threading.Lock()
+
+    def rpc(self, msg: Dict) -> Dict:
+        with self._lock:
+            _send_msg(self._sock, msg)
+            resp = _recv_msg(self._sock)
+        if resp is None:
+            raise ConnectionError("serving connection closed")
+        return resp
+
+    def close(self):
+        self._sock.close()
+
+
+class TCPInputQueue:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8980):
+        self._conn = _Connection(host, port)
+        self._results: Dict[str, np.ndarray] = {}
+
+    def enqueue(self, uri: str, **data) -> None:
+        """Enqueue one record (reference: ``InputQueue.enqueue(uri, t=...)``);
+        the single tensor value is the model input."""
+        if len(data) != 1:
+            raise ValueError("enqueue expects exactly one named tensor")
+        (_, value), = data.items()
+        arr = np.asarray(value)
+        resp = self._conn.rpc({"op": "predict", "uri": uri,
+                               "data": arr[None] if arr.ndim > 0 and
+                               self._needs_batch(arr) else arr})
+        if "error" in resp:
+            raise RuntimeError(resp["error"])
+        self._results[uri] = resp["result"]
+
+    @staticmethod
+    def _needs_batch(arr: np.ndarray) -> bool:
+        return True  # single-record enqueue always adds the batch dim
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        """Synchronous batch predict (reference: ``InputQueue.predict``)."""
+        resp = self._conn.rpc({"op": "predict", "uri": "_sync_",
+                               "data": np.asarray(x)})
+        if "error" in resp:
+            raise RuntimeError(resp["error"])
+        return resp["result"]
+
+    def pop_result(self, uri: str) -> Optional[np.ndarray]:
+        return self._results.pop(uri, None)
+
+    def stats(self) -> Dict:
+        return self._conn.rpc({"op": "stats"})
+
+    def close(self):
+        self._conn.close()
+
+
+class TCPOutputQueue:
+    """Result fetch API (reference: ``OutputQueue.query``). With the TCP
+    front door responses come back on the request connection, so this wraps
+    the same client-side result store."""
+
+    def __init__(self, input_queue: TCPInputQueue):
+        self._iq = input_queue
+
+    def query(self, uri: str) -> Optional[np.ndarray]:
+        return self._iq.pop_result(uri)

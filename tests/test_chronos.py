@@ -150,3 +150,125 @@ def test_dbscan_detector():
     d.fit(y)
     idx = d.anomaly_indexes()
     assert 100 in idx and 101 in idx
+
+
+def test_mtnet_forecaster(orca_ctx):
+    from zoo_tpu.chronos.forecaster import MTNetForecaster
+
+    rs = np.random.RandomState(0)
+    t = np.arange(400, dtype=np.float32)
+    series = np.sin(t * 0.2) + 0.05 * rs.randn(400)
+    fc = MTNetForecaster(target_dim=1, feature_dim=1, long_series_num=3,
+                         series_length=6, ar_window_size=4,
+                         cnn_hid_size=16, rnn_hid_size=16, lr=0.01)
+    L = fc.past_seq_len
+    x = np.stack([series[i:i + L] for i in range(300)])[..., None]
+    y = series[L:L + 300].reshape(-1, 1, 1)
+    hist = fc.fit((x, y), epochs=6, batch_size=32)
+    assert hist["loss"][-1] < hist["loss"][0]
+    preds = fc.predict((x[:16], None))
+    assert preds.shape == (16, 1, 1)
+
+
+def test_arima_forecaster():
+    from zoo_tpu.chronos.forecaster import ARIMAForecaster
+
+    rs = np.random.RandomState(0)
+    n = 300
+    y = np.zeros(n)
+    for t in range(2, n):  # AR(2) process
+        y[t] = 0.6 * y[t - 1] - 0.3 * y[t - 2] + rs.randn() * 0.5
+    fc = ARIMAForecaster(p=2, d=0, q=1)
+    res = fc.fit(y[:280])
+    assert res["mse"] < 1.0
+    pred = fc.predict(horizon=20)
+    assert pred.shape == (20,)
+    ev = fc.evaluate(y[280:], metrics=("mse", "smape"))
+    # AR(2) with 0.5-sigma noise: forecast error near noise variance
+    assert ev["mse"] < 2.0
+
+
+def test_arima_differencing_and_roundtrip(tmp_path):
+    from zoo_tpu.chronos.forecaster import ARIMAForecaster
+
+    rs = np.random.RandomState(1)
+    trend = np.cumsum(0.5 + 0.1 * rs.randn(200))  # random walk with drift
+    fc = ARIMAForecaster(p=1, d=1, q=0)
+    fc.fit(trend)
+    pred = fc.predict(10)
+    # drift ~0.5/step must be carried through the integration
+    assert 1.0 < (pred[-1] - trend[-1]) < 10.0
+    p = str(tmp_path / "arima.npz")
+    fc.save(p)
+    fc2 = ARIMAForecaster().load(p)
+    np.testing.assert_allclose(fc2.predict(10), pred)
+
+
+def test_tcmf_forecaster(tmp_path):
+    from zoo_tpu.chronos.forecaster import TCMFForecaster
+
+    rs = np.random.RandomState(0)
+    t = np.arange(240, dtype=np.float32)
+    basis = np.stack([np.sin(t * 0.1), np.cos(t * 0.07), t * 0.01])
+    F = rs.randn(20, 3).astype(np.float32)
+    Y = F @ basis + 0.01 * rs.randn(20, 240).astype(np.float32)
+    fc = TCMFForecaster(rank=6, ar_lag=10, alt_iters=8)
+    res = fc.fit({"y": Y[:, :200]})
+    assert res["mse"] < 0.01  # low-rank panel reconstructs well
+    pred = fc.predict(horizon=40)
+    assert pred.shape == (20, 40)
+    ev = fc.evaluate({"y": Y[:, 200:]})
+    assert ev["mse"] < 0.5
+    # incremental + save/load
+    fc.fit_incremental({"y": Y[:, 200:220]})
+    p = str(tmp_path / "tcmf.npz")
+    fc.save(p)
+    fc2 = TCMFForecaster.load(p)
+    assert fc2.predict(5).shape == (20, 5)
+
+
+def test_prophet_gated():
+    from zoo_tpu.chronos.forecaster import ProphetForecaster
+
+    with pytest.raises(ImportError, match="prophet"):
+        ProphetForecaster()
+
+
+def test_concurrent_search_engine(orca_ctx):
+    import threading
+
+    from zoo_tpu.automl.hp import grid_search
+    from zoo_tpu.automl.search import LocalSearchEngine, TrialStopper
+
+    import time as _time
+
+    seen_threads = set()
+
+    def trial(cfg):
+        seen_threads.add(threading.get_ident())
+        _time.sleep(0.05)  # force overlap so the pool fans out
+        return {"mse": (cfg["a"] - 3) ** 2}
+
+    eng = LocalSearchEngine(n_parallel=4)
+    eng.compile(trial, {"a": grid_search([0, 1, 2, 3, 4, 5])}, n_sampling=1,
+                metric="mse")
+    trials = eng.run()
+    assert len(trials) == 6
+    assert eng.get_best_trial().config["a"] == 3
+    assert len(seen_threads) > 1  # genuinely concurrent
+
+    # reporter-driven early stop
+    stopped_at = {}
+
+    def trial_with_reporter(cfg, reporter):
+        for step in range(100):
+            metric = 100 - step
+            if reporter(step, metric):
+                stopped_at[cfg["a"]] = step
+                break
+        return {"mse": metric}
+
+    eng2 = LocalSearchEngine(stopper=TrialStopper(max_steps=5))
+    eng2.compile(trial_with_reporter, {"a": grid_search([1, 2])}, metric="mse")
+    eng2.run()
+    assert all(v == 5 for v in stopped_at.values())

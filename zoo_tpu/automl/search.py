@@ -79,13 +79,40 @@ def _expand_configs(search_space: Dict[str, Any], n_sampling: int,
     return configs
 
 
-class LocalSearchEngine(SearchEngine):
-    """Sequential in-process trials (one TPU mesh shared by all trials)."""
+class TrialStopper:
+    """Early stop rule for a single trial (reference: ``TrialStopper`` in
+    ``ray_tune_search_engine.py`` — metric threshold and/or epoch cap)."""
 
-    def __init__(self):
+    def __init__(self, metric_threshold: Optional[float] = None,
+                 mode: str = "min", max_steps: Optional[int] = None):
+        self.metric_threshold = metric_threshold
+        self.mode = mode
+        self.max_steps = max_steps
+
+    def __call__(self, step: int, metric: float) -> bool:
+        if self.max_steps is not None and step >= self.max_steps:
+            return True
+        if self.metric_threshold is not None:
+            if self.mode == "min" and metric <= self.metric_threshold:
+                return True
+            if self.mode == "max" and metric >= self.metric_threshold:
+                return True
+        return False
+
+
+class LocalSearchEngine(SearchEngine):
+    """In-process trials over a thread pool (reference value proposition:
+    concurrent Ray Tune trials, ``ray_tune_search_engine.py:29``; XLA
+    dispatch releases the GIL so ``n_parallel`` trials genuinely overlap
+    on the host while sharing the device)."""
+
+    def __init__(self, n_parallel: int = 1,
+                 stopper: Optional[TrialStopper] = None):
         self._trials: List[Trial] = []
         self._mode = "min"
         self._metric = "mse"
+        self.n_parallel = max(1, int(n_parallel))
+        self.stopper = stopper
 
     def compile(self, trial_fn, search_space, n_sampling=1, metric="mse",
                 mode="min", seed=0):
@@ -95,15 +122,41 @@ class LocalSearchEngine(SearchEngine):
         self._configs = _expand_configs(search_space, n_sampling, rng)
         return self
 
+    def _run_one(self, i: int, cfg: Dict) -> Trial:
+        import inspect
+
+        kwargs = {}
+        sig = None
+        try:
+            sig = inspect.signature(self._trial_fn)
+        except (TypeError, ValueError):
+            pass
+        if sig is not None and "reporter" in sig.parameters:
+            stopper = self.stopper
+
+            def reporter(step: int, metric: float) -> bool:
+                """Trial calls this per epoch; True means stop early."""
+                return stopper(step, metric) if stopper is not None \
+                    else False
+
+            kwargs["reporter"] = reporter
+        result = self._trial_fn(dict(cfg), **kwargs)
+        metric = float(result[self._metric])
+        logger.info("trial %d/%d %s=%.5f cfg=%s", i + 1,
+                    len(self._configs), self._metric, metric, cfg)
+        return Trial(i, cfg, metric, artifacts=result)
+
     def run(self) -> List[Trial]:
-        self._trials = []
-        for i, cfg in enumerate(self._configs):
-            result = self._trial_fn(dict(cfg))
-            metric = float(result[self._metric])
-            self._trials.append(Trial(i, cfg, metric,
-                                      artifacts=result))
-            logger.info("trial %d/%d %s=%.5f cfg=%s", i + 1,
-                        len(self._configs), self._metric, metric, cfg)
+        if self.n_parallel == 1:
+            self._trials = [self._run_one(i, cfg)
+                            for i, cfg in enumerate(self._configs)]
+            return self._trials
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=self.n_parallel) as pool:
+            futures = [pool.submit(self._run_one, i, cfg)
+                       for i, cfg in enumerate(self._configs)]
+            self._trials = [f.result() for f in futures]
         return self._trials
 
     def get_best_trial(self) -> Trial:

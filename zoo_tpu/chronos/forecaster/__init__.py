@@ -1,7 +1,14 @@
-from zoo_tpu.chronos.forecaster.base import Forecaster
-from zoo_tpu.chronos.forecaster.lstm_forecaster import LSTMForecaster
-from zoo_tpu.chronos.forecaster.seq2seq_forecaster import Seq2SeqForecaster
-from zoo_tpu.chronos.forecaster.tcn_forecaster import TCNForecaster
+from zoo_tpu.chronos.forecaster.arima_forecaster import (  # noqa: F401
+    ARIMAForecaster,
+    ProphetForecaster,
+)
+from zoo_tpu.chronos.forecaster.base import Forecaster  # noqa: F401
+from zoo_tpu.chronos.forecaster.lstm_forecaster import LSTMForecaster  # noqa: F401,E501
+from zoo_tpu.chronos.forecaster.mtnet_forecaster import MTNetForecaster  # noqa: F401,E501
+from zoo_tpu.chronos.forecaster.seq2seq_forecaster import Seq2SeqForecaster  # noqa: F401,E501
+from zoo_tpu.chronos.forecaster.tcmf_forecaster import TCMFForecaster  # noqa: F401,E501
+from zoo_tpu.chronos.forecaster.tcn_forecaster import TCNForecaster  # noqa: F401,E501
 
 __all__ = ["Forecaster", "LSTMForecaster", "Seq2SeqForecaster",
-           "TCNForecaster"]
+           "TCNForecaster", "MTNetForecaster", "ARIMAForecaster",
+           "ProphetForecaster", "TCMFForecaster"]

@@ -1,0 +1,159 @@
+"""ARIMA forecaster — native implementation (no statsmodels in the image).
+
+Rebuild of the reference's ARIMA wrapper (``chronos/model/arima.py:1``
+wraps ``statsmodels.tsa.arima``). Estimation here is conditional sum of
+squares over the ARMA recursion on the d-differenced series, minimized
+with scipy (the same CSS objective statsmodels uses by default);
+forecasting runs the recursion forward and integrates the differences
+back. Univariate, like the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+
+class ARIMAForecaster:
+    """Order (p, d, q); API mirrors the reference's fit/predict/evaluate
+    on 1-D arrays."""
+
+    def __init__(self, p: int = 2, d: int = 0, q: int = 2,
+                 seasonality_mode: bool = False):
+        if seasonality_mode:
+            raise NotImplementedError("seasonal ARIMA not supported")
+        self.p, self.d, self.q = int(p), int(d), int(q)
+        self.params: Optional[np.ndarray] = None
+        self._train: Optional[np.ndarray] = None
+
+    # -- internals --------------------------------------------------------
+    def _css_resid(self, theta: np.ndarray, z: np.ndarray) -> np.ndarray:
+        p, q = self.p, self.q
+        c = theta[0]
+        phi = theta[1:1 + p]
+        psi = theta[1 + p:1 + p + q]
+        n = len(z)
+        resid = np.zeros(n)
+        for t in range(n):
+            ar = sum(phi[i] * z[t - 1 - i] for i in range(p) if t - 1 - i >= 0)
+            ma = sum(psi[j] * resid[t - 1 - j] for j in range(q)
+                     if t - 1 - j >= 0)
+            resid[t] = z[t] - c - ar - ma
+        return resid
+
+    def fit(self, data, validation_data=None, **kwargs) -> Dict[str, float]:
+        from scipy.optimize import minimize
+
+        y = np.asarray(data, np.float64).reshape(-1)
+        self._train = y.copy()
+        z = np.diff(y, n=self.d) if self.d else y
+
+        def objective(theta):
+            r = self._css_resid(theta, z)
+            return float(np.sum(r[self.p:] ** 2))
+
+        x0 = np.zeros(1 + self.p + self.q)
+        x0[0] = z.mean()
+        res = minimize(objective, x0, method="L-BFGS-B")
+        self.params = res.x
+        resid = self._css_resid(self.params, z)
+        self._resid = resid  # reused by predict(); the recursion is O(n·pq)
+        out = {"mse": float(np.mean(resid[self.p:] ** 2))}
+        if validation_data is not None:
+            horizon = len(np.asarray(validation_data).reshape(-1))
+            pred = self.predict(horizon)
+            va = np.asarray(validation_data, np.float64).reshape(-1)
+            out["val_mse"] = float(np.mean((pred - va) ** 2))
+        return out
+
+    def predict(self, horizon: int = 1, **kwargs) -> np.ndarray:
+        if self.params is None:
+            raise RuntimeError("call fit() first")
+        y = self._train
+        z = np.diff(y, n=self.d) if self.d else y.copy()
+        resid = self._resid if getattr(self, "_resid", None) is not None \
+            else self._css_resid(self.params, z)
+        c = self.params[0]
+        phi = self.params[1:1 + self.p]
+        psi = self.params[1 + self.p:]
+        zs = list(z)
+        rs = list(resid)
+        preds = []
+        for _ in range(horizon):
+            t = len(zs)
+            ar = sum(phi[i] * zs[t - 1 - i] for i in range(self.p)
+                     if t - 1 - i >= 0)
+            ma = sum(psi[j] * rs[t - 1 - j] for j in range(self.q)
+                     if t - 1 - j >= 0 and t - 1 - j < len(resid))
+            nxt = c + ar + ma
+            preds.append(nxt)
+            zs.append(nxt)
+            rs.append(0.0)
+        preds = np.asarray(preds)
+        # integrate the d differences back: walking UP one level per pass,
+        # each pass cumsums and anchors on the last value of THAT level
+        levels = [y]
+        for _ in range(self.d):
+            levels.append(np.diff(levels[-1]))
+        for k in range(self.d, 0, -1):
+            preds = np.cumsum(preds) + levels[k - 1][-1]
+        return preds
+
+    def evaluate(self, target, metrics=("mse",), **kwargs
+                 ) -> Dict[str, float]:
+        from zoo_tpu.chronos.forecaster.base import _EVAL_FNS
+
+        target = np.asarray(target, np.float64).reshape(-1)
+        pred = self.predict(len(target))
+        out = {}
+        for m in metrics:
+            key = m.lower()
+            if key not in _EVAL_FNS:
+                raise ValueError(f"unknown metric {m}")
+            out[key] = _EVAL_FNS[key](target, pred)
+        return out
+
+    def save(self, checkpoint_file: str):
+        np.savez(checkpoint_file, params=self.params, train=self._train,
+                 order=np.asarray([self.p, self.d, self.q]))
+
+    def load(self, checkpoint_file: str):
+        blob = np.load(checkpoint_file if checkpoint_file.endswith(".npz")
+                       else checkpoint_file + ".npz")
+        self.p, self.d, self.q = (int(v) for v in blob["order"])
+        self.params = blob["params"]
+        self._train = blob["train"]
+        return self
+
+
+class ProphetForecaster:
+    """Gated wrapper over facebook prophet (reference:
+    ``chronos/model/prophet.py``); the library is not in this image, so
+    construction raises with instructions — the API shape is preserved for
+    environments that have it."""
+
+    def __init__(self, changepoint_prior_scale: float = 0.05,
+                 seasonality_prior_scale: float = 10.0,
+                 holidays_prior_scale: float = 10.0,
+                 seasonality_mode: str = "additive",
+                 changepoint_range: float = 0.8):
+        try:
+            from prophet import Prophet
+        except ImportError as e:
+            raise ImportError(
+                "ProphetForecaster needs the 'prophet' package, which is "
+                "not installed in this environment") from e
+        self.model = Prophet(
+            changepoint_prior_scale=changepoint_prior_scale,
+            seasonality_prior_scale=seasonality_prior_scale,
+            holidays_prior_scale=holidays_prior_scale,
+            seasonality_mode=seasonality_mode,
+            changepoint_range=changepoint_range)
+
+    def fit(self, data, **kwargs):
+        return self.model.fit(data)
+
+    def predict(self, horizon: int = 1, freq: str = "D", **kwargs):
+        future = self.model.make_future_dataframe(periods=horizon, freq=freq)
+        return self.model.predict(future)

@@ -1,0 +1,125 @@
+"""MTNet forecaster — memory-network time-series model.
+
+Rebuild of the reference's MTNet (``chronos/model/MTNet_keras.py:1``,
+631 LoC; paper Chang et al. 2018): the lookback window splits into ``n``
+long-term memory blocks plus one short-term query block; a SHARED
+CNN+GRU encoder embeds every block, attention over the memory embeddings
+conditioned on the query picks a context, and a linear head over
+[context; query] plus an autoregressive skip term produces the forecast.
+Built on the functional Model API with shared layer instances (one set of
+encoder weights, exactly like the reference's reused keras layers).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from zoo_tpu.chronos.forecaster.base import Forecaster
+
+
+class MTNetForecaster(Forecaster):
+    def __init__(self, target_dim: int = 1, feature_dim: int = 1,
+                 long_series_num: int = 1, series_length: int = 1,
+                 ar_window_size: int = 1, cnn_height: int = 1,
+                 cnn_hid_size: int = 32, rnn_hid_size: int = 32,
+                 lr: float = 0.001, loss: str = "mse"):
+        past = (long_series_num + 1) * series_length
+        super().__init__(past_seq_len=past, input_feature_num=feature_dim,
+                         output_feature_num=target_dim, future_seq_len=1)
+        self.n = int(long_series_num)
+        self.T = int(series_length)
+        self.ar_window = int(ar_window_size)
+        self.cnn_height = int(cnn_height)
+        self.cnn_hid = int(cnn_hid_size)
+        self.rnn_hid = int(rnn_hid_size)
+        self.lr = lr
+        self.loss = loss
+        # REPLACE (not update): the base keys (past_seq_len etc.) are not
+        # MTNet ctor kwargs, and TSPipeline.load reconstructs via
+        # cls(**ctor_args)
+        self._ctor_args = dict(
+            target_dim=target_dim, feature_dim=feature_dim,
+            long_series_num=long_series_num, series_length=series_length,
+            ar_window_size=ar_window_size, cnn_height=cnn_height,
+            cnn_hid_size=cnn_hid_size, rnn_hid_size=rnn_hid_size,
+            lr=lr, loss=loss)
+
+    def _build(self):
+        import jax.numpy as jnp
+
+        from zoo_tpu.pipeline.api.keras import optimizers as zopt
+        from zoo_tpu.pipeline.api.keras.engine.topology import Input, Model
+        from zoo_tpu.pipeline.api.keras.layers import (
+            GRU,
+            Convolution1D,
+            Dense,
+            Lambda,
+            Merge,
+        )
+
+        n, T, D = self.n, self.T, self.input_feature_num
+        out_dim = self.output_feature_num
+
+        inp = Input(shape=(self.past_seq_len, D))
+        # SHARED encoder: Conv1D over time then GRU final state
+        conv = Convolution1D(self.cnn_hid, min(self.cnn_height, T),
+                             border_mode="same", activation="relu")
+        gru = GRU(self.rnn_hid, return_sequences=False)
+
+        def block(i):
+            sl = Lambda(lambda v, i=i: v[:, i * T:(i + 1) * T],
+                        output_shape=(T, D))(inp)
+            return gru(conv(sl))
+
+        mem = [block(i) for i in range(n)]       # n × (B, H)
+        query = block(n)                          # (B, H) — last block
+
+        class _Attend(Merge):
+            """softmax over memory-block scores; returns the context."""
+
+            def __init__(self, **kw):
+                super().__init__(mode="dot", **kw)
+
+            def call(self, params, inputs, *, training=False, rng=None):
+                *ms, u = inputs
+                m = jnp.stack(ms, axis=1)            # (B, n, H)
+                score = jnp.einsum("bnh,bh->bn", m, u)
+                p = jnp.asarray(jnp.exp(score - score.max(-1, keepdims=True)))
+                p = p / p.sum(-1, keepdims=True)
+                return jnp.einsum("bn,bnh->bh", p, m)
+
+            def compute_output_shape(self, input_shape):
+                return tuple(input_shape[-1])
+
+        context = _Attend()(mem + [query])
+        joined = Merge(mode="concat")([context, query])
+        nonlinear = Dense(out_dim)(joined)
+
+        # autoregressive skip: linear over the last ar_window raw steps
+        ar_in = Lambda(
+            lambda v: v[:, -self.ar_window:, :out_dim].reshape(
+                (v.shape[0], self.ar_window * out_dim)),
+            output_shape=(self.ar_window * out_dim,))(inp)
+        linear = Dense(out_dim, bias=False)(ar_in)
+        out = Merge(mode="sum")([nonlinear, linear])
+
+        m = Model(input=inp, output=out, name="mtnet")
+        m.compile(optimizer=zopt.Adam(lr=self.lr), loss=self.loss)
+        self.model = m
+
+    @staticmethod
+    def from_tsdataset(tsdataset, past_seq_len: int = 24,
+                       future_seq_len: int = 1, **kwargs):
+        if future_seq_len != 1:
+            raise ValueError("MTNet forecasts one step (reference "
+                             "constraint)")
+        d = len(tsdataset.target_cols) + len(tsdataset.feature_cols)
+        T = max(1, past_seq_len // 2)
+        fc = MTNetForecaster(target_dim=len(tsdataset.target_cols),
+                             feature_dim=d,
+                             long_series_num=past_seq_len // T - 1,
+                             series_length=T, **kwargs)
+        tsdataset.roll(fc.past_seq_len, 1)
+        return fc

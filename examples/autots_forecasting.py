@@ -1,0 +1,61 @@
+"""AutoTS time-series forecasting (reference: ``apps/automl`` AutoTS
+notebooks): TSDataset roll → AutoTSEstimator hyperparameter search over
+LSTM/TCN configs → TSPipeline predict/evaluate.
+
+Run: python examples/autots_forecasting.py [--trials 4]
+"""
+
+import argparse
+
+import numpy as np
+import pandas as pd
+
+
+def make_series(n=600, seed=0):
+    rs = np.random.RandomState(seed)
+    t = np.arange(n)
+    value = (np.sin(t * 2 * np.pi / 24) + 0.3 * np.sin(t * 2 * np.pi / 168)
+             + 0.1 * rs.randn(n))
+    return pd.DataFrame({
+        "datetime": pd.date_range("2024-01-01", periods=n, freq="h"),
+        "value": value.astype(np.float32)})
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trials", type=int, default=4)
+    ap.add_argument("--epochs", type=int, default=3)
+    args = ap.parse_args()
+
+    from zoo_tpu.orca import init_orca_context, stop_orca_context
+    from zoo_tpu.automl import hp
+    from zoo_tpu.chronos.autots import AutoTSEstimator
+    from zoo_tpu.chronos.data import TSDataset
+
+    init_orca_context(cluster_mode="local")
+    df = make_series()
+    cut = int(len(df) * 0.8)
+    train = TSDataset.from_pandas(df.iloc[:cut], dt_col="datetime",
+                                  target_col="value")
+    val = TSDataset.from_pandas(df.iloc[cut:].reset_index(drop=True),
+                                dt_col="datetime", target_col="value")
+
+    est = AutoTSEstimator(
+        model="lstm",
+        search_space={"hidden_dim": hp.choice([16, 32]),
+                      "lr": hp.loguniform(1e-3, 1e-2)},
+        past_seq_len=24, future_seq_len=1)
+    pipeline = est.fit(train, validation_data=val, epochs=args.epochs,
+                       n_sampling=args.trials)
+    res = pipeline.evaluate(val, metrics=["mse", "smape"])
+    print("best config:", pipeline.best_config)
+    print("val:", {k: round(float(v), 4) for k, v in res.items()})
+    preds = pipeline.predict(val)
+    print("forecast shape:", preds.shape)
+    stop_orca_context()
+    assert res["mse"] < 0.5
+    print("AutoTS example OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,108 @@
+"""ParquetDataset + runnable examples as integration tests."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+
+def test_parquet_write_read_arrays(tmp_path):
+    from zoo_tpu.orca.data.parquet_dataset import ParquetDataset
+
+    rs = np.random.RandomState(0)
+    imgs = rs.rand(37, 8, 8, 3).astype(np.float32)
+    labels = rs.randint(0, 3, 37)
+
+    def gen():
+        for i in range(37):
+            yield {"image": imgs[i], "label": int(labels[i]),
+                   "name": f"img{i}"}
+
+    out = str(tmp_path / "ds")
+    ParquetDataset.write(out, gen(),
+                         {"image": "ndarray", "label": "scalar",
+                          "name": "scalar"}, block_size=10)
+    assert len([f for f in os.listdir(out)
+                if f.endswith(".parquet")]) == 4  # 10+10+10+7
+    data = ParquetDataset.read_as_arrays(out)
+    np.testing.assert_allclose(data["image"], imgs, atol=1e-6)
+    np.testing.assert_array_equal(data["label"], labels)
+    assert data["name"][0] == "img0"
+
+
+def test_parquet_read_batched_and_xshards(tmp_path):
+    from zoo_tpu.orca.data.parquet_dataset import (
+        ParquetDataset,
+        write_ndarrays,
+    )
+
+    rs = np.random.RandomState(1)
+    imgs = rs.rand(25, 4, 4).astype(np.float32)
+    labels = rs.randint(0, 2, 25)
+    out = str(tmp_path / "nd")
+    write_ndarrays(imgs, labels, out, block_size=8)
+
+    batches = list(ParquetDataset.read_batched(out, batch_size=10))
+    assert [b["image"].shape[0] for b in batches] == [10, 10, 5]
+    np.testing.assert_allclose(np.concatenate([b["image"] for b in batches]),
+                               imgs, atol=1e-6)
+
+    shards = ParquetDataset.read_as_xshards(out, num_shards=5)
+    assert shards.num_partitions() == 5
+
+
+def test_parquet_image_folder(tmp_path):
+    from zoo_tpu.orca.data.parquet_dataset import (
+        ParquetDataset,
+        write_from_directory,
+    )
+
+    for cls in ("cat", "dog"):
+        d = tmp_path / "imgs" / cls
+        d.mkdir(parents=True)
+        for i in range(3):
+            (d / f"{i}.jpg").write_bytes(b"\xff\xd8FAKEJPEG" + bytes([i]))
+    out = str(tmp_path / "pq")
+    write_from_directory(str(tmp_path / "imgs"),
+                         {"cat": 0, "dog": 1}, out, shuffle=False)
+    data = ParquetDataset.read_as_arrays(out)
+    assert sorted(data["label"].tolist()) == [0, 0, 0, 1, 1, 1]
+    assert data["image"][0].startswith(b"\xff\xd8")
+
+
+def test_pandas_read_parquet(tmp_path, orca_ctx):
+    import pandas as pd
+
+    from zoo_tpu.orca.data.pandas import read_parquet
+
+    df = pd.DataFrame({"a": np.arange(20), "b": np.arange(20) * 2.0})
+    p = str(tmp_path / "t.parquet")
+    df.to_parquet(p)
+    shards = read_parquet(p, num_shards=2)
+    got = pd.concat(shards.collect()).sort_values("a").reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, df)
+
+
+_EXAMPLES = ["ncf_movielens.py", "dogs_vs_cats_resnet.py",
+             "autots_forecasting.py", "cluster_serving_roundtrip.py"]
+
+
+@pytest.mark.parametrize("script", _EXAMPLES)
+def test_example_runs(script):
+    """Each examples/ script must run end-to-end on the CPU mesh (the
+    reference's run-example-tests*.sh role)."""
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.getcwd() + os.pathsep + env.get("PYTHONPATH", "")
+    args = [sys.executable, os.path.join("examples", script)]
+    if script == "ncf_movielens.py":
+        args += ["--epochs", "2"]
+    if script == "autots_forecasting.py":
+        args += ["--trials", "2", "--epochs", "2"]
+    proc = subprocess.run(args, capture_output=True, text=True, timeout=900,
+                          env=env)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    assert "OK" in proc.stdout

@@ -102,17 +102,10 @@ class ARIMAForecaster:
 
     def evaluate(self, target, metrics=("mse",), **kwargs
                  ) -> Dict[str, float]:
-        from zoo_tpu.chronos.forecaster.base import _EVAL_FNS
+        from zoo_tpu.chronos.forecaster.base import compute_metrics
 
         target = np.asarray(target, np.float64).reshape(-1)
-        pred = self.predict(len(target))
-        out = {}
-        for m in metrics:
-            key = m.lower()
-            if key not in _EVAL_FNS:
-                raise ValueError(f"unknown metric {m}")
-            out[key] = _EVAL_FNS[key](target, pred)
-        return out
+        return compute_metrics(target, self.predict(len(target)), metrics)
 
     def save(self, checkpoint_file: str):
         np.savez(checkpoint_file, params=self.params, train=self._train,
@@ -124,6 +117,7 @@ class ARIMAForecaster:
         self.p, self.d, self.q = (int(v) for v in blob["order"])
         self.params = blob["params"]
         self._train = blob["train"]
+        self._resid = None  # stale cache from a prior fit must not leak
         return self
 
 

@@ -33,6 +33,17 @@ _EVAL_FNS = {
 }
 
 
+def compute_metrics(y_true, y_pred, metrics) -> Dict[str, float]:
+    """Shared metric dispatch for every forecaster flavor."""
+    out = {}
+    for m in metrics:
+        key = m.lower()
+        if key not in _EVAL_FNS:
+            raise ValueError(f"unknown metric: {m}")
+        out[key] = _EVAL_FNS[key](np.asarray(y_true), np.asarray(y_pred))
+    return out
+
+
 class Forecaster:
     """Subclasses set ``self.model`` (a compiled KerasNet) in ``_build``."""
 
@@ -99,13 +110,7 @@ class Forecaster:
         x, y = self._unpack(data)
         preds = self.predict((x, None), batch_size=batch_size)
         y = y.reshape(preds.shape)
-        out = {}
-        for m in metrics:
-            key = m.lower()
-            if key not in _EVAL_FNS:
-                raise ValueError(f"unknown metric: {m}")
-            out[key] = _EVAL_FNS[key](y, preds)
-        return out
+        return compute_metrics(y, preds, metrics)
 
     def save(self, checkpoint_file: str):
         self.model.save_weights(checkpoint_file)

@@ -115,11 +115,16 @@ class MTNetForecaster(Forecaster):
         if future_seq_len != 1:
             raise ValueError("MTNet forecasts one step (reference "
                              "constraint)")
+        if past_seq_len < 4 or past_seq_len % 2:
+            raise ValueError(
+                f"MTNet needs an even past_seq_len >= 4 (got "
+                f"{past_seq_len}): the window splits into memory blocks "
+                "plus a query block of equal length")
         d = len(tsdataset.target_cols) + len(tsdataset.feature_cols)
-        T = max(1, past_seq_len // 2)
+        T = past_seq_len // 2
         fc = MTNetForecaster(target_dim=len(tsdataset.target_cols),
                              feature_dim=d,
-                             long_series_num=past_seq_len // T - 1,
+                             long_series_num=1,
                              series_length=T, **kwargs)
         tsdataset.roll(fc.past_seq_len, 1)
         return fc

@@ -121,18 +121,11 @@ class TCMFForecaster:
 
     def evaluate(self, target_value, metrics=("mse",), **kwargs
                  ) -> Dict[str, float]:
-        from zoo_tpu.chronos.forecaster.base import _EVAL_FNS
+        from zoo_tpu.chronos.forecaster.base import compute_metrics
 
         Yt = np.asarray(target_value["y"] if isinstance(target_value, dict)
                         else target_value, np.float32)
-        pred = self.predict(Yt.shape[1])
-        out = {}
-        for mname in metrics:
-            key = mname.lower()
-            if key not in _EVAL_FNS:
-                raise ValueError(f"unknown metric {mname}")
-            out[key] = _EVAL_FNS[key](Yt, pred)
-        return out
+        return compute_metrics(Yt, self.predict(Yt.shape[1]), metrics)
 
     def save(self, path: str):
         extras = {}
@@ -140,13 +133,18 @@ class TCMFForecaster:
             extras = {"mean": self._mean, "std": self._std}
         np.savez(path, F=self.F, X=self.X, ar=self.ar,
                  lag=np.asarray(self.ar_lag),
-                 normalize=np.asarray(self.normalize), **extras)
+                 normalize=np.asarray(self.normalize),
+                 reg=np.asarray(self.reg),
+                 alt_iters=np.asarray(self.alt_iters),
+                 svd=np.asarray(self.svd), **extras)
 
     @classmethod
     def load(cls, path: str) -> "TCMFForecaster":
         blob = np.load(path if path.endswith(".npz") else path + ".npz")
         out = cls(rank=blob["F"].shape[1], ar_lag=int(blob["lag"]),
-                  normalize=bool(blob["normalize"]))
+                  normalize=bool(blob["normalize"]),
+                  reg=float(blob["reg"]), alt_iters=int(blob["alt_iters"]),
+                  svd=bool(blob["svd"]))
         out.F, out.X, out.ar = blob["F"], blob["X"], blob["ar"]
         if out.normalize:
             out._mean, out._std = blob["mean"], blob["std"]

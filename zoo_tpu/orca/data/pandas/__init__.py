@@ -1,3 +1,7 @@
-from zoo_tpu.orca.data.pandas.preprocessing import read_csv, read_json
+from zoo_tpu.orca.data.pandas.preprocessing import (  # noqa: F401
+    read_csv,
+    read_json,
+    read_parquet,
+)
 
-__all__ = ["read_csv", "read_json"]
+__all__ = ["read_csv", "read_json", "read_parquet"]

@@ -75,3 +75,18 @@ def read_json(file_path: str, num_shards: Optional[int] = None, **kwargs
     ``preprocessing.py`` ``read_json``)."""
     return _read_files(list_files(file_path), _read_one_json, num_shards,
                        **kwargs)
+
+
+def _read_one_parquet(path, **kwargs):
+    import pandas as pd
+    return pd.read_parquet(path, **kwargs)
+
+
+def read_parquet(file_path: str, num_shards: Optional[int] = None,
+                 **kwargs) -> LocalXShards:
+    """Read parquet file(s)/folder into an XShards of pandas DataFrames
+    (reference: ``TextSet.read_parquet`` / spark ``read.parquet``)."""
+    files = [f for f in list_files(file_path) if f.endswith(".parquet")]
+    if not files:  # a single file given directly, whatever its suffix
+        files = [file_path]
+    return _read_files(files, _read_one_parquet, num_shards, **kwargs)

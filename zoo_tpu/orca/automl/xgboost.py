@@ -1,0 +1,161 @@
+"""XGBoost + AutoXGBoost (reference: ``orca/automl/xgboost/XGBoost.py:1``,
+``auto_xgb.py``).
+
+The reference sparkles ``xgboost`` regressors/classifiers and searches
+their hyperparameters through AutoEstimator. The ``xgboost`` package is
+not in this image, so the wrapper trains through it when importable and
+otherwise falls back to sklearn's histogram gradient boosting (the same
+algorithm family with the same core knobs: n_estimators→max_iter,
+max_depth, learning_rate, reg_lambda) — callers keep one API either way.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+
+def _backend():
+    try:
+        import xgboost
+        return "xgboost"
+    except ImportError:
+        return "sklearn"
+
+
+class _XGBBase:
+    _objective = "reg"
+
+    def __init__(self, config: Optional[Dict] = None, **params):
+        cfg = dict(config or {})
+        cfg.update(params)
+        self.n_estimators = int(cfg.pop("n_estimators", 100))
+        self.max_depth = cfg.pop("max_depth", None)
+        self.learning_rate = float(cfg.pop("learning_rate",
+                                           cfg.pop("lr", 0.1)))
+        self.reg_lambda = float(cfg.pop("lambda",
+                                        cfg.pop("reg_lambda", 1.0)))
+        self.extra = cfg
+        self.backend = _backend()
+        self.model = None
+
+    def _build(self):
+        if self.backend == "xgboost":
+            import xgboost as xgb
+            cls = (xgb.XGBRegressor if self._objective == "reg"
+                   else xgb.XGBClassifier)
+            return cls(n_estimators=self.n_estimators,
+                       max_depth=self.max_depth,
+                       learning_rate=self.learning_rate,
+                       reg_lambda=self.reg_lambda, **self.extra)
+        from sklearn.ensemble import (
+            HistGradientBoostingClassifier,
+            HistGradientBoostingRegressor,
+        )
+        cls = (HistGradientBoostingRegressor if self._objective == "reg"
+               else HistGradientBoostingClassifier)
+        return cls(max_iter=self.n_estimators, max_depth=self.max_depth,
+                   learning_rate=self.learning_rate,
+                   l2_regularization=self.reg_lambda)
+
+    def fit(self, x, y, validation_data=None) -> "_XGBBase":
+        if self.backend != "xgboost" and self.extra:
+            import warnings
+            warnings.warn(
+                f"xgboost not installed; sklearn fallback ignores extra "
+                f"hyperparameters {sorted(self.extra)}")
+        self.model = self._build()
+        self.model.fit(np.asarray(x), np.asarray(y))
+        return self
+
+    def predict(self, x) -> np.ndarray:
+        if self.model is None:
+            raise RuntimeError("call fit() first")
+        return np.asarray(self.model.predict(np.asarray(x)))
+
+    def evaluate(self, x, y, metrics=("mse",)) -> Dict[str, float]:
+        pred = self.predict(x)
+        y = np.asarray(y)
+        out = {}
+        for m in metrics:
+            key = m.lower()
+            if key == "mse":
+                out[key] = float(np.mean((pred - y) ** 2))
+            elif key == "mae":
+                out[key] = float(np.mean(np.abs(pred - y)))
+            elif key in ("accuracy", "acc"):
+                out[key] = float(np.mean(pred == y))
+            elif key == "logloss":
+                proba = np.clip(self.model.predict_proba(
+                    np.asarray(x)), 1e-7, 1 - 1e-7)
+                out[key] = float(-np.mean(
+                    np.log(proba[np.arange(len(y)), y.astype(int)])))
+            else:
+                raise ValueError(f"unknown metric {m}")
+        return out
+
+
+class XGBoostRegressor(_XGBBase):
+    _objective = "reg"
+
+
+class XGBoostClassifier(_XGBBase):
+    _objective = "clf"
+
+
+class AutoXGBoost:
+    """Hyperparameter search over the boosted-tree knobs via the shared
+    search engine (reference: ``auto_xgb.AutoXGBRegressor/Classifier``
+    through AutoEstimator)."""
+
+    def __init__(self, task: str = "regression",
+                 metric: Optional[str] = None,
+                 n_parallel: int = 1):
+        self.task = task
+        self.metric = metric or ("mse" if task == "regression"
+                                 else "accuracy")
+        self.mode = "min" if self.metric in ("mse", "mae", "logloss") \
+            else "max"
+        self.n_parallel = n_parallel
+        self.best_model = None
+        self.best_config: Optional[Dict] = None
+
+    def fit(self, data, validation_data=None, search_space: Optional[Dict]
+            = None, n_sampling: int = 4, seed: int = 0):
+        from zoo_tpu.automl.search import LocalSearchEngine
+
+        x, y = data
+        vx, vy = validation_data if validation_data is not None else (x, y)
+        cls = (XGBoostRegressor if self.task == "regression"
+               else XGBoostClassifier)
+
+        def trial(cfg: Dict) -> Dict:
+            model = cls(config=cfg)
+            model.fit(x, y)
+            res = model.evaluate(vx, vy, metrics=(self.metric,))
+            res["_model"] = model
+            return res
+
+        from zoo_tpu.automl import hp
+        space = search_space or {
+            "n_estimators": hp.choice([50, 100, 200]),
+            "max_depth": hp.choice([3, 5, 7]),
+            "learning_rate": hp.loguniform(0.01, 0.3),
+        }
+        eng = LocalSearchEngine(n_parallel=self.n_parallel)
+        eng.compile(trial, space, n_sampling=n_sampling,
+                    metric=self.metric, mode=self.mode, seed=seed)
+        eng.run()
+        best = eng.get_best_trial()
+        self.best_config = dict(best.config)
+        self.best_model = best.artifacts["_model"]
+        return self
+
+    def predict(self, x) -> np.ndarray:
+        if self.best_model is None:
+            raise RuntimeError("call fit() first")
+        return self.best_model.predict(x)
+
+    def get_best_model(self):
+        return self.best_model

@@ -34,15 +34,22 @@ class ParquetDataset:
         import pyarrow as pa
         import pyarrow.parquet as pq
 
+        if write_mode not in ("overwrite", "error", "append"):
+            raise ValueError(f"write_mode must be overwrite|error|append, "
+                             f"got {write_mode!r}")
+        start_idx = 0
         if os.path.isdir(path):
             if write_mode == "error":
                 raise FileExistsError(path)
             if write_mode == "overwrite":
                 import shutil
                 shutil.rmtree(path)
+            else:  # append continues the part numbering
+                parts = [f for f in os.listdir(path)
+                         if f.endswith(".parquet")]
+                start_idx = len(parts)
         os.makedirs(path, exist_ok=True)
-        with open(os.path.join(path, _META), "w") as f:
-            json.dump({"schema": schema}, f)
+        dtypes: Dict[str, str] = {}
 
         def flush(rows: List[Dict], idx: int):
             if not rows:
@@ -51,9 +58,12 @@ class ParquetDataset:
             for name, kind in schema.items():
                 vals = [r[name] for r in rows]
                 if kind == "ndarray":
+                    dt = np.asarray(vals[0]).dtype
+                    dtypes.setdefault(name, dt.name)
                     cols[name] = pa.array(
-                        [np.asarray(v).flatten().tolist() for v in vals],
-                        pa.list_(pa.float32()))
+                        [np.asarray(v, dt).flatten().tolist()
+                         for v in vals],
+                        pa.list_(pa.from_numpy_dtype(dt)))
                     cols[name + "_shape"] = pa.array(
                         [list(np.asarray(v).shape) for v in vals],
                         pa.list_(pa.int32()))
@@ -68,26 +78,35 @@ class ParquetDataset:
                            os.path.join(path, f"part-{idx:05d}.parquet"))
 
         rows: List[Dict] = []
-        idx = 0
+        idx = start_idx
         for rec in generator:
             rows.append(rec)
             if len(rows) >= block_size:
                 flush(rows, idx)
                 rows, idx = [], idx + 1
         flush(rows, idx)
+        with open(os.path.join(path, _META), "w") as f:
+            json.dump({"schema": schema, "dtypes": dtypes}, f)
 
     # -- read -------------------------------------------------------------
     @staticmethod
-    def _schema(path: str) -> Dict[str, str]:
+    def _meta(path: str) -> Dict:
         with open(os.path.join(path, _META)) as f:
-            return json.load(f)["schema"]
+            return json.load(f)
+
+    @staticmethod
+    def _schema(path: str) -> Dict[str, str]:
+        return ParquetDataset._meta(path)["schema"]
 
     @staticmethod
     def read_as_arrays(path: str) -> Dict[str, np.ndarray]:
-        """Whole dataset as {column: array} (ndarray columns reshaped)."""
+        """Whole dataset as {column: array} (ndarray columns reshaped,
+        dtypes restored from the metadata sidecar)."""
         import pyarrow.parquet as pq
 
-        schema = ParquetDataset._schema(path)
+        meta = ParquetDataset._meta(path)
+        schema = meta["schema"]
+        dtypes = meta.get("dtypes", {})
         parts = sorted(f for f in os.listdir(path)
                        if f.endswith(".parquet"))
         out: Dict[str, List] = {k: [] for k in schema}
@@ -96,10 +115,11 @@ class ParquetDataset:
             cols = {c: table[c].to_pylist() for c in table.column_names}
             for name, kind in schema.items():
                 if kind == "ndarray":
+                    dt = np.dtype(dtypes.get(name, "float32"))
                     for flat, shape in zip(cols[name],
                                            cols[name + "_shape"]):
                         out[name].append(
-                            np.asarray(flat, np.float32).reshape(shape))
+                            np.asarray(flat, dt).reshape(shape))
                 else:
                     out[name].extend(cols[name])
         return {k: (np.stack(v) if schema[k] == "ndarray"
@@ -125,7 +145,9 @@ class ParquetDataset:
         pipeline form; wrap with DoubleBufferedIterator to stage ahead)."""
         import pyarrow.parquet as pq
 
-        schema = ParquetDataset._schema(path)
+        meta = ParquetDataset._meta(path)
+        schema = meta["schema"]
+        dtypes = meta.get("dtypes", {})
         want = columns or list(schema)
         parts = sorted(f for f in os.listdir(path)
                        if f.endswith(".parquet"))
@@ -138,7 +160,8 @@ class ParquetDataset:
                 for name in want:
                     if schema[name] == "ndarray":
                         buf[name].append(np.asarray(
-                            cols[name][i], np.float32).reshape(
+                            cols[name][i],
+                            np.dtype(dtypes.get(name, "float32"))).reshape(
                             cols[name + "_shape"][i]))
                     else:
                         buf[name].append(cols[name][i])

@@ -55,10 +55,19 @@ class XShards:
         ``shard.py:42``). All leaves must share the same length."""
         leaves = []
 
+        def _is_frame(d):
+            try:
+                import pandas as pd
+            except ImportError:
+                return False
+            return isinstance(d, (pd.DataFrame, pd.Series))
+
         def _len(d):
             if isinstance(d, np.ndarray):
                 leaves.append(d)
                 return d.shape[0]
+            if _is_frame(d):
+                return len(d)
             if isinstance(d, dict):
                 sizes = {k: _len(v) for k, v in d.items()}
                 return next(iter(sizes.values()))
@@ -75,6 +84,8 @@ class XShards:
         def _slice(d, lo, hi):
             if isinstance(d, np.ndarray):
                 return d[lo:hi]
+            if _is_frame(d):
+                return d.iloc[lo:hi].reset_index(drop=True)
             if isinstance(d, dict):
                 return {k: _slice(v, lo, hi) for k, v in d.items()}
             if isinstance(d, tuple):

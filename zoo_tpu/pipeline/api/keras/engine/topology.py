@@ -523,26 +523,55 @@ class KerasNet:
         return np.asarray(jnp.concatenate(outs, axis=0))
 
     def _evaluate_arrays(self, xs, ys, batch_size) -> Dict[str, float]:
-        """Exact (non-approximated) evaluation: predictions are computed in
-        sharded batches, loss/metrics reduced once over the full set."""
-        preds = self._predict_arrays(xs, batch_size)
-        out = {}
-        if isinstance(preds, tuple):
+        """Exact STREAMING evaluation: per-batch loss/metric partials
+        accumulate on device — O(1) host memory and one device→host sync
+        regardless of dataset size (the reference streams its
+        ValidationMethod aggregation per batch the same way; round-1
+        materialized the full prediction array on host)."""
+        if len(getattr(self, "outputs", [None])) > 1:
             # multi-output: combined loss over the heads; per-head metrics
             # are not aggregated (pass per-head eval sets instead)
+            preds = self._predict_arrays(xs, batch_size)
             yt = [jnp.asarray(a) for a in ys] \
                 if isinstance(ys, (list, tuple)) else jnp.asarray(ys)
+            if self.loss_fn is None:
+                return {}
+            return {"loss": float(self.loss_fn(
+                yt, tuple(jnp.asarray(p) for p in preds)))}
+        if self._jit_pred is None:
+            self._jit_pred = self._build_pred_step()
+        params = self._place(self.params)
+        ys = np.asarray(ys) if not hasattr(ys, "devices") else ys
+        n = data_utils.num_samples(xs)
+        mult = self._shard_multiple()
+        bs = max(mult, (min(batch_size, n) // mult) * mult)
+        loss_sum = None
+        totals = {m.name: None for m in self.metrics}
+        seen = 0
+        for idx in data_utils.batch_slices(n, bs, False,
+                                           drop_remainder=False):
+            chunk = [a[idx] for a in xs]
+            yb = ys[idx]
+            padded, real = data_utils.pad_batch(chunk, bs)
+            preds = self._jit_pred(params, *self._put_batch(padded))
+            preds = preds[:real]  # lazy device slice, no sync
+            yt = jnp.asarray(yb)
             if self.loss_fn is not None:
-                out["loss"] = float(self.loss_fn(
-                    yt, tuple(jnp.asarray(p) for p in preds)))
-            return out
-        preds = jnp.asarray(preds)
-        yt = jnp.asarray(ys)
-        if self.loss_fn is not None:
-            out["loss"] = float(self.loss_fn(yt, preds))
+                contrib = self.loss_fn(yt, preds) * real
+                loss_sum = contrib if loss_sum is None \
+                    else loss_sum + contrib
+            for m in self.metrics:
+                s, c = m.batch_eval(yt, preds)
+                prev = totals[m.name]
+                totals[m.name] = (s, c) if prev is None \
+                    else (prev[0] + s, prev[1] + c)
+            seen += real
+        out = {}
+        if loss_sum is not None:
+            out["loss"] = float(np.asarray(loss_sum)) / max(seen, 1)
         for m in self.metrics:
-            s, c = m.batch_eval(yt, preds)
-            out[m.name] = float(m.finalize(s, c))
+            s, c = totals[m.name]
+            out[m.name] = float(np.asarray(m.finalize(s, c)))
         return out
 
     def evaluate(self, x, y=None, batch_size: int = 32,

@@ -1,0 +1,8 @@
+from zoo_tpu.pipeline.nnframes.nn_classifier import (  # noqa: F401
+    NNClassifier,
+    NNClassifierModel,
+    NNEstimator,
+    NNModel,
+)
+
+__all__ = ["NNEstimator", "NNModel", "NNClassifier", "NNClassifierModel"]

@@ -1,0 +1,191 @@
+"""NNFrames: Spark-ML-pipeline-style Estimator/Transformer wrappers.
+
+Rebuild of the reference's NNFrames API
+(``pyzoo/zoo/pipeline/nnframes/nn_classifier.py:139`` ``NNEstimator`` /
+``NNModel`` / ``NNClassifier`` / ``NNClassifierModel``; Scala
+``pipeline/nnframes/``): ``NNEstimator(model, criterion).setBatchSize(n)
+.setMaxEpoch(e).fit(df)`` returns an ``NNModel`` transformer whose
+``transform(df)`` appends a ``prediction`` column. The reference rides
+Spark DataFrames; here the same estimator/transformer contract runs over
+pandas DataFrames (and XShards of them) feeding the jitted sharded step —
+the SURVEY §7.1 translation-table north star (``cluster_mode`` decides the
+mesh, not the API).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+
+def _to_matrix(df, cols: Sequence[str]) -> np.ndarray:
+    """Feature columns → (n, d) float matrix; array-valued cells (the
+    Spark Vector role) flatten in order."""
+    parts = []
+    for c in cols:
+        v = df[c].to_numpy()
+        if v.dtype == object:  # column of arrays/lists
+            v = np.stack([np.asarray(e, np.float32).reshape(-1)
+                          for e in v])
+        else:
+            v = v.astype(np.float32).reshape(len(v), -1)
+        parts.append(v)
+    return parts[0] if len(parts) == 1 else np.concatenate(parts, axis=1)
+
+
+class NNEstimator:
+    """Builder-style estimator (set* methods mirror the Spark-ML params)."""
+
+    def __init__(self, model, criterion: str = "mse",
+                 features_col: str = "features", label_col: str = "label"):
+        self.model = model
+        self.criterion = criterion
+        self.features_col = [features_col] if isinstance(features_col, str) \
+            else list(features_col)
+        self.label_col = label_col
+        self.batch_size = 32
+        self.max_epoch = 1
+        self.learning_rate: Optional[float] = None
+        self.optim_method = "adam"
+        self.caching_sample = True
+
+    # -- Spark-ML style setters -------------------------------------------
+    def setFeaturesCol(self, col: Union[str, Sequence[str]]):
+        self.features_col = [col] if isinstance(col, str) else list(col)
+        return self
+
+    def setLabelCol(self, col: str):
+        self.label_col = col
+        return self
+
+    def setBatchSize(self, n: int):
+        self.batch_size = int(n)
+        return self
+
+    def setMaxEpoch(self, n: int):
+        self.max_epoch = int(n)
+        return self
+
+    def setLearningRate(self, lr: float):
+        self.learning_rate = float(lr)
+        return self
+
+    def setOptimMethod(self, name: str):
+        self.optim_method = name
+        return self
+
+    def setCachingSample(self, flag: bool):
+        self.caching_sample = bool(flag)
+        return self
+
+    # -- fit ---------------------------------------------------------------
+    def _compile(self):
+        if self.model.loss_fn is None:
+            from zoo_tpu.pipeline.api.keras import optimizers as zopt
+
+            opt = {"adam": zopt.Adam, "sgd": zopt.SGD,
+                   "rmsprop": zopt.RMSprop}[self.optim_method.lower()]
+            kwargs = {} if self.learning_rate is None \
+                else {"lr": self.learning_rate}
+            self.model.compile(optimizer=opt(**kwargs),
+                               loss=self.criterion)
+
+    def _unpack(self, df):
+        from zoo_tpu.orca.data.shard import LocalXShards
+
+        if isinstance(df, LocalXShards):
+            import pandas as pd
+
+            df = pd.concat(df.collect(), ignore_index=True)
+        x = _to_matrix(df, self.features_col)
+        y = df[self.label_col].to_numpy() if self.label_col in df else None
+        return df, x, y
+
+    def fit(self, df) -> "NNModel":
+        df, x, y = self._unpack(df)
+        if y is None:
+            raise ValueError(f"label column {self.label_col!r} not in df")
+        self._compile()
+        y = self._prepare_labels(y)
+        self.model.fit(x, y, batch_size=self.batch_size,
+                       nb_epoch=self.max_epoch, verbose=0)
+        return self._make_model()
+
+    def _prepare_labels(self, y):
+        return y.astype(np.float32).reshape(len(y), -1)
+
+    def _make_model(self) -> "NNModel":
+        return NNModel(self.model, features_col=self.features_col)
+
+
+class NNModel:
+    """Transformer: appends ``prediction`` to the DataFrame (reference
+    ``NNModel.transform``)."""
+
+    prediction_col = "prediction"
+
+    def __init__(self, model, features_col: Sequence[str] = ("features",)):
+        self.model = model
+        self.features_col = list(features_col)
+        self.batch_size = 256
+
+    def setFeaturesCol(self, col: Union[str, Sequence[str]]):
+        self.features_col = [col] if isinstance(col, str) else list(col)
+        return self
+
+    def setBatchSize(self, n: int):
+        self.batch_size = int(n)
+        return self
+
+    def setPredictionCol(self, col: str):
+        self.prediction_col = col
+        return self
+
+    def _predict(self, df) -> np.ndarray:
+        x = _to_matrix(df, self.features_col)
+        return self.model.predict(x, batch_size=self.batch_size)
+
+    def transform(self, df):
+        from zoo_tpu.orca.data.shard import LocalXShards
+
+        if isinstance(df, LocalXShards):
+            return df.transform_shard(self.transform)
+        out = df.copy()
+        preds = self._predict(df)
+        out[self.prediction_col] = (preds[:, 0] if preds.ndim == 2
+                                    and preds.shape[1] == 1
+                                    else list(preds))
+        return out
+
+
+class NNClassifier(NNEstimator):
+    """Classifier flavor: integer labels, argmax prediction (reference
+    ``NNClassifier`` — labels are 1-based there via Spark-ML convention;
+    0-based here, documented)."""
+
+    def __init__(self, model, criterion: str =
+                 "sparse_categorical_crossentropy",
+                 features_col: str = "features", label_col: str = "label"):
+        super().__init__(model, criterion, features_col, label_col)
+
+    def _prepare_labels(self, y):
+        return y.astype(np.int32)
+
+    def _make_model(self) -> "NNClassifierModel":
+        return NNClassifierModel(self.model,
+                                 features_col=self.features_col)
+
+
+class NNClassifierModel(NNModel):
+    def transform(self, df):
+        from zoo_tpu.orca.data.shard import LocalXShards
+
+        if isinstance(df, LocalXShards):
+            return df.transform_shard(self.transform)
+        out = df.copy()
+        probs = self._predict(df)
+        out[self.prediction_col] = np.argmax(probs, axis=-1) \
+            if probs.ndim > 1 and probs.shape[-1] > 1 \
+            else (probs.reshape(-1) > 0.5).astype(np.int32)
+        return out

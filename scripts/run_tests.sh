@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Hermetic test run on the virtual CPU mesh (the reference's
+# pyzoo/dev/run-pytests role).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m pytest tests/ -q "$@"

@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Launch a zoo_tpu training script on every host of a TPU pod slice.
+#
+# Rebuild of the reference's spark-submit wrappers (scripts/spark-submit-*.sh):
+# there the cluster manager distributed the python env and launched
+# executors; on TPU the hosts are fixed, so deployment is "copy the wheel,
+# run the same script on every worker" — jax.distributed discovers the
+# topology from the TPU metadata, and init_orca_context(cluster_mode="tpu")
+# does the rest.
+#
+# Usage:
+#   scripts/run_tpu_pod.sh <tpu-name> <zone> <script.py> [args...]
+set -euo pipefail
+TPU_NAME=${1:?tpu name}; ZONE=${2:?zone}; SCRIPT=${3:?script}; shift 3
+
+# ship the package and the entry script to every worker
+gcloud compute tpus tpu-vm scp --worker=all --zone="$ZONE" --recurse \
+    "$(dirname "$0")/.." "$TPU_NAME":~/zoo_tpu_pkg
+gcloud compute tpus tpu-vm scp --worker=all --zone="$ZONE" \
+    "$SCRIPT" "$TPU_NAME":~/job.py
+
+# run one process per host; jax.distributed auto-detects coordinator/rank
+gcloud compute tpus tpu-vm ssh --worker=all --zone="$ZONE" "$TPU_NAME" \
+    --command="cd ~/zoo_tpu_pkg && PYTHONPATH=~/zoo_tpu_pkg python ~/job.py $*"

@@ -148,3 +148,49 @@ def test_resnet18_tiny(orca_ctx):
     n_bn = sum(1 for p in m.params.values()
                if isinstance(p, dict) and "stats" in p)
     assert n_bn > 10
+
+
+def test_ssd_detection_pipeline(orca_ctx):
+    """SSD: anchors, decode, NMS, end-to-end predict_detections layout."""
+    import jax.numpy as jnp
+
+    from zoo_tpu.models.image import SSD, decode_boxes, nms
+
+    m = SSD(n_classes=4, input_size=64, feature_channels=(16, 32))
+    assert m.anchors.shape[1] == 4
+    x = np.random.RandomState(0).rand(2, 64, 64, 3).astype(np.float32)
+    dets = m.predict_detections(x, score_threshold=0.0, top_k=10)
+    assert len(dets) == 2
+    for det in dets:
+        assert det.shape[1] == 6
+        assert det.shape[0] <= 10
+        labels = det[:, 0]
+        assert ((labels >= 1) & (labels < 4)).all()  # bg never emitted
+
+    # NMS suppresses an overlapping lower-scored box, keeps disjoint one
+    boxes = jnp.asarray([[0.0, 0.0, 0.5, 0.5],
+                         [0.01, 0.01, 0.51, 0.51],
+                         [0.6, 0.6, 0.9, 0.9]])
+    scores = jnp.asarray([0.9, 0.8, 0.7])
+    _, kept_scores, _ = nms(boxes, scores, top_k=3, iou_threshold=0.5)
+    kept = np.asarray(kept_scores)
+    assert kept[0] > 0 and kept[2] > 0 and kept[1] == 0
+
+    # decode identity: zero deltas give the anchor box corners
+    anchors = jnp.asarray([[0.5, 0.5, 0.2, 0.2]])
+    out = np.asarray(decode_boxes(anchors, jnp.zeros((1, 4))))
+    np.testing.assert_allclose(out, [[0.4, 0.4, 0.6, 0.6]], atol=1e-6)
+
+
+def test_object_detector_image_set(orca_ctx):
+    from zoo_tpu.feature.image import ImageSet
+    from zoo_tpu.models.image import SSD, ObjectDetector
+
+    m = SSD(n_classes=3, input_size=64, feature_channels=(16, 32))
+    det = ObjectDetector(m, label_map={1: "cat", 2: "dog"})
+    imgs = [np.random.randint(0, 255, (80, 100, 3), np.uint8)
+            for _ in range(3)]
+    iset = ImageSet.from_arrays(imgs)
+    out = det.predict_image_set(iset, score_threshold=0.0)
+    preds = out.get_predict()
+    assert len(preds) == 3 and all(p.shape[1] == 6 for p in preds)

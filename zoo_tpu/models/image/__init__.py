@@ -1,3 +1,11 @@
-from zoo_tpu.models.image.resnet import ResNet, resnet18, resnet50
+from zoo_tpu.models.image.objectdetection import (  # noqa: F401
+    SSD,
+    ObjectDetector,
+    decode_boxes,
+    generate_anchors,
+    nms,
+)
+from zoo_tpu.models.image.resnet import ResNet, resnet18, resnet50  # noqa: F401,E501
 
-__all__ = ["ResNet", "resnet18", "resnet50"]
+__all__ = ["ResNet", "resnet18", "resnet50", "SSD", "ObjectDetector",
+           "generate_anchors", "decode_boxes", "nms"]

@@ -1,17 +1,21 @@
-"""File utilities over local / (optionally) gcs paths.
+"""File utilities over local / http(s) / gcs paths.
 
 Rebuild of ``pyzoo/zoo/orca/data/file.py`` (open_text, exists, makedirs,
 write_text over local/hdfs/s3). The TPU-native deployment story replaces
-HDFS/S3 with GCS; ``gs://`` support is gated on an optional gcsfs/tensorstore
-install, everything else is plain POSIX.
+HDFS/S3 with GCS and plain HTTP: ``http(s)://`` downloads through urllib
+with a local cache, ``gs://`` goes through gcsfs/tensorstore when
+installed (gated with a clear error otherwise), everything else is POSIX.
 """
 
 from __future__ import annotations
 
 import glob as _glob
+import hashlib
 import os
 import shutil
-from typing import List
+import tempfile
+import urllib.request
+from typing import List, Optional
 
 
 def _strip_scheme(path: str) -> str:
@@ -24,30 +28,109 @@ def is_local_path(path: str) -> bool:
     return "://" not in path or path.startswith("file://")
 
 
-def exists(path: str) -> bool:
-    path = _strip_scheme(path)
+def _gcs_fs():
+    try:
+        import gcsfs
+        return gcsfs.GCSFileSystem()
+    except ImportError as e:
+        raise ImportError(
+            "gs:// paths need the gcsfs package (not installed in this "
+            "image); download the data locally or install gcsfs") from e
+
+
+def download(url: str, cache_dir: Optional[str] = None) -> str:
+    """Fetch an http(s) resource into a content-addressed local cache and
+    return the local path (the reference's remote reads funnel through
+    hadoop; here HTTP is the lingua franca)."""
+    cache_dir = cache_dir or os.path.join(tempfile.gettempdir(),
+                                          "zoo_tpu_downloads")
+    os.makedirs(cache_dir, exist_ok=True)
+    name = hashlib.sha1(url.encode()).hexdigest()[:16] + "_" + \
+        os.path.basename(url.split("?")[0])
+    local = os.path.join(cache_dir, name)
+    if not os.path.exists(local):
+        # per-writer temp file + atomic publish: concurrent processes
+        # (pod hosts on a shared fs) must not interleave into one .part
+        fd, tmp = tempfile.mkstemp(dir=cache_dir, suffix=".part")
+        try:
+            with urllib.request.urlopen(url, timeout=60) as resp, \
+                    os.fdopen(fd, "wb") as f:
+                shutil.copyfileobj(resp, f)
+            os.replace(tmp, local)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            raise
+    return local
+
+
+def _localize(path: str) -> str:
+    """Any supported path → a local filesystem path."""
     if is_local_path(path):
-        return os.path.exists(path)
-    raise NotImplementedError(f"remote path not supported here: {path}")
+        return _strip_scheme(path)
+    if path.startswith(("http://", "https://")):
+        return download(path)
+    if path.startswith("gs://"):
+        fs = _gcs_fs()
+        cache = os.path.join(tempfile.gettempdir(), "zoo_tpu_gcs")
+        os.makedirs(cache, exist_ok=True)
+        # keep the basename so extension-based filters (read_parquet etc.)
+        # still match the localized file
+        local = os.path.join(
+            cache, hashlib.sha1(path.encode()).hexdigest()[:16] + "_" +
+            os.path.basename(path))
+        if not os.path.exists(local):
+            fd, tmp = tempfile.mkstemp(dir=cache, suffix=".part")
+            os.close(fd)
+            try:
+                fs.get(path, tmp)  # staged: no truncated cache hits
+                os.replace(tmp, local)
+            except BaseException:
+                if os.path.exists(tmp):
+                    os.remove(tmp)
+                raise
+        return local
+    raise NotImplementedError(f"unsupported path scheme: {path}")
+
+
+def exists(path: str) -> bool:
+    if is_local_path(path):
+        return os.path.exists(_strip_scheme(path))
+    if path.startswith(("http://", "https://")):
+        req = urllib.request.Request(path, method="HEAD")
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return resp.status < 400
+        except Exception:
+            return False
+    if path.startswith("gs://"):
+        return _gcs_fs().exists(path)
+    raise NotImplementedError(f"unsupported path scheme: {path}")
 
 
 def makedirs(path: str):
-    path = _strip_scheme(path)
     if is_local_path(path):
-        os.makedirs(path, exist_ok=True)
+        os.makedirs(_strip_scheme(path), exist_ok=True)
         return
-    raise NotImplementedError(f"remote path not supported here: {path}")
+    if path.startswith("gs://"):
+        return  # object stores have no directories
+    raise NotImplementedError(f"cannot create directories under {path}")
 
 
 def open_text(path: str) -> List[str]:
-    """Read a text file and return its lines (reference:
-    ``orca/data/file.py`` ``open_text``)."""
-    path = _strip_scheme(path)
-    with open(path) as f:
+    """Read a text file (local, http(s) or gs) and return its lines
+    (reference: ``orca/data/file.py`` ``open_text``)."""
+    with open(_localize(path)) as f:
         return [line.rstrip("\n") for line in f]
 
 
 def write_text(path: str, text: str):
+    if path.startswith("gs://"):
+        with _gcs_fs().open(path, "w") as f:
+            f.write(text)
+        return
+    if not is_local_path(path):
+        raise NotImplementedError(f"cannot write to {path}")
     path = _strip_scheme(path)
     with open(path, "w") as f:
         f.write(text)
@@ -56,7 +139,10 @@ def write_text(path: str, text: str):
 def list_files(path_glob: str) -> List[str]:
     """Expand a path or glob to a sorted file list; a directory expands to
     its (non-hidden) files — matches the reference's extract_one behavior
-    for `read_csv` on a folder."""
+    for `read_csv` on a folder. Remote http(s)/gs paths localize to one
+    file."""
+    if not is_local_path(path_glob):
+        return [_localize(path_glob)]
     path_glob = _strip_scheme(path_glob)
     if os.path.isdir(path_glob):
         return sorted(

@@ -1,0 +1,247 @@
+"""Caffe loader: synthetic caffemodel binary + deploy prototxt round-trip.
+
+Mirrors the reference's CaffeLoader specs (load a conv/pool/fc net, check
+forward numerics) without needing caffe: the NetParameter is hand-encoded
+with the same wire codec the loader decodes with."""
+
+import numpy as np
+import pytest
+
+from zoo_tpu.tensorboard import proto as wire
+from zoo_tpu.models.caffe_loader import (
+    CaffeNetParameter, load_caffe, parse_prototxt)
+from zoo_tpu.pipeline.api.net import Net
+
+
+def _blob(arr):
+    arr = np.asarray(arr, np.float32)
+    shape = b"".join(wire.field_varint(1, d) for d in arr.shape)
+    data = b"".join(wire.field_float(5, float(v)) for v in arr.reshape(-1))
+    return wire.field_bytes(7, shape) + data
+
+
+def _layer(name, type_, bottoms, tops, blobs=(), param_field=None,
+           param_bytes=b""):
+    out = wire.field_bytes(1, name.encode())
+    out += wire.field_bytes(2, type_.encode())
+    for b in bottoms:
+        out += wire.field_bytes(3, b.encode())
+    for t in tops:
+        out += wire.field_bytes(4, t.encode())
+    for bl in blobs:
+        out += wire.field_bytes(7, _blob(bl))
+    if param_field:
+        out += wire.field_bytes(param_field, param_bytes)
+    return out
+
+
+def _make_model(tmp_path):
+    rng = np.random.RandomState(0)
+    w = rng.randn(4, 3, 3, 3).astype(np.float32)
+    b = rng.randn(4).astype(np.float32)
+    fc_w = (0.1 * rng.randn(2, 4 * 4 * 4)).astype(np.float32)
+    fc_b = (0.1 * rng.randn(2)).astype(np.float32)
+
+    conv_param = (wire.field_varint(1, 4) + wire.field_varint(4, 3)
+                  + wire.field_varint(6, 1) + wire.field_varint(3, 1))
+    pool_param = (wire.field_varint(1, 0) + wire.field_varint(2, 2)
+                  + wire.field_varint(3, 2))
+    ip_param = wire.field_varint(1, 2)
+
+    net = wire.field_bytes(1, b"testnet")
+    net += wire.field_bytes(3, b"data")
+    for d in (1, 3, 8, 8):
+        net += wire.field_varint(4, d)
+    net += wire.field_bytes(100, _layer("conv1", "Convolution", ["data"],
+                                        ["conv1"], [w, b], 106, conv_param))
+    net += wire.field_bytes(100, _layer("relu1", "ReLU", ["conv1"],
+                                        ["conv1"]))
+    net += wire.field_bytes(100, _layer("pool1", "Pooling", ["conv1"],
+                                        ["pool1"], (), 121, pool_param))
+    net += wire.field_bytes(100, _layer("fc1", "InnerProduct", ["pool1"],
+                                        ["fc1"], [fc_w, fc_b], 117,
+                                        ip_param))
+    net += wire.field_bytes(100, _layer("prob", "Softmax", ["fc1"],
+                                        ["prob"]))
+    path = tmp_path / "model.caffemodel"
+    path.write_bytes(net)
+    return str(path), (w, b, fc_w, fc_b)
+
+
+def _numpy_forward(x, w, b, fc_w, fc_b):
+    n, _, h, w_ = x.shape
+    co, ci, kh, kw = w.shape
+    xp = np.pad(x, [(0, 0), (0, 0), (1, 1), (1, 1)])
+    conv = np.zeros((n, co, h, w_), np.float32)
+    for i in range(h):
+        for j in range(w_):
+            patch = xp[:, :, i:i + kh, j:j + kw]
+            conv[:, :, i, j] = np.einsum("nchw,ochw->no", patch, w) + b
+    relu = np.maximum(conv, 0)
+    pool = relu.reshape(n, co, 4, 2, 4, 2).max(axis=(3, 5))
+    fc = pool.reshape(n, -1) @ fc_w.T + fc_b
+    e = np.exp(fc - fc.max(-1, keepdims=True))
+    return e / e.sum(-1, keepdims=True)
+
+
+def test_binary_parse(tmp_path):
+    path, _ = _make_model(tmp_path)
+    with open(path, "rb") as f:
+        net = CaffeNetParameter(f.read())
+    assert net.name == "testnet"
+    assert [l.type for l in net.layers] == [
+        "Convolution", "ReLU", "Pooling", "InnerProduct", "Softmax"]
+    assert net.inputs == ["data"]
+    assert net.input_shapes == [(1, 3, 8, 8)]
+    assert net.layers[0].blobs[0].shape == (4, 3, 3, 3)
+
+
+def test_forward_matches_numpy(tmp_path):
+    path, (w, b, fc_w, fc_b) = _make_model(tmp_path)
+    model = Net.load_caffe(None, path)
+    x = np.random.RandomState(1).randn(2, 3, 8, 8).astype(np.float32)
+    y = np.asarray(model.predict(x, batch_size=2))
+    ref = _numpy_forward(x, w, b, fc_w, fc_b)
+    np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_prototxt_topology(tmp_path):
+    path, (w, b, fc_w, fc_b) = _make_model(tmp_path)
+    deploy = """
+    name: "testnet"
+    input: "data"
+    input_shape { dim: 1 dim: 3 dim: 8 dim: 8 }
+    layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+            convolution_param { num_output: 4 kernel_size: 3 pad: 1 } }
+    layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+    layer { name: "pool1" type: "Pooling" bottom: "conv1" top: "pool1"
+            pooling_param { pool: MAX kernel_size: 2 stride: 2 } }
+    layer { name: "fc1" type: "InnerProduct" bottom: "pool1" top: "fc1"
+            inner_product_param { num_output: 2 } }
+    layer { name: "prob" type: "Softmax" bottom: "fc1" top: "prob" }
+    """
+    def_path = tmp_path / "deploy.prototxt"
+    def_path.write_text(deploy)
+    model = load_caffe(str(def_path), path)
+    x = np.random.RandomState(2).randn(2, 3, 8, 8).astype(np.float32)
+    y = np.asarray(model.predict(x, batch_size=2))
+    ref = _numpy_forward(x, w, b, fc_w, fc_b)
+    np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_prototxt_parser_basics():
+    net = parse_prototxt(
+        'name: "n" # comment\nlayer { name: "l" include { phase: TRAIN } }')
+    assert net["name"] == ["n"]
+    assert net["layer"][0]["include"][0]["phase"] == ["TRAIN"]
+
+
+def test_train_phase_layers_skipped(tmp_path):
+    path, _ = _make_model(tmp_path)
+    deploy = """
+    input: "data"
+    input_dim: 1 input_dim: 3 input_dim: 8 input_dim: 8
+    layer { name: "aug" type: "Data" top: "data" include { phase: TRAIN } }
+    layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+            convolution_param { num_output: 4 kernel_size: 3 pad: 1 } }
+    """
+    def_path = tmp_path / "d.prototxt"
+    def_path.write_text(deploy)
+    model = load_caffe(str(def_path), path)
+    assert [l.name for l in model.caffe_layers] == ["conv1"]
+
+
+def test_deconvolution_matches_torch(tmp_path):
+    """Caffe Deconvolution == torch ConvTranspose2d (in, out/g, kh, kw)."""
+    torch = pytest.importorskip("torch")
+    rng = np.random.RandomState(4)
+    w = rng.randn(3, 5, 4, 4).astype(np.float32)  # (in, out, kh, kw)
+    b = rng.randn(5).astype(np.float32)
+    conv_param = (wire.field_varint(1, 5) + wire.field_varint(4, 4)
+                  + wire.field_varint(6, 2) + wire.field_varint(3, 1))
+    net = wire.field_bytes(3, b"data")
+    for d in (1, 3, 6, 6):
+        net += wire.field_varint(4, d)
+    net += wire.field_bytes(100, _layer("up", "Deconvolution", ["data"],
+                                        ["up"], [w, b], 106, conv_param))
+    path = tmp_path / "deconv.caffemodel"
+    path.write_bytes(net)
+    model = load_caffe(None, str(path))
+    x = rng.randn(2, 3, 6, 6).astype(np.float32)
+    y = np.asarray(model.predict(x, batch_size=2))
+    tconv = torch.nn.ConvTranspose2d(3, 5, 4, stride=2, padding=1)
+    with torch.no_grad():
+        tconv.weight.copy_(torch.from_numpy(w))
+        tconv.bias.copy_(torch.from_numpy(b))
+        ref = tconv(torch.from_numpy(x)).numpy()
+    assert y.shape == ref.shape == (2, 5, 12, 12)
+    np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_ave_pool_pad_denominator(tmp_path):
+    """Caffe AVE pool divides by window clipped to the padded extent:
+    corner of an all-ones input with k=3,s=2,p=1 is 4/9, not 1."""
+    pool_param = (wire.field_varint(1, 1) + wire.field_varint(2, 3)
+                  + wire.field_varint(3, 2) + wire.field_varint(4, 1))
+    net = wire.field_bytes(3, b"data")
+    for d in (1, 1, 4, 4):
+        net += wire.field_varint(4, d)
+    net += wire.field_bytes(100, _layer("p", "Pooling", ["data"], ["p"],
+                                        (), 121, pool_param))
+    path = tmp_path / "ave.caffemodel"
+    path.write_bytes(net)
+    model = load_caffe(None, str(path))
+    y = np.asarray(model.predict(np.ones((1, 1, 4, 4), np.float32),
+                                 batch_size=1))
+    np.testing.assert_allclose(y[0, 0, 0, 0], 4.0 / 9.0, rtol=1e-5)
+    np.testing.assert_allclose(y[0, 0, 1, 1], 1.0, rtol=1e-5)
+
+
+def test_new_format_allcaps_types_not_mangled(tmp_path):
+    """'ELU' is a legitimate new-format type name, not a V1 enum."""
+    path, _ = _make_model(tmp_path)
+    deploy = """
+    input: "data"
+    input_shape { dim: 1 dim: 3 dim: 8 dim: 8 }
+    layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+            convolution_param { num_output: 4 kernel_size: 3 pad: 1 } }
+    layer { name: "e" type: "ELU" bottom: "conv1" top: "e" }
+    layer { name: "ip" type: "INNER_PRODUCT" bottom: "e" top: "ip"
+            inner_product_param { num_output: 2 } }
+    """
+    def_path = tmp_path / "elu.prototxt"
+    def_path.write_text(deploy)
+    model = load_caffe(str(def_path), path)
+    assert [l.type for l in model.caffe_layers] == [
+        "Convolution", "ELU", "InnerProduct"]
+    model.caffe_layers[2].blobs = []  # no weights for 'ip' in the binary
+    types = [l.type for l in model.caffe_layers]
+    assert "Elu" not in types
+
+
+def test_missing_bottom_raises(tmp_path):
+    path, _ = _make_model(tmp_path)
+    deploy = """
+    input: "data"
+    input_shape { dim: 1 dim: 3 dim: 8 dim: 8 }
+    layer { name: "conv1" type: "Convolution" bottom: "nope" top: "conv1"
+            convolution_param { num_output: 4 kernel_size: 3 pad: 1 } }
+    """
+    def_path = tmp_path / "bad.prototxt"
+    def_path.write_text(deploy)
+    model = load_caffe(str(def_path), path)
+    with pytest.raises(KeyError, match="undefined bottom"):
+        model.predict(np.zeros((1, 3, 8, 8), np.float32), batch_size=1)
+
+
+def test_finetune_caffe_model(tmp_path):
+    """A loaded caffe net trains like any zoo model (blobs are params)."""
+    path, _ = _make_model(tmp_path)
+    model = Net.load_caffe(None, path)
+    x = np.random.RandomState(3).randn(8, 3, 8, 8).astype(np.float32)
+    yt = np.eye(2, dtype=np.float32)[np.arange(8) % 2]
+    model.compile(optimizer="adam", loss="categorical_crossentropy")
+    h0 = model.evaluate(x, yt, batch_size=8)
+    model.fit(x, yt, batch_size=8, nb_epoch=12, verbose=0)
+    h1 = model.evaluate(x, yt, batch_size=8)
+    assert h1["loss"] < h0["loss"]

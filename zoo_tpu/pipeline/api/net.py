@@ -1,0 +1,63 @@
+"""``Net`` — the one-stop foreign/native model loading facade.
+
+Rebuild of the reference's ``pyzoo/zoo/pipeline/api/net/net.py`` (class
+``Net`` with ``load_bigdl`` / ``load`` / ``load_torch`` / ``load_tf`` /
+``load_caffe`` / ``load_keras`` static loaders). Each loader returns a
+zoo model (:class:`KerasNet`) that predicts/fine-tunes on TPU like any
+natively-built model; the heavy lifting lives in the per-format modules
+(``models.caffe_loader``, ``pipeline.api.onnx``, ``bridges.*``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+
+class Net:
+    """Static loaders for models from other frameworks/formats."""
+
+    @staticmethod
+    def load(path: str):
+        """Load a natively-saved zoo model (reference ``Net.load`` loads a
+        BigDL Model; here the pickled KerasNet from ``model.save``)."""
+        from zoo_tpu.pipeline.api.keras.engine.topology import KerasNet
+        return KerasNet.load(path)
+
+    load_bigdl = load
+
+    @staticmethod
+    def load_caffe(def_path: Optional[str], model_path: str):
+        """Load a Caffe model (reference ``Net.load_caffe``; Scala
+        ``CaffeLoader.loadCaffe`` ``models/caffe/CaffeLoader.scala:718``)."""
+        from zoo_tpu.models.caffe_loader import load_caffe
+        return load_caffe(def_path, model_path)
+
+    @staticmethod
+    def load_torch(module_or_path, example_inputs: Sequence):
+        """Load a PyTorch ``nn.Module`` (or a ``torch.save`` file path) by
+        tracing it to a JAX graph (reference ``Net.load_torch`` ships a
+        pickled module through jep; ``TorchModel.scala:34``)."""
+        from zoo_tpu.bridges.fx_bridge import torch_to_graph_net
+        if isinstance(module_or_path, str):
+            import torch
+            module_or_path = torch.load(module_or_path, weights_only=False)
+        return torch_to_graph_net(module_or_path, example_inputs)
+
+    @staticmethod
+    def load_tf(path: str, signature: str = "serving_default"):
+        """Load a TF SavedModel / frozen graph for inference (reference
+        ``Net.load_tf`` → ``TFNet.scala:56``)."""
+        from zoo_tpu.bridges.tf_graph import load_saved_model
+        return load_saved_model(path, signature=signature)
+
+    @staticmethod
+    def load_onnx(path_or_bytes):
+        """Load an ONNX model (reference ``onnx_loader.py:1``)."""
+        from zoo_tpu.pipeline.api.onnx.onnx_loader import load_onnx
+        return load_onnx(path_or_bytes)
+
+    @staticmethod
+    def load_keras(model):
+        """Convert an in-memory tf.keras model (reference ``Net.load_keras``
+        converts a keras definition+weights json/hdf5 pair)."""
+        from zoo_tpu.bridges.keras_bridge import convert_keras_model
+        return convert_keras_model(model)

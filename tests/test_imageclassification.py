@@ -1,0 +1,88 @@
+"""Image-classification zoo: forward shapes at reduced resolution, the
+ImageClassifier pipeline wrapper, and MobileNet depthwise training.
+
+Mirrors the reference's imageclassification specs (predict over an
+ImageSet with the family's preprocessing config attached)."""
+
+import numpy as np
+import pytest
+
+from zoo_tpu.feature.image import ImageFeature, ImageSet
+from zoo_tpu.models.image import (
+    ImageClassifier,
+    create_image_classifier,
+    densenet121,
+    inception_v1,
+    mobilenet_v1,
+    mobilenet_v2,
+    squeezenet,
+    vgg16,
+)
+
+SMALL = (64, 64, 3)
+
+
+@pytest.mark.parametrize("builder", [
+    inception_v1, mobilenet_v1, mobilenet_v2, squeezenet, densenet121])
+def test_forward_shape(builder):
+    model = builder(7, input_shape=SMALL)
+    x = np.random.RandomState(0).rand(2, *SMALL).astype(np.float32)
+    y = np.asarray(model.predict(x, batch_size=2))
+    assert y.shape == (2, 7)
+    np.testing.assert_allclose(y.sum(-1), 1.0, rtol=1e-4)
+
+
+def test_vgg_forward_shape():
+    model = vgg16(5, input_shape=(32, 32, 3))
+    x = np.random.RandomState(0).rand(2, 32, 32, 3).astype(np.float32)
+    y = np.asarray(model.predict(x, batch_size=2))
+    assert y.shape == (2, 5)
+
+
+def test_catalogue_lookup():
+    m = create_image_classifier("squeezenet", class_num=11)
+    assert m.name == "squeezenet"
+    with pytest.raises(ValueError, match="unknown image-classification"):
+        create_image_classifier("resnet-9000")
+
+
+def test_mobilenet_trains():
+    model = mobilenet_v1(3, input_shape=(32, 32, 3))
+    x = np.random.RandomState(0).rand(12, 32, 32, 3).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[np.arange(12) % 3]
+    model.compile(optimizer="adam", loss="categorical_crossentropy")
+    hist = model.fit(x, y, batch_size=12, nb_epoch=15, verbose=0)
+    assert hist["loss"][-1] < hist["loss"][0]
+
+
+def test_image_classifier_pipeline():
+    clf = ImageClassifier.create("squeezenet", class_num=4,
+                                 label_map={0: "cat", 1: "dog", 2: "fox",
+                                            3: "owl"})
+    rng = np.random.RandomState(1)
+    feats = [ImageFeature(image=(rng.rand(300, 280, 3) * 255)
+                          .astype(np.uint8)) for _ in range(3)]
+    out = clf.predict_image_set(ImageSet(feats), top_k=2)
+    for f in out.features:
+        assert np.asarray(f["predict"]).shape == (4,)
+        assert len(f["classes"]) == 2 and len(f["probs"]) == 2
+        assert f["classes"][0] in ("cat", "dog", "fox", "owl")
+        assert f["probs"][0] >= f["probs"][1]
+    # predict is non-destructive: a second call sees the original uint8
+    # images and reproduces the same probabilities
+    first = [np.asarray(f["predict"]).copy() for f in out.features]
+    assert out.features[0]["image"].dtype == np.uint8
+    again = clf.predict_image_set(ImageSet(feats), top_k=2)
+    for f, p in zip(again.features, first):
+        np.testing.assert_allclose(np.asarray(f["predict"]), p, atol=1e-5)
+
+
+def test_save_load_roundtrip(tmp_path):
+    clf = ImageClassifier.create("mobilenet-v2", class_num=3)
+    x = np.random.RandomState(2).rand(2, 224, 224, 3).astype(np.float32)
+    ref = np.asarray(clf.model.predict(x, batch_size=2))
+    p = str(tmp_path / "m.zoo")
+    clf.save_model(p)
+    clf2 = ImageClassifier.load_model(p)
+    got = np.asarray(clf2.model.predict(x, batch_size=2))
+    np.testing.assert_allclose(got, ref, atol=1e-5)

@@ -1,3 +1,16 @@
+from zoo_tpu.models.image.imageclassification import (  # noqa: F401
+    ImageClassifier,
+    LabelOutput,
+    create_image_classifier,
+    densenet121,
+    image_classification_preprocess,
+    inception_v1,
+    mobilenet_v1,
+    mobilenet_v2,
+    squeezenet,
+    vgg16,
+    vgg19,
+)
 from zoo_tpu.models.image.objectdetection import (  # noqa: F401
     SSD,
     ObjectDetector,
@@ -8,4 +21,8 @@ from zoo_tpu.models.image.objectdetection import (  # noqa: F401
 from zoo_tpu.models.image.resnet import ResNet, resnet18, resnet50  # noqa: F401,E501
 
 __all__ = ["ResNet", "resnet18", "resnet50", "SSD", "ObjectDetector",
-           "generate_anchors", "decode_boxes", "nms"]
+           "generate_anchors", "decode_boxes", "nms",
+           "ImageClassifier", "LabelOutput", "create_image_classifier",
+           "image_classification_preprocess", "inception_v1", "vgg16",
+           "vgg19", "mobilenet_v1", "mobilenet_v2", "squeezenet",
+           "densenet121"]

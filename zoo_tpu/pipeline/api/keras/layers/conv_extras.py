@@ -703,3 +703,60 @@ class SpatialDropout3D(Layer):
             shape = (inputs.shape[0], 1, 1, 1, inputs.shape[4])
         keep = jax.random.bernoulli(r, 1.0 - self.p, shape)
         return jnp.where(keep, inputs / (1.0 - self.p), 0.0)
+
+
+class DepthwiseConvolution2D(Layer):
+    """Per-channel spatial conv without the pointwise mix (the depthwise
+    half of ``SeparableConvolution2D``; net-new layer the MobileNet
+    configs in ``models/image/imageclassification`` need — the reference
+    ships MobileNet only as a pretrained BigDL file)."""
+
+    def __init__(self, nb_row: int, nb_col: int, init="glorot_uniform",
+                 activation=None, border_mode: str = "valid",
+                 subsample=(1, 1), depth_multiplier: int = 1,
+                 dim_ordering: str = "th", bias: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        self.kernel = (int(nb_row), int(nb_col))
+        self.init = get_initializer(init)
+        self.activation = get_activation_fn(activation)
+        self.border_mode = border_mode
+        self.subsample = _tup(subsample, 2)
+        self.mult = int(depth_multiplier)
+        self.dim_ordering = dim_ordering
+        self.bias = bias
+
+    def build(self, rng, input_shape):
+        cin = input_shape[1] if self.dim_ordering == "th" else input_shape[3]
+        p = {"W": self.init(rng, self.kernel + (1, cin * self.mult),
+                            jnp.float32)}
+        if self.bias:
+            p["b"] = jnp.zeros((cin * self.mult,), jnp.float32)
+        return p
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        x = inputs
+        if self.dim_ordering == "th":
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        y = jax.lax.conv_general_dilated(
+            x, params["W"], window_strides=self.subsample,
+            padding=self.border_mode.upper(),
+            feature_group_count=x.shape[-1],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.bias:
+            y = y + params["b"]
+        if self.activation:
+            y = self.activation(y)
+        if self.dim_ordering == "th":
+            y = jnp.transpose(y, (0, 3, 1, 2))
+        return y
+
+    def compute_output_shape(self, input_shape):
+        if self.dim_ordering == "th":
+            b, c, h, w = input_shape
+        else:
+            b, h, w, c = input_shape
+        oh = _out_dim(h, self.kernel[0], self.subsample[0], self.border_mode)
+        ow = _out_dim(w, self.kernel[1], self.subsample[1], self.border_mode)
+        if self.dim_ordering == "th":
+            return (b, c * self.mult, oh, ow)
+        return (b, oh, ow, c * self.mult)

@@ -1,0 +1,112 @@
+"""PPML crypto (EncryptSupportive) + encrypted-model and int8 inference
+wiring (InferenceModel.load_encrypted / quantize_model)."""
+
+import numpy as np
+import pytest
+
+from zoo_tpu.pipeline.api.keras.engine.topology import Sequential
+from zoo_tpu.pipeline.api.keras.layers import Dense
+from zoo_tpu.pipeline.inference.inference_model import (
+    InferenceModel,
+    quantize_model,
+    save_encrypted,
+)
+from zoo_tpu.ppml import EncryptSupportive
+
+
+def test_cbc_roundtrip_bytes():
+    data = bytes(range(256)) * 33  # not block-aligned
+    enc = EncryptSupportive.encrypt_bytes_with_aes_cbc(
+        data, "secret", "salty")
+    assert enc[:16] != data[:16] and len(enc) > len(data)
+    dec = EncryptSupportive.decrypt_bytes_with_aes_cbc(
+        enc, "secret", "salty")
+    assert dec == data
+
+
+def test_cbc_roundtrip_string_base64():
+    msg = "hello TPU enclave ✓"
+    enc = EncryptSupportive.encrypt_with_aes_cbc(msg, "s3cret", "NaCl")
+    assert enc != msg
+    assert EncryptSupportive.decrypt_with_aes_cbc(
+        enc, "s3cret", "NaCl") == msg
+
+
+def test_gcm_roundtrip_and_tamper_detection():
+    data = b"model bytes " * 100
+    enc = EncryptSupportive.encrypt_bytes_with_aes_gcm(data, "k", "s")
+    assert EncryptSupportive.decrypt_bytes_with_aes_gcm(
+        enc, "k", "s") == data
+    tampered = enc[:20] + bytes([enc[20] ^ 0xFF]) + enc[21:]
+    with pytest.raises(ValueError, match="decryption failed"):
+        EncryptSupportive.decrypt_bytes_with_aes_gcm(tampered, "k", "s")
+
+
+def test_wrong_secret_fails():
+    enc = EncryptSupportive.encrypt_bytes_with_aes_cbc(b"x" * 64, "a", "b")
+    with pytest.raises(ValueError):
+        EncryptSupportive.decrypt_bytes_with_aes_cbc(enc, "WRONG", "b")
+
+
+def test_key_lengths():
+    for key_len in (128, 256):
+        enc = EncryptSupportive.encrypt_bytes_with_aes_cbc(
+            b"abc", "s", "t", key_len=key_len)
+        assert EncryptSupportive.decrypt_bytes_with_aes_cbc(
+            enc, "s", "t", key_len=key_len) == b"abc"
+
+
+def _small_model():
+    m = Sequential(name="enc_test")
+    m.add(Dense(32, activation="relu", input_shape=(16,)))
+    m.add(Dense(4))
+    m.build()
+    return m
+
+
+def test_encrypted_model_roundtrip(tmp_path):
+    model = _small_model()
+    x = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+    ref = np.asarray(model.predict(x, batch_size=8))
+    p = str(tmp_path / "m.enc")
+    save_encrypted(model, p, "topsecret", "pepper")
+    # ciphertext on disk: loading it unencrypted must fail
+    with pytest.raises(Exception):
+        InferenceModel().load(p)
+    im = InferenceModel().load_encrypted(p, "topsecret", "pepper")
+    got = np.asarray(im.predict(x, batch_size=8))
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+def test_quantize_model_close_and_int8(tmp_path):
+    model = _small_model()
+    x = np.random.RandomState(1).randn(8, 16).astype(np.float32)
+    ref = np.asarray(model.predict(x, batch_size=8))
+    q = quantize_model(model)
+    for key, group in q.params.items():
+        if "dense" in key:
+            assert group["W_q"].dtype == np.int8
+            assert "W" not in group
+    got = np.asarray(q.predict(x, batch_size=8))
+    # int8 per-channel quantization: ~1% relative error budget
+    assert np.max(np.abs(got - ref)) / (np.max(np.abs(ref)) + 1e-9) < 0.02
+
+
+def test_load_quantized_from_disk(tmp_path):
+    model = _small_model()
+    x = np.random.RandomState(2).randn(4, 16).astype(np.float32)
+    ref = np.asarray(model.predict(x, batch_size=4))
+    p = str(tmp_path / "m.zoo")
+    model.save(p)
+    im = InferenceModel().load(p, quantize=True)
+    got = np.asarray(im.predict(x, batch_size=4))
+    assert np.max(np.abs(got - ref)) / (np.max(np.abs(ref)) + 1e-9) < 0.02
+
+
+def test_quantized_model_refuses_fit():
+    model = quantize_model(_small_model())
+    model.compile(optimizer="adam", loss="mse")
+    x = np.zeros((4, 16), np.float32)
+    with pytest.raises(RuntimeError, match="inference-only"):
+        model.fit(x, np.zeros((4, 4), np.float32), batch_size=4,
+                  nb_epoch=1, verbose=0)

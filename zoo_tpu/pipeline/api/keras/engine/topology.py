@@ -317,6 +317,10 @@ class KerasNet:
             verbose: int = 1) -> Dict[str, List[float]]:
         """reference: ``KerasNet.fit`` ``Topology.scala:347`` (trains via
         InternalDistriOptimizer there; a jitted step loop here)."""
+        if getattr(self, "_quantized", False):
+            raise RuntimeError(
+                "this model was int8-quantized (quantize_model) and is "
+                "inference-only; re-load the float checkpoint to train")
         if self.loss_fn is None:
             raise RuntimeError("call compile() before fit()")
         xs, ys = data_utils.to_xy_arrays(x, y, feature_cols, label_cols)
@@ -597,7 +601,7 @@ class KerasNet:
         return self._predict_arrays(xs, batch_size)
 
     # -- persistence -------------------------------------------------------
-    def save(self, path: str):
+    def to_bytes(self) -> bytes:
         """Serialize the WHOLE model (architecture + weights) with
         cloudpickle — the rebuild of the reference's Scala module
         serialization (``SerializerSpec``-covered save/load round trips).
@@ -615,13 +619,16 @@ class KerasNet:
             self.validation_summary = TrainSummary()
             if params is not None:
                 self.params = jax.tree_util.tree_map(np.asarray, params)
-            with open(path, "wb") as f:
-                cloudpickle.dump(self, f)
+            return cloudpickle.dumps(self)
         finally:
             self._jit_train, self._jit_eval, self._jit_pred = jt, je, jp
             self.train_summary, self.validation_summary = ts, vs
             self._opt_state = opt
             self.params = params
+
+    def save(self, path: str):
+        with open(path, "wb") as f:
+            f.write(self.to_bytes())
         return path
 
     @staticmethod

@@ -63,7 +63,11 @@ class Dense(Layer):
         return params
 
     def call(self, params, inputs, *, training=False, rng=None):
-        y = jnp.matmul(inputs, params["W"])
+        if "W_q" in params:  # int8 weights (InferenceModel.quantize path)
+            from zoo_tpu.ops.pallas.quant import quantized_dense
+            y = quantized_dense(inputs, params["W_q"], params["W_scale"])
+        else:
+            y = jnp.matmul(inputs, params["W"])
         if self.bias:
             y = y + params["b"]
         return self.activation(y) if self.activation else y

@@ -45,10 +45,46 @@ class InferenceModel:
                               batch_size=batch_size)  # warm compile
         return self
 
-    def load(self, path: str, batch_size: Optional[int] = None):
-        """Load a full serialized zoo model (reference: ``doLoadBigDL``)."""
+    def load(self, path: str, batch_size: Optional[int] = None,
+             quantize: bool = False):
+        """Load a full serialized zoo model (reference: ``doLoadBigDL``;
+        ``quantize=True`` is the int8 path, reference
+        ``doLoadOpenVINOInt8`` ``InferenceModel.scala:283``)."""
         from zoo_tpu.pipeline.api.keras.engine.topology import KerasNet
-        return self.load_keras(KerasNet.load(path), batch_size=batch_size)
+        model = KerasNet.load(path)
+        if quantize:
+            model = quantize_model(model)
+        return self.load_keras(model, batch_size=batch_size)
+
+    def load_caffe(self, def_path: Optional[str], model_path: str,
+                   batch_size: Optional[int] = None):
+        """reference: ``doLoadCaffe`` — Caffe deploy net + weights."""
+        from zoo_tpu.models.caffe_loader import load_caffe
+        return self.load_keras(load_caffe(def_path, model_path),
+                               batch_size=batch_size)
+
+    def load_onnx(self, path_or_bytes, batch_size: Optional[int] = None):
+        """ONNX graph as an inference holder (reference ONNX loader)."""
+        from zoo_tpu.pipeline.api.onnx.onnx_loader import load_onnx
+        return self.load_keras(load_onnx(path_or_bytes),
+                               batch_size=batch_size)
+
+    def load_encrypted(self, path: str, secret: str, salt: str,
+                       key_len: int = 128, mode: str = "cbc",
+                       batch_size: Optional[int] = None,
+                       quantize: bool = False):
+        """Load an encrypted-at-rest zoo model (reference:
+        ``doLoadEncrypted*`` via ``EncryptSupportive.scala:27``). The file
+        is decrypted in memory only — plaintext never touches disk."""
+        import cloudpickle
+
+        from zoo_tpu.ppml.crypto import EncryptSupportive
+        blob = EncryptSupportive.decrypt_file(path, secret, salt,
+                                              key_len=key_len, mode=mode)
+        model = cloudpickle.loads(blob)
+        if quantize:
+            model = quantize_model(model)
+        return self.load_keras(model, batch_size=batch_size)
 
     def load_tf(self, model_or_path, batch_size: Optional[int] = None,
                 example_inputs=None, signature: str = "serving_default"):
@@ -106,3 +142,46 @@ class InferenceModel:
     @property
     def model(self):
         return self._model
+
+
+def save_encrypted(model, path: str, secret: str, salt: str,
+                   key_len: int = 128, mode: str = "cbc"):
+    """Serialize a zoo model encrypted at rest (counterpart of
+    ``InferenceModel.load_encrypted``; reference ``EncryptSupportive``).
+    Serialization happens in memory — plaintext never touches disk."""
+    from zoo_tpu.ppml.crypto import EncryptSupportive
+    enc = (EncryptSupportive.encrypt_bytes_with_aes_cbc if mode == "cbc"
+           else EncryptSupportive.encrypt_bytes_with_aes_gcm)
+    with open(path, "wb") as f:
+        f.write(enc(model.to_bytes(), secret, salt, key_len))
+    return path
+
+
+def quantize_model(model):
+    """Post-training int8 quantization of every Dense weight matrix
+    (per-output-channel symmetric); the forward then runs the Pallas
+    int8 MXU matmul (``ops/pallas/quant.py``). TPU equivalent of the
+    reference's OpenVINO int8 IR path (``doLoadOpenVINOInt8``) and the
+    VNNI int8 story (``wp-bigdl.md:192-196``)."""
+    from zoo_tpu.ops.pallas.quant import quantize_int8
+    from zoo_tpu.pipeline.api.keras.layers.core import Dense
+
+    if model.params is None:
+        raise ValueError("model must be built before quantization")
+    dense_keys = {model._key_of(l) for l in model.layers
+                  if isinstance(l, Dense)}
+
+    def walk(tree):
+        for key, val in list(tree.items()):
+            if isinstance(val, dict):
+                if key in dense_keys and "W" in val:
+                    w = val.pop("W")
+                    w_q, w_scale = quantize_int8(w, axis=0)
+                    val["W_q"], val["W_scale"] = w_q, w_scale
+                else:
+                    walk(val)
+
+    walk(model.params)
+    model._jit_pred = model._jit_eval = model._jit_train = None
+    model._quantized = True  # inference-only: fit() refuses cleanly
+    return model

@@ -1,0 +1,15 @@
+"""PPML — privacy-preserving ML building blocks.
+
+Rebuild of the reference's ``ppml/`` (SGX-trusted Spark/BigDL and trusted
+Cluster Serving via Graphene/Occlum enclaves). TPU has no SGX; the
+equivalent trust story is documented in ``zoo_tpu/ppml/README.md``
+(Confidential-VM hosts + encrypted-at-rest artifacts + TLS in transit).
+What is code here is the part that carries over 1:1: AES model/file
+encryption (:class:`EncryptSupportive`, wire-compatible with the
+reference's ``EncryptSupportive.scala``) used by
+``InferenceModel.load_encrypted`` and ``save_encrypted``.
+"""
+
+from zoo_tpu.ppml.crypto import EncryptSupportive  # noqa: F401
+
+__all__ = ["EncryptSupportive"]

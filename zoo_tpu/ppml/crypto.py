@@ -1,0 +1,188 @@
+"""Encrypt-at-rest support (PPML building block).
+
+Rebuild of the reference's ``EncryptSupportive``
+(``zoo/src/main/scala/com/intel/analytics/zoo/pipeline/inference/EncryptSupportive.scala:27``):
+AES-CBC/PKCS5 and AES-GCM with a PBKDF2-HMAC-SHA256 key (65536
+iterations), IV prepended to the ciphertext, Base64 for the string APIs —
+wire-compatible with artifacts produced by the reference (same KDF, same
+framing). Key derivation uses stdlib ``hashlib.pbkdf2_hmac``; the AES
+primitives are the platform's native OpenSSL ``libcrypto`` driven through
+``ctypes`` EVP calls (this environment has no Python AES package, and the
+reference's crypto is likewise the JVM's native provider).
+"""
+
+from __future__ import annotations
+
+import base64
+import ctypes
+import ctypes.util
+import hashlib
+import os
+from typing import Optional
+
+_ITERATIONS = 65536
+_CBC_IV_LEN = 16
+_GCM_IV_LEN = 12
+_GCM_TAG_LEN = 16
+# EVP_CIPHER_CTX_ctrl codes (openssl/evp.h)
+_EVP_CTRL_GCM_SET_TAG = 0x11
+_EVP_CTRL_GCM_GET_TAG = 0x10
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _crypto() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        name = ctypes.util.find_library("crypto")
+        for candidate in ([name] if name else []) + [
+                "libcrypto.so.3", "libcrypto.so.1.1", "libcrypto.so"]:
+            try:
+                lib = ctypes.CDLL(candidate)
+                lib.EVP_CIPHER_CTX_new.restype = ctypes.c_void_p
+                for fn in ("EVP_aes_128_cbc", "EVP_aes_256_cbc",
+                           "EVP_aes_128_gcm", "EVP_aes_256_gcm"):
+                    getattr(lib, fn).restype = ctypes.c_void_p
+                _lib = lib
+                break
+            except OSError:
+                continue
+        if _lib is None:
+            raise RuntimeError(
+                "OpenSSL libcrypto not found; encrypted-model support "
+                "requires the system OpenSSL library")
+    return _lib
+
+
+def _derive_key(secret: str, salt: str, key_len_bits: int) -> bytes:
+    return hashlib.pbkdf2_hmac("sha256", secret.encode(), salt.encode(),
+                               _ITERATIONS, dklen=key_len_bits // 8)
+
+
+def _evp(mode: str, encrypt: bool, key: bytes, iv: bytes, data: bytes,
+         tag: Optional[bytes] = None) -> bytes:
+    lib = _crypto()
+    cipher_name = f"EVP_aes_{len(key) * 8}_{mode}"
+    cipher = getattr(lib, cipher_name)()
+    ctx = lib.EVP_CIPHER_CTX_new()
+    if not ctx:
+        raise RuntimeError("EVP_CIPHER_CTX_new failed")
+    try:
+        init = lib.EVP_EncryptInit_ex if encrypt else lib.EVP_DecryptInit_ex
+        update = (lib.EVP_EncryptUpdate if encrypt
+                  else lib.EVP_DecryptUpdate)
+        final = (lib.EVP_EncryptFinal_ex if encrypt
+                 else lib.EVP_DecryptFinal_ex)
+        if init(ctypes.c_void_p(ctx), ctypes.c_void_p(cipher), None,
+                key, iv) != 1:
+            raise RuntimeError(f"{cipher_name} init failed")
+        out = ctypes.create_string_buffer(len(data) + 32)
+        outl = ctypes.c_int(0)
+        if update(ctypes.c_void_p(ctx), out, ctypes.byref(outl), data,
+                  len(data)) != 1:
+            raise RuntimeError(f"{cipher_name} update failed")
+        total = outl.value
+        if mode == "gcm" and not encrypt:
+            if tag is None:
+                raise ValueError("GCM decrypt requires the auth tag")
+            if lib.EVP_CIPHER_CTX_ctrl(
+                    ctypes.c_void_p(ctx), _EVP_CTRL_GCM_SET_TAG,
+                    len(tag), tag) != 1:
+                raise RuntimeError("setting GCM tag failed")
+        fin = ctypes.create_string_buffer(32)
+        finl = ctypes.c_int(0)
+        if final(ctypes.c_void_p(ctx), fin, ctypes.byref(finl)) != 1:
+            raise ValueError(
+                "decryption failed (wrong secret/salt or corrupted "
+                "ciphertext)" if not encrypt else "encryption failed")
+        result = out.raw[:total] + fin.raw[:finl.value]
+        if mode == "gcm" and encrypt:
+            gtag = ctypes.create_string_buffer(_GCM_TAG_LEN)
+            if lib.EVP_CIPHER_CTX_ctrl(
+                    ctypes.c_void_p(ctx), _EVP_CTRL_GCM_GET_TAG,
+                    _GCM_TAG_LEN, gtag) != 1:
+                raise RuntimeError("getting GCM tag failed")
+            result += gtag.raw
+        return result
+    finally:
+        lib.EVP_CIPHER_CTX_free(ctypes.c_void_p(ctx))
+
+
+class EncryptSupportive:
+    """AES-CBC / AES-GCM helpers, reference-compatible framing."""
+
+    # -- CBC (reference encryptWithAESCBC:37 / decryptWithAESCBC:62) ------
+    @staticmethod
+    def encrypt_bytes_with_aes_cbc(content: bytes, secret: str, salt: str,
+                                   key_len: int = 128) -> bytes:
+        key = _derive_key(secret, salt, key_len)
+        iv = os.urandom(_CBC_IV_LEN)
+        return iv + _evp("cbc", True, key, iv, content)
+
+    @staticmethod
+    def decrypt_bytes_with_aes_cbc(content: bytes, secret: str, salt: str,
+                                   key_len: int = 128) -> bytes:
+        key = _derive_key(secret, salt, key_len)
+        iv, body = content[:_CBC_IV_LEN], content[_CBC_IV_LEN:]
+        return _evp("cbc", False, key, iv, body)
+
+    @classmethod
+    def encrypt_with_aes_cbc(cls, content: str, secret: str, salt: str,
+                             key_len: int = 128) -> str:
+        return base64.b64encode(cls.encrypt_bytes_with_aes_cbc(
+            content.encode(), secret, salt, key_len)).decode()
+
+    @classmethod
+    def decrypt_with_aes_cbc(cls, content: str, secret: str, salt: str,
+                             key_len: int = 128) -> str:
+        return cls.decrypt_bytes_with_aes_cbc(
+            base64.b64decode(content), secret, salt, key_len).decode()
+
+    # -- GCM (reference encryptBytesWithAESGCM:100; IV=12, tag=16) --------
+    @staticmethod
+    def encrypt_bytes_with_aes_gcm(content: bytes, secret: str, salt: str,
+                                   key_len: int = 128) -> bytes:
+        key = _derive_key(secret, salt, key_len)
+        iv = os.urandom(_GCM_IV_LEN)
+        return iv + _evp("gcm", True, key, iv, content)
+
+    @staticmethod
+    def decrypt_bytes_with_aes_gcm(content: bytes, secret: str, salt: str,
+                                   key_len: int = 128) -> bytes:
+        key = _derive_key(secret, salt, key_len)
+        iv = content[:_GCM_IV_LEN]
+        body = content[_GCM_IV_LEN:-_GCM_TAG_LEN]
+        tag = content[-_GCM_TAG_LEN:]
+        return _evp("gcm", False, key, iv, body, tag=tag)
+
+    @classmethod
+    def encrypt_with_aes_gcm(cls, content: str, secret: str, salt: str,
+                             key_len: int = 128) -> str:
+        return base64.b64encode(cls.encrypt_bytes_with_aes_gcm(
+            content.encode(), secret, salt, key_len)).decode()
+
+    @classmethod
+    def decrypt_with_aes_gcm(cls, content: str, secret: str, salt: str,
+                             key_len: int = 128) -> str:
+        return cls.decrypt_bytes_with_aes_gcm(
+            base64.b64decode(content), secret, salt, key_len).decode()
+
+    # -- files (reference encryptFileWithAESCBC area) ---------------------
+    @classmethod
+    def encrypt_file(cls, in_path: str, out_path: str, secret: str,
+                     salt: str, key_len: int = 128, mode: str = "cbc"):
+        with open(in_path, "rb") as f:
+            data = f.read()
+        enc = (cls.encrypt_bytes_with_aes_cbc if mode == "cbc"
+               else cls.encrypt_bytes_with_aes_gcm)
+        with open(out_path, "wb") as f:
+            f.write(enc(data, secret, salt, key_len))
+
+    @classmethod
+    def decrypt_file(cls, in_path: str, secret: str, salt: str,
+                     key_len: int = 128, mode: str = "cbc") -> bytes:
+        with open(in_path, "rb") as f:
+            data = f.read()
+        dec = (cls.decrypt_bytes_with_aes_cbc if mode == "cbc"
+               else cls.decrypt_bytes_with_aes_gcm)
+        return dec(data, secret, salt, key_len)

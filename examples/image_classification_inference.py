@@ -1,0 +1,59 @@
+"""Image-classification inference (reference:
+``pyzoo/zoo/examples/imageclassification/predict.py``): build (or load) a
+zoo classifier, run it over an ImageSet with the family's preprocessing
+config, optionally int8-quantized (the reference's OpenVINO int8 path →
+Pallas int8 MXU matmul here), and print top-k labels.
+
+Run: python examples/image_classification_inference.py \
+         [--model squeezenet] [--quantize] [--image-dir DIR]
+"""
+
+import argparse
+import os
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="squeezenet")
+    ap.add_argument("--image-dir", default=None,
+                    help="directory of images; synthetic if omitted")
+    ap.add_argument("--class-num", type=int, default=10)
+    ap.add_argument("--quantize", action="store_true")
+    ap.add_argument("--top-k", type=int, default=3)
+    args = ap.parse_args()
+
+    from zoo_tpu.orca import init_orca_context, stop_orca_context
+    from zoo_tpu.feature.image import ImageFeature, ImageSet
+    from zoo_tpu.models.image import ImageClassifier
+    from zoo_tpu.pipeline.inference.inference_model import quantize_model
+
+    init_orca_context(cluster_mode="local")
+    label_map = {i: f"class_{i}" for i in range(args.class_num)}
+    clf = ImageClassifier.create(args.model, class_num=args.class_num,
+                                 label_map=label_map)
+    if args.quantize:
+        clf.model.build()
+        quantize_model(clf.model)
+
+    if args.image_dir and os.path.isdir(args.image_dir):
+        image_set = ImageSet.read(args.image_dir)
+    else:
+        rs = np.random.RandomState(0)
+        image_set = ImageSet([
+            ImageFeature(image=(rs.rand(280, 320, 3) * 255)
+                         .astype(np.uint8), uri=f"synthetic_{i}.jpg")
+            for i in range(6)])
+
+    out = clf.predict_image_set(image_set, top_k=args.top_k)
+    for f in out.features:
+        pairs = ", ".join(f"{c}:{p:.3f}"
+                          for c, p in zip(f["classes"], f["probs"]))
+        print(f"{f.get('uri', '?'):22} -> {pairs}")
+    stop_orca_context()
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,132 @@
+"""Training-loop tracing and per-phase step timers.
+
+Rebuild of the reference's tracing/profiling story (SURVEY §5.1): the
+serving stack's per-stage ``Timer`` (``serving/engine/Timer.scala:22-60``)
+and the BigDL DistriOptimizer's per-iteration wall-clock logging. On TPU
+the deep half of the story is XLA's own profiler: :func:`trace` wraps
+``jax.profiler.trace`` so a fit/predict window produces a
+TensorBoard-viewable XPlane trace (op-level HLO timing, HBM usage), which
+the reference has no equivalent of.
+
+``StepProfiler`` is the host-side half: named-phase wall-clock stats
+(data-wait vs device-step vs eval) with per-epoch reset, pushed as
+scalars into the model's ``TrainSummary`` so profiles land next to Loss/
+Throughput in TensorBoard. Enabling it makes the train loop synchronize
+on every step (``block_until_ready``) — that is the point (accurate step
+times), but it costs dispatch overlap, so it is opt-in via
+``model.set_profile(...)``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Iterator, Optional
+
+
+class PhaseTimer:
+    """Running stats for one named phase (reference ``Timer.scala``)."""
+
+    __slots__ = ("n", "total", "max", "min")
+
+    def __init__(self):
+        self.n = 0
+        self.total = 0.0
+        self.max = 0.0
+        self.min = float("inf")
+
+    def record(self, dt: float):
+        self.n += 1
+        self.total += dt
+        self.max = max(self.max, dt)
+        self.min = min(self.min, dt)
+
+    def stats(self) -> Dict[str, float]:
+        return {"count": self.n,
+                "avg_ms": 1000 * self.total / max(self.n, 1),
+                "max_ms": 1000 * self.max,
+                "min_ms": 0.0 if self.n == 0 else 1000 * self.min}
+
+
+class StepProfiler:
+    """Named-phase wall-clock profiler for the training loop.
+
+    Phases used by ``KerasNet.fit``: ``data`` (host wait on the staged
+    input pipeline), ``reshard`` (device-side sub-batch re-placement on
+    the superbatch path), ``step`` (jitted train step; synced when
+    ``sync=True``), ``eval`` (validation pass, when validation_data is
+    given). Arbitrary extra phases are fine. ``sync=False`` skips the
+    per-step ``block_until_ready`` — cheaper, but ``step`` then measures
+    dispatch, not device time.
+    """
+
+    def __init__(self, trace_dir: Optional[str] = None,
+                 trace_epochs: int = 1, sync: bool = True):
+        self.timers: Dict[str, PhaseTimer] = {}       # current epoch
+        self.cumulative: Dict[str, PhaseTimer] = {}   # whole run
+        self.trace_dir = trace_dir
+        self.trace_epochs = int(trace_epochs)
+        self.sync = sync
+        self._epoch = 0
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(name, time.perf_counter() - t0)
+
+    def record(self, name: str, dt: float):
+        self.timers.setdefault(name, PhaseTimer()).record(dt)
+        self.cumulative.setdefault(name, PhaseTimer()).record(dt)
+
+    def timed_iter(self, it: Iterator, name: str = "data") -> Iterator:
+        """Yield from ``it`` recording the host wait per item."""
+        while True:
+            t0 = time.perf_counter()
+            try:
+                item = next(it)
+            except StopIteration:
+                return
+            self.record(name, time.perf_counter() - t0)
+            yield item
+
+    @contextlib.contextmanager
+    def epoch_trace(self):
+        """XLA profiler capture for the first ``trace_epochs`` epochs when
+        ``trace_dir`` is set; no-op afterwards (traces are large). Also
+        resets the per-epoch timers so an aborted previous epoch cannot
+        leak partial timings into this one."""
+        self.timers = {}
+        self._epoch += 1
+        if self.trace_dir and self._epoch <= self.trace_epochs:
+            import jax
+
+            with jax.profiler.trace(self.trace_dir):
+                yield
+        else:
+            yield
+
+    def stats(self) -> Dict[str, Dict[str, float]]:
+        """Whole-run per-phase stats (survives epoch resets)."""
+        return {name: t.stats() for name, t in self.cumulative.items()}
+
+    def epoch_scalars(self) -> Dict[str, float]:
+        """avg-ms per phase for the epoch, then reset the epoch counters
+        (cumulative stats keep accruing for :meth:`stats`)."""
+        out = {f"{name.capitalize()}TimeMs": t.stats()["avg_ms"]
+               for name, t in self.timers.items()}
+        self.timers = {}
+        return out
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Standalone XLA profiler window (``jax.profiler.trace``): wrap any
+    region — a predict burst, a serving soak — and open the resulting
+    ``plugins/profile`` in TensorBoard."""
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield

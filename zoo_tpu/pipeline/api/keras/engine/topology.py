@@ -14,6 +14,7 @@ update are fused and scheduled by XLA; weights never leave the device.
 
 from __future__ import annotations
 
+import contextlib
 import pickle
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
@@ -195,6 +196,27 @@ class KerasNet:
         """reference: ``Topology.scala:162-168``."""
         self.train_summary = TrainSummary(log_dir, app_name + "/train")
         self.validation_summary = TrainSummary(log_dir, app_name + "/val")
+
+    def set_profile(self, trace_dir: Optional[str] = None,
+                    trace_epochs: int = 1):
+        """Enable per-phase step timers for the next ``fit`` (data-wait /
+        device-step avg-ms scalars into the train summary) and, when
+        ``trace_dir`` is given, an XLA profiler capture of the first
+        ``trace_epochs`` epochs (rebuild of SURVEY §5.1; per-stage
+        ``Timer.scala`` + net-new ``jax.profiler`` depth). Forces a
+        device sync per step while enabled (accurate step times at the
+        cost of dispatch overlap); ``clear_profile()`` turns it off."""
+        from zoo_tpu.common.profiling import StepProfiler
+        self._profiler = StepProfiler(trace_dir=trace_dir,
+                                      trace_epochs=trace_epochs)
+        return self._profiler
+
+    def clear_profile(self):
+        self._profiler = None
+
+    def get_profile_stats(self):
+        prof = getattr(self, "_profiler", None)
+        return prof.stats() if prof else {}
 
     def get_train_summary(self, tag: str = "Loss"):
         return self.train_summary.read_scalar(tag)
@@ -395,6 +417,7 @@ class KerasNet:
             # a staged multi-host global array cannot be host-sliced into
             # sub-batches; assemble exactly one global batch per put
             group = 1
+        prof = getattr(self, "_profiler", None)
         for epoch in range(nb_epoch):
             t0 = time.time()
             loss_sum, n_steps = None, 0
@@ -404,28 +427,45 @@ class KerasNet:
                 stage_fn=lambda idx: self._put_batch(
                     [a[idx] for a in arrs]))
             try:
-                for staged in batches:
-                    n_sub = (staged[0].shape[0] // local_bs if group > 1
-                             else 1)
-                    for j in range(n_sub):
-                        if group > 1:
-                            # re-place the sub-slice so a multi-device mesh
-                            # keeps the guaranteed batch sharding (device-
-                            # to-device; a no-op on one chip)
-                            sub = self._put_batch(
-                                [t[j * local_bs:(j + 1) * local_bs]
-                                 for t in staged])
-                        else:
-                            sub = staged
-                        params, opt_state, rng, loss = self._jit_train(
-                            params, opt_state, rng, *sub)
-                        self._step += 1
-                        n_steps += 1
-                        # running device-side sum: one host transfer per
-                        # epoch (a per-step sync pays a full round trip —
-                        # ~100ms over a tunneled PJRT transport)
-                        loss_sum = loss if loss_sum is None \
-                            else loss_sum + loss
+                with (prof.epoch_trace() if prof
+                      else contextlib.nullcontext()):
+                    source = (prof.timed_iter(iter(batches), "data")
+                              if prof else batches)
+                    for staged in source:
+                        n_sub = (staged[0].shape[0] // local_bs
+                                 if group > 1 else 1)
+                        for j in range(n_sub):
+                            if group > 1:
+                                # re-place the sub-slice so a multi-device
+                                # mesh keeps the guaranteed batch sharding
+                                # (device-to-device; a no-op on one chip)
+                                with (prof.phase("reshard") if prof
+                                      else contextlib.nullcontext()):
+                                    sub = self._put_batch(
+                                        [t[j * local_bs:(j + 1) * local_bs]
+                                         for t in staged])
+                            else:
+                                sub = staged
+                            if prof:
+                                with prof.phase("step"):
+                                    params, opt_state, rng, loss = \
+                                        self._jit_train(params, opt_state,
+                                                        rng, *sub)
+                                    if prof.sync:
+                                        # sync so the phase measures the
+                                        # real device step, not dispatch
+                                        jax.block_until_ready(loss)
+                            else:
+                                params, opt_state, rng, loss = \
+                                    self._jit_train(params, opt_state,
+                                                    rng, *sub)
+                            self._step += 1
+                            n_steps += 1
+                            # running device-side sum: one host transfer
+                            # per epoch (a per-step sync pays a full round
+                            # trip — ~100ms over a tunneled PJRT transport)
+                            loss_sum = loss if loss_sum is None \
+                                else loss_sum + loss
             finally:
                 batches.close()
             epoch_loss = float(np.asarray(loss_sum)) / max(n_steps, 1)
@@ -438,10 +478,15 @@ class KerasNet:
             if val_arrays is not None:
                 vx, vy = val_arrays
                 self.params = params  # evaluate on current params
-                val = self._evaluate_arrays(vx, vy, batch_size)
+                with (prof.phase("eval") if prof
+                      else contextlib.nullcontext()):
+                    val = self._evaluate_arrays(vx, vy, batch_size)
                 for k, v in val.items():
                     history.setdefault("val_" + k, []).append(v)
                     self.validation_summary.add_scalar(k, v, self._step)
+            if prof:
+                for tag, val_ms in prof.epoch_scalars().items():
+                    self.train_summary.add_scalar(tag, val_ms, self._step)
             plateau = getattr(self.optimizer, "plateau", None)
             if plateau is not None:
                 mon = plateau.monitor
@@ -611,10 +656,12 @@ class KerasNet:
         jt, je, jp = self._jit_train, self._jit_eval, self._jit_pred
         ts, vs, opt = self.train_summary, self.validation_summary, \
             self._opt_state
+        prof = getattr(self, "_profiler", None)
         params = self.params
         try:
             self._jit_train = self._jit_eval = self._jit_pred = None
             self._opt_state = None
+            self._profiler = None
             self.train_summary = TrainSummary()
             self.validation_summary = TrainSummary()
             if params is not None:
@@ -624,6 +671,7 @@ class KerasNet:
             self._jit_train, self._jit_eval, self._jit_pred = jt, je, jp
             self.train_summary, self.validation_summary = ts, vs
             self._opt_state = opt
+            self._profiler = prof
             self.params = params
 
     def save(self, path: str):

@@ -1,0 +1,265 @@
+"""Worker-process bootstrap and supervision.
+
+Rebuild of the reference's RayOnSpark machinery
+(``pyzoo/zoo/ray/raycontext.py:323`` ``RayContext._start_cluster``,
+``gen_ray_start``:271 with its barrier-mode start; ``ProcessMonitor``
+``pyzoo/zoo/ray/process.py:90``; ``JVMGuard``:33 which registers the
+raylet pids so the JVM kills orphans). There the cluster fabric to boot
+was Ray-on-Spark-executors; on TPU the fabric is the JAX distributed
+runtime — one Python worker process per host — so what carries over is
+the *supervision* capability:
+
+* :class:`ProcessMonitor` — spawn N workers, watch them, restart on crash
+  (bounded), tear the whole group down when any worker fails fatally or
+  the parent exits. The JVMGuard orphan-kill maps to ``PR_SET_PDEATHSIG``
+  (children get SIGKILLed by the kernel if the supervisor dies) plus
+  process-group kills.
+* :func:`launch_local_cluster` — the reference's ``local`` RayContext:
+  boot an N-process JAX CPU cluster on one machine (coordinator on a free
+  localhost port, ranks via ``ZOO_*`` env) for dev/test of multi-host
+  code paths.
+* CLI: ``python -m zoo_tpu.orca.bootstrap --nproc 4 train.py ...`` —
+  supervised multi-process launch, the torchrun/spark-submit analogue
+  (on a real pod, ``scripts/run_tpu_pod.sh`` runs one of these per host).
+
+``init_orca_context(cluster_mode="tpu")`` picks the rank/coordinator up
+from the ``ZOO_COORDINATOR_ADDRESS`` / ``ZOO_NUM_PROCESSES`` /
+``ZOO_PROCESS_ID`` environment this module sets.
+"""
+
+from __future__ import annotations
+
+import atexit
+import ctypes
+import logging
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+_PR_SET_PDEATHSIG = 1
+
+
+def _child_preexec():
+    """Run in the child between fork and exec: new session (own process
+    group for clean group-kill) and kernel-level orphan protection."""
+    os.setsid()
+    try:
+        libc = ctypes.CDLL(None, use_errno=True)
+        libc.prctl(_PR_SET_PDEATHSIG, signal.SIGKILL)
+    except Exception:
+        pass  # non-Linux: atexit kill still covers the common case
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class WorkerProcess:
+    """One supervised worker (reference: a ray start subprocess tracked by
+    ``ProcessInfo``)."""
+
+    def __init__(self, cmd: Sequence[str], env: Dict[str, str],
+                 name: str, log_dir: Optional[str] = None):
+        self.cmd = list(cmd)
+        self.env = dict(env)
+        self.name = name
+        self.log_dir = log_dir
+        self.proc: Optional[subprocess.Popen] = None
+        self.restarts = 0
+        self._log_fh = None
+
+    def spawn(self):
+        if self._log_fh:  # restart: release the previous run's handle
+            self._log_fh.close()
+            self._log_fh = None
+        if self.log_dir:
+            os.makedirs(self.log_dir, exist_ok=True)
+            self._log_fh = open(
+                os.path.join(self.log_dir, f"{self.name}.log"), "ab")
+            out = err = self._log_fh
+        else:
+            out = err = None
+        self.proc = subprocess.Popen(
+            self.cmd, env=self.env, stdout=out, stderr=err,
+            preexec_fn=_child_preexec)
+        return self.proc
+
+    @property
+    def returncode(self) -> Optional[int]:
+        return self.proc.poll() if self.proc else None
+
+    def kill(self):
+        if self.proc is not None and self.proc.poll() is None:
+            try:  # group-kill: the worker may have forked its own helpers
+                os.killpg(self.proc.pid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+            try:
+                self.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(self.proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                self.proc.wait()
+        if self._log_fh:  # close even for self-exited workers
+            self._log_fh.close()
+            self._log_fh = None
+
+
+class ProcessMonitor:
+    """Spawn + supervise a set of workers (reference ``ProcessMonitor``
+    ``ray/process.py:90``: tracks pids, raises when a member dies, cleans
+    the rest up).
+
+    ``max_restarts``: per-worker crash budget. Within budget a crashed
+    worker is respawned; past it the whole group is torn down and
+    :meth:`wait` raises. Exit code 0 counts as completion, not a crash.
+    """
+
+    def __init__(self, workers: List[WorkerProcess], max_restarts: int = 0,
+                 poll_interval: float = 0.2):
+        self.workers = workers
+        self.max_restarts = int(max_restarts)
+        self.poll_interval = poll_interval
+        self._failed: Optional[str] = None
+        self._stop = threading.Event()
+        self._lock = threading.Lock()  # serializes respawn vs teardown
+        self._thread: Optional[threading.Thread] = None
+        atexit.register(self.stop)
+
+    def start(self) -> "ProcessMonitor":
+        for w in self.workers:
+            w.spawn()
+            logger.info("spawned %s (pid %d)", w.name, w.proc.pid)
+        self._thread = threading.Thread(target=self._watch, daemon=True,
+                                        name="zoo-process-monitor")
+        self._thread.start()
+        return self
+
+    def _watch(self):
+        while not self._stop.is_set():
+            for w in self.workers:
+                rc = w.returncode
+                if rc is None or rc == 0:
+                    continue
+                if w.restarts < self.max_restarts:
+                    with self._lock:
+                        if self._stop.is_set():
+                            return  # teardown won the race: no respawn
+                        w.restarts += 1
+                        logger.warning(
+                            "%s exited rc=%d; restart %d/%d", w.name, rc,
+                            w.restarts, self.max_restarts)
+                        w.spawn()
+                else:
+                    self._failed = (f"{w.name} exited rc={rc} with no "
+                                    f"restart budget left "
+                                    f"({w.restarts}/{self.max_restarts})")
+                    logger.error("%s — tearing the group down",
+                                 self._failed)
+                    self._stop.set()
+                    for other in self.workers:
+                        other.kill()
+                    return
+            if all(w.returncode == 0 for w in self.workers):
+                self._stop.set()
+                return
+            time.sleep(self.poll_interval)
+
+    def wait(self, timeout: Optional[float] = None):
+        """Block until every worker exits 0; raise on fatal failure."""
+        deadline = time.time() + timeout if timeout is not None else None
+        while True:
+            if self._failed:
+                raise RuntimeError(self._failed)
+            if all(w.returncode == 0 for w in self.workers):
+                return
+            if deadline is not None and time.time() > deadline:
+                self.stop()
+                raise TimeoutError(
+                    f"workers still running after {timeout}s")
+            time.sleep(self.poll_interval)
+
+    def alive(self) -> List[str]:
+        return [w.name for w in self.workers if w.returncode is None]
+
+    def stop(self):
+        with self._lock:  # no respawn may interleave with the kills
+            self._stop.set()
+            for w in self.workers:
+                w.kill()
+
+
+def launch_local_cluster(nproc: int, script: str,
+                         args: Sequence[str] = (),
+                         local_devices_per_proc: int = 1,
+                         max_restarts: int = 0,
+                         log_dir: Optional[str] = None,
+                         env: Optional[Dict[str, str]] = None
+                         ) -> ProcessMonitor:
+    """Boot an ``nproc``-process JAX CPU cluster running ``script`` on
+    this machine (the reference's local RayContext). Each worker gets
+    ``ZOO_COORDINATOR_ADDRESS`` / ``ZOO_NUM_PROCESSES`` /
+    ``ZOO_PROCESS_ID`` plus a forced-CPU JAX platform with
+    ``local_devices_per_proc`` virtual devices, so
+    ``init_orca_context(cluster_mode="tpu")`` forms the same process mesh
+    it would on a pod."""
+    coord = f"127.0.0.1:{free_port()}"
+    workers = []
+    for pid in range(nproc):
+        wenv = dict(os.environ)
+        wenv.update(env or {})
+        wenv.update({
+            "ZOO_COORDINATOR_ADDRESS": coord,
+            "ZOO_NUM_PROCESSES": str(nproc),
+            "ZOO_PROCESS_ID": str(pid),
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": (wenv.get("XLA_FLAGS", "") +
+                          " --xla_force_host_platform_device_count="
+                          f"{local_devices_per_proc}").strip(),
+        })
+        workers.append(WorkerProcess(
+            [sys.executable, script, *args], wenv, f"worker-{pid}",
+            log_dir=log_dir))
+    return ProcessMonitor(workers, max_restarts=max_restarts).start()
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m zoo_tpu.orca.bootstrap",
+        description="Supervised multi-process launcher (reference: "
+                    "RayContext/spark-submit role)")
+    ap.add_argument("--nproc", type=int, default=1)
+    ap.add_argument("--max-restarts", type=int, default=0)
+    ap.add_argument("--devices-per-proc", type=int, default=1)
+    ap.add_argument("--log-dir", default=None)
+    ap.add_argument("script")
+    ap.add_argument("args", nargs=argparse.REMAINDER)
+    ns = ap.parse_args(argv)
+    mon = launch_local_cluster(
+        ns.nproc, ns.script, ns.args,
+        local_devices_per_proc=ns.devices_per_proc,
+        max_restarts=ns.max_restarts, log_dir=ns.log_dir)
+    try:
+        mon.wait()
+        return 0
+    except (RuntimeError, KeyboardInterrupt) as e:
+        logger.error("%s", e)
+        mon.stop()
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

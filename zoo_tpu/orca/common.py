@@ -75,7 +75,14 @@ def _maybe_init_distributed(cluster_mode: str, num_nodes: int = 1):
         _DIST_INITIALIZED = True
         return
     try:
-        jax.distributed.initialize()
+        coord = os.environ.get("ZOO_COORDINATOR_ADDRESS")
+        if coord:  # rendezvous injected by zoo_tpu.orca.bootstrap
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=int(os.environ["ZOO_NUM_PROCESSES"]),
+                process_id=int(os.environ["ZOO_PROCESS_ID"]))
+        else:  # real pod: topology discovered from the TPU metadata
+            jax.distributed.initialize()
         _DIST_INITIALIZED = True
     except Exception as e:
         if num_nodes > 1:

@@ -1,0 +1,118 @@
+"""ElasticSearch I/O for XShards (reference:
+``pyzoo/zoo/orca/data/elastic_search.py:27`` — ``elastic_search.read_df``
+/ ``write_df`` / ``read_rdd`` over the es-hadoop Spark connector).
+
+The rebuild talks to ES over its plain REST API via the official
+``elasticsearch`` Python client (8.x calling conventions) when it is
+installed (this hermetic image does not ship it, so every entry point
+degrades to a clear ImportError-derived message rather than an attribute
+crash); results land in pandas DataFrames / :class:`LocalXShards`, the
+rebuild's data plane. Reads paginate with ``search_after`` so whole
+indices come back (the es-hadoop connector read everything too); writes
+use the bulk API.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import pandas as pd
+
+_PAGE = 1000
+
+
+def _client(es_config: dict):
+    try:
+        from elasticsearch import Elasticsearch
+    except ImportError as e:
+        raise ImportError(
+            "elastic_search I/O needs the `elasticsearch` package "
+            "(pip install elasticsearch); it is not bundled with zoo_tpu"
+        ) from e
+    default_port = int(es_config.get("es.port", 9200))
+    hosts = es_config.get("es.nodes", "localhost")
+    if isinstance(hosts, str):
+        hosts = [h.strip() for h in hosts.split(",")]
+    nodes = []
+    for h in hosts:  # es-hadoop allows bare hosts or host:port entries
+        if "://" in h:
+            host, port = h.split("://", 1)[1], default_port
+            if ":" in host:
+                host, port = host.rsplit(":", 1)
+            nodes.append({"host": host, "port": int(port),
+                          "scheme": h.split("://", 1)[0]})
+        else:
+            host, port = h, default_port
+            if ":" in h:
+                host, port = h.rsplit(":", 1)
+            nodes.append({"host": host, "port": int(port),
+                          "scheme": "http"})
+    kwargs = {}
+    user = es_config.get("es.net.http.auth.user")
+    if user:
+        kwargs["basic_auth"] = (user,
+                                es_config.get("es.net.http.auth.pass", ""))
+    return Elasticsearch(nodes, **kwargs)
+
+
+class elastic_search:  # noqa: N801 — reference spells the class this way
+    """Primitives for ES interaction (reference class of the same name)."""
+
+    @staticmethod
+    def read_df(es_config: dict, es_resource: str,
+                schema: Optional[list] = None,
+                query: Optional[dict] = None,
+                size: Optional[int] = None) -> pd.DataFrame:
+        """Read an index into a DataFrame (reference ``read_df:31``;
+        ``schema`` selects columns). Paginates with ``search_after`` so
+        indices larger than the ES result window come back whole;
+        ``size`` optionally caps the row count."""
+        es = _client(es_config)
+        rows, after = [], None
+        q = query or {"match_all": {}}
+        while True:
+            page = min(_PAGE, size - len(rows)) if size else _PAGE
+            if page <= 0:
+                break
+            resp = es.search(index=es_resource, query=q, size=page,
+                             sort=[{"_doc": "asc"}],
+                             search_after=after)
+            hits = resp["hits"]["hits"]
+            if not hits:
+                break
+            rows.extend(h["_source"] for h in hits)
+            after = hits[-1]["sort"]
+        df = pd.json_normalize(rows)  # reference flatten_df: dotted names
+        if schema:
+            df = df[[c for c in schema if c in df.columns]]
+        return df
+
+    @staticmethod
+    def write_df(es_config: dict, es_resource: str, df: pd.DataFrame,
+                 chunk_size: int = 1000):
+        """Write a DataFrame into an index via the bulk API (reference
+        ``write_df:76`` used the bulk-oriented es-hadoop connector)."""
+        es = _client(es_config)
+        records = df.to_dict(orient="records")
+        for start in range(0, len(records), chunk_size):
+            ops = []
+            for doc in records[start:start + chunk_size]:
+                ops.append({"index": {"_index": es_resource}})
+                ops.append(doc)
+            if ops:
+                resp = es.bulk(operations=ops)
+                if resp.get("errors"):
+                    bad = [i["index"] for i in resp["items"]
+                           if i.get("index", {}).get("error")][:3]
+                    raise RuntimeError(f"bulk index failures: {bad}")
+        es.indices.refresh(index=es_resource)
+
+    @staticmethod
+    def read_shards(es_config: dict, es_resource: str,
+                    query: Optional[dict] = None,
+                    num_shards: Optional[int] = None):
+        """Read an index into XShards of DataFrames (reference
+        ``read_rdd:94`` landed in an RDD; here LocalXShards)."""
+        from zoo_tpu.orca.data.shard import LocalXShards
+        df = elastic_search.read_df(es_config, es_resource, query=query)
+        return LocalXShards.partition(df, num_shards or 4)

@@ -125,3 +125,15 @@ def test_wait_timeout_zero_is_immediate(tmp_path):
     with pytest.raises(TimeoutError):
         mon.wait(timeout=0)
     assert w.returncode is not None  # torn down by the timeout path
+
+
+def test_deliberate_stop_is_not_failure(tmp_path):
+    hang = tmp_path / "hang3.py"
+    hang.write_text("import time; time.sleep(600)")
+    w = WorkerProcess([sys.executable, str(hang)], dict(os.environ), "h3")
+    mon = ProcessMonitor([w], max_restarts=0).start()
+    time.sleep(0.5)
+    mon.stop()
+    time.sleep(0.6)  # let the watcher observe the killed worker
+    assert mon._failed is None
+    mon.wait(timeout=5)  # returns: deliberate stop, not a crash

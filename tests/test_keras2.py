@@ -1,0 +1,97 @@
+"""Keras-2 façade: arg translation onto the keras-1 engine (reference:
+``pyzoo/zoo/pipeline/api/keras2``)."""
+
+import numpy as np
+import pytest
+
+from zoo_tpu.pipeline.api.keras2 import Input, Model, Sequential, layers as L
+
+
+def test_dense_mlp_trains():
+    m = Sequential(name="k2_mlp")
+    m.add(L.Dense(32, activation="relu", input_shape=(16,),
+                  kernel_initializer="glorot_uniform"))
+    m.add(L.Dropout(rate=0.1))
+    m.add(L.Dense(1, use_bias=False))
+    m.compile(optimizer="adam", loss="mse")
+    rs = np.random.RandomState(0)
+    x = rs.randn(64, 16).astype(np.float32)
+    y = x[:, :1]
+    h = m.fit(x, y, batch_size=16, nb_epoch=4, verbose=0)
+    assert h["loss"][-1] < h["loss"][0]
+    # use_bias=False really dropped the bias
+    last = [k for k in m.params if k.endswith("dense")][-1]
+    assert "b" not in m.params[last]
+
+
+def test_conv_pool_stack_shapes():
+    m = Sequential(name="k2_conv")
+    m.add(L.Conv2D(8, 3, padding="same", activation="relu",
+                   input_shape=(16, 16, 3)))
+    m.add(L.MaxPooling2D(pool_size=2))
+    m.add(L.Conv2D(4, (3, 3), strides=(2, 2), padding="same"))
+    m.add(L.GlobalAveragePooling2D())
+    m.add(L.Dense(5, activation="softmax"))
+    x = np.random.RandomState(1).rand(2, 16, 16, 3).astype(np.float32)
+    y = np.asarray(m.predict(x, batch_size=2))
+    assert y.shape == (2, 5)
+
+
+def test_conv1d_and_pooling1d():
+    m = Sequential()
+    m.add(L.Conv1D(6, 3, strides=1, padding="valid",
+                   input_shape=(10, 4)))
+    m.add(L.MaxPooling1D(pool_size=2))
+    m.add(L.GlobalMaxPooling1D())
+    x = np.random.RandomState(2).rand(3, 10, 4).astype(np.float32)
+    assert np.asarray(m.predict(x, batch_size=3)).shape == (3, 6)
+
+
+def test_embedding_lstm():
+    m = Sequential()
+    m.add(L.Embedding(50, 8, input_length=6))
+    m.add(L.LSTM(12, return_sequences=False))
+    m.add(L.Dense(2, activation="softmax"))
+    x = np.random.RandomState(3).randint(0, 50, (4, 6)).astype(np.int32)
+    assert np.asarray(m.predict(x, batch_size=4)).shape == (4, 2)
+
+
+def test_functional_merge_layers():
+    a = Input(shape=(8,), name="a")
+    b = Input(shape=(8,), name="b")
+    mx = L.Maximum()([a, b])
+    av = L.Average()([a, b])
+    cat = L.Concatenate(axis=-1)([mx, av])
+    out = L.Dense(3)(cat)
+    model = Model(input=[a, b], output=out)
+    xa = np.random.RandomState(4).randn(5, 8).astype(np.float32)
+    xb = np.random.RandomState(5).randn(5, 8).astype(np.float32)
+    y = np.asarray(model.predict([xa, xb], batch_size=5))
+    assert y.shape == (5, 3)
+
+
+def test_merge_semantics():
+    a = Input(shape=(4,))
+    b = Input(shape=(4,))
+    model = Model(input=[a, b], output=L.Minimum()([a, b]))
+    xa = np.array([[1., 5., 3., 0.]], np.float32)
+    xb = np.array([[2., 4., 3., -1.]], np.float32)
+    np.testing.assert_allclose(
+        np.asarray(model.predict([xa, xb], batch_size=1)),
+        np.minimum(xa, xb))
+
+
+def test_advanced_activation_and_bn():
+    m = Sequential()
+    m.add(L.Dense(8, input_shape=(4,)))
+    m.add(L.BatchNormalization())
+    m.add(L.LeakyReLU(alpha=0.2))
+    x = np.random.RandomState(6).randn(4, 4).astype(np.float32)
+    assert np.asarray(m.predict(x, batch_size=4)).shape == (4, 8)
+    with pytest.raises(ValueError, match="axis"):
+        L.BatchNormalization(axis=1)
+
+
+def test_unsupported_data_format_raises():
+    with pytest.raises(ValueError, match="unknown data_format"):
+        L.Conv2D(4, 3, data_format="weird")

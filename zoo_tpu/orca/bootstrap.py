@@ -162,14 +162,18 @@ class ProcessMonitor:
                             w.restarts, self.max_restarts)
                         w.spawn()
                 else:
-                    self._failed = (f"{w.name} exited rc={rc} with no "
-                                    f"restart budget left "
-                                    f"({w.restarts}/{self.max_restarts})")
-                    logger.error("%s — tearing the group down",
-                                 self._failed)
-                    self._stop.set()
-                    for other in self.workers:
-                        other.kill()
+                    with self._lock:
+                        if self._stop.is_set():
+                            return  # deliberate stop(), not a crash
+                        self._failed = (
+                            f"{w.name} exited rc={rc} with no restart "
+                            f"budget left "
+                            f"({w.restarts}/{self.max_restarts})")
+                        logger.error("%s — tearing the group down",
+                                     self._failed)
+                        self._stop.set()
+                        for other in self.workers:
+                            other.kill()
                     return
             if all(w.returncode == 0 for w in self.workers):
                 self._stop.set()
@@ -184,6 +188,8 @@ class ProcessMonitor:
                 raise RuntimeError(self._failed)
             if all(w.returncode == 0 for w in self.workers):
                 return
+            if self._stop.is_set():
+                return  # deliberate stop(): termination, not failure
             if deadline is not None and time.time() > deadline:
                 self.stop()
                 raise TimeoutError(

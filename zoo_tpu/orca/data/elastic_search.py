@@ -33,20 +33,25 @@ def _client(es_config: dict):
     hosts = es_config.get("es.nodes", "localhost")
     if isinstance(hosts, str):
         hosts = [h.strip() for h in hosts.split(",")]
+    def _split_host_port(h: str, default: int):
+        """host / host:port / [v6]:port / bare v6 — only strip a suffix
+        that is actually a numeric port."""
+        if h.startswith("["):  # [v6addr]:port or [v6addr]
+            addr, _, rest = h[1:].partition("]")
+            port = rest[1:] if rest.startswith(":") else ""
+            return addr, int(port) if port.isdigit() else default
+        head, _, tail = h.rpartition(":")
+        if head and tail.isdigit() and ":" not in head:
+            return head, int(tail)
+        return h, default  # bare host or bare IPv6 literal
+
     nodes = []
     for h in hosts:  # es-hadoop allows bare hosts or host:port entries
+        scheme = "http"
         if "://" in h:
-            host, port = h.split("://", 1)[1], default_port
-            if ":" in host:
-                host, port = host.rsplit(":", 1)
-            nodes.append({"host": host, "port": int(port),
-                          "scheme": h.split("://", 1)[0]})
-        else:
-            host, port = h, default_port
-            if ":" in h:
-                host, port = h.rsplit(":", 1)
-            nodes.append({"host": host, "port": int(port),
-                          "scheme": "http"})
+            scheme, h = h.split("://", 1)
+        host, port = _split_host_port(h, default_port)
+        nodes.append({"host": host, "port": port, "scheme": scheme})
     kwargs = {}
     user = es_config.get("es.net.http.auth.user")
     if user:
@@ -71,7 +76,8 @@ class elastic_search:  # noqa: N801 — reference spells the class this way
         rows, after = [], None
         q = query or {"match_all": {}}
         while True:
-            page = min(_PAGE, size - len(rows)) if size else _PAGE
+            page = (min(_PAGE, size - len(rows)) if size is not None
+                    else _PAGE)
             if page <= 0:
                 break
             resp = es.search(index=es_resource, query=q, size=page,

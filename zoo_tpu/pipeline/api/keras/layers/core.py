@@ -281,7 +281,7 @@ class BatchNormalization(Layer):
 
 class Merge(Layer):
     """Merge a list of inputs (reference: ``core.py`` ``Merge`` /
-    ``merge()``): modes concat / sum / mul / ave / max / dot / cos."""
+    ``merge()``): modes concat / sum / mul / ave / max / min / dot / cos."""
 
     def __init__(self, mode: str = "sum", concat_axis: int = -1, **kwargs):
         super().__init__(**kwargs)
@@ -305,6 +305,11 @@ class Merge(Layer):
             out = xs[0]
             for x in xs[1:]:
                 out = jnp.maximum(out, x)
+            return out
+        if self.mode == "min":  # keras2 Minimum (keras2/layers/merge.py:62)
+            out = xs[0]
+            for x in xs[1:]:
+                out = jnp.minimum(out, x)
             return out
         if self.mode == "dot":
             return jnp.sum(xs[0] * xs[1], axis=-1, keepdims=True)

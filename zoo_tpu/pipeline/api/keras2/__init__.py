@@ -1,0 +1,20 @@
+"""Keras-2-style API variant (reference: ``pyzoo/zoo/pipeline/api/keras2``).
+
+The reference ships a second layer namespace with Keras-2 argument
+conventions (``units``/``filters``/``kernel_size``/``strides``/
+``padding``/``use_bias``/``kernel_initializer``) alongside the Keras-1
+API. Here each keras2 symbol is a thin adapter that translates the
+Keras-2 argument names onto the corresponding Keras-1 layer from
+``zoo_tpu.pipeline.api.keras`` — one engine, two façades, exactly the
+reference's structure (its keras2 layers also compile to the same Scala
+modules underneath).
+"""
+
+from zoo_tpu.pipeline.api.keras.engine.topology import (  # noqa: F401
+    Input,
+    Model,
+    Sequential,
+)
+from zoo_tpu.pipeline.api.keras2 import layers  # noqa: F401
+
+__all__ = ["Input", "Model", "Sequential", "layers"]

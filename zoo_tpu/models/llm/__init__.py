@@ -1,0 +1,10 @@
+from zoo_tpu.models.llm.llama import (  # noqa: F401
+    Llama,
+    LlamaConfig,
+    llama3_8b_config,
+    llama_param_count,
+    tiny_llama_config,
+)
+
+__all__ = ["Llama", "LlamaConfig", "llama3_8b_config",
+           "tiny_llama_config", "llama_param_count"]

@@ -1,0 +1,198 @@
+"""Llama-family decoder-only LM (BASELINE.md stretch row: "Llama-3-8B …
+FSDP-style shard over ICI").
+
+Net-new vs the reference (its largest attention model is BERT,
+``BERT.scala:402``): a modern decoder stack — RMSNorm pre-norm, rotary
+position embeddings, grouped-query attention, SwiGLU MLP, no biases —
+built in the same mega-layer idiom as ``TransformerLayer``
+(``self_attention.py``): one Layer owning stacked per-block params run
+under ``lax.scan``, so compile time is O(1) in depth and the (n_block,
+d_in, d_out) weight stacking gives ``parallel.plans.leaf_sharding`` its
+natural FSDP/TP axes (fsdp shards the block axis or the largest matmul
+dim; model shards the matmul output dim — Megatron column style).
+
+Attention rides ``ops.attention.dot_product_attention`` — the Pallas
+flash kernel at long sequence, the XLA-fused dense path otherwise; the
+ring-attention sequence-parallel variant composes at the estimator level
+(``parallel/ring_attention.py``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from zoo_tpu.ops.attention import dot_product_attention
+from zoo_tpu.pipeline.api.keras.engine.base import Layer, get_initializer
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab: int = 32000
+    hidden: int = 4096
+    n_block: int = 32
+    n_head: int = 32
+    n_kv_head: int = 8
+    intermediate: int = 14336
+    rope_theta: float = 500000.0
+    rms_eps: float = 1e-5
+    tie_embeddings: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.n_head
+
+
+def llama3_8b_config() -> LlamaConfig:
+    """Llama-3-8B shapes (public architecture card)."""
+    return LlamaConfig(vocab=128256, hidden=4096, n_block=32, n_head=32,
+                       n_kv_head=8, intermediate=14336,
+                       rope_theta=500000.0)
+
+
+def tiny_llama_config(vocab: int = 256) -> LlamaConfig:
+    """Test/dryrun config: same topology, toy widths."""
+    return LlamaConfig(vocab=vocab, hidden=64, n_block=2, n_head=4,
+                       n_kv_head=2, intermediate=128, rope_theta=10000.0)
+
+
+def llama_param_count(cfg: LlamaConfig) -> int:
+    """Analytic parameter count (embed + blocks + final norm + lm head)."""
+    h, kv = cfg.hidden, cfg.n_kv_head * cfg.head_dim
+    per_block = (h * h + 2 * h * kv + h * h      # q, k, v, o
+                 + 3 * h * cfg.intermediate      # w1 (gate), w3 (up), w2
+                 + 2 * h)                        # two RMSNorm gains
+    total = cfg.vocab * h + cfg.n_block * per_block + h
+    if not cfg.tie_embeddings:
+        total += cfg.vocab * h
+    return total
+
+
+def _rms_norm(x, gain, eps):
+    xf = x.astype(jnp.float32)  # f32 island (same policy as _layer_norm)
+    norm = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True)
+                              + eps)
+    return (norm * gain.astype(jnp.float32)).astype(x.dtype)
+
+
+def rope_frequencies(head_dim: int, seq_len: int, theta: float):
+    """(T, D/2) cos/sin tables, f32."""
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2,
+                                      dtype=jnp.float32) / head_dim))
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    ang = jnp.outer(t, inv)  # (T, D/2)
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x, cos, sin):
+    """Rotate (B, H, T, D) by per-position angles (HF rotate-half
+    convention)."""
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2], x[..., d2:]
+    cos = cos[None, None, :, :].astype(x.dtype)
+    sin = sin[None, None, :, :].astype(x.dtype)
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x2 * cos + x1 * sin], axis=-1)
+
+
+class Llama(Layer):
+    """Decoder-only Llama LM as one mega-layer: int ids (B, T) →
+    logits (B, T, vocab) (``lm_head=True``, default) or hidden states
+    (B, T, hidden)."""
+
+    def __init__(self, config: Optional[LlamaConfig] = None,
+                 lm_head: bool = True, init="glorot_uniform",
+                 attention_impl: str = "auto", **kwargs):
+        super().__init__(**kwargs)
+        self.cfg = config or LlamaConfig()
+        if self.cfg.hidden % self.cfg.n_head:
+            raise ValueError("hidden must divide by n_head")
+        if self.cfg.n_head % self.cfg.n_kv_head:
+            raise ValueError("n_head must divide by n_kv_head")
+        self.lm_head = lm_head
+        self.init = get_initializer(init)
+        self.attention_impl = attention_impl
+
+    # -- params -----------------------------------------------------------
+    def _block_params(self, rng):
+        c = self.cfg
+        kv = c.n_kv_head * c.head_dim
+        ks = jax.random.split(rng, 6)
+        return {
+            "wq": self.init(ks[0], (c.hidden, c.hidden), jnp.float32),
+            "wk": self.init(ks[1], (c.hidden, kv), jnp.float32),
+            "wv": self.init(ks[2], (c.hidden, kv), jnp.float32),
+            "wo": self.init(ks[3], (c.hidden, c.hidden), jnp.float32),
+            "w_gate": self.init(ks[4], (c.hidden, c.intermediate),
+                                jnp.float32),
+            "w_up": self.init(ks[5], (c.hidden, c.intermediate),
+                              jnp.float32),
+            "w_down": self.init(
+                jax.random.fold_in(ks[5], 1), (c.intermediate, c.hidden),
+                jnp.float32),
+            "attn_norm": jnp.ones((c.hidden,), jnp.float32),
+            "mlp_norm": jnp.ones((c.hidden,), jnp.float32),
+        }
+
+    def build(self, rng, input_shape):
+        c = self.cfg
+        k_embed, k_blocks, k_head = jax.random.split(rng, 3)
+        blocks = jax.vmap(self._block_params)(
+            jax.random.split(k_blocks, c.n_block))
+        params = {
+            "embed": self.init(k_embed, (c.vocab, c.hidden), jnp.float32)
+            * 0.02 * (3.0 ** 0.5),  # small-embed init, LM convention
+            "blocks": blocks,
+            "final_norm": jnp.ones((c.hidden,), jnp.float32),
+        }
+        if self.lm_head and not c.tie_embeddings:
+            params["head"] = self.init(k_head, (c.hidden, c.vocab),
+                                       jnp.float32)
+        return params
+
+    # -- forward ----------------------------------------------------------
+    def _block(self, p, h, cos, sin):
+        c = self.cfg
+        B, T, _ = h.shape
+        x = _rms_norm(h, p["attn_norm"], c.rms_eps)
+        q = (x @ p["wq"]).reshape(B, T, c.n_head, c.head_dim)
+        k = (x @ p["wk"]).reshape(B, T, c.n_kv_head, c.head_dim)
+        v = (x @ p["wv"]).reshape(B, T, c.n_kv_head, c.head_dim)
+        q = apply_rope(q.transpose(0, 2, 1, 3), cos, sin)
+        k = apply_rope(k.transpose(0, 2, 1, 3), cos, sin)
+        v = v.transpose(0, 2, 1, 3)
+        rep = c.n_head // c.n_kv_head
+        if rep > 1:  # GQA: broadcast kv groups to query heads
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
+        a = dot_product_attention(q, k, v, causal=True,
+                                  impl=self.attention_impl)
+        a = a.transpose(0, 2, 1, 3).reshape(B, T, c.hidden)
+        h = h + a @ p["wo"]
+        x = _rms_norm(h, p["mlp_norm"], c.rms_eps)
+        f = (jax.nn.silu(x @ p["w_gate"]) * (x @ p["w_up"])) @ p["w_down"]
+        return h + f
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        c = self.cfg
+        ids = inputs.astype(jnp.int32)
+        h = jnp.take(params["embed"], ids, axis=0)
+        cos, sin = rope_frequencies(c.head_dim, ids.shape[1], c.rope_theta)
+
+        def body(carry, blk):
+            return self._block(blk, carry, cos, sin), None
+
+        h, _ = jax.lax.scan(body, h, params["blocks"])
+        h = _rms_norm(h, params["final_norm"], c.rms_eps)
+        if not self.lm_head:
+            return h
+        head = (params["embed"].T if c.tie_embeddings
+                else params["head"])
+        return h @ head.astype(h.dtype)
+
+    def compute_output_shape(self, input_shape):
+        b, t = input_shape
+        return (b, t, self.cfg.vocab if self.lm_head else self.cfg.hidden)

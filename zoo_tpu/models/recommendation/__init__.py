@@ -1,4 +1,7 @@
 from zoo_tpu.models.recommendation.neuralcf import NeuralCF
 from zoo_tpu.models.recommendation.recommender import Recommender, UserItemFeature
+from zoo_tpu.models.recommendation.session_recommender import SessionRecommender
+from zoo_tpu.models.recommendation.wide_and_deep import ColumnFeatureInfo, WideAndDeep
 
-__all__ = ["NeuralCF", "Recommender", "UserItemFeature"]
+__all__ = ["NeuralCF", "Recommender", "UserItemFeature", "WideAndDeep",
+           "ColumnFeatureInfo", "SessionRecommender"]

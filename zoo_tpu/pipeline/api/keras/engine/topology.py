@@ -692,9 +692,36 @@ class KerasNet:
             pickle.dump({"params": host, "step": self._step}, f)
 
     def load_weights(self, path: str):
+        """Restore a ``save_weights`` blob. Params are keyed by layer
+        position+type (``_param_keys``), so a checkpoint only restores
+        into a structurally identical model — a mismatch (layer inserted/
+        removed/retyped, or a shape change) is a hard error here, never a
+        silent mis-restore."""
         with open(path, "rb") as f:
             blob = pickle.load(f)
-        self.params = blob["params"]
+        loaded = blob["params"]
+        if self.params is None:
+            try:  # materialize the model's own structure to validate
+                self.build()
+            except ValueError:
+                pass  # input shape unknowable here: accept unvalidated
+        if self.params is not None:
+            def _shapes(tree):
+                return {k: np.shape(v) for k, v in
+                        jax.tree_util.tree_leaves_with_path(tree)}
+            have, got = _shapes(self.params), _shapes(loaded)
+            if have != got:
+                missing = sorted(set(map(str, have)) - set(map(str, got)))
+                extra = sorted(set(map(str, got)) - set(map(str, have)))
+                changed = sorted(str(k) for k in have
+                                 if k in got and have[k] != got[k])
+                raise ValueError(
+                    "checkpoint does not match this model's structure "
+                    "(params are keyed by layer position+type, so layers "
+                    "must match one-for-one). "
+                    f"missing={missing[:5]} unexpected={extra[:5]} "
+                    f"shape-changed={changed[:5]}")
+        self.params = loaded
         self._step = blob.get("step", 0)
         return self
 

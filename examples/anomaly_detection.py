@@ -1,0 +1,65 @@
+"""Time-series anomaly detection (reference: ``apps/anomaly-detection``
+notebook + ``pyzoo/zoo/examples/anomalydetection``): unroll a univariate
+series into windows, train the stacked-LSTM AnomalyDetector to predict
+the next value, flag the largest forecast errors as anomalies — then
+cross-check with the Chronos ThresholdDetector.
+
+Run: python examples/anomaly_detection.py [--epochs 5]
+"""
+
+import argparse
+
+import numpy as np
+
+
+def make_series(n=2000, n_anomalies=8, seed=0):
+    rs = np.random.RandomState(seed)
+    t = np.arange(n)
+    base = (np.sin(t * 2 * np.pi / 50) + 0.5 * np.sin(t * 2 * np.pi / 113)
+            + 0.05 * rs.randn(n)).astype(np.float32)
+    idx = rs.choice(np.arange(100, n - 100), n_anomalies, replace=False)
+    base[idx] += rs.choice([-1, 1], n_anomalies) * rs.uniform(
+        2.0, 3.0, n_anomalies).astype(np.float32)
+    return base, set(int(i) for i in idx)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=5)
+    ap.add_argument("--unroll", type=int, default=24)
+    args = ap.parse_args()
+
+    from zoo_tpu.orca import init_orca_context, stop_orca_context
+    from zoo_tpu.models.anomalydetection import AnomalyDetector
+
+    init_orca_context(cluster_mode="local")
+    series, truth = make_series()
+    x, y = AnomalyDetector.unroll(series, args.unroll)
+    cut = int(0.7 * len(x))
+
+    model = AnomalyDetector(feature_shape=(args.unroll, 1))
+    model.compile(optimizer="adam", loss="mse")
+    model.fit(x[:cut], y[:cut], batch_size=128, nb_epoch=args.epochs,
+              verbose=0)
+
+    pred = np.asarray(model.predict(x, batch_size=256)).ravel()
+    anoms = model.detect_anomalies(y, pred, anomaly_size=12)
+    flagged = {a + args.unroll for a in anoms}  # window index -> series t
+    hits = len(flagged & truth)
+    print(f"LSTM detector: flagged {len(flagged)}, "
+          f"true anomalies recovered {hits}/{len(truth)}")
+
+    from zoo_tpu.chronos.detector.anomaly import ThresholdDetector
+    td = ThresholdDetector()
+    td.set_params(ratio=0.01)
+    td.fit(y, pred)
+    td_idx = set(int(i) + args.unroll for i in td.anomaly_indexes())
+    print(f"ThresholdDetector: flagged {len(td_idx)}, "
+          f"recovered {len(td_idx & truth)}/{len(truth)}")
+    assert hits >= len(truth) // 2, (hits, truth)
+    stop_orca_context()
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -88,7 +88,8 @@ def test_pandas_read_parquet(tmp_path, orca_ctx):
 _EXAMPLES = ["ncf_movielens.py", "dogs_vs_cats_resnet.py",
              "autots_forecasting.py", "cluster_serving_roundtrip.py",
              "text_classification.py", "torch_finetune.py",
-             "image_classification_inference.py"]
+             "image_classification_inference.py", "anomaly_detection.py",
+             "wide_n_deep_recommendation.py", "variational_autoencoder.py"]
 
 
 @pytest.mark.parametrize("script", _EXAMPLES)
@@ -106,6 +107,8 @@ def test_example_runs(script):
         args += ["--trials", "2", "--epochs", "2"]
     if script in ("text_classification.py", "torch_finetune.py"):
         args += ["--epochs", "2"]
+    if script == "anomaly_detection.py":
+        args += ["--epochs", "3"]
     proc = subprocess.run(args, capture_output=True, text=True, timeout=900,
                           env=env)
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]

@@ -106,8 +106,11 @@ class KerasNet:
 
     # -- public API (keras-1 names, reference Topology.scala) -------------
     def compile(self, optimizer, loss, metrics=None,
-                dtype_policy: str = "float32"):
+                loss_weights=None, dtype_policy: str = "float32"):
         """reference: ``KerasNet.compile`` ``Topology.scala:139``.
+
+        ``loss_weights``: optional per-output scalar weights for
+        multi-output models (keras semantics; reference multi-task use).
 
         ``dtype_policy``: "float32" (default) or "mixed_bfloat16" — params
         and optimizer state stay f32, forward/backward compute runs in
@@ -122,12 +125,21 @@ class KerasNet:
                 "loss per output")
         if isinstance(loss, (list, tuple)) and len(loss) != n_out:
             raise ValueError(f"{len(loss)} losses for {n_out} outputs")
+        if loss_weights is not None and not isinstance(loss,
+                                                       (list, tuple)):
+            raise ValueError("loss_weights needs a list of losses")
         self.dtype_policy = dtype_policy
         self.optimizer = get_optimizer(optimizer)
         if isinstance(loss, (list, tuple)):
-            # multi-output: one loss per output, summed (the reference's
-            # multi-task graphs combine per-head criteria the same way)
+            # multi-output: one loss per output, weighted sum (the
+            # reference's multi-task graphs combine per-head criteria the
+            # same way)
             fns = [get_loss(l) for l in loss]
+            ws = ([float(w) for w in loss_weights]
+                  if loss_weights is not None else [1.0] * len(fns))
+            if len(ws) != len(fns):
+                raise ValueError(f"{len(ws)} loss_weights for "
+                                 f"{len(fns)} losses")
 
             def _multi_loss(ys, preds):
                 ys = ys if isinstance(ys, (list, tuple)) else [ys]
@@ -136,7 +148,8 @@ class KerasNet:
                     raise ValueError(
                         f"{len(fns)} losses, {len(ys)} label sets, "
                         f"{len(preds)} outputs — counts must match")
-                return sum(f(y, p) for f, y, p in zip(fns, ys, preds))
+                return sum(w * f(y, p)
+                           for w, f, y, p in zip(ws, fns, ys, preds))
 
             self.loss_fn = _multi_loss
             self.loss_name = "multi"

@@ -1,0 +1,100 @@
+"""The serving launcher CLI boots the whole pipeline (embedded RESP
+server + engine + HTTP frontend) from a saved model file, and clients
+round-trip through both wire protocols."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+
+def _free_ports(n):
+    """Distinct ports: hold all sockets open until every port is drawn."""
+    socks = [socket.socket() for _ in range(n)]
+    try:
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
+def test_serving_cli_roundtrip(tmp_path):
+    from zoo_tpu.pipeline.api.keras.engine.topology import Sequential
+    from zoo_tpu.pipeline.api.keras.layers import Dense
+
+    m = Sequential(name="cli_served")
+    m.add(Dense(8, activation="relu", input_shape=(4,)))
+    m.add(Dense(3, activation="softmax"))
+    m.build()
+    model_path = str(tmp_path / "m.zoo")
+    m.save(model_path)
+    x = np.random.RandomState(0).randn(4).astype(np.float32)
+    ref = np.asarray(m.predict(x[None], batch_size=1))[0]
+
+    redis_port, http_port = _free_ports(2)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.getcwd() + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "zoo_tpu.serving.run", "--model", model_path,
+         "--redis-port", str(redis_port), "--http-port", str(http_port),
+         "--batch-size", "4"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    try:
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            try:
+                with socket.create_connection(("127.0.0.1", http_port),
+                                              timeout=1):
+                    break
+            except OSError:
+                assert proc.poll() is None, proc.stdout.read()[-2000:]
+                time.sleep(0.3)
+        else:
+            raise TimeoutError("serving CLI never opened the HTTP port")
+
+        # redis-protocol path
+        from zoo_tpu.serving.client import InputQueue, OutputQueue
+        iq = InputQueue(host="127.0.0.1", port=redis_port)
+        iq.enqueue("req1", t=x)
+        oq = OutputQueue(host="127.0.0.1", port=redis_port)
+        got = "[]"
+        for _ in range(300):
+            got = oq.query("req1")
+            if not isinstance(got, str):
+                break
+            time.sleep(0.1)
+        assert not isinstance(got, str), got
+        np.testing.assert_allclose(
+            np.asarray(got).reshape(-1), ref, atol=1e-4)
+
+        # http path
+        body = json.dumps(
+            {"instances": [{"t": x.tolist()}]}).encode()
+        resp = json.loads(urllib.request.urlopen(
+            urllib.request.Request(
+                f"http://127.0.0.1:{http_port}/predict", data=body,
+                headers={"Content-Type": "application/json"}),
+            timeout=60).read())
+        val = json.loads(json.loads(resp["predictions"][0])["value"])
+        pred = np.asarray(val["data"], np.float32).reshape(-1)
+        np.testing.assert_allclose(pred, ref, atol=1e-4)
+
+        metrics = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{http_port}/metrics", timeout=30).read())
+        assert any("inference" in str(k) for k in metrics)
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()

@@ -1,0 +1,128 @@
+"""Cluster Serving launcher CLI (reference: the ``cluster-serving-start``
+script + ``config.yaml`` read by ``ClusterServingHelper.scala:292``).
+
+``python -m zoo_tpu.serving.run --model m.zoo [--config config.yaml]``
+loads the model into an :class:`InferenceModel`, starts the serving loop
+against Redis (external, or the embedded RESP server when nothing is
+listening) and the HTTP frontend, then blocks until SIGINT/SIGTERM.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+
+def _load_config(path):
+    """Minimal config.yaml reader (flat ``key: value`` pairs under the
+    reference's section names; no yaml dependency)."""
+    out = {}
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if ":" in line:
+                k, v = line.split(":", 1)
+                if v.strip():
+                    out[k.strip()] = v.strip().strip("'\"")
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m zoo_tpu.serving.run")
+    ap.add_argument("--model", required=False,
+                    help="serialized zoo model (.zoo) or TF SavedModel dir")
+    ap.add_argument("--config", help="reference-style config.yaml "
+                                     "(modelPath/redis/.. keys)")
+    ap.add_argument("--redis-host", default="localhost")
+    ap.add_argument("--redis-port", type=int, default=6379)
+    ap.add_argument("--http-port", type=int, default=10020)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--concurrent-num", type=int, default=4)
+    ap.add_argument("--quantize", action="store_true")
+    ap.add_argument("--redis-mode", default="auto",
+                    choices=["auto", "external", "embedded"],
+                    help="external: wait for a real Redis (up to "
+                         "--redis-wait s, then fail); embedded: always "
+                         "boot the in-process RESP server; auto: probe "
+                         "briefly, then fall back to embedded")
+    ap.add_argument("--redis-wait", type=float, default=60.0)
+    ns = ap.parse_args(argv)
+
+    if ns.config:
+        cfg = _load_config(ns.config)
+        ns.model = ns.model or cfg.get("modelPath") or cfg.get("path")
+        ns.redis_host = cfg.get("redisHost", ns.redis_host)
+        ns.redis_port = int(cfg.get("redisPort", ns.redis_port))
+        ns.batch_size = int(cfg.get("batchSize", ns.batch_size))
+    if not ns.model:
+        ap.error("--model (or a config with modelPath) is required")
+
+    from zoo_tpu.pipeline.inference.inference_model import InferenceModel
+    from zoo_tpu.serving.client import InputQueue
+    from zoo_tpu.serving.cluster_serving import ClusterServing, FrontEnd
+    from zoo_tpu.serving.redis_embedded import EmbeddedRedis
+
+    # Redis resolution (the reference's test mode runs embedded-redis):
+    # external Redis may come up after us (compose depends_on orders
+    # start, not readiness), so probe with retries before any fallback.
+    import socket as _socket
+    import time as _time
+
+    def _reachable() -> bool:
+        try:
+            with _socket.create_connection(
+                    (ns.redis_host, ns.redis_port), timeout=1):
+                return True
+        except OSError:
+            return False
+
+    embedded = None
+    if ns.redis_mode == "embedded":
+        embedded = EmbeddedRedis(host="127.0.0.1",
+                                 port=ns.redis_port).start()
+        ns.redis_host, ns.redis_port = "127.0.0.1", embedded.port
+    else:
+        wait = ns.redis_wait if ns.redis_mode == "external" else 3.0
+        deadline = _time.time() + wait
+        while not _reachable() and _time.time() < deadline:
+            _time.sleep(0.5)
+        if not _reachable():
+            if ns.redis_mode == "external":
+                print(f"no Redis at {ns.redis_host}:{ns.redis_port} "
+                      f"after {wait:.0f}s", file=sys.stderr)
+                return 1
+            embedded = EmbeddedRedis(host="127.0.0.1",
+                                     port=ns.redis_port).start()
+            ns.redis_host, ns.redis_port = "127.0.0.1", embedded.port
+    if embedded is not None:
+        print(f"embedded RESP server on :{embedded.port}", flush=True)
+
+    im = InferenceModel(supported_concurrent_num=ns.concurrent_num)
+    import os
+    if os.path.isdir(ns.model):
+        im.load_tf(ns.model, batch_size=ns.batch_size)
+    else:
+        im.load(ns.model, batch_size=ns.batch_size,
+                quantize=ns.quantize)
+
+    serving = ClusterServing(model=im, redis_host=ns.redis_host,
+                             redis_port=ns.redis_port,
+                             batch_size=ns.batch_size).start()
+    fe = FrontEnd(serving, InputQueue(host=ns.redis_host,
+                                      port=ns.redis_port),
+                  host="0.0.0.0", port=ns.http_port).start()
+    print(f"serving: redis {ns.redis_host}:{ns.redis_port}  "
+          f"http {fe.host}:{fe.port}", flush=True)
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    serving.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -56,14 +56,36 @@ class FeatureTable:
         return FeatureTable(df.copy())
 
     @staticmethod
-    def read_csv(path: str, **kwargs) -> "FeatureTable":
+    def _read_parts(path: str, reader, **kwargs) -> "FeatureTable":
         from zoo_tpu.orca.data.file import list_files
-        parts = [pd.read_csv(f, **kwargs) for f in list_files(path)]
-        return FeatureTable(pd.concat(parts, ignore_index=True))
+        files = list_files(path)
+        if not files:
+            raise FileNotFoundError(f"no files under {path!r}")
+        return FeatureTable(pd.concat(
+            [reader(f, **kwargs) for f in files], ignore_index=True))
+
+    @staticmethod
+    def read_csv(path: str, **kwargs) -> "FeatureTable":
+        return FeatureTable._read_parts(path, pd.read_csv, **kwargs)
 
     @staticmethod
     def read_parquet(path: str) -> "FeatureTable":
         return FeatureTable(pd.read_parquet(path))
+
+    @staticmethod
+    def read_json(path: str, **kwargs) -> "FeatureTable":
+        """reference: ``read_json``."""
+        return FeatureTable._read_parts(path, pd.read_json, **kwargs)
+
+    @staticmethod
+    def from_dict(data: Dict) -> "FeatureTable":
+        """reference: ``from_dict`` — column name → values."""
+        return FeatureTable(pd.DataFrame(data))
+
+    def write_parquet(self, path: str) -> "FeatureTable":
+        """reference: ``write_parquet``."""
+        self.df.to_parquet(path)
+        return self
 
     # -- basic -------------------------------------------------------------
     def select(self, *cols) -> "FeatureTable":
@@ -88,6 +110,125 @@ class FeatureTable:
 
     def size(self) -> int:
         return len(self.df)
+
+    @property
+    def columns(self) -> List[str]:
+        """reference: ``columns`` property."""
+        return list(self.df.columns)
+
+    def col(self, name: str) -> pd.Series:
+        """reference: ``col``."""
+        return self.df[name]
+
+    def distinct(self) -> "FeatureTable":
+        """reference: ``distinct``."""
+        return FeatureTable(self.df.drop_duplicates())
+
+    def sample(self, fraction: float, seed: int = 0) -> "FeatureTable":
+        """reference: ``sample`` (without replacement)."""
+        return FeatureTable(self.df.sample(frac=fraction,
+                                           random_state=seed))
+
+    def split(self, weights: Sequence[float], seed: int = 0
+              ) -> List["FeatureTable"]:
+        """Random row split by normalized weights (reference:
+        ``split``/``random_split``)."""
+        w = np.asarray(weights, np.float64)
+        w = w / w.sum()
+        idx = np.random.RandomState(seed).permutation(len(self.df))
+        bounds = (np.concatenate([[0], np.cumsum(w)]) * len(idx)
+                  ).round().astype(int)
+        bounds[-1] = len(idx)  # float cumsum must not drop the tail row
+        return [FeatureTable(self.df.iloc[idx[bounds[i]:bounds[i + 1]]]
+                             .reset_index(drop=True))
+                for i in range(len(w))]
+
+    def append_column(self, name: str, value) -> "FeatureTable":
+        """reference: ``append_column`` — constant or array/Series."""
+        df = self.df.copy()
+        df[name] = value
+        return FeatureTable(df)
+
+    def merge_cols(self, columns: Sequence[str], target: str
+                   ) -> "FeatureTable":
+        """Merge columns into one list column (reference:
+        ``merge_cols``)."""
+        cols = _as_list(columns)
+        df = self.df.copy()
+        # row-wise so mixed dtypes keep their own type (int ids must not
+        # float-upcast on the way into a list column)
+        df[target] = [list(row) for row in
+                      zip(*(df[c].tolist() for c in cols))]
+        return FeatureTable(df.drop(columns=cols))
+
+    def add(self, columns, value: float = 1.0) -> "FeatureTable":
+        """Add a scalar to numeric columns (reference: ``add``)."""
+        df = self.df.copy()
+        for c in _as_list(columns):
+            df[c] = df[c] + value
+        return FeatureTable(df)
+
+    def median(self, columns=None) -> "FeatureTable":
+        """Per-column medians as a (column, median) table (reference:
+        ``median``)."""
+        cols = _as_list(columns) or list(
+            self.df.select_dtypes("number").columns)
+        return FeatureTable(pd.DataFrame(
+            {"column": cols,
+             "median": [float(self.df[c].median()) for c in cols]}))
+
+    def get_stats(self, columns, aggr: Union[str, Dict]) -> Dict:
+        """Column statistics dict (reference: ``get_stats``; ``aggr`` is
+        one of min/max/avg/sum or a per-column dict)."""
+        cols = _as_list(columns) or list(
+            self.df.select_dtypes("number").columns)
+        out = {}
+        for c in cols:
+            how = aggr[c] if isinstance(aggr, dict) else aggr
+            how = {"avg": "mean"}.get(how, how)
+            out[c] = float(getattr(self.df[c], how)())
+        return out
+
+    def filter_by_frequency(self, columns, min_freq: int = 2
+                            ) -> "FeatureTable":
+        """Keep rows whose value combination appears >= min_freq times
+        (reference: ``filter_by_frequency``)."""
+        cols = _as_list(columns)
+        counts = self.df.groupby(cols)[cols[0]].transform("size")
+        return FeatureTable(self.df[counts >= min_freq])
+
+    def hash_encode(self, columns, bins: int, method: str = "md5"
+                    ) -> "FeatureTable":
+        """Hash string columns into ``bins`` buckets (reference:
+        ``hash_encode``)."""
+        import hashlib
+        df = self.df.copy()
+        for c in _as_list(columns):
+            h = getattr(hashlib, method)
+            df[c] = [int(h(str(v).encode()).hexdigest(), 16) % bins
+                     for v in df[c]]
+        return FeatureTable(df)
+
+    def cross_hash_encode(self, columns, bin_size: int,
+                          cross_col_name: Optional[str] = None
+                          ) -> "FeatureTable":
+        """Hash-cross of several columns (reference:
+        ``cross_hash_encode``)."""
+        cols = _as_list(columns)
+        out = self.cross_columns([cols], [bin_size])
+        if cross_col_name:
+            out = out.rename({"_".join(cols): cross_col_name})
+        return out
+
+    def one_hot(self, columns) -> "FeatureTable":
+        """alias kept for the reference's ``one_hot``."""
+        return self.one_hot_encode(columns)
+
+    def ordinal_shuffle_partition(self, seed: int = 0) -> "FeatureTable":
+        """Global row shuffle (the reference shuffles within partitions;
+        single-table equivalent is a full permutation)."""
+        return FeatureTable(self.df.sample(frac=1.0, random_state=seed)
+                            .reset_index(drop=True))
 
     def show(self, n: int = 20):
         print(self.df.head(n).to_string())
@@ -241,6 +382,46 @@ class FeatureTable:
                     row[f"{c}_hist_seq"] = vals[c][max(0, i - max_len):i]
                 out_rows.append(row)
         return FeatureTable(pd.DataFrame(out_rows))
+
+    def add_length(self, col_name: str) -> "FeatureTable":
+        """Length of a list column as ``<col>_length`` (reference:
+        ``add_length``)."""
+        df = self.df.copy()
+        df[f"{col_name}_length"] = df[col_name].apply(len)
+        return FeatureTable(df)
+
+    def add_neg_hist_seq(self, item_size: int, item_history_col: str,
+                         neg_num: int, seed: int = 0) -> "FeatureTable":
+        """For each history sequence add ``neg_num`` random negative items
+        per step as ``neg_<col>`` (reference: ``add_neg_hist_seq``)."""
+        rs = np.random.RandomState(seed)
+        df = self.df.copy()
+
+        def _negs(seq):
+            out = []
+            for v in seq:
+                draws = rs.randint(1, item_size + 1, neg_num)
+                draws[draws == v] = (draws[draws == v] % item_size) + 1
+                out.append(draws.tolist())
+            return out
+
+        df[f"neg_{item_history_col}"] = df[item_history_col].apply(_negs)
+        return FeatureTable(df)
+
+    def mask(self, cols: Sequence[str], seq_len: int) -> "FeatureTable":
+        """1/0 mask columns ``<col>_mask`` for list columns (reference:
+        ``mask``)."""
+        df = self.df.copy()
+        for c in _as_list(cols):
+            df[f"{c}_mask"] = df[c].apply(
+                lambda v: [1] * min(len(v), seq_len)
+                + [0] * max(0, seq_len - len(v)))
+        return FeatureTable(df)
+
+    def mask_pad(self, padding_cols: Sequence[str],
+                 mask_cols: Sequence[str], seq_len: int) -> "FeatureTable":
+        """mask then pad in one call (reference: ``mask_pad``)."""
+        return self.mask(mask_cols, seq_len).pad(padding_cols, seq_len)
 
     def pad(self, cols: Sequence[str], seq_len: int,
             mask_cols: Optional[Sequence[str]] = None) -> "FeatureTable":

@@ -1,0 +1,151 @@
+"""Foreign dataset ingestion: torch DataLoader and tf.data.Dataset feed
+every estimator surface (reference: orca/data tf/torch bridges)."""
+
+import numpy as np
+import pytest
+
+from zoo_tpu.pipeline.api.keras.engine.topology import Sequential
+from zoo_tpu.pipeline.api.keras.layers import Dense
+
+
+def _model(inputs=1):
+    m = Sequential()
+    m.add(Dense(8, activation="relu", input_shape=(4,)))
+    m.add(Dense(1))
+    m.compile(optimizer="adam", loss="mse")
+    return m
+
+
+def test_torch_dataloader_fit_predict():
+    torch = pytest.importorskip("torch")
+    from torch.utils.data import DataLoader, TensorDataset
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(64, 4).astype(np.float32)
+    y = (x @ rs.randn(4, 1)).astype(np.float32)
+    loader = DataLoader(TensorDataset(torch.from_numpy(x),
+                                      torch.from_numpy(y)), batch_size=16)
+    m = _model()
+    h = m.fit(loader, batch_size=16, nb_epoch=4, verbose=0)
+    assert h["loss"][-1] < h["loss"][0]
+    res = m.evaluate(loader, batch_size=32)
+    assert np.isfinite(res["loss"])
+
+
+def test_tf_dataset_fit():
+    tf = pytest.importorskip("tensorflow")
+    rs = np.random.RandomState(1)
+    x = rs.randn(48, 4).astype(np.float32)
+    y = (x @ rs.randn(4, 1)).astype(np.float32)
+    ds = tf.data.Dataset.from_tensor_slices((x, y)).batch(12)
+    m = _model()
+    h = m.fit(ds, batch_size=12, nb_epoch=4, verbose=0)
+    assert h["loss"][-1] < h["loss"][0]
+
+
+def test_orca_estimator_with_dataloader():
+    torch = pytest.importorskip("torch")
+    from torch.utils.data import DataLoader, TensorDataset
+    from zoo_tpu.orca.learn.keras import Estimator
+
+    rs = np.random.RandomState(2)
+    x = rs.randn(32, 4).astype(np.float32)
+    y = (x @ rs.randn(4, 1)).astype(np.float32)
+    loader = DataLoader(TensorDataset(torch.from_numpy(x),
+                                      torch.from_numpy(y)), batch_size=8)
+    est = Estimator.from_keras(_model())
+    h = est.fit(loader, epochs=2, batch_size=8)
+    assert len(h["loss"]) == 2
+
+
+def test_empty_loader_raises():
+    torch = pytest.importorskip("torch")
+    from torch.utils.data import DataLoader, TensorDataset
+    empty = DataLoader(TensorDataset(torch.zeros(0, 4)), batch_size=4)
+    with pytest.raises(ValueError, match="empty"):
+        _model().fit(empty, batch_size=4, nb_epoch=1, verbose=0)
+
+
+def test_multi_input_tuple_batches():
+    """(x1, x2, y) batches: all-but-last are inputs, last is labels."""
+    torch = pytest.importorskip("torch")
+    from torch.utils.data import DataLoader, TensorDataset
+    from zoo_tpu.pipeline.api.keras.engine.data_utils import to_xy_arrays
+
+    rs = np.random.RandomState(3)
+    a = rs.randn(20, 4).astype(np.float32)
+    b = rs.randn(20, 3).astype(np.float32)
+    y = rs.randn(20, 1).astype(np.float32)
+    loader = DataLoader(TensorDataset(*(torch.from_numpy(v)
+                                        for v in (a, b, y))), batch_size=5)
+    xs, ys = to_xy_arrays(loader)
+    assert len(xs) == 2 and xs[0].shape == (20, 4) and xs[1].shape == (20, 3)
+    assert ys.shape == (20, 1)
+
+
+def test_dict_collate_batches():
+    torch = pytest.importorskip("torch")
+    from torch.utils.data import DataLoader, Dataset
+    from zoo_tpu.pipeline.api.keras.engine.data_utils import to_xy_arrays
+
+    class D(Dataset):
+        def __len__(self):
+            return 12
+
+        def __getitem__(self, i):
+            return {"x": np.float32([i, i + 1]), "y": np.float32([2 * i])}
+
+    xs, ys = to_xy_arrays(DataLoader(D(), batch_size=4))
+    assert xs[0].shape == (12, 2) and ys.shape == (12, 1)
+
+
+def test_unbatched_tf_dataset_rejected():
+    tf = pytest.importorskip("tensorflow")
+    from zoo_tpu.pipeline.api.keras.engine.data_utils import to_xy_arrays
+    ds = tf.data.Dataset.from_tensor_slices(
+        np.zeros((8, 4), np.float32))  # per-sample, never batched
+    with pytest.raises(ValueError, match="must be batched"):
+        to_xy_arrays(ds)
+
+
+def test_separate_y_with_loader_rejected():
+    torch = pytest.importorskip("torch")
+    from torch.utils.data import DataLoader, TensorDataset
+    from zoo_tpu.pipeline.api.keras.engine.data_utils import to_xy_arrays
+    loader = DataLoader(TensorDataset(torch.zeros(8, 4)), batch_size=4)
+    with pytest.raises(ValueError, match="separate y"):
+        to_xy_arrays(loader, y=np.zeros(8))
+
+
+def test_dataloader_subclass_detected():
+    torch = pytest.importorskip("torch")
+    from torch.utils.data import DataLoader, TensorDataset
+    from zoo_tpu.pipeline.api.keras.engine.data_utils import to_xy_arrays
+
+    class MyLoader(DataLoader):
+        pass
+
+    xs, _ = to_xy_arrays(MyLoader(TensorDataset(torch.zeros(8, 4)),
+                                  batch_size=4))
+    assert xs[0].shape == (8, 4)
+
+
+def test_tf2_estimator_dataset_path():
+    tf = pytest.importorskip("tensorflow")
+    from zoo_tpu.orca.learn.tf2 import Estimator
+
+    def creator(config):
+        m = tf.keras.Sequential([
+            tf.keras.layers.Dense(4, activation="relu",
+                                  input_shape=(4,)),
+            tf.keras.layers.Dense(1)])
+        m.compile(optimizer="adam", loss="mse")
+        return m
+
+    rs = np.random.RandomState(4)
+    x = rs.randn(32, 4).astype(np.float32)
+    y = (x @ rs.randn(4, 1)).astype(np.float32)
+    ds = tf.data.Dataset.from_tensor_slices((x, y)).batch(8)
+    est = Estimator.from_keras(model_creator=creator)
+    h = est.fit(ds, epochs=2, batch_size=8)
+    assert len(h["loss"]) == 2

@@ -147,28 +147,18 @@ class TF2Estimator(KerasEstimator):
     # -- data adapters -----------------------------------------------------
     def _materialize(self, data, batch_size):
         """Accept the reference's data forms: creator function, tf.data
-        Dataset, XShards / dict / arrays."""
+        Dataset, XShards / dict / arrays. Dataset conversion delegates to
+        the shared loader path in ``data_utils``."""
         if callable(data) and not isinstance(data, (list, tuple, dict)):
             data = data(self.config, batch_size)  # reference data_creator
-        try:
-            import tensorflow as tf
-            if isinstance(data, tf.data.Dataset):
-                xs, ys = [], []
-                for item in data.as_numpy_iterator():
-                    if isinstance(item, tuple) and len(item) == 2:
-                        xs.append(item[0])
-                        ys.append(item[1])
-                    else:
-                        xs.append(item)
-                x = np.concatenate([np.atleast_1d(a) for a in xs]) \
-                    if xs and np.ndim(xs[0]) else np.stack(xs)
-                if ys:
-                    y = np.concatenate([np.atleast_1d(a) for a in ys]) \
-                        if np.ndim(ys[0]) else np.stack(ys)
-                    return {"x": x, "y": y}
-                return {"x": x}
-        except ImportError:
-            pass
+        from zoo_tpu.pipeline.api.keras.engine.data_utils import (
+            _foreign_batches, to_xy_arrays)
+        if _foreign_batches(data) is not None:
+            xs, ys = to_xy_arrays(data)
+            out = {"x": xs if len(xs) > 1 else xs[0]}
+            if ys is not None:
+                out["y"] = ys
+            return out
         return data
 
     def fit(self, data, epochs: int = 1, batch_size: int = 32,

@@ -14,6 +14,57 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 
+# full-materialization cap: a .repeat()ed / infinite dataset must fail
+# with a message naming the cause, not OOM silently
+_MAX_FOREIGN_BATCHES = 100_000
+
+
+def _np_leaf(o):
+    if "torch" in type(o).__module__:
+        import torch
+        if o.dtype == torch.bfloat16:  # .numpy() rejects bf16
+            return o.detach().cpu().float().numpy()
+        return o.detach().cpu().numpy()
+    return o.numpy() if hasattr(o, "numpy") else np.asarray(o)
+
+
+def _np_tree(o):
+    if isinstance(o, (list, tuple)):
+        return [_np_tree(v) for v in o]
+    if isinstance(o, dict):
+        return {k: _np_tree(v) for k, v in o.items()}
+    return _np_leaf(o)
+
+
+def _foreign_batches(x):
+    """Return a numpy batch iterable when ``x`` is a torch DataLoader or
+    a (batched) tf.data.Dataset; None otherwise. Datasets themselves
+    (map-style torch Dataset, unbatched tf Dataset) are deliberately NOT
+    accepted — they yield per-sample elements, not batches."""
+    try:
+        from torch.utils.data import DataLoader
+        if isinstance(x, DataLoader):  # incl. user subclasses
+            return (_np_tree(batch) for batch in x)
+    except ImportError:
+        pass
+    if type(x).__module__.startswith("tensorflow") and \
+            hasattr(x, "as_numpy_iterator") and hasattr(x, "element_spec"):
+        spec = x.element_spec
+        first = (spec[0] if isinstance(spec, (list, tuple)) else
+                 next(iter(spec.values())) if isinstance(spec, dict)
+                 else spec)
+        if first.shape.rank is not None and (
+                first.shape.rank == 0 or first.shape[0] is not None):
+            raise ValueError(
+                "tf.data.Dataset inputs must be batched (call "
+                ".batch(n)); got elements of static shape "
+                f"{first.shape} — if this IS a batched dataset, it was "
+                "batched with drop_remainder=True; use "
+                "drop_remainder=False or pass numpy arrays")
+        return (_np_tree(b) for b in x.as_numpy_iterator())
+    return None
+
+
 def to_xy_arrays(x, y=None, feature_cols: Optional[Sequence[str]] = None,
                  label_cols: Optional[Sequence[str]] = None
                  ) -> Tuple[List[np.ndarray], Optional[np.ndarray]]:
@@ -21,9 +72,51 @@ def to_xy_arrays(x, y=None, feature_cols: Optional[Sequence[str]] = None,
 
     Accepts: numpy array(s), dict {"x": ..., "y": ...}, XShards of such
     dicts or of DataFrames (with feature_cols/label_cols), pandas DataFrame
-    (with feature_cols/label_cols).
+    (with feature_cols/label_cols), a torch ``DataLoader``, or a
+    ``tf.data.Dataset`` of (x, y) batches (both materialized host-side —
+    the reference's orca data bridges ``orca/data/tf/data.py`` /
+    DataLoader feed did the same per-worker materialization).
     """
     from zoo_tpu.orca.data.shard import LocalXShards
+
+    loader = _foreign_batches(x)
+    if loader is not None:
+        if y is not None:
+            raise ValueError(
+                "pass labels inside the DataLoader/Dataset batches, not "
+                "as a separate y= argument")
+        xs_b, ys_b = [], []
+        for n, batch in enumerate(loader):
+            if n >= _MAX_FOREIGN_BATCHES:
+                raise ValueError(
+                    f"dataset yielded more than {_MAX_FOREIGN_BATCHES} "
+                    "batches — is it infinite (tf .repeat() / torch "
+                    "IterableDataset)? Materialization needs a finite "
+                    "dataset")
+            if isinstance(batch, dict):  # {'x': ..., 'y': ...} collate
+                bx, by = batch.get("x"), batch.get("y")
+                if bx is None:
+                    raise ValueError(
+                        "dict batches must carry 'x' (and optionally "
+                        f"'y'); got keys {sorted(batch)}")
+            elif isinstance(batch, (list, tuple)):
+                if len(batch) == 1:
+                    bx, by = batch[0], None
+                else:  # (x, y) or (x1, ..., xn, y): last item is labels
+                    bx, by = list(batch[:-1]), batch[-1]
+                    if len(bx) == 1:
+                        bx = bx[0]
+            else:
+                bx, by = batch, None
+            xs_b.append([np.asarray(a) for a in _as_list(bx)])
+            if by is not None:
+                ys_b.append(np.asarray(by))
+        if not xs_b:
+            raise ValueError("empty dataset/dataloader")
+        xs = [np.concatenate([b[i] for b in xs_b])
+              for i in range(len(xs_b[0]))]
+        ys = np.concatenate(ys_b) if ys_b else None
+        return xs, _normalize_labels(ys)
 
     if isinstance(x, LocalXShards):
         first = x.collect()[0]

@@ -1,0 +1,56 @@
+"""AutoXGBoost hyperparameter search (reference:
+``pyzoo/zoo/examples/orca/automl/autoxgboost_regressor.py``): search the
+boosted-tree knobs with the AutoML engine, refit the best config, and
+compare against an untuned model.
+
+Run: python examples/auto_xgboost_regression.py [--samples 8]
+"""
+
+import argparse
+
+import numpy as np
+
+
+def make_regression(n=2000, d=8, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, d).astype(np.float32)
+    y = (np.sin(x[:, 0]) + 0.5 * x[:, 1] ** 2 + x[:, 2] * x[:, 3]
+         + 0.1 * rs.randn(n)).astype(np.float32)
+    return x, y
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--samples", type=int, default=8)
+    args = ap.parse_args()
+
+    from zoo_tpu.orca import init_orca_context, stop_orca_context
+    from zoo_tpu.automl import hp
+    from zoo_tpu.orca.automl.xgboost import AutoXGBoost, XGBoostRegressor
+
+    init_orca_context(cluster_mode="local")
+    x, y = make_regression()
+    cut = int(0.8 * len(x))
+    train, val = (x[:cut], y[:cut]), (x[cut:], y[cut:])
+
+    base = XGBoostRegressor(n_estimators=10, max_depth=2)
+    base.fit(*train)
+    base_mse = base.evaluate(*val)["mse"]
+
+    auto = AutoXGBoost(task="regression", metric="mse")
+    auto.fit(train, validation_data=val,
+             search_space={"n_estimators": hp.choice([25, 50, 100]),
+                           "max_depth": hp.choice([3, 5, 7]),
+                           "learning_rate": hp.loguniform(0.03, 0.3)},
+             n_sampling=args.samples)
+    tuned_mse = auto.evaluate(*val)["mse"] if hasattr(auto, "evaluate") \
+        else float(np.mean((auto.predict(val[0]) - val[1]) ** 2))
+    print(f"untuned mse={base_mse:.4f}  tuned mse={tuned_mse:.4f}  "
+          f"best={auto.best_config}")
+    assert tuned_mse < base_mse
+    stop_orca_context()
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -89,7 +89,8 @@ _EXAMPLES = ["ncf_movielens.py", "dogs_vs_cats_resnet.py",
              "autots_forecasting.py", "cluster_serving_roundtrip.py",
              "text_classification.py", "torch_finetune.py",
              "image_classification_inference.py", "anomaly_detection.py",
-             "wide_n_deep_recommendation.py", "variational_autoencoder.py"]
+             "wide_n_deep_recommendation.py", "variational_autoencoder.py",
+             "seq2seq_forecast.py", "auto_xgboost_regression.py"]
 
 
 @pytest.mark.parametrize("script", _EXAMPLES)
@@ -109,6 +110,8 @@ def test_example_runs(script):
         args += ["--epochs", "2"]
     if script == "anomaly_detection.py":
         args += ["--epochs", "3"]
+    if script == "auto_xgboost_regression.py":
+        args += ["--samples", "4"]
     proc = subprocess.run(args, capture_output=True, text=True, timeout=900,
                           env=env)
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]

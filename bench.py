@@ -133,6 +133,35 @@ def bench_bert(batch_size=64, seq_len=128, steps_per_epoch=48,
     return sps, tokens_per_sec, flops_per_sample * sps
 
 
+def bench_llama(batch_size=64, seq_len=512, steps_per_epoch=6):
+    """GPT2-small-scale Llama causal LM (the round-2 flagship family):
+    next-token training, analytic matmul FLOPs like bench_bert."""
+    from zoo_tpu.models.llm import Llama, LlamaConfig
+    from zoo_tpu.pipeline.api.keras import Sequential
+    from zoo_tpu.pipeline.api.keras.optimizers import AdamWeightDecay
+
+    cfg = LlamaConfig(vocab=32000, hidden=768, n_block=12, n_head=12,
+                      n_kv_head=4, intermediate=2048, rope_theta=10000.0)
+    m = Sequential()
+    m.add(Llama(cfg, remat=True, input_shape=(seq_len,)))
+    m.compile(optimizer=AdamWeightDecay(lr=1e-4),
+              loss="sparse_categorical_crossentropy_from_logits",
+              dtype_policy="mixed_bfloat16")
+    n = batch_size * steps_per_epoch
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, cfg.vocab, (n, seq_len)).astype(np.int32)
+    labels = np.roll(ids, -1, axis=1)
+    sps = _timed_fit(m, ids, labels, batch_size)
+    h, kv = cfg.hidden, cfg.n_kv_head * cfg.head_dim
+    fwd_per_token = cfg.n_block * (
+        2 * (h * h * 2 + 2 * h * kv)          # q,o + k,v projections
+        + 2 * 3 * h * cfg.intermediate        # gate/up/down
+        + 4 * seq_len * h                     # attention scores+values
+    ) + 2 * h * cfg.vocab                     # lm head
+    flops_per_sample = 3 * fwd_per_token * seq_len
+    return sps * seq_len, flops_per_sample * sps
+
+
 def main():
     import jax
 
@@ -166,6 +195,13 @@ def main():
                 bert_mfu = b_flops / peak
         except Exception as e:  # noqa: BLE001
             extra["bert_error"] = repr(e)
+        try:
+            l_tps, l_flops = bench_llama()
+            extra["llama_tokens_per_sec"] = round(l_tps, 1)
+            if peak == peak:
+                extra["llama_mfu"] = round(l_flops / peak, 4)
+        except Exception as e:  # noqa: BLE001
+            extra["llama_error"] = repr(e)
     finally:
         stop_orca_context()
 

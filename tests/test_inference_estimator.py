@@ -1,0 +1,78 @@
+"""Inference-only estimator (reference OpenVINO estimator surface):
+predict over arrays and XShards, fit refuses, int8 path."""
+
+import numpy as np
+import pytest
+
+from zoo_tpu.orca.data.shard import LocalXShards
+from zoo_tpu.orca.learn.inference import Estimator
+from zoo_tpu.pipeline.api.keras.engine.topology import Sequential
+from zoo_tpu.pipeline.api.keras.layers import Dense
+
+
+@pytest.fixture(scope="module")
+def saved_model(tmp_path_factory):
+    m = Sequential(name="inf_est")
+    m.add(Dense(16, activation="relu", input_shape=(8,)))
+    m.add(Dense(3))
+    m.build()
+    p = str(tmp_path_factory.mktemp("m") / "m.zoo")
+    m.save(p)
+    x = np.random.RandomState(0).randn(32, 8).astype(np.float32)
+    return p, x, np.asarray(m.predict(x, batch_size=32))
+
+
+def test_predict_arrays(saved_model):
+    p, x, ref = saved_model
+    est = Estimator.from_model(p)
+    np.testing.assert_allclose(est.predict(x, batch_size=16), ref,
+                               atol=1e-5)
+
+
+def test_predict_xshards(saved_model):
+    p, x, ref = saved_model
+    est = Estimator.from_model(p)
+    shards = LocalXShards.partition({"x": x}, num_shards=4)
+    out = est.predict(shards, batch_size=16)
+    got = np.concatenate([s["prediction"] for s in out.collect()])
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+def test_fit_refuses(saved_model):
+    p, _, _ = saved_model
+    with pytest.raises(NotImplementedError, match="cannot fit"):
+        Estimator.from_model(p).fit(None, epochs=1)
+
+
+def test_quantized_path(saved_model):
+    p, x, ref = saved_model
+    est = Estimator.from_model(p, quantize=True)
+    got = est.predict(x)
+    rel = np.max(np.abs(got - ref)) / (np.max(np.abs(ref)) + 1e-9)
+    assert rel < 0.03
+
+
+def test_openvino_shim_names_migrations(saved_model):
+    with pytest.raises(NotImplementedError, match="from_tf"):
+        Estimator.from_openvino(model_path="x.xml")
+
+
+def test_bare_array_shards(saved_model):
+    p, x, ref = saved_model
+    est = Estimator.from_model(p)
+    out = est.predict(LocalXShards.partition(x, num_shards=4))
+    got = np.concatenate([s["prediction"] for s in out.collect()])
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+def test_multi_output_model(tmp_path):
+    from zoo_tpu.pipeline.api.keras.engine.topology import Input, Model
+    a = Input(shape=(6,))
+    model = Model(input=a, output=[Dense(2)(a), Dense(4)(a)])
+    model.build()
+    p = str(tmp_path / "multi.zoo")
+    model.save(p)
+    x = np.random.RandomState(1).randn(10, 6).astype(np.float32)
+    out = Estimator.from_model(p).predict(x, batch_size=5)
+    assert isinstance(out, list) and len(out) == 2
+    assert out[0].shape == (10, 2) and out[1].shape == (10, 4)

@@ -1,0 +1,6 @@
+from zoo_tpu.orca.learn.inference.estimator import (  # noqa: F401
+    Estimator,
+    InferenceEstimator,
+)
+
+__all__ = ["Estimator", "InferenceEstimator"]

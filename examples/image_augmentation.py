@@ -1,0 +1,87 @@
+"""Image augmentation pipeline (reference:
+``pyzoo/zoo/examples/imageclassification`` preprocessing +
+``apps/image-augmentation`` notebook): chain the ImageSet transformer
+zoo — color jitter, random crop/flip/aspect scale — and feed the result
+straight into training via ``ImageSet.to_arrays`` (swap in
+``to_xshards()`` for the sharded estimator path).
+
+Run: python examples/image_augmentation.py [--epochs 4]
+"""
+
+import argparse
+import random
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=4)
+    args = ap.parse_args()
+
+    from zoo_tpu.orca import init_orca_context, stop_orca_context
+    from zoo_tpu.feature.common import ChainedPreprocessing
+    from zoo_tpu.feature.image import (
+        ImageBrightness,
+        ImageChannelNormalize,
+        ImageFeature,
+        ImageHFlip,
+        ImageMatToTensor,
+        ImageRandomCrop,
+        ImageRandomPreprocessing,
+        ImageResize,
+        ImageSet,
+        ImageSetToSample,
+    )
+    from zoo_tpu.orca.learn.keras import Estimator
+    from zoo_tpu.pipeline.api.keras.engine.topology import Sequential
+    from zoo_tpu.pipeline.api.keras.layers import Conv2D, Dense, Flatten
+
+    init_orca_context(cluster_mode="local")
+    random.seed(0)  # image transformers draw from the random module
+    rs = np.random.RandomState(0)
+    # two classes: bright blobs top-left vs bottom-right
+    feats = []
+    for i in range(240):
+        img = (rs.rand(40, 40, 3) * 60).astype(np.uint8)
+        label = i % 2
+        y0, x0 = (4, 4) if label == 0 else (24, 24)
+        img[y0:y0 + 12, x0:x0 + 12] += 150
+        feats.append(ImageFeature(image=img, label=label,
+                                  uri=f"img_{i}.jpg"))
+    image_set = ImageSet(feats)
+
+    augment = ChainedPreprocessing([
+        ImageResize(36, 36),
+        ImageRandomPreprocessing(ImageBrightness(-20, 20), 0.5),
+        ImageRandomPreprocessing(ImageHFlip(), 0.0),  # flip would swap cls
+        ImageRandomCrop(32, 32),
+        ImageChannelNormalize(110.0, 110.0, 110.0, 60.0, 60.0, 60.0),
+        ImageMatToTensor(format="NHWC"),
+        ImageSetToSample(),
+    ])
+    transformed = image_set.transform(augment)
+    x, y = transformed.to_arrays()
+    print("augmented batch:", x.shape, "labels:", y.shape)
+
+    m = Sequential()
+    m.add(Conv2D(8, 3, 3, subsample=(2, 2), activation="relu",
+                 border_mode="same", dim_ordering="tf",
+                 input_shape=(32, 32, 3)))
+    m.add(Conv2D(8, 3, 3, subsample=(2, 2), activation="relu",
+                 border_mode="same", dim_ordering="tf"))
+    m.add(Flatten())
+    m.add(Dense(2, activation="softmax"))
+    m.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+              metrics=["accuracy"])
+    est = Estimator.from_keras(m)
+    est.fit({"x": x, "y": y}, epochs=args.epochs, batch_size=48)
+    res = est.evaluate({"x": x, "y": y}, batch_size=240)
+    print("train-set accuracy:", res)
+    assert res["accuracy"] > 0.9, res
+    stop_orca_context()
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+"""``zoo`` — drop-in import-compatibility package.
+
+The reference's user-facing package is ``zoo`` (``pyzoo/zoo``). This
+package lets reference user code run against the TPU rebuild without
+editing imports: a meta-path finder forwards every ``zoo.X.Y`` import to
+``zoo_tpu.X.Y`` (the module objects ARE the zoo_tpu modules — one
+implementation, two import names). Anything zoo_tpu does not implement
+surfaces as the ordinary ModuleNotFoundError for ``zoo_tpu.X``.
+
+    from zoo.orca import init_orca_context          # reference line
+    from zoo.pipeline.api.keras.layers import Dense  # works unmodified
+"""
+
+import importlib
+import importlib.abc
+import importlib.util
+import sys
+
+
+class _ZooForwarder(importlib.abc.MetaPathFinder, importlib.abc.Loader):
+    def find_spec(self, fullname, path=None, target=None):
+        if not fullname.startswith("zoo."):
+            return None
+        real = "zoo_tpu." + fullname[len("zoo."):]
+        try:
+            real_spec = importlib.util.find_spec(real)
+        except ModuleNotFoundError:
+            return None
+        if real_spec is None:
+            return None
+        return importlib.util.spec_from_loader(
+            fullname, self, origin=real_spec.origin,
+            is_package=real_spec.submodule_search_locations is not None)
+
+    def create_module(self, spec):
+        # the forwarded module IS the zoo_tpu module (identity, not copy)
+        module = importlib.import_module(
+            "zoo_tpu." + spec.name[len("zoo."):])
+        # the import machinery will overwrite the module's metadata with
+        # the zoo-named spec; stash the real values to restore after
+        self._stash = {a: getattr(module, a, None)
+                       for a in ("__spec__", "__loader__", "__name__",
+                                 "__package__", "__path__")}
+        return module
+
+    def exec_module(self, module):
+        # restore the zoo_tpu identity the loader protocol clobbered —
+        # importlib.reload / find_spec on the real name must keep working
+        for attr, val in self._stash.items():
+            if val is not None:
+                setattr(module, attr, val)
+
+
+if not any(isinstance(f, _ZooForwarder) for f in sys.meta_path):
+    sys.meta_path.insert(0, _ZooForwarder())
+
+# the reference exposes its version here
+__version__ = "2.0.0-tpu"
+
+
+def __getattr__(name):
+    """Top-level reference idioms (``from zoo import init_nncontext``;
+    the reference's ``zoo/__init__.py`` star-re-exported nncontext)."""
+    from zoo_tpu.common import nncontext
+    if hasattr(nncontext, name):
+        return getattr(nncontext, name)
+    import zoo_tpu
+    if hasattr(zoo_tpu, name):
+        return getattr(zoo_tpu, name)
+    raise AttributeError(f"module 'zoo' has no attribute {name!r}")

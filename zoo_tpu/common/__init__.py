@@ -1,3 +1,9 @@
 from zoo_tpu.common.context import ZooContext, RuntimeContext, get_runtime_context
+from zoo_tpu.common.nncontext import (  # noqa: F401 — reference re-export
+    init_nncontext,
+    init_spark_on_local,
+    init_spark_on_yarn,
+)
 
-__all__ = ["ZooContext", "RuntimeContext", "get_runtime_context"]
+__all__ = ["ZooContext", "RuntimeContext", "get_runtime_context",
+           "init_nncontext", "init_spark_on_local", "init_spark_on_yarn"]

@@ -1,0 +1,50 @@
+"""nncontext compatibility layer (reference:
+``pyzoo/zoo/common/nncontext.py:31,56,335`` — ``init_spark_on_local`` /
+``init_spark_on_yarn`` / ``init_nncontext`` returned a SparkContext with
+the BigDL engine initialized).
+
+There is no Spark here; each entry point boots the TPU runtime context
+instead (the object whose lifecycle matches the SparkContext's role:
+created once, carries the cluster/mesh handles, torn down at exit).
+Reference scripts that do ``sc = init_nncontext()`` and only thread
+``sc`` through to zoo APIs run unmodified — every zoo_tpu API reads the
+process-global context and ignores a passed ``sc``.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Optional
+
+from zoo_tpu.orca.common import init_orca_context
+
+
+def init_nncontext(conf=None, spark_log_level: str = "WARN",
+                   redirect_spark_log: bool = True, **kwargs):
+    """reference ``init_nncontext:335``; returns the runtime context."""
+    return init_orca_context(cluster_mode="local")
+
+
+def init_spark_on_local(cores=2, conf=None, python_location=None,
+                        spark_log_level: str = "WARN", **kwargs):
+    """reference ``init_spark_on_local:31``; ``cores`` sizes the host
+    input-pipeline pool."""
+    return init_orca_context(cluster_mode="local",
+                             cores=None if cores in ("*", None)
+                             else int(cores))
+
+
+def init_spark_on_yarn(hadoop_conf=None, conda_name: Optional[str] = None,
+                       num_executors: int = 1, executor_cores: int = 2,
+                       executor_memory: str = "2g", **kwargs):
+    """reference ``init_spark_on_yarn:56``. There is no YARN on a TPU
+    pod; the nearest launch story is one process per TPU host (see
+    ``scripts/run_tpu_pod.sh`` / ``zoo_tpu.orca.bootstrap``)."""
+    warnings.warn(
+        "init_spark_on_yarn: no YARN on TPU — starting the multi-host "
+        "JAX runtime instead (num_executors maps to num_nodes); launch "
+        "one process per host via scripts/run_tpu_pod.sh or "
+        "python -m zoo_tpu.orca.bootstrap", stacklevel=2)
+    return init_orca_context(cluster_mode="tpu",
+                             num_nodes=int(num_executors),
+                             cores=int(executor_cores))

@@ -1,0 +1,13 @@
+"""Keras models namespace (reference:
+``pyzoo/zoo/pipeline/api/keras/models.py`` — exposes Sequential/Model).
+The engine lives in ``engine.topology``; this module is the reference's
+import path for it."""
+
+from zoo_tpu.pipeline.api.keras.engine.topology import (  # noqa: F401
+    Input,
+    KerasNet,
+    Model,
+    Sequential,
+)
+
+__all__ = ["Input", "KerasNet", "Model", "Sequential"]

@@ -91,7 +91,8 @@ _EXAMPLES = ["ncf_movielens.py", "dogs_vs_cats_resnet.py",
              "image_classification_inference.py", "anomaly_detection.py",
              "wide_n_deep_recommendation.py", "variational_autoencoder.py",
              "seq2seq_forecast.py", "auto_xgboost_regression.py",
-             "session_recommendation.py", "image_augmentation.py"]
+             "session_recommendation.py", "image_augmentation.py",
+             "multihost_training.py"]
 
 
 @pytest.mark.parametrize("script", _EXAMPLES)

@@ -27,6 +27,47 @@ def _free_ports(n):
             s.close()
 
 
+def _launch_cli(args, extra_env=None):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.getcwd() + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra_env or {})
+    return subprocess.Popen(
+        [sys.executable, "-m", "zoo_tpu.serving.run", *args],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+
+
+def _wait_for_port(proc, port, timeout=120):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=1):
+                return
+        except OSError:
+            assert proc.poll() is None, proc.stdout.read()[-2000:]
+            time.sleep(0.3)
+    raise TimeoutError("serving CLI never opened the HTTP port")
+
+
+def _http_predict(port, x):
+    body = json.dumps({"instances": [{"t": x.tolist()}]}).encode()
+    resp = json.loads(urllib.request.urlopen(
+        urllib.request.Request(
+            f"http://127.0.0.1:{port}/predict", data=body,
+            headers={"Content-Type": "application/json"}),
+        timeout=60).read())
+    val = json.loads(json.loads(resp["predictions"][0])["value"])
+    return np.asarray(val["data"], np.float32).reshape(-1)
+
+
+def _terminate(proc):
+    proc.send_signal(signal.SIGTERM)
+    try:
+        proc.wait(timeout=15)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
 def test_serving_cli_roundtrip(tmp_path):
     from zoo_tpu.pipeline.api.keras.engine.topology import Sequential
     from zoo_tpu.pipeline.api.keras.layers import Dense
@@ -41,26 +82,12 @@ def test_serving_cli_roundtrip(tmp_path):
     ref = np.asarray(m.predict(x[None], batch_size=1))[0]
 
     redis_port, http_port = _free_ports(2)
-    env = dict(os.environ)
-    env["PYTHONPATH"] = os.getcwd() + os.pathsep + env.get("PYTHONPATH", "")
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "zoo_tpu.serving.run", "--model", model_path,
-         "--redis-port", str(redis_port), "--http-port", str(http_port),
-         "--batch-size", "4"],
-        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-        text=True)
+    proc = _launch_cli(["--model", model_path,
+                        "--redis-port", str(redis_port),
+                        "--http-port", str(http_port),
+                        "--batch-size", "4"])
     try:
-        deadline = time.time() + 120
-        while time.time() < deadline:
-            try:
-                with socket.create_connection(("127.0.0.1", http_port),
-                                              timeout=1):
-                    break
-            except OSError:
-                assert proc.poll() is None, proc.stdout.read()[-2000:]
-                time.sleep(0.3)
-        else:
-            raise TimeoutError("serving CLI never opened the HTTP port")
+        _wait_for_port(proc, http_port)
 
         # redis-protocol path
         from zoo_tpu.serving.client import InputQueue, OutputQueue
@@ -78,23 +105,67 @@ def test_serving_cli_roundtrip(tmp_path):
             np.asarray(got).reshape(-1), ref, atol=1e-4)
 
         # http path
-        body = json.dumps(
-            {"instances": [{"t": x.tolist()}]}).encode()
-        resp = json.loads(urllib.request.urlopen(
-            urllib.request.Request(
-                f"http://127.0.0.1:{http_port}/predict", data=body,
-                headers={"Content-Type": "application/json"}),
-            timeout=60).read())
-        val = json.loads(json.loads(resp["predictions"][0])["value"])
-        pred = np.asarray(val["data"], np.float32).reshape(-1)
-        np.testing.assert_allclose(pred, ref, atol=1e-4)
-
+        np.testing.assert_allclose(_http_predict(http_port, x), ref,
+                                   atol=1e-4)
         metrics = json.loads(urllib.request.urlopen(
             f"http://127.0.0.1:{http_port}/metrics", timeout=30).read())
         assert any("inference" in str(k) for k in metrics)
     finally:
-        proc.send_signal(signal.SIGTERM)
-        try:
-            proc.wait(timeout=15)
-        except subprocess.TimeoutExpired:
-            proc.kill()
+        _terminate(proc)
+
+
+def test_serving_cli_encrypted_model(tmp_path):
+    """Trusted-serving parity: the CLI serves an encrypted-at-rest model
+    with the key from env (explicit --encrypted opt-in)."""
+    from zoo_tpu.pipeline.api.keras.engine.topology import Sequential
+    from zoo_tpu.pipeline.api.keras.layers import Dense
+    from zoo_tpu.pipeline.inference.inference_model import save_encrypted
+
+    m = Sequential(name="enc_served")
+    m.add(Dense(4, input_shape=(2,)))
+    m.build()
+    enc = str(tmp_path / "m.enc")
+    save_encrypted(m, enc, "kms-secret", "kms-salt", mode="gcm")
+    x = np.random.RandomState(0).randn(2).astype(np.float32)
+    ref = np.asarray(m.predict(x[None], batch_size=1))[0]
+
+    redis_port, http_port = _free_ports(2)
+    proc = _launch_cli(
+        ["--model", enc, "--encrypted",
+         "--redis-port", str(redis_port), "--http-port", str(http_port)],
+        extra_env={"ZOO_MODEL_SECRET": "kms-secret",
+                   "ZOO_MODEL_SALT": "kms-salt",
+                   "ZOO_MODEL_ENC_MODE": "gcm"})
+    try:
+        _wait_for_port(proc, http_port)
+        np.testing.assert_allclose(_http_predict(http_port, x), ref,
+                                   atol=1e-4)
+    finally:
+        _terminate(proc)
+
+
+def test_plaintext_model_ignores_stray_secret_env(tmp_path):
+    """A stray ZOO_MODEL_SECRET in the environment must not reroute a
+    plaintext model through decryption."""
+    from zoo_tpu.pipeline.api.keras.engine.topology import Sequential
+    from zoo_tpu.pipeline.api.keras.layers import Dense
+
+    m = Sequential(name="plain_served")
+    m.add(Dense(2, input_shape=(2,)))
+    m.build()
+    path = str(tmp_path / "m.zoo")
+    m.save(path)
+    x = np.random.RandomState(1).randn(2).astype(np.float32)
+    ref = np.asarray(m.predict(x[None], batch_size=1))[0]
+
+    redis_port, http_port = _free_ports(2)
+    proc = _launch_cli(
+        ["--model", path, "--redis-port", str(redis_port),
+         "--http-port", str(http_port)],
+        extra_env={"ZOO_MODEL_SECRET": "leftover-from-other-deploy"})
+    try:
+        _wait_for_port(proc, http_port)
+        np.testing.assert_allclose(_http_predict(http_port, x), ref,
+                                   atol=1e-4)
+    finally:
+        _terminate(proc)

@@ -48,6 +48,17 @@ def main(argv=None) -> int:
                          "boot the in-process RESP server; auto: probe "
                          "briefly, then fall back to embedded")
     ap.add_argument("--redis-wait", type=float, default=60.0)
+    ap.add_argument("--encrypted", action="store_true",
+                    help="the model file is encrypted at rest (reference "
+                         "trusted serving); key material comes from "
+                         "--model-secret/--model-salt or the "
+                         "ZOO_MODEL_SECRET/ZOO_MODEL_SALT env")
+    ap.add_argument("--model-secret", default=None)
+    ap.add_argument("--model-salt", default=None)
+    ap.add_argument("--model-enc-mode", default=None,
+                    choices=["cbc", "gcm"],
+                    help="cipher mode of the encrypted model "
+                         "(ZOO_MODEL_ENC_MODE env; default cbc)")
     ns = ap.parse_args(argv)
 
     if ns.config:
@@ -103,6 +114,23 @@ def main(argv=None) -> int:
     import os
     if os.path.isdir(ns.model):
         im.load_tf(ns.model, batch_size=ns.batch_size)
+    elif ns.encrypted or ns.model_secret is not None:
+        # encrypted at rest (reference trusted-realtime-ml): decrypted in
+        # memory only; key material arrives via flags or env (a KMS hook
+        # in production), never in the model file's directory. Plaintext
+        # models are NEVER rerouted here by a stray env var — the branch
+        # needs the explicit --encrypted/--model-secret opt-in.
+        secret = ns.model_secret or os.environ.get("ZOO_MODEL_SECRET")
+        salt = ns.model_salt or os.environ.get("ZOO_MODEL_SALT")
+        if not secret:
+            ap.error("--encrypted needs --model-secret or "
+                     "ZOO_MODEL_SECRET")
+        mode = (ns.model_enc_mode
+                or os.environ.get("ZOO_MODEL_ENC_MODE", "cbc"))
+        if mode not in ("cbc", "gcm"):
+            ap.error(f"invalid cipher mode {mode!r} (cbc|gcm)")
+        im.load_encrypted(ns.model, secret, salt or "", mode=mode,
+                          batch_size=ns.batch_size, quantize=ns.quantize)
     else:
         im.load(ns.model, batch_size=ns.batch_size,
                 quantize=ns.quantize)

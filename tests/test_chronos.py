@@ -272,3 +272,93 @@ def test_concurrent_search_engine(orca_ctx):
     eng2.compile(trial_with_reporter, {"a": grid_search([1, 2])}, metric="mse")
     eng2.run()
     assert all(v == 5 for v in stopped_at.values())
+
+
+# -- round-2 depth: rolling/global feature generation -------------------
+
+def _two_id_df(n=60):
+    import pandas as pd
+    rows = []
+    for sid in ("a", "b"):
+        base = 1.0 if sid == "a" else 10.0
+        t = np.arange(n)
+        rows.append(pd.DataFrame({
+            "datetime": pd.date_range("2024-01-01", periods=n, freq="h"),
+            "id": sid,
+            "value": base + np.sin(t / 5.0)}))
+    return pd.concat(rows, ignore_index=True)
+
+
+def test_gen_rolling_feature_minimal():
+    from zoo_tpu.chronos.data import TSDataset
+    ts = TSDataset.from_pandas(_two_id_df(), dt_col="datetime",
+                               target_col="value", id_col="id")
+    ts.gen_rolling_feature(window_size=6)
+    for stat in ("mean", "std", "min", "max", "median"):
+        assert f"value_rolling_{stat}" in ts.feature_col
+    df = ts.to_pandas()
+    assert not df.isna().any().any()
+    # windows never cross id boundaries: id 'b' rows stay near base 10
+    b = df[df.id == "b"]
+    assert b["value_rolling_mean"].min() > 5.0
+
+
+def test_gen_rolling_feature_comprehensive_and_roll():
+    from zoo_tpu.chronos.data import TSDataset
+    ts = TSDataset.from_pandas(_two_id_df(), dt_col="datetime",
+                               target_col="value", id_col="id")
+    ts.gen_rolling_feature(window_size=6, settings="comprehensive")
+    assert "value_rolling_trend_slope" in ts.feature_col
+    x, y = ts.roll(lookback=12, horizon=2).to_numpy()
+    assert x.shape[-1] == 1 + len(ts.feature_col)
+    assert np.isfinite(x).all() and np.isfinite(y).all()
+
+
+def test_gen_global_feature():
+    from zoo_tpu.chronos.data import TSDataset
+    ts = TSDataset.from_pandas(_two_id_df(), dt_col="datetime",
+                               target_col="value", id_col="id")
+    ts.gen_global_feature(settings="comprehensive")
+    df = ts.to_pandas()
+    # constant per id, different across ids
+    a = df[df.id == "a"]["value_global_mean"]
+    b = df[df.id == "b"]["value_global_mean"]
+    assert a.nunique() == 1 and b.nunique() == 1
+    assert abs(a.iloc[0] - b.iloc[0]) > 5.0
+    assert "value_global_autocorr1" in ts.feature_col
+    with pytest.raises(ValueError, match="minimal"):
+        ts.gen_global_feature(settings="weird")
+
+
+def test_rolling_std_no_cross_id_leak():
+    """First-row NaN std must fill from THIS id, not the previous one."""
+    import pandas as pd
+    from zoo_tpu.chronos.data import TSDataset
+    n = 30
+    rows = []
+    for sid, scale in (("a", 1.0), ("b", 50.0)):
+        rs = np.random.RandomState(0 if sid == "a" else 1)
+        rows.append(pd.DataFrame({
+            "datetime": pd.date_range("2024-01-01", periods=n, freq="h"),
+            "id": sid, "value": scale * rs.randn(n)}))
+    ts = TSDataset.from_pandas(pd.concat(rows, ignore_index=True),
+                               dt_col="datetime", target_col="value",
+                               id_col="id")
+    ts.gen_rolling_feature(window_size=6)
+    df = ts.to_pandas()
+    b_first_std = df[df.id == "b"]["value_rolling_std"].iloc[0]
+    assert b_first_std > 5.0, b_first_std  # from id b, not id a's ~1.0
+
+
+def test_trend_slope_exact_on_linear_series():
+    import pandas as pd
+    from zoo_tpu.chronos.data import TSDataset
+    n = 20
+    df = pd.DataFrame({
+        "datetime": pd.date_range("2024-01-01", periods=n, freq="h"),
+        "value": np.arange(n, dtype=np.float64)})
+    ts = TSDataset.from_pandas(df, dt_col="datetime", target_col="value")
+    ts.gen_rolling_feature(window_size=6, settings="comprehensive")
+    slopes = ts.to_pandas()["value_rolling_trend_slope"].to_numpy()
+    # slope of a unit-slope line is 1.0 for every window size > 1
+    np.testing.assert_allclose(slopes[1:], 1.0, atol=1e-9)

@@ -126,6 +126,105 @@ class TSDataset:
                 self.feature_col.append(f)
         return self
 
+    def gen_rolling_feature(self, window_size: int,
+                            settings: str = "minimal",
+                            cols: Optional[Sequence[str]] = None):
+        """Rolling statistical features per target column (reference:
+        ``gen_rolling_feature`` — tsfresh ``MinimalFCParameters`` /
+        ``ComprehensiveFCParameters`` over rolled windows; this rebuild
+        computes the same statistic families natively, no tsfresh).
+
+        ``settings="minimal"``: mean/std/min/max/median over the trailing
+        ``window_size`` steps; ``"comprehensive"`` adds quantiles,
+        absolute energy, mean abs change and linear-trend slope. Features
+        are appended as columns named ``<col>_rolling_<stat>`` (leading
+        rows without a full window are backfilled)."""
+        if settings not in ("minimal", "comprehensive"):
+            raise ValueError("settings must be minimal | comprehensive")
+        cols = list(cols) if cols is not None else list(self.target_col)
+
+        def _stats(roll):
+            out = {"mean": roll.mean(), "std": roll.std(),
+                   "min": roll.min(), "max": roll.max(),
+                   "median": roll.median()}
+            if settings == "comprehensive":
+                out["q25"] = roll.quantile(0.25)
+                out["q75"] = roll.quantile(0.75)
+                out["abs_energy"] = roll.apply(
+                    lambda v: float(np.sum(np.square(v))), raw=True)
+                out["mean_abs_change"] = roll.apply(
+                    lambda v: float(np.mean(np.abs(np.diff(v))))
+                    if len(v) > 1 else 0.0, raw=True)
+
+                def _slope(v):
+                    idx = np.arange(len(v), dtype=np.float64)
+                    denom = float(((idx - idx.mean()) ** 2).sum()) or 1.0
+                    return float(((idx - idx.mean())
+                                  * (v - v.mean())).sum() / denom)
+
+                out["trend_slope"] = roll.apply(_slope, raw=True)
+            return out
+
+        parts = []
+        for g in self._groups():
+            block = {}
+            for c in cols:
+                roll = g[c].rolling(window_size, min_periods=1)
+                for stat, series in _stats(roll).items():
+                    block[f"{c}_rolling_{stat}"] = series.to_numpy()
+            # fill WITHIN the group: a global ffill would leak the previous
+            # id's trailing stats into this id's NaN leading rows
+            parts.append(pd.DataFrame(block, index=g.index)
+                         .ffill().bfill().fillna(0.0))
+        feats = pd.concat(parts).sort_index()
+        for name in feats.columns:
+            self.df[name] = feats[name]
+            if name not in self.feature_col:
+                self.feature_col.append(name)
+        return self
+
+    def gen_global_feature(self, settings: str = "minimal",
+                           cols: Optional[Sequence[str]] = None):
+        """Whole-series statistics per id, broadcast as constant feature
+        columns (reference: ``gen_global_feature`` via tsfresh
+        ``extract_features``; same statistic families natively).
+
+        ``minimal``: mean/std/min/max; ``comprehensive`` adds skewness,
+        kurtosis and lag-1 autocorrelation. Columns are named
+        ``<col>_global_<stat>``."""
+        if settings not in ("minimal", "comprehensive"):
+            raise ValueError("settings must be minimal | comprehensive")
+        cols = list(cols) if cols is not None else list(self.target_col)
+
+        def _stats(v: np.ndarray):
+            out = {"mean": float(np.mean(v)), "std": float(np.std(v)),
+                   "min": float(np.min(v)), "max": float(np.max(v))}
+            if settings == "comprehensive":
+                sd = np.std(v) or 1.0
+                z = (v - np.mean(v)) / sd
+                out["skew"] = float(np.mean(z ** 3))
+                out["kurtosis"] = float(np.mean(z ** 4) - 3.0)
+                out["autocorr1"] = (
+                    float(np.corrcoef(v[:-1], v[1:])[0, 1])
+                    if len(v) > 2 and np.std(v[:-1]) > 0
+                    and np.std(v[1:]) > 0 else 0.0)
+            return out
+
+        parts = []
+        for g in self._groups():
+            block = {}
+            for c in cols:
+                v = g[c].to_numpy(dtype=np.float64)
+                for stat, val in _stats(v).items():
+                    block[f"{c}_global_{stat}"] = val
+            parts.append(pd.DataFrame(block, index=g.index))
+        feats = pd.concat(parts).sort_index()
+        for name in feats.columns:  # one batched assign per column
+            self.df[name] = feats[name]
+            if name not in self.feature_col:
+                self.feature_col.append(name)
+        return self
+
     # -- scaling -----------------------------------------------------------
     def scale(self, scaler, fit: bool = True):
         """sklearn-style scaler over target+feature cols (reference keeps

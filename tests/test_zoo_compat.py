@@ -68,3 +68,16 @@ def test_spec_not_clobbered():
     assert real.__path__  # non-empty: submodules stay importable
     importlib.reload(real)
     import zoo_tpu.orca.data  # noqa: F401 — would fail on a bad spec
+
+
+def test_collapsed_fabric_shims_redirect():
+    """Reference fabric import paths resolve and name the migration."""
+    from zoo.orca.learn.horovod import HorovodRayRunner
+    from zoo.orca.learn.mxnet import Estimator as MXEstimator
+    from zoo.orca.learn.mpi import MPIEstimator
+    with pytest.raises(NotImplementedError, match="mesh"):
+        HorovodRayRunner()
+    with pytest.raises(NotImplementedError, match="from_torch"):
+        MXEstimator.from_mxnet()
+    with pytest.raises(NotImplementedError, match="bootstrap"):
+        MPIEstimator()

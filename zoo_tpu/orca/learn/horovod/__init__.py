@@ -1,0 +1,23 @@
+"""Horovod fabric shim (reference: ``orca/learn/horovod`` +
+``horovod_ray_runner.py:81``).
+
+On TPU every data-parallel fabric — Horovod's ring allreduce included —
+collapses into XLA collectives over the ICI mesh (SURVEY §2.11), so
+there is nothing to run Horovod *on*. The reference import path resolves
+and points at the one fabric."""
+
+
+class HorovodRayRunner:
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(
+            "Horovod does not exist on TPU — data parallelism is XLA "
+            "collectives over the mesh. Use orca.learn.pytorch / "
+            "orca.learn.tf2 / orca.learn.keras Estimators; "
+            "init_orca_context(mesh_axes={'data': -1}) IS the allreduce "
+            "fabric")
+
+
+def run(*args, **kwargs):
+    raise NotImplementedError(
+        "Horovod does not exist on TPU — data parallelism is XLA "
+        "collectives over the mesh; see HorovodRayRunner's message")

@@ -1,0 +1,17 @@
+"""MPI fabric shim (reference: ``orca/learn/mpi/mpi_estimator.py:28`` —
+mpirun-launched training with plasma-staged partitions).
+
+The mpirun-one-process-per-host pattern maps directly onto the TPU
+launch story: ``python -m zoo_tpu.orca.bootstrap`` locally,
+``scripts/run_tpu_pod.sh`` on a pod (one process per host,
+``jax.distributed`` as the rendezvous). The reference import path
+resolves and redirects."""
+
+
+class MPIEstimator:
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(
+            "No MPI on TPU — the equivalent launch is one supervised "
+            "process per host: python -m zoo_tpu.orca.bootstrap "
+            "--nproc N train.py (dev box) or scripts/run_tpu_pod.sh "
+            "(pod); inside, use any orca Estimator")

@@ -1,0 +1,15 @@
+"""MXNet fabric shim (reference: ``orca/learn/mxnet/estimator.py`` —
+Ray actors split into kvstore servers and workers).
+
+MXNet has no TPU backend and the kvstore parameter server maps onto the
+same XLA-collective fabric as everything else (SURVEY §2.11). The
+reference import path resolves and redirects."""
+
+
+class Estimator:
+    @staticmethod
+    def from_mxnet(*args, **kwargs):
+        raise NotImplementedError(
+            "MXNet has no TPU backend. Port the model to a supported "
+            "frontend: orca.learn.pytorch Estimator.from_torch traces "
+            "any torch module; gluon models usually translate 1:1")

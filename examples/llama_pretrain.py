@@ -1,0 +1,66 @@
+"""Tiny-Llama causal-LM pre-training (BASELINE.md stretch family):
+decoder-only Llama (RMSNorm/RoPE/GQA/SwiGLU) trained next-token on a
+synthetic grammar, mixed-bf16 with rematerialized blocks — the exact
+recipe that scales to the 8B config under an FSDP×TP mesh
+(``docs/parallelism.md``).
+
+Run: python examples/llama_pretrain.py [--epochs 12]
+"""
+
+import argparse
+
+import numpy as np
+
+
+def make_corpus(n=512, seq=24, vocab=96, seed=0):
+    """Sequences from a 2-state grammar: even tokens step +2, odd step
+    +3 (mod vocab) — enough structure for a tiny LM to compress."""
+    rs = np.random.RandomState(seed)
+    starts = rs.randint(0, vocab, (n, 1))
+    ids = [starts]
+    for _ in range(seq):
+        prev = ids[-1]
+        ids.append(np.where(prev % 2 == 0, prev + 2, prev + 3) % vocab)
+    ids = np.concatenate(ids, axis=1)
+    return ids[:, :-1].astype(np.int32), ids[:, 1:].astype(np.int32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=12)
+    args = ap.parse_args()
+
+    from zoo_tpu.orca import init_orca_context, stop_orca_context
+    from zoo_tpu.models.llm import Llama, LlamaConfig, llama_param_count
+    from zoo_tpu.pipeline.api.keras.engine.topology import Sequential
+
+    init_orca_context(cluster_mode="local")
+    cfg = LlamaConfig(vocab=96, hidden=96, n_block=3, n_head=6,
+                      n_kv_head=2, intermediate=256, rope_theta=10000.0)
+    print(f"config: {llama_param_count(cfg) / 1e3:.1f}k params, "
+          f"GQA {cfg.n_head}q/{cfg.n_kv_head}kv")
+
+    x, y = make_corpus()
+    m = Sequential(name="tiny_llama_pretrain")
+    m.add(Llama(cfg, remat=True, input_shape=(x.shape[1],)))
+    m.compile(optimizer="adam",
+              loss="sparse_categorical_crossentropy_from_logits",
+              dtype_policy="mixed_bfloat16")
+    h = m.fit(x, y, batch_size=128, nb_epoch=args.epochs, verbose=0)
+    print(f"loss {h['loss'][0]:.3f} -> {h['loss'][-1]:.3f} "
+          f"(uniform would be {np.log(cfg.vocab):.3f})")
+    assert h["loss"][-1] < 1.0, h["loss"]  # grammar is deterministic
+
+    # greedy continuation follows the grammar
+    logits = np.asarray(m.predict(x[:4], batch_size=4))
+    nxt = logits[:, -1].argmax(-1)
+    want = np.where(x[:4, -1] % 2 == 0, x[:4, -1] + 2,
+                    x[:4, -1] + 3) % cfg.vocab
+    print("greedy next:", nxt, "expected:", want)
+    assert (nxt == want).mean() >= 0.75
+    stop_orca_context()
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

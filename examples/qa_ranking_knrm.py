@@ -1,0 +1,95 @@
+"""QA answer ranking with KNRM (reference:
+``pyzoo/zoo/examples/qaranker/qa_ranker.py``: TextSet relations + KNRM,
+pairwise training, listwise NDCG/MAP evaluation).
+
+Run: python examples/qa_ranking_knrm.py [--epochs 12]
+"""
+
+import argparse
+
+import numpy as np
+
+
+def make_qa_corpus(n_q=40, n_cand=6, seed=0):
+    """Questions about a topic word; the right answer repeats it."""
+    rs = np.random.RandomState(seed)
+    topics = ("planet star comet orbit moon galaxy nebula quasar "
+              "meteor cluster dust cloud").split()
+    filler = ("the a is of about tell me what how why fact info "
+              "detail item thing").split()
+    questions, answers, relations = [], [], []
+    aid = 0
+    for qid in range(n_q):
+        topic = topics[qid % len(topics)]
+        q_text = f"tell me about {topic} " + " ".join(
+            rs.choice(filler, 3))
+        questions.append((f"q{qid}", q_text))
+        pos = rs.randint(0, n_cand)
+        for c in range(n_cand):
+            if c == pos:
+                text = (f"{topic} " * 2 + " ".join(rs.choice(filler, 4)))
+                label = 1
+            else:
+                other = topics[(qid + 1 + c) % len(topics)]
+                text = (f"{other} " + " ".join(rs.choice(filler, 5)))
+                label = 0
+            answers.append((f"a{aid}", text))
+            relations.append((f"q{qid}", f"a{aid}", label))
+            aid += 1
+    return questions, answers, relations
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=12)
+    args = ap.parse_args()
+
+    from zoo_tpu.orca import init_orca_context, stop_orca_context
+    from zoo_tpu.feature.text import TextFeature, TextSet
+    from zoo_tpu.models.ranking import KNRM
+
+    init_orca_context(cluster_mode="local")
+    q_len, a_len = 8, 10
+    questions, answers, relations = make_qa_corpus()
+
+    q_set = TextSet([TextFeature(t, uri=u) for u, t in questions])
+    a_set = TextSet([TextFeature(t, uri=u) for u, t in answers])
+    q_set.tokenize().normalize()
+    a_set.tokenize().normalize()
+    # shared vocabulary: index answers with the question corpus map
+    q_set.word2idx(max_words_num=500)
+    a_set.word2idx(existing_map=q_set.get_word_index())
+    q_set.shape_sequence(len=q_len)
+    a_set.shape_sequence(len=a_len)
+    vocab = max(q_set.get_word_index().values()) + 2
+
+    pairs = TextSet.from_relation_pairs(relations, q_set, a_set)
+    x, y = pairs.to_arrays()
+    cut = int(0.8 * len(x))
+
+    model = KNRM(text1_length=q_len, text2_length=a_len,
+                 vocab_size=vocab, embed_size=32)
+    model.compile(optimizer="adam", loss="binary_crossentropy")
+    model.fit(x[:cut], y[:cut].astype(np.float32)[:, None],
+              batch_size=64, nb_epoch=args.epochs, verbose=0)
+
+    # listwise evaluation: rank each question's candidates
+    lists = TextSet.from_relation_lists(relations, q_set, a_set)
+    hits, total = 0, 0
+    for f in lists.features:
+        scores = np.asarray(model.predict(
+            np.asarray(f["indexedTokens"], np.int32),
+            batch_size=len(f["label"]))).ravel()
+        if f["label"][int(np.argmax(scores))] == 1:
+            hits += 1
+        total += 1
+    p_at_1 = hits / total
+    print(f"P@1 over {total} queries: {p_at_1:.2f} "
+          f"(random would be {1 / 6:.2f})")
+    assert p_at_1 > 0.4, p_at_1
+    stop_orca_context()
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -93,7 +93,8 @@ _EXAMPLES = ["ncf_movielens.py", "dogs_vs_cats_resnet.py",
              "seq2seq_forecast.py", "auto_xgboost_regression.py",
              "session_recommendation.py", "image_augmentation.py",
              "multihost_training.py", "image_similarity.py",
-             "llama_pretrain.py", "qa_ranking_knrm.py"]
+             "llama_pretrain.py", "qa_ranking_knrm.py",
+             "nnframes_pipeline.py"]
 
 
 @pytest.mark.parametrize("script", _EXAMPLES)

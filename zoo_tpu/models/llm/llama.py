@@ -12,9 +12,10 @@ natural FSDP/TP axes (fsdp shards the block axis or the largest matmul
 dim; model shards the matmul output dim — Megatron column style).
 
 Attention rides ``ops.attention.dot_product_attention`` — the Pallas
-flash kernel at long sequence, the XLA-fused dense path otherwise; the
-ring-attention sequence-parallel variant composes at the estimator level
-(``parallel/ring_attention.py``).
+flash kernel at long sequence, the XLA-fused dense path otherwise — or,
+with ``attention_impl="ring"``, the sequence-parallel ring kernel over
+the mesh ``seq`` axis (``parallel/ring_attention.py``), which carries
+the unrepeated GQA kv heads around the ICI ring.
 """
 
 from __future__ import annotations
@@ -106,11 +107,20 @@ class Llama(Layer):
     def __init__(self, config: Optional[LlamaConfig] = None,
                  lm_head: bool = True, init="glorot_uniform",
                  attention_impl: str = "auto", remat: bool = False,
-                 **kwargs):
+                 mesh=None, **kwargs):
         """``remat=True`` wraps each block in ``jax.checkpoint`` so the
         backward pass recomputes block activations instead of storing
         them — O(1) activation memory in depth, ~1.3x FLOPs; the standard
-        HBM/FLOPs trade for training larger batches/sequences."""
+        HBM/FLOPs trade for training larger batches/sequences.
+
+        ``attention_impl="ring"``: sequence-parallel ring attention over
+        the mesh ``seq`` axis (``parallel/ring_attention.py``) — shard
+        the token axis of the inputs over ``seq`` and context length
+        scales with the number of chips. Needs a mesh with a ``seq``
+        axis: pass ``mesh=`` or set one via
+        ``init_orca_context(mesh_axes={..., "seq": k})``. GQA note: the
+        ring kernel wants equal q/kv heads, so kv heads are broadcast
+        before the ring (same math as the dense path)."""
         super().__init__(**kwargs)
         self.cfg = config or LlamaConfig()
         if self.cfg.hidden % self.cfg.n_head:
@@ -121,6 +131,23 @@ class Llama(Layer):
         self.init = get_initializer(init)
         self.attention_impl = attention_impl
         self.remat = remat
+        self.mesh = mesh
+
+    def _seq_mesh(self):
+        mesh = self.mesh
+        if mesh is None:
+            from zoo_tpu.common.context import get_runtime_context
+            ctx = get_runtime_context(required=False)
+            mesh = getattr(ctx, "mesh", None) if ctx else None
+        # explicit meshes get the same validation as ambient ones: a
+        # missing/size-1 seq axis must fail HERE, not as a cryptic
+        # unresolved-axis error inside shard_map
+        if mesh is None or "seq" not in mesh.axis_names \
+                or mesh.shape.get("seq", 1) <= 1:
+            raise ValueError(
+                'attention_impl="ring" needs a mesh with a seq axis > 1; '
+                "pass mesh= or init_orca_context(mesh_axes={'seq': k})")
+        return mesh
 
     # -- params -----------------------------------------------------------
     def _block_params(self, rng):
@@ -170,12 +197,17 @@ class Llama(Layer):
         q = apply_rope(q.transpose(0, 2, 1, 3), cos, sin)
         k = apply_rope(k.transpose(0, 2, 1, 3), cos, sin)
         v = v.transpose(0, 2, 1, 3)
-        rep = c.n_head // c.n_kv_head
-        if rep > 1:  # GQA: broadcast kv groups to query heads
-            k = jnp.repeat(k, rep, axis=1)
-            v = jnp.repeat(v, rep, axis=1)
-        a = dot_product_attention(q, k, v, causal=True,
-                                  impl=self.attention_impl)
+        if self.attention_impl == "ring":
+            # GQA-aware kernel: the ring carries the unrepeated kv heads
+            from zoo_tpu.parallel.ring_attention import ring_attention
+            a = ring_attention(self._seq_mesh(), q, k, v, causal=True)
+        else:
+            rep = c.n_head // c.n_kv_head
+            if rep > 1:  # GQA: broadcast kv groups to query heads
+                k = jnp.repeat(k, rep, axis=1)
+                v = jnp.repeat(v, rep, axis=1)
+            a = dot_product_attention(q, k, v, causal=True,
+                                      impl=self.attention_impl)
         a = a.transpose(0, 2, 1, 3).reshape(B, T, c.hidden)
         h = h + a @ p["wo"]
         x = _rms_norm(h, p["mlp_norm"], c.rms_eps)

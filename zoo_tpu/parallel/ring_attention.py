@@ -26,15 +26,22 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
                           scale: Optional[float] = None):
-    """Runs INSIDE shard_map. q/k/v: local blocks (B, H, Tl, D)."""
+    """Runs INSIDE shard_map. q: (B, Hq, Tl, D); k/v: (B, Hkv, Tl, D)
+    with Hq a multiple of Hkv (GQA): the ring carries the UNREPEATED
+    kv blocks — the group broadcast happens locally per block, so ICI
+    traffic and resident K/V stay O(Hkv), not O(Hq)."""
     n = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     b, h, tl, d = q.shape
+    rep = h // k.shape[1]
     scale = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(q.dtype)
 
     q_pos = my_idx * tl + jnp.arange(tl)
 
     def block(q, k_blk, v_blk, src_idx, m, l, o):
+        if rep > 1:  # GQA: local broadcast only
+            k_blk = jnp.repeat(k_blk, rep, axis=1)
+            v_blk = jnp.repeat(v_blk, rep, axis=1)
         s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) * scale
         if causal:
             k_pos = src_idx * tl + jnp.arange(tl)
@@ -78,11 +85,16 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
 def ring_attention(mesh: Mesh, q, k, v, *, causal: bool = False,
                    seq_axis: str = "seq"):
     """Context-parallel attention of global (B, H, T, D) arrays sharded on
-    the T axis over ``seq_axis``. Returns output with the same sharding.
+    the T axis over ``seq_axis``. Returns output with q's sharding.
+    ``k``/``v`` may carry fewer (grouped/GQA) heads than ``q`` — the ring
+    rotates the small kv blocks and broadcasts per group locally.
 
     The reference equivalent does not exist; use this wherever a
     transformer's sequence no longer fits one chip.
     """
+    if q.shape[1] % k.shape[1]:
+        raise ValueError(f"q heads ({q.shape[1]}) must be a multiple of "
+                         f"kv heads ({k.shape[1]})")
     spec = P(None, None, seq_axis, None)
     fn = jax.shard_map(
         partial(_ring_attention_local, axis_name=seq_axis, causal=causal),

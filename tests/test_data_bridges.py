@@ -149,3 +149,71 @@ def test_tf2_estimator_dataset_path():
     est = Estimator.from_keras(model_creator=creator)
     h = est.fit(ds, epochs=2, batch_size=8)
     assert len(h["loss"]) == 2
+
+
+def test_orca_tf_dataset_builder():
+    """reference orca.data.tf.Dataset: from_tensor_slices + map chain."""
+    from zoo_tpu.orca.data.shard import LocalXShards
+    from zoo_tpu.orca.data.tf.data import Dataset
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(24, 4).astype(np.float32)
+    y = rs.randint(0, 2, 24).astype(np.int64)
+    shards = LocalXShards.partition({"x": x, "y": y}, num_shards=3)
+    ds = Dataset.from_tensor_slices(shards)
+    assert len(ds) == 24
+    ds2 = ds.map(lambda xy: (xy[0] * 2.0, xy[1]))
+    gx, gy = ds2.to_numpy()
+    np.testing.assert_allclose(gx, x * 2.0, atol=1e-6)
+    np.testing.assert_array_equal(gy, y)
+    # original dataset unchanged (map is deferred + non-destructive)
+    ox, _ = ds.to_numpy()
+    np.testing.assert_allclose(ox, x, atol=1e-6)
+
+
+def test_orca_tf_dataset_to_tf():
+    tf = pytest.importorskip("tensorflow")
+    from zoo_tpu.orca.data.tf.data import Dataset
+    x = np.arange(12, dtype=np.float32).reshape(6, 2)
+    ds = Dataset.from_tensor_slices({"x": x}).to_tf_dataset(batch_size=3)
+    batches = list(ds.as_numpy_iterator())
+    assert len(batches) == 2 and batches[0].shape == (3, 2)
+
+
+def test_orca_tf_dataset_via_compat_path():
+    from zoo.orca.data.tf.data import Dataset  # reference import line
+    ds = Dataset.from_tensor_slices(np.zeros((4, 2), np.float32))
+    assert len(ds) == 4
+
+
+def test_orca_tf_dataset_feeds_estimator_directly():
+    from zoo_tpu.orca.data.tf.data import Dataset
+    rs = np.random.RandomState(5)
+    x = rs.randn(32, 4).astype(np.float32)
+    y = (x @ rs.randn(4, 1)).astype(np.float32)
+    ds = Dataset.from_tensor_slices({"x": x, "y": y})
+    m = _model()
+    h = m.fit(ds, batch_size=8, nb_epoch=3, verbose=0)
+    assert h["loss"][-1] < h["loss"][0]
+
+
+def test_orca_tf_dataset_ntuple_and_mismatch():
+    from zoo_tpu.orca.data.tf.data import Dataset
+    a = np.zeros((5, 2), np.float32)
+    b = np.ones((5, 3), np.float32)
+    w = np.full((5,), 2.0, np.float32)
+    ds = Dataset.from_tensor_slices((a, b, w))
+    assert len(ds) == 5
+    xs, ys = ds.to_numpy()
+    assert ys is None and len(xs) == 3 and xs[1].shape == (5, 3)
+    with pytest.raises(ValueError, match="disagree on length"):
+        Dataset.from_tensor_slices({"x": np.zeros((4, 2)),
+                                    "y": np.zeros((3,))})
+
+
+def test_orca_tf_dataset_dict_columns():
+    from zoo_tpu.orca.data.tf.data import Dataset
+    ds = Dataset.from_tensor_slices({"a": np.arange(4), "b": np.ones(4)})
+    cols, ys = ds.to_numpy()
+    assert ys is None and set(cols) == {"a", "b"}
+    np.testing.assert_array_equal(cols["a"], np.arange(4))

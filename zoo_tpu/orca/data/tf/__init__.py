@@ -1,0 +1,3 @@
+from zoo_tpu.orca.data.tf.data import Dataset  # noqa: F401
+
+__all__ = ["Dataset"]

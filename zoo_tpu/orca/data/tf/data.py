@@ -1,0 +1,115 @@
+"""Orca TF-data bridge (reference: ``pyzoo/zoo/orca/data/tf/data.py:124``
+— ``Dataset.from_tensor_slices(xshards)`` + ``map`` building a deferred
+tf.data pipeline per worker).
+
+The rebuild's estimators consume tf.data datasets and XShards directly
+(``data_utils.to_xy_arrays``), so this module is the thin deferred
+builder that keeps the reference's composition style working: build from
+XShards (or arrays), chain ``map``s, and either hand the result to an
+estimator (it materializes lazily) or export a real ``tf.data.Dataset``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import numpy as np
+
+
+class Dataset:
+    """Deferred per-element dataset over XShards / arrays (reference
+    ``Dataset`` + ``TensorSliceDataset`` + ``MapDataset`` collapsed)."""
+
+    def __init__(self, elements: List, fns: Optional[List[Callable]] = None):
+        self._elements = elements
+        self._fns = list(fns or [])
+
+    # -- construction (reference Dataset.from_tensor_slices:190) ----------
+    @staticmethod
+    def from_tensor_slices(data) -> "Dataset":
+        """``data``: XShards of dicts/arrays, a dict of arrays, an array,
+        or a tuple of arrays — sliced along axis 0 like
+        ``tf.data.Dataset.from_tensor_slices``."""
+        from zoo_tpu.orca.data.shard import LocalXShards
+
+        if isinstance(data, LocalXShards):
+            elements = []
+            for shard in data.collect():
+                elements.extend(_slice_rows(shard))
+            return Dataset(elements)
+        return Dataset(_slice_rows(data))
+
+    def map(self, fn: Callable) -> "Dataset":
+        """Deferred per-element transform (reference ``MapDataset``)."""
+        return Dataset(self._elements, self._fns + [fn])
+
+    # -- materialization ---------------------------------------------------
+    def _realized(self):
+        out = self._elements
+        for fn in self._fns:
+            out = [fn(e) for e in out]
+        return out
+
+    def to_numpy(self):
+        """(x, y) arrays. Element shapes map back like tf.data:
+        2-tuples split into (features, labels); longer tuples become a
+        list of feature arrays (no labels); dict rows become a dict of
+        stacked column arrays; plain rows stack as features."""
+        rows = self._realized()
+        if not rows:
+            raise ValueError("empty dataset")
+        first = rows[0]
+        if isinstance(first, tuple) and len(first) == 2:
+            xs = np.stack([np.asarray(r[0]) for r in rows])
+            ys = np.stack([np.asarray(r[1]) for r in rows])
+            return xs, ys
+        if isinstance(first, tuple):
+            return [np.stack([np.asarray(r[i]) for r in rows])
+                    for i in range(len(first))], None
+        if isinstance(first, dict):
+            return {k: np.stack([np.asarray(r[k]) for r in rows])
+                    for k in first}, None
+        return np.stack([np.asarray(r) for r in rows]), None
+
+    def to_tf_dataset(self, batch_size: Optional[int] = None):
+        """Export a real ``tf.data.Dataset`` (needs tensorflow)."""
+        import tensorflow as tf
+
+        x, y = self.to_numpy()
+        if isinstance(x, list):
+            x = tuple(x)
+        ds = tf.data.Dataset.from_tensor_slices((x, y) if y is not None
+                                                else x)
+        return ds.batch(batch_size) if batch_size else ds
+
+    def __len__(self):
+        return len(self._elements)
+
+
+def _check_equal_lengths(arrays):
+    lengths = {len(a) for a in arrays}
+    if len(lengths) > 1:
+        raise ValueError(
+            f"from_tensor_slices components disagree on length: "
+            f"{sorted(lengths)} (tf.data raises on this too)")
+
+
+def _slice_rows(data) -> List:
+    if isinstance(data, dict):
+        if "x" in data:
+            xs = np.asarray(data["x"])
+            ys = data.get("y")
+            if ys is not None:
+                ys = np.asarray(ys)
+                _check_equal_lengths([xs, ys])
+                return list(zip(xs, ys))
+            return list(xs)
+        keys = sorted(data)
+        cols = [np.asarray(data[k]) for k in keys]
+        _check_equal_lengths(cols)
+        return [dict(zip(keys, row)) for row in zip(*cols)]
+    if isinstance(data, tuple):
+        cols = [np.asarray(c) for c in data]
+        _check_equal_lengths(cols)
+        return list(zip(*cols))
+    return list(np.asarray(data))

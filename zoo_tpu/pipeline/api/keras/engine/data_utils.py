@@ -79,6 +79,19 @@ def to_xy_arrays(x, y=None, feature_cols: Optional[Sequence[str]] = None,
     """
     from zoo_tpu.orca.data.shard import LocalXShards
 
+    from zoo_tpu.orca.data.tf.data import Dataset as _OrcaTFDataset
+    if isinstance(x, _OrcaTFDataset):
+        if y is not None:
+            raise ValueError("labels ride inside the Dataset elements, "
+                             "not a separate y= argument")
+        xs, ys = x.to_numpy()
+        if isinstance(xs, dict):
+            raise ValueError(
+                "dict-of-columns Dataset cannot feed fit directly; "
+                "map() it into (features, label) tuples first")
+        return (_as_list(xs) if not isinstance(xs, list) else xs,
+                _normalize_labels(ys))
+
     loader = _foreign_batches(x)
     if loader is not None:
         if y is not None:

@@ -86,3 +86,24 @@ def test_save_load_roundtrip(tmp_path):
     clf2 = ImageClassifier.load_model(p)
     got = np.asarray(clf2.model.predict(x, batch_size=2))
     np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+def test_space_to_depth_stem_matches_conv():
+    """The s2d stem is mathematically the 7x7/s2 SAME conv (same HWIO
+    weights), cf. SpaceToDepthStem docstring."""
+    import jax
+    import jax.numpy as jnp
+
+    from zoo_tpu.models.image.resnet import SpaceToDepthStem
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 32, 32, 3), jnp.float32)
+    stem = SpaceToDepthStem(8)
+    params = stem.build(jax.random.PRNGKey(0), (None, 32, 32, 3))
+    got = stem.call(params, x)
+    want = jax.lax.conv_general_dilated(
+        x, params["W"], (2, 2), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    assert got.shape == want.shape == (2, 16, 16, 8)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-4, rtol=1e-4)

@@ -103,3 +103,43 @@ def test_eval_phase_and_save_strips_profiler(tmp_path):
     loaded = KerasNet.load(p)
     assert getattr(loaded, "_profiler", None) is None
     assert m._profiler is not None  # original untouched
+
+
+def test_xplane_parser_roundtrip(tmp_path):
+    """device_op_times on a hand-built XSpace: one TPU plane, two events
+    with durations carried via the device_duration_ps stat."""
+    from zoo_tpu.common.xplane import device_op_times, op_breakdown
+
+    def varint(v):
+        out = b""
+        while True:
+            b7 = v & 0x7F
+            v >>= 7
+            if v:
+                out += bytes([b7 | 0x80])
+            else:
+                return out + bytes([b7])
+
+    def field(n, payload):
+        return varint((n << 3) | 2) + varint(len(payload)) + payload
+
+    def vfield(n, v):
+        return varint(n << 3) + varint(v)
+
+    ev_meta = field(4, vfield(1, 7) + field(2, vfield(1, 7) + field(
+        2, b"%fusion.1 = f32[2]{0} fusion(...), kind=kLoop")))
+    stat_meta = field(5, vfield(1, 2) + field(2, vfield(1, 2) + field(
+        2, b"device_duration_ps")))
+    stat = field(4, vfield(1, 2) + vfield(3, 5_000_000))  # 5 us
+    event = field(4, vfield(1, 7) + stat)
+    line = field(3, event + event)
+    plane = field(1, field(2, b"/device:TPU:0") + ev_meta + stat_meta + line)
+    p = tmp_path / "t.xplane.pb"
+    p.write_bytes(plane)
+
+    times = device_op_times(str(p))
+    (name, (ms, cnt)), = times.items()
+    assert "fusion.1" in name and cnt == 2
+    assert abs(ms - 0.01) < 1e-9
+    rows = op_breakdown(str(p))
+    assert rows[0][0] == "fusion/kLoop" and rows[0][2] == 2

@@ -1,0 +1,157 @@
+"""XPlane (.xplane.pb) reader: per-op device-time breakdown without a
+TensorFlow/TensorBoard dependency.
+
+Completes the profiling story (SURVEY §5.1: the reference exposes coarse
+per-phase timers + codahale ``/metrics``; the rebuild adds ``jax.profiler``
+traces via ``KerasNet.set_profile(trace_dir)``): the traces land as XPlane
+protobufs, and on a minimal image there is nothing to open them with. This
+module parses the protobuf wire format directly (schema:
+tensorflow/tsl/profiler/protobuf/xplane.proto) and aggregates device event
+durations by HLO op, so `op_breakdown()` answers "where did the step time
+go" in-process.
+
+Wire layout (verified against captures from this image's libtpu):
+``XSpace.planes=1``; ``XPlane{name=2, lines=3, event_metadata=4,
+stat_metadata=5}``; ``XLine{events=4}``; ``XEvent{metadata_id=1,
+duration_ps=3, stats=4}``; ``XStat{metadata_id=1, uint64_value=3}``; event
+durations may live either inline (field 3) or in a ``device_duration_ps``
+stat.
+"""
+
+from __future__ import annotations
+
+import re
+import struct
+from collections import defaultdict
+from typing import Dict, Iterator, List, Tuple
+
+
+def _read_varint(buf: bytes, i: int) -> Tuple[int, int]:
+    r = 0
+    s = 0
+    while True:
+        b = buf[i]
+        i += 1
+        r |= (b & 0x7F) << s
+        if not b & 0x80:
+            return r, i
+        s += 7
+
+
+def _fields(buf: bytes) -> Iterator[Tuple[int, object]]:
+    i, n = 0, len(buf)
+    while i < n:
+        tag, i = _read_varint(buf, i)
+        fn, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, i = _read_varint(buf, i)
+        elif wt == 2:
+            ln, i = _read_varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wt == 5:
+            v = struct.unpack("<I", buf[i:i + 4])[0]
+            i += 4
+        elif wt == 1:
+            v = struct.unpack("<Q", buf[i:i + 8])[0]
+            i += 8
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        yield fn, v
+
+
+def _metadata_map(msg: bytes, name_fields=(3, 2)) -> Dict[int, str]:
+    """Decode one {id -> name} metadata map entry; prefers display_name."""
+    key, names = None, {}
+    for f, v in _fields(msg):
+        if f == 1:
+            key = v
+        elif f == 2 and isinstance(v, bytes):
+            for ef, ev in _fields(v):
+                if ef in name_fields and isinstance(ev, bytes):
+                    names[ef] = ev
+    name = b""
+    for f in name_fields:
+        if names.get(f):
+            name = names[f]
+            break
+    return {key: name.decode(errors="replace")} if key is not None else {}
+
+
+def device_op_times(path: str) -> Dict[str, Tuple[float, int]]:
+    """Aggregate device event durations by full HLO op text.
+
+    Returns {op_name: (total_ms, count)} for the ``/device:TPU:*`` planes.
+    """
+    data = open(path, "rb").read()
+    out: Dict[str, List] = defaultdict(lambda: [0, 0])
+    for fn, plane in _fields(data):
+        if fn != 1 or not isinstance(plane, bytes):
+            continue
+        name = b""
+        event_meta: Dict[int, str] = {}
+        stat_meta: Dict[int, str] = {}
+        lines = []
+        for pf, pv in _fields(plane):
+            if pf == 2:
+                name = pv
+            elif pf == 4 and isinstance(pv, bytes):
+                event_meta.update(_metadata_map(pv, name_fields=(3, 2)))
+            elif pf == 5 and isinstance(pv, bytes):
+                stat_meta.update(_metadata_map(pv, name_fields=(2,)))
+            elif pf == 3:
+                lines.append(pv)
+        if b"TPU" not in name and b"GPU" not in name:
+            continue
+        dur_stat_ids = {k for k, v in stat_meta.items()
+                        if v == "device_duration_ps"}
+        for line in lines:
+            for lf, lv in _fields(line):
+                if lf != 4 or not isinstance(lv, bytes):
+                    continue
+                mid, dur = 0, 0
+                for ef, ev in _fields(lv):
+                    if ef == 1:
+                        mid = ev
+                    elif ef == 3 and not isinstance(ev, bytes):
+                        dur = dur or ev
+                    elif ef == 4 and isinstance(ev, bytes):
+                        smid, sval = 0, 0
+                        for sf, sv in _fields(ev):
+                            if sf == 1:
+                                smid = sv
+                            elif sf == 3 and not isinstance(sv, bytes):
+                                sval = sv
+                        if smid in dur_stat_ids:
+                            dur = sval
+                a = out[event_meta.get(mid, str(mid))]
+                a[0] += dur
+                a[1] += 1
+    return {k: (v[0] / 1e9, v[1]) for k, v in out.items()}
+
+
+_OP_RE = re.compile(r"= \S+? (\w[\w.-]*?)\(")
+_KIND_RE = re.compile(r"kind=(k\w+)")
+
+
+def op_breakdown(path: str, top: int = 20) -> List[Tuple[str, float, int]]:
+    """Group :func:`device_op_times` by op category (fusion kind /
+    primitive name); returns [(category, total_ms, count)] sorted by time.
+
+    The practical companion to ``set_profile``: run one profiled fit with
+    ``trace_dir=...``, then feed the ``*.xplane.pb`` under
+    ``<trace_dir>/plugins/profile/<ts>/`` here to see where device time
+    went.
+    """
+    byop: Dict[str, List] = defaultdict(lambda: [0.0, 0])
+    for nm, (ms, cnt) in device_op_times(path).items():
+        m = _OP_RE.search(nm)
+        key = m.group(1) if m else nm.split(" ")[0][:40]
+        if "fusion" in nm[:80] or "fusion" in key:
+            km = _KIND_RE.search(nm)
+            key = f"fusion/{km.group(1) if km else '?'}"
+        byop[key][0] += ms
+        byop[key][1] += cnt
+    rows = sorted(((k, v[0], v[1]) for k, v in byop.items()),
+                  key=lambda r: -r[1])
+    return rows[:top]

@@ -109,6 +109,15 @@ def batch_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
     return NamedSharding(mesh, P(*spec))
 
 
+def stacked_batch_sharding(mesh: Mesh, ndim: int = 3) -> NamedSharding:
+    """Sharding for a (k, batch, ...) superbatch feeding the scanned
+    multi-step train loop: the scan dim is replicated, the batch dim is
+    split over (data, fsdp)."""
+    axes = data_axes(mesh)
+    spec = [None, axes if axes else None] + [None] * (ndim - 2)
+    return NamedSharding(mesh, P(*spec))
+
+
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

@@ -255,17 +255,22 @@ class BatchNormalization(Layer):
 
     def call(self, params, inputs, *, training=False, rng=None):
         axes = tuple(range(inputs.ndim - 1))
-        # f32 island: batch stats in reduced precision destabilize the
-        # normalization under the mixed-bf16 policy
-        xf = inputs.astype(jnp.float32)
+        # f32 island for the STATS only (batch moments in bf16 destabilize
+        # the normalization); the per-element normalize is then applied as
+        # a precomputed (C,)-vector scale/shift in the compute dtype —
+        # bf16 elementwise runs at twice the f32 vector rate and the big
+        # activation tensor never round-trips through f32
         if training:
+            xf = inputs.astype(jnp.float32)
             mean = jnp.mean(xf, axis=axes)
             var = jnp.var(xf, axis=axes)
         else:
             mean, var = params["stats"]["mean"], params["stats"]["var"]
-        y = (xf - mean) / jnp.sqrt(var + self.epsilon)
-        return (y * params["gamma"].astype(jnp.float32)
-                + params["beta"].astype(jnp.float32)).astype(inputs.dtype)
+        inv = jax.lax.rsqrt(var + self.epsilon) \
+            * params["gamma"].astype(jnp.float32)
+        shift = params["beta"].astype(jnp.float32) - mean * inv
+        return inputs * inv.astype(inputs.dtype) \
+            + shift.astype(inputs.dtype)
 
     def updated_stats(self, params, inputs):
         axes = tuple(range(inputs.ndim - 1))

@@ -135,7 +135,7 @@ def bench_bert(batch_size=64, seq_len=128, steps_per_epoch=48,
     return sps, tokens_per_sec, flops_per_sample * sps
 
 
-def bench_llama(batch_size=64, seq_len=512, steps_per_epoch=6):
+def bench_llama(batch_size=64, seq_len=512, steps_per_epoch=24):
     """GPT2-small-scale Llama causal LM (the round-2 flagship family):
     next-token training, analytic matmul FLOPs like bench_bert."""
     from zoo_tpu.models.llm import Llama, LlamaConfig
@@ -145,7 +145,9 @@ def bench_llama(batch_size=64, seq_len=512, steps_per_epoch=6):
     cfg = LlamaConfig(vocab=32000, hidden=768, n_block=12, n_head=12,
                       n_kv_head=4, intermediate=2048, rope_theta=10000.0)
     m = Sequential()
-    m.add(Llama(cfg, remat=True, input_shape=(seq_len,)))
+    # remat="dots": MLP-half checkpointing under the dots policy — full
+    # remat costs ~4x forward FLOPs (0.32 vs 0.39 MFU measured on v5e)
+    m.add(Llama(cfg, remat="dots", input_shape=(seq_len,)))
     m.compile(optimizer=AdamWeightDecay(lr=1e-4),
               loss="sparse_categorical_crossentropy_from_logits",
               dtype_policy="mixed_bfloat16")

@@ -12,7 +12,8 @@ go" in-process.
 
 Wire layout (verified against captures from this image's libtpu):
 ``XSpace.planes=1``; ``XPlane{name=2, lines=3, event_metadata=4,
-stat_metadata=5}``; ``XLine{events=4}``; ``XEvent{metadata_id=1,
+stat_metadata=5}``; ``XEventMetadata{id=1, name=2, metadata=3,
+display_name=4}``; ``XLine{events=4}``; ``XEvent{metadata_id=1,
 duration_ps=3, stats=4}``; ``XStat{metadata_id=1, uint64_value=3}``; event
 durations may live either inline (field 3) or in a ``device_duration_ps``
 stat.
@@ -60,7 +61,7 @@ def _fields(buf: bytes) -> Iterator[Tuple[int, object]]:
         yield fn, v
 
 
-def _metadata_map(msg: bytes, name_fields=(3, 2)) -> Dict[int, str]:
+def _metadata_map(msg: bytes, name_fields=(4, 2)) -> Dict[int, str]:
     """Decode one {id -> name} metadata map entry; prefers display_name."""
     key, names = None, {}
     for f, v in _fields(msg):
@@ -96,7 +97,9 @@ def device_op_times(path: str) -> Dict[str, Tuple[float, int]]:
             if pf == 2:
                 name = pv
             elif pf == 4 and isinstance(pv, bytes):
-                event_meta.update(_metadata_map(pv, name_fields=(3, 2)))
+                # XEventMetadata: display_name=4, name=2 (3 is the binary
+                # `metadata` payload — never a display string)
+                event_meta.update(_metadata_map(pv, name_fields=(4, 2)))
             elif pf == 5 and isinstance(pv, bytes):
                 stat_meta.update(_metadata_map(pv, name_fields=(2,)))
             elif pf == 3:

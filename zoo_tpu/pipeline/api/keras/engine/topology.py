@@ -327,18 +327,26 @@ class KerasNet:
                     _merge_state(self._cast_compute(tr), state),
                     self._cast_compute(xs), training=True, rng=step_rng,
                     collect=collect)
-                preds = jax.tree.map(
-                    lambda p: p.astype(jnp.float32)
-                    if hasattr(p, "dtype") and p.dtype == jnp.bfloat16
-                    else p, preds)
+                if not getattr(self.loss_fn, "_handles_low_precision",
+                               False):
+                    preds = jax.tree.map(
+                        lambda p: p.astype(jnp.float32)
+                        if hasattr(p, "dtype") and p.dtype == jnp.bfloat16
+                        else p, preds)
                 return self.loss_fn(ys, preds), collect
 
             (loss, collect), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(trainable)
             grads = self._apply_grad_clip(grads)
-            updates, opt_state = tx.update(grads, opt_state, trainable)
-            import optax
-            trainable = optax.apply_updates(trainable, updates)
+            if getattr(self.optimizer, "fused", False):
+                # direct-apply path: the Pallas fused kernel writes new
+                # params in one pass, no optax updates/apply round trip
+                trainable, opt_state = self.optimizer.apply_fused(
+                    grads, opt_state, trainable)
+            else:
+                updates, opt_state = tx.update(grads, opt_state, trainable)
+                import optax
+                trainable = optax.apply_updates(trainable, updates)
             new_params = _merge_state(trainable, collect or state)
             return new_params, opt_state, new_rng, loss
 
@@ -438,7 +446,10 @@ class KerasNet:
         params = self._place(self.params)
         tx = self.optimizer.make()
         trainable, _ = _split_state(params)
-        opt_state = self._opt_state or tx.init(trainable)
+        opt_state = self._opt_state or (
+            self.optimizer.init_fused(trainable)
+            if getattr(self.optimizer, "fused", False) else
+            tx.init(trainable))
 
         rng = jax.random.PRNGKey(seed + 1)
         nprng = np.random.RandomState(seed)
@@ -484,15 +495,21 @@ class KerasNet:
         scan_group = min(group, n_batches)
         while scan_group > 1 and n_batches % scan_group:
             scan_group -= 1
+        # "interposed" = somebody replaced _jit_train with their own
+        # wrapper (the elastic-retry fault-injection contract); our own
+        # cached build (e.g. from a profiled fit) must not disable scan
+        interposed = self._jit_train is not None \
+            and self._jit_train is not getattr(self, "_own_jit_train", None)
         use_scan = scan_group > 1 and prof is None and pc == 1 \
-            and self._jit_train is None
+            and not interposed
         if use_scan:
             group = scan_group
             # getattr: instances unpickled from blobs predating _jit_multi
             if getattr(self, "_jit_multi", None) is None:
                 self._jit_multi = self._build_multi_train_step()
         elif self._jit_train is None:
-            self._jit_train = self._build_train_step()
+            self._jit_train = self._own_jit_train = \
+                self._build_train_step()
         for epoch in range(nb_epoch):
             t0 = time.time()
             loss_sum, n_steps = None, 0
@@ -746,6 +763,7 @@ class KerasNet:
 
         jt, je, jp = self._jit_train, self._jit_eval, self._jit_pred
         jm = getattr(self, "_jit_multi", None)
+        jo = getattr(self, "_own_jit_train", None)
         ts, vs, opt = self.train_summary, self.validation_summary, \
             self._opt_state
         prof = getattr(self, "_profiler", None)
@@ -753,6 +771,7 @@ class KerasNet:
         try:
             self._jit_train = self._jit_eval = self._jit_pred = None
             self._jit_multi = None
+            self._own_jit_train = None
             self._opt_state = None
             self._profiler = None
             self.train_summary = TrainSummary()
@@ -763,6 +782,7 @@ class KerasNet:
         finally:
             self._jit_train, self._jit_eval, self._jit_pred = jt, je, jp
             self._jit_multi = jm
+            self._own_jit_train = jo
             self.train_summary, self.validation_summary = ts, vs
             self._opt_state = opt
             self._profiler = prof

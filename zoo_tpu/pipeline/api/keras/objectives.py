@@ -84,9 +84,25 @@ def _sparse_nll(idx, logp, ignore_index: int = -100):
 
 def sparse_categorical_crossentropy_from_logits(y_true, logits):
     """torch ``nn.CrossEntropyLoss`` semantics (logits in, int labels;
-    channel-first layouts and ``ignore_index=-100`` respected)."""
+    channel-first layouts and ``ignore_index=-100`` respected).
+
+    Written as logsumexp-minus-gather rather than a full ``log_softmax``
+    so only the two reduced tensors are produced in f32 — with a large
+    vocab the (B, T, V) f32 log-probs tensor would dominate peak HBM
+    (4.2GB at B=64, T=512, V=32k). Accepts bf16 logits directly (marked
+    ``_handles_low_precision``: the train step skips its blanket f32
+    upcast); the reductions and the final arithmetic run in f32."""
     idx, logits = _class_last(y_true, logits)
-    return _sparse_nll(idx, jax.nn.log_softmax(logits, axis=-1))
+    mask = idx != -100
+    safe = jnp.where(mask, idx, 0)
+    lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(
+        logits, safe[..., None], axis=-1)[..., 0].astype(jnp.float32)
+    total = jnp.sum(jnp.where(mask, lse - picked, 0.0))
+    return total / jnp.maximum(jnp.sum(mask), 1)
+
+
+sparse_categorical_crossentropy_from_logits._handles_low_precision = True
 
 
 def nll_loss(y_true, log_probs):

@@ -1,6 +1,18 @@
 #!/usr/bin/env bash
 # Hermetic test run on the virtual CPU mesh (the reference's
 # pyzoo/dev/run-pytests role).
+#
+# Two tiers (reference: pyzoo/dev splits run-pytests / run-pytests-ray /
+# ...-horovod by runtime weight):
+#   scripts/run_tests.sh          fast tier (default pytest selection,
+#                                 `-m "not slow"`, < ~10 min)
+#   scripts/run_tests.sh --all    full matrix incl. the subprocess-heavy
+#                                 slow tier (bootstrap supervision,
+#                                 multi-process clusters, example scripts)
 set -euo pipefail
 cd "$(dirname "$0")/.."
+if [[ "${1:-}" == "--all" ]]; then
+    shift
+    exec python -m pytest tests/ -q -m "" "$@"
+fi
 exec python -m pytest tests/ -q "$@"

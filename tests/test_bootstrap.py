@@ -18,6 +18,9 @@ from zoo_tpu.orca.bootstrap import (
     launch_local_cluster,
 )
 
+# real subprocesses, each paying a fresh JAX import/compile
+pytestmark = pytest.mark.slow
+
 
 def _script(tmp_path, body, name="w.py"):
     p = tmp_path / name

@@ -46,3 +46,36 @@ def test_orca_context_flags():
     OrcaContext.train_data_store = "DISK_2"
     assert OrcaContext.train_data_store == "DISK_2"
     OrcaContext.train_data_store = "DRAM"
+
+
+def test_debug_nans_mode():
+    """SURVEY §5.2: the NaN-check flag wires jax_debug_nans and makes a
+    non-finite loss fatal inside fit."""
+    import jax
+    import numpy as np
+    import pytest
+
+    from zoo_tpu.common.context import ZooContext
+    from zoo_tpu.pipeline.api.keras import Sequential
+    from zoo_tpu.pipeline.api.keras.layers import Dense
+
+    assert ZooContext.debug_nans is False
+    ZooContext.debug_nans = True
+    try:
+        assert jax.config.jax_debug_nans
+        import jax.numpy as jnp
+
+        def nan_loss(y, p):
+            # log of a strictly negative number manufactures a NaN
+            return jnp.log(-jnp.abs(p) - 1.0).mean()
+
+        m = Sequential()
+        m.add(Dense(4, input_shape=(3,)))
+        m.compile(optimizer="sgd", loss=nan_loss)
+        x = np.random.RandomState(0).randn(16, 3).astype(np.float32)
+        y = np.zeros((16, 4), np.float32)
+        with pytest.raises(FloatingPointError):
+            m.fit(x, y, batch_size=8, nb_epoch=1, verbose=0)
+    finally:
+        ZooContext.debug_nans = False
+    assert not jax.config.jax_debug_nans

@@ -1,0 +1,69 @@
+"""Unit tests for the multi-host shard data plane (single-process parts:
+assignment math, codec safety, the TCP exchange round trip)."""
+
+import numpy as np
+import pytest
+
+from zoo_tpu.orca.data.plane import (
+    ShardExchange,
+    _decode_shard,
+    _encode_shard,
+    assign_shards,
+)
+
+
+def test_assign_balanced_noop():
+    # already balanced: nothing moves
+    plan = assign_shards([4, 4])
+    assert plan == [[0, 1, 2, 3], [4, 5, 6, 7]]
+
+
+def test_assign_locality_first():
+    # host0 holds 6, host1 holds 2: only host0's surplus (ids 4, 5) moves
+    plan = assign_shards([6, 2])
+    assert plan[0] == [0, 1, 2, 3]
+    assert plan[1] == [6, 7, 4, 5]
+    moved = set(plan[1]) - {6, 7}
+    assert moved == {4, 5}
+
+
+def test_assign_remainder_and_empty_host():
+    plan = assign_shards([7, 0, 2])
+    # totals 9 over 3 hosts -> 3 each; every id assigned exactly once
+    assert sorted(x for p in plan for x in p) == list(range(9))
+    assert [len(p) for p in plan] == [3, 3, 3]
+    # host2 keeps both of its own shards (ids 7, 8)
+    assert {7, 8} <= set(plan[2])
+
+
+def test_codec_roundtrip_and_no_pickle():
+    shard = {"x": np.arange(12, dtype=np.float32).reshape(3, 4),
+             "y": np.array([1, 2, 3], np.int64)}
+    blob = _encode_shard(shard)
+    out = _decode_shard(blob)
+    assert set(out) == {"x", "y"}
+    np.testing.assert_array_equal(out["x"], shard["x"])
+    # object arrays (the pickle vector) are rejected at encode time
+    with pytest.raises(TypeError):
+        _encode_shard({"o": "not-an-array"})  # type: ignore[dict-item]
+
+
+def test_exchange_fetch_roundtrip():
+    shards = {7: {"x": np.ones((4, 2), np.float32)},
+              9: {"x": np.zeros((1, 2), np.float32)}}
+    ex = ShardExchange(shards, bind="127.0.0.1")
+    try:
+        got = ShardExchange.fetch(("127.0.0.1", ex.port), 7)
+        np.testing.assert_array_equal(got["x"], shards[7]["x"])
+        with pytest.raises(KeyError):
+            ShardExchange.fetch(("127.0.0.1", ex.port), 8)
+    finally:
+        ex.close()
+
+
+def test_rebalance_single_process_passthrough():
+    from zoo_tpu.orca.data import LocalXShards, rebalance_shards
+
+    shards = LocalXShards([{"x": np.ones((2, 2), np.float32)}])
+    out = rebalance_shards(shards)
+    assert out.num_partitions() == 1

@@ -110,3 +110,62 @@ def test_serving_end_to_end(orca_ctx):
         iq.close()
     finally:
         server.stop()
+
+
+def test_tcp_door_rejects_pickle_and_survives(orca_ctx):
+    """Security contract (docs/serving.md): the TCP door never executes
+    wire bytes. A pickle payload is dropped without unpickling, and the
+    server keeps serving legitimate clients afterwards."""
+    import pickle
+    import socket
+    import struct
+
+    from zoo_tpu.serving import ServingServer, TCPInputQueue
+
+    m, x = _trained_model(orca_ctx)
+    inf = InferenceModel().load_keras(m, batch_size=8)
+    server = ServingServer(inf, port=0, batch_size=8,
+                           max_wait_ms=5).start()
+    try:
+        fired = []
+
+        class Bomb:
+            def __reduce__(self):
+                return (fired.append, ("boom",))
+
+        payload = pickle.dumps({"op": "predict", "uri": "u",
+                                "data": Bomb()})
+        with socket.create_connection((server.host, server.port),
+                                      timeout=10) as s:
+            s.sendall(struct.pack(">I", len(payload)) + payload)
+            # server drops the connection instead of unpickling
+            assert s.recv(1) == b""
+        assert fired == []  # the payload never executed
+
+        # and the server is still alive for a real client
+        iq = TCPInputQueue(host=server.host, port=server.port)
+        preds = iq.predict(x[:4])
+        assert preds.shape[0] == 4
+    finally:
+        server.stop()
+
+
+def test_serving_codec_roundtrip_types():
+    from zoo_tpu.serving.codec import dumps, loads
+
+    msg = {"op": "predict", "uri": "a/b", "n": 3, "f": 1.5, "ok": True,
+           "none": None,
+           "arr": np.arange(6, dtype=np.float32).reshape(2, 3),
+           "nested": [{"t": (1, 2, np.ones(2, np.int64))}]}
+    out = loads(dumps(msg))
+    assert out["op"] == "predict" and out["n"] == 3 and out["none"] is None
+    np.testing.assert_array_equal(out["arr"], msg["arr"])
+    assert isinstance(out["nested"][0]["t"], tuple)
+    np.testing.assert_array_equal(out["nested"][0]["t"][2],
+                                  np.ones(2, np.int64))
+    import pytest
+
+    with pytest.raises(TypeError):
+        dumps({"bad": object()})
+    with pytest.raises(TypeError):
+        dumps({"strs": np.array(["a", "b"])})

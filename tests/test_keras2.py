@@ -95,3 +95,37 @@ def test_advanced_activation_and_bn():
 def test_unsupported_data_format_raises():
     with pytest.raises(ValueError, match="unknown data_format"):
         L.Conv2D(4, 3, data_format="weird")
+
+
+def test_keras2_reference_parity_names():
+    """Every public name in the reference keras2 package exists here
+    (docs/keras-api.md parity list)."""
+    import zoo_tpu.pipeline.api.keras2.layers as k2
+
+    reference_names = [
+        "Activation", "Average", "AveragePooling1D", "Conv1D", "Conv2D",
+        "Cropping1D", "Dense", "Dropout", "Flatten",
+        "GlobalAveragePooling1D", "GlobalAveragePooling2D",
+        "GlobalMaxPooling1D", "LocallyConnected1D", "MaxPooling1D",
+        "Maximum", "Minimum", "average", "maximum", "minimum",
+    ]
+    missing = [n for n in reference_names if not hasattr(k2, n)]
+    assert not missing, missing
+
+
+def test_keras2_functional_merges():
+    import numpy as np
+
+    from zoo_tpu.pipeline.api.keras2.layers import (Dense, average,
+                                                    maximum, minimum)
+    from zoo_tpu.pipeline.api.keras.engine.topology import Input, Model
+
+    a = Input(shape=(4,))
+    d1 = Dense(3)(a)
+    d2 = Dense(3)(a)
+    for fn, np_fn in ((average, lambda x, y: (x + y) / 2),
+                      (maximum, np.maximum), (minimum, np.minimum)):
+        m = Model(input=a, output=fn([d1, d2]))
+        x = np.random.RandomState(0).randn(5, 4).astype(np.float32)
+        out = m.predict(x, batch_size=5)
+        assert out.shape == (5, 3)

@@ -7,6 +7,8 @@ import sys
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # runs every example script in a fresh subprocess
+
 
 def test_parquet_write_read_arrays(tmp_path):
     from zoo_tpu.orca.data.parquet_dataset import ParquetDataset
@@ -94,7 +96,8 @@ _EXAMPLES = ["ncf_movielens.py", "dogs_vs_cats_resnet.py",
              "session_recommendation.py", "image_augmentation.py",
              "multihost_training.py", "image_similarity.py",
              "llama_pretrain.py", "qa_ranking_knrm.py",
-             "nnframes_pipeline.py"]
+             "nnframes_pipeline.py", "fraud_detection.py",
+             "tfnet_image_inference.py"]
 
 
 @pytest.mark.parametrize("script", _EXAMPLES)
@@ -116,6 +119,8 @@ def test_example_runs(script):
         args += ["--epochs", "3"]
     if script == "auto_xgboost_regression.py":
         args += ["--samples", "4"]
+    if script == "fraud_detection.py":
+        args += ["--rows", "8000", "--epochs", "3"]
     proc = subprocess.run(args, capture_output=True, text=True, timeout=900,
                           env=env)
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]

@@ -110,3 +110,41 @@ def test_quantized_model_refuses_fit():
     with pytest.raises(RuntimeError, match="inference-only"):
         model.fit(x, np.zeros((4, 4), np.float32), batch_size=4,
                   nb_epoch=1, verbose=0)
+
+
+def test_quantize_conv_model(orca_ctx):
+    """Int8 covers conv nets (the reference's headline int8 use —
+    SSD/VGG inference): quantized conv predictions stay close to float,
+    weights shrink to int8."""
+    import jax.numpy as jnp
+
+    from zoo_tpu.pipeline.api.keras import Sequential
+    from zoo_tpu.pipeline.api.keras.layers import (
+        Conv2D, Dense, Flatten, GlobalAveragePooling2D)
+    from zoo_tpu.pipeline.inference.inference_model import quantize_model
+
+    m = Sequential()
+    m.add(Conv2D(8, 3, 3, border_mode="same", dim_ordering="tf",
+                 activation="relu", input_shape=(8, 8, 3)))
+    m.add(Conv2D(8, 3, 3, border_mode="same", dim_ordering="tf"))
+    m.add(GlobalAveragePooling2D(dim_ordering="tf"))
+    m.add(Dense(4, activation="softmax"))
+    m.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    x = np.random.RandomState(0).rand(6, 8, 8, 3).astype(np.float32)
+    m.build()
+    ref = np.asarray(m.predict(x, batch_size=6))
+
+    quantize_model(m)
+    for layer in m.layers:
+        p = m.params[m._key_of(layer)]
+        if "W_q" in p:
+            assert p["W_q"].dtype == jnp.int8
+            assert "W" not in p
+    got = np.asarray(m.predict(x, batch_size=6))
+    assert got.shape == ref.shape
+    np.testing.assert_allclose(got, ref, atol=0.05)
+    # int8 is inference-only
+    import pytest
+
+    with pytest.raises(RuntimeError, match="quantized"):
+        m.fit(x, np.zeros(6, np.int32), batch_size=6, nb_epoch=1)

@@ -14,6 +14,8 @@ import urllib.request
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # CLI entry points in fresh subprocesses
+
 
 def _free_ports(n):
     """Distinct ports: hold all sockets open until every port is drawn."""

@@ -169,7 +169,11 @@ class LocalSearchEngine(SearchEngine):
 class RayTuneSearchEngine(SearchEngine):  # pragma: no cover - needs ray
     """ray.tune-backed engine (reference:
     ``ray_tune_search_engine.py:29``); selected automatically when ray is
-    installed."""
+    installed.
+
+    **Untested integration**: ray is not bundled in the dev image, so
+    this class has never executed here (docs/chronos.md carries the same
+    caveat). The thread-pool ``LocalSearchEngine`` is the tested path."""
 
     def __init__(self):
         import ray  # noqa: F401  (raises if absent)

@@ -29,6 +29,7 @@ class _ClassPropertyMeta(type):
     _shard_size = None
     _train_data_store = "DRAM"
     _eager_mode = True
+    _debug_nans = False
 
     @property
     def log_output(cls) -> bool:
@@ -100,6 +101,23 @@ class _ClassPropertyMeta(type):
     @eager_mode.setter
     def eager_mode(cls, value: bool):
         _ClassPropertyMeta._eager_mode = bool(value)
+
+    @property
+    def debug_nans(cls) -> bool:
+        """NaN-check/debug mode (SURVEY §5.2 rebuild commitment — the
+        reference has no sanitizers; JAX purity plus this flag carry the
+        role). When True: ``jax.config.jax_debug_nans`` is enabled (XLA
+        re-runs the op that produced a NaN un-jitted and raises at the
+        exact primitive) and every ``fit`` asserts per-epoch losses are
+        finite, so divergence fails loudly at the step that caused it."""
+        return cls._debug_nans
+
+    @debug_nans.setter
+    def debug_nans(cls, value: bool):
+        import jax
+
+        _ClassPropertyMeta._debug_nans = bool(value)
+        jax.config.update("jax_debug_nans", bool(value))
 
 
 class ZooContext(metaclass=_ClassPropertyMeta):

@@ -1,0 +1,206 @@
+"""TFPark text models, TPU-native (reference:
+``pyzoo/zoo/tfpark/text/keras/`` — ``text_model.py:21`` TextKerasModel
+base; ``ner.py:21`` NER BiLSTM-CRF; ``pos_tagging.py:20`` SequenceTagger;
+``intent_extraction.py:20`` IntentEntity multi-task model; all wrap
+nlp-architect keras graphs there). Here the same architectures are built
+directly on the keras facade's functional API, so they train through the
+jitted sharded step like every other zoo model.
+
+Shared input convention (reference parity):
+- word indices ``(batch, sequence_length)``
+- character indices ``(batch, sequence_length, word_length)``
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from zoo_tpu.models.text.crf import (
+    CRF,
+    crf_decode,
+    crf_negative_log_likelihood,
+    unpack_crf,
+)
+from zoo_tpu.pipeline.api.keras.engine.topology import Input, Model
+from zoo_tpu.pipeline.api.keras.layers import (
+    LSTM,
+    Bidirectional,
+    Conv1D,
+    Dense,
+    Dropout,
+    Embedding,
+    GlobalMaxPooling1D,
+    Reshape,
+    TimeDistributed,
+    merge,
+)
+
+__all__ = ["NER", "SequenceTagger", "IntentEntity", "CRF", "crf_decode",
+           "crf_negative_log_likelihood"]
+
+
+def _char_features(chars_in, seq_len: int, word_length: int,
+                   char_vocab_size: int, char_emb_dim: int,
+                   out_dim: int):
+    """Per-word character features: embed chars, convolve within each
+    word, max-pool — the TPU-friendly char encoder (one big batched conv
+    instead of a per-word RNN; the reference's nlp-architect models use
+    a char Bi-LSTM, same role)."""
+    h = Reshape((seq_len * word_length,))(chars_in)
+    h = Embedding(char_vocab_size, char_emb_dim)(h)
+    h = Reshape((seq_len, word_length, char_emb_dim))(h)
+    h = TimeDistributed(Conv1D(out_dim, 3, border_mode="same",
+                               activation="relu"))(h)
+    return TimeDistributed(GlobalMaxPooling1D())(h)
+
+
+class NER(Model):
+    """Named-entity recognition: BiLSTM tagger with a CRF (default) or
+    softmax head (reference ``ner.py:21``; inputs/outputs match its
+    docstring: words (B, T) + chars (B, T, word_length) -> tags).
+
+    ``crf_mode="reg"`` (the reference default — full equal-length
+    sequences) is supported; ``"pad"`` (explicit lengths) is not.
+    Compile with ``model.default_loss()``; decode predictions with
+    ``model.predict_tags(...)``.
+    """
+
+    def __init__(self, num_entities: int, word_vocab_size: int,
+                 char_vocab_size: int, sequence_length: int = 64,
+                 word_length: int = 12, word_emb_dim: int = 100,
+                 char_emb_dim: int = 30, tagger_lstm_dim: int = 100,
+                 dropout: float = 0.5, crf_mode: str = "reg",
+                 classifier: str = "crf"):
+        if crf_mode != "reg":
+            raise ValueError(
+                'crf_mode="pad" is not supported; pad to equal length '
+                'and use "reg" (the reference default)')
+        if classifier not in ("crf", "softmax"):
+            raise ValueError("classifier must be 'crf' or 'softmax'")
+        self.classifier = classifier
+        words = Input(shape=(sequence_length,), name="words")
+        chars = Input(shape=(sequence_length, word_length), name="chars")
+        w = Embedding(word_vocab_size, word_emb_dim)(words)
+        c = _char_features(chars, sequence_length, word_length,
+                           char_vocab_size, char_emb_dim, char_emb_dim)
+        h = merge([w, c], mode="concat")
+        h = Dropout(dropout)(h)
+        h = Bidirectional(LSTM(tagger_lstm_dim, return_sequences=True))(h)
+        h = Bidirectional(LSTM(tagger_lstm_dim, return_sequences=True))(h)
+        h = Dropout(dropout)(h)
+        if classifier == "crf":
+            emissions = Dense(num_entities)(h)
+            out = CRF()(emissions)
+        else:
+            out = Dense(num_entities, activation="softmax")(h)
+        super().__init__(input=[words, chars], output=out, name="ner")
+
+    def default_loss(self):
+        return (crf_negative_log_likelihood if self.classifier == "crf"
+                else "sparse_categorical_crossentropy")
+
+    def predict_tags(self, words, chars, batch_size: int = 32):
+        packed = self.predict([words, chars], batch_size=batch_size)
+        if self.classifier == "crf":
+            return np.asarray(crf_decode(packed))
+        return np.argmax(packed, axis=-1)
+
+    @staticmethod
+    def load_model(path: str) -> "NER":
+        return Model.load(path)
+
+
+class SequenceTagger(Model):
+    """POS-tagger / chunker: 3 BiLSTM layers, two softmax heads
+    (reference ``pos_tagging.py:20``; ``classifier="crf"`` upgrades the
+    chunk head to a CRF as there). Inputs: words, plus chars when
+    ``char_vocab_size`` is given."""
+
+    def __init__(self, num_pos_labels: int, num_chunk_labels: int,
+                 word_vocab_size: int,
+                 char_vocab_size: Optional[int] = None,
+                 sequence_length: int = 64, word_length: int = 12,
+                 feature_size: int = 100, dropout: float = 0.2,
+                 classifier: str = "softmax"):
+        classifier = classifier.lower()
+        if classifier not in ("softmax", "crf"):
+            raise ValueError("classifier should be softmax or crf")
+        self.classifier = classifier
+        words = Input(shape=(sequence_length,), name="words")
+        inputs = [words]
+        h = Embedding(word_vocab_size, feature_size)(words)
+        if char_vocab_size is not None:
+            chars = Input(shape=(sequence_length, word_length),
+                          name="chars")
+            inputs.append(chars)
+            c = _char_features(chars, sequence_length, word_length,
+                               char_vocab_size, 30, feature_size)
+            h = merge([h, c], mode="concat")
+        h = Dropout(dropout)(h)
+        for _ in range(3):
+            h = Bidirectional(LSTM(feature_size,
+                                   return_sequences=True))(h)
+        pos = Dense(num_pos_labels, activation="softmax")(h)
+        if classifier == "crf":
+            chunk = CRF()(Dense(num_chunk_labels)(h))
+        else:
+            chunk = Dense(num_chunk_labels, activation="softmax")(h)
+        super().__init__(input=inputs, output=[pos, chunk],
+                         name="sequence_tagger")
+
+    def default_loss(self):
+        chunk_loss = (crf_negative_log_likelihood
+                      if self.classifier == "crf"
+                      else "sparse_categorical_crossentropy")
+        return ["sparse_categorical_crossentropy", chunk_loss]
+
+    @staticmethod
+    def load_model(path: str) -> "SequenceTagger":
+        return Model.load(path)
+
+
+class IntentEntity(Model):
+    """Joint intent classification + slot filling (reference
+    ``intent_extraction.py:20``): shared encoder, a sequence-level
+    intent head and a per-token entity head."""
+
+    def __init__(self, num_intents: int, num_entities: int,
+                 word_vocab_size: int, char_vocab_size: int,
+                 sequence_length: int = 64, word_length: int = 12,
+                 word_emb_dim: int = 100, char_emb_dim: int = 30,
+                 char_lstm_dim: int = 30, tagger_lstm_dim: int = 100,
+                 dropout: float = 0.2, classifier: str = "softmax"):
+        if classifier not in ("softmax", "crf"):
+            raise ValueError("classifier must be 'softmax' or 'crf'")
+        self.classifier = classifier
+        words = Input(shape=(sequence_length,), name="words")
+        chars = Input(shape=(sequence_length, word_length), name="chars")
+        w = Embedding(word_vocab_size, word_emb_dim)(words)
+        c = _char_features(chars, sequence_length, word_length,
+                           char_vocab_size, char_emb_dim, char_lstm_dim)
+        h = merge([w, c], mode="concat")
+        h = Dropout(dropout)(h)
+        h = Bidirectional(LSTM(tagger_lstm_dim, return_sequences=True))(h)
+        # intent rides the sequence summary; tags ride the full sequence
+        intent_feat = Bidirectional(LSTM(tagger_lstm_dim))(h)
+        intent = Dense(num_intents, activation="softmax")(intent_feat)
+        tag_h = Bidirectional(LSTM(tagger_lstm_dim,
+                                   return_sequences=True))(h)
+        if classifier == "crf":
+            tags = CRF()(Dense(num_entities)(tag_h))
+        else:
+            tags = Dense(num_entities, activation="softmax")(tag_h)
+        super().__init__(input=[words, chars], output=[intent, tags],
+                         name="intent_entity")
+
+    def default_loss(self):
+        tag_loss = (crf_negative_log_likelihood
+                    if self.classifier == "crf"
+                    else "sparse_categorical_crossentropy")
+        return ["sparse_categorical_crossentropy", tag_loss]
+
+    @staticmethod
+    def load_model(path: str) -> "IntentEntity":
+        return Model.load(path)

@@ -1,0 +1,113 @@
+"""Linear-chain CRF for sequence tagging, as jittable JAX scans.
+
+The reference's NER head is a BiLSTM-CRF (nlp-architect's ``NERCRF``
+wrapped by ``pyzoo/zoo/tfpark/text/keras/ner.py:21``; the CRF op comes
+from keras-contrib there). TPU-native rebuild: the forward algorithm
+(partition function) and Viterbi decoding are ``lax.scan`` over time —
+static shapes, no data-dependent Python control flow.
+
+Packing convention: the :class:`CRF` layer appends its (E, E) transition
+matrix to the emissions along the time axis — output ``(B, T+E, E)`` —
+so the transition params flow to the loss (``crf_negative_log_likelihood``)
+and the decoder (``crf_decode``) through the standard ``loss(y, preds)``
+interface. ``unpack_crf`` splits them back apart.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from zoo_tpu.pipeline.api.keras.engine.base import Layer
+
+__all__ = ["CRF", "crf_negative_log_likelihood", "crf_decode",
+           "unpack_crf"]
+
+
+class CRF(Layer):
+    """Terminal tagging layer: owns the transition matrix and packs it
+    with the emissions (see module docstring)."""
+
+    def build(self, rng, input_shape):
+        e = input_shape[-1]
+        return {"T": jnp.zeros((e, e), jnp.float32)}
+
+    def call(self, params, inputs, *, training=False, rng=None):
+        b, _, e = inputs.shape
+        trans = jnp.broadcast_to(params["T"].astype(inputs.dtype),
+                                 (b, e, e))
+        return jnp.concatenate([inputs, trans], axis=1)
+
+    def compute_output_shape(self, input_shape):
+        b, t, e = input_shape
+        return (b, None if t is None else t + e, e)
+
+
+def unpack_crf(packed) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(B, T+E, E) -> emissions (B, T, E), transitions (E, E)."""
+    e = packed.shape[-1]
+    return packed[:, :-e, :], packed[0, -e:, :]
+
+
+def _forward_log_z(emissions, trans):
+    """log partition function per sequence: (B, T, E), (E, E) -> (B,)."""
+
+    def step(alpha, em_t):
+        # alpha (B, E): logsumexp over previous tag
+        scores = alpha[:, :, None] + trans[None, :, :] + em_t[:, None, :]
+        return jax.nn.logsumexp(scores, axis=1), None
+
+    alpha0 = emissions[:, 0, :]
+    alpha, _ = jax.lax.scan(step, alpha0,
+                            jnp.moveaxis(emissions[:, 1:, :], 1, 0))
+    return jax.nn.logsumexp(alpha, axis=-1)
+
+
+def crf_negative_log_likelihood(y_true, packed):
+    """Mean negative log-likelihood of the tag sequences (the CRF
+    training objective; reference crf_mode='reg' — full equal-length
+    sequences)."""
+    emissions, trans = unpack_crf(packed)
+    emissions = emissions.astype(jnp.float32)
+    trans = trans.astype(jnp.float32)
+    y = y_true.astype(jnp.int32)
+    if y.ndim == emissions.ndim:  # (B, T, 1) labels
+        y = y[..., 0]
+    b, t, _ = emissions.shape
+    em_score = jnp.sum(
+        jnp.take_along_axis(emissions, y[..., None], axis=-1)[..., 0],
+        axis=1)
+    tr_score = jnp.sum(trans[y[:, :-1], y[:, 1:]], axis=1)
+    log_z = _forward_log_z(emissions, trans)
+    return jnp.mean(log_z - em_score - tr_score)
+
+
+def crf_decode(packed) -> jnp.ndarray:
+    """Viterbi decode: (B, T+E, E) -> best tag path (B, T)."""
+    emissions, trans = unpack_crf(packed)
+    emissions = emissions.astype(jnp.float32)
+    trans = trans.astype(jnp.float32)
+
+    def fwd(score, em_t):
+        # score (B, E) best score ending in each tag
+        cand = score[:, :, None] + trans[None, :, :]   # (B, E_prev, E)
+        best_prev = jnp.argmax(cand, axis=1)           # (B, E)
+        return jnp.max(cand, axis=1) + em_t, best_prev
+
+    score0 = emissions[:, 0, :]
+    final, back = jax.lax.scan(fwd, score0,
+                               jnp.moveaxis(emissions[:, 1:, :], 1, 0))
+    last = jnp.argmax(final, axis=-1)                  # (B,)
+
+    def bwd(tag, bp_t):
+        prev = jnp.take_along_axis(bp_t, tag[:, None], axis=-1)[:, 0]
+        return prev, prev
+
+    _, path = jax.lax.scan(bwd, last, back, reverse=True)
+    return jnp.concatenate([jnp.moveaxis(path, 0, 1), last[:, None]],
+                           axis=1)
+
+
+crf_negative_log_likelihood._handles_low_precision = True

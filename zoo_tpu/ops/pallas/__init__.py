@@ -51,10 +51,12 @@ def pad_dim(x, axis: int, mult: int):
 
 from zoo_tpu.ops.pallas.flash_attention import flash_attention  # noqa: E402
 from zoo_tpu.ops.pallas.quant import (  # noqa: E402
-    quantize_int8, quantized_matmul, quantized_dense)
+    quantize_int8, quantized_matmul, quantized_dense,
+    quantize_conv_weights, quantized_conv2d)
 from zoo_tpu.ops.pallas.fused_optim import (  # noqa: E402
     fused_apply_sgd, fused_apply_adam)
 
 __all__ = ["flash_attention", "quantize_int8", "quantized_matmul",
-           "quantized_dense", "fused_apply_sgd", "fused_apply_adam",
+           "quantized_dense", "quantize_conv_weights", "quantized_conv2d",
+           "fused_apply_sgd", "fused_apply_adam",
            "on_tpu", "resolve_interpret"]

@@ -1,3 +1,4 @@
 from zoo_tpu.orca.data.shard import XShards, LocalXShards
+from zoo_tpu.orca.data.plane import rebalance_shards
 
-__all__ = ["XShards", "LocalXShards"]
+__all__ = ["XShards", "LocalXShards", "rebalance_shards"]

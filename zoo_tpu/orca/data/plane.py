@@ -1,0 +1,231 @@
+"""Multi-host shard data plane: locality-aware shard exchange.
+
+Rebuild of the reference's RayXShards movement layer
+(``pyzoo/zoo/orca/data/ray_xshards.py:67`` — each Spark partition is put
+into the plasma store on its node; ``:250`` ``assign_partitions_to_actors``
+assigns actors to co-located partitions so only the imbalance actually
+moves). The TPU-native shape of the same capability:
+
+* every JAX process serves its local shards over an ephemeral TCP port
+  (:class:`ShardExchange`) using a **non-executable** codec (length-framed
+  ``.npz`` — ``numpy.load(allow_pickle=False)``, never pickle);
+* peer discovery rides the JAX distributed runtime itself —
+  ``multihost_utils.process_allgather`` of each host's (ip, port, count)
+  triple, so there is no extra coordinator and no driver-side collect;
+* :func:`assign_shards` computes the same deterministic, locality-first
+  plan on every host: each host keeps as many of its own shards as the
+  balanced target allows, and only surplus shards are fetched by deficit
+  hosts;
+* :func:`rebalance_shards` runs the whole exchange and returns this
+  process's balanced, disjoint shard set — ready for the estimator's
+  per-process feed into ``host_local_to_global``
+  (``parallel/mesh.py:152``).
+
+Shards must be dicts of numpy arrays (the estimator feed format); use
+``XShards.partition({"x": ..., "y": ...})``.
+"""
+
+from __future__ import annotations
+
+import io
+import socket
+import struct
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["ShardExchange", "assign_shards", "rebalance_shards"]
+
+_MAGIC = b"ZSX1"
+
+
+def _encode_shard(shard: Dict[str, np.ndarray]) -> bytes:
+    if not isinstance(shard, dict) or not all(
+            isinstance(v, np.ndarray) for v in shard.values()):
+        raise TypeError(
+            "the shard exchange ships dict-of-ndarray shards only; got "
+            f"{type(shard).__name__} (convert DataFrame shards with "
+            "to_dict('series') -> numpy first)")
+    buf = io.BytesIO()
+    np.savez(buf, **shard)
+    return buf.getvalue()
+
+
+def _decode_shard(blob: bytes) -> Dict[str, np.ndarray]:
+    with np.load(io.BytesIO(blob), allow_pickle=False) as z:
+        return {k: z[k] for k in z.files}
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    out = b""
+    while len(out) < n:
+        chunk = sock.recv(n - len(out))
+        if not chunk:
+            raise ConnectionError("peer closed mid-message")
+        out += chunk
+    return out
+
+
+class ShardExchange:
+    """Serve this process's shards (by global id) to peer hosts.
+
+    Protocol: request = ``ZSX1`` + u32 global id; response = u32 length +
+    npz bytes (length 0 = not held here). The codec cannot execute code
+    on either end. The port is ephemeral, announced only through the JAX
+    coordination service, and the server thread dies with the process.
+    """
+
+    def __init__(self, shards_by_gid: Dict[int, Dict[str, np.ndarray]],
+                 bind: str = "0.0.0.0"):
+        self._blobs = {gid: _encode_shard(s)
+                       for gid, s in shards_by_gid.items()}
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((bind, 0))
+        self._srv.listen(16)
+        self.port = self._srv.getsockname()[1]
+        self._closed = False
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        while not self._closed:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn: socket.socket):
+        try:
+            with conn:
+                while True:
+                    try:
+                        head = _recv_exact(conn, 8)
+                    except ConnectionError:
+                        return
+                    if head[:4] != _MAGIC:
+                        return  # not our protocol: drop the connection
+                    (gid,) = struct.unpack("!I", head[4:])
+                    blob = self._blobs.get(gid)
+                    if blob is None:
+                        conn.sendall(struct.pack("!I", 0))
+                    else:
+                        conn.sendall(struct.pack("!I", len(blob)) + blob)
+        except OSError:
+            pass
+
+    def close(self):
+        self._closed = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    @staticmethod
+    def fetch(addr: Tuple[str, int], gid: int) -> Dict[str, np.ndarray]:
+        with socket.create_connection(addr, timeout=60) as sock:
+            sock.sendall(_MAGIC + struct.pack("!I", gid))
+            (n,) = struct.unpack("!I", _recv_exact(sock, 4))
+            if n == 0:
+                raise KeyError(f"peer {addr} does not hold shard {gid}")
+            return _decode_shard(_recv_exact(sock, n))
+
+
+def assign_shards(counts: Sequence[int]) -> List[List[int]]:
+    """Deterministic locality-first balanced assignment.
+
+    ``counts[h]`` = shards host ``h`` currently holds; global ids number
+    hosts' shards consecutively (host 0 owns 0..counts[0]-1, ...).
+    Returns per-host lists of global ids such that (a) totals differ by
+    at most 1 (remainder goes to the lowest-indexed hosts, so every host
+    derives the same plan), and (b) each host keeps its OWN shards up to
+    its target before any shard moves — only the imbalance crosses the
+    network (the ``assign_partitions_to_actors`` objective,
+    ``ray_xshards.py:250``).
+    """
+    hosts = len(counts)
+    total = sum(counts)
+    targets = [total // hosts + (1 if h < total % hosts else 0)
+               for h in range(hosts)]
+    offsets = np.concatenate([[0], np.cumsum(counts)]).astype(int)
+    own = [list(range(offsets[h], offsets[h + 1])) for h in range(hosts)]
+    keep = [own[h][:targets[h]] for h in range(hosts)]
+    surplus = [gid for h in range(hosts) for gid in own[h][targets[h]:]]
+    out = []
+    for h in range(hosts):
+        need = targets[h] - len(keep[h])
+        take, surplus = surplus[:need], surplus[need:]
+        out.append(keep[h] + take)
+    return out
+
+
+def rebalance_shards(shards, bind_ip: Optional[str] = None):
+    """Exchange shards so every process holds a balanced, disjoint set.
+
+    ``shards``: this process's :class:`LocalXShards` of dict-of-ndarray
+    shards (each host contributes what it has — counts may differ).
+    Returns this process's rebalanced ``LocalXShards``. Single-process:
+    returns the input unchanged.
+    """
+    import jax
+
+    from zoo_tpu.orca.data.shard import LocalXShards
+
+    parts = shards.collect() if hasattr(shards, "collect") else list(shards)
+    if jax.process_count() == 1:
+        return LocalXShards(parts)
+
+    from jax.experimental import multihost_utils
+
+    pid = jax.process_index()
+    ip = bind_ip or _default_ip()
+    # announce (ip, port, count) through the coordination service; the
+    # exchange must outlive the fetch phase on every host
+    counts_probe = multihost_utils.process_allgather(
+        np.asarray([len(parts)], np.int32)).reshape(-1)
+    offsets = np.concatenate([[0], np.cumsum(counts_probe)]).astype(int)
+    exchange = ShardExchange(
+        {int(offsets[pid] + i): s for i, s in enumerate(parts)},
+        bind=ip)
+    try:
+        me = np.asarray(list(_ip_to_words(ip)) + [exchange.port],
+                        np.int64)
+        table = multihost_utils.process_allgather(me)
+        addrs = [(_words_to_ip(row[:-1]), int(row[-1])) for row in table]
+        plan = assign_shards([int(c) for c in counts_probe])
+        mine = []
+        for gid in plan[pid]:
+            src = int(np.searchsorted(offsets, gid, side="right") - 1)
+            if src == pid:
+                mine.append(parts[gid - offsets[pid]])
+            else:
+                mine.append(ShardExchange.fetch(addrs[src], gid))
+        # barrier: nobody tears their server down while a peer still fetches
+        multihost_utils.sync_global_devices("zoo_tpu_shard_rebalance")
+    finally:
+        exchange.close()
+    return LocalXShards(mine)
+
+
+def _default_ip() -> str:
+    """The address peers can reach us on: the interface that routes out
+    (UDP connect trick — nothing is sent); loopback in single-host runs."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("10.255.255.255", 1))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
+def _ip_to_words(ip: str):
+    return [int(b) for b in socket.inet_aton(ip)]
+
+
+def _words_to_ip(words) -> str:
+    return socket.inet_ntoa(bytes(int(w) for w in words))

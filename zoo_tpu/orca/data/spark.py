@@ -1,0 +1,128 @@
+"""Gated Spark DataFrame ingestion: partitions → per-host shards, no
+driver collect.
+
+Rebuild of the reference's primary estimator feed — every Orca estimator
+accepts a Spark DataFrame plus feature/label columns
+(``pyzoo/zoo/orca/learn/tf/estimator.py:486`` ``fit(df, feature_cols,
+label_cols)``; ``pyzoo/zoo/pipeline/nnframes/nn_classifier.py:139``;
+``pyzoo/zoo/orca/data/shard.py:129`` builds SparkXShards on the RDD).
+There, partitions stream executor→JVM tensors; here they become
+numpy shard FILES written *by the executors* (``mapPartitionsWithIndex``)
+into a staging directory every TPU host can read (GCS/NFS — the
+plasma-store role of ``ray_xshards.py:67``), and each JAX process loads
+only its round-robin slice (``shards_for_process``). The only thing that
+ever reaches the Spark driver is the list of file paths — never row data
+(SURVEY §7.4 hard part #1).
+
+pyspark is NOT a dependency: the adapter talks to a four-method surface
+(``df.columns``, ``df.rdd``, ``rdd.mapPartitionsWithIndex(f)``,
+``.collect()``) so it is testable against a pandas-backed stub, and the
+estimator detects DataFrames by module name (``is_spark_dataframe``)
+without importing pyspark.
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["is_spark_dataframe", "spark_dataframe_to_shards"]
+
+
+def is_spark_dataframe(obj) -> bool:
+    """True for ``pyspark.sql.DataFrame`` (connect or classic) without
+    importing pyspark."""
+    mod = type(obj).__module__ or ""
+    return mod.startswith("pyspark.") and type(obj).__name__ == "DataFrame"
+
+
+def _partition_writer(columns: Sequence[str], staging_dir: str, run: str):
+    """The function shipped to Spark executors. Self-contained: converts
+    a partition's rows to one ``.npz`` of column arrays and yields only
+    the (partition_id, path, row_count) triple."""
+
+    def write(pid, rows):
+        cols = {c: [] for c in columns}
+        n = 0
+        for row in rows:
+            for c in columns:
+                cols[c].append(row[c])
+            n += 1
+        if n == 0:
+            return iter(())
+        path = os.path.join(staging_dir, f"zoo-{run}-p{pid:05d}.npz")
+        np.savez(path, **{c: np.asarray(v) for c, v in cols.items()})
+        return iter([(pid, path, n)])
+
+    return write
+
+
+def spark_dataframe_to_shards(df, feature_cols: Sequence[str],
+                              label_cols: Optional[Sequence[str]] = None,
+                              staging_dir: Optional[str] = None,
+                              process_index: Optional[int] = None,
+                              process_count: Optional[int] = None):
+    """Materialize a Spark DataFrame as THIS process's ``LocalXShards``.
+
+    ``staging_dir`` must be visible to both Spark executors and the TPU
+    hosts (defaults to ``$ZOO_SPARK_STAGING`` or a tmp dir — the latter
+    only works in ``local[*]`` mode where executors share the
+    filesystem). Returns shards shaped for the estimator feed:
+    ``{"x": (n, F) | (n,), "y": (n, L) | (n,)}``.
+
+    Retention: every call stages a fresh uuid-tagged copy of the
+    DataFrame. In single-process runs the run's files are deleted after
+    loading; multi-host runs cannot know when peers finish reading, so
+    the files persist — point ``ZOO_SPARK_STAGING`` at job-scoped
+    storage that is reclaimed with the job.
+    """
+    if not feature_cols:
+        raise ValueError("feature_cols required for DataFrame input")
+    label_cols = list(label_cols or [])
+    missing = [c for c in list(feature_cols) + label_cols
+               if c not in df.columns]
+    if missing:
+        raise ValueError(f"column(s) not found: {missing}; "
+                         f"available: {list(df.columns)}")
+    staging_dir = staging_dir or os.environ.get("ZOO_SPARK_STAGING")
+    if staging_dir is None:
+        import tempfile
+        staging_dir = tempfile.mkdtemp(prefix="zoo_spark_")
+    run = uuid.uuid4().hex[:8]
+    writer = _partition_writer(list(feature_cols) + label_cols,
+                               staging_dir, run)
+    # executors write the shard files; ONLY the path metadata collects
+    meta = sorted(df.rdd.mapPartitionsWithIndex(writer).collect())
+
+    from zoo_tpu.orca.data.shard import LocalXShards, shards_for_process
+
+    paths = LocalXShards([p for _, p, _ in meta])
+    mine = shards_for_process(paths, process_index=process_index,
+                              process_count=process_count)
+
+    def load(path: str):
+        with np.load(path, allow_pickle=False) as z:
+            feats = [z[c] for c in feature_cols]
+            labs = [z[c] for c in label_cols]
+        x = feats[0] if len(feats) == 1 else np.stack(feats, axis=1)
+        shard = {"x": x}
+        if labs:
+            shard["y"] = labs[0] if len(labs) == 1 \
+                else np.stack(labs, axis=1)
+        return shard
+
+    out = LocalXShards([load(p) for p in mine.collect()])
+    import jax
+
+    pcnt = process_count if process_count is not None \
+        else jax.process_count()
+    if pcnt == 1:
+        for _, p, _ in meta:  # single reader: reclaim this run's staging
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+    return out

@@ -79,6 +79,17 @@ def to_xy_arrays(x, y=None, feature_cols: Optional[Sequence[str]] = None,
     """
     from zoo_tpu.orca.data.shard import LocalXShards
 
+    from zoo_tpu.orca.data.spark import is_spark_dataframe
+    if is_spark_dataframe(x):
+        # Spark DataFrame: executors write shard files, this process
+        # loads its slice (no driver collect — orca/data/spark.py)
+        from zoo_tpu.orca.data.spark import spark_dataframe_to_shards
+        if not feature_cols:
+            raise ValueError("feature_cols required for Spark DataFrame "
+                             "input")
+        shards = spark_dataframe_to_shards(x, feature_cols, label_cols)
+        return to_xy_arrays(shards, None, None, None)
+
     from zoo_tpu.orca.data.tf.data import Dataset as _OrcaTFDataset
     if isinstance(x, _OrcaTFDataset):
         if y is not None:

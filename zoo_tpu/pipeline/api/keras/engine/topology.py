@@ -577,6 +577,14 @@ class KerasNet:
             finally:
                 batches.close()
             epoch_loss = float(np.asarray(loss_sum)) / max(n_steps, 1)
+            from zoo_tpu.common.context import ZooContext
+            if ZooContext.debug_nans and not np.isfinite(epoch_loss):
+                raise FloatingPointError(
+                    f"{self.name}: non-finite training loss "
+                    f"({epoch_loss}) in epoch {epoch + 1} — NaN-check "
+                    "mode (ZooContext.debug_nans) treats this as fatal; "
+                    "jax_debug_nans should have pinpointed the producing "
+                    "op above")
             history["loss"].append(epoch_loss)
             self.train_summary.add_scalar("Loss", epoch_loss, self._step)
             self.train_summary.add_scalar(

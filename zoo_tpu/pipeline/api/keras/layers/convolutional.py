@@ -78,6 +78,18 @@ class Convolution2D(Layer):
         x = inputs
         if self.dim_ordering == "th":
             x = jnp.transpose(x, (0, 2, 3, 1))  # NCHW -> NHWC
+        if "W_q" in params:  # int8 weights (quantize_model path)
+            from zoo_tpu.ops.pallas.quant import quantized_conv2d
+            y = quantized_conv2d(
+                x, params["W_q"], params["W_scale"],
+                strides=self.subsample,
+                padding=self.border_mode.upper(),
+                bias=params.get("b") if self.bias else None)
+            if self.activation:
+                y = self.activation(y)
+            if self.dim_ordering == "th":
+                y = jnp.transpose(y, (0, 3, 1, 2))
+            return y
         y = jax.lax.conv_general_dilated(
             x, params["W"], window_strides=self.subsample,
             padding=self.border_mode.upper(),

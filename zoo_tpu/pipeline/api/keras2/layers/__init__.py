@@ -18,6 +18,7 @@ __all__ = [
     "AveragePooling2D", "GlobalAveragePooling1D", "GlobalMaxPooling1D",
     "GlobalAveragePooling2D", "GlobalMaxPooling2D",
     "Maximum", "Minimum", "Average", "Add", "Concatenate",
+    "average", "maximum", "minimum",
     "LocallyConnected1D", "LeakyReLU", "ELU", "ThresholdedReLU",
     "ConvLSTM2D", "BatchNormalization", "LSTM", "GRU", "SimpleRNN",
 ]
@@ -255,6 +256,21 @@ class Concatenate(_MergeN):
     def __call__(self, inputs: Sequence):
         return _merge(list(inputs), mode="concat", concat_axis=self.axis,
                       name=self.name)
+
+
+def average(inputs: Sequence, name: Optional[str] = None):
+    """Functional alias (reference ``keras2/layers/merge.py`` ``average``)."""
+    return Average(name=name)(inputs)
+
+
+def maximum(inputs: Sequence, name: Optional[str] = None):
+    """Functional alias (reference ``keras2/layers/merge.py`` ``maximum``)."""
+    return Maximum(name=name)(inputs)
+
+
+def minimum(inputs: Sequence, name: Optional[str] = None):
+    """Functional alias (reference ``keras2/layers/merge.py`` ``minimum``)."""
+    return Minimum(name=name)(inputs)
 
 
 # ------------------------------------------------- advanced activations

@@ -158,18 +158,27 @@ def save_encrypted(model, path: str, secret: str, salt: str,
 
 
 def quantize_model(model):
-    """Post-training int8 quantization of every Dense weight matrix
-    (per-output-channel symmetric); the forward then runs the Pallas
-    int8 MXU matmul (``ops/pallas/quant.py``). TPU equivalent of the
+    """Post-training int8 quantization of every Dense and Conv2D weight
+    (per-output-channel symmetric); the forward then runs the int8 MXU
+    matmul / int8 conv (``ops/pallas/quant.py``). TPU equivalent of the
     reference's OpenVINO int8 IR path (``doLoadOpenVINOInt8``) and the
-    VNNI int8 story (``wp-bigdl.md:192-196``)."""
-    from zoo_tpu.ops.pallas.quant import quantize_int8
+    VNNI int8 story — whose headline use is conv-net inference
+    (SSD/VGG, ``wp-bigdl.md:192-196``)."""
+    from zoo_tpu.ops.pallas.quant import (
+        quantize_conv_weights,
+        quantize_int8,
+    )
+    from zoo_tpu.pipeline.api.keras.layers.convolutional import (
+        Convolution2D,
+    )
     from zoo_tpu.pipeline.api.keras.layers.core import Dense
 
     if model.params is None:
         raise ValueError("model must be built before quantization")
     dense_keys = {model._key_of(l) for l in model.layers
                   if isinstance(l, Dense)}
+    conv_keys = {model._key_of(l) for l in model.layers
+                 if isinstance(l, Convolution2D)}
 
     def walk(tree):
         for key, val in list(tree.items()):
@@ -178,6 +187,9 @@ def quantize_model(model):
                     w = val.pop("W")
                     w_q, w_scale = quantize_int8(w, axis=0)
                     val["W_q"], val["W_scale"] = w_q, w_scale
+                elif key in conv_keys and "W" in val:
+                    w = val.pop("W")
+                    val["W_q"], val["W_scale"] = quantize_conv_weights(w)
                 else:
                     walk(val)
 

@@ -93,7 +93,33 @@ class NNEstimator:
 
     def _unpack(self, df):
         from zoo_tpu.orca.data.shard import LocalXShards
+        from zoo_tpu.orca.data.spark import (
+            is_spark_dataframe,
+            spark_dataframe_to_shards,
+        )
 
+        if is_spark_dataframe(df):
+            # Spark ML contract (reference nn_classifier.py:139): the
+            # executors write shard files; this process loads its slice
+            # and proceeds over pandas (no driver collect)
+            import pandas as pd
+
+            label = ([self.label_col]
+                     if self.label_col in df.columns else [])
+            shards = spark_dataframe_to_shards(
+                df, self.features_col, label)
+            frames = []
+            for s in shards.collect():
+                x = np.asarray(s["x"])
+                if len(self.features_col) == 1:
+                    d = {self.features_col[0]: list(x)}
+                else:
+                    d = {c: x[:, i]
+                         for i, c in enumerate(self.features_col)}
+                if "y" in s:
+                    d[self.label_col] = np.asarray(s["y"])
+                frames.append(pd.DataFrame(d))
+            df = pd.concat(frames, ignore_index=True)
         if isinstance(df, LocalXShards):
             import pandas as pd
 

@@ -1,0 +1,97 @@
+"""Non-executable wire codec for the serving TCP door.
+
+Replaces pickle on the socket (an unauthenticated ``pickle.loads`` is
+remote code execution the moment the port is reachable): messages are a
+JSON structure tree plus raw little-endian array buffers — nothing in the
+frame can execute on either end. Supported values: dict / list / tuple /
+str / int / float / bool / None / numpy ndarray (+ numpy scalars).
+
+Frame: ``ZSRV`` magic + u32 header length + JSON header + concatenated
+array buffers. Arrays appear in the JSON as
+``{"__nd__": i, "dtype": ..., "shape": ...}`` placeholders indexing the
+buffer list; tuples as ``{"__tuple__": [...]}``.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any, List, Tuple
+
+import numpy as np
+
+_MAGIC = b"ZSRV"
+
+# object/str dtypes could smuggle pickled payloads via np.frombuffer
+# misuse on the peer; whitelist plain numeric/bool kinds only
+_OK_KINDS = frozenset("biufc")
+
+
+def _pack(obj: Any, bufs: List[bytes]):
+    if isinstance(obj, np.ndarray):
+        if obj.dtype.kind not in _OK_KINDS:
+            raise TypeError(f"unsupported array dtype {obj.dtype} "
+                            "(numeric/bool arrays only)")
+        idx = len(bufs)
+        bufs.append(np.ascontiguousarray(obj).tobytes())
+        return {"__nd__": idx, "dtype": obj.dtype.str,
+                "shape": list(obj.shape)}
+    if isinstance(obj, np.generic):
+        return _pack(np.asarray(obj), bufs)
+    if isinstance(obj, tuple):
+        return {"__tuple__": [_pack(v, bufs) for v in obj]}
+    if isinstance(obj, list):
+        return [_pack(v, bufs) for v in obj]
+    if isinstance(obj, dict):
+        out = {}
+        for k, v in obj.items():
+            if not isinstance(k, str):
+                raise TypeError("dict keys must be str on the wire")
+            if k in ("__nd__", "__tuple__"):
+                raise TypeError(
+                    f"dict key {k!r} is reserved by the wire format")
+            out[k] = _pack(v, bufs)
+        return out
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if hasattr(obj, "shape") and hasattr(obj, "dtype"):  # jax array
+        return _pack(np.asarray(obj), bufs)
+    raise TypeError(f"unsupported wire type: {type(obj).__name__}")
+
+
+def _unpack(node: Any, bufs: List[bytes]):
+    if isinstance(node, dict):
+        if "__nd__" in node:
+            arr = np.frombuffer(bufs[node["__nd__"]],
+                                dtype=np.dtype(node["dtype"]))
+            # copy: frombuffer views are read-only; callers expect
+            # mutable arrays (the old pickle wire returned them)
+            return arr.reshape(node["shape"]).copy()
+        if "__tuple__" in node:
+            return tuple(_unpack(v, bufs) for v in node["__tuple__"])
+        return {k: _unpack(v, bufs) for k, v in node.items()}
+    if isinstance(node, list):
+        return [_unpack(v, bufs) for v in node]
+    return node
+
+
+def dumps(obj: Any) -> bytes:
+    bufs: List[bytes] = []
+    tree = _pack(obj, bufs)
+    header = json.dumps({"tree": tree,
+                         "bufs": [len(b) for b in bufs]}).encode()
+    return (_MAGIC + struct.pack(">I", len(header)) + header
+            + b"".join(bufs))
+
+
+def loads(blob: bytes) -> Any:
+    if blob[:4] != _MAGIC:
+        raise ValueError("bad frame magic (not a zoo serving message)")
+    (hlen,) = struct.unpack(">I", blob[4:8])
+    head = json.loads(blob[8:8 + hlen].decode())
+    bufs: List[bytes] = []
+    off = 8 + hlen
+    for n in head["bufs"]:
+        bufs.append(blob[off:off + n])
+        off += n
+    return _unpack(head["tree"], bufs)

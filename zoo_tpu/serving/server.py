@@ -6,17 +6,20 @@ batching controlled by ``ClusterServingInference``; per-stage ``Timer``
 stats ``serving/engine/Timer.scala:22-60``).
 
 The JVM streaming stack collapses to one async Python server pinned to the
-TPU: a TCP front door accepts length-prefixed pickled requests, a batcher
+TPU: a TCP front door accepts length-prefixed requests in a
+NON-EXECUTABLE codec (``serving/codec.py`` — JSON structure + raw array
+buffers; never pickle, so a reachable port cannot execute code), a batcher
 thread micro-batches up to ``batch_size`` or ``max_wait_ms`` (the
 reference's "batch size = core count" guidance maps to a fixed XLA batch,
 padded so one executable serves every request), the InferenceModel runs the
 batch, and responses are routed back per-request. Per-stage timers are kept
-(same avg/max/min stats the reference's Timer collects).
+(same avg/max/min stats the reference's Timer collects). The server binds
+loopback by default; pass ``host="0.0.0.0"`` only on a trusted network —
+there is no authentication on this door (see docs/serving.md).
 """
 
 from __future__ import annotations
 
-import pickle
 import queue
 import socket
 import socketserver
@@ -51,17 +54,21 @@ class StageTimer:
 
 
 def _send_msg(sock: socket.socket, obj):
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    from zoo_tpu.serving.codec import dumps
+
+    payload = dumps(obj)
     sock.sendall(struct.pack(">I", len(payload)) + payload)
 
 
 def _recv_msg(sock: socket.socket):
+    from zoo_tpu.serving.codec import loads
+
     header = _recv_exact(sock, 4)
     if header is None:
         return None
     (length,) = struct.unpack(">I", header)
     body = _recv_exact(sock, length)
-    return None if body is None else pickle.loads(body)
+    return None if body is None else loads(body)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:

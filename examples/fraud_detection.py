@@ -36,6 +36,11 @@ def rebalance(x, y, ratio=0.25, seed=1):
     rs = np.random.RandomState(seed)
     pos = np.where(y == 1)[0]
     neg = np.where(y == 0)[0]
+    if len(pos) == 0:
+        raise ValueError(
+            "training split contains no fraud rows — nothing to "
+            "oversample; use more rows (--rows) or a dataset slice that "
+            "includes positives")
     need = int(len(neg) * ratio)
     picked = rs.choice(pos, size=need, replace=True)
     idx = np.concatenate([neg, picked])

@@ -91,11 +91,39 @@ def spark_dataframe_to_shards(df, feature_cols: Sequence[str],
     if staging_dir is None:
         import tempfile
         staging_dir = tempfile.mkdtemp(prefix="zoo_spark_")
-    run = uuid.uuid4().hex[:8]
-    writer = _partition_writer(list(feature_cols) + label_cols,
-                               staging_dir, run)
-    # executors write the shard files; ONLY the path metadata collects
-    meta = sorted(df.rdd.mapPartitionsWithIndex(writer).collect())
+
+    import jax
+
+    live_multihost = (process_index is None and process_count is None
+                      and jax.process_count() > 1)
+    if live_multihost:
+        # stage ONCE for the whole cluster: process 0 runs the Spark job
+        # and publishes a manifest; peers agree on the run tag through
+        # the coordination service and read the manifest from the shared
+        # staging dir (one materialization, one dataset copy)
+        import json
+
+        from jax.experimental import multihost_utils
+
+        tag = np.frombuffer(uuid.uuid4().bytes[:8], np.uint8)
+        tag = multihost_utils.broadcast_one_to_all(tag)
+        run = bytes(tag.tolist()).hex()
+        manifest = os.path.join(staging_dir, f"zoo-{run}-manifest.json")
+        if jax.process_index() == 0:
+            writer = _partition_writer(list(feature_cols) + label_cols,
+                                       staging_dir, run)
+            meta = sorted(df.rdd.mapPartitionsWithIndex(writer).collect())
+            with open(manifest, "w") as f:
+                json.dump(meta, f)
+        multihost_utils.sync_global_devices(f"zoo_spark_stage_{run}")
+        with open(manifest) as f:
+            meta = [tuple(m) for m in json.load(f)]
+    else:
+        run = uuid.uuid4().hex[:8]
+        writer = _partition_writer(list(feature_cols) + label_cols,
+                                   staging_dir, run)
+        # executors write the shard files; ONLY the path metadata collects
+        meta = sorted(df.rdd.mapPartitionsWithIndex(writer).collect())
 
     from zoo_tpu.orca.data.shard import LocalXShards, shards_for_process
 

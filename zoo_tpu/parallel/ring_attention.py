@@ -34,7 +34,7 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
     my_idx = jax.lax.axis_index(axis_name)
     b, h, tl, d = q.shape
     rep = h // k.shape[1]
-    scale = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(q.dtype)
+    scale = scale if scale is not None else 1.0 / float(d) ** 0.5
 
     q_pos = my_idx * tl + jnp.arange(tl)
 
@@ -42,7 +42,13 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
         if rep > 1:  # GQA: local broadcast only
             k_blk = jnp.repeat(k_blk, rep, axis=1)
             v_blk = jnp.repeat(v_blk, rep, axis=1)
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) * scale
+        # bf16 matmul operands, f32 scores/statistics: the online-softmax
+        # running max/denominator/accumulator stay f32 across ring rounds
+        # (same numerics as the dense path's f32 softmax island and the
+        # flash kernel's f32 scratch) — bf16 accumulation loses ~1e-2
+        # relative mass over long rings
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk,
+                       preferred_element_type=jnp.float32) * scale
         if causal:
             k_pos = src_idx * tl + jnp.arange(tl)
             allowed = q_pos[:, None] >= k_pos[None, :]
@@ -55,8 +61,9 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new[..., None])
         l_new = l * alpha + p.sum(axis=-1)
-        o_new = o * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p,
-                                                  v_blk)
+        o_new = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p.astype(v_blk.dtype), v_blk,
+            preferred_element_type=jnp.float32)
         return m_new, l_new, o_new
 
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -72,14 +79,14 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
         m, l, o = block(q, k_blk, v_blk, src_idx, m, l, o)
         return k_blk, v_blk, src_idx, m, l, o
 
-    m0 = jnp.full((b, h, tl), -jnp.inf, q.dtype)
-    l0 = jnp.zeros((b, h, tl), q.dtype)
-    o0 = jnp.zeros_like(q)
+    m0 = jnp.full((b, h, tl), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, tl), jnp.float32)
+    o0 = jnp.zeros(q.shape, jnp.float32)
     m, l, o = block(q, k, v, my_idx, m0, l0, o0)
     carry = (k, v, my_idx, m, l, o)
     carry = jax.lax.fori_loop(0, n - 1, body, carry)
     _, _, _, m, l, o = carry
-    return o / jnp.maximum(l, 1e-30)[..., None]
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
 
 def ring_attention(mesh: Mesh, q, k, v, *, causal: bool = False,

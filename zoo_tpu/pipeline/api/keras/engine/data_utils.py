@@ -84,6 +84,10 @@ def to_xy_arrays(x, y=None, feature_cols: Optional[Sequence[str]] = None,
         # Spark DataFrame: executors write shard files, this process
         # loads its slice (no driver collect — orca/data/spark.py)
         from zoo_tpu.orca.data.spark import spark_dataframe_to_shards
+        if y is not None:
+            raise ValueError("labels come from label_cols for Spark "
+                             "DataFrame input, not a separate y= "
+                             "argument")
         if not feature_cols:
             raise ValueError("feature_cols required for Spark DataFrame "
                              "input")

@@ -34,14 +34,15 @@ from zoo_tpu.pipeline.api.keras.engine.base import (
 
 
 def _layer_norm(x, gamma, beta, eps=1e-5):
-    # f32 island: mean/var in reduced precision drift badly under the
-    # mixed-bf16 policy; compute stats in f32, emit in the input dtype
+    # f32 island for the STATS only (mean/var in bf16 drift badly); the
+    # normalized tensor drops to the compute dtype BEFORE the affine so
+    # autodiff saves a bf16 residual, not a f32 one (same treatment as
+    # llama's _rms_norm — the f32 product was a 2x-sized scan carry)
     xf = x.astype(jnp.float32)
     mean = jnp.mean(xf, axis=-1, keepdims=True)
     var = jnp.var(xf, axis=-1, keepdims=True)
-    out = (xf - mean) / jnp.sqrt(var + eps)
-    return (out * gamma.astype(jnp.float32)
-            + beta.astype(jnp.float32)).astype(x.dtype)
+    out = ((xf - mean) * jax.lax.rsqrt(var + eps)).astype(x.dtype)
+    return out * gamma.astype(x.dtype) + beta.astype(x.dtype)
 
 
 class LayerNorm(Layer):

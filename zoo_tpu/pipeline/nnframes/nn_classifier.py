@@ -119,6 +119,12 @@ class NNEstimator:
                 if "y" in s:
                     d[self.label_col] = np.asarray(s["y"])
                 frames.append(pd.DataFrame(d))
+            if not frames:
+                raise ValueError(
+                    "this process received no rows from the Spark "
+                    "DataFrame (empty partitions, or more JAX processes "
+                    "than non-empty partitions — repartition the "
+                    "DataFrame to at least process_count parts)")
             df = pd.concat(frames, ignore_index=True)
         if isinstance(df, LocalXShards):
             import pandas as pd

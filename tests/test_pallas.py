@@ -136,3 +136,56 @@ def test_fused_adam_matches_optax():
     p_ref = optax.apply_updates(p, upd)
     np.testing.assert_allclose(np.asarray(p1), np.asarray(p_ref),
                                atol=1e-5, rtol=1e-5)
+
+
+def test_flash_gqa_matches_repeated_dense():
+    """GQA-native flash: unrepeated kv heads through the kernel's index
+    maps — values AND all three gradients must match dense attention on
+    the explicitly repeated kv."""
+    import jax
+    import jax.numpy as jnp
+
+    from zoo_tpu.ops.attention import dot_product_attention
+    from zoo_tpu.ops.pallas import flash_attention
+
+    rs = np.random.RandomState(0)
+    B, HQ, HKV, T, D = 2, 6, 2, 32, 8
+    q = jnp.asarray(rs.randn(B, HQ, T, D), jnp.float32)
+    k = jnp.asarray(rs.randn(B, HKV, T, D), jnp.float32)
+    v = jnp.asarray(rs.randn(B, HKV, T, D), jnp.float32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, block_q=16,
+                                       block_k=16, interpret=True) ** 2)
+
+    def loss_dense(q, k, v):
+        rep = HQ // HKV
+        return jnp.sum(dot_product_attention(
+            q, jnp.repeat(k, rep, axis=1), jnp.repeat(v, rep, axis=1),
+            causal=True, impl="dense") ** 2)
+
+    rep = HQ // HKV
+    np.testing.assert_allclose(
+        np.asarray(flash_attention(q, k, v, causal=True, block_q=16,
+                                   block_k=16, interpret=True)),
+        np.asarray(dot_product_attention(
+            q, jnp.repeat(k, rep, axis=1), jnp.repeat(v, rep, axis=1),
+            causal=True, impl="dense")), atol=2e-5)
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b, nm in zip(gf, gd, "qkv"):
+        assert a.shape == b.shape, (nm, a.shape, b.shape)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, err_msg=f"d{nm}")
+
+
+def test_flash_gqa_rejects_bad_head_ratio():
+    import jax.numpy as jnp
+    import pytest
+
+    from zoo_tpu.ops.pallas import flash_attention
+
+    q = jnp.zeros((1, 5, 16, 8))
+    kv = jnp.zeros((1, 2, 16, 8))
+    with pytest.raises(ValueError, match="multiple"):
+        flash_attention(q, kv, kv, interpret=True)

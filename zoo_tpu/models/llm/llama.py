@@ -214,10 +214,9 @@ class Llama(Layer):
             from zoo_tpu.parallel.ring_attention import ring_attention
             a = ring_attention(self._seq_mesh(), q, k, v, causal=True)
         else:
-            rep = c.n_head // c.n_kv_head
-            if rep > 1:  # GQA: broadcast kv groups to query heads
-                k = jnp.repeat(k, rep, axis=1)
-                v = jnp.repeat(v, rep, axis=1)
+            # GQA passes the unrepeated kv heads straight through: the
+            # flash kernel maps query heads onto their group's kv head
+            # in its index maps, the dense path broadcasts internally
             a = dot_product_attention(q, k, v, causal=True,
                                       impl=self.attention_impl)
         a = a.transpose(0, 2, 1, 3).reshape(B, T, c.hidden)

@@ -35,6 +35,10 @@ def dot_product_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     blockwise kernel, zoo_tpu.ops.pallas.flash_attention), or "auto" —
     flash on TPU when it applies (no arbitrary mask, no dropout),
     dense otherwise.
+
+    GQA: ``k``/``v`` may carry fewer heads than ``q`` (``H_q % H_kv ==
+    0``). The flash kernel consumes the unrepeated kv heads natively;
+    the dense path broadcasts the groups here.
     """
     flash_ok = mask is None and dropout_p == 0.0
     # auto: flash from S>=512 up — with 512x512 blocks the kernel beats
@@ -51,6 +55,13 @@ def dot_product_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                              "impl for those")
         from zoo_tpu.ops.pallas import flash_attention
         return flash_attention(q, k, v, causal=causal, scale=scale)
+    if k.shape[1] != q.shape[1]:  # GQA on the dense path: broadcast
+        if q.shape[1] % k.shape[1]:
+            raise ValueError(f"q heads ({q.shape[1]}) must be a multiple "
+                             f"of kv heads ({k.shape[1]})")
+        rep = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / float(d) ** 0.5
     # QK^T rides the MXU in the input dtype; the softmax runs in an f32

@@ -81,3 +81,15 @@ def test_collapsed_fabric_shims_redirect():
         MXEstimator.from_mxnet()
     with pytest.raises(NotImplementedError, match="bootstrap"):
         MPIEstimator()
+
+
+def test_tfpark_text_models_reference_path():
+    """The reference's ``from zoo.tfpark.text.keras import NER`` line
+    (``pyzoo/zoo/tfpark/text/keras/ner.py``) resolves unmodified."""
+    from zoo.tfpark.text.keras import NER, IntentEntity, SequenceTagger
+
+    import zoo_tpu.models.text as real
+
+    assert NER is real.NER
+    assert SequenceTagger is real.SequenceTagger
+    assert IntentEntity is real.IntentEntity

@@ -1,0 +1,10 @@
+"""``zoo_tpu.tfpark`` — reference-import-path aliases.
+
+The reference's TFPark (TF1-graphs-on-BigDL: TFOptimizer, TFDataset,
+KerasModel, ``tfpark/tf_optimizer.py:350``) is declared obsolete by the
+no-JVM architecture (docs/migration.md); the capabilities live in the
+Orca estimators and bridges. What survives under this name is the text
+model family (``tfpark/text/keras``), so reference imports like
+``from zoo.tfpark.text.keras import NER`` keep working through the
+``zoo`` compat forwarder.
+"""

@@ -1,0 +1,3 @@
+"""Parent of the ``keras`` alias package (the reference's
+``tfpark/text/__init__.py`` is likewise empty — the model classes live
+in ``zoo_tpu.tfpark.text.keras``)."""

@@ -1,0 +1,14 @@
+"""Reference import-path alias (``pyzoo/zoo/tfpark/text/keras``):
+``from zoo.tfpark.text.keras import NER`` works unmodified."""
+
+from zoo_tpu.models.text import (  # noqa: F401
+    CRF,
+    IntentEntity,
+    NER,
+    SequenceTagger,
+    crf_decode,
+    crf_negative_log_likelihood,
+)
+
+__all__ = ["NER", "SequenceTagger", "IntentEntity", "CRF",
+           "crf_decode", "crf_negative_log_likelihood"]

@@ -169,3 +169,46 @@ def test_serving_codec_roundtrip_types():
         dumps({"bad": object()})
     with pytest.raises(TypeError):
         dumps({"strs": np.array(["a", "b"])})
+
+
+def test_serving_codec_rejects_malformed_frames():
+    import json
+    import struct
+
+    import pytest
+
+    from zoo_tpu.serving.codec import dumps, loads
+
+    def frame(head: dict, body: bytes = b"") -> bytes:
+        h = json.dumps(head).encode()
+        return b"ZSRV" + struct.pack(">I", len(h)) + h + body
+
+    good = dumps({"arr": np.arange(4, dtype=np.float32)})
+    loads(good)  # sanity
+
+    cases = [
+        good[:6],                                     # truncated header
+        frame({"tree": {"__nd__": 5, "dtype": "<f4", "shape": [1]},
+               "bufs": [4]}, b"\x00" * 4),            # out-of-range index
+        frame({"tree": None, "bufs": [64]}, b"\x00" * 4),  # over-length buf
+        frame({"tree": {"__nd__": 0, "dtype": "<f4", "shape": [9]},
+               "bufs": [4]}, b"\x00" * 4),            # shape > buffer
+        frame({"bufs": []}),                          # missing tree
+        b"ZSRV" + struct.pack(">I", 99) + b"{}",      # header past frame
+        frame({"tree": {"__nd__": 0, "shape": [1]},
+               "bufs": [4]}, b"\x00" * 4),            # missing dtype key
+        frame({"tree": {"__nd__": 0, "dtype": "<U1", "shape": [1]},
+               "bufs": [4]}, b"\x00" * 4),            # non-numeric dtype
+    ]
+    for blob in cases:
+        with pytest.raises(ValueError):
+            loads(blob)
+
+
+def test_llama_remat_typo_rejected():
+    import pytest
+
+    from zoo_tpu.models.llm.llama import Llama
+
+    with pytest.raises(ValueError, match="remat"):
+        Llama(remat="dot")

@@ -49,7 +49,12 @@ def _encode_shard(shard: Dict[str, np.ndarray]) -> bytes:
             "to_dict('series') -> numpy first)")
     buf = io.BytesIO()
     np.savez(buf, **shard)
-    return buf.getvalue()
+    blob = buf.getvalue()
+    if len(blob) > 0xFFFFFFFF:
+        raise ValueError(
+            f"shard encodes to {len(blob)} bytes, over the exchange's "
+            "u32 frame limit (4 GiB) — split it before shipping")
+    return blob
 
 
 def _decode_shard(blob: bytes) -> Dict[str, np.ndarray]:
@@ -57,13 +62,19 @@ def _decode_shard(blob: bytes) -> Dict[str, np.ndarray]:
         return {k: z[k] for k in z.files}
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    out = b""
-    while len(out) < n:
-        chunk = sock.recv(n - len(out))
-        if not chunk:
+def _recv_exact(sock: socket.socket, n: int) -> bytearray:
+    # preallocate + recv_into: shards are tens of MB, so quadratic
+    # bytes-concat accumulation would dominate the exchange; return the
+    # bytearray itself — bytes(out) would re-copy the whole blob, and
+    # every caller (magic compare, struct.unpack, BytesIO) takes it
+    out = bytearray(n)
+    view = memoryview(out)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:])
+        if not r:
             raise ConnectionError("peer closed mid-message")
-        out += chunk
+        got += r
     return out
 
 

@@ -87,15 +87,25 @@ def spark_dataframe_to_shards(df, feature_cols: Sequence[str],
     if missing:
         raise ValueError(f"column(s) not found: {missing}; "
                          f"available: {list(df.columns)}")
-    staging_dir = staging_dir or os.environ.get("ZOO_SPARK_STAGING")
-    if staging_dir is None:
-        import tempfile
-        staging_dir = tempfile.mkdtemp(prefix="zoo_spark_")
-
     import jax
 
     live_multihost = (process_index is None and process_count is None
                       and jax.process_count() > 1)
+    staging_dir = staging_dir or os.environ.get("ZOO_SPARK_STAGING")
+    if staging_dir is None:
+        if live_multihost:
+            # each process would mkdtemp() a DIFFERENT directory; peers
+            # would then fail on the manifest read after the sync barrier
+            # with a confusing FileNotFoundError — fail fast, before
+            # creating anything, with the real cause
+            raise RuntimeError(
+                "spark_dataframe_to_shards in multi-host mode needs a "
+                "staging directory visible to every host: set "
+                "ZOO_SPARK_STAGING (or pass staging_dir=) to shared "
+                "storage (NFS/GCS-fuse); the default per-process tmp dir "
+                "is host-local")
+        import tempfile
+        staging_dir = tempfile.mkdtemp(prefix="zoo_spark_")
     if live_multihost:
         # stage ONCE for the whole cluster: process 0 runs the Spark job
         # and publishes a manifest; peers agree on the run tag through

@@ -62,11 +62,22 @@ def _pack(obj: Any, bufs: List[bytes]):
 def _unpack(node: Any, bufs: List[bytes]):
     if isinstance(node, dict):
         if "__nd__" in node:
-            arr = np.frombuffer(bufs[node["__nd__"]],
-                                dtype=np.dtype(node["dtype"]))
-            # copy: frombuffer views are read-only; callers expect
-            # mutable arrays (the old pickle wire returned them)
-            return arr.reshape(node["shape"]).copy()
+            idx = node["__nd__"]
+            if not isinstance(idx, int) or not 0 <= idx < len(bufs):
+                raise ValueError("malformed frame")
+            try:
+                dt = np.dtype(node["dtype"])
+                if dt.kind not in _OK_KINDS:
+                    # mirror the encode-side whitelist: a wire-supplied
+                    # unicode/object/structured dtype must die here as a
+                    # protocol error, not deep inside the model
+                    raise ValueError(f"dtype kind {dt.kind!r}")
+                arr = np.frombuffer(bufs[idx], dtype=dt)
+                # copy: frombuffer views are read-only; callers expect
+                # mutable arrays (the old pickle wire returned them)
+                return arr.reshape(node["shape"]).copy()
+            except (KeyError, TypeError, ValueError) as e:
+                raise ValueError("malformed frame") from e
         if "__tuple__" in node:
             return tuple(_unpack(v, bufs) for v in node["__tuple__"])
         return {k: _unpack(v, bufs) for k, v in node.items()}
@@ -87,11 +98,28 @@ def dumps(obj: Any) -> bytes:
 def loads(blob: bytes) -> Any:
     if blob[:4] != _MAGIC:
         raise ValueError("bad frame magic (not a zoo serving message)")
+    if len(blob) < 8:
+        raise ValueError("malformed frame")
     (hlen,) = struct.unpack(">I", blob[4:8])
-    head = json.loads(blob[8:8 + hlen].decode())
+    if 8 + hlen > len(blob):
+        raise ValueError("malformed frame")
+    try:
+        head = json.loads(blob[8:8 + hlen].decode())
+        lens = head["bufs"]
+        tree = head["tree"]
+    except (UnicodeDecodeError, json.JSONDecodeError, KeyError,
+            TypeError) as e:
+        raise ValueError("malformed frame") from e
+    # declared buffer lengths must tile the frame body exactly — a
+    # wire-supplied over-length otherwise surfaces as a confusing
+    # numpy error deep inside _unpack instead of a protocol error here
+    if (not isinstance(lens, list)
+            or any(not isinstance(n, int) or n < 0 for n in lens)
+            or 8 + hlen + sum(lens) != len(blob)):
+        raise ValueError("malformed frame")
     bufs: List[bytes] = []
     off = 8 + hlen
-    for n in head["bufs"]:
+    for n in lens:
         bufs.append(blob[off:off + n])
         off += n
-    return _unpack(head["tree"], bufs)
+    return _unpack(tree, bufs)

@@ -71,13 +71,18 @@ def _recv_msg(sock: socket.socket):
     return None if body is None else loads(body)
 
 
-def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytearray]:
+    # preallocate + recv_into: multi-MB array payloads would otherwise
+    # pay quadratic bytes-concat; the bytearray goes straight to the
+    # codec (slicing/compare/frombuffer all take it) — no final copy
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:])
+        if not r:
             return None
-        buf += chunk
+        got += r
     return buf
 
 

@@ -1,0 +1,111 @@
+"""Sharding-quality checks: compiled-HLO collective assertions.
+
+The dryrun's value depends on these assertions actually failing when a
+sharding spec is broken — the replication regression they exist to catch
+still trains with finite loss. ``test_broken_fsdp_spec_fails`` proves the
+negative case with a deliberately broken placement.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from zoo_tpu.parallel.hlo_check import (
+    CollectiveError,
+    assert_collectives,
+    collective_counts,
+)
+
+
+def _small_ncf():
+    from zoo_tpu.models.recommendation import NeuralCF
+    from zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+    m = NeuralCF(user_count=64, item_count=64, class_num=5, user_embed=8,
+                 item_embed=8, hidden_layers=(16, 8), mf_embed=8)
+    m.compile(optimizer=Adam(lr=1e-3),
+              loss="sparse_categorical_crossentropy")
+    return m
+
+
+def _xy(n=32):
+    rs = np.random.RandomState(0)
+    x = np.stack([rs.randint(0, 64, n), rs.randint(0, 64, n)],
+                 axis=1).astype(np.int32)
+    return x, rs.randint(0, 5, n).astype(np.int32)
+
+
+def test_collective_counts_parses_hlo_text():
+    txt = """
+HloModule jit_step
+  %ag = f32[8,64]{1,0} all-gather(%p0), replica_groups={{0,1}}
+  %ar.1 = f32[64]{0} all-reduce-start(%g), to_apply=%sum
+  %ar.2 = f32[64]{0} all-reduce-done(%ar.1)
+  %rs = f32[8]{0} reduce-scatter(%g2), dimensions={0}
+  %cp = f32[4]{0} collective-permute(%x), source_target_pairs={{0,1}}
+    """
+    c = collective_counts(txt)
+    assert c == {"all-gather": 1, "all-reduce": 1, "reduce-scatter": 1,
+                 "collective-permute": 1}
+
+
+def test_assert_collectives_modes():
+    txt = "%a = f32[4] all-reduce(%g)"
+    assert_collectives(txt, require=["all-reduce"], forbid=["all-gather"])
+    with pytest.raises(CollectiveError, match="absent"):
+        assert_collectives(txt, require=["all-gather"])
+    with pytest.raises(CollectiveError, match="none of"):
+        assert_collectives(txt, require_any=["all-gather",
+                                             "reduce-scatter"])
+    with pytest.raises(CollectiveError, match="forbidden"):
+        assert_collectives(txt, forbid=["all-reduce"])
+
+
+@pytest.fixture
+def fsdp_ctx():
+    from zoo_tpu.orca import init_orca_context, stop_orca_context
+
+    n = len(jax.devices())
+    if n < 4:
+        pytest.skip("needs the 8-device CPU mesh")
+    init_orca_context(cluster_mode="local", devices=jax.devices()[:n],
+                      mesh_axes={"data": n // 2, "fsdp": 2})
+    yield
+    stop_orca_context()
+
+
+def test_correct_fsdp_spec_passes(fsdp_ctx):
+    m = _small_ncf()
+    x, y = _xy()
+    hlo = m.lower_train_hlo(x, y, batch_size=8)
+    assert_collectives(hlo, require=["all-gather"],
+                       require_any=["reduce-scatter", "all-to-all",
+                                    "all-reduce"],
+                       label="fsdp step")
+
+
+def test_broken_fsdp_spec_fails(fsdp_ctx):
+    """A placement that silently replicates params under an fsdp mesh
+    still trains — but the checker must refuse it."""
+    from zoo_tpu.parallel.mesh import replicated_sharding
+
+    m = _small_ncf()
+
+    def broken_place(params):
+        mesh = m._mesh()
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, replicated_sharding(mesh)), params)
+
+    m._place = broken_place
+    x, y = _xy()
+    # the broken spec still fits with finite loss — exactly why a
+    # run-and-check-loss dryrun can't catch it
+    hist = m.fit(x, y, batch_size=8, nb_epoch=1, verbose=0)
+    assert np.isfinite(hist["loss"][0])
+    hlo = m.lower_train_hlo(x, y, batch_size=8)
+    with pytest.raises(CollectiveError):
+        assert_collectives(hlo, require=["all-gather"],
+                           require_any=["reduce-scatter", "all-to-all",
+                                        "all-reduce"],
+                           label="broken fsdp step")

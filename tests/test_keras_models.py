@@ -132,3 +132,30 @@ def test_mixed_bfloat16_policy_trains(orca_ctx):
     leaves = jax.tree.leaves(m.params)
     assert leaves and not any(
         l.dtype == jnp.bfloat16 for l in leaves if hasattr(l, "dtype"))
+
+
+def test_save_after_device_resident_fit(tmp_path):
+    """A single-chip fit on an HBM-resident dataset caches a jitted
+    staging fn; save()/to_bytes() must clear it like every other jit
+    cache or cloudpickle dies on the PjitFunction."""
+    import jax
+    import jax.numpy as jnp
+
+    from zoo_tpu.orca import init_orca_context, stop_orca_context
+
+    init_orca_context(cluster_mode="local", devices=[jax.devices()[0]])
+    try:
+        x, y = _toy_regression(n=64)
+        model = Sequential()
+        model.add(Dense(2, input_shape=(4,)))
+        model.compile(optimizer="adam", loss="mse")
+        model.fit(jnp.asarray(x), jnp.asarray(y), batch_size=16,
+                  nb_epoch=1, shuffle=False, verbose=0)
+        p = str(tmp_path / "m.zoo")
+        model.save(p)
+        m2 = Sequential.load(p)
+        np.testing.assert_allclose(np.asarray(model.predict(x[:4])),
+                                   np.asarray(m2.predict(x[:4])),
+                                   rtol=1e-5)
+    finally:
+        stop_orca_context()

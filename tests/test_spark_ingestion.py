@@ -1,6 +1,21 @@
 """Spark DataFrame ingestion adapter, tested against a stubbed partition
-iterator (pyspark is not in this image — VERDICT r2 missing #1). The stub
-implements exactly the four-method surface the adapter uses."""
+iterator.
+
+pyspark cannot run here: the image has no JVM (``java`` absent) and
+installs are not possible, so a live ``local[4]`` SparkContext is out of
+reach — the constraint is recorded in ``docs/migration.md``. The stub
+therefore conforms to the real ``RDD.mapPartitionsWithIndex`` contract
+as closely as a JVM-less harness can:
+
+* the shipped function is ROUND-TRIPPED through cloudpickle on every
+  call (Spark's ``CloudPickleSerializer`` — exactly where closures
+  break on real clusters);
+* ``test_executor_subprocess_runs_pickled_writer`` executes the pickled
+  writer in a FRESH python interpreter (a real executor boundary: no
+  shared memory, staging-dir visibility for real);
+* SQL schema edges are covered: null rows, ``Decimal``, ArrayType
+  (nested lists), string columns.
+"""
 
 import numpy as np
 import pandas as pd
@@ -25,6 +40,11 @@ class _StubRDD:
         self._parts = partitions
 
     def mapPartitionsWithIndex(self, f):
+        # the real contract: the function is serialized, shipped, and
+        # deserialized on executors — a closure that only works
+        # in-process must fail HERE, not on a live cluster
+        import cloudpickle
+        f = cloudpickle.loads(cloudpickle.dumps(f))
         out = []
         for i, part in enumerate(self._parts):
             out.extend(f(i, iter(part)))
@@ -34,11 +54,19 @@ class _StubRDD:
 class DataFrame:  # noqa: N801 — must be named like pyspark's class
     """Pandas-backed stub of pyspark.sql.DataFrame."""
 
+    @staticmethod
+    def _row(row):
+        # real Spark delivers SQL NULL as python None in EVERY column
+        # type; pandas holds NaN — convert so the stub is row-faithful
+        d = row._asdict() if hasattr(row, "_asdict") else dict(row)
+        return {k: None if (isinstance(v, float) and np.isnan(v)) else v
+                for k, v in d.items()}
+
     def __init__(self, pdf: pd.DataFrame, num_partitions: int = 3):
         self._pdf = pdf
         bounds = np.linspace(0, len(pdf), num_partitions + 1).astype(int)
         self._parts = [
-            [row._asdict() if hasattr(row, "_asdict") else dict(row)
+            [self._row(row)
              for _, row in pdf.iloc[bounds[i]:bounds[i + 1]].iterrows()]
             for i in range(num_partitions)]
 
@@ -142,6 +170,92 @@ def test_estimator_fit_spark_requires_feature_cols():
     m.compile(optimizer="adam", loss="mse")
     with pytest.raises(ValueError, match="feature_cols"):
         Estimator.from_keras(m).fit(df, epochs=1)
+
+
+def test_executor_subprocess_runs_pickled_writer(tmp_path):
+    """The shipped writer must survive a REAL executor boundary: plain
+    pickle over a fresh python interpreter, no shared memory with the
+    driver, results read back only through the staging dir."""
+    import pickle
+    import subprocess
+    import sys
+
+    from zoo_tpu.orca.data.spark import _partition_writer
+
+    writer = _partition_writer(["f1", "label"], str(tmp_path), "subproc")
+    rows = [{"f1": float(i), "label": float(i % 2)} for i in range(10)]
+    payload = tmp_path / "task.pkl"
+    with open(payload, "wb") as fh:
+        pickle.dump((writer, 3, rows), fh)  # plain pickle, like a worker
+
+    script = (
+        "import pickle, sys\n"
+        f"f, pid, rows = pickle.load(open({str(payload)!r}, 'rb'))\n"
+        "meta = list(f(pid, iter(rows)))\n"
+        f"pickle.dump(meta, open({str(tmp_path / 'meta.pkl')!r}, 'wb'))\n"
+    )
+    subprocess.run([sys.executable, "-c", script], check=True,
+                   timeout=120)
+    with open(tmp_path / "meta.pkl", "rb") as fh:
+        meta = pickle.load(fh)
+    (pid, path, n), = meta
+    assert pid == 3 and n == 10
+    with np.load(path, allow_pickle=False) as z:
+        np.testing.assert_allclose(z["f1"], np.arange(10.0))
+
+
+def test_schema_edge_cases(tmp_path):
+    """Null rows, Decimal, ArrayType, and string columns — the SQL-type
+    edges a real DataFrame delivers to the partition iterator."""
+    from decimal import Decimal
+
+    # nulls in a float column -> NaN
+    pdf = pd.DataFrame({"f": [1.0, None, 3.0],
+                        "label": [0.0, 1.0, 0.0]})
+    shards = spark_dataframe_to_shards(
+        DataFrame(pdf, 1), ["f"], ["label"], staging_dir=str(tmp_path),
+        process_index=0, process_count=1)
+    x = np.concatenate([s["x"] for s in shards.collect()])
+    assert np.isnan(x[1]) and x[0] == 1.0
+
+    # Decimal column -> float64 (Spark DecimalType rows arrive as Decimal)
+    pdf = pd.DataFrame({"f": [Decimal("1.25"), Decimal("2.5")],
+                        "label": [0.0, 1.0]})
+    shards = spark_dataframe_to_shards(
+        DataFrame(pdf, 1), ["f"], ["label"], staging_dir=str(tmp_path),
+        process_index=0, process_count=1)
+    x = np.concatenate([s["x"] for s in shards.collect()])
+    np.testing.assert_allclose(x, [1.25, 2.5])
+
+    # ArrayType column -> stacked 2-D features
+    pdf = pd.DataFrame({"f": [[1.0, 2.0], [3.0, 4.0]],
+                        "label": [0.0, 1.0]})
+    shards = spark_dataframe_to_shards(
+        DataFrame(pdf, 1), ["f"], ["label"], staging_dir=str(tmp_path),
+        process_index=0, process_count=1)
+    x = np.concatenate([s["x"] for s in shards.collect()])
+    np.testing.assert_allclose(x, [[1.0, 2.0], [3.0, 4.0]])
+
+    # null in a non-float column -> actionable error, not dtype=object
+    pdf = pd.DataFrame({"f": ["a", None], "label": [0.0, 1.0]})
+    with pytest.raises(ValueError, match="na.fill"):
+        spark_dataframe_to_shards(
+            DataFrame(pdf, 1), ["f"], ["label"],
+            staging_dir=str(tmp_path), process_index=0, process_count=1)
+
+    # string column -> actionable error (npz side is allow_pickle=False)
+    pdf = pd.DataFrame({"f": ["a", "b"], "label": [0.0, 1.0]})
+    with pytest.raises(TypeError, match="non-numeric"):
+        spark_dataframe_to_shards(
+            DataFrame(pdf, 1), ["f"], ["label"],
+            staging_dir=str(tmp_path), process_index=0, process_count=1)
+
+    # ragged ArrayType -> actionable error
+    pdf = pd.DataFrame({"f": [[1.0, 2.0], [3.0]], "label": [0.0, 1.0]})
+    with pytest.raises(ValueError, match="ragged"):
+        spark_dataframe_to_shards(
+            DataFrame(pdf, 1), ["f"], ["label"],
+            staging_dir=str(tmp_path), process_index=0, process_count=1)
 
 
 def test_nnestimator_fit_spark_dataframe(tmp_path, monkeypatch):

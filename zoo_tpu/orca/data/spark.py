@@ -39,25 +39,75 @@ def is_spark_dataframe(obj) -> bool:
     return mod.startswith("pyspark.") and type(obj).__name__ == "DataFrame"
 
 
-def _partition_writer(columns: Sequence[str], staging_dir: str, run: str):
-    """The function shipped to Spark executors. Self-contained: converts
-    a partition's rows to one ``.npz`` of column arrays and yields only
-    the (partition_id, path, row_count) triple."""
+def _column_array(name: str, vals: List) -> np.ndarray:
+    """Convert one column's python values (as Spark rows deliver them) to
+    a numeric ndarray, covering the SQL-type edge cases: nulls become NaN
+    in float columns (and are an error in non-float ones), ``Decimal``
+    becomes float64, array columns (``ArrayType``) stack to 2-D. String/
+    object columns fail with a clear message — the staged ``.npz`` files
+    are loaded with ``allow_pickle=False`` on the TPU hosts."""
+    import decimal
 
-    def write(pid, rows):
-        cols = {c: [] for c in columns}
+    has_null = any(v is None for v in vals)
+    if vals and any(isinstance(v, decimal.Decimal) for v in vals):
+        return np.asarray([np.nan if v is None else float(v)
+                           for v in vals], np.float64)
+    if has_null:
+        if all(v is None or isinstance(v, float) for v in vals):
+            return np.asarray([np.nan if v is None else v for v in vals],
+                              np.float64)
+        raise ValueError(
+            f"column {name!r} contains nulls in a non-float type; "
+            "fill or drop them in Spark (df.na.fill / df.na.drop) "
+            "before handing the DataFrame to the estimator")
+    if vals and isinstance(vals[0], (list, tuple, np.ndarray)):
+        try:
+            return np.stack([np.asarray(v, np.float32) for v in vals])
+        except ValueError as e:
+            raise ValueError(
+                f"array column {name!r} has ragged lengths; pad it to a "
+                "fixed size in Spark before ingestion") from e
+    arr = np.asarray(vals)
+    if arr.dtype.kind not in "biufc":  # unicode/object/bytes/datetime
+        raise TypeError(
+            f"column {name!r} has non-numeric type "
+            f"{type(vals[0]).__name__}; select/cast numeric columns "
+            "(StringIndexer etc. happen Spark-side)")
+    return arr
+
+
+class _PartitionWriter:
+    """The callable shipped to Spark executors via
+    ``rdd.mapPartitionsWithIndex``. A module-level class instance — NOT a
+    closure — so it serializes under plain pickle as well as Spark's
+    cloudpickle; executors only need this module importable (the zoo_tpu
+    wheel on the executor python path, the reference's ``--py-files``
+    story). Converts a partition's rows to one ``.npz`` of column arrays
+    and yields only the (partition_id, path, row_count) triple."""
+
+    def __init__(self, columns: Sequence[str], staging_dir: str, run: str):
+        self.columns = list(columns)
+        self.staging_dir = staging_dir
+        self.run = run
+
+    def __call__(self, pid, rows):
+        cols = {c: [] for c in self.columns}
         n = 0
         for row in rows:
-            for c in columns:
+            for c in self.columns:
                 cols[c].append(row[c])
             n += 1
         if n == 0:
             return iter(())
-        path = os.path.join(staging_dir, f"zoo-{run}-p{pid:05d}.npz")
-        np.savez(path, **{c: np.asarray(v) for c, v in cols.items()})
+        path = os.path.join(self.staging_dir,
+                            f"zoo-{self.run}-p{pid:05d}.npz")
+        np.savez(path, **{c: _column_array(c, v)
+                          for c, v in cols.items()})
         return iter([(pid, path, n)])
 
-    return write
+
+def _partition_writer(columns: Sequence[str], staging_dir: str, run: str):
+    return _PartitionWriter(columns, staging_dir, run)
 
 
 def spark_dataframe_to_shards(df, feature_cols: Sequence[str],

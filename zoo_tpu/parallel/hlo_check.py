@@ -1,0 +1,101 @@
+"""Compiled-HLO sharding-quality checks.
+
+A sharding regression that silently replicates everything still *runs*
+and produces finite loss — the only place the difference is visible
+before you pay for 8 chips is the compiled HLO's collective mix. These
+helpers inspect the optimized module text of a compiled step and assert
+the collectives the intended parallelism plan implies:
+
+- pure DP: gradients all-reduce; **no** all-gather (a full-parameter
+  all-gather under DP means params were accidentally sharded or the
+  batch sharding leaked into the params);
+- FSDP/ZeRO: all-gather (weights into the consuming op) **and** a grad
+  reduction (reduce-scatter, or all-reduce on backends whose SPMD
+  partitioner didn't pattern-match the scatter form);
+- ring/sequence parallel: collective-permute (the ring hop).
+
+Reference semantics being checked: the slice-wise parameter-server
+update of ``Topology.scala:1204`` (reduce-scatter + apply + all-gather)
+is what XLA's SPMD partitioner emits for a ZeRO-sharded step.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterable, Optional
+
+__all__ = ["collective_counts", "assert_collectives", "CollectiveError"]
+
+# async pairs (all-reduce-start/-done) and channel-suffixed forms all
+# reduce to the base op name; "-start" lines carry the operands so count
+# only those plus the plain sync form
+_COLLECTIVE_RE = re.compile(
+    r"\b(all-reduce|all-gather|reduce-scatter|collective-permute|"
+    r"all-to-all)(-start)?\b")
+
+
+class CollectiveError(AssertionError):
+    """A compiled step's collective mix contradicts the intended plan."""
+
+
+def collective_counts(hlo_text: str) -> Dict[str, int]:
+    """Count collective instructions in optimized HLO module text.
+
+    Counts instruction definitions (lines containing ``= <op>`` or the
+    fused/async start forms), merging async ``-start`` with sync forms.
+    """
+    counts: Dict[str, int] = {}
+    for line in hlo_text.splitlines():
+        # instruction lines look like  "%name = type op(...)"; skip
+        # metadata/backend-config mentions by requiring the op token to
+        # follow an "= " or " = " assignment on the line
+        if "=" not in line:
+            continue
+        rhs = line.split("=", 1)[1]
+        m = _COLLECTIVE_RE.search(rhs)
+        if not m:
+            continue
+        if m.group(2) is None and "-done" in rhs[:m.start() + 24]:
+            continue  # the -done half of an async pair
+        counts[m.group(1)] = counts.get(m.group(1), 0) + 1
+    return counts
+
+
+def _text_of(compiled) -> str:
+    if isinstance(compiled, str):
+        return compiled
+    return compiled.as_text()
+
+
+def assert_collectives(compiled, *, require: Iterable[str] = (),
+                       require_any: Optional[Iterable[str]] = None,
+                       forbid: Iterable[str] = (),
+                       label: str = "step") -> Dict[str, int]:
+    """Assert the collective mix of a compiled executable (or HLO text).
+
+    ``require``: ops that must each appear at least once.
+    ``require_any``: at least one op of this set must appear.
+    ``forbid``: ops that must not appear at all.
+    Returns the counts for further custom assertions.
+    """
+    counts = collective_counts(_text_of(compiled))
+    missing = [op for op in require if counts.get(op, 0) == 0]
+    if missing:
+        raise CollectiveError(
+            f"{label}: expected collective(s) {missing} absent from the "
+            f"compiled HLO (found {counts or 'none'}) — the sharding "
+            "spec did not produce the intended parallelism")
+    if require_any is not None:
+        opts = list(require_any)
+        if not any(counts.get(op, 0) for op in opts):
+            raise CollectiveError(
+                f"{label}: none of {opts} present in the compiled HLO "
+                f"(found {counts or 'none'}) — the sharding spec did "
+                "not produce the intended parallelism")
+    bad = {op: counts[op] for op in forbid if counts.get(op, 0)}
+    if bad:
+        raise CollectiveError(
+            f"{label}: forbidden collective(s) {bad} present in the "
+            "compiled HLO — under this plan they indicate accidental "
+            "resharding (e.g. a full-parameter all-gather in pure DP)")
+    return counts

@@ -1,10 +1,23 @@
-"""``zoo_tpu.tfpark`` — reference-import-path aliases.
+"""``zoo_tpu.tfpark`` — reference-import-path compat surface.
 
 The reference's TFPark (TF1-graphs-on-BigDL: TFOptimizer, TFDataset,
-KerasModel, ``tfpark/tf_optimizer.py:350``) is declared obsolete by the
-no-JVM architecture (docs/migration.md); the capabilities live in the
-Orca estimators and bridges. What survives under this name is the text
-model family (``tfpark/text/keras``), so reference imports like
-``from zoo.tfpark.text.keras import NER`` keep working through the
-``zoo`` compat forwarder.
+KerasModel, ``tfpark/tf_optimizer.py:350``) is architecturally obsolete
+here (docs/migration.md) but its *capabilities* are not: ``KerasModel``,
+``TFDataset`` and ``GANEstimator`` delegate onto the Orca fabric
+(``tfpark/compat.py``), ``TFEstimator`` raises a migration-pointing
+error, and the text model family (``tfpark/text/keras``) is the real
+implementation — so reference imports like ``from zoo.tfpark import
+KerasModel`` and ``from zoo.tfpark.text.keras import NER`` keep working
+through the ``zoo`` compat forwarder.
 """
+
+from zoo_tpu.tfpark.compat import (  # noqa: F401
+    GANEstimator,
+    KerasModel,
+    TFDataset,
+    TFEstimator,
+    TFParkMigrationError,
+)
+
+__all__ = ["KerasModel", "TFDataset", "TFEstimator", "GANEstimator",
+           "TFParkMigrationError"]

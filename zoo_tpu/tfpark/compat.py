@@ -1,0 +1,299 @@
+"""TFPark training-API compat surface.
+
+The reference's TFPark (``pyzoo/zoo/tfpark``) is the TF1-graphs-on-BigDL
+stack: ``KerasModel`` (``tfpark/model.py:31``) wraps a compiled tf.keras
+model and trains it distributed, ``TFDataset`` (``tfpark/tf_dataset.py:121``)
+is the placeholder-feed dataset facade, ``TFEstimator``
+(``tfpark/estimator.py:30``) runs TF1 ``model_fn`` Estimators, and
+``GANEstimator`` (``tfpark/gan``) alternates G/D training.
+
+Here the *capabilities* already exist under Orca names, so this module is
+real delegation, not stubs: ``KerasModel`` bridges a tf.keras model onto
+the zoo_tpu keras facade (``bridges/keras_bridge.py``) and trains it with
+the jitted fit fabric; ``TFDataset.from_ndarrays`` /
+``from_tf_data_dataset`` / ``from_dataframe`` feed it; ``GANEstimator``
+is the Orca GAN fabric (``orca/learn/gan.py``). Only the TF1-specific
+surfaces (``model_fn`` Estimators, RDD/placeholder feeds) raise
+migration errors that name their replacement — never a bare
+``ModuleNotFoundError``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from zoo_tpu.orca.learn.gan import GANEstimator  # re-export  # noqa: F401
+
+__all__ = ["KerasModel", "TFDataset", "TFEstimator", "GANEstimator",
+           "TFParkMigrationError"]
+
+
+class TFParkMigrationError(NotImplementedError):
+    """A TFPark surface whose mechanism (TF1 graphs on the JVM) does not
+    exist here; the message names the migration target."""
+
+
+def _is_facade_model(model) -> bool:
+    from zoo_tpu.pipeline.api.keras.engine.topology import KerasNet
+    return isinstance(model, KerasNet)
+
+
+class KerasModel:
+    """``zoo.tfpark.KerasModel`` — reference ``tfpark/model.py:31``.
+
+    Accepts a COMPILED tf.keras model (converted through the structural
+    keras bridge, optimizer/loss mapped like the TF2 estimator does) or a
+    zoo_tpu keras-facade model directly. ``fit``/``evaluate``/``predict``
+    run on the jitted TPU fabric; the reference's ``distributed=True``
+    flag is accepted and ignored (distribution is the ambient mesh here,
+    set via ``init_orca_context(mesh_axes=...)``)."""
+
+    def __init__(self, model, model_dir: Optional[str] = None,
+                 optimizer=None):
+        if _is_facade_model(model):
+            self.model = model
+        else:
+            from zoo_tpu.bridges.keras_bridge import convert_keras_model
+            from zoo_tpu.orca.learn.tf2.estimator import (
+                _convert_loss,
+                _convert_optimizer,
+            )
+
+            zmodel = convert_keras_model(model)
+            opt = optimizer if optimizer is not None else \
+                getattr(model, "optimizer", None)
+            loss = getattr(model, "loss", None)
+            if loss is None:
+                raise ValueError(
+                    "KerasModel needs a compiled tf.keras model "
+                    "(model.compile(...) first) or a compiled facade "
+                    "model")
+            zmodel.compile(optimizer=_convert_optimizer(opt),
+                           loss=_convert_loss(loss))
+            self.model = zmodel
+        self.model_dir = model_dir
+
+    # -- weights ---------------------------------------------------------
+    def get_weights(self):
+        return self.model.get_weights() \
+            if hasattr(self.model, "get_weights") else self.model.params
+
+    def set_weights(self, weights):
+        if hasattr(self.model, "set_weights"):
+            self.model.set_weights(weights)
+        else:
+            self.model.params = weights
+
+    def save_weights(self, filepath, overwrite=True, save_format=None):
+        self.model.save_weights(filepath)
+
+    def load_weights(self, filepath, by_name=False):
+        self.model.load_weights(filepath)
+
+    def save_model(self, path, overwrite=True):
+        self.model.save(path)
+
+    @staticmethod
+    def load_model(path):
+        from zoo_tpu.pipeline.api.keras.engine.topology import KerasNet
+        out = KerasModel.__new__(KerasModel)
+        out.model = KerasNet.load(path)
+        out.model_dir = None
+        return out
+
+    # -- train/eval/predict ---------------------------------------------
+    @staticmethod
+    def _unpack(x, y, batch_size):
+        if isinstance(x, TFDataset):
+            bs = x.batch_size if x.batch_size and x.batch_size > 0 \
+                else batch_size
+            return x.x, x.y, bs
+        return x, y, batch_size
+
+    def fit(self, x=None, y=None, batch_size=32, epochs=1,
+            validation_data=None, distributed=False, **kwargs):
+        x, y, batch_size = self._unpack(x, y, batch_size)
+        if isinstance(validation_data, TFDataset):
+            validation_data = (validation_data.x, validation_data.y)
+        return self.model.fit(x, y, batch_size=batch_size,
+                              nb_epoch=epochs,
+                              validation_data=validation_data,
+                              verbose=kwargs.get("verbose", 0))
+
+    def evaluate(self, x=None, y=None, batch_per_thread=None,
+                 distributed=False):
+        x, y, bs = self._unpack(x, y, batch_per_thread or 32)
+        return self.model.evaluate(x, y, batch_size=bs)
+
+    def predict(self, x, batch_per_thread=None, distributed=False):
+        x, _, bs = self._unpack(x, None, batch_per_thread or 256)
+        return self.model.predict(x, batch_size=bs)
+
+    def train_on_batch(self, x, y=None, sample_weight=None):
+        h = self.model.fit(x, y, batch_size=len(np.asarray(x)),
+                           nb_epoch=1, shuffle=False, verbose=0)
+        return h["loss"][-1]
+
+    def test_on_batch(self, x, y=None, sample_weight=None,
+                      reset_metrics=True):
+        return self.model.evaluate(x, y, batch_size=len(np.asarray(x)))
+
+    def predict_on_batch(self, x):
+        return self.model.predict(x, batch_size=len(np.asarray(x)))
+
+
+class TFDataset:
+    """``zoo.tfpark.TFDataset`` — reference ``tfpark/tf_dataset.py:121``.
+
+    The reference builds TF1 placeholder feeds over RDDs; here the
+    constructors that have a data-capability equivalent materialize to
+    numpy (the jitted fit fabric stages device-side), and the RDD/TF1
+    ones raise a migration error naming the replacement."""
+
+    def __init__(self, x, y=None, batch_size: int = -1,
+                 batch_per_thread: int = -1, val_x=None, val_y=None):
+        self.x, self.y = x, y
+        self.batch_size = batch_size if batch_size > 0 else batch_per_thread
+        self.val_x, self.val_y = val_x, val_y
+
+    @staticmethod
+    def from_ndarrays(tensors, batch_size: int = -1,
+                      batch_per_thread: int = -1, val_tensors=None,
+                      **kwargs) -> "TFDataset":
+        """reference: ``tf_dataset.py:384`` — (features, labels) ndarray
+        tuples (or a single features array/tuple)."""
+        def split(t):
+            if isinstance(t, (tuple, list)) and len(t) == 2:
+                return t[0], t[1]
+            return t, None
+        x, y = split(tensors)
+        vx, vy = split(val_tensors) if val_tensors is not None \
+            else (None, None)
+        return TFDataset(x, y, batch_size, batch_per_thread, vx, vy)
+
+    @staticmethod
+    def from_tf_data_dataset(dataset, batch_size: int = -1,
+                             batch_per_thread: int = -1,
+                             **kwargs) -> "TFDataset":
+        """reference: ``tf_dataset.py:601`` — materializes a (finite)
+        ``tf.data.Dataset`` of (features, labels) to numpy; the fit
+        fabric re-batches device-side."""
+        xs, ys = [], []
+        for item in dataset.as_numpy_iterator():
+            if isinstance(item, (tuple, list)) and len(item) == 2:
+                xs.append(np.asarray(item[0]))
+                ys.append(np.asarray(item[1]))
+            else:
+                xs.append(np.asarray(item))
+        if not xs:
+            raise ValueError("from_tf_data_dataset got an empty dataset")
+        x = np.stack(xs)
+        y = np.stack(ys) if ys else None
+        return TFDataset(x, y, batch_size, batch_per_thread)
+
+    @staticmethod
+    def from_dataframe(df, feature_cols: Sequence[str],
+                       labels_cols: Optional[Sequence[str]] = None,
+                       batch_size: int = -1, batch_per_thread: int = -1,
+                       **kwargs) -> "TFDataset":
+        """reference: ``tf_dataset.py:641`` — Spark DataFrame via the
+        staging-dir ingestion (``orca/data/spark.py``), pandas directly."""
+        import pandas as pd
+
+        from zoo_tpu.orca.data.spark import (
+            is_spark_dataframe,
+            spark_dataframe_to_shards,
+        )
+
+        labels_cols = list(labels_cols or [])
+        if is_spark_dataframe(df):
+            shards = spark_dataframe_to_shards(df, feature_cols,
+                                               labels_cols)
+            parts = shards.collect()
+            x = np.concatenate([p["x"] for p in parts])
+            y = np.concatenate([p["y"] for p in parts]) \
+                if labels_cols else None
+        elif isinstance(df, pd.DataFrame):
+            x = df[list(feature_cols)].to_numpy()
+            if x.shape[1] == 1:
+                x = x[:, 0]
+            y = df[labels_cols].to_numpy() if labels_cols else None
+            if y is not None and y.shape[1] == 1:
+                y = y[:, 0]
+        else:
+            raise TypeError(f"from_dataframe expects a Spark or pandas "
+                            f"DataFrame, got {type(df).__name__}")
+        return TFDataset(x, y, batch_size, batch_per_thread)
+
+    # -- TF1/RDD-mechanism constructors: migration errors ----------------
+    @staticmethod
+    def _migration(name: str, target: str):
+        raise TFParkMigrationError(
+            f"TFDataset.{name} fed TF1 placeholder graphs from RDDs — a "
+            f"mechanism the no-JVM architecture removed. Use {target} "
+            "(docs/migration.md, 'Spark DataFrame ingestion' / 'data "
+            "layer').")
+
+    @staticmethod
+    def from_rdd(*args, **kwargs):
+        TFDataset._migration(
+            "from_rdd",
+            "XShards (zoo.orca.data) or TFDataset.from_ndarrays")
+
+    @staticmethod
+    def from_string_rdd(*args, **kwargs):
+        TFDataset._migration("from_string_rdd",
+                             "orca.data pandas readers + TextSet")
+
+    @staticmethod
+    def from_bytes_rdd(*args, **kwargs):
+        TFDataset._migration("from_bytes_rdd", "orca.data readers")
+
+    @staticmethod
+    def from_image_set(*args, **kwargs):
+        TFDataset._migration(
+            "from_image_set",
+            "zoo.feature.image ImageSet + estimator fit on its arrays")
+
+    @staticmethod
+    def from_text_set(*args, **kwargs):
+        TFDataset._migration(
+            "from_text_set",
+            "zoo.feature.text TextSet + estimator fit on its arrays")
+
+    @staticmethod
+    def from_feature_set(*args, **kwargs):
+        TFDataset._migration(
+            "from_feature_set",
+            "orca.data FeatureSet tiers (orca/data/cache.py)")
+
+    @staticmethod
+    def from_tfrecord_file(*args, **kwargs):
+        TFDataset._migration(
+            "from_tfrecord_file",
+            "zoo.orca.data.tfrecord.read_tfrecords (CRC-checked native "
+            "reader)")
+
+
+class TFEstimator:
+    """``zoo.tfpark.TFEstimator`` — reference ``tfpark/estimator.py:30``:
+    TF1 ``model_fn`` Estimators on BigDL. TF1 graph-mode ``model_fn``
+    has no equivalent mechanism here; both entry points raise a
+    migration error naming the working replacements."""
+
+    _MSG = ("TFEstimator ran TF1 model_fn graphs on the JVM — that "
+            "mechanism does not exist in the TPU-native architecture. "
+            "Migrate to zoo.orca.learn.tf2.Estimator.from_keras "
+            "(a model_creator returning a compiled tf.keras model) or "
+            "zoo.tfpark.KerasModel; frozen TF1 inference graphs load "
+            "through zoo.pipeline.inference.InferenceModel / TFNet "
+            "(bridges/tf_graph.py). See docs/migration.md.")
+
+    def __init__(self, *args, **kwargs):
+        raise TFParkMigrationError(self._MSG)
+
+    @classmethod
+    def from_model_fn(cls, *args, **kwargs):
+        raise TFParkMigrationError(cls._MSG)

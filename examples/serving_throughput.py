@@ -1,0 +1,88 @@
+"""Cluster-serving throughput demo (reference role: the streaming
+throughput numbers of ``docs/ClusterServingGuide`` — N concurrent
+clients pushing records at the TCP door, the server micro-batching into
+the model, per-stage timers reporting where the time went).
+
+Run: python examples/serving_throughput.py \
+         [--clients 4] [--records 512] [--batch-size 32]
+"""
+
+import argparse
+import threading
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--records", type=int, default=512,
+                    help="records per client")
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--client-batch", type=int, default=32,
+                    help="rows per client request")
+    args = ap.parse_args()
+
+    from zoo_tpu.models.recommendation import NeuralCF
+    from zoo_tpu.orca import init_orca_context, stop_orca_context
+    from zoo_tpu.pipeline.inference.inference_model import InferenceModel
+    from zoo_tpu.serving.server import ServingServer
+    from zoo_tpu.serving.tcp_client import TCPInputQueue
+
+    init_orca_context(cluster_mode="local")
+    server = None
+    try:
+        m = NeuralCF(user_count=1000, item_count=2000, class_num=2,
+                     user_embed=16, item_embed=16, hidden_layers=(32, 16))
+        im = InferenceModel()
+        im.load_keras(m)
+        server = ServingServer(im, host="127.0.0.1", port=0,
+                               batch_size=args.batch_size).start()
+
+        rs = np.random.RandomState(0)
+        done = []
+
+        def client(cid):
+            iq = TCPInputQueue(host=server.host, port=server.port)
+            n = 0
+            while n < args.records:
+                k = min(args.client_batch, args.records - n)
+                x = np.stack([rs.randint(0, 1000, k),
+                              rs.randint(0, 2000, k)], 1).astype(np.int32)
+                preds = iq.predict(x)
+                assert preds.shape[0] == k
+                n += k
+            iq.close()
+            done.append(n)
+
+        # warm the compile outside the timed window
+        warm = TCPInputQueue(host=server.host, port=server.port)
+        warm.predict(np.zeros((args.client_batch, 2), np.int32))
+        warm.close()
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(args.clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        total = sum(done)
+        print(f"{args.clients} clients x {args.records} records: "
+              f"{total / dt:,.0f} records/s  ({dt * 1e3:.0f}ms total)")
+        for stage, timer in server.timers.items():
+            s = timer.stats()
+            print(f"  stage {stage:9s}: n={s['count']:5.0f} "
+                  f"avg={s['avg_ms']:.2f}ms max={s['max_ms']:.2f}ms")
+        assert total == args.clients * args.records
+        print("OK")
+    finally:
+        if server is not None:
+            server.stop()
+        stop_orca_context()
+
+
+if __name__ == "__main__":
+    main()

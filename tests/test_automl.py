@@ -96,3 +96,128 @@ def test_autots_estimator(orca_ctx, tmp_path):
     pipeline.save(str(tmp_path / "pipe"))
     again = TSPipeline.load(str(tmp_path / "pipe"))
     np.testing.assert_allclose(preds, again.predict(test), rtol=1e-5)
+
+
+def test_tpe_beats_random_equal_budget():
+    """Seeded toy objective (quadratic bowl + categorical penalty): at an
+    equal 40-trial budget, TPE's best must beat random's best on average
+    across seeds (the model-based-search acceptance bar)."""
+    from zoo_tpu.automl.search import LocalSearchEngine
+
+    space = {"x": hp.uniform(-5.0, 5.0),
+             "y": hp.loguniform(1e-3, 1e1),
+             "k": hp.choice(["a", "b", "c"])}
+
+    def objective(cfg):
+        pen = {"a": 0.0, "b": 1.0, "c": 2.0}[cfg["k"]]
+        return {"mse": (cfg["x"] - 1.7) ** 2
+                + (np.log10(cfg["y"]) - (-1.0)) ** 2 + pen}
+
+    tpe_wins, margins = 0, []
+    for seed in range(5):
+        rnd = LocalSearchEngine()
+        rnd.compile(objective, space, n_sampling=40, metric="mse",
+                    mode="min", seed=seed)
+        rnd.run()
+        best_rnd = rnd.get_best_trial().metric
+
+        tpe = LocalSearchEngine(search_alg="tpe")
+        tpe.compile(objective, space, n_sampling=40, metric="mse",
+                    mode="min", seed=seed)
+        tpe.run()
+        best_tpe = tpe.get_best_trial().metric
+        tpe_wins += best_tpe <= best_rnd
+        margins.append(best_rnd - best_tpe)
+    assert tpe_wins >= 4, (tpe_wins, margins)
+    assert np.mean(margins) > 0, margins
+
+
+def test_tpe_categorical_converges():
+    from zoo_tpu.automl.tpe import TPESampler
+
+    space = {"k": hp.choice([0, 1, 2, 3])}
+    tpe = TPESampler(space, mode="min", n_startup=8)
+    rng = np.random.RandomState(0)
+    history = []
+    for _ in range(40):
+        cfg = tpe.suggest(rng, history)
+        history.append((cfg, 0.0 if cfg["k"] == 2 else 1.0))
+    late = [c["k"] for c, _ in history[-10:]]
+    assert late.count(2) >= 6, late  # the model homes in on the optimum
+
+
+def test_asha_stops_underperformers():
+    """Trials report per-epoch; ASHA must cut clearly-bad trials at rung
+    boundaries so they run fewer epochs than the good ones."""
+    from zoo_tpu.automl.search import ASHAScheduler, LocalSearchEngine
+
+    epochs_run = {}
+
+    def trial(cfg, reporter=None):
+        # quality is the config value itself: lower = better from epoch 1
+        q = cfg["q"]
+        steps = 0
+        for e in range(9):
+            steps = e + 1
+            if reporter is not None and reporter(steps, q + 0.01 * e):
+                break
+        epochs_run[q] = steps
+        return {"mse": q}
+
+    eng = LocalSearchEngine(
+        scheduler=ASHAScheduler(max_t=9, grace_period=1,
+                                reduction_factor=3, mode="min"))
+    eng.compile(trial, {"q": hp.grid_search(list(range(9)))},
+                metric="mse", mode="min", seed=0)
+    eng.run()
+    assert eng.get_best_trial().config["q"] == 0
+    good = epochs_run[0]
+    worst = max(epochs_run[q] for q in (6, 7, 8))
+    assert good == 9, epochs_run
+    assert worst < 9, epochs_run  # the bad tail was cut early
+
+
+def test_autots_accepts_search_alg_and_scheduler(orca_ctx):
+    from zoo_tpu.chronos.autots import AutoTSEstimator, TSPipeline
+    from zoo_tpu.chronos.data import TSDataset
+
+    t = pd.date_range("2024-01-01", periods=200, freq="h")
+    v = np.sin(np.arange(200) * 2 * np.pi / 24)
+    df = pd.DataFrame({"ts": t, "value": v})
+    train, _, test = TSDataset.from_pandas(
+        df, dt_col="ts", target_col="value", with_split=True,
+        test_ratio=0.2)
+    auto = AutoTSEstimator(model="lstm",
+                           search_space={"hidden_dim": hp.choice([8]),
+                                         "lr": hp.loguniform(1e-3, 1e-2)},
+                           past_seq_len=hp.randint(8, 16),
+                           future_seq_len=1, metric="mse")
+    pipeline = auto.fit(train, validation_data=test, epochs=2,
+                        batch_size=32, n_sampling=3, search_alg="tpe",
+                        scheduler="asha")
+    assert isinstance(pipeline, TSPipeline)
+    assert np.isfinite(pipeline.evaluate(test, metrics=["mse"])["mse"])
+
+
+def test_auto_estimator_accepts_tpe(orca_ctx):
+    from zoo_tpu.pipeline.api.keras import Sequential
+    from zoo_tpu.pipeline.api.keras.layers import Dense
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(64, 4).astype(np.float32)
+    y = (x @ rs.randn(4, 1)).astype(np.float32)
+
+    def creator(cfg):
+        m = Sequential()
+        m.add(Dense(int(cfg["hidden"]), input_shape=(4,),
+                    activation="relu"))
+        m.add(Dense(1))
+        m.compile(optimizer="adam", loss="mse")
+        return m
+
+    est = AutoEstimator.from_keras(model_creator=creator)
+    est.fit((x, y), epochs=2, batch_size=16, metric="mse",
+            search_space={"hidden": hp.choice([4, 8])}, n_sampling=3,
+            search_alg="tpe", scheduler="asha")
+    assert np.isfinite(est.best_metric)
+    assert est.get_best_config()["hidden"] in (4, 8)

@@ -97,7 +97,8 @@ _EXAMPLES = ["ncf_movielens.py", "dogs_vs_cats_resnet.py",
              "multihost_training.py", "image_similarity.py",
              "llama_pretrain.py", "qa_ranking_knrm.py",
              "nnframes_pipeline.py", "fraud_detection.py",
-             "tfnet_image_inference.py"]
+             "tfnet_image_inference.py", "object_detection_ssd.py",
+             "quantized_inference.py", "serving_throughput.py"]
 
 
 @pytest.mark.parametrize("script", _EXAMPLES)
@@ -121,6 +122,10 @@ def test_example_runs(script):
         args += ["--samples", "4"]
     if script == "fraud_detection.py":
         args += ["--rows", "8000", "--epochs", "3"]
+    if script == "object_detection_ssd.py":
+        args += ["--out", "/tmp/zoo_detections.png"]
+    if script == "serving_throughput.py":
+        args += ["--clients", "2", "--records", "128"]
     proc = subprocess.run(args, capture_output=True, text=True, timeout=900,
                           env=env)
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]

@@ -100,29 +100,119 @@ class TrialStopper:
         return False
 
 
+class ASHAScheduler:
+    """Asynchronous Successive Halving (stopping form).
+
+    The trial-scheduler role of the reference's ray.tune ``scheduler=``
+    knob (``ray_tune_search_engine.py:151``): trials report per-epoch
+    metrics; at each rung (``grace_period * reduction_factor**k``) a
+    trial continues only if its metric is in the top ``1/reduction_
+    factor`` quantile of results recorded at that rung so far. Thread-
+    safe — the local engine runs trials concurrently."""
+
+    def __init__(self, max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: int = 3, mode: str = "min"):
+        import threading
+
+        self.mode = mode
+        self.rf = int(reduction_factor)
+        self.rungs: List[int] = []
+        r = int(grace_period)
+        while r <= int(max_t):
+            self.rungs.append(r)
+            r *= self.rf
+        self._recorded: Dict[int, Dict[int, float]] = \
+            {r: {} for r in self.rungs}
+        self._lock = threading.Lock()
+
+    def on_result(self, trial_id: int, step: int, metric: float) -> bool:
+        """True = stop this trial now."""
+        stop = False
+        with self._lock:
+            for rung in self.rungs:
+                if step < rung or trial_id in self._recorded[rung]:
+                    continue
+                self._recorded[rung][trial_id] = metric
+                vals = list(self._recorded[rung].values())
+                if len(vals) < self.rf:
+                    continue  # too few results to cut anyone
+                q = (np.quantile(vals, 1.0 / self.rf)
+                     if self.mode == "min"
+                     else np.quantile(vals, 1.0 - 1.0 / self.rf))
+                survives = metric <= q if self.mode == "min" \
+                    else metric >= q
+                if not survives:
+                    stop = True
+        return stop
+
+
+def _make_search_alg(search_alg, search_space, mode):
+    if search_alg in (None, "random", "grid"):
+        return None
+    if search_alg == "tpe":
+        from zoo_tpu.automl.tpe import TPESampler
+
+        return TPESampler(search_space, mode=mode)
+    if hasattr(search_alg, "suggest"):
+        return search_alg
+    raise ValueError(
+        f"unknown search_alg {search_alg!r}: use None/'random', 'tpe', "
+        "or an object with suggest(rng, history)")
+
+
+def _make_scheduler(scheduler, mode):
+    if scheduler is None:
+        return None
+    if scheduler == "asha":
+        return ASHAScheduler(mode=mode)
+    if hasattr(scheduler, "on_result"):
+        return scheduler
+    raise ValueError(f"unknown scheduler {scheduler!r}: use None, "
+                     "'asha', or an object with on_result(id, step, m)")
+
+
 class LocalSearchEngine(SearchEngine):
     """In-process trials over a thread pool (reference value proposition:
     concurrent Ray Tune trials, ``ray_tune_search_engine.py:29``; XLA
     dispatch releases the GIL so ``n_parallel`` trials genuinely overlap
-    on the host while sharing the device)."""
+    on the host while sharing the device).
+
+    ``search_alg``: None/'random' (grid-cross + random draws), 'tpe'
+    (model-based, ``automl/tpe.py``), or any object with
+    ``suggest(rng, history)``. ``scheduler``: None, 'asha', or any
+    object with ``on_result(trial_id, step, metric) -> bool`` — consulted
+    through the trial's ``reporter`` callback, so trials whose
+    ``trial_fn`` accepts ``reporter`` get early-stopped at rungs."""
 
     def __init__(self, n_parallel: int = 1,
-                 stopper: Optional[TrialStopper] = None):
+                 stopper: Optional[TrialStopper] = None,
+                 search_alg=None, scheduler=None):
         self._trials: List[Trial] = []
         self._mode = "min"
         self._metric = "mse"
         self.n_parallel = max(1, int(n_parallel))
         self.stopper = stopper
+        self.search_alg = search_alg
+        self.scheduler = scheduler
 
     def compile(self, trial_fn, search_space, n_sampling=1, metric="mse",
-                mode="min", seed=0):
-        rng = np.random.RandomState(seed)
+                mode="min", seed=0, search_alg=None, scheduler=None):
+        self._rng = np.random.RandomState(seed)
         self._metric, self._mode = metric, mode
         self._trial_fn = trial_fn
-        self._configs = _expand_configs(search_space, n_sampling, rng)
+        self._alg = _make_search_alg(search_alg or self.search_alg,
+                                     search_space, mode)
+        self._sched = _make_scheduler(scheduler or self.scheduler, mode)
+        if self._alg is None:
+            self._configs = _expand_configs(search_space, n_sampling,
+                                            self._rng)
+        else:
+            # model-based: ask/tell loop; budget = n_sampling trials
+            self._configs = None
+            self._n_trials = max(1, int(n_sampling))
         return self
 
-    def _run_one(self, i: int, cfg: Dict) -> Trial:
+    def _run_one(self, i: int, cfg: Dict, total: int) -> Trial:
         import inspect
 
         kwargs = {}
@@ -131,30 +221,49 @@ class LocalSearchEngine(SearchEngine):
             sig = inspect.signature(self._trial_fn)
         except (TypeError, ValueError):
             pass
-        if sig is not None and "reporter" in sig.parameters:
-            stopper = self.stopper
+        # only inject a reporter when something actually consumes the
+        # per-epoch reports — trial_fns switch to epoch-at-a-time
+        # training when given one, which costs an evaluate() per epoch
+        if sig is not None and "reporter" in sig.parameters \
+                and (self.stopper is not None or self._sched is not None):
+            stopper, sched = self.stopper, self._sched
 
             def reporter(step: int, metric: float) -> bool:
                 """Trial calls this per epoch; True means stop early."""
-                return stopper(step, metric) if stopper is not None \
+                stop = stopper(step, metric) if stopper is not None \
                     else False
+                if sched is not None:
+                    stop = sched.on_result(i, step, metric) or stop
+                return stop
 
             kwargs["reporter"] = reporter
         result = self._trial_fn(dict(cfg), **kwargs)
         metric = float(result[self._metric])
-        logger.info("trial %d/%d %s=%.5f cfg=%s", i + 1,
-                    len(self._configs), self._metric, metric, cfg)
+        logger.info("trial %d/%d %s=%.5f cfg=%s", i + 1, total,
+                    self._metric, metric, cfg)
         return Trial(i, cfg, metric, artifacts=result)
 
     def run(self) -> List[Trial]:
+        if self._alg is not None:
+            # sequential ask/tell: each suggestion conditions on every
+            # completed trial (the model-based point)
+            history: List = []
+            self._trials = []
+            for i in range(self._n_trials):
+                cfg = self._alg.suggest(self._rng, history)
+                t = self._run_one(i, cfg, self._n_trials)
+                history.append((dict(cfg), t.metric))
+                self._trials.append(t)
+            return self._trials
         if self.n_parallel == 1:
-            self._trials = [self._run_one(i, cfg)
+            self._trials = [self._run_one(i, cfg, len(self._configs))
                             for i, cfg in enumerate(self._configs)]
             return self._trials
         from concurrent.futures import ThreadPoolExecutor
 
         with ThreadPoolExecutor(max_workers=self.n_parallel) as pool:
-            futures = [pool.submit(self._run_one, i, cfg)
+            futures = [pool.submit(self._run_one, i, cfg,
+                                   len(self._configs))
                        for i, cfg in enumerate(self._configs)]
             self._trials = [f.result() for f in futures]
         return self._trials
@@ -226,8 +335,12 @@ class RayTuneSearchEngine(SearchEngine):  # pragma: no cover - needs ray
                      artifacts=result)
 
 
-def make_search_engine() -> SearchEngine:
-    try:
-        return RayTuneSearchEngine()
-    except Exception:
-        return LocalSearchEngine()
+def make_search_engine(search_alg=None, scheduler=None) -> SearchEngine:
+    if search_alg is None and scheduler is None:
+        try:
+            return RayTuneSearchEngine()
+        except Exception:
+            return LocalSearchEngine()
+    # model-based search / ASHA are local-engine features; the ray engine
+    # would accept tune-native searchers instead
+    return LocalSearchEngine(search_alg=search_alg, scheduler=scheduler)

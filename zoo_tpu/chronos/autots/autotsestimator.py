@@ -74,9 +74,14 @@ class AutoTSEstimator:
 
     def fit(self, data: TSDataset, validation_data: Optional[TSDataset] = None,
             epochs: int = 2, batch_size: int = 32, n_sampling: int = 1,
-            seed: int = 0) -> "TSPipeline":
+            seed: int = 0, search_alg=None,
+            scheduler=None) -> "TSPipeline":
         """Search and return the best TSPipeline (reference:
-        ``AutoTSEstimator.fit`` returning a TSPipeline)."""
+        ``AutoTSEstimator.fit`` returning a TSPipeline; ``search_alg``/
+        ``scheduler`` mirror the ray.tune knobs of
+        ``ray_tune_search_engine.py:29,151`` — ``search_alg="tpe"`` for
+        model-based sampling, ``scheduler="asha"`` for successive-halving
+        early stopping of per-epoch-reporting trials)."""
         if not isinstance(data, TSDataset):
             raise ValueError("AutoTSEstimator.fit expects a TSDataset")
         n_features = data.get_feature_num()
@@ -86,7 +91,7 @@ class AutoTSEstimator:
         space = dict(self.search_space)
         space["past_seq_len"] = self.past_seq_len
 
-        def trial_fn(config: Dict) -> Dict:
+        def trial_fn(config: Dict, reporter=None) -> Dict:
             lookback = int(config.pop("past_seq_len"))
             data.roll(lookback, horizon)
             val = validation_data
@@ -94,14 +99,29 @@ class AutoTSEstimator:
                 val.roll(lookback, horizon)
             f = _build_forecaster(self.model, lookback, horizon,
                                   n_features, n_targets, config)
-            f.fit(data, epochs=epochs, batch_size=batch_size,
-                  validation_data=val)
-            res = f.evaluate(val if val is not None else data,
-                             metrics=[self.metric])
+            eval_ds = val if val is not None else data
+            if reporter is None:
+                f.fit(data, epochs=epochs, batch_size=batch_size,
+                      validation_data=val)
+                res = f.evaluate(eval_ds, metrics=[self.metric])
+            else:
+                # per-epoch reporting: the ASHA scheduler cuts trials at
+                # rung boundaries through this callback
+                res = {self.metric: float("inf")}
+                for e in range(epochs):
+                    # per-epoch seed: each nb_epoch=1 call re-creates the
+                    # shuffle RNG; a constant seed would repeat the same
+                    # permutation every epoch
+                    f.fit(data, epochs=1, batch_size=batch_size,
+                          validation_data=val, seed=seed + e)
+                    res = f.evaluate(eval_ds, metrics=[self.metric])
+                    if reporter(e + 1, float(res[self.metric])):
+                        break
             return {self.metric: res[self.metric], "forecaster": f,
                     "lookback": lookback}
 
-        engine = make_search_engine()
+        engine = make_search_engine(search_alg=search_alg,
+                                    scheduler=scheduler)
         engine.compile(trial_fn, space, n_sampling=n_sampling,
                        metric=self.metric, mode="min", seed=seed)
         engine.run()

@@ -82,7 +82,7 @@ class Forecaster:
 
     # -- API --------------------------------------------------------------
     def fit(self, data, epochs: int = 1, batch_size: int = 32,
-            validation_data=None) -> Dict:
+            validation_data=None, seed: int = 0) -> Dict:
         x, y = self._unpack(data)
         if y is None:
             raise ValueError("fit requires rolled targets")
@@ -95,7 +95,7 @@ class Forecaster:
             val = (vx, vy.reshape(vy.shape[0], -1))
         hist = self.model.fit(x, y, batch_size=min(batch_size, len(x)),
                               nb_epoch=epochs, validation_data=val,
-                              verbose=0)
+                              verbose=0, seed=seed)
         self.fitted = True
         return hist
 

@@ -3,12 +3,25 @@
 Rebuild of the reference's TCMF/DeepGLO (``chronos/model/tcmf/DeepGLO.py:1``
 904 LoC): a high-dimensional series panel Y (m series × t steps) factors
 into per-series embeddings F (m × k) and temporal factors X (k × t); the
-temporal factors carry an autoregressive model that forecasts them
-forward, and Y_future = F · X_future. The reference alternates torch
-training of F/X/TCN across Ray workers; here the alternating ridge
-updates are closed-form (jitted matmuls — TPU-friendly m×k×t GEMMs) and
-the temporal model is a per-factor AR(lag) fit by least squares. ``ynew``
-incremental support matches ``fit_incremental``.
+temporal factors carry a temporal model that forecasts them forward, and
+Y_future = F · X_future. The reference alternates torch training of F/X
+and a TCN across Ray workers; here the alternating ridge updates are
+closed-form (jitted matmuls — TPU-friendly m×k×t GEMMs) and the temporal
+model is selectable:
+
+* ``temporal_model="ar"`` — per-factor AR(lag) by least squares (fast,
+  linear);
+* ``temporal_model="tcn"`` — DeepGLO's actual temporal network: the
+  multivariate dilated-causal TCN (``tcn_forecaster.py``) trained on
+  windows of X, all k factors as channels, so it captures the nonlinear
+  cross-factor dynamics a linear AR forfeits (an AR(lag) is always fit
+  too, as the fallback/compat path).
+
+``ynew`` incremental support matches ``fit_incremental``. Distributed
+panels: the F/X ridge alternations are plain GEMMs — under a mesh they
+shard over the series axis m like any data-parallel matmul (the role the
+reference distributes across Ray workers); the temporal model trains on
+the k×t factor matrix, which is small and replicated.
 """
 
 from __future__ import annotations
@@ -24,16 +37,28 @@ class TCMFForecaster:
                  rank: int = 16, kernel_size_Y: int = 7, lr: float = 0.0005,
                  normalize: bool = False, use_time: bool = False,
                  svd: bool = True, ar_lag: int = 8, alt_iters: int = 10,
-                 reg: float = 1e-2):
+                 reg: float = 1e-2, temporal_model: str = "ar",
+                 tcn_epochs: int = 40):
+        if temporal_model not in ("ar", "tcn"):
+            raise ValueError(
+                f"temporal_model must be 'ar' or 'tcn', got "
+                f"{temporal_model!r}")
         self.rank = int(rank)
         self.ar_lag = int(ar_lag)
         self.alt_iters = int(alt_iters)
         self.reg = float(reg)
         self.svd = svd
         self.normalize = normalize
+        self.temporal_model = temporal_model
+        self.num_channels_X = list(num_channels_X or [32, 32])
+        self.kernel_size = int(kernel_size)
+        self.dropout = float(dropout)
+        self.lr = float(lr)
+        self.tcn_epochs = int(tcn_epochs)
         self.F: Optional[np.ndarray] = None   # (m, k)
         self.X: Optional[np.ndarray] = None   # (k, t)
         self.ar: Optional[np.ndarray] = None  # (k, lag+1)
+        self._tcn = None                      # TCNForecaster over X
         self._mean = self._std = None
 
     def fit(self, x, val_len: int = 0, **kwargs) -> Dict[str, float]:
@@ -65,9 +90,46 @@ class TCMFForecaster:
             F = np.asarray(jnp.linalg.solve(Xj @ Xj.T + eye,
                                             Xj @ Yj.T)).T
         self.F, self.X = np.asarray(F), np.asarray(X)
-        self._fit_ar()
+        self._fit_temporal()
         recon = self.F @ self.X
         return {"mse": float(np.mean((recon - Y) ** 2))}
+
+    def _fit_temporal(self):
+        self._fit_ar()  # always: fallback + the save/compat path
+        if self.temporal_model == "tcn":
+            self._fit_tcn()
+
+    def _x_windows(self):
+        k, t = self.X.shape
+        lag = self.ar_lag
+        Xs = self.X.T  # (t, k)
+        wins = np.stack([Xs[j:j + lag] for j in range(t - lag)])
+        tgts = Xs[lag:][:, None, :]  # (n, horizon=1, k)
+        return wins.astype(np.float32), tgts.astype(np.float32)
+
+    def _fit_tcn(self):
+        """DeepGLO's temporal network: one multivariate TCN over the k
+        factor series (factors as channels → cross-factor nonlinear
+        dynamics; reference trains TCN X alternately,
+        ``DeepGLO.py`` train_Xseq)."""
+        from zoo_tpu.chronos.forecaster.tcn_forecaster import TCNForecaster
+
+        k, t = self.X.shape
+        lag = self.ar_lag  # already clamped to t-1 by _fit_ar
+        if t - lag < 8:
+            raise ValueError(
+                f"temporal_model='tcn' needs at least ar_lag + 8 "
+                f"timesteps to form training windows; got t={t} with "
+                f"lag={lag} — use temporal_model='ar' for panels this "
+                "short")
+        wins, tgts = self._x_windows()
+        self._tcn = TCNForecaster(
+            past_seq_len=lag, future_seq_len=1, input_feature_num=k,
+            output_feature_num=k, num_channels=self.num_channels_X,
+            kernel_size=min(self.kernel_size, lag), dropout=self.dropout,
+            lr=self.lr)
+        self._tcn.fit((wins, tgts), epochs=self.tcn_epochs,
+                      batch_size=min(128, len(wins)))
 
     def _fit_ar(self):
         k, t = self.X.shape
@@ -99,21 +161,32 @@ class TCMFForecaster:
         Xnew = np.asarray(jnp.linalg.solve(Fj.T @ Fj + eye,
                                            Fj.T @ jnp.asarray(Ynew)))
         self.X = np.concatenate([self.X, Xnew], axis=1)
-        self._fit_ar()
+        self._fit_temporal()
         return self
 
-    def predict(self, horizon: int = 24, **kwargs) -> np.ndarray:
-        if self.F is None:
-            raise RuntimeError("call fit() first")
-        k, t = self.X.shape
+    def _roll_factors(self, horizon: int) -> np.ndarray:
+        """Forecast the factor matrix forward: (k, horizon)."""
         lag = self.ar_lag
+        if self._tcn is not None:
+            hist = self.X[:, -lag:].T.astype(np.float32)  # (lag, k)
+            steps = []
+            for _ in range(horizon):
+                nxt = self._tcn.predict((hist[None], None))[0, 0]  # (k,)
+                steps.append(nxt)
+                hist = np.concatenate([hist[1:], nxt[None]], axis=0)
+            return np.stack(steps, axis=1)
         hist = self.X[:, -lag:].copy()
         steps = []
         for _ in range(horizon):
             nxt = (hist * self.ar[:, :lag]).sum(axis=1) + self.ar[:, lag]
             steps.append(nxt)
             hist = np.concatenate([hist[:, 1:], nxt[:, None]], axis=1)
-        Xf = np.stack(steps, axis=1)            # (k, horizon)
+        return np.stack(steps, axis=1)
+
+    def predict(self, horizon: int = 24, **kwargs) -> np.ndarray:
+        if self.F is None:
+            raise RuntimeError("call fit() first")
+        Xf = self._roll_factors(horizon)        # (k, horizon)
         Yf = self.F @ Xf
         if self.normalize:
             Yf = Yf * self._std + self._mean
@@ -136,16 +209,50 @@ class TCMFForecaster:
                  normalize=np.asarray(self.normalize),
                  reg=np.asarray(self.reg),
                  alt_iters=np.asarray(self.alt_iters),
-                 svd=np.asarray(self.svd), **extras)
+                 svd=np.asarray(self.svd),
+                 temporal_model=np.asarray(self.temporal_model),
+                 num_channels_X=np.asarray(self.num_channels_X),
+                 kernel_size=np.asarray(self.kernel_size),
+                 dropout=np.asarray(self.dropout),
+                 lr=np.asarray(self.lr),
+                 tcn_epochs=np.asarray(self.tcn_epochs), **extras)
+        if self._tcn is not None:
+            base = path[:-4] if path.endswith(".npz") else path
+            self._tcn.save(base + ".tcn.pkl")
 
     @classmethod
     def load(cls, path: str) -> "TCMFForecaster":
         blob = np.load(path if path.endswith(".npz") else path + ".npz")
+        tm = str(blob["temporal_model"]) if "temporal_model" in blob \
+            else "ar"
         out = cls(rank=blob["F"].shape[1], ar_lag=int(blob["lag"]),
                   normalize=bool(blob["normalize"]),
                   reg=float(blob["reg"]), alt_iters=int(blob["alt_iters"]),
-                  svd=bool(blob["svd"]))
+                  svd=bool(blob["svd"]), temporal_model=tm,
+                  num_channels_X=(list(blob["num_channels_X"])
+                                  if "num_channels_X" in blob else None),
+                  kernel_size=(int(blob["kernel_size"])
+                               if "kernel_size" in blob else 7),
+                  dropout=(float(blob["dropout"])
+                           if "dropout" in blob else 0.1),
+                  lr=float(blob["lr"]) if "lr" in blob else 5e-4,
+                  tcn_epochs=(int(blob["tcn_epochs"])
+                              if "tcn_epochs" in blob else 40))
         out.F, out.X, out.ar = blob["F"], blob["X"], blob["ar"]
         if out.normalize:
             out._mean, out._std = blob["mean"], blob["std"]
+        if tm == "tcn":
+            from zoo_tpu.chronos.forecaster.tcn_forecaster import (
+                TCNForecaster,
+            )
+
+            k = out.F.shape[1]
+            out._tcn = TCNForecaster(
+                past_seq_len=out.ar_lag, future_seq_len=1,
+                input_feature_num=k, output_feature_num=k,
+                num_channels=out.num_channels_X,
+                kernel_size=min(out.kernel_size, out.ar_lag),
+                dropout=out.dropout, lr=out.lr)
+            base = path[:-4] if path.endswith(".npz") else path
+            out._tcn.load(base + ".tcn.pkl")
         return out

@@ -15,13 +15,15 @@ from zoo_tpu.models.image.objectdetection import (  # noqa: F401
     SSD,
     ObjectDetector,
     decode_boxes,
+    encode_targets,
     generate_anchors,
+    multibox_loss,
     nms,
 )
 from zoo_tpu.models.image.resnet import ResNet, resnet18, resnet50  # noqa: F401,E501
 
 __all__ = ["ResNet", "resnet18", "resnet50", "SSD", "ObjectDetector",
-           "generate_anchors", "decode_boxes", "nms",
+           "generate_anchors", "decode_boxes", "nms", "encode_targets", "multibox_loss",
            "ImageClassifier", "LabelOutput", "create_image_classifier",
            "image_classification_preprocess", "inception_v1", "vgg16",
            "vgg19", "mobilenet_v1", "mobilenet_v2", "squeezenet",

@@ -85,6 +85,80 @@ def nms(boxes: jnp.ndarray, scores: jnp.ndarray, top_k: int = 100,
     return top_boxes, jnp.where(keep, top_scores, 0.0), idx
 
 
+def encode_targets(anchors: np.ndarray, gt_boxes: np.ndarray,
+                   gt_labels: np.ndarray, iou_threshold: float = 0.5,
+                   variance: Tuple[float, float] = (0.1, 0.2)
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+    """SSD target assignment for ONE image (host-side, numpy).
+
+    ``gt_boxes``: (G, 4) [x1,y1,x2,y2] normalized; ``gt_labels``: (G,)
+    ints >= 1 (0 is background). Returns (cls_t (A,), box_t (A, 4)):
+    each anchor matched to its best-IoU ground truth when IoU >=
+    threshold (plus the best anchor per gt, the reference's bipartite
+    step), others background. Box targets are the inverse of
+    ``decode_boxes``'s delta transform."""
+    A = anchors.shape[0]
+    cls_t = np.zeros((A,), np.int32)
+    box_t = np.zeros((A, 4), np.float32)
+    if len(gt_boxes) == 0:
+        return cls_t, box_t
+    ax1y1 = anchors[:, :2] - anchors[:, 2:] / 2
+    ax2y2 = anchors[:, :2] + anchors[:, 2:] / 2
+    lt = np.maximum(ax1y1[:, None], gt_boxes[None, :, :2])
+    rb = np.minimum(ax2y2[:, None], gt_boxes[None, :, 2:])
+    inter = np.prod(np.clip(rb - lt, 0, None), axis=-1)
+    area_a = np.prod(anchors[:, 2:], axis=-1)
+    area_g = np.prod(gt_boxes[:, 2:] - gt_boxes[:, :2], axis=-1)
+    iou = inter / (area_a[:, None] + area_g[None] - inter + 1e-9)
+    best_gt = iou.argmax(axis=1)
+    best_iou = iou.max(axis=1)
+    pos = best_iou >= iou_threshold
+    # bipartite step: every gt claims its single best UNCLAIMED anchor,
+    # even when that IoU is under the threshold — claiming without the
+    # exclusion would let a later gt steal an earlier one's only anchor
+    # and leave that object unmatched entirely
+    claimed = set()
+    for g in range(len(gt_boxes)):
+        for a in np.argsort(-iou[:, g]):
+            a = int(a)
+            if a not in claimed:
+                claimed.add(a)
+                best_gt[a] = g
+                pos[a] = True
+                break
+    matched = gt_boxes[best_gt]
+    cxcy_g = (matched[:, :2] + matched[:, 2:]) / 2
+    wh_g = matched[:, 2:] - matched[:, :2]
+    d_xy = (cxcy_g - anchors[:, :2]) / (anchors[:, 2:] * variance[0])
+    d_wh = np.log(np.clip(wh_g / anchors[:, 2:], 1e-6, None)) / variance[1]
+    box_t[pos] = np.concatenate([d_xy, d_wh], axis=-1)[pos]
+    cls_t[pos] = gt_labels[best_gt[pos]]
+    return cls_t, box_t
+
+
+def multibox_loss(cls_logits, box_deltas, cls_t, box_t,
+                  neg_pos_ratio: int = 3):
+    """SSD multibox loss (one batch, jittable): softmax CE over matched
+    anchors + hard-negative-mined background anchors (``neg_pos_ratio``
+    negatives per positive, picked by loss rank — the reference's
+    MultiBox mining) and smooth-L1 on positive box deltas."""
+    logp = jax.nn.log_softmax(cls_logits.astype(jnp.float32), axis=-1)
+    ce = -jnp.take_along_axis(logp, cls_t[..., None], axis=-1)[..., 0]
+    pos = cls_t > 0                               # (B, A)
+    n_pos = jnp.maximum(pos.sum(axis=1), 1)
+    # hard negative mining: rank background anchors by their CE
+    neg_ce = jnp.where(pos, -jnp.inf, ce)
+    rank = jnp.argsort(jnp.argsort(-neg_ce, axis=1), axis=1)
+    n_neg = jnp.minimum(neg_pos_ratio * n_pos,
+                        pos.shape[1] - n_pos)
+    neg = rank < n_neg[:, None]
+    cls_loss = jnp.where(pos | neg, ce, 0.0).sum(axis=1) / n_pos
+    diff = jnp.abs(box_deltas.astype(jnp.float32) - box_t)
+    sl1 = jnp.where(diff < 1.0, 0.5 * diff ** 2, diff - 0.5).sum(-1)
+    box_loss = jnp.where(pos, sl1, 0.0).sum(axis=1) / n_pos
+    return (cls_loss + box_loss).mean()
+
+
 class _MultiBoxHead(Layer):
     """Shared conv head on one feature map: per-anchor class scores and
     box deltas."""
@@ -188,6 +262,68 @@ class SSD(KerasNet):
             cls_all.append(cls)
             box_all.append(box)
         return jnp.concatenate(cls_all, 1), jnp.concatenate(box_all, 1)
+
+    # -- training ---------------------------------------------------------
+    def fit_detection(self, images: np.ndarray, boxes_list: List,
+                      labels_list: List, epochs: int = 10,
+                      batch_size: int = 16, lr: float = 1e-3,
+                      iou_threshold: float = 0.5, seed: int = 0,
+                      verbose: int = 0) -> List[float]:
+        """Train the detector end-to-end with the SSD multibox loss.
+
+        ``boxes_list[i]``: (G_i, 4) normalized [x1,y1,x2,y2] ground-truth
+        boxes for image i; ``labels_list[i]``: (G_i,) int labels >= 1.
+        Target assignment runs host-side once (``encode_targets``); the
+        jitted step is pure fixed-shape tensor math. Returns per-epoch
+        mean losses. (reference role: the SSD fine-tuning loop of
+        ``apps/object-detection`` / Scala SSD examples.)"""
+        import optax
+
+        self.build()
+        n = len(images)
+        # a batch larger than the dataset would make the step range empty
+        # and silently train nothing
+        batch_size = min(batch_size, n)
+        cls_t = np.zeros((n, self.anchors.shape[0]), np.int32)
+        box_t = np.zeros((n, self.anchors.shape[0], 4), np.float32)
+        for i in range(n):
+            cls_t[i], box_t[i] = encode_targets(
+                self.anchors, np.asarray(boxes_list[i], np.float32),
+                np.asarray(labels_list[i], np.int32),
+                iou_threshold=iou_threshold)
+        tx = optax.adam(lr)
+        params = self._place(self.params)
+        opt_state = tx.init(params)
+
+        @jax.jit
+        def step(params, opt_state, imgs, ct, bt):
+            def loss_fn(p):
+                cls, box = self._forward(p, [imgs], training=True,
+                                         rng=None, collect=None)
+                return multibox_loss(cls, box, ct, bt)
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        imgs_all = np.asarray(images, np.float32)
+        rs = np.random.RandomState(seed)
+        history = []
+        for epoch in range(epochs):
+            order = rs.permutation(n)
+            losses = []
+            for s in range(0, n - batch_size + 1, batch_size):
+                idx = order[s:s + batch_size]
+                params, opt_state, loss = step(
+                    params, opt_state, jnp.asarray(imgs_all[idx]),
+                    jnp.asarray(cls_t[idx]), jnp.asarray(box_t[idx]))
+                losses.append(float(np.asarray(loss)))
+            history.append(float(np.mean(losses)))
+            if verbose:
+                print(f"epoch {epoch + 1}/{epochs} "
+                      f"multibox_loss={history[-1]:.4f}")
+        self.params = jax.tree_util.tree_map(np.asarray, params)
+        self._jit_detect = None  # weights changed; detection must retrace
+        return history
 
     # -- detection --------------------------------------------------------
     def predict_detections(self, images: np.ndarray,

@@ -66,9 +66,13 @@ class AutoEstimator:
             validation_data=None, metric: str = "mse",
             metric_mode: Optional[str] = None,
             search_space: Optional[Dict] = None, n_sampling: int = 1,
-            seed: int = 0) -> "AutoEstimator":
+            seed: int = 0, search_alg=None,
+            scheduler=None) -> "AutoEstimator":
         """Run the search (reference: ``AutoEstimator.fit`` with
-        ``search_space``/``n_sampling``/``metric``)."""
+        ``search_space``/``n_sampling``/``metric``; ``search_alg``/
+        ``scheduler`` mirror ray.tune's knobs,
+        ``ray_tune_search_engine.py:29,151`` — ``"tpe"`` for model-based
+        sampling, ``"asha"`` for successive-halving early stopping)."""
         if search_space is None:
             raise ValueError("search_space is required")
         mode = metric_mode or ("min" if metric.lower() in _MINIMIZE
@@ -78,17 +82,49 @@ class AutoEstimator:
         def _xy(d):
             return d if isinstance(d, tuple) else (d, None)
 
-        def trial_fn(config: Dict) -> Dict:
+        def trial_fn(config: Dict, reporter=None) -> Dict:
             bs = int(config.pop("batch_size", batch_size))
             model = self.model_builder(config)
             if hasattr(model, "torch_model"):  # PyTorchEstimator
-                model.fit(data, epochs=epochs, batch_size=bs)
-                res = model.evaluate(eval_data, batch_size=bs)
+                if reporter is None:
+                    model.fit(data, epochs=epochs, batch_size=bs)
+                    res = model.evaluate(eval_data, batch_size=bs)
+                else:  # per-epoch reporting for the ASHA scheduler
+                    res = {}
+                    for e in range(epochs):
+                        model.fit(data, epochs=1, batch_size=bs)
+                        res = model.evaluate(eval_data, batch_size=bs)
+                        val = res.get(metric, res.get("loss"))
+                        if val is None:
+                            raise ValueError(
+                                f"metric {metric!r} not produced by "
+                                f"evaluate(); available: {sorted(res)}")
+                        if reporter(e + 1, float(val)):
+                            break
             else:  # compiled keras-facade model
                 x, y = _xy(data)
-                model.fit(x, y, batch_size=bs, nb_epoch=epochs, verbose=0)
                 ex, ey = _xy(eval_data)
-                res = model.evaluate(ex, ey, batch_size=bs)
+                if reporter is None:
+                    model.fit(x, y, batch_size=bs, nb_epoch=epochs,
+                              verbose=0)
+                    res = model.evaluate(ex, ey, batch_size=bs)
+                else:
+                    res = {}
+                    for e in range(epochs):
+                        # seed varies per epoch: each nb_epoch=1 call
+                        # re-creates the shuffle/dropout RNGs, and a
+                        # constant seed would repeat the identical
+                        # permutation and masks every epoch
+                        model.fit(x, y, batch_size=bs, nb_epoch=1,
+                                  verbose=0, seed=seed + e)
+                        res = model.evaluate(ex, ey, batch_size=bs)
+                        val = res.get(metric, res.get("loss"))
+                        if val is None:
+                            raise ValueError(
+                                f"metric {metric!r} not produced by "
+                                f"evaluate(); available: {sorted(res)}")
+                        if reporter(e + 1, float(val)):
+                            break
             if metric not in res:
                 # res["loss"] may stand in for the metric only when the
                 # compiled loss really is that metric. (For the torch path
@@ -115,7 +151,8 @@ class AutoEstimator:
                 value = res[metric]
             return {metric: float(value), "model": model}
 
-        engine = make_search_engine()
+        engine = make_search_engine(search_alg=search_alg,
+                                    scheduler=scheduler)
         engine.compile(trial_fn, search_space, n_sampling=n_sampling,
                        metric=metric, mode=mode, seed=seed)
         engine.run()

@@ -1,0 +1,81 @@
+"""High-dimensional panel forecasting with TCMF (reference role: the
+Chronos TCMF-at-scale story — ``chronos/model/tcmf/DeepGLO.py`` forecasts
+thousands of correlated series through a rank-k factorization whose
+temporal factors carry a TCN).
+
+Builds a 500-series panel driven by a few nonlinear latent factors,
+fits TCMF with both temporal models, and reports the held-out horizon
+MSE of each — the TCN should win, that being DeepGLO's point.
+
+Run: python examples/tcmf_panel_forecast.py [--series 500] [--rank 4]
+"""
+
+import argparse
+
+import numpy as np
+
+
+def make_panel(n_series: int, t: int, seed: int = 0):
+    """Panel driven by threshold-AR latent factors: nonlinear,
+    non-chaotic — exactly predictable given the rule, but outside any
+    linear AR's class (a linear factor like a sinusoid would be AR-
+    predictable and wash the comparison out)."""
+    rs = np.random.RandomState(seed)
+    x1 = np.empty(t, np.float32)
+    x1[0] = 0.2
+    for i in range(1, t):
+        x1[i] = 0.95 * x1[i - 1] + (0.4 if x1[i - 1] < 0 else -0.4)
+    x2 = np.empty(t, np.float32)
+    x2[0] = -0.3
+    for i in range(1, t):
+        x2[i] = 0.9 * x2[i - 1] + (0.5 if x2[i - 1] < 0.1 else -0.6)
+    X = np.stack([x1, x2])
+    F = rs.randn(n_series, 2).astype(np.float32)
+    return (F @ X + 0.01 * rs.randn(n_series, t)).astype(np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--series", type=int, default=500)
+    ap.add_argument("--steps", type=int, default=240)
+    ap.add_argument("--horizon", type=int, default=8)
+    ap.add_argument("--rank", type=int, default=2)
+    ap.add_argument("--tcn-epochs", type=int, default=150)
+    args = ap.parse_args()
+
+    from zoo_tpu.chronos.forecaster import TCMFForecaster
+    from zoo_tpu.orca import init_orca_context, stop_orca_context
+
+    init_orca_context(cluster_mode="local")
+    try:
+        Y = make_panel(args.series, args.steps)
+        train = Y[:, :-args.horizon]
+        test = Y[:, -args.horizon:]
+        print(f"panel: {Y.shape[0]} series x {Y.shape[1]} steps, "
+              f"forecasting the last {args.horizon}")
+
+        results = {}
+        for tm, kw in (("ar", {}),
+                       ("tcn", dict(tcn_epochs=args.tcn_epochs,
+                                    dropout=0.0, lr=2e-3,
+                                    kernel_size=4))):
+            f = TCMFForecaster(rank=args.rank, ar_lag=8,
+                               temporal_model=tm, **kw)
+            fit = f.fit({"y": train})
+            pred = f.predict(horizon=args.horizon)
+            mse = float(np.mean((pred - test) ** 2))
+            results[tm] = mse
+            print(f"temporal_model={tm:3s}: reconstruction mse="
+                  f"{fit['mse']:.4f}  horizon-{args.horizon} "
+                  f"forecast mse={mse:.4f}")
+        ratio = results["ar"] / max(results["tcn"], 1e-12)
+        print(f"TCN vs AR forecast-MSE ratio: {ratio:.1f}x "
+              f"{'(TCN wins)' if ratio > 1 else '(AR wins)'}")
+        assert results["tcn"] < results["ar"], results
+        print("OK")
+    finally:
+        stop_orca_context()
+
+
+if __name__ == "__main__":
+    main()

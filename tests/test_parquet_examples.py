@@ -98,7 +98,8 @@ _EXAMPLES = ["ncf_movielens.py", "dogs_vs_cats_resnet.py",
              "llama_pretrain.py", "qa_ranking_knrm.py",
              "nnframes_pipeline.py", "fraud_detection.py",
              "tfnet_image_inference.py", "object_detection_ssd.py",
-             "quantized_inference.py", "serving_throughput.py"]
+             "quantized_inference.py", "serving_throughput.py",
+             "tcmf_panel_forecast.py"]
 
 
 @pytest.mark.parametrize("script", _EXAMPLES)

@@ -5,6 +5,11 @@ from zoo_tpu.models.llm.llama import (  # noqa: F401
     llama_param_count,
     tiny_llama_config,
 )
+from zoo_tpu.models.llm.moe_llama import (  # noqa: F401
+    MoELlama,
+    place_moe_params,
+)
 
 __all__ = ["Llama", "LlamaConfig", "llama3_8b_config",
-           "tiny_llama_config", "llama_param_count"]
+           "tiny_llama_config", "llama_param_count", "MoELlama",
+           "place_moe_params"]

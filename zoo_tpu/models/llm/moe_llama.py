@@ -1,0 +1,112 @@
+"""Mixture-of-Experts Llama variant (expert-parallel, Mixtral-style).
+
+Net-new vs the reference (SURVEY §2.10: EP absent upstream). Every
+block's SwiGLU MLP becomes a top-k-routed expert bank
+(``ops/moe.py``); expert weights gain a leading E dim sharded over the
+mesh ``expert`` axis, so the token dispatch/combine einsums lower to
+ICI all-to-alls under GSPMD. Attention half, RoPE, norms and the scanned
+block loop are inherited from :class:`Llama` unchanged.
+
+Training note: the router's load-balance auxiliary loss must reach the
+optimizer — use :meth:`call_with_aux` inside the train step (the plain
+``call`` drops it, which is correct for inference). ``__graft_entry__``
+exercises the full EP train step on the dryrun mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from zoo_tpu.models.llm.llama import (
+    Llama,
+    LlamaConfig,
+    _rms_norm,
+    rope_frequencies,
+)
+from zoo_tpu.ops.moe import init_moe_params, moe_ffn
+
+__all__ = ["MoELlama", "place_moe_params"]
+
+
+class MoELlama(Llama):
+    def __init__(self, config: Optional[LlamaConfig] = None,
+                 n_experts: int = 8, top_k: int = 2,
+                 capacity_factor: float = 1.25,
+                 aux_loss_weight: float = 0.01, **kwargs):
+        super().__init__(config, **kwargs)
+        self.n_experts = int(n_experts)
+        self.top_k = int(top_k)
+        self.capacity_factor = float(capacity_factor)
+        self.aux_loss_weight = float(aux_loss_weight)
+
+    # -- params -----------------------------------------------------------
+    def _block_params(self, rng):
+        p = super()._block_params(rng)
+        for k in ("w_gate", "w_up", "w_down"):
+            del p[k]
+        c = self.cfg
+        p.update(init_moe_params(jax.random.fold_in(rng, 7), c.hidden,
+                                 c.intermediate, self.n_experts,
+                                 init=self.init))
+        return p
+
+    # -- forward ----------------------------------------------------------
+    def _mlp_part(self, p, h):
+        y, aux = self._moe_part(p, h)
+        return y  # inference path: aux loss dropped
+
+    def _moe_part(self, p, h):
+        c = self.cfg
+        x = _rms_norm(h, p["mlp_norm"], c.rms_eps)
+        moe_p = {k: p[k] for k in ("router", "w_gate", "w_up", "w_down")}
+        y, aux = moe_ffn(moe_p, x, top_k=self.top_k,
+                         capacity_factor=self.capacity_factor,
+                         aux_loss_weight=self.aux_loss_weight)
+        return h + y, aux
+
+    def call_with_aux(self, params, inputs):
+        """(logits, total_aux_loss) — the training forward. Add the aux
+        term to the task loss so the router learns to balance load."""
+        c = self.cfg
+        ids = inputs.astype(jnp.int32)
+        h = jnp.take(params["embed"], ids, axis=0)
+        cos, sin = rope_frequencies(c.head_dim, ids.shape[1],
+                                    c.rope_theta)
+
+        def body(carry, blk):
+            h, aux = carry
+            h = self._attn_part(blk, h, cos, sin)
+            h, a = self._moe_part(blk, h)
+            return (h, aux + a), None
+
+        (h, aux), _ = jax.lax.scan(body, (h, jnp.float32(0)),
+                                   params["blocks"])
+        h = _rms_norm(h, params["final_norm"], c.rms_eps)
+        if not self.lm_head:
+            return h, aux
+        head = (params["embed"].T if c.tie_embeddings else params["head"])
+        return h @ head.astype(h.dtype), aux
+
+
+def place_moe_params(params, mesh):
+    """Device-put an :class:`MoELlama` params tree: expert banks sharded
+    over the ``expert`` axis (blocks are stacked, so the leading dim is
+    the layer stack and E is dim 1); everything else replicated (compose
+    with fsdp/model via ``parallel.plans`` when those axes are active)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from zoo_tpu.parallel.mesh import replicated_sharding
+
+    expert_keys = {"w_gate", "w_up", "w_down"}
+
+    def place(path, x):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name in expert_keys and x.ndim == 4:
+            return jax.device_put(
+                x, NamedSharding(mesh, P(None, "expert", None, None)))
+        return jax.device_put(x, replicated_sharding(mesh))
+
+    return jax.tree_util.tree_map_with_path(place, params)

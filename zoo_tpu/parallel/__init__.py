@@ -6,6 +6,7 @@ from zoo_tpu.parallel.mesh import (
     host_local_to_global,
     DEFAULT_AXES,
 )
+from zoo_tpu.parallel.pipeline import pipeline_apply, stack_stages
 
 __all__ = [
     "build_mesh",
@@ -14,4 +15,6 @@ __all__ = [
     "fsdp_param_sharding",
     "host_local_to_global",
     "DEFAULT_AXES",
+    "pipeline_apply",
+    "stack_stages",
 ]

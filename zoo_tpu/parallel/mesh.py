@@ -17,6 +17,9 @@ Axis-name convention (used by every sharding plan in zoo_tpu):
 - ``fsdp``  — ZeRO-3 style parameter sharding (combines with ``data``)
 - ``model`` — tensor parallel (net-new vs the reference, SURVEY §2.10)
 - ``seq``   — sequence/context parallel (ring attention, net-new, SURVEY §5.7)
+- ``expert`` — expert parallel (MoE token all-to-all, ``ops/moe.py``)
+- ``pipe``  — pipeline parallel (GPipe microbatching,
+  ``parallel/pipeline.py``)
 """
 
 from __future__ import annotations
@@ -27,7 +30,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-DEFAULT_AXES = ("data", "fsdp", "model", "seq")
+DEFAULT_AXES = ("data", "fsdp", "model", "seq", "expert", "pipe")
 
 
 def _factor_shape(n_devices: int, axis_sizes: Dict[str, int],

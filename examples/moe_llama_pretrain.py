@@ -1,0 +1,102 @@
+"""Mixture-of-Experts Llama pre-training with expert parallelism.
+
+Net-new family vs the reference (SURVEY §2.10: EP absent upstream):
+a Mixtral-style MoE-Llama (top-2 of E experts per block) trained
+next-token on a synthetic grammar, expert banks sharded over the mesh
+``expert`` axis so the token dispatch runs as ICI collectives. The
+router's load-balance aux loss joins the objective; the script reports
+both the task loss trend and the aux term (≈1.0x weight means balanced
+routing).
+
+Run: python examples/moe_llama_pretrain.py [--steps 30] [--experts 4]
+"""
+
+import argparse
+
+import numpy as np
+
+
+def make_corpus(n=256, seq=16, vocab=96, seed=0):
+    rs = np.random.RandomState(seed)
+    starts = rs.randint(0, vocab, (n, 1))
+    ids = [starts]
+    for _ in range(seq):
+        prev = ids[-1]
+        ids.append(np.where(prev % 2 == 0, prev + 2, prev + 3) % vocab)
+    ids = np.concatenate(ids, axis=1)
+    return ids[:, :-1].astype(np.int32), ids[:, 1:].astype(np.int32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--experts", type=int, default=4)
+    ap.add_argument("--batch", type=int, default=64)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from zoo_tpu.models.llm import (
+        LlamaConfig,
+        MoELlama,
+        place_moe_params,
+    )
+    from zoo_tpu.orca import init_orca_context, stop_orca_context
+    from zoo_tpu.parallel import build_mesh
+
+    init_orca_context(cluster_mode="local")
+    try:
+        n_dev = len(jax.devices())
+        expert_ax = min(args.experts, n_dev) \
+            if n_dev % min(args.experts, n_dev) == 0 else 1
+        mesh = build_mesh(jax.devices(),
+                          axis_sizes={"data": n_dev // expert_ax,
+                                      "expert": expert_ax})
+        print(f"mesh: data={n_dev // expert_ax} x expert={expert_ax}")
+
+        cfg = LlamaConfig(vocab=96, hidden=64, n_block=2, n_head=4,
+                          n_kv_head=2, intermediate=128,
+                          rope_theta=10000.0)
+        model = MoELlama(cfg, n_experts=args.experts, top_k=2)
+        params = place_moe_params(
+            model.build(jax.random.PRNGKey(0), (None, 16)), mesh)
+
+        x, y = make_corpus(n=args.batch)
+        bsh = NamedSharding(mesh, P("data"))
+        xd = jax.device_put(x, bsh)
+        yd = jax.device_put(y, bsh)
+
+        def loss_fn(p, b, lbl):
+            logits, aux = model.call_with_aux(p, b)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+            ce = -jnp.mean(jnp.take_along_axis(logp, lbl[..., None], -1))
+            return ce + aux, (ce, aux)
+
+        @jax.jit
+        def step(p, b, lbl):
+            (_, (ce, aux)), g = jax.value_and_grad(
+                loss_fn, has_aux=True)(p, b, lbl)
+            p = jax.tree_util.tree_map(lambda w, gr: w - 0.05 * gr, p, g)
+            return p, ce, aux
+
+        with mesh:
+            first = last = None
+            for i in range(args.steps):
+                params, ce, aux = step(params, xd, yd)
+                if i == 0:
+                    first = float(ce)
+                last = float(ce)
+                if i % 10 == 0:
+                    print(f"step {i:3d}: ce={float(ce):.4f} "
+                          f"aux={float(aux):.4f}")
+        print(f"cross-entropy {first:.3f} -> {last:.3f}")
+        assert last < first, "MoE-Llama failed to learn"
+        print("OK")
+    finally:
+        stop_orca_context()
+
+
+if __name__ == "__main__":
+    main()

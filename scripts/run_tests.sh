@@ -11,8 +11,14 @@
 #                                 multi-process clusters, example scripts)
 set -euo pipefail
 cd "$(dirname "$0")/.."
+# compile-bound JAX tests parallelize well across cores; a 1-core box
+# (this dev image) runs serially — the README records both timings
+XDIST=()
+if [[ "$(nproc)" -gt 1 ]] && python -c "import xdist" 2>/dev/null; then
+    XDIST=(-n auto)
+fi
 if [[ "${1:-}" == "--all" ]]; then
     shift
-    exec python -m pytest tests/ -q -m "" "$@"
+    exec python -m pytest tests/ -q -m "" "${XDIST[@]}" "$@"
 fi
-exec python -m pytest tests/ -q "$@"
+exec python -m pytest tests/ -q "${XDIST[@]}" "$@"

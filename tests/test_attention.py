@@ -84,6 +84,7 @@ def test_layernorm():
     np.testing.assert_allclose(y.std(-1), 1, atol=1e-2)
 
 
+@pytest.mark.slow
 def test_tiny_bert_classifier_trains(orca_ctx):
     """BERT + pooler + head, end-to-end fit on a toy task: does the first
     token id determine the class."""

@@ -87,6 +87,7 @@ def test_lstm_forecaster_learns(orca_ctx):
     assert preds.shape == (ts.numpy_x.shape[0], 1, 1)
 
 
+@pytest.mark.slow
 def test_tcn_forecaster_multistep(orca_ctx):
     df = _sine_df(300)
     ts = TSDataset.from_pandas(df, dt_col="ts", target_col="value")
@@ -152,6 +153,7 @@ def test_dbscan_detector():
     assert 100 in idx and 101 in idx
 
 
+@pytest.mark.slow
 def test_mtnet_forecaster(orca_ctx):
     from zoo_tpu.chronos.forecaster import MTNetForecaster
 

@@ -146,6 +146,7 @@ def test_creator_functions(orca_ctx):
     assert hist["loss"][-1] < hist["loss"][0]
 
 
+@pytest.mark.slow
 def test_hf_bert_finetune_parity(orca_ctx):
     """VERDICT round-1 acceptance: a HuggingFace-style BERT classifier
     fine-tunes through Estimator.from_torch (traced bridge), and converted

@@ -23,7 +23,13 @@ SMALL = (64, 64, 3)
 
 
 @pytest.mark.parametrize("builder", [
-    inception_v1, mobilenet_v1, mobilenet_v2, squeezenet, densenet121])
+    # mobilenet_v1 is the fast-tier representative; the big builds are
+    # 13-34s of pure compile each on a 1-core box — slow tier
+    mobilenet_v1,
+    pytest.param(inception_v1, marks=pytest.mark.slow),
+    pytest.param(mobilenet_v2, marks=pytest.mark.slow),
+    pytest.param(squeezenet, marks=pytest.mark.slow),
+    pytest.param(densenet121, marks=pytest.mark.slow)])
 def test_forward_shape(builder):
     model = builder(7, input_shape=SMALL)
     x = np.random.RandomState(0).rand(2, *SMALL).astype(np.float32)
@@ -46,6 +52,7 @@ def test_catalogue_lookup():
         create_image_classifier("resnet-9000")
 
 
+@pytest.mark.slow
 def test_mobilenet_trains():
     model = mobilenet_v1(3, input_shape=(32, 32, 3))
     x = np.random.RandomState(0).rand(12, 32, 32, 3).astype(np.float32)

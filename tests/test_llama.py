@@ -159,6 +159,7 @@ def test_sharded_train_step_fsdp_tp():
     assert np.isfinite(float(l0)) and float(l1) < float(l0)
 
 
+@pytest.mark.slow
 def test_remat_matches_no_remat():
     """jax.checkpoint must not change numerics; grads agree with the
     stored-activation path."""

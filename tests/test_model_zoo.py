@@ -44,6 +44,7 @@ def test_wide_and_deep(orca_ctx):
                       verbose=0)["loss"][0])
 
 
+@pytest.mark.slow
 def test_text_classifier(orca_ctx):
     from zoo_tpu.models.textclassification import TextClassifier
 
@@ -132,6 +133,7 @@ def test_knrm(orca_ctx):
     assert m.predict(x[:8]).shape == (8, 1)
 
 
+@pytest.mark.slow
 def test_resnet18_tiny(orca_ctx):
     from zoo_tpu.models.image import resnet18
 

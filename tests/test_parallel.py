@@ -127,6 +127,7 @@ def test_ring_attention_jit_under_mesh():
     np.testing.assert_allclose(np.asarray(out), np.asarray(dense), atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_gradients_match_dense():
     """Training with sequence parallelism needs d(ring_attention); the
     shard_map/ppermute program must differentiate to the dense grads."""

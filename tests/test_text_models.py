@@ -79,6 +79,7 @@ def test_crf_viterbi_matches_bruteforce():
 
 # --------------------------------------------------------------- models
 
+@pytest.mark.slow
 def test_ner_crf_converges_and_roundtrips(tmp_path):
     words, chars, tags = _data(n_tags=4)
     m = NER(num_entities=4, word_vocab_size=VOCAB, char_vocab_size=CHARS,
@@ -116,6 +117,7 @@ def test_ner_rejects_pad_mode():
         NER(4, VOCAB, CHARS, crf_mode="pad")
 
 
+@pytest.mark.slow
 def test_sequence_tagger_two_heads(tmp_path):
     words, chars, tags = _data(n=48, n_tags=3)
     chunk = (tags > 0).astype(np.int32)

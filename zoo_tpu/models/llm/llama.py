@@ -167,25 +167,36 @@ class Llama(Layer):
         return mesh
 
     # -- params -----------------------------------------------------------
+    def _mlp_block_params(self, k_gate, k_up):
+        """The MLP half's weights — a separate hook so MoE variants can
+        swap in expert banks without materializing (and discarding) the
+        dense SwiGLU weights. Key derivation unchanged from round 2 so
+        existing checkpoints keep their values."""
+        c = self.cfg
+        return {
+            "w_gate": self.init(k_gate, (c.hidden, c.intermediate),
+                                jnp.float32),
+            "w_up": self.init(k_up, (c.hidden, c.intermediate),
+                              jnp.float32),
+            "w_down": self.init(
+                jax.random.fold_in(k_up, 1), (c.intermediate, c.hidden),
+                jnp.float32),
+        }
+
     def _block_params(self, rng):
         c = self.cfg
         kv = c.n_kv_head * c.head_dim
         ks = jax.random.split(rng, 6)
-        return {
+        p = {
             "wq": self.init(ks[0], (c.hidden, c.hidden), jnp.float32),
             "wk": self.init(ks[1], (c.hidden, kv), jnp.float32),
             "wv": self.init(ks[2], (c.hidden, kv), jnp.float32),
             "wo": self.init(ks[3], (c.hidden, c.hidden), jnp.float32),
-            "w_gate": self.init(ks[4], (c.hidden, c.intermediate),
-                                jnp.float32),
-            "w_up": self.init(ks[5], (c.hidden, c.intermediate),
-                              jnp.float32),
-            "w_down": self.init(
-                jax.random.fold_in(ks[5], 1), (c.intermediate, c.hidden),
-                jnp.float32),
             "attn_norm": jnp.ones((c.hidden,), jnp.float32),
             "mlp_norm": jnp.ones((c.hidden,), jnp.float32),
         }
+        p.update(self._mlp_block_params(ks[4], ks[5]))
+        return p
 
     def build(self, rng, input_shape):
         c = self.cfg

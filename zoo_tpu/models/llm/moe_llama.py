@@ -43,15 +43,13 @@ class MoELlama(Llama):
         self.aux_loss_weight = float(aux_loss_weight)
 
     # -- params -----------------------------------------------------------
-    def _block_params(self, rng):
-        p = super()._block_params(rng)
-        for k in ("w_gate", "w_up", "w_down"):
-            del p[k]
+    def _mlp_block_params(self, k_gate, k_up):
+        # the hook exists so the dense SwiGLU weights are never
+        # materialized: at 8B shapes that's ~0.7GB of glorot samples
+        # built and thrown away per build() otherwise
         c = self.cfg
-        p.update(init_moe_params(jax.random.fold_in(rng, 7), c.hidden,
-                                 c.intermediate, self.n_experts,
-                                 init=self.init))
-        return p
+        return init_moe_params(k_gate, c.hidden, c.intermediate,
+                               self.n_experts, init=self.init)
 
     # -- forward ----------------------------------------------------------
     def _mlp_part(self, p, h):
@@ -69,17 +67,38 @@ class MoELlama(Llama):
 
     def call_with_aux(self, params, inputs):
         """(logits, total_aux_loss) — the training forward. Add the aux
-        term to the task loss so the router learns to balance load."""
+        term to the task loss so the router learns to balance load.
+        Honors the inherited ``remat`` setting the same way Llama.call
+        does: "dots" checkpoints only the MoE half (the flash kernel's
+        custom_vjp keeps its own residuals), True remats the whole
+        block."""
         c = self.cfg
         ids = inputs.astype(jnp.int32)
         h = jnp.take(params["embed"], ids, axis=0)
         cos, sin = rope_frequencies(c.head_dim, ids.shape[1],
                                     c.rope_theta)
 
+        if self.remat == "dots":
+            moe_fn = jax.checkpoint(
+                self._moe_part, prevent_cse=False,
+                policy=jax.checkpoint_policies
+                .dots_with_no_batch_dims_saveable)
+
+            def block_fn(blk, h):
+                return moe_fn(blk, self._attn_part(blk, h, cos, sin))
+        elif self.remat:
+            def _whole(blk, h):
+                return self._moe_part(blk,
+                                      self._attn_part(blk, h, cos, sin))
+            block_fn = jax.checkpoint(_whole, prevent_cse=False)
+        else:
+            def block_fn(blk, h):
+                return self._moe_part(blk,
+                                      self._attn_part(blk, h, cos, sin))
+
         def body(carry, blk):
             h, aux = carry
-            h = self._attn_part(blk, h, cos, sin)
-            h, a = self._moe_part(blk, h)
+            h, a = block_fn(blk, h)
             return (h, aux + a), None
 
         (h, aux), _ = jax.lax.scan(body, (h, jnp.float32(0)),

@@ -1,0 +1,72 @@
+#!/usr/bin/env bash
+# Bundle the zoo_tpu repo + python environment for air-gapped pod hosts.
+#
+# Rebuild of the reference's conda-pack deployment story
+# (docs "Python User Guide": conda-pack the driver env, ship the tarball
+# to YARN executors via --archives). On TPU pods the equivalent need is
+# hosts without network egress: this script produces ONE tarball holding
+#   - the zoo_tpu repo (the package is run from source, PYTHONPATH-based)
+#   - the environment, packed the best way available:
+#       conda-pack / venv-pack  -> bundle/env.tgz (relocatable env)
+#       fallback                -> bundle/requirements.lock (pip freeze);
+#                                  PACK_FULL_ENV=1 additionally copies
+#                                  the live venv verbatim (relocatable
+#                                  only to the same absolute prefix; the
+#                                  docker image in docker/ is the
+#                                  supported route when neither packer
+#                                  exists)
+#
+# Usage:
+#   scripts/pack_env.sh [out.tgz]        # default: zoo_tpu_bundle.tgz
+#   PACK_FULL_ENV=1 scripts/pack_env.sh  # force the verbatim env copy
+#
+# Unpack on each worker:
+#   tar -xzf zoo_tpu_bundle.tgz && cd bundle
+#   if [ -f env.tgz ]; then mkdir -p env && tar -xzf env.tgz -C env \
+#       && source env/bin/activate; \
+#   elif [ -d env ]; then source env/bin/activate; \
+#   else pip install -r requirements.lock; fi
+#   PYTHONPATH=$PWD/repo python repo/examples/ncf_movielens.py
+set -euo pipefail
+OUT=${1:-zoo_tpu_bundle.tgz}
+REPO_DIR="$(cd "$(dirname "$0")/.." && pwd)"
+# python3-only hosts (stock TPU VMs) have no bare `python`
+PY=${PYTHON:-$(command -v python3 || command -v python)}
+STAGE=$(mktemp -d)
+trap 'rm -rf "$STAGE"' EXIT
+mkdir -p "$STAGE/bundle"
+
+# 1. the repo (source tree minus caches/VCS/envs/previous bundles)
+mkdir -p "$STAGE/bundle/repo"
+tar -C "$REPO_DIR" --exclude='.git' --exclude='__pycache__' \
+    --exclude='*.pyc' --exclude='build' --exclude='*.tgz' \
+    --exclude='*.tar.gz' --exclude='.venv' --exclude='venv' \
+    --exclude='.pytest_cache' --exclude='*.egg-info' -cf - . \
+    | tar -C "$STAGE/bundle/repo" -xf -
+
+# 2. the environment
+if "$PY" -c "import conda_pack" 2>/dev/null; then
+    "$PY" -m conda_pack -o "$STAGE/bundle/env.tgz"
+    echo "env packed with conda-pack -> bundle/env.tgz"
+elif "$PY" -c "import venv_pack" 2>/dev/null; then
+    "$PY" -m venv_pack -o "$STAGE/bundle/env.tgz"
+    echo "env packed with venv-pack -> bundle/env.tgz"
+else
+    "$PY" -m pip freeze --all > "$STAGE/bundle/requirements.lock" \
+        2>/dev/null || \
+        "$PY" -m pip freeze > "$STAGE/bundle/requirements.lock"
+    echo "no conda-pack/venv-pack in this env: wrote requirements.lock"
+    if [[ "${PACK_FULL_ENV:-0}" == "1" && -n "${VIRTUAL_ENV:-}" ]]; then
+        echo "PACK_FULL_ENV=1: copying $VIRTUAL_ENV verbatim (works only"
+        echo "at the same absolute prefix on the workers)"
+        mkdir -p "$STAGE/bundle/env"
+        tar -C "$VIRTUAL_ENV" --exclude='__pycache__' -cf - . \
+            | tar -C "$STAGE/bundle/env" -xf -
+    else
+        echo "workers will need: pip install -r requirements.lock"
+        echo "(or use the docker image in docker/ — the supported route)"
+    fi
+fi
+
+tar -C "$STAGE" -czf "$OUT" bundle
+echo "wrote $OUT ($(du -h "$OUT" | cut -f1))"

@@ -23,7 +23,7 @@
 # Unpack on each worker:
 #   tar -xzf zoo_tpu_bundle.tgz && cd bundle
 #   if [ -f env.tgz ]; then mkdir -p env && tar -xzf env.tgz -C env \
-#       && source env/bin/activate; \
+#       && source env/bin/activate && conda-unpack 2>/dev/null || true; \
 #   elif [ -d env ]; then source env/bin/activate; \
 #   else pip install -r requirements.lock; fi
 #   PYTHONPATH=$PWD/repo python repo/examples/ncf_movielens.py

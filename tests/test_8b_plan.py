@@ -1,0 +1,68 @@
+"""Llama-3-8B placement plan at REAL shapes (BASELINE stretch row).
+
+The dryrun proves the multichip step executes at tiny widths; this
+proves the sharding PLAN at the actual 8B shapes without materializing
+a byte: abstract param tree via jax.eval_shape, placement via the same
+leaf_sharding the fit path uses, then per-device memory accounting
+against v5e HBM.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from zoo_tpu.models.llm import Llama, llama3_8b_config, llama_param_count
+from zoo_tpu.parallel import build_mesh
+from zoo_tpu.parallel.plans import leaf_sharding
+
+
+def test_llama3_8b_fsdp_tp_plan_fits_v5e_hbm():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    cfg = llama3_8b_config()
+    n_params = llama_param_count(cfg)
+    assert 7.5e9 < n_params < 8.5e9  # it really is the 8B config
+
+    layer = Llama(cfg)
+    tree = jax.eval_shape(
+        lambda k: layer.build(k, (None, 8192)), jax.random.PRNGKey(0))
+
+    mesh = build_mesh(jax.devices()[:8],
+                      axis_sizes={"fsdp": 4, "model": 2})
+    total_bytes = 0
+    max_shard_bytes = 0
+    unsharded_big = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        sh = leaf_sharding(mesh, leaf.shape)
+        spec = sh.spec
+        shard_elems = np.prod(leaf.shape, dtype=np.int64)
+        divisor = 1
+        for dim, ax in zip(leaf.shape, tuple(spec) + (None,) * 8):
+            if ax is not None:
+                axes = ax if isinstance(ax, tuple) else (ax,)
+                for a in axes:
+                    divisor *= mesh.shape[a]
+        shard_elems //= divisor
+        nbytes = int(np.prod(leaf.shape, dtype=np.int64)) * 4
+        total_bytes += nbytes
+        max_shard_bytes += shard_elems * 4
+        if divisor == 1 and nbytes > 64 << 20:
+            unsharded_big.append((jax.tree_util.keystr(path),
+                                  leaf.shape))
+    # every >64MB tensor must be sharded by the plan — a replicated
+    # embedding alone (128256 x 4096 f32 = 2.1GB) would blow the budget
+    assert not unsharded_big, unsharded_big
+    # the plan must divide the full tree by ~the mesh size (fully
+    # sharded, not just the big leaves)
+    assert max_shard_bytes < total_bytes / 6
+    # params + grads + adam m/v, all f32 = 4x params of static state.
+    # On THIS 8-chip mesh that is ~15GiB/chip — honestly NOT a v5e fit;
+    # the plan's point is that per-chip state scales as 1/n_chips, so
+    # doubling the fsdp axis (16 chips, the smallest real 8B pod) lands
+    # at ~7.5GiB/chip with >8GiB of HBM left for activations at
+    # seq 8192. Assert both sides of that claim.
+    static_8 = 4 * max_shard_bytes
+    static_16 = static_8 // 2           # fsdp 4 -> 8 halves every shard
+    assert static_8 > 12 << 30          # 8 chips genuinely don't fit
+    assert static_16 < 8 << 30, f"{static_16 / (1 << 30):.1f} GiB"

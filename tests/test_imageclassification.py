@@ -114,3 +114,49 @@ def test_space_to_depth_stem_matches_conv():
     assert got.shape == want.shape == (2, 16, 16, 8)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=1e-4, rtol=1e-4)
+
+
+def test_image3d_transforms():
+    """feature/image3d (SURVEY #21 3D imaging): crops are exact slices,
+    zero-angle rotation and identity affine are no-ops, real rotations
+    keep shape, and the chain composes over an ImageSet."""
+    from zoo_tpu.feature.common import ChainedPreprocessing
+    from zoo_tpu.feature.image import ImageFeature, ImageSet
+    from zoo_tpu.feature.image3d import (
+        AffineTransform3D,
+        CenterCrop3D,
+        Crop3D,
+        RandomCrop3D,
+        Rotate3D,
+    )
+
+    rs = np.random.RandomState(0)
+    vol = rs.rand(12, 10, 8).astype(np.float32)
+
+    out = Crop3D(start=(2, 1, 0), patch_size=(4, 4, 4)).map_image(vol)
+    np.testing.assert_array_equal(out, vol[2:6, 1:5, 0:4])
+
+    out = CenterCrop3D(patch_size=(6, 6, 6)).map_image(vol)
+    np.testing.assert_array_equal(out, vol[3:9, 2:8, 1:7])
+
+    out = RandomCrop3D(patch_size=(5, 5, 5)).map_image(vol)
+    assert out.shape == (5, 5, 5)
+
+    np.testing.assert_allclose(
+        Rotate3D(rotation_angles=(0.0, 0.0, 0.0)).map_image(vol), vol)
+    rot = Rotate3D(rotation_angles=(0.3, 0.0, 0.1)).map_image(vol)
+    assert rot.shape == vol.shape and np.isfinite(rot).all()
+
+    ident = AffineTransform3D(np.eye(3)).map_image(vol)
+    np.testing.assert_allclose(ident, vol, atol=1e-5)
+    shifted = AffineTransform3D(np.eye(3),
+                                translation=(1, 0, 0)).map_image(vol)
+    # translation by +1 in z pulls voxels from one plane over
+    np.testing.assert_allclose(shifted[0], vol[1], atol=1e-5)
+
+    s = ImageSet.from_arrays([vol], [1])
+    s = s.transform(ChainedPreprocessing([
+        CenterCrop3D(patch_size=(8, 8, 8)),
+        Rotate3D(rotation_angles=(0.0, 0.0, 0.2))]))
+    assert s.features[0]["image"].shape == (8, 8, 8)
+    assert s.features[0]["label"] == 1

@@ -8,7 +8,7 @@ a bright bar (class 1) run through the 3D preprocessing chain
 on the augmented patches and is evaluated on clean center-cropped
 volumes.
 
-Run: python examples/image_augmentation_3d.py [--epochs 6]
+Run: python examples/image_augmentation_3d.py [--epochs 14]
 """
 
 import argparse

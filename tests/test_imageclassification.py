@@ -121,7 +121,7 @@ def test_image3d_transforms():
     zero-angle rotation and identity affine are no-ops, real rotations
     keep shape, and the chain composes over an ImageSet."""
     from zoo_tpu.feature.common import ChainedPreprocessing
-    from zoo_tpu.feature.image import ImageFeature, ImageSet
+    from zoo_tpu.feature.image import ImageSet
     from zoo_tpu.feature.image3d import (
         AffineTransform3D,
         CenterCrop3D,

@@ -1,0 +1,68 @@
+"""Autograd DSL: custom loss + Lambda-style variable math (reference:
+``pyzoo/zoo/examples/autograd`` — ``custom.py`` builds a CustomLoss from
+Variable expressions, ``customloss.py`` trains with it).
+
+Fits a small regressor with a hand-built robust loss (mean absolute
+error with an epsilon-insensitive zone expressed in Variable ops) and
+compares against plain MSE on data with heavy-tailed label noise —
+the robust loss should win on clean held-out MSE.
+
+Run: python examples/autograd_custom_loss.py [--epochs 20]
+"""
+
+import argparse
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=20)
+    args = ap.parse_args()
+
+    from zoo_tpu.orca import init_orca_context, stop_orca_context
+    from zoo_tpu.pipeline.api import autograd as A
+    from zoo_tpu.pipeline.api.autograd import CustomLoss, Variable
+    from zoo_tpu.pipeline.api.keras import Sequential
+    from zoo_tpu.pipeline.api.keras.layers import Dense
+    from zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+    init_orca_context(cluster_mode="local")
+    try:
+        rs = np.random.RandomState(0)
+        w_true = rs.randn(6, 1).astype(np.float32)
+        x = rs.randn(512, 6).astype(np.float32)
+        clean = x @ w_true
+        # heavy-tailed corruption on 10% of labels
+        noise = np.where(rs.rand(512, 1) < 0.1,
+                         8.0 * rs.randn(512, 1), 0.02 * rs.randn(512, 1))
+        y = (clean + noise).astype(np.float32)
+        xt = rs.randn(128, 6).astype(np.float32)
+        yt = xt @ w_true
+
+        # epsilon-insensitive MAE, written in the Variable DSL exactly
+        # like the reference's autograd example composes its loss
+        y_true = Variable(input_shape=(1,))
+        y_pred = Variable(input_shape=(1,))
+        err = A.abs(y_true - y_pred)
+        robust = A.mean(A.maximum(err - 0.05, 0.0), axis=1)
+        robust_loss = CustomLoss(robust, y_true, y_pred)
+
+        results = {}
+        for tag, loss in (("mse", "mse"), ("robust", robust_loss)):
+            m = Sequential()
+            m.add(Dense(1, input_shape=(6,)))
+            m.compile(optimizer=Adam(lr=0.05), loss=loss)
+            m.fit(x, y, batch_size=64, nb_epoch=args.epochs, verbose=0)
+            pred = np.asarray(m.predict(xt, batch_size=128))
+            results[tag] = float(np.mean((pred - yt) ** 2))
+            print(f"{tag:6s} loss -> clean held-out mse "
+                  f"{results[tag]:.4f}")
+        assert results["robust"] < results["mse"], results
+        print("robust custom loss beats MSE under label corruption — OK")
+    finally:
+        stop_orca_context()
+
+
+if __name__ == "__main__":
+    main()

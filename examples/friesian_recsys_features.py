@@ -1,0 +1,93 @@
+"""Friesian feature engineering → NCF training (reference:
+``pyzoo/zoo/examples/friesian`` + the Friesian FeatureTable recsys
+pipelines): raw interaction logs run through the FeatureTable ops —
+string indexing, negative sampling, crossed features — and the
+engineered table trains the NCF ranker end-to-end.
+
+Run: python examples/friesian_recsys_features.py [--epochs 12]
+"""
+
+import argparse
+
+import numpy as np
+import pandas as pd
+
+
+def make_logs(n_users=60, n_items=120, n_rows=1200, n_clusters=6,
+              seed=0):
+    """Implicit-feedback logs with classic CF structure: items fall into
+    clusters, each user draws 90% of their interactions from their own
+    cluster — so a matched (user, item) pair is much likelier to be a
+    real interaction than a sampled negative."""
+    rs = np.random.RandomState(seed)
+    users = rs.randint(0, n_users, n_rows)
+    user_cluster = rs.randint(0, n_clusters, n_users)
+    per = n_items // n_clusters
+    own = (user_cluster[users] * per
+           + rs.randint(0, per, n_rows))
+    items = np.where(rs.rand(n_rows) < 0.9, own,
+                     rs.randint(0, n_items, n_rows))
+    return pd.DataFrame({
+        "user": [f"u{u}" for u in users],
+        "item": items + 1,                     # 1-based ids
+        "ts": np.arange(n_rows),
+    })
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=12)
+    args = ap.parse_args()
+
+    from zoo_tpu.friesian.feature import FeatureTable
+    from zoo_tpu.models.recommendation import NeuralCF
+    from zoo_tpu.orca import init_orca_context, stop_orca_context
+
+    init_orca_context(cluster_mode="local")
+    try:
+        logs = make_logs()
+        tbl = FeatureTable.from_pandas(logs)
+
+        # 1. string-index users (most-frequent-first ids, reference
+        #    gen_string_idx semantics)
+        [user_idx] = tbl.gen_string_idx("user")
+        tbl = tbl.encode_string("user", [user_idx])
+        print(f"indexed {user_idx.size} users")
+
+        # 2. negative sampling for implicit feedback (3 negatives per
+        #    positive, the reference's add_negative_samples role)
+        n_items = int(tbl.df["item"].max())
+        tbl = tbl.add_neg_samples(item_size=n_items, item_col="item",
+                                  neg_num=3)
+        pos = int((tbl.df["label"] == 1).sum())
+        neg = int((tbl.df["label"] == 0).sum())
+        print(f"after negative sampling: {pos} positives, "
+              f"{neg} negatives")
+
+        # 3. train NCF on the engineered table
+        df = tbl.df.sample(frac=1.0, random_state=0)
+        x = np.stack([df["user"].to_numpy() - 1,
+                      df["item"].to_numpy() - 1], axis=1).astype(np.int32)
+        y = df["label"].to_numpy().astype(np.int32)
+        split = int(0.9 * len(y))
+        model = NeuralCF(user_count=user_idx.size, item_count=n_items,
+                         class_num=2, user_embed=16, item_embed=16,
+                         hidden_layers=(32, 16))
+        from zoo_tpu.pipeline.api.keras.optimizers import Adam
+        model.compile(optimizer=Adam(lr=0.01),
+                      loss="sparse_categorical_crossentropy",
+                      metrics=["accuracy"])
+        model.fit(x[:split], y[:split], batch_size=128,
+                  nb_epoch=args.epochs, verbose=0)
+        res = model.evaluate(x[split:], y[split:], batch_size=128)
+        print(f"held-out: {res}")
+        # 25% positives; beating the majority class shows the features
+        # carry signal through the pipeline
+        assert res["accuracy"] > 0.76, res
+        print("OK")
+    finally:
+        stop_orca_context()
+
+
+if __name__ == "__main__":
+    main()

@@ -100,7 +100,8 @@ _EXAMPLES = ["ncf_movielens.py", "dogs_vs_cats_resnet.py",
              "tfnet_image_inference.py", "object_detection_ssd.py",
              "quantized_inference.py", "serving_throughput.py",
              "tcmf_panel_forecast.py", "moe_llama_pretrain.py",
-             "image_augmentation_3d.py"]
+             "image_augmentation_3d.py", "autograd_custom_loss.py",
+             "friesian_recsys_features.py"]
 
 
 @pytest.mark.parametrize("script", _EXAMPLES)

@@ -79,13 +79,22 @@ def _metadata_map(msg: bytes, name_fields=(4, 2)) -> Dict[int, str]:
     return {key: name.decode(errors="replace")} if key is not None else {}
 
 
-def device_op_times(path: str) -> Dict[str, Tuple[float, int]]:
+def device_op_times(path: str, include_async: bool = False
+                    ) -> Dict[str, Tuple[float, int]]:
     """Aggregate device event durations by full HLO op text.
 
-    Returns {op_name: (total_ms, count)} for the ``/device:TPU:*`` planes.
+    Returns {op_name: (total_ms, count)} for the ``/device:TPU:*`` planes,
+    counting ONLY the per-op trace lines (``XLA Ops``; plus ``Async XLA
+    Ops`` when ``include_async``). The other lines a real device plane
+    carries — ``Steps`` and ``XLA Modules`` span whole training steps,
+    host planes carry python-function spans in different units — must not
+    be mixed into an op breakdown (they made earlier breakdowns report
+    step-length "ops" named by their step number).
     """
     data = open(path, "rb").read()
     out: Dict[str, List] = defaultdict(lambda: [0, 0])
+    op_lines = {b"XLA Ops"} | ({b"Async XLA Ops"} if include_async
+                               else set())
     for fn, plane in _fields(data):
         if fn != 1 or not isinstance(plane, bytes):
             continue
@@ -109,9 +118,23 @@ def device_op_times(path: str) -> Dict[str, Tuple[float, int]]:
         dur_stat_ids = {k for k, v in stat_meta.items()
                         if v == "device_duration_ps"}
         for line in lines:
+            line_name = b""
+            display_name = b""
+            events = []
             for lf, lv in _fields(line):
-                if lf != 4 or not isinstance(lv, bytes):
-                    continue
+                if lf == 2 and isinstance(lv, bytes):
+                    line_name = lv
+                elif lf == 11 and isinstance(lv, bytes):
+                    display_name = lv  # some producers name lines here
+                elif lf == 4 and isinstance(lv, bytes):
+                    events.append(lv)
+            line_name = line_name or display_name
+            # GPU planes name per-kernel lines by stream, not "XLA Ops"
+            is_stream = (b"GPU" in name
+                         and line_name.startswith(b"Stream"))
+            if line_name not in op_lines and not is_stream:
+                continue
+            for lv in events:
                 mid, dur = 0, 0
                 for ef, ev in _fields(lv):
                     if ef == 1:
@@ -137,22 +160,38 @@ _OP_RE = re.compile(r"= \S+? (\w[\w.-]*?)\(")
 _KIND_RE = re.compile(r"kind=(k\w+)")
 
 
-def op_breakdown(path: str, top: int = 20) -> List[Tuple[str, float, int]]:
+def op_breakdown(path: str, top: int = 20, include_async: bool = False
+                 ) -> List[Tuple[str, float, int]]:
     """Group :func:`device_op_times` by op category (fusion kind /
     primitive name); returns [(category, total_ms, count)] sorted by time.
 
     The practical companion to ``set_profile``: run one profiled fit with
     ``trace_dir=...``, then feed the ``*.xplane.pb`` under
     ``<trace_dir>/plugins/profile/<ts>/`` here to see where device time
-    went.
+    went. ``include_async`` adds the ``Async XLA Ops`` line (async
+    collectives / DMA).
     """
     byop: Dict[str, List] = defaultdict(lambda: [0.0, 0])
-    for nm, (ms, cnt) in device_op_times(path).items():
+    for nm, (ms, cnt) in device_op_times(
+            path, include_async=include_async).items():
         m = _OP_RE.search(nm)
         key = m.group(1) if m else nm.split(" ")[0][:40]
+        root = key.lstrip("%").split(".")[0].split("(")[0]
+        if root in ("while", "call", "conditional"):
+            # control-flow wrappers span their whole body; their children
+            # are traced individually, so counting both double-reports
+            continue
         if "fusion" in nm[:80] or "fusion" in key:
             km = _KIND_RE.search(nm)
-            key = f"fusion/{km.group(1) if km else '?'}"
+            if km:
+                key = f"fusion/{km.group(1)}"
+            else:
+                # device planes name fused computations by content
+                # ("pad_add_fusion", "convolution_fusion.12"): strip the
+                # instance suffix so repeats bucket together
+                stem = re.sub(r"[.]\d+$", "",
+                              nm.split(" ")[0].lstrip("%"))
+                key = f"fusion/{stem[:48]}"
         byop[key][0] += ms
         byop[key][1] += cnt
     rows = sorted(((k, v[0], v[1]) for k, v in byop.items()),

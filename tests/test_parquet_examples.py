@@ -101,7 +101,8 @@ _EXAMPLES = ["ncf_movielens.py", "dogs_vs_cats_resnet.py",
              "quantized_inference.py", "serving_throughput.py",
              "tcmf_panel_forecast.py", "moe_llama_pretrain.py",
              "image_augmentation_3d.py", "autograd_custom_loss.py",
-             "friesian_recsys_features.py"]
+             "friesian_recsys_features.py", "inception_training.py",
+             "elastic_training.py", "xshards_preprocessing.py"]
 
 
 @pytest.mark.parametrize("script", _EXAMPLES)

@@ -58,6 +58,20 @@ def _merge_state(trainable: Dict, state: Dict) -> Dict:
 from zoo_tpu.tensorboard import TrainSummary  # noqa: E402
 
 
+def _scan_steps(step, params, opt_state, rng, stacked):
+    """``lax.scan`` of the train step over batches stacked as
+    (k, batch, ...); the shared core of the multi-step and whole-epoch
+    dispatch paths — their per-step math must stay identical."""
+    def body(carry, batch):
+        p, o, r = carry
+        p, o, r, loss = step(p, o, r, *batch)
+        return (p, o, r), loss
+
+    (params, opt_state, rng), losses = jax.lax.scan(
+        body, (params, opt_state, rng), stacked)
+    return params, opt_state, rng, jnp.sum(losses)
+
+
 class KerasNet:
     """Shared training engine for Sequential and Model."""
 
@@ -161,6 +175,7 @@ class KerasNet:
         self.metrics = [get_metric(m) for m in (metrics or [])]
         self._jit_train = self._jit_eval = self._jit_pred = None
         self._jit_multi = self._own_jit_train = None
+        self._jit_epoch_cache = None
         self._opt_state = None  # a new optimizer cannot reuse old state
         return self
 
@@ -178,18 +193,22 @@ class KerasNet:
                                        max_value: float):
         """Clip every gradient element into [min_value, max_value]."""
         self._grad_clip = ("const", float(min_value), float(max_value))
-        self._jit_train = self._jit_multi = self._own_jit_train = None  # clip is in the step
+        # clip is in the step: drop every cache holding a traced step
+        self._jit_train = self._jit_multi = self._own_jit_train = None
+        self._jit_epoch_cache = None
         return self
 
     def set_gradient_clipping_by_l2_norm(self, clip_norm: float):
         """Scale gradients so their global L2 norm is at most clip_norm."""
         self._grad_clip = ("l2", float(clip_norm))
         self._jit_train = self._jit_multi = self._own_jit_train = None
+        self._jit_epoch_cache = None
         return self
 
     def clear_gradient_clipping(self):
         self._grad_clip = None
         self._jit_train = self._jit_multi = self._own_jit_train = None
+        self._jit_epoch_cache = None
         return self
 
     def _apply_grad_clip(self, grads):
@@ -367,16 +386,28 @@ class KerasNet:
         step = self._make_step_fn()
 
         def multi(params, opt_state, rng, *stacked):
-            def body(carry, batch):
-                params, opt_state, rng = carry
-                p, o, r, loss = step(params, opt_state, rng, *batch)
-                return (p, o, r), loss
-
-            (params, opt_state, rng), losses = jax.lax.scan(
-                body, (params, opt_state, rng), stacked)
-            return params, opt_state, rng, jnp.sum(losses)
+            return _scan_steps(step, params, opt_state, rng, stacked)
 
         return jax.jit(multi, donate_argnums=(0, 1, 2))
+
+    def _build_epoch_train_step(self, k: int, bs: int):
+        """A FULL epoch in one dispatch: permutation-gather of the (small,
+        device-resident) dataset + ``lax.scan`` of the step over all ``k``
+        batches, inside a single jit call. On high-latency PJRT transports
+        the per-dispatch overhead (measured 76-137ms per call on the
+        tunneled dev chip) otherwise dominates small-model epochs — two
+        superbatch dispatches cost more than the whole NCF epoch's
+        compute. Only used for datasets small enough that the permuted
+        gather copy is cheap (fit caps it at 256MB)."""
+        step = self._make_step_fn()
+
+        def epoch_fn(params, opt_state, rng, *args):
+            *arrs, perm = args
+            stacked = [a[perm].reshape((k, bs) + a.shape[1:])
+                       for a in arrs]
+            return _scan_steps(step, params, opt_state, rng, stacked)
+
+        return jax.jit(epoch_fn, donate_argnums=(0, 1, 2))
 
     def _build_pred_step(self):
         def step(params, *xs):
@@ -542,9 +573,22 @@ class KerasNet:
         # cached build (e.g. from a profiled fit) must not disable scan
         interposed = self._jit_train is not None \
             and self._jit_train is not getattr(self, "_own_jit_train", None)
+        # whole-epoch dispatch: small device-resident dataset on one chip
+        # -> permutation-gather + full-epoch scan in ONE jit call per
+        # epoch (see _build_epoch_train_step). The 256MB cap bounds the
+        # permuted-copy HBM cost; the even-division requirement avoids a
+        # ragged tail batch forcing a second compile.
+        use_epoch = (device_resident and pc == 1
+                     and (mesh is None or mesh.size == 1)
+                     and prof is None and not interposed
+                     and n % local_bs == 0 and n_batches >= 2
+                     and sum(a.nbytes for a in arrs) <= (256 << 20))
         use_scan = scan_group > 1 and prof is None and pc == 1 \
-            and not interposed
-        if use_scan:
+            and not interposed and not use_epoch
+        if use_epoch:
+            if getattr(self, "_jit_epoch_cache", None) is None:
+                self._jit_epoch_cache = {}
+        elif use_scan:
             group = scan_group
             # getattr: instances unpickled from blobs predating _jit_multi
             if getattr(self, "_jit_multi", None) is None:
@@ -555,95 +599,108 @@ class KerasNet:
         for epoch in range(nb_epoch):
             t0 = time.time()
             loss_sum, n_steps = None, 0
-            if device_resident and self._mesh() is None:
-                # HBM-resident dataset on one chip: gather + reshape for a
-                # whole superbatch in ONE jitted call. Python-level
-                # per-array slicing costs 2 dispatches per array, and
-                # per-dispatch overhead on tunneled PJRT backends has been
-                # measured at 13-90ms — for small-sample models (NCF) that
-                # made the HBM-staged path slower than feeding from host.
-                if getattr(self, "_jit_stage", None) is None:
-                    import functools
-
-                    @functools.partial(jax.jit, static_argnums=(2, 3))
-                    def _jit_stage(arrs, idx, k, bs):
-                        out = [a[idx] for a in arrs]
-                        if k:
-                            out = [a.reshape((k, bs) + a.shape[1:])
-                                   for a in out]
-                        return out
-                    self._jit_stage = _jit_stage
-
-                def _stage(idx):
-                    k = len(idx) // local_bs if use_scan else 0
-                    return self._jit_stage(arrs, jnp.asarray(idx), k,
-                                           local_bs)
+            if use_epoch:
+                kk = n // local_bs
+                je = self._jit_epoch_cache.get((kk, local_bs))
+                if je is None:
+                    je = self._jit_epoch_cache[(kk, local_bs)] = \
+                        self._build_epoch_train_step(kk, local_bs)
+                perm = (nprng.permutation(n) if shuffle
+                        else np.arange(n)).astype(np.int32)
+                params, opt_state, rng, loss_sum = je(
+                    params, opt_state, rng, *arrs, jnp.asarray(perm))
+                self._step += kk
+                n_steps = kk
             else:
-                def _stage(idx):
-                    sliced = [a[idx] for a in arrs]
-                    if use_scan:  # (k*bs,...) -> (k, bs, ...) for scan
-                        sliced = [a.reshape((len(idx) // local_bs,
-                                             local_bs)
-                                            + a.shape[1:])
-                                  for a in sliced]
-                        return self._put_stacked(sliced)
-                    return self._put_batch(sliced)
+                if device_resident and self._mesh() is None:
+                    # HBM-resident dataset on one chip: gather + reshape for a
+                    # whole superbatch in ONE jitted call. Python-level
+                    # per-array slicing costs 2 dispatches per array, and
+                    # per-dispatch overhead on tunneled PJRT backends has been
+                    # measured at 13-90ms — for small-sample models (NCF) that
+                    # made the HBM-staged path slower than feeding from host.
+                    if getattr(self, "_jit_stage", None) is None:
+                        import functools
 
-            batches = DoubleBufferedIterator(
-                data_utils.batch_slices(n, local_bs, shuffle, nprng,
-                                        group=group),
-                stage_fn=_stage)
-            try:
-                with (prof.epoch_trace() if prof
-                      else contextlib.nullcontext()):
-                    source = (prof.timed_iter(iter(batches), "data")
-                              if prof else batches)
-                    for staged in source:
-                        if use_scan:
-                            k = staged[0].shape[0]
-                            params, opt_state, rng, loss = self._jit_multi(
-                                params, opt_state, rng, *staged)
-                            self._step += k
-                            n_steps += k
-                            loss_sum = loss if loss_sum is None \
-                                else loss_sum + loss
-                            continue
-                        n_sub = (staged[0].shape[0] // local_bs
-                                 if group > 1 else 1)
-                        for j in range(n_sub):
-                            if group > 1:
-                                # re-place the sub-slice so a multi-device
-                                # mesh keeps the guaranteed batch sharding
-                                # (device-to-device; a no-op on one chip)
-                                with (prof.phase("reshard") if prof
-                                      else contextlib.nullcontext()):
-                                    sub = self._put_batch(
-                                        [t[j * local_bs:(j + 1) * local_bs]
-                                         for t in staged])
-                            else:
-                                sub = staged
-                            if prof:
-                                with prof.phase("step"):
+                        @functools.partial(jax.jit, static_argnums=(2, 3))
+                        def _jit_stage(arrs, idx, k, bs):
+                            out = [a[idx] for a in arrs]
+                            if k:
+                                out = [a.reshape((k, bs) + a.shape[1:])
+                                       for a in out]
+                            return out
+                        self._jit_stage = _jit_stage
+
+                    def _stage(idx):
+                        k = len(idx) // local_bs if use_scan else 0
+                        return self._jit_stage(arrs, jnp.asarray(idx), k,
+                                               local_bs)
+                else:
+                    def _stage(idx):
+                        sliced = [a[idx] for a in arrs]
+                        if use_scan:  # (k*bs,...) -> (k, bs, ...) for scan
+                            sliced = [a.reshape((len(idx) // local_bs,
+                                                 local_bs)
+                                                + a.shape[1:])
+                                      for a in sliced]
+                            return self._put_stacked(sliced)
+                        return self._put_batch(sliced)
+
+                batches = DoubleBufferedIterator(
+                    data_utils.batch_slices(n, local_bs, shuffle, nprng,
+                                            group=group),
+                    stage_fn=_stage)
+                try:
+                    with (prof.epoch_trace() if prof
+                          else contextlib.nullcontext()):
+                        source = (prof.timed_iter(iter(batches), "data")
+                                  if prof else batches)
+                        for staged in source:
+                            if use_scan:
+                                k = staged[0].shape[0]
+                                params, opt_state, rng, loss = self._jit_multi(
+                                    params, opt_state, rng, *staged)
+                                self._step += k
+                                n_steps += k
+                                loss_sum = loss if loss_sum is None \
+                                    else loss_sum + loss
+                                continue
+                            n_sub = (staged[0].shape[0] // local_bs
+                                     if group > 1 else 1)
+                            for j in range(n_sub):
+                                if group > 1:
+                                    # re-place the sub-slice so a multi-device
+                                    # mesh keeps the guaranteed batch sharding
+                                    # (device-to-device; a no-op on one chip)
+                                    with (prof.phase("reshard") if prof
+                                          else contextlib.nullcontext()):
+                                        sub = self._put_batch(
+                                            [t[j * local_bs:(j + 1) * local_bs]
+                                             for t in staged])
+                                else:
+                                    sub = staged
+                                if prof:
+                                    with prof.phase("step"):
+                                        params, opt_state, rng, loss = \
+                                            self._jit_train(params, opt_state,
+                                                            rng, *sub)
+                                        if prof.sync:
+                                            # sync so the phase measures the
+                                            # real device step, not dispatch
+                                            jax.block_until_ready(loss)
+                                else:
                                     params, opt_state, rng, loss = \
                                         self._jit_train(params, opt_state,
                                                         rng, *sub)
-                                    if prof.sync:
-                                        # sync so the phase measures the
-                                        # real device step, not dispatch
-                                        jax.block_until_ready(loss)
-                            else:
-                                params, opt_state, rng, loss = \
-                                    self._jit_train(params, opt_state,
-                                                    rng, *sub)
-                            self._step += 1
-                            n_steps += 1
-                            # running device-side sum: one host transfer
-                            # per epoch (a per-step sync pays a full round
-                            # trip — ~100ms over a tunneled PJRT transport)
-                            loss_sum = loss if loss_sum is None \
-                                else loss_sum + loss
-            finally:
-                batches.close()
+                                self._step += 1
+                                n_steps += 1
+                                # running device-side sum: one host transfer
+                                # per epoch (a per-step sync pays a full round
+                                # trip — ~100ms over a tunneled PJRT transport)
+                                loss_sum = loss if loss_sum is None \
+                                    else loss_sum + loss
+                finally:
+                    batches.close()
             epoch_loss = float(np.asarray(loss_sum)) / max(n_steps, 1)
             from zoo_tpu.common.context import ZooContext
             if ZooContext.debug_nans and not np.isfinite(epoch_loss):
@@ -840,6 +897,7 @@ class KerasNet:
         jt, je, jp = self._jit_train, self._jit_eval, self._jit_pred
         jm = getattr(self, "_jit_multi", None)
         jo = getattr(self, "_own_jit_train", None)
+        jc = getattr(self, "_jit_epoch_cache", None)
         ts, vs, opt = self.train_summary, self.validation_summary, \
             self._opt_state
         prof = getattr(self, "_profiler", None)
@@ -849,6 +907,7 @@ class KerasNet:
             self._jit_multi = None
             self._own_jit_train = None
             self._jit_stage = None
+            self._jit_epoch_cache = None
             self._opt_state = None
             self._profiler = None
             self.train_summary = TrainSummary()
@@ -860,6 +919,7 @@ class KerasNet:
             self._jit_train, self._jit_eval, self._jit_pred = jt, je, jp
             self._jit_multi = jm
             self._own_jit_train = jo
+            self._jit_epoch_cache = jc
             self.train_summary, self.validation_summary = ts, vs
             self._opt_state = opt
             self._profiler = prof

@@ -88,10 +88,12 @@ def _timed_fit(model, xs, y, batch_size, epochs=5):
 
     The dataset is staged into HBM once up front (the TPU-native input
     pattern: cache in device memory, slice/shuffle on device). The timed
-    window still exercises the full fit pipeline — per-epoch permutation,
-    superbatch staging, DoubleBufferedIterator, jitted steps — but is not
-    capped by the host->device transport (which on a tunneled PJRT backend
-    measures the tunnel, not the chip)."""
+    window still exercises the full fit pipeline — per-epoch permutation
+    and the jitted steps (small datasets take the whole-epoch
+    single-dispatch path; larger ones the superbatch
+    DoubleBufferedIterator) — but is not capped by the host->device
+    transport (which on a tunneled PJRT backend measures the tunnel, not
+    the chip)."""
     import jax.numpy as jnp
 
     n = int(y.shape[0])
@@ -364,6 +366,37 @@ def bench_llama(batch_size=64, seq_len=512, steps_per_epoch=24, epochs=5):
     return _stats(rates), flops_per_sample, seq_len
 
 
+def bench_llama_longctx(batch_size=8, seq_len=4096, steps_per_epoch=8,
+                        epochs=5):
+    """Long-context single-chip evidence (SURVEY §5.7): the flash
+    kernel's blockwise softmax keeps S=4096 training in memory where the
+    dense path would materialize a 16M-entry score matrix per head.
+    Multi-chip sequence parallelism (ring attention) is dryrun-validated
+    separately; this row pins the single-chip long-seq throughput."""
+    from zoo_tpu.models.llm import Llama, LlamaConfig
+    from zoo_tpu.pipeline.api.keras import Sequential
+    from zoo_tpu.pipeline.api.keras.optimizers import AdamWeightDecay
+
+    cfg = LlamaConfig(vocab=32000, hidden=768, n_block=12, n_head=12,
+                      n_kv_head=4, intermediate=2048, rope_theta=10000.0)
+    m = Sequential()
+    m.add(Llama(cfg, remat="dots", input_shape=(seq_len,)))
+    m.compile(optimizer=AdamWeightDecay(lr=1e-4),
+              loss="sparse_categorical_crossentropy_from_logits",
+              dtype_policy="mixed_bfloat16")
+    n = batch_size * steps_per_epoch
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, cfg.vocab, (n, seq_len)).astype(np.int32)
+    labels = np.roll(ids, -1, axis=1)
+    rates = _timed_fit(m, ids, labels, batch_size, epochs=epochs)
+    h, kv = cfg.hidden, cfg.n_kv_head * cfg.head_dim
+    fwd_per_token = cfg.n_block * (
+        2 * (h * h * 2 + 2 * h * kv) + 2 * 3 * h * cfg.intermediate
+        + 4 * seq_len * h) + 2 * h * cfg.vocab
+    flops_per_sample = 3 * fwd_per_token * seq_len
+    return _stats(rates), flops_per_sample, seq_len
+
+
 def main():
     import jax
 
@@ -424,6 +457,15 @@ def main():
                 extra["llama_mfu"] = round(l_flops * l_p50 / peak, 4)
         except Exception as e:  # noqa: BLE001
             extra["llama_error"] = repr(e)
+        try:
+            (lc_p50, lc_sp), lc_flops, lc_seq = bench_llama_longctx()
+            extra["llama_s4096_tokens_per_sec"] = round(lc_p50 * lc_seq, 1)
+            extra["llama_s4096_spread"] = round(lc_sp, 3)
+            if peak == peak:
+                extra["llama_s4096_mfu"] = round(lc_flops * lc_p50 / peak,
+                                                 4)
+        except Exception as e:  # noqa: BLE001
+            extra["llama_longctx_error"] = repr(e)
     finally:
         stop_orca_context()
 

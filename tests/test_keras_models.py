@@ -165,6 +165,16 @@ def test_epoch_scan_matches_host_fed_fit():
         assert getattr(m_dev, "_jit_epoch_cache", None), \
             "epoch-scan path not taken"
         np.testing.assert_allclose(host["loss"], dev["loss"], rtol=2e-5)
+
+        # shuffle=False takes the no-gather variant (reshape, no perm)
+        host_nf = build().fit(x, y, batch_size=32, nb_epoch=2, seed=7,
+                              shuffle=False, verbose=0)
+        m_nf = build()
+        dev_nf = m_nf.fit(jnp.asarray(x), jnp.asarray(y), batch_size=32,
+                          nb_epoch=2, seed=7, shuffle=False, verbose=0)
+        assert (8, 32, False) in m_nf._jit_epoch_cache
+        np.testing.assert_allclose(host_nf["loss"], dev_nf["loss"],
+                                   rtol=2e-5)
     finally:
         stop_orca_context()
 
@@ -213,8 +223,11 @@ def test_save_after_device_resident_fit(tmp_path):
         model = Sequential()
         model.add(Dense(2, input_shape=(4,)))
         model.compile(optimizer="adam", loss="mse")
-        model.fit(jnp.asarray(x), jnp.asarray(y), batch_size=16,
+        # batch 24: 64 % 24 != 0 keeps the whole-epoch path OFF so this
+        # fit exercises the _jit_stage superbatch path it exists to cover
+        model.fit(jnp.asarray(x), jnp.asarray(y), batch_size=24,
                   nb_epoch=1, shuffle=False, verbose=0)
+        assert getattr(model, "_jit_stage", None) is not None
         p = str(tmp_path / "m.zoo")
         model.save(p)
         m2 = Sequential.load(p)

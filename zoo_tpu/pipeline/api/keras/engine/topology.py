@@ -390,7 +390,7 @@ class KerasNet:
 
         return jax.jit(multi, donate_argnums=(0, 1, 2))
 
-    def _build_epoch_train_step(self, k: int, bs: int):
+    def _build_epoch_train_step(self, k: int, bs: int, gather: bool):
         """A FULL epoch in one dispatch: permutation-gather of the (small,
         device-resident) dataset + ``lax.scan`` of the step over all ``k``
         batches, inside a single jit call. On high-latency PJRT transports
@@ -402,9 +402,15 @@ class KerasNet:
         step = self._make_step_fn()
 
         def epoch_fn(params, opt_state, rng, *args):
-            *arrs, perm = args
-            stacked = [a[perm].reshape((k, bs) + a.shape[1:])
-                       for a in arrs]
+            if gather:
+                *arrs, perm = args
+                stacked = [a[perm].reshape((k, bs) + a.shape[1:])
+                           for a in arrs]
+            else:
+                # shuffle=False: an identity gather would copy the whole
+                # dataset in HBM for nothing — reshape is free
+                stacked = [a[:k * bs].reshape((k, bs) + a.shape[1:])
+                           for a in args]
             return _scan_steps(step, params, opt_state, rng, stacked)
 
         return jax.jit(epoch_fn, donate_argnums=(0, 1, 2))
@@ -601,18 +607,22 @@ class KerasNet:
             loss_sum, n_steps = None, 0
             if use_epoch:
                 kk = n // local_bs
-                je = self._jit_epoch_cache.get((kk, local_bs))
+                key = (kk, local_bs, bool(shuffle))
+                je = self._jit_epoch_cache.get(key)
                 if je is None:
-                    je = self._jit_epoch_cache[(kk, local_bs)] = \
-                        self._build_epoch_train_step(kk, local_bs)
-                perm = (nprng.permutation(n) if shuffle
-                        else np.arange(n)).astype(np.int32)
+                    je = self._jit_epoch_cache[key] = \
+                        self._build_epoch_train_step(kk, local_bs,
+                                                     bool(shuffle))
+                extra_args = []
+                if shuffle:
+                    perm = nprng.permutation(n).astype(np.int32)
+                    extra_args = [jnp.asarray(perm)]
                 params, opt_state, rng, loss_sum = je(
-                    params, opt_state, rng, *arrs, jnp.asarray(perm))
+                    params, opt_state, rng, *arrs, *extra_args)
                 self._step += kk
                 n_steps = kk
             else:
-                if device_resident and self._mesh() is None:
+                if device_resident and (mesh is None or mesh.size == 1):
                     # HBM-resident dataset on one chip: gather + reshape for a
                     # whole superbatch in ONE jitted call. Python-level
                     # per-array slicing costs 2 dispatches per array, and

@@ -57,6 +57,80 @@ def test_nnclassifier_and_xshards(orca_ctx):
     assert "prediction" in got.columns and len(got) == len(df)
 
 
+def test_nn_image_reader_pipeline(orca_ctx, tmp_path):
+    """NNImageReader.readImages -> sample_preprocessing chain ->
+    NNClassifier fit/transform (the reference's image transfer-learning
+    NNFrames flow, ``nn_image_reader.py:25`` + ``RowToImageFeature``)."""
+    import cv2
+
+    from zoo_tpu.feature.common import ChainedPreprocessing
+    from zoo_tpu.feature.image import (
+        ImageChannelNormalize,
+        ImageMatToTensor,
+        ImageResize,
+    )
+    from zoo_tpu.pipeline.api.keras.layers import Convolution2D, Flatten
+    from zoo_tpu.pipeline.nnframes import (
+        NNClassifier,
+        NNImageReader,
+        RowToImageFeature,
+    )
+
+    rs = np.random.RandomState(0)
+    for cls, tint in (("red", (40, 40, 200)), ("blue", (200, 40, 40))):
+        d = tmp_path / "imgs" / cls
+        d.mkdir(parents=True)
+        for i in range(8):
+            img = (rs.rand(12, 14, 3) * 50 + np.asarray(tint)
+                   ).astype(np.uint8)
+            cv2.imwrite(str(d / f"{i}.png"), img)
+
+    df = NNImageReader.readImages(str(tmp_path / "imgs"))
+    assert set(df.columns) >= {"image", "origin", "label"}
+    assert len(df) == 16
+    assert df.attrs["label_map"] == {"blue": 0, "red": 1}
+    assert df["image"].iloc[0].shape == (12, 14, 3)
+
+    chain = ChainedPreprocessing([
+        RowToImageFeature(),
+        ImageResize(8, 8),
+        ImageChannelNormalize(127.5, 127.5, 127.5, 127.5, 127.5, 127.5),
+        ImageMatToTensor(format="NHWC"),
+    ])
+    model = Sequential()
+    model.add(Convolution2D(4, 3, 3, activation="relu",
+                            dim_ordering="tf", input_shape=(8, 8, 3)))
+    model.add(Flatten())
+    model.add(Dense(2, activation="softmax"))
+    clf = (NNClassifier(model, features_col="image")
+           .setSamplePreprocessing(chain)
+           .setBatchSize(8).setMaxEpoch(12).setLearningRate(0.01))
+    nn_model = clf.fit(df)
+
+    out = nn_model.transform(df)
+    acc = float((out["prediction"].to_numpy()
+                 == df["label"].to_numpy()).mean())
+    assert acc >= 0.8, acc
+
+
+def test_nn_image_reader_flat_dir_with_stray_subdir(tmp_path):
+    """A flat image dir containing a junk subdir (.ipynb_checkpoints)
+    must stay in flat mode, not flip into (empty) labeled mode."""
+    import cv2
+
+    from zoo_tpu.pipeline.nnframes import NNImageReader
+
+    d = tmp_path / "flat"
+    (d / ".ipynb_checkpoints").mkdir(parents=True)
+    rs = np.random.RandomState(0)
+    for i in range(3):
+        cv2.imwrite(str(d / f"{i}.png"),
+                    (rs.rand(6, 6, 3) * 255).astype(np.uint8))
+    df = NNImageReader.readImages(str(d))
+    assert len(df) == 3
+    assert "label" not in df.columns
+
+
 def test_xgboost_regressor_and_classifier():
     from zoo_tpu.orca.automl.xgboost import (
         XGBoostClassifier,

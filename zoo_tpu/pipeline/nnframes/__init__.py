@@ -4,5 +4,10 @@ from zoo_tpu.pipeline.nnframes.nn_classifier import (  # noqa: F401
     NNEstimator,
     NNModel,
 )
+from zoo_tpu.pipeline.nnframes.nn_image_reader import (  # noqa: F401
+    NNImageReader,
+    RowToImageFeature,
+)
 
-__all__ = ["NNEstimator", "NNModel", "NNClassifier", "NNClassifierModel"]
+__all__ = ["NNEstimator", "NNModel", "NNClassifier", "NNClassifierModel",
+           "NNImageReader", "RowToImageFeature"]

@@ -34,11 +34,35 @@ def _to_matrix(df, cols: Sequence[str]) -> np.ndarray:
     return parts[0] if len(parts) == 1 else np.concatenate(parts, axis=1)
 
 
+def _featurize(df, cols: Sequence[str], preprocessing) -> np.ndarray:
+    """Feature columns → model input. Without a chain: flat numeric
+    matrix (the Spark Vector role). With a ``sample_preprocessing``
+    chain: the chain maps each cell of the single feature column to a
+    feature carrying ``tensor`` (or a transformed ``image``), preserving
+    tensor shape for conv models."""
+    if preprocessing is None:
+        return _to_matrix(df, cols)
+    if len(cols) != 1:
+        raise ValueError(
+            "sample_preprocessing requires a single feature column; got "
+            f"{list(cols)}")
+    xs = []
+    for cell in df[cols[0]]:
+        f = preprocessing(cell)
+        if isinstance(f, dict):
+            t = f.get("tensor", f.get("image"))
+        else:
+            t = f
+        xs.append(np.asarray(t, np.float32))
+    return np.stack(xs)
+
+
 class NNEstimator:
     """Builder-style estimator (set* methods mirror the Spark-ML params)."""
 
     def __init__(self, model, criterion: str = "mse",
-                 features_col: str = "features", label_col: str = "label"):
+                 features_col: str = "features", label_col: str = "label",
+                 sample_preprocessing=None):
         self.model = model
         self.criterion = criterion
         self.features_col = [features_col] if isinstance(features_col, str) \
@@ -49,6 +73,7 @@ class NNEstimator:
         self.learning_rate: Optional[float] = None
         self.optim_method = "adam"
         self.caching_sample = True
+        self.sample_preprocessing = sample_preprocessing
 
     # -- Spark-ML style setters -------------------------------------------
     def setFeaturesCol(self, col: Union[str, Sequence[str]]):
@@ -77,6 +102,16 @@ class NNEstimator:
 
     def setCachingSample(self, flag: bool):
         self.caching_sample = bool(flag)
+        return self
+
+    def setSamplePreprocessing(self, chain):
+        """Per-cell transform chain applied to the (single) feature
+        column before stacking — the reference's image-pipeline entry
+        (``NNEstimator(..., sample_preprocessing=ChainedPreprocessing(
+        [RowToImageFeature(), ImageResize(...), ..., ImageMatToTensor()
+        ]))``). The chain's output feature must carry ``tensor`` (or
+        leave ``image`` as the tensor)."""
+        self.sample_preprocessing = chain
         return self
 
     # -- fit ---------------------------------------------------------------
@@ -130,7 +165,7 @@ class NNEstimator:
             import pandas as pd
 
             df = pd.concat(df.collect(), ignore_index=True)
-        x = _to_matrix(df, self.features_col)
+        x = _featurize(df, self.features_col, self.sample_preprocessing)
         y = df[self.label_col].to_numpy() if self.label_col in df else None
         return df, x, y
 
@@ -148,7 +183,8 @@ class NNEstimator:
         return y.astype(np.float32).reshape(len(y), -1)
 
     def _make_model(self) -> "NNModel":
-        return NNModel(self.model, features_col=self.features_col)
+        return NNModel(self.model, features_col=self.features_col,
+                       sample_preprocessing=self.sample_preprocessing)
 
 
 class NNModel:
@@ -157,10 +193,12 @@ class NNModel:
 
     prediction_col = "prediction"
 
-    def __init__(self, model, features_col: Sequence[str] = ("features",)):
+    def __init__(self, model, features_col: Sequence[str] = ("features",),
+                 sample_preprocessing=None):
         self.model = model
         self.features_col = list(features_col)
         self.batch_size = 256
+        self.sample_preprocessing = sample_preprocessing
 
     def setFeaturesCol(self, col: Union[str, Sequence[str]]):
         self.features_col = [col] if isinstance(col, str) else list(col)
@@ -174,8 +212,12 @@ class NNModel:
         self.prediction_col = col
         return self
 
+    def setSamplePreprocessing(self, chain):
+        self.sample_preprocessing = chain
+        return self
+
     def _predict(self, df) -> np.ndarray:
-        x = _to_matrix(df, self.features_col)
+        x = _featurize(df, self.features_col, self.sample_preprocessing)
         return self.model.predict(x, batch_size=self.batch_size)
 
     def transform(self, df):
@@ -198,15 +240,18 @@ class NNClassifier(NNEstimator):
 
     def __init__(self, model, criterion: str =
                  "sparse_categorical_crossentropy",
-                 features_col: str = "features", label_col: str = "label"):
-        super().__init__(model, criterion, features_col, label_col)
+                 features_col: str = "features", label_col: str = "label",
+                 sample_preprocessing=None):
+        super().__init__(model, criterion, features_col, label_col,
+                         sample_preprocessing=sample_preprocessing)
 
     def _prepare_labels(self, y):
         return y.astype(np.int32)
 
     def _make_model(self) -> "NNClassifierModel":
-        return NNClassifierModel(self.model,
-                                 features_col=self.features_col)
+        return NNClassifierModel(
+            self.model, features_col=self.features_col,
+            sample_preprocessing=self.sample_preprocessing)
 
 
 class NNClassifierModel(NNModel):

@@ -1,0 +1,160 @@
+"""The reference's user-facing import surface resolves here.
+
+Statements below are the import lines that appear in the reference's
+examples, apps, and docs (``pyzoo/zoo/examples``, ``apps/``, ``docs/``)
+— the de-facto public API a migrating user's scripts contain. Every one
+must import (resolving to the rebuild's implementation or a
+migration-pointing callable — never a bare ModuleNotFoundError).
+"""
+
+import numpy as np
+import pytest
+
+_REFERENCE_IMPORTS = [
+    # orca core
+    "from zoo.orca import init_orca_context, stop_orca_context",
+    "from zoo.orca import OrcaContext",
+    "from zoo.orca.data import XShards, SharedValue",
+    "import zoo.orca.data.pandas",
+    "from zoo.orca.data.image.parquet_dataset import read_parquet, write_parquet",
+    # orca estimators (all fabrics)
+    "from zoo.orca.learn.tf.estimator import Estimator",
+    "from zoo.orca.learn.tf2 import Estimator",
+    "from zoo.orca.learn.pytorch import Estimator",
+    "from zoo.orca.learn.bigdl import Estimator",
+    "from zoo.orca.learn.openvino import Estimator",
+    "from zoo.orca.learn.metrics import Accuracy",
+    "from zoo.orca.learn.metrics import MSE",
+    "from zoo.orca.learn.trigger import EveryEpoch",
+    # orca automl
+    "from zoo.orca.automl import hp",
+    "from zoo.orca.automl.auto_estimator import AutoEstimator",
+    "from zoo.orca.automl.xgboost import AutoXGBRegressor",
+    "from zoo.orca.automl.xgboost import AutoXGBClassifier",
+    "from zoo.orca.automl.pytorch_utils import LR_NAME",
+    # legacy automl
+    "from zoo.automl.common.metrics import Evaluator",
+    "from zoo.automl.recipe.base import Recipe",
+    # chronos (modern + legacy zouwu surfaces)
+    "from zoo.chronos.data import TSDataset",
+    "from zoo.chronos.autots.forecast import AutoTSTrainer, TSPipeline",
+    "from zoo.chronos.config.recipe import LSTMGridRandomRecipe",
+    "from zoo.chronos.model.forecast.lstm_forecaster import LSTMForecaster",
+    "from zoo.chronos.model.forecast.tcn_forecaster import TCNForecaster",
+    "from zoo.chronos.model.forecast.mtnet_forecaster import MTNetForecaster",
+    "from zoo.chronos.model.forecast.tcmf_forecaster import TCMFForecaster",
+    "from zoo.chronos.model.anomaly import DBScanDetector",
+    "from zoo.chronos.preprocessing.utils import train_val_test_split",
+    "from zoo.chronos.regression.time_sequence_predictor import "
+    "TimeSequencePredictor",
+    "from zoo.chronos.pipeline.time_sequence import load_ts_pipeline",
+    # keras facade
+    "from zoo.pipeline.api.keras.models import Sequential, Model",
+    "from zoo.pipeline.api.keras.layers import Dense, Input, Flatten",
+    "from zoo.pipeline.api.keras.layers import Mul, SparseDense, "
+    "SparseEmbedding",
+    "from zoo.pipeline.api.keras.objectives import "
+    "SparseCategoricalCrossEntropy",
+    "from zoo.pipeline.api.keras.metrics import Top1Accuracy",
+    "from zoo.pipeline.api.keras.optimizers import Adam",
+    # torch / tf compat
+    "from zoo.pipeline.api.torch import TorchModel, TorchLoss, TorchOptim",
+    "from zoo.tfpark import TFDataset, TFOptimizer, TFPredictor",
+    "from zoo.tfpark import KerasModel, TFEstimator, ZooOptimizer, TFNet",
+    "from zoo.tfpark.estimator import TFEstimator",
+    "from zoo.tfpark.gan.gan_estimator import GANEstimator",
+    "from zoo.tfpark.text.estimator import BERTClassifier, bert_input_fn",
+    "from zoo.tfpark.text.keras import NER",
+    "from zoo.util.tf import export_tf",
+    "from zoo.util.utils import detect_conda_env_name",
+    # nnframes / feature
+    "from zoo.pipeline.nnframes import NNEstimator, NNClassifier, "
+    "NNImageReader",
+    "from zoo.feature.common import ChainedPreprocessing, FeatureSet",
+    "from zoo.feature.image import ImageSet",
+    "from zoo.feature.image3d.transformation import Rotate3D, Crop3D",
+    "from zoo.feature.text import TextSet, DistributedTextSet",
+    "from zoo.models.textmatching import KNRM",
+    "from zoo.models.anomalydetection import AnomalyDetector",
+    # serving / inference / misc
+    "from zoo.pipeline.inference import InferenceModel",
+    "from zoo.serving.client import InputQueue, OutputQueue",
+    "from zoo.serving.client import http_response_to_ndarray",
+    "from zoo.common import Sample, convert_to_safe_path",
+    "from zoo.common.nncontext import init_nncontext",
+    "from zoo.ray import RayContext",
+    "from zoo import init_nncontext",
+    "from zoo.orca.learn.mxnet import Estimator, create_config",
+]
+
+
+@pytest.mark.parametrize("stmt", _REFERENCE_IMPORTS,
+                         ids=[s[:60] for s in _REFERENCE_IMPORTS])
+def test_reference_import_resolves(stmt):
+    exec(stmt, {})
+
+
+def test_legacy_autots_trainer_end_to_end(orca_ctx):
+    """The zouwu-era pandas API searches and forecasts end-to-end."""
+    import pandas as pd
+
+    from zoo.chronos.autots.forecast import AutoTSTrainer
+    from zoo.chronos.config.recipe import SmokeRecipe
+    from zoo.chronos.preprocessing.utils import train_val_test_split
+
+    t = np.arange(300)
+    df = pd.DataFrame({
+        "datetime": pd.date_range("2020-01-01", periods=300, freq="h"),
+        "value": np.sin(t / 8).astype(np.float32),
+    })
+    train_df, _, test_df = train_val_test_split(
+        df, val_ratio=0, test_ratio=0.2, look_back=8)
+    trainer = AutoTSTrainer(horizon=1, dt_col="datetime",
+                            target_col="value")
+    ppl = trainer.fit(train_df, recipe=SmokeRecipe())
+    pred = ppl.predict(test_df)
+    assert np.isfinite(np.asarray(pred)).all()
+    res = ppl.evaluate(test_df, metrics=["mse"])
+    assert np.isfinite(res["mse"])
+
+
+def test_evaluator_and_preprocessing_utils():
+    from zoo.automl.common.metrics import Evaluator
+
+    assert Evaluator.evaluate("mse", [1.0, 2.0], [1.0, 2.0]) == 0.0
+    raw = Evaluator.evaluate("mae", np.ones((4, 2)), np.zeros((4, 2)),
+                             multioutput="raw_values")
+    np.testing.assert_allclose(raw, [1.0, 1.0])
+
+
+def test_torch_model_compat_traces_and_predicts(orca_ctx):
+    import torch
+
+    from zoo.pipeline.api.torch import TorchModel, TorchOptim
+
+    net = torch.nn.Sequential(torch.nn.Linear(4, 8), torch.nn.ReLU(),
+                              torch.nn.Linear(8, 2))
+    zmodel = TorchModel.from_pytorch(net, input_shape=(1, 4))
+    x = np.random.RandomState(0).randn(6, 4).astype(np.float32)
+    preds = np.asarray(zmodel.predict(x))
+    assert preds.shape == (6, 2)
+    opt = TorchOptim.from_pytorch(
+        torch.optim.SGD(net.parameters(), lr=0.05, momentum=0.9))
+    assert type(opt).__name__ == "SGD"
+
+
+def test_compat_layers_train(orca_ctx):
+    """Mul / SparseDense participate in a real fit."""
+    from zoo.pipeline.api.keras.layers import Dense, Mul
+    from zoo.pipeline.api.keras.models import Sequential
+    from zoo.pipeline.api.keras.objectives import MeanSquaredError
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(128, 4).astype(np.float32)
+    y = (3.0 * x.sum(axis=1, keepdims=True)).astype(np.float32)
+    m = Sequential()
+    m.add(Mul(input_shape=(4,)))
+    m.add(Dense(1))
+    m.compile(optimizer="adam", loss=MeanSquaredError())
+    h = m.fit(x, y, batch_size=32, nb_epoch=4, verbose=0)
+    assert h["loss"][-1] < h["loss"][0]

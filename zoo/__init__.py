@@ -17,11 +17,64 @@ import importlib.util
 import sys
 
 
+# Reference module paths whose implementation lives under a DIFFERENT
+# zoo_tpu name (pure renames — the TPU-native layout regrouped them).
+# Longest-prefix match; the remainder of the path is appended.
+_ALIASES = {
+    # chronos: the reference's model/ tree is forecaster/ + detector/
+    "zoo.chronos.model.forecast": "zoo_tpu.chronos.forecaster",
+    "zoo.chronos.model.anomaly": "zoo_tpu.chronos.detector.anomaly",
+    "zoo.chronos.model": "zoo_tpu.chronos.forecaster",
+    # legacy zouwu-era chronos API
+    "zoo.chronos.autots.forecast": "zoo_tpu.chronos.legacy.forecast",
+    "zoo.chronos.config.recipe": "zoo_tpu.chronos.legacy.recipe",
+    "zoo.chronos.config": "zoo_tpu.chronos.legacy",
+    "zoo.chronos.pipeline.time_sequence":
+        "zoo_tpu.chronos.legacy.time_sequence",
+    "zoo.chronos.pipeline": "zoo_tpu.chronos.legacy",
+    "zoo.chronos.regression.time_sequence_predictor":
+        "zoo_tpu.chronos.legacy.time_sequence",
+    "zoo.chronos.regression": "zoo_tpu.chronos.legacy",
+    "zoo.chronos.preprocessing.utils":
+        "zoo_tpu.chronos.legacy.preprocessing",
+    "zoo.chronos.preprocessing": "zoo_tpu.chronos.legacy",
+    # model zoo regroupings
+    "zoo.models.textmatching": "zoo_tpu.models.ranking",
+    # (zoo.feature.image3d.transformation resolves through the default
+    # prefix rewrite — zoo_tpu/feature/image3d/transformation.py)
+    # orca estimator fabrics collapsed onto the XLA fabric
+    "zoo.orca.learn.tf.estimator": "zoo_tpu.orca.learn.tf2.estimator",
+    "zoo.orca.learn.tf": "zoo_tpu.orca.learn.tf2",
+    "zoo.orca.learn.bigdl.estimator":
+        "zoo_tpu.orca.learn.keras.estimator",
+    "zoo.orca.learn.bigdl": "zoo_tpu.orca.learn.keras",
+    "zoo.orca.learn.openvino.estimator":
+        "zoo_tpu.orca.learn.inference.estimator",
+    "zoo.orca.learn.openvino": "zoo_tpu.orca.learn.inference",
+    "zoo.orca.learn.metrics": "zoo_tpu.pipeline.api.keras.metrics",
+    # orca data
+    "zoo.orca.data.image.parquet_dataset":
+        "zoo_tpu.orca.data.parquet_dataset",
+    "zoo.orca.data.image": "zoo_tpu.orca.data",
+}
+
+
+def _real_name(fullname):
+    best = None
+    for old in _ALIASES:
+        if fullname == old or fullname.startswith(old + "."):
+            if best is None or len(old) > len(best):
+                best = old
+    if best is not None:
+        return _ALIASES[best] + fullname[len(best):]
+    return "zoo_tpu." + fullname[len("zoo."):]
+
+
 class _ZooForwarder(importlib.abc.MetaPathFinder, importlib.abc.Loader):
     def find_spec(self, fullname, path=None, target=None):
         if not fullname.startswith("zoo."):
             return None
-        real = "zoo_tpu." + fullname[len("zoo."):]
+        real = _real_name(fullname)
         try:
             real_spec = importlib.util.find_spec(real)
         except ModuleNotFoundError:
@@ -34,8 +87,7 @@ class _ZooForwarder(importlib.abc.MetaPathFinder, importlib.abc.Loader):
 
     def create_module(self, spec):
         # the forwarded module IS the zoo_tpu module (identity, not copy)
-        module = importlib.import_module(
-            "zoo_tpu." + spec.name[len("zoo."):])
+        module = importlib.import_module(_real_name(spec.name))
         # the import machinery will overwrite the module's metadata with
         # the zoo-named spec; stash the real values to restore after
         self._stash = {a: getattr(module, a, None)

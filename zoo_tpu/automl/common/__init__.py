@@ -1,0 +1,1 @@
+"""Reference ``zoo.automl.common`` compat (``pyzoo/zoo/automl/common``)."""

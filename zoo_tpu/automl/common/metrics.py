@@ -1,0 +1,29 @@
+"""Reference ``zoo.automl.common.metrics`` (``automl/common/metrics.py``):
+the ``Evaluate``/``Evaluator`` metric dispatch used by legacy AutoML
+user code. Shares the forecaster metric table."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from zoo_tpu.chronos.forecaster.base import _EVAL_FNS as _METRICS
+
+
+class Evaluator:
+    """reference ``metrics.py`` ``Evaluator.evaluate(metric, y, yhat)``."""
+
+    @staticmethod
+    def evaluate(metric: str, y_true, y_pred, multioutput=None):
+        metric = metric.lower()
+        if metric not in _METRICS:
+            raise ValueError(
+                f"unknown metric {metric!r}; choose from "
+                f"{sorted(_METRICS)}")
+        y_true = np.asarray(y_true, np.float64)
+        y_pred = np.asarray(y_pred, np.float64)
+        if multioutput == "raw_values" and y_true.ndim > 1:
+            flat_t = y_true.reshape(-1, y_true.shape[-1])
+            flat_p = y_pred.reshape(-1, y_pred.shape[-1])
+            return np.asarray([_METRICS[metric](flat_t[:, i], flat_p[:, i])
+                               for i in range(flat_t.shape[-1])])
+        return _METRICS[metric](y_true.ravel(), y_pred.ravel())

@@ -1,0 +1,1 @@
+"""Reference ``zoo.automl.recipe`` compat."""

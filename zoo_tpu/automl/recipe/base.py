@@ -1,0 +1,5 @@
+"""Reference ``zoo.automl.recipe.base`` — the Recipe base class (the
+chronos recipes subclass it; ``chronos/config/recipe.py`` imports it
+from here in the reference layout)."""
+
+from zoo_tpu.chronos.legacy.recipe import Recipe  # noqa: F401

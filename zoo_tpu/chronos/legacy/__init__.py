@@ -1,0 +1,29 @@
+"""Zouwu-era Chronos API (the reference's pre-TSDataset surface):
+``AutoTSTrainer``/``TSPipeline`` over raw pandas DataFrames
+(``pyzoo/zoo/chronos/autots/forecast.py:22``), the ``Recipe`` search
+configs (``chronos/config/recipe.py``), ``TimeSequencePredictor``
+(``chronos/regression/time_sequence_predictor.py``) and the
+``train_val_test_split`` preprocessing util. All adapt onto the
+TSDataset + AutoTSEstimator stack; reference imports resolve through
+the ``zoo`` forwarder's alias table.
+"""
+
+from zoo_tpu.chronos.legacy.forecast import (  # noqa: F401
+    AutoTSTrainer,
+    TSPipeline,
+)
+from zoo_tpu.chronos.legacy.preprocessing import (  # noqa: F401
+    train_val_test_split,
+)
+from zoo_tpu.chronos.legacy.recipe import (  # noqa: F401
+    GridRandomRecipe,
+    LSTMGridRandomRecipe,
+    Recipe,
+    RandomRecipe,
+    SmokeRecipe,
+    TCNGridRandomRecipe,
+)
+from zoo_tpu.chronos.legacy.time_sequence import (  # noqa: F401
+    TimeSequencePredictor,
+    load_ts_pipeline,
+)

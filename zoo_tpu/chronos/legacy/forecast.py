@@ -1,0 +1,83 @@
+"""Legacy AutoTS API (reference ``chronos/autots/forecast.py``):
+``AutoTSTrainer.fit(train_df) -> TSPipeline`` over raw pandas frames.
+Thin adapter over ``TimeSequencePredictor`` exactly like the reference
+(``forecast.py:22`` wraps its ``TimeSequencePredictor`` the same way)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+from zoo_tpu.chronos.legacy.time_sequence import TimeSequencePredictor
+
+
+class AutoTSTrainer:
+    """reference ``forecast.py:22``."""
+
+    def __init__(self, horizon: int = 1, dt_col: str = "datetime",
+                 target_col: Union[str, Sequence[str]] = "value",
+                 logs_dir: str = "~/zoo_automl_logs",
+                 extra_features_col: Optional[Sequence[str]] = None,
+                 search_alg: Optional[str] = None,
+                 search_alg_params=None,
+                 scheduler: Optional[str] = None, scheduler_params=None,
+                 name: str = "automl"):
+        self.internal = TimeSequencePredictor(
+            dt_col=dt_col, target_col=target_col, future_seq_len=horizon,
+            extra_features_col=extra_features_col, logs_dir=logs_dir,
+            search_alg=search_alg, search_alg_params=search_alg_params,
+            scheduler=scheduler, scheduler_params=scheduler_params,
+            name=name)
+
+    def fit(self, train_df, validation_df=None, metric: str = "mse",
+            recipe=None, uncertainty: bool = False, upload_dir=None):
+        if uncertainty:
+            raise NotImplementedError(
+                "uncertainty=True (MC dropout sigma) is not carried by "
+                "the TPU rebuild's forecasters; run multiple predicts "
+                "with training=True dropout for an MC estimate")
+        inner = self.internal.fit(train_df, validation_df, metric=metric,
+                                  recipe=recipe, upload_dir=upload_dir)
+        ppl = TSPipeline()
+        ppl.internal = inner
+        ppl._to_ds = self.internal._to_ds
+        return ppl
+
+
+class TSPipeline:
+    """reference ``forecast.py:95`` — the legacy pipeline accepts raw
+    pandas frames; ``.internal`` is the modern TSDataset-based pipeline
+    (``chronos.autots.autotsestimator.TSPipeline``)."""
+
+    def __init__(self):
+        self.internal = None
+        self._to_ds = None
+
+    def _adapt(self, df):
+        from zoo_tpu.chronos.data.tsdataset import TSDataset
+        if isinstance(df, TSDataset) or self._to_ds is None:
+            return df
+        return self._to_ds(df)
+
+    def fit(self, input_df, validation_df=None, epochs=1, batch_size=32):
+        self.internal.fit(self._adapt(input_df), epochs=epochs,
+                          batch_size=batch_size)
+        return self
+
+    def predict(self, input_df):
+        return self.internal.predict(self._adapt(input_df))
+
+    def evaluate(self, input_df, metrics=("mse",), multioutput=None):
+        return self.internal.evaluate(self._adapt(input_df),
+                                      metrics=metrics)
+
+    def save(self, pipeline_file: str):
+        self.internal.save(pipeline_file)
+
+    @staticmethod
+    def load(pipeline_file: str):
+        from zoo_tpu.chronos.autots.autotsestimator import (
+            TSPipeline as _Modern,
+        )
+        ppl = TSPipeline()
+        ppl.internal = _Modern.load(pipeline_file)
+        return ppl

@@ -1,0 +1,27 @@
+"""Legacy preprocessing utils (reference
+``chronos/preprocessing/utils.py``)."""
+
+from __future__ import annotations
+
+
+def train_val_test_split(df, val_ratio=0, test_ratio=0.1, look_back=0,
+                         horizon=1):
+    """Split a time-ordered DataFrame into train/val/test in timeline
+    order (reference ``utils.py:18``): the val/test splits are extended
+    backwards by ``look_back + horizon - 1`` rows so their first rolled
+    window is fully covered."""
+    total = len(df)
+    n_val = int(total * val_ratio)
+    n_test = int(total * test_ratio)
+    n_train = total - n_val - n_test
+    lookback_ext = look_back + horizon - 1
+    train_df = df.iloc[:n_train]
+    val_df = df.iloc[max(0, n_train - lookback_ext):n_train + n_val]
+    test_df = df.iloc[max(0, n_train + n_val - lookback_ext):]
+    if n_val == 0:
+        val_df = val_df.iloc[0:0]
+    if n_test == 0:
+        test_df = test_df.iloc[0:0]
+    return (train_df.reset_index(drop=True),
+            val_df.reset_index(drop=True),
+            test_df.reset_index(drop=True))

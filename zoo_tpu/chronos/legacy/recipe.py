@@ -1,0 +1,112 @@
+"""Legacy search recipes (reference ``chronos/config/recipe.py``):
+bundles of search space + runtime budget consumed by ``AutoTSTrainer``.
+Spaces use the ``zoo_tpu.automl.hp`` samplers and carry only the keys
+the AutoTSEstimator forecaster builders consume (hidden_dim/layer_num/
+dropout/lr for LSTM; num_channels/kernel_size for TCN; past_seq_len
+everywhere). The reference's per-layer unit grids collapse onto
+``hidden_dim`` — one width knob per trial — and ``batch_size`` is a
+trainer argument here, not a searched dimension."""
+
+from __future__ import annotations
+
+from zoo_tpu.automl import hp
+
+
+class Recipe:
+    """Base (reference ``chronos/config/recipe.py`` ``Recipe``): a
+    ``search_space()`` plus ``num_samples`` random draws and an
+    ``epochs`` budget per trial."""
+
+    num_samples = 1
+    model = "lstm"
+    epochs = 2
+
+    def search_space(self):
+        raise NotImplementedError
+
+
+def _look_back_space(look_back):
+    if isinstance(look_back, (tuple, list)):
+        lo, hi = int(look_back[0]), int(look_back[1])
+        return hp.randint(lo, hi + 1)
+    return int(look_back)
+
+
+class SmokeRecipe(Recipe):
+    """Quick sanity search (reference ``SmokeRecipe``)."""
+
+    def __init__(self):
+        self.num_samples = 1
+        self.epochs = 1
+
+    def search_space(self):
+        return {"hidden_dim": hp.choice([16, 32]),
+                "layer_num": 1,
+                "lr": hp.uniform(0.001, 0.01),
+                "past_seq_len": 2}
+
+
+class LSTMGridRandomRecipe(Recipe):
+    """reference ``LSTMGridRandomRecipe``: grid over the layer width,
+    random over dropout/lr/lookback."""
+
+    def __init__(self, num_rand_samples=1, epochs=5,
+                 training_iteration=10, look_back=2,
+                 lstm_units=(16, 32, 64)):
+        self.num_samples = num_rand_samples
+        self.epochs = epochs
+        self.training_iteration = training_iteration
+        self._space = {
+            "hidden_dim": hp.grid_search(list(lstm_units)),
+            "layer_num": 2,
+            "dropout": hp.uniform(0.2, 0.5),
+            "lr": hp.uniform(0.001, 0.01),
+            "past_seq_len": _look_back_space(look_back),
+        }
+
+    def search_space(self):
+        return dict(self._space)
+
+
+class TCNGridRandomRecipe(Recipe):
+    """TCN flavor of the grid+random recipe."""
+
+    model = "tcn"
+
+    def __init__(self, num_rand_samples=1, epochs=5, look_back=12,
+                 hidden_units=(16, 32), levels=(2, 3),
+                 kernel_size=(2, 3)):
+        self.num_samples = num_rand_samples
+        self.epochs = epochs
+        self._space = {
+            "num_channels": hp.choice(
+                [[u] * lv for u in hidden_units for lv in levels]),
+            "kernel_size": hp.choice(list(kernel_size)),
+            "lr": hp.uniform(0.001, 0.01),
+            "past_seq_len": _look_back_space(look_back),
+        }
+
+    def search_space(self):
+        return dict(self._space)
+
+
+class GridRandomRecipe(LSTMGridRandomRecipe):
+    """reference ``GridRandomRecipe`` (generic name, LSTM space)."""
+
+
+class RandomRecipe(Recipe):
+    """reference ``RandomRecipe``: pure random sampling."""
+
+    def __init__(self, num_rand_samples=1, epochs=5, look_back=2):
+        self.num_samples = num_rand_samples
+        self.epochs = epochs
+        self._space = {
+            "hidden_dim": hp.choice([16, 32, 64]),
+            "layer_num": hp.randint(1, 3),
+            "dropout": hp.uniform(0.2, 0.5),
+            "lr": hp.uniform(0.001, 0.01),
+            "past_seq_len": _look_back_space(look_back),
+        }
+
+    def search_space(self):
+        return dict(self._space)

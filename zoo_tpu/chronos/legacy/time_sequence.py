@@ -1,0 +1,69 @@
+"""Legacy ``TimeSequencePredictor`` (reference
+``chronos/regression/time_sequence_predictor.py``) and
+``load_ts_pipeline`` (``chronos/pipeline/time_sequence.py``): the
+zouwu-era pandas-in/pipeline-out AutoML entry, adapted onto
+TSDataset + AutoTSEstimator."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+
+class TimeSequencePredictor:
+    """reference ``time_sequence_predictor.py`` — ``fit(train_df)``
+    searches forecaster hyperparameters and returns a fitted pipeline."""
+
+    def __init__(self, dt_col: str = "datetime",
+                 target_col: Union[str, Sequence[str]] = "value",
+                 future_seq_len: int = 1,
+                 extra_features_col: Optional[Sequence[str]] = None,
+                 logs_dir: str = "~/zoo_automl_logs",
+                 search_alg: Optional[str] = None,
+                 search_alg_params=None, scheduler: Optional[str] = None,
+                 scheduler_params=None, name: str = "automl"):
+        self.dt_col = dt_col
+        self.target_col = ([target_col] if isinstance(target_col, str)
+                           else list(target_col))
+        self.future_seq_len = future_seq_len
+        self.extra_features_col = (list(extra_features_col)
+                                   if extra_features_col else None)
+        self.search_alg = search_alg
+        self.scheduler = scheduler
+        self.name = name
+
+    def _to_ds(self, df):
+        from zoo_tpu.chronos.data.tsdataset import TSDataset
+
+        if df is None or isinstance(df, TSDataset):
+            return df
+        return TSDataset.from_pandas(
+            df, dt_col=self.dt_col, target_col=self.target_col,
+            extra_feature_col=self.extra_features_col, with_split=False)
+
+    def fit(self, train_df, validation_df=None, metric: str = "mse",
+            recipe=None, mc: bool = False, upload_dir=None):
+        from zoo_tpu.chronos.autots.autotsestimator import AutoTSEstimator
+        from zoo_tpu.chronos.legacy.recipe import SmokeRecipe
+
+        recipe = recipe or SmokeRecipe()
+        space = recipe.search_space()
+        past_seq_len = space.pop("past_seq_len", 24)
+
+        est = AutoTSEstimator(
+            model=getattr(recipe, "model", "lstm"), search_space=space,
+            past_seq_len=past_seq_len,
+            future_seq_len=self.future_seq_len, metric=metric,
+            name=self.name)
+        return est.fit(self._to_ds(train_df),
+                       validation_data=self._to_ds(validation_df),
+                       epochs=getattr(recipe, "epochs", 2),
+                       n_sampling=getattr(recipe, "num_samples", 1),
+                       search_alg=self.search_alg,
+                       scheduler=self.scheduler)
+
+
+def load_ts_pipeline(path: str):
+    """reference ``chronos/pipeline/time_sequence.py``
+    ``load_ts_pipeline`` — restore a saved pipeline."""
+    from zoo_tpu.chronos.autots.autotsestimator import TSPipeline
+    return TSPipeline.load(path)

@@ -4,6 +4,25 @@ from zoo_tpu.common.nncontext import (  # noqa: F401 — reference re-export
     init_spark_on_local,
     init_spark_on_yarn,
 )
+from zoo_tpu.util.utils import convert_to_safe_path  # noqa: F401
+
+
+class Sample:
+    """reference ``zoo.common.Sample`` (the BigDL sample record): a
+    (features, labels) pair of ndarrays. The rebuild's estimators take
+    arrays/XShards directly; this record type keeps reference user code
+    constructing Samples importable."""
+
+    def __init__(self, features, labels=None):
+        import numpy as np
+        self.features = np.asarray(features)
+        self.labels = None if labels is None else np.asarray(labels)
+
+    @classmethod
+    def from_ndarray(cls, features, labels=None):
+        return cls(features, labels)
+
 
 __all__ = ["ZooContext", "RuntimeContext", "get_runtime_context",
-           "init_nncontext", "init_spark_on_local", "init_spark_on_yarn"]
+           "init_nncontext", "init_spark_on_local", "init_spark_on_yarn",
+           "Sample", "convert_to_safe_path"]

@@ -192,3 +192,32 @@ class Relations:
         tb = pq.read_table(path).to_pydict()
         return [Relation(str(a), str(b), int(c)) for a, b, c in
                 zip(tb["id1"], tb["id2"], tb["label"])]
+
+
+class FeatureSet:
+    """reference ``zoo.feature.common.FeatureSet`` (Scala
+    ``feature/FeatureSet.scala:52`` — the tiered training-sample cache).
+    The capability lives in ``zoo_tpu.orca.data.cache`` (TieredSampleCache
+    / CachedDataset + DoubleBufferedIterator feed); this name adapts the
+    reference's ``FeatureSet.rdd/ndarrays(...).cache()`` construction."""
+
+    def __init__(self, dataset):
+        self.dataset = dataset
+
+    @staticmethod
+    def ndarrays(arrays, memory_type: str = "DRAM"):
+        from zoo_tpu.orca.data.cache import CachedDataset
+
+        # the reference's PMEM/DIRECT tiers (Optane / off-heap) have no
+        # TPU-host analog; both mean "bigger than DRAM", which the cache
+        # models as a DISK_n spill budget
+        store = memory_type.upper()
+        if store in ("PMEM", "DIRECT"):
+            store = "DISK_2"
+        return FeatureSet(CachedDataset(arrays, store=store))
+
+    def cache(self):
+        return self
+
+    def __iter__(self):
+        return iter(self.dataset)

@@ -1,0 +1,11 @@
+"""Reference import path ``zoo.feature.image3d.transformation``
+(``pyzoo/zoo/feature/image3d/transformation.py``) — the 3D transforms
+live in the package root here."""
+
+from zoo_tpu.feature.image3d import (  # noqa: F401
+    AffineTransform3D,
+    CenterCrop3D,
+    Crop3D,
+    RandomCrop3D,
+    Rotate3D,
+)

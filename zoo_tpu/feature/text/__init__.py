@@ -24,7 +24,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 __all__ = [
-    "TextFeature", "TextSet", "LocalTextSet", "load_glove_matrix",
+    "TextFeature", "TextSet", "LocalTextSet", "DistributedTextSet",
+    "load_glove_matrix",
 ]
 
 
@@ -285,6 +286,13 @@ class LocalTextSet(TextSet):
             features = [TextFeature(t, None if labels is None else labels[i])
                         for i, t in enumerate(texts or [])]
         super().__init__(list(features))
+
+
+class DistributedTextSet(LocalTextSet):
+    """reference ``DistributedTextSet`` (RDD-backed there). The rebuild
+    processes text shard-wise per host; the distributed/local split is a
+    placement detail, so this IS the local set under the reference's
+    other name."""
 
 
 def load_glove_matrix(path: str, word_index: Dict[str, int],

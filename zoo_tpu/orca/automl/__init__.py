@@ -1,3 +1,4 @@
+from zoo_tpu.automl import hp  # noqa: F401  (reference: zoo.orca.automl.hp)
 from zoo_tpu.orca.automl.auto_estimator import AutoEstimator
 
-__all__ = ["AutoEstimator"]
+__all__ = ["AutoEstimator", "hp"]

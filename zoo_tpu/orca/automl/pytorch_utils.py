@@ -1,0 +1,5 @@
+"""Reference ``zoo.orca.automl.pytorch_utils`` — the hyperparameter
+key constants legacy model creators read from trial configs."""
+
+LR_NAME = "lr"
+DEFAULT_LR = 1e-3

@@ -111,13 +111,18 @@ class AutoXGBoost:
 
     def __init__(self, task: str = "regression",
                  metric: Optional[str] = None,
-                 n_parallel: int = 1):
+                 n_parallel: int = 1,
+                 fixed_config: Optional[Dict] = None):
         self.task = task
         self.metric = metric or ("mse" if task == "regression"
                                  else "accuracy")
         self.mode = "min" if self.metric in ("mse", "mae", "logloss") \
             else "max"
         self.n_parallel = n_parallel
+        # reference: AutoXGB ctor kwargs like n_estimators/tree_method/
+        # random_state are FIXED model params shared by every trial; the
+        # searched space overrides them per-trial
+        self.fixed_config = dict(fixed_config or {})
         self.best_model = None
         self.best_config: Optional[Dict] = None
 
@@ -131,7 +136,7 @@ class AutoXGBoost:
                else XGBoostClassifier)
 
         def trial(cfg: Dict) -> Dict:
-            model = cls(config=cfg)
+            model = cls(config={**self.fixed_config, **cfg})
             model.fit(x, y)
             res = model.evaluate(vx, vy, metrics=(self.metric,))
             res["_model"] = model
@@ -159,3 +164,34 @@ class AutoXGBoost:
 
     def get_best_model(self):
         return self.best_model
+
+
+_AUTOXGB_INFRA_KWARGS = ("cpus_per_trial", "name", "logs_dir",
+                         "remote_dir")
+
+
+def _split_xgb_kwargs(kwargs: Dict) -> Dict:
+    """Reference AutoXGB ctors mix infra args (dropped here) with fixed
+    XGBoost params (forwarded into every trial's config)."""
+    return {k: v for k, v in kwargs.items()
+            if k not in _AUTOXGB_INFRA_KWARGS}
+
+
+class AutoXGBRegressor(AutoXGBoost):
+    """reference ``auto_xgb.AutoXGBRegressor`` — task pinned; extra
+    kwargs become fixed per-trial XGBoost params."""
+
+    def __init__(self, metric=None, n_parallel: int = 1, **xgb_params):
+        super().__init__(task="regression", metric=metric,
+                         n_parallel=n_parallel,
+                         fixed_config=_split_xgb_kwargs(xgb_params))
+
+
+class AutoXGBClassifier(AutoXGBoost):
+    """reference ``auto_xgb.AutoXGBClassifier`` — task pinned; extra
+    kwargs become fixed per-trial XGBoost params."""
+
+    def __init__(self, metric=None, n_parallel: int = 1, **xgb_params):
+        super().__init__(task="classification", metric=metric,
+                         n_parallel=n_parallel,
+                         fixed_config=_split_xgb_kwargs(xgb_params))

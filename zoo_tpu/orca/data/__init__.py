@@ -1,4 +1,14 @@
 from zoo_tpu.orca.data.shard import XShards, LocalXShards
 from zoo_tpu.orca.data.plane import rebalance_shards
 
-__all__ = ["XShards", "LocalXShards", "rebalance_shards"]
+
+class SharedValue:
+    """reference ``orca/data/utils.py`` ``SharedValue`` — a broadcast
+    handle (Spark Broadcast there). One process-space here: it simply
+    carries ``.value``."""
+
+    def __init__(self, value=None):
+        self.value = value
+
+
+__all__ = ["XShards", "LocalXShards", "rebalance_shards", "SharedValue"]

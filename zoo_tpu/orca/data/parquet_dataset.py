@@ -207,3 +207,32 @@ def write_ndarrays(images: np.ndarray, labels: np.ndarray,
 
     ParquetDataset.write(output_path, gen(),
                          {"image": "ndarray", "label": "scalar"}, **kwargs)
+
+
+def write_parquet(format: str, output_path: str, *args, **kwargs):
+    """reference ``orca/data/image/parquet_dataset.py`` ``write_parquet``
+    — format-dispatching writer ("ndarray" arrays, "image_folder" a
+    class-subdir tree)."""
+    if format in ("ndarray", "ndarrays"):
+        return write_ndarrays(*args, output_path=output_path, **kwargs)
+    if format in ("image_folder", "voc", "directory"):
+        return write_from_directory(*args, output_path=output_path,
+                                    **kwargs)
+    raise ValueError(f"unknown format {format!r}; use 'ndarray' or "
+                     "'image_folder'")
+
+
+def read_parquet(format: str, path: str, **kwargs):
+    """reference ``read_parquet`` — "tf"/"torch" loaders collapse onto
+    the framework-neutral array/batched readers here."""
+    if format in ("arrays", "numpy"):
+        return ParquetDataset.read_as_arrays(path)
+    if format == "batched":
+        return ParquetDataset.read_batched(path, **kwargs)
+    if format in ("xshards", "shards"):
+        return ParquetDataset.read_as_xshards(path, **kwargs)
+    if format in ("tf", "torch"):
+        # the reference returns tf.data / torch datasets; the rebuild's
+        # estimators consume arrays or XShards directly
+        return ParquetDataset.read_as_arrays(path)
+    raise ValueError(f"unknown format {format!r}")

@@ -13,3 +13,16 @@ class Estimator:
             "MXNet has no TPU backend. Port the model to a supported "
             "frontend: orca.learn.pytorch Estimator.from_torch traces "
             "any torch module; gluon models usually translate 1:1")
+
+
+def create_config(log_interval=10, optimizer="sgd",
+                  optimizer_params=None, seed=None, **extra_config):
+    """reference ``mxnet/utils.py`` ``create_config`` — builds the
+    trainer config dict MXNet estimators consumed. Kept so reference
+    scripts reach the redirect above with their config intact."""
+    config = {"log_interval": log_interval, "optimizer": optimizer,
+              "optimizer_params": optimizer_params or {}}
+    if seed is not None:
+        config["seed"] = seed
+    config.update(extra_config)
+    return config

@@ -400,6 +400,7 @@ class KerasNet:
         compute. Only used for datasets small enough that the permuted
         gather copy is cheap (fit caps it at 256MB)."""
         step = self._make_step_fn()
+        mesh = self._mesh()
 
         def epoch_fn(params, opt_state, rng, *args):
             if gather:
@@ -411,6 +412,14 @@ class KerasNet:
                 # dataset in HBM for nothing — reshape is free
                 stacked = [a[:k * bs].reshape((k, bs) + a.shape[1:])
                            for a in args]
+            if mesh is not None and mesh.size > 1:
+                # multi-device: pin the per-step batch dim onto the data
+                # axes (the _put_stacked layout) so the scanned steps run
+                # sharded instead of replicated
+                from zoo_tpu.parallel.mesh import stacked_batch_sharding
+                stacked = [jax.lax.with_sharding_constraint(
+                    a, stacked_batch_sharding(mesh, a.ndim))
+                    for a in stacked]
             return _scan_steps(step, params, opt_state, rng, stacked)
 
         return jax.jit(epoch_fn, donate_argnums=(0, 1, 2))
@@ -585,7 +594,6 @@ class KerasNet:
         # permuted-copy HBM cost; the even-division requirement avoids a
         # ragged tail batch forcing a second compile.
         use_epoch = (device_resident and pc == 1
-                     and (mesh is None or mesh.size == 1)
                      and prof is None and not interposed
                      and n % local_bs == 0 and n_batches >= 2
                      and sum(a.nbytes for a in arrs) <= (256 << 20))
@@ -607,7 +615,10 @@ class KerasNet:
             loss_sum, n_steps = None, 0
             if use_epoch:
                 kk = n // local_bs
-                key = (kk, local_bs, bool(shuffle))
+                # mesh identity in the key: the built closure bakes the
+                # mesh in (sharding constraint), so a context change must
+                # not reuse a stale-mesh epoch fn
+                key = (kk, local_bs, bool(shuffle), id(mesh))
                 je = self._jit_epoch_cache.get(key)
                 if je is None:
                     je = self._jit_epoch_cache[key] = \

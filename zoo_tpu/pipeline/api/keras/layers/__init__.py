@@ -67,6 +67,13 @@ from zoo_tpu.pipeline.api.keras.layers.extras import (  # noqa: F401
     Power, RReLU, ResizeBilinear, Scale, Select, SoftShrink, Sqrt, Square,
     Squeeze, Threshold, WithinChannelLRN2D,
 )
+from zoo_tpu.pipeline.api.keras.layers.compat_extras import (  # noqa: F401
+    KerasLayerWrapper,
+    Mul,
+    SparseDense,
+    SparseEmbedding,
+)
+from zoo_tpu.pipeline.api.keras.engine.topology import Input  # noqa: F401
 from zoo_tpu.pipeline.api.keras.layers.conv_extras import (  # noqa: F401
     DepthwiseConvolution2D,
     AtrousConvolution1D, AtrousConvolution2D, AveragePooling3D, ConvLSTM2D,
@@ -103,4 +110,5 @@ __all__ = [
     "LocallyConnected2D", "MaxPooling3D", "SeparableConvolution2D",
     "ShareConvolution2D", "SpatialDropout3D", "UpSampling3D",
     "WordEmbedding", "ZeroPadding3D",
+    "Input", "KerasLayerWrapper", "Mul", "SparseDense", "SparseEmbedding",
 ]

@@ -1,0 +1,67 @@
+"""Reference layer names without a distinct TPU-native mechanism
+(``pyzoo/zoo/pipeline/api/keras/layers/core.py:365`` ``SparseDense``,
+``embeddings.py:166`` ``SparseEmbedding``, ``torch.py:395`` ``Mul``,
+``wrappers.py:86`` ``KerasLayerWrapper``). Sparse*: the JVM fabric used
+sparse tensors to skip useless gradInput work; XLA consumes dense
+minibatches, so these ARE Dense/Embedding with the reference's extra
+arguments accepted (wide&deep-style callers keep working).
+``KerasLayerWrapper`` adapts one tf.keras layer through the structural
+keras bridge."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from zoo_tpu.pipeline.api.keras.engine.base import Layer
+from zoo_tpu.pipeline.api.keras.layers.core import Dense, Embedding
+
+
+class SparseDense(Dense):
+    """reference ``SparseDense`` — Dense over (the densified form of) a
+    sparse input; ``backward_start``/``backward_length`` gated partial
+    backprop on the JVM and are accepted and ignored here (autodiff
+    through a dense minibatch has no such cost cliff)."""
+
+    def __init__(self, output_dim, backward_start=-1, backward_length=-1,
+                 **kwargs):
+        super().__init__(output_dim, **kwargs)
+
+
+class SparseEmbedding(Embedding):
+    """reference ``SparseEmbedding`` — Embedding whose JVM twin consumed
+    SparseTensor ids; ids here are dense int arrays already."""
+
+
+class Mul(Layer):
+    """reference ``torch.py:395`` ``Mul`` — multiply the input by ONE
+    learned scalar."""
+
+    def build(self, rng, input_shape):
+        return {"w": jnp.ones((1,), jnp.float32)}
+
+    def call(self, params, x, *, training=False, rng=None):
+        return x * params["w"].astype(x.dtype)
+
+    def compute_output_shape(self, input_shape):
+        return input_shape
+
+
+class KerasLayerWrapper:
+    """reference ``wrappers.py:86`` — wrap a single tf.keras layer as a
+    zoo layer by converting it through the structural keras bridge."""
+
+    def __new__(cls, keras_layer, input_shape=None, **kwargs):
+        import tensorflow as tf
+
+        from zoo_tpu.bridges.keras_bridge import convert_keras_model
+
+        if input_shape is None:
+            raise ValueError("KerasLayerWrapper needs input_shape")
+        km = tf.keras.Sequential(
+            [tf.keras.Input(shape=tuple(input_shape)), keras_layer])
+        zmodel = convert_keras_model(km)
+        # a single-layer conversion yields one zoo layer; return it
+        layers = getattr(zmodel, "layers", None)
+        if layers and len(layers) == 1:
+            return layers[0]
+        return zmodel

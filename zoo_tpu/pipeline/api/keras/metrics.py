@@ -141,3 +141,8 @@ def get_metric(identifier: Union[str, Metric]) -> Metric:
     if key not in _ALIASES:
         raise ValueError(f"unknown metric: {identifier}")
     return _ALIASES[key]()
+
+
+# reference validation-method names (``orca/learn/metrics.py:19-340``
+# compiled Metric classes to these BigDL ValidationMethods)
+Top1Accuracy = Accuracy

@@ -168,3 +168,38 @@ def get_loss(identifier: Union[str, Callable]) -> Callable:
     if key not in _ALIASES:
         raise ValueError(f"unknown loss: {identifier}")
     return _ALIASES[key]
+
+
+class LossFunction:
+    """reference class-style objective base (``objectives.py``): the
+    class names below instantiate to the plain loss callables above —
+    ``compile(loss=SparseCategoricalCrossEntropy())`` works like
+    ``compile(loss="sparse_categorical_crossentropy")``."""
+
+    _fn = None
+
+    def __new__(cls, *args, **kwargs):
+        if cls._fn is None:
+            raise TypeError("LossFunction is abstract")
+        return cls._fn
+
+
+def _loss_class(name, fn):
+    return type(name, (LossFunction,), {"_fn": staticmethod(fn),
+                                        "__doc__": fn.__doc__})
+
+
+SparseCategoricalCrossEntropy = _loss_class(
+    "SparseCategoricalCrossEntropy", sparse_categorical_crossentropy)
+CategoricalCrossEntropy = _loss_class(
+    "CategoricalCrossEntropy", categorical_crossentropy)
+BinaryCrossEntropy = _loss_class("BinaryCrossEntropy",
+                                 binary_crossentropy)
+MeanSquaredError = _loss_class("MeanSquaredError", mean_squared_error)
+MeanAbsoluteError = _loss_class("MeanAbsoluteError", mean_absolute_error)
+Hinge = _loss_class("Hinge", hinge)
+SquaredHinge = _loss_class("SquaredHinge", squared_hinge)
+KullbackLeiblerDivergence = _loss_class("KullbackLeiblerDivergence",
+                                        kullback_leibler_divergence)
+Poisson = _loss_class("Poisson", poisson)
+CosineProximity = _loss_class("CosineProximity", cosine_proximity)

@@ -1,0 +1,66 @@
+"""Reference ``zoo.pipeline.api.torch`` compat
+(``pyzoo/zoo/pipeline/api/torch/torch_model.py:36`` ``TorchModel``,
+``torch_criterion.py`` ``TorchLoss``, ``torch_optim.py`` ``TorchOptim``
+— the jep path shipping pickled torch modules into executor JVMs).
+
+The rebuild ingests torch natively through ``torch.export`` tracing
+(``bridges/fx_bridge.py``): ``TorchModel.from_pytorch`` returns a zoo
+model that trains/predicts on TPU, ``TorchLoss.from_pytorch`` wraps a
+torch loss callable for the Orca torch estimator, and ``TorchOptim``
+maps torch optimizer configs onto the keras-facade optimizers.
+"""
+
+from __future__ import annotations
+
+
+class TorchModel:
+    """reference ``torch_model.py:36``."""
+
+    @staticmethod
+    def from_pytorch(module, example_inputs=None, input_shape=None):
+        """Trace a torch ``nn.Module`` into a TPU-trainable zoo model.
+        Provide ``example_inputs`` (preferred) or an ``input_shape``
+        from which a float example is synthesized."""
+        import torch
+
+        from zoo_tpu.pipeline.api.net import Net
+
+        if example_inputs is None:
+            if input_shape is None:
+                raise ValueError(
+                    "from_pytorch needs example_inputs=[tensor,...] or "
+                    "input_shape=(...) to trace the module")
+            example_inputs = [torch.randn(*input_shape)]
+        return Net.load_torch(module, example_inputs)
+
+
+class TorchLoss:
+    """reference ``torch_criterion.py`` — wraps a torch loss for the
+    Orca torch estimator (which consumes torch callables directly)."""
+
+    @staticmethod
+    def from_pytorch(criterion):
+        return criterion
+
+
+class TorchOptim:
+    """reference ``torch_optim.py`` — torch optimizer spec → the
+    keras-facade optimizer the traced model trains with."""
+
+    @staticmethod
+    def from_pytorch(optimizer):
+        import torch
+
+        from zoo_tpu.pipeline.api.keras import optimizers as zopt
+
+        lr = optimizer.param_groups[0].get("lr", 1e-3) \
+            if hasattr(optimizer, "param_groups") else 1e-3
+        if isinstance(optimizer, torch.optim.SGD):
+            mom = optimizer.param_groups[0].get("momentum", 0.0)
+            return zopt.SGD(lr=lr, momentum=mom)
+        if isinstance(optimizer, torch.optim.AdamW):
+            wd = optimizer.param_groups[0].get("weight_decay", 0.01)
+            return zopt.AdamWeightDecay(lr=lr, weight_decay=wd)
+        if isinstance(optimizer, torch.optim.RMSprop):
+            return zopt.RMSprop(lr=lr)
+        return zopt.Adam(lr=lr)

@@ -1,0 +1,80 @@
+"""Reference ``zoo.ray`` compat (``pyzoo/zoo/ray/raycontext.py:323``
+``RayContext`` — RayOnSpark boots Ray raylets inside Spark executors).
+
+The TPU rebuild has no Spark executors to nest Ray into: its worker
+fabric is the supervised multi-process bootstrap
+(``zoo_tpu.orca.bootstrap`` — ProcessMonitor, restart budgets, orphan
+kill), and SPMD workers rendezvous through ``jax.distributed``. This
+``RayContext`` keeps reference scripts importable and maps the two
+lifecycle calls onto that fabric; if a real Ray install is present,
+``init`` simply starts/connects a local Ray instead, so Ray-Tune-style
+user code keeps working where ray is available.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class RayContext:
+    """reference ``raycontext.py:323``."""
+
+    _active: Optional["RayContext"] = None
+
+    def __init__(self, sc=None, redis_port=None, password=None,
+                 object_store_memory=None, verbose=False, env=None,
+                 extra_params=None, num_ray_nodes=None,
+                 ray_node_cpu_cores=None, **_ignored):
+        self.sc = sc
+        self.object_store_memory = object_store_memory
+        self.num_ray_nodes = num_ray_nodes
+        self.initialized = False
+        RayContext._active = self
+
+    @classmethod
+    def get(cls, initialize: bool = True) -> "RayContext":
+        ctx = cls._active or cls()
+        if initialize and not ctx.initialized:
+            ctx.init()
+        return ctx
+
+    def init(self, driver_cores: int = 0):
+        try:
+            import ray
+        except ImportError as e:
+            raise RuntimeError(
+                "RayContext.init: no ray in this environment. The TPU "
+                "rebuild's cluster fabric is the supervised bootstrap "
+                "(zoo_tpu.orca.bootstrap.launch_local_cluster / "
+                "scripts/run_tpu_pod.sh) and AutoML runs on the local "
+                "search engine (zoo_tpu.automl.search) — "
+                "init_orca_context() alone is enough for those. Install "
+                "ray only if your own code calls ray.* APIs directly."
+            ) from e
+        if not ray.is_initialized():  # pragma: no cover - needs ray
+            kwargs = {}
+            if self.object_store_memory:
+                kwargs["object_store_memory"] = _to_bytes(
+                    self.object_store_memory)
+            ray.init(**kwargs)
+        self.initialized = True
+        return self
+
+    def stop(self):
+        if self.initialized:  # pragma: no cover - needs ray
+            import ray
+            ray.shutdown()
+            self.initialized = False
+
+
+def _to_bytes(mem) -> int:
+    if isinstance(mem, int):
+        return mem
+    s = str(mem).lower().strip()
+    mult = 1
+    for suffix, m in (("g", 1 << 30), ("m", 1 << 20), ("k", 1 << 10),
+                      ("b", 1)):
+        if s.endswith(suffix):
+            s, mult = s[:-len(suffix)], m
+            break
+    return int(float(s) * mult)

@@ -259,3 +259,22 @@ class OutputQueue(API):
         if val == "NaN":
             return val
         return decode_ndarray_b64(val)
+
+
+def http_response_to_ndarray(response) -> np.ndarray:
+    """reference ``serving/client.py`` — decode a frontend ``/predict``
+    HTTP response (requests.Response or raw JSON text) to ndarray."""
+    import json
+
+    text = getattr(response, "text", response)
+    body = json.loads(text) if isinstance(text, str) else text
+    if isinstance(body, dict):
+        for key in ("predictions", "prediction", "result", "value"):
+            if key in body:
+                body = body[key]
+                break
+    if isinstance(body, list) and body and isinstance(body[0], str):
+        return np.stack([decode_ndarray_b64(b) for b in body])
+    if isinstance(body, str):
+        return decode_ndarray_b64(body)
+    return np.asarray(body)

@@ -16,8 +16,13 @@ from zoo_tpu.tfpark.compat import (  # noqa: F401
     KerasModel,
     TFDataset,
     TFEstimator,
+    TFNet,
+    TFOptimizer,
     TFParkMigrationError,
+    TFPredictor,
+    ZooOptimizer,
 )
 
 __all__ = ["KerasModel", "TFDataset", "TFEstimator", "GANEstimator",
+           "TFNet", "TFOptimizer", "TFPredictor", "ZooOptimizer",
            "TFParkMigrationError"]

@@ -297,3 +297,81 @@ class TFEstimator:
     @classmethod
     def from_model_fn(cls, *args, **kwargs):
         raise TFParkMigrationError(cls._MSG)
+
+
+class TFNet:
+    """``zoo.tfpark.TFNet`` — reference ``tfpark/tfnet.py`` (frozen-graph
+    inference as a layer). Delegates to the GraphDef→JAX interpreter."""
+
+    @staticmethod
+    def from_export_folder(folder: str):
+        from zoo_tpu.pipeline.api.net import Net
+        return Net.load_tf(folder)
+
+    @staticmethod
+    def from_session(sess, inputs, outputs, generate_backward=False):
+        import tempfile
+
+        from zoo_tpu.pipeline.api.net import Net
+        from zoo_tpu.util.tf import export_tf
+
+        folder = tempfile.mkdtemp(prefix="zoo_tfnet_")
+        export_tf(sess, folder, inputs=inputs, outputs=outputs)
+        return Net.load_tf(folder)
+
+
+class ZooOptimizer:
+    """``zoo.tfpark.ZooOptimizer`` — reference ``zoo_optimizer.py``
+    wrapped a tf.train.Optimizer to tag gradients for the JVM fabric.
+    No JVM fabric here: it is the identity on the wrapped optimizer so
+    reference model-building code keeps running."""
+
+    def __new__(cls, optimizer, *args, **kwargs):
+        return optimizer
+
+
+class TFOptimizer:
+    """``zoo.tfpark.TFOptimizer`` — reference ``tf_optimizer.py:350``
+    drove exported TF1 graphs through BigDL. Mechanism-less here."""
+
+    _MSG = ("TFOptimizer exported TF1 session graphs to the JVM fabric "
+            "— migrate training to zoo.orca.learn.tf2.Estimator or the "
+            "keras facade (zoo.pipeline.api.keras); see "
+            "docs/migration.md")
+
+    def __init__(self, *args, **kwargs):
+        raise TFParkMigrationError(self._MSG)
+
+    @classmethod
+    def from_train_op(cls, *a, **k):
+        raise TFParkMigrationError(cls._MSG)
+
+    @classmethod
+    def from_loss(cls, *a, **k):
+        raise TFParkMigrationError(cls._MSG)
+
+    @classmethod
+    def from_keras(cls, *a, **k):
+        raise TFParkMigrationError(cls._MSG)
+
+
+class TFPredictor:
+    """``zoo.tfpark.TFPredictor`` — reference ``tf_predictor.py`` ran
+    TF1 session fetches distributed. Frozen graphs predict through
+    TFNet/InferenceModel instead."""
+
+    _MSG = ("TFPredictor ran TF1 session fetches on the JVM — export "
+            "the graph and predict through zoo.tfpark.TFNet"
+            ".from_export_folder or zoo.pipeline.inference"
+            ".InferenceModel; see docs/migration.md")
+
+    def __init__(self, *args, **kwargs):
+        raise TFParkMigrationError(self._MSG)
+
+    @classmethod
+    def from_outputs(cls, *a, **k):
+        raise TFParkMigrationError(cls._MSG)
+
+    @classmethod
+    def from_keras(cls, *a, **k):
+        raise TFParkMigrationError(cls._MSG)

@@ -1,0 +1,3 @@
+"""Reference import path ``zoo.tfpark.estimator`` (``tfpark/estimator.py:30``)."""
+
+from zoo_tpu.tfpark.compat import TFEstimator  # noqa: F401

@@ -1,0 +1,3 @@
+"""Reference import path ``zoo.tfpark.gan`` (``tfpark/gan/``)."""
+
+from zoo_tpu.tfpark.gan.gan_estimator import GANEstimator  # noqa: F401

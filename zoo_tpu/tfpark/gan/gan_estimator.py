@@ -1,0 +1,5 @@
+"""Reference import path ``zoo.tfpark.gan.gan_estimator``
+(``tfpark/gan/gan_estimator.py``) — the real implementation is the
+orca GAN estimator (single-jit alternating G/D steps)."""
+
+from zoo_tpu.orca.learn.gan import GANEstimator  # noqa: F401

@@ -1,0 +1,90 @@
+"""Reference import path ``zoo.tfpark.text.estimator``
+(``tfpark/text/estimator/`` — BERTClassifier/BERTNER/BERTSQuAD over the
+TF1 estimator fabric). The TF1 ``model_fn`` fabric does not exist here;
+BERT fine-tuning runs natively on the keras-facade ``BERT`` layer (the
+bench's headline model). These adapters keep the reference's class names
+importable: ``BERTClassifier`` builds that native fine-tune model, and
+``bert_input_fn`` materializes the feature dicts it consumes."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class BERTClassifier:
+    """reference ``bert_classifier.py:64`` — ``num_classes`` +
+    checkpoint-dir ctor, ``train/evaluate/predict`` over input fns.
+    Here: a keras-facade BERT classifier (CLS-token head) with the same
+    train surface; pretrained TF checkpoint loading goes through the
+    keras bridge, not TF1 init hooks."""
+
+    def __init__(self, num_classes: int, bert_config_file=None,
+                 init_checkpoint=None, use_one_hot_embeddings=False,
+                 optimizer=None, model_dir=None,
+                 vocab: int = 30522, hidden_size: int = 768,
+                 n_block: int = 12, n_head: int = 12,
+                 seq_len: int = 128):
+        from zoo_tpu.pipeline.api.keras import Sequential
+        from zoo_tpu.pipeline.api.keras.layers import BERT, Dense, Lambda
+        from zoo_tpu.pipeline.api.keras.optimizers import AdamWeightDecay
+
+        if init_checkpoint is not None:
+            raise NotImplementedError(
+                "TF1 BERT checkpoint init is not wired; convert the "
+                "checkpoint to a keras model and use "
+                "bridges.keras_bridge, or fine-tune from scratch")
+        m = Sequential()
+        m.add(BERT(vocab=vocab, hidden_size=hidden_size, n_block=n_block,
+                   n_head=n_head, seq_len=seq_len,
+                   intermediate_size=4 * hidden_size,
+                   max_position_len=max(seq_len, 512),
+                   input_shape=(seq_len,)))
+        m.add(Lambda(lambda h: h[:, 0], output_shape=(hidden_size,)))
+        m.add(Dense(num_classes, activation="softmax"))
+        m.compile(optimizer=optimizer or AdamWeightDecay(lr=2e-5),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+        self.model = m
+        self.seq_len = seq_len
+
+    def train(self, input_fn, steps=None, batch_size: int = 32,
+              epochs: int = 1):
+        x, y = _materialize(input_fn)
+        return self.model.fit(x, y, batch_size=batch_size,
+                              nb_epoch=epochs, verbose=0)
+
+    def evaluate(self, input_fn, eval_methods=("accuracy",),
+                 batch_size: int = 32):
+        x, y = _materialize(input_fn)
+        return self.model.evaluate(x, y, batch_size=batch_size)
+
+    def predict(self, input_fn, batch_size: int = 32):
+        x, _ = _materialize(input_fn)
+        return np.asarray(self.model.predict(x, batch_size=batch_size))
+
+
+def bert_input_fn(data, max_seq_length: int, batch_size: int,
+                  features_key: str = "input_ids", labels=None, **_):
+    """reference ``bert_base.py:52`` built TF feed dicts from an RDD;
+    here it normalizes (dict | (x, y) | ndarray) into the arrays the
+    classifier consumes, returned as a thunk for API parity."""
+    def fn():
+        if isinstance(data, dict):
+            x = np.asarray(data[features_key])
+            y = np.asarray(data["label"]) if "label" in data else labels
+        elif isinstance(data, tuple):
+            x, y = np.asarray(data[0]), np.asarray(data[1])
+        else:
+            x, y = np.asarray(data), labels
+        if x.shape[-1] != max_seq_length:
+            raise ValueError(f"sequence length {x.shape[-1]} != "
+                             f"max_seq_length {max_seq_length}")
+        return x, y
+    return fn
+
+
+def _materialize(input_fn):
+    out = input_fn() if callable(input_fn) else input_fn
+    if isinstance(out, tuple):
+        return out
+    return out, None

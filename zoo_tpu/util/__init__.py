@@ -1,0 +1,3 @@
+"""Reference ``zoo.util`` compat package (``pyzoo/zoo/util``): TF graph
+utilities and environment helpers the reference's example/app scripts
+import. Each delegates onto the rebuild's real implementation."""

@@ -1,0 +1,40 @@
+"""Reference ``zoo.util.tf`` (``pyzoo/zoo/util/tf.py``): TF-graph
+export helpers. The rebuild ingests TF models through the GraphDef→JAX
+interpreter (``bridges/tf_graph.py``), so ``export_tf`` — "strip a TF1
+session's graph to an inference subgraph and save it" — maps to saving
+a SavedModel/frozen graph that ``Net.load_tf`` can consume."""
+
+from __future__ import annotations
+
+
+def export_tf(sess=None, folder: str = None, inputs=None, outputs=None,
+              generate_backward: bool = False,
+              allow_non_differentiable_input: bool = True):
+    """reference ``util/tf.py:50``. With a live TF1 session: freeze the
+    relevant subgraph to ``folder`` via TF's own utilities; the result
+    loads here through ``Net.load_tf(folder)``."""
+    try:
+        import tensorflow as tf
+    except ImportError as e:  # pragma: no cover - tf ships in the image
+        raise RuntimeError(
+            "export_tf needs tensorflow to freeze the session graph; "
+            "for models already saved, pass the SavedModel/frozen-graph "
+            "path straight to zoo_tpu.pipeline.api.net.Net.load_tf") from e
+    if sess is None or folder is None or not inputs or not outputs:
+        raise ValueError("export_tf(sess, folder, inputs, outputs) all "
+                         "required")
+    graph_def = tf.compat.v1.graph_util.convert_variables_to_constants(
+        sess, sess.graph_def,
+        [t.name.split(":")[0] for t in outputs])
+    tf.io.write_graph(graph_def, folder, "frozen_inference_graph.pb",
+                      as_text=False)
+    with open(f"{folder}/graph_meta.txt", "w") as f:
+        f.write("inputs: " + ",".join(t.name for t in inputs) + "\n")
+        f.write("outputs: " + ",".join(t.name for t in outputs) + "\n")
+    return folder
+
+
+def process_grad(grad):
+    """reference ``util/tf.py:28`` tagged gradients for train_op
+    discovery — meaningless without the TF1-on-JVM fabric; identity."""
+    return grad

@@ -143,6 +143,39 @@ def test_torch_model_compat_traces_and_predicts(orca_ctx):
     assert type(opt).__name__ == "SGD"
 
 
+def test_tfnet_from_export_folder(orca_ctx, tmp_path):
+    """zoo.tfpark.TFNet delegates frozen-graph loading to the GraphDef
+    interpreter and predicts."""
+    import tensorflow as tf
+
+    from zoo.tfpark import TFNet
+
+    m = tf.keras.Sequential([
+        tf.keras.Input(shape=(4,)),
+        tf.keras.layers.Dense(3, activation="relu"),
+    ])
+    d = str(tmp_path / "sm")
+    tf.saved_model.save(m, d)
+    net = TFNet.from_export_folder(d)
+    x = np.random.RandomState(0).randn(5, 4).astype(np.float32)
+    out = np.asarray(net.predict(x)) if hasattr(net, "predict") \
+        else np.asarray(net(x))
+    assert out.shape == (5, 3)
+
+
+def test_keras_layer_wrapper_and_zoo_optimizer(orca_ctx):
+    import tensorflow as tf
+
+    from zoo.pipeline.api.keras.layers import KerasLayerWrapper
+    from zoo.tfpark import ZooOptimizer
+
+    layer = KerasLayerWrapper(tf.keras.layers.Dense(3), input_shape=(4,))
+    assert layer is not None
+    # ZooOptimizer is the identity on the wrapped optimizer
+    opt = object()
+    assert ZooOptimizer(opt) is opt
+
+
 def test_compat_layers_train(orca_ctx):
     """Mul / SparseDense participate in a real fit."""
     from zoo.pipeline.api.keras.layers import Dense, Mul

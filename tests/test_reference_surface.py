@@ -143,6 +143,28 @@ def test_torch_model_compat_traces_and_predicts(orca_ctx):
     assert type(opt).__name__ == "SGD"
 
 
+def test_estimator_from_bigdl_and_from_graph(orca_ctx):
+    """The aliased bigdl/tf estimator factories behave: from_bigdl
+    compiles+wraps (BigDL models here ARE keras-facade models);
+    from_graph raises a migration-pointing error, never AttributeError."""
+    from zoo.orca.learn.bigdl import Estimator as BigdlEstimator
+    from zoo.orca.learn.tf.estimator import Estimator as TFEstimator
+    from zoo.pipeline.api.keras.layers import Dense
+    from zoo.pipeline.api.keras.models import Sequential
+
+    m = Sequential()
+    m.add(Dense(1, input_shape=(4,)))
+    est = BigdlEstimator.from_bigdl(model=m, loss="mse", optimizer="sgd")
+    rs = np.random.RandomState(0)
+    data = {"x": rs.randn(64, 4).astype(np.float32),
+            "y": rs.randn(64, 1).astype(np.float32)}
+    h = est.fit(data, epochs=1, batch_size=32)
+    assert np.isfinite(h["loss"][0])
+
+    with pytest.raises(NotImplementedError, match="from_graph"):
+        TFEstimator.from_graph(inputs=None, outputs=None)
+
+
 def test_tfnet_from_export_folder(orca_ctx, tmp_path):
     """zoo.tfpark.TFNet delegates frozen-graph loading to the GraphDef
     interpreter and predicts."""

@@ -35,6 +35,23 @@ class Estimator:
         return KerasEstimator(model, model_dir=model_dir,
                               max_ckpt_to_keep=max_ckpt_to_keep)
 
+    @staticmethod
+    def from_bigdl(*, model, loss=None, optimizer=None, metrics=None,
+                   feature_preprocessing=None, label_preprocessing=None,
+                   model_dir: Optional[str] = None) -> "KerasEstimator":
+        """reference ``Estimator.from_bigdl(model=..., loss=...,
+        optimizer=...)`` — "BigDL models" here ARE the keras-facade
+        models, so this compiles the given pieces and wraps the result
+        exactly like ``from_keras``."""
+        if loss is not None or optimizer is not None \
+                or metrics is not None:
+            model.compile(optimizer=optimizer or "adam",
+                          loss=loss or "mse", metrics=metrics)
+        elif model.loss_fn is None:
+            raise ValueError("from_bigdl: pass loss=/optimizer= or a "
+                             "compiled model")
+        return KerasEstimator(model, model_dir=model_dir)
+
 
 class KerasEstimator:
     def __init__(self, model, model_dir: Optional[str] = None,

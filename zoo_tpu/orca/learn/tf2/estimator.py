@@ -110,6 +110,24 @@ def _convert_metrics(kmodel) -> list:
 
 class Estimator:
     @staticmethod
+    def from_graph(*, inputs=None, outputs=None, labels=None, loss=None,
+                   optimizer=None, metrics=None, updates=None,
+                   sess=None, model_dir=None, **_):
+        """reference ``orca/learn/tf/estimator.py:291`` — TF1 graph
+        tensors driven by the JVM fabric. No TF1 session mechanism here;
+        this import path (``zoo.orca.learn.tf.estimator``) aliases the
+        TF2/keras-creator estimator, so raise with the working route."""
+        raise NotImplementedError(
+            "Estimator.from_graph drove TF1 session graphs (placeholder "
+            "inputs + train_op) on the JVM fabric, which does not exist "
+            "in the TPU rebuild. Either: (a) freeze the graph and load "
+            "it for inference via zoo.tfpark.TFNet.from_export_folder / "
+            "InferenceModel, or (b) port training to "
+            "Estimator.from_keras(model_creator=...) (tf.keras model "
+            "converted through the structural bridge). See "
+            "docs/migration.md.")
+
+    @staticmethod
     def from_keras(*, model_creator: Callable,
                    config: Optional[dict] = None,
                    model_dir: Optional[str] = None,

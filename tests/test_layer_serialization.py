@@ -26,6 +26,9 @@ SPEC = {
     "Activation": (lambda: L.Activation("relu"), (6,), "f"),
     "BatchNormalization": (lambda: L.BatchNormalization(), (6,), "f"),
     "Dense": (lambda: L.Dense(4), (6,), "f"),
+    "SparseDense": (lambda: L.SparseDense(4), (6,), "f"),
+    "SparseEmbedding": (lambda: L.SparseEmbedding(10, 4), (5,), "i"),
+    "Mul": (lambda: L.Mul(), (6,), "f"),
     "Dropout": (lambda: L.Dropout(0.5), (6,), "f"),
     "Embedding": (lambda: L.Embedding(10, 4), (5,), "i"),
     "Flatten": (lambda: L.Flatten(), (2, 3), "f"),
@@ -156,6 +159,10 @@ SKIP = {
     "GaussianSampler": "two-input VAE sampler — covered below",
     "Convolution1D": "alias of Conv1D",
     "Convolution2D": "alias of Conv2D",
+    "Input": "tensor factory function, not a layer",
+    "KerasLayerWrapper": "tf.keras-layer conversion factory (returns a "
+                         "bridged layer; covered by the keras bridge "
+                         "tests)",
 }
 
 

@@ -45,6 +45,31 @@ class OrcaContext(ZooContext):
     aliased here so user code reads ``from zoo_tpu.orca import OrcaContext``
     exactly like the reference."""
 
+    # reference ``barrier_mode`` gated Spark barrier-scheduling for the
+    # RayOnSpark bootstrap (``raycontext.py:565``); the supervised
+    # bootstrap here always gang-launches, so the flag is accepted and
+    # inert (kept for reference user code that sets it)
+    barrier_mode = True
+
+    @staticmethod
+    def get_ray_context():
+        """reference ``OrcaContext.get_ray_context`` — the active
+        RayContext (a lifecycle shim here; see ``zoo_tpu.ray``)."""
+        from zoo_tpu.ray import RayContext
+        return RayContext.get(initialize=False)
+
+    @staticmethod
+    def get_spark_context():
+        raise RuntimeError(
+            "no SparkContext exists in the TPU rebuild (no JVM); Spark "
+            "DataFrames enter through the gated ingestion "
+            "(zoo_tpu.orca.data.spark) and everything else is "
+            "XShards/numpy — see docs/migration.md")
+
+    @staticmethod
+    def get_spark_session():
+        OrcaContext.get_spark_context()
+
 
 _DIST_INITIALIZED = False
 

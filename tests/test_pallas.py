@@ -189,3 +189,53 @@ def test_flash_gqa_rejects_bad_head_ratio():
     kv = jnp.zeros((1, 2, 16, 8))
     with pytest.raises(ValueError, match="multiple"):
         flash_attention(q, kv, kv, interpret=True)
+
+
+def test_fused_bottleneck_matches_xla():
+    """The fused bottleneck kernel (interpret mode on CPU) matches the
+    XLA conv composition; the no-fit geometry falls back cleanly."""
+    from zoo_tpu.ops.pallas.fused_block import (
+        _pick_k,
+        _xla_block,
+        fused_bottleneck,
+    )
+
+    rs = np.random.RandomState(0)
+    b, h, w, cin, cmid = 4, 8, 8, 32, 16
+    x = jnp.asarray(rs.randn(b, h, w, cin).astype(np.float32))
+    w1 = jnp.asarray((rs.randn(cin, cmid) / np.sqrt(cin))
+                     .astype(np.float32))
+    w2 = jnp.asarray((rs.randn(3, 3, cmid, cmid) / np.sqrt(9 * cmid))
+                     .astype(np.float32))
+    w3 = jnp.asarray((rs.randn(cmid, cin) / np.sqrt(cmid))
+                     .astype(np.float32))
+
+    ref = np.asarray(_xla_block(x, w1, w2, w3))
+    got = np.asarray(fused_bottleneck(x, w1, w2, w3, interpret=True))
+    # the kernel computes in bf16 with f32 accumulation
+    np.testing.assert_allclose(got, ref, atol=5e-2, rtol=5e-2)
+
+    # package interpret contract: the off-TPU DEFAULT also runs the
+    # (interpreted) kernel, bf16 tolerance — not the XLA fallback
+    fb = np.asarray(fused_bottleneck(x, w1, w2, w3))
+    np.testing.assert_allclose(fb, ref, atol=5e-2, rtol=5e-2)
+
+    # VMEM planner: real geometries fit, absurd ones return 0
+    assert _pick_k(128, 56, 56, 256, 64) >= 1
+    assert _pick_k(128, 112, 112, 2048, 512) == 0
+
+    # interpret mode has no VMEM: the kernel must still run (not the
+    # fallback) even on a geometry the TPU planner rejects
+    b2, h2, w2_, cin2, cmid2 = 2, 12, 12, 2048, 512
+    assert _pick_k(b2, h2, w2_, cin2, cmid2) == 0
+    xb = jnp.asarray(rs.randn(b2, h2, w2_, cin2).astype(np.float32))
+    wb1 = jnp.asarray((rs.randn(cin2, cmid2) / np.sqrt(cin2))
+                      .astype(np.float32))
+    wb2 = jnp.asarray((rs.randn(3, 3, cmid2, cmid2)
+                       / np.sqrt(9 * cmid2)).astype(np.float32))
+    wb3 = jnp.asarray((rs.randn(cmid2, cin2) / np.sqrt(cmid2))
+                      .astype(np.float32))
+    big_ref = np.asarray(_xla_block(xb, wb1, wb2, wb3))
+    big_got = np.asarray(fused_bottleneck(xb, wb1, wb2, wb3,
+                                          interpret=True))
+    np.testing.assert_allclose(big_got, big_ref, atol=8e-2, rtol=8e-2)

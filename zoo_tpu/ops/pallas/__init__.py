@@ -55,8 +55,9 @@ from zoo_tpu.ops.pallas.quant import (  # noqa: E402
     quantize_conv_weights, quantized_conv2d)
 from zoo_tpu.ops.pallas.fused_optim import (  # noqa: E402
     fused_apply_sgd, fused_apply_adam)
+from zoo_tpu.ops.pallas.fused_block import fused_bottleneck  # noqa: E402
 
 __all__ = ["flash_attention", "quantize_int8", "quantized_matmul",
            "quantized_dense", "quantize_conv_weights", "quantized_conv2d",
-           "fused_apply_sgd", "fused_apply_adam",
+           "fused_apply_sgd", "fused_apply_adam", "fused_bottleneck",
            "on_tpu", "resolve_interpret"]

@@ -121,7 +121,12 @@ def test_legacy_autots_trainer_end_to_end(orca_ctx):
 def test_evaluator_and_preprocessing_utils():
     from zoo.automl.common.metrics import Evaluator
 
-    assert Evaluator.evaluate("mse", [1.0, 2.0], [1.0, 2.0]) == 0.0
+    # default multioutput='raw_values' matches the reference's
+    # sklearn-backed return shape: one entry per output column
+    np.testing.assert_allclose(
+        Evaluator.evaluate("mse", [1.0, 2.0], [1.0, 2.0]), [0.0])
+    assert Evaluator.evaluate(
+        "mse", [1.0, 2.0], [1.0, 2.0], multioutput="uniform_average") == 0.0
     raw = Evaluator.evaluate("mae", np.ones((4, 2)), np.zeros((4, 2)),
                              multioutput="raw_values")
     np.testing.assert_allclose(raw, [1.0, 1.0])

@@ -13,7 +13,11 @@ class Evaluator:
     """reference ``metrics.py`` ``Evaluator.evaluate(metric, y, yhat)``."""
 
     @staticmethod
-    def evaluate(metric: str, y_true, y_pred, multioutput=None):
+    def evaluate(metric: str, y_true, y_pred, multioutput="raw_values"):
+        if multioutput not in (None, "uniform_average", "raw_values"):
+            raise ValueError(
+                f"multioutput={multioutput!r}: expected None, "
+                "'uniform_average' or 'raw_values'")
         metric = metric.lower()
         if metric not in _METRICS:
             raise ValueError(
@@ -21,9 +25,15 @@ class Evaluator:
                 f"{sorted(_METRICS)}")
         y_true = np.asarray(y_true, np.float64)
         y_pred = np.asarray(y_pred, np.float64)
-        if multioutput == "raw_values" and y_true.ndim > 1:
-            flat_t = y_true.reshape(-1, y_true.shape[-1])
-            flat_p = y_pred.reshape(-1, y_pred.shape[-1])
-            return np.asarray([_METRICS[metric](flat_t[:, i], flat_p[:, i])
-                               for i in range(flat_t.shape[-1])])
+        if multioutput == "raw_values":
+            # sklearn shape semantics (the reference delegates there):
+            # one entry per output column, a 1-element array for 1-D.
+            if y_true.ndim > 1:
+                flat_t = y_true.reshape(-1, y_true.shape[-1])
+                flat_p = y_pred.reshape(-1, y_pred.shape[-1])
+                return np.asarray(
+                    [_METRICS[metric](flat_t[:, i], flat_p[:, i])
+                     for i in range(flat_t.shape[-1])])
+            return np.asarray(
+                [_METRICS[metric](y_true.ravel(), y_pred.ravel())])
         return _METRICS[metric](y_true.ravel(), y_pred.ravel())

@@ -7,6 +7,8 @@ from __future__ import annotations
 
 from typing import Optional, Sequence, Union
 
+import numpy as np
+
 from zoo_tpu.chronos.legacy.time_sequence import TimeSequencePredictor
 
 
@@ -58,7 +60,21 @@ class TSPipeline:
             return df
         return self._to_ds(df)
 
-    def fit(self, input_df, validation_df=None, epochs=1, batch_size=32):
+    def fit(self, input_df, validation_df=None, uncertainty: bool = False,
+            epochs=1, batch_size=32, **user_config):
+        if uncertainty:
+            raise NotImplementedError(
+                "uncertainty=True (MC dropout sigma) is not carried by "
+                "the TPU rebuild's forecasters; run multiple predicts "
+                "with training=True dropout for an MC estimate")
+        if user_config:
+            # the reference applies these as model-config overrides and
+            # rebuilds; silently dropping them would train with defaults
+            raise NotImplementedError(
+                f"user_config overrides {sorted(user_config)} are not "
+                "applied by the TPU rebuild's incremental fit; re-search "
+                "with AutoTSTrainer.fit(recipe=...) to change "
+                "hyperparameters")
         self.internal.fit(self._adapt(input_df), epochs=epochs,
                           batch_size=batch_size)
         return self
@@ -66,9 +82,27 @@ class TSPipeline:
     def predict(self, input_df):
         return self.internal.predict(self._adapt(input_df))
 
-    def evaluate(self, input_df, metrics=("mse",), multioutput=None):
-        return self.internal.evaluate(self._adapt(input_df),
-                                      metrics=metrics)
+    def evaluate(self, input_df, metrics=("mse",),
+                 multioutput="raw_values"):
+        """reference ``forecast.py`` TSPipeline.evaluate — honors
+        ``multioutput`` by recomputing each metric over the pipeline's
+        own predictions (per-column for ``'raw_values'``)."""
+        if multioutput not in (None, "uniform_average", "raw_values"):
+            raise ValueError(
+                f"multioutput={multioutput!r}: expected None, "
+                "'uniform_average' or 'raw_values'")
+        ds = self._adapt(input_df)
+        if multioutput in (None, "uniform_average"):
+            return self.internal.evaluate(ds, metrics=metrics)
+        from zoo_tpu.automl.common.metrics import Evaluator
+        fc = self.internal.forecaster
+        x, y = fc._unpack(self.internal._rolled(ds))
+        preds = fc.predict((x, None))
+        y = np.asarray(y).reshape(np.asarray(preds).shape)
+        # lowercase keys to match the internal path's compute_metrics
+        return {m.lower(): Evaluator.evaluate(m, y, preds,
+                                              multioutput=multioutput)
+                for m in metrics}
 
     def save(self, pipeline_file: str):
         self.internal.save(pipeline_file)

@@ -617,8 +617,10 @@ class KerasNet:
                 kk = n // local_bs
                 # mesh identity in the key: the built closure bakes the
                 # mesh in (sharding constraint), so a context change must
-                # not reuse a stale-mesh epoch fn
-                key = (kk, local_bs, bool(shuffle), id(mesh))
+                # not reuse a stale-mesh epoch fn. Mesh is value-hashable
+                # (axis names + device array incl. shape), unlike id()
+                # which a GC'd mesh can leak to a new object.
+                key = (kk, local_bs, bool(shuffle), mesh)
                 je = self._jit_epoch_cache.get(key)
                 if je is None:
                     je = self._jit_epoch_cache[key] = \

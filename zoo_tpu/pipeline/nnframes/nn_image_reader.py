@@ -30,13 +30,14 @@ class NNImageReader:
     @staticmethod
     def readImages(path: str, sc=None, minPartitions: int = 1,
                    resizeH: int = -1, resizeW: int = -1,
+                   image_codec: int = -1,
                    with_label: Optional[bool] = None):
         """Read a directory/glob of images into a pandas DataFrame with
         columns ``image`` (HWC BGR uint8 ndarray), ``origin`` (file
         path) and — for a ``path/<class>/*`` layout — ``label``.
 
-        ``sc``/``minPartitions`` are accepted for reference signature
-        compatibility and ignored (no Spark in this process; pass the
+        ``sc``/``minPartitions``/``image_codec`` are accepted for
+        reference signature compatibility and ignored (no Spark in this process; pass the
         DataFrame to ``NNEstimator.fit`` directly). ``with_label=None``
         auto-detects the class-subdirectory layout.
         """

@@ -151,7 +151,7 @@ def test_torch_model_compat_traces_and_predicts(orca_ctx):
 def test_estimator_from_bigdl_and_from_graph(orca_ctx):
     """The aliased bigdl/tf estimator factories behave: from_bigdl
     compiles+wraps (BigDL models here ARE keras-facade models);
-    from_graph raises a migration-pointing error, never AttributeError."""
+    from_graph validates its inputs, never AttributeError."""
     from zoo.orca.learn.bigdl import Estimator as BigdlEstimator
     from zoo.orca.learn.tf.estimator import Estimator as TFEstimator
     from zoo.pipeline.api.keras.layers import Dense
@@ -166,7 +166,9 @@ def test_estimator_from_bigdl_and_from_graph(orca_ctx):
     h = est.fit(data, epochs=1, batch_size=32)
     assert np.isfinite(h["loss"][0])
 
-    with pytest.raises(NotImplementedError, match="from_graph"):
+    # from_graph now trains TF1 graphs (tests/test_tf1_training.py);
+    # calling it without the graph's input placeholders is a clear error
+    with pytest.raises(ValueError, match="inputs"):
         TFEstimator.from_graph(inputs=None, outputs=None)
 
 

@@ -301,6 +301,134 @@ def _range(ctx, n, start, limit, delta):
                       int(np.asarray(delta)))
 
 
+# -- ops that appear in TF1 *training* graphs (loss heads etc.) -----------
+
+@_tf_op("SparseSoftmaxCrossEntropyWithLogits")
+def _sparse_xent(ctx, n, logits, labels):
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    idx = jnp.asarray(labels).astype(jnp.int32)[..., None]
+    loss = -jnp.take_along_axis(lp, idx, axis=-1)[..., 0]
+    # output 1 is TF's precomputed backprop; forward graphs only read
+    # output 0 and jax.grad differentiates the log_softmax form directly
+    return (loss, jnp.zeros_like(logits))
+
+
+@_tf_op("SoftmaxCrossEntropyWithLogits")
+def _xent(ctx, n, logits, labels):
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    loss = -jnp.sum(jnp.asarray(labels) * lp, axis=-1)
+    return (loss, jnp.zeros_like(logits))
+
+
+_tf_op("L2Loss")(lambda ctx, n, x: jnp.sum(jnp.square(x)) * 0.5)
+_tf_op("AddN", "AccumulateNV2")(
+    lambda ctx, n, *xs: sum(xs[1:], start=xs[0]))
+_tf_op("ZerosLike")(lambda ctx, n, x: jnp.zeros_like(x))
+_tf_op("OnesLike")(lambda ctx, n, x: jnp.ones_like(x))
+_tf_op("Log1p")(lambda ctx, n, x: jnp.log1p(x))
+_tf_op("Rank")(lambda ctx, n, x: np.int32(jnp.asarray(x).ndim))
+_tf_op("Size")(lambda ctx, n, x: np.int32(jnp.asarray(x).size))
+
+
+@_tf_op("OneHot")
+def _one_hot(ctx, n, indices, depth, on_value, off_value):
+    axis = n.attr["axis"].i if "axis" in n.attr else -1
+    oh = jax.nn.one_hot(jnp.asarray(indices).astype(jnp.int32),
+                        int(np.asarray(depth)), axis=axis)
+    on = np.asarray(on_value)
+    off = np.asarray(off_value)
+    return oh * (on - off) + off
+
+
+@_tf_op("Tile")
+def _tile(ctx, n, x, multiples):
+    return jnp.tile(x, [int(m) for m in np.asarray(multiples).reshape(-1)])
+
+
+@_tf_op("BroadcastTo")
+def _broadcast_to(ctx, n, x, shape):
+    return jnp.broadcast_to(x, [int(s) for s in
+                                np.asarray(shape).reshape(-1)])
+
+
+# ops with >1 output beyond the FusedBatchNorm/Unpack special cases
+_MULTI_OUT = {"SparseSoftmaxCrossEntropyWithLogits": 2,
+              "SoftmaxCrossEntropyWithLogits": 2}
+
+# stateful mutation ops: never on a forward/loss value path; reaching one
+# means the caller asked for a target behind an assignment
+_STATE_OPS = ("Assign", "AssignVariableOp", "AssignAdd", "AssignSub",
+              "AssignAddVariableOp", "AssignSubVariableOp")
+
+
+def _interpret(nodes: Dict[str, object], env: Dict[str, object],
+               targets: Sequence[str]):
+    """Walk a GraphDef from ``targets`` back to seeds in ``env``
+    (placeholders AND captured variable nodes), computing each node once.
+    The shared core of frozen-graph inference and TF1-graph training."""
+    from tensorflow.python.framework import tensor_util
+
+    def value_of(ref: str):
+        if ref.startswith("^"):
+            return None  # control edge
+        name, _, idx = ref.partition(":")
+        out = compute(name)
+        if idx and int(idx) > 0:
+            return out[int(idx)]
+        return out[0] if isinstance(out, tuple) and n_outputs(name) > 1 \
+            else (out if not isinstance(out, tuple) else out[0])
+
+    def n_outputs(name):
+        node = nodes[name]
+        return 6 if node.op.startswith("FusedBatchNorm") else (
+            node.attr["num"].i if node.op == "Unpack"
+            else _MULTI_OUT.get(node.op, 1))
+
+    def compute(name):
+        if name in env:
+            return env[name]
+        node = nodes[name]
+        if node.op == "Const":
+            val = tensor_util.MakeNdarray(node.attr["value"].tensor)
+            if val.dtype == np.float64:
+                val = val.astype(np.float32)
+            elif val.dtype == np.int64:
+                val = val.astype(np.int32)
+            env[name] = val
+            return val
+        if node.op in ("Placeholder", "PlaceholderWithDefault"):
+            raise ValueError(f"unbound graph input: {name}")
+        if node.op in ("VariableV2", "Variable", "VarHandleOp"):
+            raise ValueError(
+                f"uncaptured variable node {name!r}: pass it through the "
+                "params/frozen dicts (capture_trainable_graph) or freeze "
+                "the graph first")
+        if node.op == "ReadVariableOp":
+            out = value_of(node.input[0])
+            env[name] = out
+            return out
+        if node.op in _STATE_OPS:
+            raise NotImplementedError(
+                f"TF op {node.op} (node {name}) mutates graph state; the "
+                "JAX interpreter is pure — evaluate value tensors, not "
+                "assignment ops (moving-stat updates are captured frozen "
+                "at conversion time)")
+        if node.op == "NoOp":
+            env[name] = None
+            return None
+        fn = _TF_OPS.get(node.op)
+        if fn is None:
+            raise NotImplementedError(
+                f"TF op {node.op} (node {name}) has no JAX mapping in "
+                "zoo_tpu.bridges.tf_graph._TF_OPS")
+        args = [value_of(i) for i in node.input if not i.startswith("^")]
+        out = fn(None, node, *args)
+        env[name] = out
+        return out
+
+    return [value_of(ref) for ref in targets]
+
+
 class TFGraphFunction:
     """A frozen GraphDef interpreted as a pure JAX function."""
 
@@ -312,57 +440,8 @@ class TFGraphFunction:
         self._nodes = {n.name: n for n in graph_def.node}
 
     def __call__(self, *inputs):
-        from tensorflow.python.framework import tensor_util
-
-        env: Dict[str, object] = {}
-        for name, val in zip(self.input_names, inputs):
-            env[name] = val
-
-        def value_of(ref: str):
-            if ref.startswith("^"):
-                return None  # control edge
-            name, _, idx = ref.partition(":")
-            out = compute(name)
-            if idx and int(idx) > 0:
-                return out[int(idx)]
-            return out[0] if isinstance(out, tuple) and n_outputs(name) > 1 \
-                else (out if not isinstance(out, tuple) else out[0])
-
-        def n_outputs(name):
-            node = self._nodes[name]
-            return 6 if node.op.startswith("FusedBatchNorm") else (
-                node.attr["num"].i if node.op == "Unpack" else 1)
-
-        def compute(name):
-            if name in env:
-                return env[name]
-            node = self._nodes[name]
-            if node.op == "Const":
-                val = tensor_util.MakeNdarray(node.attr["value"].tensor)
-                if val.dtype == np.float64:
-                    val = val.astype(np.float32)
-                elif val.dtype == np.int64:
-                    val = val.astype(np.int32)
-                env[name] = val
-                return val
-            if node.op in ("Placeholder", "PlaceholderWithDefault"):
-                raise ValueError(f"unbound graph input: {name}")
-            if node.op == "NoOp":
-                env[name] = None
-                return None
-            fn = _TF_OPS.get(node.op)
-            if fn is None:
-                raise NotImplementedError(
-                    f"TF op {node.op} (node {name}) has no JAX mapping in "
-                    "zoo_tpu.bridges.tf_graph._TF_OPS")
-            args = [value_of(i) for i in node.input if not i.startswith("^")]
-            out = fn(None, node, *args)
-            env[name] = out
-            return out
-
-        results = []
-        for ref in self.output_names:
-            results.append(value_of(ref))
+        env: Dict[str, object] = dict(zip(self.input_names, inputs))
+        results = _interpret(self._nodes, env, self.output_names)
         return results[0] if len(results) == 1 else tuple(results)
 
 
@@ -407,6 +486,230 @@ def load_saved_model(path: str, signature: str = "serving_default",
     out = TFGraphFunction(gd, in_names, out_names)
     out._keepalive = sm  # the loaded object owns the variables
     return out
+
+
+class TrainableTFGraph:
+    """A TF1 graph whose trainable variables are a JAX params pytree.
+
+    The training-side counterpart of :class:`TFGraphFunction` — the
+    mechanism the reference's TFOptimizer/TFTrainingHelper provided by
+    exporting the session graph to the JVM fabric
+    (``pyzoo/zoo/tfpark/tf_optimizer.py:464,514``). Here the graph is
+    interpreted in JAX with variable nodes seeded from a params dict, so
+    ``jax.grad`` of the interpreted loss IS the backward pass — exactly
+    the treatment the ONNX loader gives initializers
+    (``pipeline/api/onnx/onnx_loader.py``).
+
+    ``params``: {variable node name: ndarray} — trainable.
+    ``frozen``: non-trainable globals (BN moving stats, global_step…)
+    captured as constants at conversion time.
+    """
+
+    def __init__(self, graph_def, input_names: List[str],
+                 label_names: List[str], loss_ref: Optional[str],
+                 output_refs: List[str], params: Dict[str, np.ndarray],
+                 frozen: Optional[Dict[str, np.ndarray]] = None,
+                 metric_refs: Optional[Dict[str, str]] = None):
+        self.graph_def = graph_def
+        self.input_names = list(input_names)
+        self.label_names = list(label_names)
+        self.loss_ref = loss_ref
+        self.output_refs = list(output_refs)
+        self.params = {k: np.asarray(v) for k, v in params.items()}
+        self.frozen = {k: np.asarray(v) for k, v in (frozen or {}).items()}
+        self.metric_refs = dict(metric_refs or {})
+        self._nodes = {n.name: n for n in graph_def.node}
+
+    def _env(self, params, inputs, labels=()):
+        env: Dict[str, object] = dict(self.frozen)
+        env.update(params)
+        env.update(zip(self.input_names, inputs))
+        env.update(zip(self.label_names, labels))
+        return env
+
+    def loss_fn(self, params, inputs: Sequence, labels: Sequence = ()):
+        """Scalar loss as a pure function of (params, data) — jittable
+        and differentiable."""
+        if self.loss_ref is None:
+            raise ValueError("graph captured without a loss tensor")
+        out = _interpret(self._nodes, self._env(params, inputs, labels),
+                         [self.loss_ref])[0]
+        return jnp.asarray(out).reshape(())
+
+    def forward(self, params, inputs: Sequence):
+        outs = _interpret(self._nodes, self._env(params, inputs),
+                          self.output_refs)
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    def metrics_fn(self, params, inputs: Sequence, labels: Sequence = ()):
+        if not self.metric_refs:
+            return {}
+        names = list(self.metric_refs)
+        vals = _interpret(self._nodes, self._env(params, inputs, labels),
+                          [self.metric_refs[k] for k in names])
+        return {k: jnp.asarray(v) for k, v in zip(names, vals)}
+
+
+def capture_trainable_graph(*, inputs: Sequence, labels: Sequence = (),
+                            loss=None, outputs: Sequence = (),
+                            metrics: Optional[Dict[str, object]] = None,
+                            sess=None) -> "tuple":
+    """Capture a live TF1 graph (placeholders + variables + loss tensor)
+    into a :class:`TrainableTFGraph`.
+
+    Trainable variables become the params pytree with their CURRENT
+    session values (uninitialized ones are initialized first — the
+    ``sess`` contract of the reference's ``from_loss``:
+    ``tf_optimizer.py:514`` "if you want to use a pre-trained model,
+    pass the Session that loaded it"). Non-trainable globals are frozen.
+
+    Returns ``(TrainableTFGraph, sess, trainable_tf_vars)`` so the
+    caller can write trained values back into the session.
+    """
+    import tensorflow as tf
+    tf1 = tf.compat.v1
+
+    anchor = loss if loss is not None else \
+        (list(outputs) + list(inputs))[0]
+    graph = anchor.graph
+    if sess is None:
+        sess = tf1.Session(graph=graph)
+    with graph.as_default():
+        gvars = tf1.global_variables()
+        if gvars:
+            uninit = {n.decode() if isinstance(n, bytes) else str(n)
+                      for n in sess.run(
+                          tf1.report_uninitialized_variables(gvars))}
+            to_init = [v for v in gvars if v.op.name in uninit]
+            if to_init:
+                sess.run(tf1.variables_initializer(to_init))
+        tvars = tf1.trainable_variables()
+
+    def _np(v):
+        a = np.asarray(v)
+        if a.dtype == np.float64:
+            a = a.astype(np.float32)
+        elif a.dtype == np.int64:
+            a = a.astype(np.int32)
+        return a
+
+    tset = {id(v) for v in tvars}
+    params = {v.op.name: _np(val)
+              for v, val in zip(tvars, sess.run(list(tvars)))} \
+        if tvars else {}
+    nt = [v for v in gvars if id(v) not in tset]
+    frozen = {v.op.name: _np(val)
+              for v, val in zip(nt, sess.run(list(nt)))} if nt else {}
+
+    trainable = TrainableTFGraph(
+        graph.as_graph_def(),
+        input_names=[t.op.name for t in inputs],
+        label_names=[t.op.name for t in labels],
+        loss_ref=(loss.name if loss is not None else None),
+        output_refs=[t.name for t in outputs],
+        params=params, frozen=frozen,
+        metric_refs={k: t.name for k, t in (metrics or {}).items()})
+    return trainable, sess, list(tvars)
+
+
+def write_back_variables(sess, tf_vars, params: Dict[str, np.ndarray]):
+    """Push trained JAX params back into the TF session's variables, so
+    the user's saver/export flow sees the trained weights — the round
+    trip the reference closes after ``TFOptimizer.optimize()``."""
+    for v in tf_vars:
+        if v.op.name not in params:
+            continue
+        val = np.asarray(params[v.op.name])
+        # feed the initializer's value input and re-run it: writes the
+        # variable without adding ops to an already-run graph (the
+        # classic pre-trained-weight-load trick; tf1.assign here would
+        # mutate the graph post-session and TF warns/errors)
+        init = v.initializer
+        sess.run(init, feed_dict={init.inputs[1]: val})
+
+
+_APPLY_OPTIM = {
+    "ApplyGradientDescent": ("sgd", {"lr": 1}),
+    "ResourceApplyGradientDescent": ("sgd", {"lr": 1}),
+    "ApplyMomentum": ("sgd_momentum", {"lr": 2, "momentum": 4}),
+    "ResourceApplyMomentum": ("sgd_momentum", {"lr": 2, "momentum": 4}),
+    "ResourceApplyKerasMomentum": ("sgd_momentum",
+                                   {"lr": 2, "momentum": 4}),
+    "ApplyAdam": ("adam", {"lr": 5, "beta_1": 6, "beta_2": 7,
+                           "epsilon": 8}),
+    "ResourceApplyAdam": ("adam", {"lr": 5, "beta_1": 6, "beta_2": 7,
+                                   "epsilon": 8}),
+    "ApplyAdagrad": ("adagrad", {"lr": 2}),
+    "ResourceApplyAdagrad": ("adagrad", {"lr": 2}),
+    "ResourceApplyAdagradV2": ("adagrad", {"lr": 2}),
+    "ApplyRMSProp": ("rmsprop", {"lr": 3, "rho": 4}),
+    "ResourceApplyRMSProp": ("rmsprop", {"lr": 3, "rho": 4}),
+}
+
+
+def optimizer_from_train_op(graph_def, train_op_name: str):
+    """Recover the optimizer family + hyperparameters from a TF1
+    ``train_op`` (the role of the reference's
+    ``_get_vars_grads_from_train_op``, ``tf_optimizer.py:464``): the
+    train_op groups ``Apply*`` ops whose const inputs carry lr/betas.
+
+    Returns a zoo optimizer instance. Raises ``NotImplementedError``
+    when the optimizer family is unknown or the learning rate is not a
+    graph constant (e.g. a schedule subgraph) — the graceful-error
+    contract for unconvertible train_ops."""
+    from zoo_tpu.pipeline.api.keras import optimizers as zopt
+
+    nodes = {n.name: n for n in graph_def.node}
+    name = train_op_name.split(":")[0].lstrip("^")
+    if name not in nodes:
+        raise ValueError(f"train_op node {name!r} not in graph")
+
+    # collect Apply* ops reachable via control/data deps of the train_op
+    seen, stack, applies = set(), [name], []
+    while stack:
+        cur = stack.pop()
+        if cur in seen or cur not in nodes:
+            continue
+        seen.add(cur)
+        node = nodes[cur]
+        if node.op in _APPLY_OPTIM:
+            applies.append(node)
+            continue
+        for ref in node.input:
+            stack.append(ref.lstrip("^").split(":")[0])
+    if not applies:
+        raise NotImplementedError(
+            f"train_op {name!r} leads to no recognized Apply* optimizer "
+            f"op (supported: {sorted(set(_APPLY_OPTIM))}); use "
+            "TFOptimizer.from_loss with an explicit optim_method")
+
+    def const_of(ref):
+        nd = nodes.get(ref.split(":")[0].lstrip("^"))
+        while nd is not None and nd.op in ("Identity", "ReadVariableOp"):
+            nd = nodes.get(nd.input[0].split(":")[0])
+        if nd is None or nd.op != "Const":
+            raise NotImplementedError(
+                f"hyperparameter input {ref!r} of the train_op is not a "
+                "graph constant (a schedule subgraph?); pass the "
+                "optimizer explicitly via TFOptimizer.from_loss")
+        from tensorflow.python.framework import tensor_util
+        return float(tensor_util.MakeNdarray(nd.attr["value"].tensor))
+
+    node = applies[0]
+    kind, slots = _APPLY_OPTIM[node.op]
+    hp = {k: const_of(node.input[i]) for k, i in slots.items()}
+    if kind == "sgd":
+        return zopt.SGD(lr=hp["lr"])
+    if kind == "sgd_momentum":
+        return zopt.SGD(lr=hp["lr"], momentum=hp["momentum"])
+    if kind == "adam":
+        return zopt.Adam(lr=hp["lr"], beta_1=hp["beta_1"],
+                         beta_2=hp["beta_2"], epsilon=hp["epsilon"])
+    if kind == "adagrad":
+        return zopt.Adagrad(lr=hp["lr"])
+    if kind == "rmsprop":
+        return zopt.RMSprop(lr=hp["lr"], rho=hp["rho"])
+    raise NotImplementedError(kind)
 
 
 class TFGraphWrapper:

@@ -111,21 +111,27 @@ def _convert_metrics(kmodel) -> list:
 class Estimator:
     @staticmethod
     def from_graph(*, inputs=None, outputs=None, labels=None, loss=None,
-                   optimizer=None, metrics=None, updates=None,
-                   sess=None, model_dir=None, **_):
-        """reference ``orca/learn/tf/estimator.py:291`` — TF1 graph
-        tensors driven by the JVM fabric. No TF1 session mechanism here;
-        this import path (``zoo.orca.learn.tf.estimator``) aliases the
-        TF2/keras-creator estimator, so raise with the working route."""
-        raise NotImplementedError(
-            "Estimator.from_graph drove TF1 session graphs (placeholder "
-            "inputs + train_op) on the JVM fabric, which does not exist "
-            "in the TPU rebuild. Either: (a) freeze the graph and load "
-            "it for inference via zoo.tfpark.TFNet.from_export_folder / "
-            "InferenceModel, or (b) port training to "
-            "Estimator.from_keras(model_creator=...) (tf.keras model "
-            "converted through the structural bridge). See "
-            "docs/migration.md.")
+                   optimizer=None, metrics=None, clip_norm=None,
+                   clip_value=None, updates=None, sess=None,
+                   model_dir=None, backend="bigdl", **_):
+        """reference ``orca/learn/tf/estimator.py:291`` — train a
+        user-built TF1 graph (placeholder inputs/labels + scalar loss
+        tensor). The reference drives the session graph on the JVM
+        fabric; here the graph's variables are captured as a JAX params
+        pytree and trained with ``jax.grad`` of the interpreted loss on
+        the mesh (``graph_estimator.TFGraphEstimator``)."""
+        if inputs is None:
+            raise ValueError("from_graph requires inputs= (the graph's "
+                             "input placeholder tensors)")
+        from zoo_tpu.orca.learn.tf2.graph_estimator import (
+            TFGraphEstimator,
+        )
+        return TFGraphEstimator(inputs=inputs, outputs=outputs,
+                                labels=labels, loss=loss,
+                                optimizer=optimizer, metrics=metrics,
+                                clip_norm=clip_norm,
+                                clip_value=clip_value, updates=updates,
+                                sess=sess, model_dir=model_dir)
 
     @staticmethod
     def from_keras(*, model_creator: Callable,

@@ -152,11 +152,98 @@ class TFDataset:
     numpy (the jitted fit fabric stages device-side), and the RDD/TF1
     ones raise a migration error naming the replacement."""
 
+    # graph → TFDataset that created placeholders in it, so
+    # TFOptimizer.from_loss can find the feed the way the reference's
+    # ``_get_dataset_from_loss`` walks the graph (``tf_optimizer.py``)
+    _placeholder_registry: "weakref.WeakValueDictionary" = None
+
     def __init__(self, x, y=None, batch_size: int = -1,
                  batch_per_thread: int = -1, val_x=None, val_y=None):
         self.x, self.y = x, y
         self.batch_size = batch_size if batch_size > 0 else batch_per_thread
         self.val_x, self.val_y = val_x, val_y
+        self._tensors = None
+
+    @property
+    def tensors(self):
+        """TF1 placeholders matching this dataset's arrays — the
+        reference UX (``tf_dataset.py``): build the model on
+        ``dataset.tensors``, then ``TFOptimizer.from_loss(loss, ...)``
+        finds the dataset through the loss graph."""
+        if self._tensors is None:
+            import weakref
+
+            import tensorflow as tf
+            tf1 = tf.compat.v1
+
+            graph = tf1.get_default_graph()
+
+            def ph(a, name):
+                a = np.asarray(a)
+                return tf1.placeholder(
+                    tf.dtypes.as_dtype(a.dtype),
+                    (None,) + tuple(a.shape[1:]), name=name)
+
+            def build(data, prefix):
+                if isinstance(data, (tuple, list)):
+                    return tuple(ph(a, f"{prefix}_{i}")
+                                 for i, a in enumerate(data))
+                return ph(data, prefix)
+
+            x_t = build(self.x, "zoo_feature")
+            if self.y is not None:
+                self._tensors = (x_t, build(self.y, "zoo_label"))
+            else:
+                self._tensors = x_t
+            if TFDataset._placeholder_registry is None:
+                TFDataset._placeholder_registry = {}
+            TFDataset._placeholder_registry.setdefault(
+                weakref.ref(graph), []).append(weakref.ref(self))
+        return self._tensors
+
+    def _flat_placeholders(self):
+        import tensorflow as tf
+        flat = tf.nest.flatten(self._tensors) if self._tensors else []
+        return {t.op.name for t in flat}
+
+    @staticmethod
+    def _from_graph(graph, loss=None) -> "Optional[TFDataset]":
+        """Find the dataset whose placeholders feed ``loss`` — multiple
+        datasets can register placeholders in one graph (train + val),
+        so ancestry of the loss disambiguates, like the reference's
+        ``_get_dataset_from_loss`` graph walk."""
+        reg = TFDataset._placeholder_registry
+        if reg is None:
+            return None
+        candidates = []
+        for gref, dsets in list(reg.items()):
+            if gref() is None:
+                del reg[gref]  # graph was GC'd
+                continue
+            if gref() is not graph:
+                continue
+            candidates = [d() for d in dsets if d() is not None]
+        if not candidates:
+            return None
+        if len(candidates) == 1 or loss is None:
+            return candidates[-1]
+        # ops feeding the loss
+        seen, stack = set(), [loss.op]
+        while stack:
+            op = stack.pop()
+            if op.name in seen:
+                continue
+            seen.add(op.name)
+            stack.extend(t.op for t in op.inputs)
+        feeding = [d for d in candidates
+                   if d._flat_placeholders() and
+                   d._flat_placeholders() <= seen]
+        if len(feeding) == 1:
+            return feeding[0]
+        raise ValueError(
+            "could not uniquely locate the TFDataset feeding this loss "
+            f"({len(feeding)} of {len(candidates)} registered datasets "
+            "feed it); pass dataset= explicitly to from_loss")
 
     @staticmethod
     def from_ndarrays(tensors, batch_size: int = -1,
@@ -331,28 +418,146 @@ class ZooOptimizer:
 
 
 class TFOptimizer:
-    """``zoo.tfpark.TFOptimizer`` — reference ``tf_optimizer.py:350``
-    drove exported TF1 graphs through BigDL. Mechanism-less here."""
+    """``zoo.tfpark.TFOptimizer`` — reference ``tf_optimizer.py:350``:
+    train a TF1 session graph distributed. The reference exports the
+    graph to the JVM/BigDL fabric; here the graph's variables are
+    captured as a JAX params pytree and the interpreted loss is
+    differentiated with ``jax.grad`` on the mesh
+    (``orca/learn/tf2/graph_estimator.GraphTrainer``). After
+    ``optimize()`` the trained weights are written back into the user's
+    session, so their saver/export flow keeps working."""
 
-    _MSG = ("TFOptimizer exported TF1 session graphs to the JVM fabric "
-            "— migrate training to zoo.orca.learn.tf2.Estimator or the "
-            "keras facade (zoo.pipeline.api.keras); see "
-            "docs/migration.md")
+    def __init__(self, trainer, dataset: "TFDataset", sess, tf_vars,
+                 batch_size: Optional[int] = None):
+        self._trainer = trainer
+        self._dataset = dataset
+        self.sess = sess
+        self._tf_vars = tf_vars
+        self._batch_size = batch_size if batch_size else (
+            dataset.batch_size if dataset is not None
+            and dataset.batch_size and dataset.batch_size > 0 else 32)
+        self.estimator = None  # reference parity attribute
 
-    def __init__(self, *args, **kwargs):
-        raise TFParkMigrationError(self._MSG)
+    @staticmethod
+    def _capture(loss, optim_method, session, inputs, labels, dataset,
+                 metrics, clip_norm, clip_value, tensor_with_value):
+        from zoo_tpu.bridges.tf_graph import capture_trainable_graph
+        from zoo_tpu.orca.learn.tf2.graph_estimator import GraphTrainer
+
+        if tensor_with_value:
+            raise TFParkMigrationError(
+                "tensor_with_value fed phase-dependent placeholders "
+                "(train vs validation constants); bake the training "
+                "value into the graph or make it a model input")
+        if dataset is None and inputs is None:
+            dataset = TFDataset._from_graph(loss.graph, loss)
+            if dataset is None:
+                raise ValueError(
+                    "from_loss could not locate a TFDataset for this "
+                    "graph: build the model on dataset.tensors, or pass "
+                    "inputs=/dataset= explicitly")
+        if inputs is None:
+            inputs = dataset.tensors
+        # reference semantics (tf_optimizer.py:553): a 2-tuple of inputs
+        # IS the (features, labels) structure
+        if labels is None and isinstance(inputs, tuple) \
+                and len(inputs) == 2:
+            inputs, labels = inputs
+        ins = list(inputs) if isinstance(inputs, (tuple, list)) \
+            else [inputs]
+        lbs = [] if labels is None else (
+            list(labels) if isinstance(labels, (tuple, list))
+            else [labels])
+        trainable, sess, tf_vars = capture_trainable_graph(
+            inputs=ins, labels=lbs, loss=loss, metrics=metrics,
+            sess=session)
+        trainer = GraphTrainer(trainable, optim_method,
+                               clip_norm=clip_norm,
+                               clip_value=clip_value)
+        return trainer, dataset, sess, tf_vars
 
     @classmethod
-    def from_train_op(cls, *a, **k):
-        raise TFParkMigrationError(cls._MSG)
+    def from_loss(cls, loss, optim_method, session=None, inputs=None,
+                  dataset=None, val_outputs=None, val_labels=None,
+                  val_method=None, clip_norm=None, clip_value=None,
+                  metrics=None, tensor_with_value=None,
+                  session_config=None, model_dir=None, updates=None):
+        """reference ``tf_optimizer.py:514`` — the loss tensor must come
+        from a graph built on ``TFDataset.tensors`` (or pass ``inputs=``
+        + ``dataset=``)."""
+        if updates:
+            import logging
+            logging.getLogger(__name__).warning(
+                "from_loss(updates=...): update ops are captured frozen "
+                "— running stats will not advance during training")
+        trainer, dataset, sess, tf_vars = cls._capture(
+            loss, optim_method, session, inputs, None, dataset, metrics,
+            clip_norm, clip_value, tensor_with_value)
+        return cls(trainer, dataset, sess, tf_vars)
 
     @classmethod
-    def from_loss(cls, *a, **k):
-        raise TFParkMigrationError(cls._MSG)
+    def from_train_op(cls, train_op, loss, *, inputs=None, labels=None,
+                      metrics=None, updates=None, sess=None,
+                      dataset=None, tensor_with_value=None,
+                      session_config=None, model_dir=None):
+        """reference ``tf_optimizer.py:464`` — recovers the optimizer
+        family + hyperparameters from the ``Apply*`` ops behind the
+        train_op (``bridges/tf_graph.optimizer_from_train_op``); raises
+        ``NotImplementedError`` for unrecognized optimizers or
+        non-constant learning rates."""
+        from zoo_tpu.bridges.tf_graph import optimizer_from_train_op
+
+        optim = optimizer_from_train_op(
+            loss.graph.as_graph_def(),
+            getattr(train_op, "name", train_op))
+        trainer, dataset, sess_, tf_vars = cls._capture(
+            loss, optim, sess, inputs, labels, dataset, metrics,
+            None, None, tensor_with_value)
+        return cls(trainer, dataset, sess_, tf_vars)
 
     @classmethod
-    def from_keras(cls, *a, **k):
-        raise TFParkMigrationError(cls._MSG)
+    def from_keras(cls, keras_model, dataset, session=None,
+                   model_dir=None, metrics=None, **kwargs):
+        raise TFParkMigrationError(
+            "TFOptimizer.from_keras: use zoo.tfpark.KerasModel (same "
+            "capability, structural bridge) — see docs/migration.md")
+
+    # -- the reference train entrypoint ----------------------------------
+    def optimize(self, end_trigger=None, batch_size: Optional[int] = None,
+                 checkpoint_trigger=None):
+        from zoo_tpu.bridges.tf_graph import write_back_variables
+        from zoo_tpu.orca.learn.trigger import MaxEpoch, MaxIteration
+
+        if self._dataset is None:
+            raise ValueError(
+                "optimize() needs the TFDataset the graph was built on "
+                "(from_loss located none and no dataset= was passed)")
+        bs = int(batch_size or self._batch_size or 32)
+        xs = [np.asarray(a) for a in (
+            self._dataset.x if isinstance(self._dataset.x, (tuple, list))
+            else [self._dataset.x])]
+        ys = [] if self._dataset.y is None else [
+            np.asarray(a) for a in (
+                self._dataset.y
+                if isinstance(self._dataset.y, (tuple, list))
+                else [self._dataset.y])]
+        n = xs[0].shape[0]
+        if end_trigger is None:
+            epochs = 1
+        elif isinstance(end_trigger, MaxEpoch):
+            epochs = end_trigger.max_epoch
+        elif isinstance(end_trigger, MaxIteration):
+            steps_per_epoch = max(1, n // bs)
+            epochs = max(1, -(-end_trigger.max_iteration
+                              // steps_per_epoch))
+        else:
+            raise ValueError(
+                f"unsupported end_trigger {type(end_trigger).__name__}; "
+                "use MaxEpoch(n) or MaxIteration(n)")
+        hist = self._trainer.fit(xs, ys, epochs=epochs, batch_size=bs)
+        write_back_variables(self.sess, self._tf_vars,
+                             self._trainer.numpy_params())
+        return hist
 
 
 class TFPredictor:

@@ -576,7 +576,9 @@ def capture_trainable_graph(*, inputs: Sequence, labels: Sequence = (),
         sess = tf1.Session(graph=graph)
     with graph.as_default():
         gvars = tf1.global_variables()
-        if gvars:
+        # a finalized graph (MonitoredTrainingSession etc.) can't grow
+        # init-check ops — its variables are initialized by contract
+        if gvars and not graph.finalized:
             uninit = {n.decode() if isinstance(n, bytes) else str(n)
                       for n in sess.run(
                           tf1.report_uninitialized_variables(gvars))}

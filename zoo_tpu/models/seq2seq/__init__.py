@@ -1,3 +1,8 @@
-from zoo_tpu.models.seq2seq.seq2seq import Seq2seq
+from zoo_tpu.models.seq2seq.seq2seq import (
+    Bridge,
+    RNNDecoder,
+    RNNEncoder,
+    Seq2seq,
+)
 
-__all__ = ["Seq2seq"]
+__all__ = ["Seq2seq", "RNNEncoder", "RNNDecoder", "Bridge"]

@@ -183,7 +183,8 @@ class GraphTrainer:
     # -- API --------------------------------------------------------------
     def fit(self, xs: List[np.ndarray], ys: List[np.ndarray],
             epochs: int = 1, batch_size: int = 32, shuffle: bool = True,
-            seed: int = 0) -> Dict[str, List[float]]:
+            seed: int = 0,
+            max_steps: Optional[int] = None) -> Dict[str, List[float]]:
         if not self.params:
             raise ValueError(
                 "the captured graph has no trainable variables — nothing "
@@ -201,6 +202,7 @@ class GraphTrainer:
         n = int(xs[0].shape[0])
         rng = np.random.default_rng(seed)
         history: Dict[str, List[float]] = {"loss": []}
+        steps_done = 0
         for _ in range(int(epochs)):
             order = rng.permutation(n) if shuffle else np.arange(n)
             losses = []
@@ -209,14 +211,20 @@ class GraphTrainer:
             usable = max(n - n % batch_size, batch_size) \
                 if n >= batch_size else n
             for lo in range(0, usable, batch_size):
+                if max_steps is not None and steps_done >= max_steps:
+                    break
                 idx = order[lo:lo + batch_size]
                 batch = self._put_batch(
                     [np.asarray(a)[idx] for a in (*xs, *ys)])
                 self.params, self.opt_state, loss = self._jit_step(
                     self.params, self.opt_state, *batch)
                 losses.append(loss)
-            history["loss"].append(
-                float(np.mean([np.asarray(v) for v in losses])))
+                steps_done += 1
+            if losses:
+                history["loss"].append(
+                    float(np.mean([np.asarray(v) for v in losses])))
+            if max_steps is not None and steps_done >= max_steps:
+                break
         return history
 
     def predict(self, xs: List[np.ndarray], batch_size: int = 256):
@@ -336,10 +344,26 @@ class TFGraphEstimator:
             feature_cols=None, label_cols=None, validation_data=None,
             checkpoint_trigger=None, shuffle: bool = True):
         xs, ys = self._norm(data, feature_cols, label_cols, need_y=True)
-        hist = self.trainer.fit(xs, ys, epochs=epochs,
-                                batch_size=batch_size, shuffle=shuffle,
-                                seed=self._epoch)
-        self._epoch += int(epochs)
+        val = None
+        if validation_data is not None:
+            val = self._norm(validation_data, feature_cols, label_cols,
+                             need_y=True)
+        hist: Dict[str, List[float]] = {}
+        for _ in range(int(epochs)):
+            h = self.trainer.fit(xs, ys, epochs=1,
+                                 batch_size=batch_size, shuffle=shuffle,
+                                 seed=self._epoch)
+            for k, v in h.items():
+                hist.setdefault(k, []).extend(v)
+            self._epoch += 1
+            if val is not None:
+                for k, v in self.trainer.evaluate(
+                        *val, batch_size=batch_size).items():
+                    hist.setdefault(f"val_{k}", []).append(v)
+            if self.model_dir and checkpoint_trigger is not None and \
+                    checkpoint_trigger.fire_on_epoch(self._epoch):
+                self._write_back()
+                self.save_checkpoint()
         self._write_back()
         if self.model_dir:
             self.save_checkpoint()

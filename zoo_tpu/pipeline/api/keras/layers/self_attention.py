@@ -122,7 +122,15 @@ class TransformerLayer(Layer):
                  hidden_drop: float = 0.1, attn_drop: float = 0.1,
                  initializer_range: float = 0.02,
                  bidirectional: bool = False, activation="gelu",
-                 attention_impl: str = "auto", **kwargs):
+                 attention_impl: str = "auto", remat=False, **kwargs):
+        """``remat``: per-block ``jax.checkpoint`` policy — ``False``
+        (store all activations; fastest when they fit), ``True`` (full
+        remat, ~4x-forward step cost for O(1) depth memory), or
+        ``"dots"`` (save matmul outputs, recompute elementwise chains —
+        the memory relief without the MXU recompute; same lever that
+        took Llama from OOM to 0.42 MFU at S=512, ``llama.py:113``).
+        Enables batch sizes that otherwise OOM (BERT-base B=256 at
+        S=128 needs it on a 16G-HBM chip)."""
         super().__init__(**kwargs)
         if hidden_size % n_head:
             raise ValueError("hidden_size must divide by n_head")
@@ -130,7 +138,11 @@ class TransformerLayer(Layer):
             raise ValueError(
                 "attention_impl='flash' does not support attention dropout; "
                 "pass attn_drop=0 (hidden_drop still applies)")
+        if remat not in (False, True, "dots"):
+            raise ValueError(f"remat must be False, True or 'dots', "
+                             f"got {remat!r}")
         self.attention_impl = attention_impl
+        self.remat = remat
         self.vocab = vocab
         self.seq_len = seq_len
         self.n_block = n_block
@@ -164,17 +176,33 @@ class TransformerLayer(Layer):
         return h + params["pos"][:t]
 
     def _run_blocks(self, params, h, mask, training, rng):
+        def raw_block(blk, h, brng):
+            return _block_forward(blk, h, n_head=self.n_head, mask=mask,
+                                  causal=not self.bidirectional,
+                                  act=self.act,
+                                  hidden_drop=self.hidden_drop,
+                                  attn_drop=self.attn_drop,
+                                  training=training, rng=brng,
+                                  attention_impl=self.attention_impl)
+
+        block_fn = raw_block
+        if training and self.remat:
+            # prevent_cse=False: the scan already prevents CSE; the
+            # default barriers would block fusions in every iteration
+            if self.remat == "dots":
+                block_fn = jax.checkpoint(
+                    raw_block, prevent_cse=False,
+                    policy=jax.checkpoint_policies
+                    .dots_with_no_batch_dims_saveable)
+            else:
+                block_fn = jax.checkpoint(raw_block, prevent_cse=False)
+
         def body(carry, blk):
             h, rng = carry
             brng = None
             if rng is not None:
                 rng, brng = jax.random.split(rng)
-            h = _block_forward(blk, h, n_head=self.n_head, mask=mask,
-                               causal=not self.bidirectional, act=self.act,
-                               hidden_drop=self.hidden_drop,
-                               attn_drop=self.attn_drop, training=training,
-                               rng=brng,
-                               attention_impl=self.attention_impl)
+            h = block_fn(blk, h, brng)
             return (h, rng), None
 
         rng = layer_rng(rng, self.name) if rng is not None else None

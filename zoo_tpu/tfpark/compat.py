@@ -542,19 +542,22 @@ class TFOptimizer:
                 if isinstance(self._dataset.y, (tuple, list))
                 else [self._dataset.y])]
         n = xs[0].shape[0]
+        max_steps = None
         if end_trigger is None:
             epochs = 1
         elif isinstance(end_trigger, MaxEpoch):
             epochs = end_trigger.max_epoch
         elif isinstance(end_trigger, MaxIteration):
+            # exact iteration budget, not rounded up to whole epochs
+            max_steps = end_trigger.max_iteration
             steps_per_epoch = max(1, n // bs)
-            epochs = max(1, -(-end_trigger.max_iteration
-                              // steps_per_epoch))
+            epochs = max(1, -(-max_steps // steps_per_epoch))
         else:
             raise ValueError(
                 f"unsupported end_trigger {type(end_trigger).__name__}; "
                 "use MaxEpoch(n) or MaxIteration(n)")
-        hist = self._trainer.fit(xs, ys, epochs=epochs, batch_size=bs)
+        hist = self._trainer.fit(xs, ys, epochs=epochs, batch_size=bs,
+                                 max_steps=max_steps)
         write_back_variables(self.sess, self._tf_vars,
                              self._trainer.numpy_params())
         return hist

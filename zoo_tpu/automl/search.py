@@ -186,7 +186,8 @@ class LocalSearchEngine(SearchEngine):
 
     def __init__(self, n_parallel: int = 1,
                  stopper: Optional[TrialStopper] = None,
-                 search_alg=None, scheduler=None):
+                 search_alg=None, scheduler=None,
+                 partition_devices: bool = False):
         self._trials: List[Trial] = []
         self._mode = "min"
         self._metric = "mse"
@@ -194,6 +195,54 @@ class LocalSearchEngine(SearchEngine):
         self.stopper = stopper
         self.search_alg = search_alg
         self.scheduler = scheduler
+        # partition the ambient mesh's devices into n_parallel disjoint
+        # sub-meshes, one per concurrent trial (SURVEY §7.4 #6 — the
+        # TPU-native form of Ray Tune's resources_per_trial packing)
+        self.partition_devices = bool(partition_devices)
+
+    def _sub_contexts(self):
+        """Split the ambient RuntimeContext's devices into n_parallel
+        disjoint data-parallel sub-meshes. Returns [] when there is no
+        context or not enough devices to give each trial one."""
+        if not self.partition_devices or self.n_parallel < 2:
+            return []
+        import dataclasses as _dc
+
+        from zoo_tpu.common.context import get_runtime_context
+        from zoo_tpu.parallel.mesh import build_mesh
+
+        ctx = get_runtime_context(required=False)
+        if ctx is None or len(ctx.devices) < self.n_parallel:
+            return []
+        # preserve the ambient mesh's non-data axis sizes (model/seq/…)
+        # inside every sub-mesh — a trial sized for tensor parallelism
+        # must not silently lose it; only the data axes shrink
+        from zoo_tpu.parallel.mesh import data_axes
+        d_axes = set(data_axes(ctx.mesh))
+        fixed = {name: size for name, size in ctx.mesh.shape.items()
+                 if name not in d_axes and size > 1}
+        non_data = int(np.prod(list(fixed.values()))) if fixed else 1
+        devs = list(ctx.devices)
+        per, rem = divmod(len(devs), self.n_parallel)
+        if per % non_data:
+            logger.warning(
+                "cannot partition %d devices into %d sub-meshes that "
+                "keep the ambient non-data axes %s; trials share the "
+                "full mesh", len(devs), self.n_parallel, fixed)
+            return []
+        subs, lo = [], 0
+        for g in range(self.n_parallel):
+            size = per + (1 if g < rem else 0)
+            size -= size % max(non_data, 1)  # keep non-data axes whole
+            group = devs[lo:lo + size]
+            lo += size
+            axis_sizes = dict(fixed)
+            axis_sizes["data"] = -1
+            subs.append(_dc.replace(
+                ctx, devices=tuple(group),
+                mesh=build_mesh(devices=group, axis_sizes=axis_sizes,
+                                axis_names=ctx.mesh.axis_names)))
+        return subs
 
     def compile(self, trial_fn, search_space, n_sampling=1, metric="mse",
                 mode="min", seed=0, search_alg=None, scheduler=None):
@@ -261,8 +310,26 @@ class LocalSearchEngine(SearchEngine):
             return self._trials
         from concurrent.futures import ThreadPoolExecutor
 
+        subs = self._sub_contexts()
+        if subs:
+            from zoo_tpu.common.context import runtime_context_scope
+
+            import queue as _q
+            pool_q: "_q.Queue" = _q.Queue()
+            for s in subs:
+                pool_q.put(s)
+
+            def submit_one(i, cfg, total):
+                sub = pool_q.get()  # lease a sub-mesh for this trial
+                try:
+                    with runtime_context_scope(sub):
+                        return self._run_one(i, cfg, total)
+                finally:
+                    pool_q.put(sub)
+        else:
+            submit_one = self._run_one
         with ThreadPoolExecutor(max_workers=self.n_parallel) as pool:
-            futures = [pool.submit(self._run_one, i, cfg,
+            futures = [pool.submit(submit_one, i, cfg,
                                    len(self._configs))
                        for i, cfg in enumerate(self._configs)]
             self._trials = [f.result() for f in futures]
@@ -335,12 +402,23 @@ class RayTuneSearchEngine(SearchEngine):  # pragma: no cover - needs ray
                      artifacts=result)
 
 
-def make_search_engine(search_alg=None, scheduler=None) -> SearchEngine:
-    if search_alg is None and scheduler is None:
+def make_search_engine(search_alg=None, scheduler=None,
+                       n_parallel: int = 1,
+                       partition_devices: Optional[bool] = None
+                       ) -> SearchEngine:
+    """``n_parallel > 1`` runs that many trials concurrently; by default
+    each concurrent trial gets its own disjoint sub-mesh of the ambient
+    devices (``partition_devices=False`` to share the full mesh
+    instead)."""
+    if partition_devices is None:
+        partition_devices = n_parallel > 1
+    if search_alg is None and scheduler is None and n_parallel == 1:
         try:
             return RayTuneSearchEngine()
         except Exception:
             return LocalSearchEngine()
-    # model-based search / ASHA are local-engine features; the ray engine
-    # would accept tune-native searchers instead
-    return LocalSearchEngine(search_alg=search_alg, scheduler=scheduler)
+    # model-based search / ASHA / sub-mesh concurrency are local-engine
+    # features; the ray engine would accept tune-native searchers instead
+    return LocalSearchEngine(n_parallel=n_parallel,
+                             search_alg=search_alg, scheduler=scheduler,
+                             partition_devices=partition_devices)

@@ -75,13 +75,16 @@ class AutoTSEstimator:
     def fit(self, data: TSDataset, validation_data: Optional[TSDataset] = None,
             epochs: int = 2, batch_size: int = 32, n_sampling: int = 1,
             seed: int = 0, search_alg=None,
-            scheduler=None) -> "TSPipeline":
+            scheduler=None, n_parallel: int = 1) -> "TSPipeline":
         """Search and return the best TSPipeline (reference:
         ``AutoTSEstimator.fit`` returning a TSPipeline; ``search_alg``/
         ``scheduler`` mirror the ray.tune knobs of
         ``ray_tune_search_engine.py:29,151`` — ``search_alg="tpe"`` for
         model-based sampling, ``scheduler="asha"`` for successive-halving
-        early stopping of per-epoch-reporting trials)."""
+        early stopping of per-epoch-reporting trials).
+
+        ``n_parallel > 1``: concurrent trials, each on its own disjoint
+        sub-mesh of the ambient devices (SURVEY §7.4 #6)."""
         if not isinstance(data, TSDataset):
             raise ValueError("AutoTSEstimator.fit expects a TSDataset")
         n_features = data.get_feature_num()
@@ -121,7 +124,8 @@ class AutoTSEstimator:
                     "lookback": lookback}
 
         engine = make_search_engine(search_alg=search_alg,
-                                    scheduler=scheduler)
+                                    scheduler=scheduler,
+                                    n_parallel=n_parallel)
         engine.compile(trial_fn, space, n_sampling=n_sampling,
                        metric=self.metric, mode="min", seed=seed)
         engine.run()

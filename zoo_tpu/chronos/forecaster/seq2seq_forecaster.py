@@ -56,13 +56,21 @@ class Seq2SeqForecaster(Forecaster):
         self.model = m
 
     # -- teacher-forced fit / greedy predict ------------------------------
+    def _start_token(self, x):
+        """First decoder input. When the target features lead the input
+        features (the chronos TSDataset layout: target cols first), the
+        last observed target value; otherwise a zero start token — never
+        a silent broadcast of mismatched features."""
+        if self.input_feature_num >= self.output_feature_num:
+            return x[:, -1:, :self.output_feature_num]
+        return np.zeros((len(x), 1, self.output_feature_num),
+                        np.float32)
+
     def _teacher_inputs(self, x, y):
-        """Decoder input: [last observed target, y[:-1]] — the standard
-        one-step-shifted teacher sequence. The first step uses the last
-        encoder-window value of the target features (reference
-        Seq2SeqPytorch feeds input_seq[:, -1, :output_num])."""
-        start = x[:, -1:, :self.output_feature_num]
-        return np.concatenate([start, y[:, :-1]], axis=1)
+        """Decoder input: [start token, y[:-1]] — the standard one-step-
+        shifted teacher sequence (reference Seq2SeqPytorch feeds
+        input_seq[:, -1, :output_num])."""
+        return np.concatenate([self._start_token(x), y[:, :-1]], axis=1)
 
     def _set_self_feed(self, flag: bool):
         """Flip the decoder between teacher-forced and free-running
@@ -72,10 +80,7 @@ class Seq2SeqForecaster(Forecaster):
         if core.train_self_feed == flag:
             return
         core.train_self_feed = flag
-        self.model._jit_train = None
-        self.model._own_jit_train = None
-        self.model._jit_multi = None
-        self.model._jit_epoch_cache = None
+        self.model._drop_train_caches()
 
     def fit(self, data, epochs: int = 1, batch_size: int = 32,
             validation_data=None, seed: int = 0,
@@ -146,11 +151,11 @@ class Seq2SeqForecaster(Forecaster):
     def predict(self, data, batch_size: int = 256) -> np.ndarray:
         x, _ = self._unpack(data)
         x = np.asarray(x)
-        # greedy decode: step 0 consumes the last observed target value,
-        # later steps the model's own predictions (eval-mode scan)
+        # greedy decode: step 0 consumes the start token (last observed
+        # target value), later steps the model's own predictions
         dec = np.zeros((len(x), self.future_seq_len,
                         self.output_feature_num), np.float32)
-        dec[:, 0] = x[:, -1, :self.output_feature_num]
+        dec[:, :1] = self._start_token(x)
         out = self.model.predict([x, dec],
                                  batch_size=min(batch_size, len(x)))
         return np.asarray(out).reshape(len(x), self.future_seq_len,

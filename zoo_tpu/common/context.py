@@ -13,6 +13,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import os
+import contextlib
 import threading
 from typing import Optional
 
@@ -153,6 +154,7 @@ class RuntimeContext:
 
 _lock = threading.Lock()
 _runtime_context: Optional[RuntimeContext] = None
+_thread_ctx = threading.local()
 
 
 def _set_runtime_context(ctx: Optional[RuntimeContext]):
@@ -161,10 +163,32 @@ def _set_runtime_context(ctx: Optional[RuntimeContext]):
         _runtime_context = ctx
 
 
+@contextlib.contextmanager
+def runtime_context_scope(ctx: RuntimeContext):
+    """Thread-local RuntimeContext override: code in this thread sees
+    ``ctx`` from :func:`get_runtime_context` while the scope is active.
+
+    The concurrent-AutoML mechanism (SURVEY §7.4 #6): each trial thread
+    runs under its own sub-mesh context, so k trials train on k disjoint
+    device groups at once — the TPU-native form of Ray Tune's
+    resources_per_trial packing
+    (reference ``automl/search/ray_tune_search_engine.py:64-103``)."""
+    prev = getattr(_thread_ctx, "override", None)
+    _thread_ctx.override = ctx
+    try:
+        yield ctx
+    finally:
+        _thread_ctx.override = prev
+
+
 def get_runtime_context(required: bool = True) -> Optional[RuntimeContext]:
     """Current :class:`RuntimeContext`, or raise if ``init_orca_context`` has
     not been called (mirrors the reference's implicit ``getOrCreate`` use of
-    SparkContext)."""
+    SparkContext). A thread-local override (``runtime_context_scope``)
+    wins over the process-global context."""
+    override = getattr(_thread_ctx, "override", None)
+    if override is not None:
+        return override
     if _runtime_context is None and required:
         raise RuntimeError(
             "No runtime context. Call zoo_tpu.orca.init_orca_context() first.")

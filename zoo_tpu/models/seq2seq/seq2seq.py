@@ -375,6 +375,13 @@ class Seq2seq(Model):
         would force per-step dispatch)."""
         import numpy as np
 
+        if self._single_input:
+            raise ValueError(
+                "infer(start_sign=, max_seq_len=) needs the two-input "
+                "Seq2seq form (encoder, decoder, ...) — the simplified "
+                "single-input constructor generates exactly "
+                "target_length steps from its internal start token; "
+                "call predict(x) instead")
         x = np.asarray(input)
         if x.ndim == 2:
             x = x[None]
@@ -383,8 +390,7 @@ class Seq2seq(Model):
         dec = np.concatenate(
             [start, np.zeros((x.shape[0], max_seq_len - 1,
                               start.shape[-1]), start.dtype)], axis=1)
-        out = self.predict([x, dec] if not self._single_input else x,
-                           batch_size=max(1, x.shape[0]))
+        out = self.predict([x, dec], batch_size=max(1, x.shape[0]))
         out = np.asarray(out)
         if build_output is not None:
             out = np.asarray(build_output(out)) if callable(build_output) \

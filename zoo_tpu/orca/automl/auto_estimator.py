@@ -67,12 +67,18 @@ class AutoEstimator:
             metric_mode: Optional[str] = None,
             search_space: Optional[Dict] = None, n_sampling: int = 1,
             seed: int = 0, search_alg=None,
-            scheduler=None) -> "AutoEstimator":
+            scheduler=None, n_parallel: int = 1) -> "AutoEstimator":
         """Run the search (reference: ``AutoEstimator.fit`` with
         ``search_space``/``n_sampling``/``metric``; ``search_alg``/
         ``scheduler`` mirror ray.tune's knobs,
         ``ray_tune_search_engine.py:29,151`` — ``"tpe"`` for model-based
-        sampling, ``"asha"`` for successive-halving early stopping)."""
+        sampling, ``"asha"`` for successive-halving early stopping).
+
+        ``n_parallel``: run that many trials CONCURRENTLY, each on its
+        own disjoint sub-mesh of the ambient devices (the TPU-native
+        form of Ray Tune's parallel trials; needs
+        ``len(devices) >= n_parallel``). TPE stays sequential — its
+        suggestions condition on every completed trial."""
         if search_space is None:
             raise ValueError("search_space is required")
         mode = metric_mode or ("min" if metric.lower() in _MINIMIZE
@@ -152,7 +158,8 @@ class AutoEstimator:
             return {metric: float(value), "model": model}
 
         engine = make_search_engine(search_alg=search_alg,
-                                    scheduler=scheduler)
+                                    scheduler=scheduler,
+                                    n_parallel=n_parallel)
         engine.compile(trial_fn, search_space, n_sampling=n_sampling,
                        metric=metric, mode=mode, seed=seed)
         engine.run()

@@ -189,26 +189,30 @@ class KerasNet:
 
     # -- gradient clipping (reference: Scala ``Estimator.scala:68`` area —
     # constant + L2-norm clipping applied inside DistriOptimizer) ----------
+    def _drop_train_caches(self):
+        """Invalidate every cache holding a traced train step — required
+        whenever something the step closure bakes in changes (grad clip,
+        loss, a layer-mode flag like seq2seq's train_self_feed)."""
+        self._jit_train = self._jit_multi = self._own_jit_train = None
+        self._jit_epoch_cache = None
+
     def set_constant_gradient_clipping(self, min_value: float,
                                        max_value: float):
         """Clip every gradient element into [min_value, max_value]."""
         self._grad_clip = ("const", float(min_value), float(max_value))
         # clip is in the step: drop every cache holding a traced step
-        self._jit_train = self._jit_multi = self._own_jit_train = None
-        self._jit_epoch_cache = None
+        self._drop_train_caches()
         return self
 
     def set_gradient_clipping_by_l2_norm(self, clip_norm: float):
         """Scale gradients so their global L2 norm is at most clip_norm."""
         self._grad_clip = ("l2", float(clip_norm))
-        self._jit_train = self._jit_multi = self._own_jit_train = None
-        self._jit_epoch_cache = None
+        self._drop_train_caches()
         return self
 
     def clear_gradient_clipping(self):
         self._grad_clip = None
-        self._jit_train = self._jit_multi = self._own_jit_train = None
-        self._jit_epoch_cache = None
+        self._drop_train_caches()
         return self
 
     def _apply_grad_clip(self, grads):
@@ -668,6 +672,20 @@ class KerasNet:
                                       for a in sliced]
                             return self._put_stacked(sliced)
                         return self._put_batch(sliced)
+
+                # the stage_fn runs on the iterator's daemon thread; pin
+                # the CALLER's runtime context (possibly a thread-local
+                # sub-mesh scope, e.g. concurrent AutoML trials) so the
+                # staged batches land on the same mesh as the params
+                _caller_ctx = get_runtime_context(required=False)
+                if _caller_ctx is not None:
+                    from zoo_tpu.common.context import (
+                        runtime_context_scope,
+                    )
+
+                    def _stage(idx, _orig=_stage, _ctx=_caller_ctx):
+                        with runtime_context_scope(_ctx):
+                            return _orig(idx)
 
                 batches = DoubleBufferedIterator(
                     data_utils.batch_slices(n, local_bs, shuffle, nprng,

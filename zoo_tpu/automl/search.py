@@ -206,21 +206,17 @@ class LocalSearchEngine(SearchEngine):
         context or not enough devices to give each trial one."""
         if not self.partition_devices or self.n_parallel < 2:
             return []
-        import dataclasses as _dc
-
         from zoo_tpu.common.context import get_runtime_context
         from zoo_tpu.parallel.mesh import build_mesh
 
         ctx = get_runtime_context(required=False)
         if ctx is None or len(ctx.devices) < self.n_parallel:
             return []
-        # preserve the ambient mesh's non-data axis sizes (model/seq/…)
-        # inside every sub-mesh — a trial sized for tensor parallelism
-        # must not silently lose it; only the data axes shrink
-        from zoo_tpu.parallel.mesh import data_axes
-        d_axes = set(data_axes(ctx.mesh))
+        # preserve every non-"data" axis size (model/seq/… AND fsdp —
+        # a trial sized for ZeRO param sharding must not silently lose
+        # it and replicate params per device); only "data" shrinks
         fixed = {name: size for name, size in ctx.mesh.shape.items()
-                 if name not in d_axes and size > 1}
+                 if name != "data" and size > 1}
         non_data = int(np.prod(list(fixed.values()))) if fixed else 1
         devs = list(ctx.devices)
         per, rem = divmod(len(devs), self.n_parallel)
@@ -238,10 +234,15 @@ class LocalSearchEngine(SearchEngine):
             lo += size
             axis_sizes = dict(fixed)
             axis_sizes["data"] = -1
-            subs.append(_dc.replace(
+            subs.append(dataclasses.replace(
                 ctx, devices=tuple(group),
                 mesh=build_mesh(devices=group, axis_sizes=axis_sizes,
                                 axis_names=ctx.mesh.axis_names)))
+        if lo < len(devs):
+            logger.warning(
+                "sub-mesh partition leaves %d of %d devices idle "
+                "(group sizes rounded to keep non-data axes %s whole)",
+                len(devs) - lo, len(devs), fixed)
         return subs
 
     def compile(self, trial_fn, search_space, n_sampling=1, metric="mse",
@@ -296,6 +297,12 @@ class LocalSearchEngine(SearchEngine):
         if self._alg is not None:
             # sequential ask/tell: each suggestion conditions on every
             # completed trial (the model-based point)
+            if self.n_parallel > 1:
+                logger.warning(
+                    "n_parallel=%d is ignored with a model-based "
+                    "search_alg: ask/tell suggestions condition on every "
+                    "completed trial, so trials run sequentially",
+                    self.n_parallel)
             history: List = []
             self._trials = []
             for i in range(self._n_trials):

@@ -212,3 +212,53 @@ def test_llama_remat_typo_rejected():
 
     with pytest.raises(ValueError, match="remat"):
         Llama(remat="dot")
+
+
+def test_serving_replica_pool_overlaps(orca_ctx):
+    """num_replicas worker pool behind the one TCP door (the reference's
+    Flink task-slot parallelism, ClusterServing.scala:54-67): two slow
+    replicas drain the shared queue concurrently — two in-flight
+    requests finish in ~one model latency, not two."""
+    import threading
+    import time as _time
+
+    import numpy as np
+
+    from zoo_tpu.serving.server import ServingServer
+    from zoo_tpu.serving.tcp_client import TCPInputQueue
+
+    class SlowModel:
+        calls = []
+
+        def predict(self, x, batch_size=8):
+            SlowModel.calls.append(threading.current_thread().name)
+            _time.sleep(0.4)
+            return np.asarray(x) * 2.0
+
+    server = ServingServer(SlowModel(), port=0, batch_size=1,
+                           max_wait_ms=0.0, num_replicas=2).start()
+    try:
+        outs, lock = [], threading.Lock()
+
+        def one():
+            q = TCPInputQueue(server.host, server.port)
+            r = q.predict(np.ones((1, 4), np.float32))
+            with lock:
+                outs.append(np.asarray(r))
+
+        threads = [threading.Thread(target=one) for _ in range(2)]
+        t0 = _time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = _time.perf_counter() - t0
+        assert len(outs) == 2
+        for r in outs:
+            np.testing.assert_allclose(r, 2.0)
+        # two replicas overlap the 0.4s sleeps; a single replica would
+        # serialize them (>= 0.8s)
+        assert wall < 0.7, wall
+        assert len({c for c in SlowModel.calls}) >= 2, SlowModel.calls
+    finally:
+        server.stop()

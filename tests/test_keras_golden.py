@@ -141,8 +141,7 @@ SPECS = [
          lambda: ZL.Deconvolution2D(4, 3, 3, dim_ordering="th"),
          lambda: keras.layers.Conv2DTranspose(4, 3, padding="valid"),
          (6, 6, 3),
-         lambda p: [np.transpose(np.asarray(p["W"]), (0, 1, 2, 3)),
-                    np.asarray(p["b"])],
+         _wb,  # zoo HWOI kernel == keras Conv2DTranspose layout
          tol=1e-4, nchw=True),
     # -- pooling ----------------------------------------------------------
     Spec("maxpool1d", lambda: ZL.MaxPooling1D(2),

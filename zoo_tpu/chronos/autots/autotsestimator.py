@@ -18,7 +18,8 @@ from zoo_tpu.automl.hp import Sampler
 from zoo_tpu.automl.search import make_search_engine
 from zoo_tpu.chronos.data.tsdataset import TSDataset
 
-_MODELS = {"lstm", "tcn", "seq2seq"}
+_MODELS = {"lstm", "tcn", "seq2seq", "arima", "prophet"}
+_STATISTICAL = {"arima", "prophet"}
 
 
 def _build_forecaster(model: str, past_seq_len: int, horizon: int,
@@ -94,6 +95,44 @@ class AutoTSEstimator:
         space = dict(self.search_space)
         space["past_seq_len"] = self.past_seq_len
 
+        def statistical_trial_fn(config: Dict, reporter=None) -> Dict:
+            """ARIMA/Prophet trials fit the raw (single-id, single-
+            target) series, not rolled windows — the reference's
+            auto_arima/auto_prophet contract
+            (``chronos/autots/model/auto_arima.py``). Held-out tail =
+            validation_data's target series, else the last 20%."""
+            from zoo_tpu.chronos.autots.model.auto_arima import (
+                arima_trial,
+                tail_split,
+            )
+
+            config.pop("past_seq_len", None)
+            if n_targets != 1 or data.id_col is not None:
+                raise ValueError(
+                    f"model={self.model!r} searches a univariate "
+                    "series; got multiple targets/ids")
+            y = np.asarray(data.df[data.target_col[0]], np.float64)
+            vy = np.asarray(
+                validation_data.df[data.target_col[0]], np.float64) \
+                if validation_data is not None else None
+            train, val = tail_split(y, vy)
+            if self.model == "arima":
+                out = arima_trial(config, train, val, self.metric)
+                f, res = out["model"], {self.metric: out[self.metric]}
+            else:  # prophet (gated on the package)
+                import pandas as pd
+
+                from zoo_tpu.chronos.forecaster.arima_forecaster import (
+                    ProphetForecaster,
+                )
+                f = ProphetForecaster(**config)
+                f.fit(pd.DataFrame({
+                    "ds": data.df[data.dt_col].iloc[:len(train)],
+                    "y": train}))
+                res = f.evaluate(val, metrics=[self.metric])
+            return {self.metric: res[self.metric], "forecaster": f,
+                    "lookback": 0}
+
         def trial_fn(config: Dict, reporter=None) -> Dict:
             lookback = int(config.pop("past_seq_len"))
             data.roll(lookback, horizon)
@@ -126,12 +165,25 @@ class AutoTSEstimator:
         engine = make_search_engine(search_alg=search_alg,
                                     scheduler=scheduler,
                                     n_parallel=n_parallel)
-        engine.compile(trial_fn, space, n_sampling=n_sampling,
+        engine.compile(statistical_trial_fn
+                       if self.model in _STATISTICAL else trial_fn,
+                       space, n_sampling=n_sampling,
                        metric=self.metric, mode="min", seed=seed)
         engine.run()
         best = engine.get_best_trial()
         self._best = best
-        return TSPipeline(best.artifacts["forecaster"],
+        winner = best.artifacts["forecaster"]
+        if self.model in _STATISTICAL:
+            # trials fit on the holdout split; the shipped model must be
+            # fit on the FULL series so predict() forecasts past its end
+            y_full = np.asarray(data.df[data.target_col[0]], np.float64)
+            if self.model == "arima":
+                winner.fit(y_full)
+            else:
+                import pandas as pd
+                winner.fit(pd.DataFrame({"ds": data.df[data.dt_col],
+                                         "y": y_full}))
+        return TSPipeline(winner,
                           lookback=best.artifacts["lookback"],
                           horizon=horizon,
                           best_config=dict(best.config),
@@ -161,15 +213,41 @@ class TSPipeline:
             data.roll(self.lookback, self.horizon)
         return data
 
+    def _statistical(self) -> bool:
+        """ARIMA/Prophet forecasters work on raw series, not rolled
+        windows (lookback 0 marks the statistical AutoTS family)."""
+        from zoo_tpu.chronos.forecaster.arima_forecaster import (
+            ARIMAForecaster,
+            ProphetForecaster,
+        )
+        return isinstance(self.forecaster,
+                          (ARIMAForecaster, ProphetForecaster))
+
+    def _series(self, data: TSDataset) -> np.ndarray:
+        return np.asarray(data.df[data.target_col[0]], np.float64)
+
     def fit(self, data: TSDataset, epochs: int = 1, batch_size: int = 32):
+        if self._statistical():
+            self.forecaster.fit(self._series(data))
+            return self
         self.forecaster.fit(self._rolled(data), epochs=epochs,
                             batch_size=batch_size)
         return self
 
     def predict(self, data: TSDataset) -> np.ndarray:
+        if self._statistical():
+            # forecast `horizon` steps past the fitted series; `data`
+            # only names the target column (the fit IS the state)
+            out = self.forecaster.predict(self.horizon)
+            if hasattr(out, "columns"):  # prophet forecast frame
+                out = out["yhat"]
+            return np.asarray(out, np.float64).reshape(-1)
         return self.forecaster.predict(self._rolled(data))
 
     def evaluate(self, data: TSDataset, metrics=("mse",)) -> Dict:
+        if self._statistical():
+            return self.forecaster.evaluate(self._series(data),
+                                            metrics=metrics)
         return self.forecaster.evaluate(self._rolled(data), metrics=metrics)
 
     def save(self, path: str):
@@ -182,7 +260,8 @@ class TSPipeline:
                          "scaler": self.scaler,
                          "forecaster_cls": type(self.forecaster).__name__,
                          "forecaster_args": dict(
-                             self.forecaster._ctor_args)}, f)
+                             getattr(self.forecaster, "_ctor_args",
+                                     {}))}, f)
 
     @staticmethod
     def load(path: str) -> "TSPipeline":

@@ -1,0 +1,96 @@
+"""Automated Prophet search (gated — prophet is not in this image).
+
+Rebuild of the reference's ``AutoProphet``
+(``pyzoo/zoo/chronos/autots/model/auto_prophet.py``: hp search over
+changepoint/seasonality priors under Ray Tune). The trial runs the
+gated :class:`~zoo_tpu.chronos.forecaster.ProphetForecaster`; importing
+this module works everywhere, constructing raises until the prophet
+package is installed (same gating as the forecaster).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class AutoProphet:
+    """reference ``auto_prophet.py``: search over changepoint_prior_scale,
+    seasonality_prior_scale, holidays_prior_scale, seasonality_mode."""
+
+    def __init__(self, changepoint_prior_scale=0.05,
+                 seasonality_prior_scale=10.0, holidays_prior_scale=10.0,
+                 seasonality_mode="additive", changepoint_range=0.8,
+                 metric: str = "mse",
+                 logs_dir: str = "/tmp/auto_prophet_logs",
+                 cpus_per_trial: int = 1, name: str = "auto_prophet",
+                 **prophet_config):
+        try:
+            import prophet  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "AutoProphet needs the 'prophet' package, which is not "
+                "bundled in this image; pip install prophet (the "
+                "AutoARIMA statistical search works without it)") from e
+        self.search_space = {
+            "changepoint_prior_scale": changepoint_prior_scale,
+            "seasonality_prior_scale": seasonality_prior_scale,
+            "holidays_prior_scale": holidays_prior_scale,
+            "seasonality_mode": seasonality_mode,
+            "changepoint_range": changepoint_range,
+        }
+        self.search_space.update(prophet_config)
+        self.metric = metric
+        self._best_model = None
+        self._best_config = None
+
+    def fit(self, data, epochs: int = 1, validation_data=None,
+            metric_threshold: Optional[float] = None, n_sampling: int = 1,
+            search_alg=None, search_alg_params=None, scheduler=None,
+            scheduler_params=None, n_parallel: int = 1):
+        """``data``: pandas frame with ``ds``/``y`` columns (the prophet
+        contract, as in the reference)."""
+        from zoo_tpu.automl.search import LocalSearchEngine
+        from zoo_tpu.chronos.forecaster.arima_forecaster import (
+            ProphetForecaster,
+        )
+
+        if validation_data is None:
+            cut = max(1, int(len(data) * 0.8))
+            train, val = data.iloc[:cut], data.iloc[cut:]
+        else:
+            train, val = data, validation_data
+
+        def trial_fn(config):
+            f = ProphetForecaster(**config)
+            f.fit(train)
+            pred = f.predict(len(val))
+            yhat = np.asarray(pred["yhat"], np.float64)
+            yv = np.asarray(val["y"], np.float64)
+            from zoo_tpu.chronos.forecaster.base import compute_metrics
+            res = compute_metrics(yv, yhat, [self.metric])
+            return {self.metric: res[self.metric], "model": f}
+
+        eng = LocalSearchEngine(n_parallel=n_parallel,
+                                search_alg=search_alg,
+                                scheduler=scheduler,
+                                partition_devices=False)
+        eng.compile(trial_fn, dict(self.search_space),
+                    n_sampling=n_sampling, metric=self.metric,
+                    mode="min")
+        eng.run()
+        best = eng.get_best_trial()
+        self._best_config = dict(best.config)
+        self._best_model = best.artifacts["model"]
+        return self
+
+    def get_best_model(self):
+        if self._best_model is None:
+            raise RuntimeError("fit() first")
+        return self._best_model
+
+    def get_best_config(self):
+        if self._best_config is None:
+            raise RuntimeError("fit() first")
+        return dict(self._best_config)

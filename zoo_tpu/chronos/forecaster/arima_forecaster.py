@@ -146,8 +146,29 @@ class ProphetForecaster:
             changepoint_range=changepoint_range)
 
     def fit(self, data, **kwargs):
+        self._fit_rows = len(data)
         return self.model.fit(data)
 
     def predict(self, horizon: int = 1, freq: str = "D", **kwargs):
+        """Forecast frame for the ``horizon`` FUTURE periods only
+        (prophet's own predict also returns the in-sample history rows;
+        consumers want the forecast)."""
         future = self.model.make_future_dataframe(periods=horizon, freq=freq)
-        return self.model.predict(future)
+        return self.model.predict(future).tail(horizon)
+
+    def evaluate(self, target, metrics=("mse",), **kwargs):
+        from zoo_tpu.chronos.forecaster.base import compute_metrics
+        target = np.asarray(target, np.float64).reshape(-1)
+        yhat = np.asarray(self.predict(len(target))["yhat"], np.float64)
+        return compute_metrics(target, yhat, metrics)
+
+    def save(self, checkpoint_file: str):
+        import pickle
+        with open(checkpoint_file, "wb") as f:
+            pickle.dump(self.model, f)
+
+    def load(self, checkpoint_file: str):
+        import pickle
+        with open(checkpoint_file, "rb") as f:
+            self.model = pickle.load(f)
+        return self

@@ -98,8 +98,9 @@ def _kernel(x_ref, w1_ref, w2_ref, w3_ref, y_ref, t_scr, *, k, h, w,
     y_ref[:] = jnp.maximum(z3 + xin, 0.0).reshape(k, h * w, cin)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
 def fused_bottleneck(x: jnp.ndarray, w1: jnp.ndarray, w2: jnp.ndarray,
-                     w3: jnp.ndarray, *,
+                     w3: jnp.ndarray,
                      interpret: Optional[bool] = None) -> jnp.ndarray:
     """``relu(x + expand(relu(conv3x3(relu(reduce(x))))))`` fused.
 
@@ -108,7 +109,38 @@ def fused_bottleneck(x: jnp.ndarray, w1: jnp.ndarray, w2: jnp.ndarray,
     interpret contract (``interpret=None`` → Pallas interpreter
     off-TPU, compiled kernel on TPU); on TPU a geometry exceeding the
     kernel's VMEM plan falls back to the XLA composition.
+
+    Differentiable via ``jax.custom_vjp``: the backward RECOMPUTES the
+    XLA composition's residuals and reuses its VJP (the kernel writes
+    only ``y``, so t1/t2 are not available to save — exporting them
+    would double the HBM writes the fusion exists to avoid). Training
+    cost is therefore fused_fwd + ~1 extra XLA forward vs the all-XLA
+    block; with the conv2_x fused speedup at most 1.65x of one forward,
+    the net train-step delta is negative — measured and documented in
+    the module docstring. Train with the stock XLA convs; this op's
+    win is inference.
     """
+    return _fused_bottleneck_impl(x, w1, w2, w3, interpret)
+
+
+def _fused_bottleneck_fwd(x, w1, w2, w3, interpret):
+    return _fused_bottleneck_impl(x, w1, w2, w3, interpret), \
+        (x, w1, w2, w3)
+
+
+def _fused_bottleneck_bwd(interpret, res, g):
+    x, w1, w2, w3 = res
+    _, vjp = jax.vjp(_xla_block, x, w1, w2, w3)
+    return vjp(g.astype(x.dtype))
+
+
+fused_bottleneck.defvjp(_fused_bottleneck_fwd, _fused_bottleneck_bwd)
+
+
+def _fused_bottleneck_impl(x: jnp.ndarray, w1: jnp.ndarray,
+                           w2: jnp.ndarray, w3: jnp.ndarray,
+                           interpret: Optional[bool] = None
+                           ) -> jnp.ndarray:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 

@@ -189,6 +189,11 @@ class ProcessMonitor:
             if all(w.returncode == 0 for w in self.workers):
                 return
             if self._stop.is_set():
+                # the watch thread assigns _failed BEFORE setting _stop;
+                # re-check so a failure set between our two reads is not
+                # mistaken for a deliberate stop()
+                if self._failed:
+                    raise RuntimeError(self._failed)
                 return  # deliberate stop(): termination, not failure
             if deadline is not None and time.time() > deadline:
                 self.stop()
@@ -240,6 +245,51 @@ def launch_local_cluster(nproc: int, script: str,
     return ProcessMonitor(workers, max_restarts=max_restarts).start()
 
 
+def run_elastic(nproc: int, script: str, args: Sequence[str] = (),
+                min_workers: int = 1, max_restarts: int = 0,
+                local_devices_per_proc: int = 1,
+                log_dir: Optional[str] = None,
+                env: Optional[Dict[str, str]] = None,
+                wait_timeout: Optional[float] = None) -> int:
+    """Scale-down elastic supervision (SURVEY §5.3; reference:
+    ``Topology.scala:1255-1337`` retries within the job from the latest
+    snapshot — this is that mechanism lifted to the supervisor, plus the
+    re-mesh the reference cannot do).
+
+    Runs ``script`` as an ``nproc``-process cluster. Same-size crashes
+    are handled inside :class:`ProcessMonitor` (per-worker
+    ``max_restarts``). When a worker exhausts its budget — a PERMANENT
+    loss — the whole group is torn down and relaunched as an
+    ``nproc-1``-process cluster (fresh coordinator, smaller mesh); the
+    training script is expected to resume from its latest checkpoint
+    (``est.load_orca_checkpoint()``), which the env var
+    ``ZOO_ELASTIC_ATTEMPT`` (> "0") signals. Stops scaling at
+    ``min_workers``; returns the world size that completed.
+    """
+    n, attempt = int(nproc), 0
+    while True:
+        wenv = dict(env or {})
+        wenv["ZOO_ELASTIC_ATTEMPT"] = str(attempt)
+        mon = launch_local_cluster(
+            n, script, args, max_restarts=max_restarts,
+            local_devices_per_proc=local_devices_per_proc,
+            log_dir=log_dir, env=wenv)
+        try:
+            mon.wait(timeout=wait_timeout)
+            return n
+        except RuntimeError as e:
+            mon.stop()
+            if n - 1 < min_workers:
+                raise RuntimeError(
+                    f"cannot scale below min_workers={min_workers} "
+                    f"(world {n} failed: {e})") from e
+            logger.warning(
+                "permanent worker loss at world size %d (%s); resuming "
+                "from the latest checkpoint on %d workers", n, e, n - 1)
+            n -= 1
+            attempt += 1
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     import argparse
 
@@ -251,19 +301,32 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--max-restarts", type=int, default=0)
     ap.add_argument("--devices-per-proc", type=int, default=1)
     ap.add_argument("--log-dir", default=None)
+    ap.add_argument("--elastic-min-workers", type=int, default=0,
+                    help="enable scale-down elastic mode: on permanent "
+                         "worker loss, relaunch the job on a smaller "
+                         "mesh (resuming from the latest checkpoint) "
+                         "down to this world size")
     ap.add_argument("script")
     ap.add_argument("args", nargs=argparse.REMAINDER)
     ns = ap.parse_args(argv)
-    mon = launch_local_cluster(
-        ns.nproc, ns.script, ns.args,
-        local_devices_per_proc=ns.devices_per_proc,
-        max_restarts=ns.max_restarts, log_dir=ns.log_dir)
     try:
+        if ns.elastic_min_workers > 0:
+            run_elastic(ns.nproc, ns.script, ns.args,
+                        min_workers=ns.elastic_min_workers,
+                        max_restarts=ns.max_restarts,
+                        local_devices_per_proc=ns.devices_per_proc,
+                        log_dir=ns.log_dir)
+            return 0
+        mon = launch_local_cluster(
+            ns.nproc, ns.script, ns.args,
+            local_devices_per_proc=ns.devices_per_proc,
+            max_restarts=ns.max_restarts, log_dir=ns.log_dir)
         mon.wait()
         return 0
     except (RuntimeError, KeyboardInterrupt) as e:
         logger.error("%s", e)
-        mon.stop()
+        if "mon" in locals():
+            mon.stop()
         return 1
 
 

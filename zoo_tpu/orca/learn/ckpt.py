@@ -23,7 +23,22 @@ _STEP_RE = re.compile(r"^(\d+)$")
 
 
 def _ensure_host(tree):
-    return jax.tree_util.tree_map(np.asarray, tree)
+    def to_host(a):
+        if hasattr(a, "is_fully_addressable") and \
+                not a.is_fully_addressable:
+            # multi-process global array: this process only holds its
+            # shards; np.asarray would raise. DP-replicated params have
+            # a full copy in the first addressable shard.
+            shard = a.addressable_shards[0]
+            if shard.data.shape == a.shape:
+                return np.asarray(shard.data)
+            raise ValueError(
+                "cannot checkpoint a cross-process SHARDED array from "
+                "one process; gather it (e.g. "
+                "multihost_utils.process_allgather) first")
+        return np.asarray(a)
+
+    return jax.tree_util.tree_map(to_host, tree)
 
 
 class CheckpointManager:
@@ -50,7 +65,10 @@ class CheckpointManager:
         path = os.path.join(self.directory, str(step))
         host_state = _ensure_host(state)
         saved = False
-        if self._ckptr is not None:
+        # orbax's save runs a cross-process barrier; a single-rank save
+        # (the estimator checkpoints from rank 0 only) would deadlock
+        # every other rank's next collective — use the pickle path
+        if self._ckptr is not None and jax.process_count() == 1:
             try:
                 self._ckptr.save(path, host_state, force=True)
                 self._ckptr.wait_until_finished()

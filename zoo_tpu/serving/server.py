@@ -99,11 +99,23 @@ class _Request:
 
 class ServingServer:
     """``ServingServer(inference_model).start()`` → serve until
-    ``stop()``."""
+    ``stop()``.
+
+    ``num_replicas``: size of the worker pool behind the TCP door — the
+    role of the reference's Flink task-slot parallelism
+    (``serving/ClusterServing.scala:54-67``: one model copy per slot
+    draining a shared queue). Each replica is a batcher thread pulling
+    from the shared request queue; pass ``models=[...]`` to give every
+    replica its own model copy (distinct devices / true CPU
+    parallelism), else they share ``model`` (bounded by its
+    ``supported_concurrent_num`` semaphore)."""
 
     def __init__(self, model, host: str = "127.0.0.1", port: int = 0,
-                 batch_size: int = 8, max_wait_ms: float = 5.0):
+                 batch_size: int = 8, max_wait_ms: float = 5.0,
+                 num_replicas: int = 1, models=None):
         self.model = model
+        self._replicas = list(models) if models else \
+            [model] * max(1, int(num_replicas))
         self.batch_size = batch_size
         self.max_wait_ms = max_wait_ms
         self.timers = {"batch": StageTimer(), "inference": StageTimer(),
@@ -151,7 +163,8 @@ class ServingServer:
         self.host, self.port = self._server.server_address
 
     # -- batcher -----------------------------------------------------------
-    def _batch_loop(self):
+    def _batch_loop(self, model=None):
+        model = model if model is not None else self.model
         while not self._stop.is_set():
             try:
                 first = self._queue.get(timeout=0.1)
@@ -174,8 +187,8 @@ class ServingServer:
             try:
                 arrays = [np.asarray(r.data) for r in batch]
                 stacked = np.concatenate(arrays, axis=0)
-                preds = self.model.predict(stacked,
-                                           batch_size=self.batch_size)
+                preds = model.predict(stacked,
+                                      batch_size=self.batch_size)
                 offset = 0
                 for r, a in zip(batch, arrays):
                     r.result = np.asarray(preds[offset:offset + len(a)])
@@ -190,9 +203,12 @@ class ServingServer:
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "ServingServer":
         self._threads = [
-            threading.Thread(target=self._server.serve_forever, daemon=True),
-            threading.Thread(target=self._batch_loop, daemon=True),
-        ]
+            threading.Thread(target=self._server.serve_forever,
+                             daemon=True)]
+        self._threads += [
+            threading.Thread(target=self._batch_loop, args=(m,),
+                             daemon=True, name=f"zoo-serving-replica-{i}")
+            for i, m in enumerate(self._replicas)]
         for t in self._threads:
             t.start()
         return self

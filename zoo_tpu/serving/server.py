@@ -112,12 +112,23 @@ class ServingServer:
 
     def __init__(self, model, host: str = "127.0.0.1", port: int = 0,
                  batch_size: int = 8, max_wait_ms: float = 5.0,
-                 num_replicas: int = 1, models=None):
+                 num_replicas: int = 1, models=None,
+                 certfile: str = None, keyfile: str = None):
+        """``certfile``/``keyfile``: serve over TLS — the trusted-
+        serving door of the reference's PPML trusted-realtime-ml story
+        (``ppml/trusted-realtime-ml/``: encrypted transport in front of
+        the serving pipeline; model-at-rest encryption is
+        ``InferenceModel.load_encrypted``)."""
         self.model = model
         self._replicas = list(models) if models else \
             [model] * max(1, int(num_replicas))
         self.batch_size = batch_size
         self.max_wait_ms = max_wait_ms
+        self._ssl_ctx = None
+        if certfile:
+            import ssl
+            self._ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            self._ssl_ctx.load_cert_chain(certfile, keyfile)
         self.timers = {"batch": StageTimer(), "inference": StageTimer(),
                        "total": StageTimer()}
         self._queue: "queue.Queue[_Request]" = queue.Queue()
@@ -126,6 +137,16 @@ class ServingServer:
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
+            def setup(self):
+                # TLS handshake PER CONNECTION THREAD — in get_request
+                # it would run on the accept loop, where one idle client
+                # blocks every other connection (and stop())
+                if outer._ssl_ctx is not None:
+                    self.request.settimeout(10.0)  # handshake bound
+                    self.request = outer._ssl_ctx.wrap_socket(
+                        self.request, server_side=True)
+                    self.request.settimeout(None)
+
             def handle(self):
                 while True:
                     msg = _recv_msg(self.request)
@@ -158,6 +179,17 @@ class ServingServer:
         class Server(socketserver.ThreadingTCPServer):
             daemon_threads = True
             allow_reuse_address = True
+
+            def handle_error(inner, request, client_address):
+                # failed TLS handshakes (plaintext probes, timeouts) are
+                # a per-connection event, not a server stack trace
+                import ssl as _ssl
+                import sys as _sys
+                exc = _sys.exc_info()[1]
+                if isinstance(exc, (_ssl.SSLError, TimeoutError, OSError)):
+                    return
+                super(Server, inner).handle_error(request,
+                                                  client_address)
 
         self._server = Server((host, port), Handler)
         self.host, self.port = self._server.server_address

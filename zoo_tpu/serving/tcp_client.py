@@ -18,8 +18,20 @@ from zoo_tpu.serving.server import _recv_msg, _send_msg
 
 
 class _Connection:
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int, tls: bool = False,
+                 cafile: str = None, verify: bool = True):
         self._sock = socket.create_connection((host, port))
+        if tls:
+            import ssl
+            ctx = ssl.create_default_context(cafile=cafile)
+            if not verify:
+                # EXPLICIT opt-out only (self-signed dev certs):
+                # encryption without server authentication — never
+                # inferred from a missing cafile
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+            self._sock = ctx.wrap_socket(self._sock,
+                                         server_hostname=host)
         self._lock = threading.Lock()
 
     def rpc(self, msg: Dict) -> Dict:
@@ -35,8 +47,14 @@ class _Connection:
 
 
 class TCPInputQueue:
-    def __init__(self, host: str = "127.0.0.1", port: int = 8980):
-        self._conn = _Connection(host, port)
+    def __init__(self, host: str = "127.0.0.1", port: int = 8980,
+                 tls: bool = False, cafile: str = None,
+                 verify: bool = True):
+        """``tls=True`` encrypts the connection; the server cert is
+        verified against ``cafile`` (or the system store). Pass
+        ``verify=False`` ONLY for self-signed dev certs."""
+        self._conn = _Connection(host, port, tls=tls, cafile=cafile,
+                                 verify=verify)
         self._results: Dict[str, np.ndarray] = {}
 
     def enqueue(self, uri: str, **data) -> None:

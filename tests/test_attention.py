@@ -42,6 +42,7 @@ def test_split_merge_heads_roundtrip():
         np.asarray(merge_heads(split_heads(x, 4))), np.asarray(x))
 
 
+@pytest.mark.heavy
 def test_transformer_causal_no_leak():
     t = TransformerLayer(vocab=50, seq_len=8, n_block=2, hidden_size=16,
                          n_head=2)
@@ -55,6 +56,7 @@ def test_transformer_causal_no_leak():
     assert np.abs(y1[:, -1] - y2[:, -1]).max() > 1e-4
 
 
+@pytest.mark.heavy
 def test_bert_outputs_and_mask():
     b = BERT(vocab=60, hidden_size=16, n_block=2, n_head=2, seq_len=8,
              intermediate_size=32, max_position_len=8)

@@ -69,6 +69,7 @@ def test_auto_estimator_keras(orca_ctx):
     assert best.predict(x[:8]).shape == (8, 1)
 
 
+@pytest.mark.heavy
 def test_autots_estimator(orca_ctx, tmp_path):
     from zoo_tpu.chronos.autots import AutoTSEstimator, TSPipeline
     from zoo_tpu.chronos.data import TSDataset
@@ -177,6 +178,7 @@ def test_asha_stops_underperformers():
     assert worst < 9, epochs_run  # the bad tail was cut early
 
 
+@pytest.mark.heavy
 def test_autots_accepts_search_alg_and_scheduler(orca_ctx):
     from zoo_tpu.chronos.autots import AutoTSEstimator, TSPipeline
     from zoo_tpu.chronos.data import TSDataset
@@ -199,6 +201,7 @@ def test_autots_accepts_search_alg_and_scheduler(orca_ctx):
     assert np.isfinite(pipeline.evaluate(test, metrics=["mse"])["mse"])
 
 
+@pytest.mark.heavy
 def test_auto_estimator_accepts_tpe(orca_ctx):
     from zoo_tpu.pipeline.api.keras import Sequential
     from zoo_tpu.pipeline.api.keras.layers import Dense

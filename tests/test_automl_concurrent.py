@@ -9,6 +9,10 @@ import numpy as np
 import pytest
 
 
+
+# compile-bound on a 1-core box: the --all tier runs these
+pytestmark = pytest.mark.heavy
+
 def test_submesh_partition_and_concurrency(orca_ctx):
     """8 virtual devices / 4 concurrent trials: every trial runs under
     its own disjoint 2-device mesh, results match the sequential run,

@@ -73,6 +73,7 @@ def test_tsdataset_split_and_resample():
     assert len(ts.df) == 50
 
 
+@pytest.mark.heavy
 def test_lstm_forecaster_learns(orca_ctx):
     df = _sine_df(300)
     ts = TSDataset.from_pandas(df, dt_col="ts", target_col="value")
@@ -101,6 +102,7 @@ def test_tcn_forecaster_multistep(orca_ctx):
     assert res["rmse"] < 0.6
 
 
+@pytest.mark.heavy
 def test_seq2seq_forecaster(orca_ctx):
     df = _sine_df(200)
     ts = TSDataset.from_pandas(df, dt_col="ts", target_col="value")
@@ -366,6 +368,7 @@ def test_trend_slope_exact_on_linear_series():
     np.testing.assert_allclose(slopes[1:], 1.0, atol=1e-9)
 
 
+@pytest.mark.heavy
 def test_tcmf_tcn_temporal_beats_ar(tmp_path):
     """temporal_model='tcn' (DeepGLO's actual temporal network) must beat
     the linear AR fallback on a panel whose factors follow threshold-AR

@@ -48,6 +48,7 @@ class _SabotagedStep:
         return self.real(*args, **kwargs)
 
 
+@pytest.mark.heavy
 def test_elastic_retry_resumes_training(orca_ctx, tmp_path):
     data = _data()
     est = Estimator.from_keras(_make_model(), model_dir=str(tmp_path))

@@ -23,6 +23,7 @@ def _ml_synth(n=512, users=40, items=60, classes=5, seed=0):
     return user, item, label
 
 
+@pytest.mark.heavy
 def test_ncf_estimator_xshards_fit(orca_ctx, tmp_path):
     user, item, label = _ml_synth()
     data = XShards.partition({
@@ -67,6 +68,7 @@ def test_ncf_estimator_dataframe_cols(orca_ctx):
     assert len(hist["loss"]) == 2
 
 
+@pytest.mark.heavy
 def test_checkpoint_resume(orca_ctx, tmp_path):
     user, item, label = _ml_synth(n=256)
     x = np.stack([user, item], axis=1).astype(np.int32)

@@ -18,6 +18,7 @@ def _linear_data(n=256, d=4, seed=0):
     return x, (x @ w).astype(np.float32)
 
 
+@pytest.mark.heavy
 def test_from_torch_fit_improves(orca_ctx):
     x, y = _linear_data()
     net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 1))
@@ -57,6 +58,7 @@ def test_bridge_conv_matches_torch(orca_ctx):
     np.testing.assert_allclose(got, ref, atol=1e-4)
 
 
+@pytest.mark.heavy
 def test_cross_entropy_classifier(orca_ctx):
     rs = np.random.RandomState(0)
     x = rs.randn(256, 4).astype(np.float32)

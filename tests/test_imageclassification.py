@@ -22,6 +22,10 @@ from zoo_tpu.models.image import (
 SMALL = (64, 64, 3)
 
 
+
+# compile-bound on a 1-core box: the --all tier runs these
+pytestmark = pytest.mark.heavy
+
 @pytest.mark.parametrize("builder", [
     # mobilenet_v1 is the fast-tier representative; the big builds are
     # 13-34s of pure compile each on a 1-core box — slow tier

@@ -289,10 +289,15 @@ import sys
 k = rsa.generate_private_key(public_exponent=65537, key_size=2048)
 name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, u"localhost")])
 now = datetime.datetime.utcnow()
+import ipaddress
 cert = (x509.CertificateBuilder().subject_name(name).issuer_name(name)
         .public_key(k.public_key()).serial_number(1)
         .not_valid_before(now)
         .not_valid_after(now + datetime.timedelta(days=1))
+        .add_extension(x509.SubjectAlternativeName([
+            x509.DNSName(u"localhost"),
+            x509.IPAddress(ipaddress.ip_address(u"127.0.0.1"))]),
+            critical=False)
         .sign(k, hashes.SHA256()))
 open(sys.argv[1], "wb").write(cert.public_bytes(serialization.Encoding.PEM))
 open(sys.argv[2], "wb").write(k.private_bytes(
@@ -310,12 +315,17 @@ open(sys.argv[2], "wb").write(k.private_bytes(
     server = ServingServer(Echo(), port=0, batch_size=4,
                            certfile=str(cert), keyfile=str(key)).start()
     try:
-        # verify against the self-signed cert itself (cafile) — the
-        # authenticated path; verify=False is the dev-only opt-out
+        # the AUTHENTICATED path: verify (default True) against the
+        # self-signed cert as the CA — SAN covers 127.0.0.1
         q = TCPInputQueue(server.host, server.port, tls=True,
-                          cafile=str(cert), verify=False)
+                          cafile=str(cert))
         out = q.predict(np.zeros((2, 3), np.float32))
         np.testing.assert_allclose(out, 1.0)
+        # the dev-only opt-out (encryption without authentication)
+        q3 = TCPInputQueue(server.host, server.port, tls=True,
+                           verify=False)
+        np.testing.assert_allclose(
+            q3.predict(np.zeros((1, 3), np.float32)), 1.0)
         # plaintext client against the TLS door fails, never half-works
         with pytest.raises(Exception):
             q2 = TCPInputQueue(server.host, server.port)

@@ -45,6 +45,7 @@ def test_sequential_with_bn_dropout(orca_ctx):
     assert not np.allclose(np.asarray(bn["stats"]["mean"]), 0)
 
 
+@pytest.mark.heavy
 def test_functional_two_tower(orca_ctx):
     """Two-input functional model (the NCF topology shape)."""
     rs = np.random.RandomState(0)

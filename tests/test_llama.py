@@ -25,6 +25,7 @@ def _build(cfg, **kw):
     return layer, params
 
 
+@pytest.mark.heavy
 def test_forward_shapes():
     cfg = tiny_llama_config()
     layer, params = _build(cfg)

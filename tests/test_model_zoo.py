@@ -8,6 +8,7 @@ import pytest
 from zoo_tpu.pipeline.api.keras.optimizers import Adam
 
 
+@pytest.mark.heavy
 def test_wide_and_deep(orca_ctx):
     from zoo_tpu.models.recommendation.wide_and_deep import (
         ColumnFeatureInfo,
@@ -152,6 +153,7 @@ def test_resnet18_tiny(orca_ctx):
     assert n_bn > 10
 
 
+@pytest.mark.heavy
 def test_ssd_detection_pipeline(orca_ctx):
     """SSD: anchors, decode, NMS, end-to-end predict_detections layout."""
     import jax.numpy as jnp
@@ -184,6 +186,7 @@ def test_ssd_detection_pipeline(orca_ctx):
     np.testing.assert_allclose(out, [[0.4, 0.4, 0.6, 0.6]], atol=1e-6)
 
 
+@pytest.mark.heavy
 def test_object_detector_image_set(orca_ctx):
     from zoo_tpu.feature.image import ImageSet
     from zoo_tpu.models.image import SSD, ObjectDetector

@@ -18,6 +18,10 @@ from zoo_tpu.parallel import build_mesh, pipeline_apply, stack_stages
 from zoo_tpu.parallel.hlo_check import collective_counts
 
 
+
+# compile-bound on a 1-core box: the --all tier runs these
+pytestmark = pytest.mark.heavy
+
 def _mesh(**axes):
     n = int(np.prod(list(axes.values())))
     if len(jax.devices()) < n:

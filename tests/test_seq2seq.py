@@ -22,6 +22,7 @@ def _data(rs, n=128, t=5, f=3):
 
 @pytest.mark.parametrize("rnn_type,bridge_type", [
     ("lstm", "dense"), ("gru", "densenonlinear")])
+@pytest.mark.heavy
 def test_seq2seq_teacher_forcing_trains(orca_ctx, rnn_type, bridge_type):
     from zoo.models.seq2seq import Bridge, RNNDecoder, RNNEncoder, Seq2seq
 

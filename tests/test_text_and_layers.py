@@ -44,6 +44,7 @@ def test_textset_read_dir_and_split(tmp_path):
     assert len(tr) + len(te) == 5
 
 
+@pytest.mark.heavy
 def test_textset_feeds_text_classifier(orca_ctx):
     """End-to-end: corpus -> chain -> TextClassifier trains (VERDICT #7
     'a text-classification example trains')."""

@@ -151,6 +151,7 @@ def test_sequence_tagger_words_only():
     m.fit(words, [tags, chunk], batch_size=16, nb_epoch=1, verbose=0)
 
 
+@pytest.mark.heavy
 def test_intent_entity_multitask(tmp_path):
     words, chars, tags = _data(n=48, n_tags=4)
     intent = (words.sum(axis=1) % 3).astype(np.int32)

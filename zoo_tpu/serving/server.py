@@ -147,6 +147,16 @@ class ServingServer:
                         self.request, server_side=True)
                     self.request.settimeout(None)
 
+            def finish(self):
+                # wrap_socket detaches the original fd, so socketserver's
+                # shutdown_request closes the dead pre-wrap object; close
+                # the SSLSocket here for a clean close_notify + fd release
+                if outer._ssl_ctx is not None:
+                    try:
+                        self.request.close()
+                    except OSError:
+                        pass
+
             def handle(self):
                 while True:
                     msg = _recv_msg(self.request)
@@ -181,12 +191,14 @@ class ServingServer:
             allow_reuse_address = True
 
             def handle_error(inner, request, client_address):
-                # failed TLS handshakes (plaintext probes, timeouts) are
-                # a per-connection event, not a server stack trace
-                import ssl as _ssl
+                # under TLS, failed handshakes (plaintext probes,
+                # timeouts — all OSError subclasses) are a
+                # per-connection event, not a server stack trace;
+                # plaintext mode keeps full tracebacks
                 import sys as _sys
                 exc = _sys.exc_info()[1]
-                if isinstance(exc, (_ssl.SSLError, TimeoutError, OSError)):
+                if outer._ssl_ctx is not None and isinstance(exc,
+                                                             OSError):
                     return
                 super(Server, inner).handle_error(request,
                                                   client_address)

@@ -277,3 +277,50 @@ def test_trainable_graph_capture_is_pure(orca_ctx):
     grads = jax.grad(lambda p: trainable.loss_fn(p, [x], [y]))(
         trainable.params)
     assert float(np.abs(np.asarray(grads["w"])).sum()) > 0
+
+
+def test_tfestimator_model_fn_trains(orca_ctx):
+    """``TFEstimator.from_model_fn`` (reference ``tfpark/estimator.py:30``)
+    trains a TF1 model_fn graph end to end. ``ModeKeys``/``EstimatorSpec``
+    come from zoo.tfpark — TensorFlow removed tf.estimator in 2.16."""
+    from zoo.tfpark import EstimatorSpec, ModeKeys, TFDataset, TFEstimator
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(128, 6).astype(np.float32)
+    w_true = rs.randn(6, 1).astype(np.float32)
+    y = (x @ w_true).astype(np.float32)
+
+    def model_fn(features, labels, mode, params):
+        W = tf1.get_variable("W", shape=(6, 1),
+                             initializer=tf1.zeros_initializer())
+        pred = tf.matmul(features, W)
+        if mode == ModeKeys.PREDICT:
+            return EstimatorSpec(mode, predictions={"pred": pred,
+                                                    "twice": pred * 2})
+        loss = tf.reduce_mean(tf.square(pred - labels))
+        mae = tf.reduce_mean(tf.abs(pred - labels))
+        train_op = tf1.train.GradientDescentOptimizer(0.1).minimize(loss)
+        return EstimatorSpec(mode, predictions=pred, loss=loss,
+                             train_op=train_op,
+                             eval_metric_ops={"mae": mae})
+
+    def input_fn():
+        return TFDataset.from_ndarrays((x, y), batch_size=32)
+
+    est = TFEstimator.from_model_fn(model_fn, params={})
+    est.train(input_fn, steps=60)
+    ev = est.evaluate(input_fn)
+    assert ev["loss"] < 0.1, ev
+    assert ev["mae"] < 0.4, ev  # eval_metric_ops carried through
+
+    def pred_input_fn():
+        return TFDataset.from_ndarrays(x[:16], batch_size=16)
+
+    preds = est.predict(pred_input_fn, predict_keys="pred")
+    assert preds.shape == (16, 1)
+    # trained weights carried into the PREDICT-mode graph by name
+    np.testing.assert_allclose(preds, (x[:16] @ w_true), atol=0.5)
+    twice = est.predict(pred_input_fn, predict_keys="twice")
+    np.testing.assert_allclose(twice, 2 * preds, rtol=1e-5)
+    with pytest.raises(ValueError, match="unknown predict_keys"):
+        est.predict(pred_input_fn, predict_keys="probabilities")

@@ -128,10 +128,9 @@ def test_tfpark_kerasmodel_fit_from_tf_keras():
 def test_tfpark_migration_errors_name_targets():
     import pytest
 
-    from zoo.tfpark import TFDataset, TFEstimator, TFParkMigrationError
+    from zoo.tfpark import TFDataset, TFParkMigrationError
 
-    with pytest.raises(TFParkMigrationError, match="orca.learn.tf2"):
-        TFEstimator.from_model_fn(lambda f, l, m: None)
+    # TFEstimator.from_model_fn TRAINS now (tests/test_tf1_training.py)
     with pytest.raises(TFParkMigrationError, match="XShards"):
         TFDataset.from_rdd(None)
     with pytest.raises(TFParkMigrationError, match="read_tfrecords"):

@@ -4,15 +4,20 @@ The reference's TFPark (TF1-graphs-on-BigDL: TFOptimizer, TFDataset,
 KerasModel, ``tfpark/tf_optimizer.py:350``) is architecturally obsolete
 here (docs/migration.md) but its *capabilities* are not: ``KerasModel``,
 ``TFDataset`` and ``GANEstimator`` delegate onto the Orca fabric
-(``tfpark/compat.py``), ``TFEstimator`` raises a migration-pointing
-error, and the text model family (``tfpark/text/keras``) is the real
+(``tfpark/compat.py``), ``TFOptimizer``/``TFEstimator`` train TF1
+graphs for real (variables captured as a JAX params pytree, jax.grad of
+the interpreted loss — round 5; ``ModeKeys``/``EstimatorSpec`` shims
+replace the ``tf.estimator`` namespace TensorFlow 2.16 removed), and
+the text model family (``tfpark/text/keras``) is the real
 implementation — so reference imports like ``from zoo.tfpark import
 KerasModel`` and ``from zoo.tfpark.text.keras import NER`` keep working
 through the ``zoo`` compat forwarder.
 """
 
 from zoo_tpu.tfpark.compat import (  # noqa: F401
+    EstimatorSpec,
     GANEstimator,
+    ModeKeys,
     KerasModel,
     TFDataset,
     TFEstimator,
@@ -25,4 +30,4 @@ from zoo_tpu.tfpark.compat import (  # noqa: F401
 
 __all__ = ["KerasModel", "TFDataset", "TFEstimator", "GANEstimator",
            "TFNet", "TFOptimizer", "TFPredictor", "ZooOptimizer",
-           "TFParkMigrationError"]
+           "TFParkMigrationError", "ModeKeys", "EstimatorSpec"]

@@ -12,10 +12,11 @@ real delegation, not stubs: ``KerasModel`` bridges a tf.keras model onto
 the zoo_tpu keras facade (``bridges/keras_bridge.py``) and trains it with
 the jitted fit fabric; ``TFDataset.from_ndarrays`` /
 ``from_tf_data_dataset`` / ``from_dataframe`` feed it; ``GANEstimator``
-is the Orca GAN fabric (``orca/learn/gan.py``). Only the TF1-specific
-surfaces (``model_fn`` Estimators, RDD/placeholder feeds) raise
-migration errors that name their replacement — never a bare
-``ModuleNotFoundError``.
+is the Orca GAN fabric (``orca/learn/gan.py``); ``TFOptimizer`` and
+``TFEstimator`` (model_fn) train TF1 graphs for real on the
+variable-capture + jax.grad machinery (round 5). Only the RDD/
+placeholder-feed constructors raise migration errors that name their
+replacement — never a bare ``ModuleNotFoundError``.
 """
 
 from __future__ import annotations
@@ -27,7 +28,7 @@ import numpy as np
 from zoo_tpu.orca.learn.gan import GANEstimator  # re-export  # noqa: F401
 
 __all__ = ["KerasModel", "TFDataset", "TFEstimator", "GANEstimator",
-           "TFParkMigrationError"]
+           "TFParkMigrationError", "ModeKeys", "EstimatorSpec"]
 
 
 class TFParkMigrationError(NotImplementedError):
@@ -364,26 +365,214 @@ class TFDataset:
             "reader)")
 
 
+class ModeKeys:
+    """``tf.estimator.ModeKeys`` replacement — TensorFlow removed
+    ``tf.estimator`` entirely in 2.16+, so model_fn code must import
+    these from ``zoo.tfpark`` now (same string values as TF1)."""
+
+    TRAIN = "train"
+    EVAL = "eval"
+    PREDICT = "infer"
+
+
+class EstimatorSpec:
+    """``tf.estimator.EstimatorSpec`` replacement (see ModeKeys): the
+    (mode, predictions, loss, train_op) contract a model_fn returns."""
+
+    def __init__(self, mode, predictions=None, loss=None, train_op=None,
+                 eval_metric_ops=None, **_):
+        self.mode = mode
+        self.predictions = predictions
+        self.loss = loss
+        self.train_op = train_op
+        self.eval_metric_ops = eval_metric_ops
+
+
 class TFEstimator:
     """``zoo.tfpark.TFEstimator`` — reference ``tfpark/estimator.py:30``:
-    TF1 ``model_fn`` Estimators on BigDL. TF1 graph-mode ``model_fn``
-    has no equivalent mechanism here; both entry points raise a
-    migration error naming the working replacements."""
+    TF1 ``model_fn`` Estimators. The reference ran them on the JVM
+    fabric; here the model_fn builds a TF1 graph whose variables are
+    captured as a JAX params pytree and trained with ``jax.grad`` of
+    the interpreted loss (the same machinery as
+    ``Estimator.from_graph``).
 
-    _MSG = ("TFEstimator ran TF1 model_fn graphs on the JVM — that "
-            "mechanism does not exist in the TPU-native architecture. "
-            "Migrate to zoo.orca.learn.tf2.Estimator.from_keras "
-            "(a model_creator returning a compiled tf.keras model) or "
-            "zoo.tfpark.KerasModel; frozen TF1 inference graphs load "
-            "through zoo.pipeline.inference.InferenceModel / TFNet "
-            "(bridges/tf_graph.py). See docs/migration.md.")
+    One migration note is forced by TensorFlow itself: ``tf.estimator``
+    was REMOVED from TF 2.16+, so a reference model_fn's
+    ``tf.estimator.EstimatorSpec``/``ModeKeys`` references must become
+    ``zoo.tfpark.EstimatorSpec``/``ModeKeys`` (same shapes/values).
+    ``input_fn`` returns a ``TFDataset`` exactly as in the reference.
+    """
 
-    def __init__(self, *args, **kwargs):
-        raise TFParkMigrationError(self._MSG)
+    def __init__(self, model_fn, params: Optional[dict] = None,
+                 model_dir: Optional[str] = None, config=None):
+        self.model_fn = model_fn
+        self.params = params
+        self.model_dir = model_dir
+        self._trained: Optional[dict] = None  # node name -> ndarray
 
     @classmethod
-    def from_model_fn(cls, *args, **kwargs):
-        raise TFParkMigrationError(cls._MSG)
+    def from_model_fn(cls, model_fn, model_dir: Optional[str] = None,
+                      config=None, params: Optional[dict] = None,
+                      warm_start_from=None):
+        if warm_start_from is not None:
+            raise TFParkMigrationError(
+                "warm_start_from: load the source checkpoint into the "
+                "session yourself and pass its values via model_fn")
+        return cls(model_fn, params=params, model_dir=model_dir,
+                   config=config)
+
+    # -- internals --------------------------------------------------------
+    def _call_model_fn(self, features, labels, mode):
+        import inspect
+
+        sig = inspect.signature(self.model_fn)
+        # the tf.estimator contract: labels/mode/params/config are all
+        # OPTIONAL parameters — pass only what the signature declares
+        kwargs = {"features": features}
+        if "labels" in sig.parameters:
+            kwargs["labels"] = labels
+        if "mode" in sig.parameters:
+            kwargs["mode"] = mode
+        if "params" in sig.parameters:
+            kwargs["params"] = self.params
+        if "config" in sig.parameters:
+            kwargs["config"] = None
+        spec = self.model_fn(**kwargs)
+        if not isinstance(spec, EstimatorSpec):
+            raise TypeError(
+                "model_fn must return zoo.tfpark.EstimatorSpec "
+                "(tf.estimator was removed from TensorFlow 2.16+; "
+                f"got {type(spec).__name__})")
+        return spec
+
+    def _build(self, input_fn, mode):
+        """Run input_fn + model_fn in a fresh TF1 graph; capture."""
+        import tensorflow as tf
+
+        from zoo_tpu.bridges.tf_graph import capture_trainable_graph
+        tf1 = tf.compat.v1
+
+        graph = tf1.Graph()
+        with graph.as_default():
+            ds = input_fn()
+            if not isinstance(ds, TFDataset):
+                raise TypeError(
+                    "input_fn must return a zoo.tfpark.TFDataset "
+                    f"(the reference contract); got {type(ds).__name__}")
+            tensors = ds.tensors
+            if isinstance(tensors, tuple) and len(tensors) == 2 \
+                    and ds.y is not None:
+                features, labels = tensors
+            else:
+                features, labels = tensors, None
+            spec = self._call_model_fn(
+                features, labels if mode != ModeKeys.PREDICT else None,
+                mode)
+            feats = list(features) if isinstance(features, (tuple, list)) \
+                else [features]
+            lbls = [] if labels is None or mode == ModeKeys.PREDICT else (
+                list(labels) if isinstance(labels, (tuple, list))
+                else [labels])
+            preds = spec.predictions
+            pred_keys, outputs = None, []
+            if isinstance(preds, dict):
+                pred_keys = list(preds)
+                outputs = [preds[k] for k in pred_keys]
+            elif preds is not None:
+                outputs = [preds]
+            metrics = None
+            if getattr(spec, "eval_metric_ops", None):
+                # TF metric ops are (value, update_op) pairs; raw value
+                # tensors are accepted too
+                metrics = {k: (v[0] if isinstance(v, (tuple, list))
+                               else v)
+                           for k, v in spec.eval_metric_ops.items()}
+            trainable, sess, tf_vars = capture_trainable_graph(
+                inputs=feats, labels=lbls, loss=spec.loss,
+                outputs=outputs, metrics=metrics)
+        # TFEstimator owns no write-back session (weights travel by
+        # name through self._trained); release the capture session
+        sess.close()
+        if self._trained:
+            # carry weights across per-mode graphs by VARIABLE NAME —
+            # the role tf.estimator's checkpoint round trip played
+            for name, val in self._trained.items():
+                if name in trainable.params:
+                    trainable.params[name] = val
+        return ds, spec, trainable, pred_keys
+
+    @staticmethod
+    def _arrays(ds):
+        xs = [np.asarray(a) for a in (
+            ds.x if isinstance(ds.x, (tuple, list)) else [ds.x])]
+        ys = [] if ds.y is None else [np.asarray(a) for a in (
+            ds.y if isinstance(ds.y, (tuple, list)) else [ds.y])]
+        bs = ds.batch_size if ds.batch_size and ds.batch_size > 0 else 32
+        return xs, ys, bs
+
+    # -- reference API ----------------------------------------------------
+    def train(self, input_fn, steps: Optional[int] = None):
+        from zoo_tpu.bridges.tf_graph import optimizer_from_train_op
+        from zoo_tpu.orca.learn.tf2.graph_estimator import GraphTrainer
+
+        ds, spec, trainable, _ = self._build(input_fn, ModeKeys.TRAIN)
+        if spec.loss is None:
+            raise ValueError("model_fn returned no loss in TRAIN mode")
+        optim = "adam"
+        if spec.train_op is not None:
+            optim = optimizer_from_train_op(
+                trainable.graph_def,
+                getattr(spec.train_op, "name", spec.train_op))
+        trainer = GraphTrainer(trainable, optim)
+        xs, ys, bs = self._arrays(ds)
+        n = xs[0].shape[0]
+        steps_per_epoch = max(1, n // bs)
+        epochs = max(1, -(-(steps or steps_per_epoch) // steps_per_epoch))
+        trainer.fit(xs, ys, epochs=epochs, batch_size=bs,
+                    max_steps=steps)
+        self._trained = trainer.numpy_params()
+        return self
+
+    def evaluate(self, input_fn, eval_methods=None,
+                 steps: Optional[int] = None, checkpoint_path=None):
+        from zoo_tpu.orca.learn.tf2.graph_estimator import GraphTrainer
+
+        ds, spec, trainable, _ = self._build(input_fn, ModeKeys.EVAL)
+        trainer = GraphTrainer(trainable, "adam")
+        xs, ys, bs = self._arrays(ds)
+        return trainer.evaluate(xs, ys, batch_size=bs)
+
+    def predict(self, input_fn, predict_keys=None, checkpoint_path=None):
+        from zoo_tpu.orca.learn.tf2.graph_estimator import GraphTrainer
+
+        ds, spec, trainable, pred_keys = self._build(input_fn,
+                                                     ModeKeys.PREDICT)
+        if spec.predictions is None:
+            raise ValueError(
+                "model_fn returned no predictions in PREDICT mode")
+        trainer = GraphTrainer(trainable, "adam")
+        xs, _ys, bs = self._arrays(ds)
+        # dict predictions come back as ONE output array — the requested
+        # key when predict_keys names it
+        if predict_keys is not None:
+            keys = [predict_keys] if isinstance(predict_keys, str) \
+                else list(predict_keys)
+            if pred_keys is None:
+                raise ValueError(
+                    "predict_keys given but model_fn returned a single "
+                    "tensor, not a dict of predictions")
+            unknown = [k for k in keys if k not in pred_keys]
+            if unknown:
+                raise ValueError(
+                    f"unknown predict_keys {unknown}; model_fn "
+                    f"predictions has {pred_keys}")
+            if len(keys) != 1:
+                raise NotImplementedError(
+                    "one predict_keys entry at a time (the rebuild "
+                    "returns a single array per predict call)")
+            trainable.output_refs = [
+                trainable.output_refs[pred_keys.index(keys[0])]]
+        return trainer.predict(xs, batch_size=bs)
 
 
 class TFNet:

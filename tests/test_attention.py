@@ -110,3 +110,32 @@ def test_tiny_bert_classifier_trains(orca_ctx):
               loss="sparse_categorical_crossentropy", metrics=["accuracy"])
     hist = m.fit(x, y, batch_size=32, nb_epoch=8, verbose=0)
     assert hist["loss"][-1] < hist["loss"][0] * 0.7
+
+
+@pytest.mark.parametrize("remat", ["dots", True])
+def test_transformer_remat_trains(orca_ctx, remat):
+    """remat policies compile and train (the bench BERT row runs
+    remat='dots'); loss matches the no-remat path step-for-step
+    (remat changes memory, never math)."""
+    from zoo_tpu.pipeline.api.keras import Sequential
+    from zoo_tpu.pipeline.api.keras.layers import Dense, Lambda, BERT
+
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 50, (32, 8)).astype(np.int32)
+    y = rs.randint(0, 2, 32).astype(np.int32)
+
+    losses = {}
+    for rm in (False, remat):
+        m = Sequential()
+        m.add(BERT(vocab=50, hidden_size=16, n_block=2, n_head=2,
+                   seq_len=8, intermediate_size=32, hidden_p_drop=0.0,
+                   attn_p_drop=0.0, max_position_len=8, remat=rm,
+                   input_shape=(8,)))
+        m.add(Lambda(lambda h: h[:, 0], output_shape=(16,)))
+        m.add(Dense(2))
+        m.compile(optimizer="sgd",
+                  loss="sparse_categorical_crossentropy_from_logits")
+        h = m.fit(ids, y, batch_size=16, nb_epoch=2, shuffle=False,
+                  verbose=0, seed=0)
+        losses[rm] = h["loss"]
+    np.testing.assert_allclose(losses[False], losses[remat], rtol=1e-4)

@@ -102,7 +102,8 @@ _EXAMPLES = ["ncf_movielens.py", "dogs_vs_cats_resnet.py",
              "tcmf_panel_forecast.py", "moe_llama_pretrain.py",
              "image_augmentation_3d.py", "autograd_custom_loss.py",
              "friesian_recsys_features.py", "inception_training.py",
-             "elastic_training.py", "xshards_preprocessing.py"]
+             "elastic_training.py", "xshards_preprocessing.py",
+             "tf1_graph_training.py"]
 
 
 @pytest.mark.parametrize("script", _EXAMPLES)

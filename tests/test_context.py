@@ -79,3 +79,15 @@ def test_debug_nans_mode():
     finally:
         ZooContext.debug_nans = False
     assert not jax.config.jax_debug_nans
+
+
+def test_envcheck_doctor(orca_ctx):
+    """The env-doctor (reference SparkRunner env-check role) reports the
+    runtime and exits ok in the dev image."""
+    from zoo_tpu.common.envcheck import collect, main
+
+    rows = collect()
+    names = {n for n, _, _ in rows}
+    assert {"python", "jax", "orca context"} <= names
+    assert all(ok for _, ok, _ in rows), rows
+    assert main() == 0

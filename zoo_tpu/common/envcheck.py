@@ -1,0 +1,94 @@
+"""Environment doctor (the reference SparkRunner's env-check role).
+
+The reference's ``SparkRunner``/``init_spark_on_yarn`` path validated
+the launch environment (JVM presence, conda archive, env vars) before
+booting executors (``pyzoo/zoo/util/spark.py``). The TPU-native
+launch has its own preflight surface: JAX platform + device visibility,
+mesh-axis math, multi-process coordination variables, the native IO
+library, and the optional frontend stacks.
+
+``python -m zoo_tpu.common.envcheck`` prints the report and exits
+non-zero when a REQUIRED item fails (the supervisor can gate worker
+launch on it).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import List, Tuple
+
+
+def collect() -> List[Tuple[str, bool, str]]:
+    """(name, ok, detail) triples; ok=False on required-item failure."""
+    out: List[Tuple[str, bool, str]] = []
+    out.append(("python", True, sys.version.split()[0]))
+
+    try:
+        import jax
+        devs = jax.devices()
+        kinds = {getattr(d, "device_kind", "?") for d in devs}
+        out.append(("jax", True,
+                    f"{jax.__version__} backend={jax.default_backend()} "
+                    f"devices={len(devs)} ({', '.join(sorted(kinds))})"))
+        out.append(("multiprocess", True,
+                    f"process {jax.process_index()}/{jax.process_count()}"))
+    except Exception as e:  # noqa: BLE001 — the report IS the handler
+        out.append(("jax", False, f"devices unavailable: {e!r}"))
+
+    coord = os.environ.get("ZOO_COORDINATOR_ADDRESS")
+    if coord:
+        out.append(("coordinator", True,
+                    f"{coord} (world {os.environ.get('ZOO_NUM_PROCESSES')}"
+                    f", rank {os.environ.get('ZOO_PROCESS_ID')})"))
+
+    try:
+        from zoo_tpu.common.context import get_runtime_context
+        ctx = get_runtime_context(required=False)
+        if ctx is not None:
+            out.append(("orca context", True,
+                        f"mode={ctx.cluster_mode} mesh="
+                        f"{dict(ctx.mesh.shape)}"))
+        else:
+            out.append(("orca context", True,
+                        "not initialized (init_orca_context())"))
+    except Exception as e:  # noqa: BLE001
+        out.append(("orca context", False, repr(e)))
+
+    try:
+        from zoo_tpu import native as loader
+        lib = loader.load()
+        out.append(("native IO (zoo_native)", lib is not None,
+                    "loaded" if lib is not None else
+                    "missing — TFRecord CRC + tiered cache fall back to "
+                    "python"))
+    except Exception as e:  # noqa: BLE001
+        out.append(("native IO (zoo_native)", True,
+                    f"python fallback ({e.__class__.__name__})"))
+
+    for mod, required in (("flax", False), ("optax", True),
+                          ("orbax.checkpoint", False),
+                          ("tensorflow", False), ("torch", False),
+                          ("pandas", True), ("pyarrow", False)):
+        try:
+            m = __import__(mod)
+            out.append((mod, True, getattr(m, "__version__", "ok")))
+        except ImportError:
+            out.append((mod, not required, "not installed"
+                        + (" (REQUIRED)" if required else " (optional)")))
+    return out
+
+
+def main(argv=None) -> int:
+    rows = collect()
+    width = max(len(n) for n, _, _ in rows)
+    ok_all = True
+    for name, ok, detail in rows:
+        mark = "ok " if ok else "FAIL"
+        ok_all &= ok
+        print(f"[{mark}] {name:<{width}}  {detail}")
+    return 0 if ok_all else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,9 +38,15 @@ def collect() -> List[Tuple[str, bool, str]]:
 
     coord = os.environ.get("ZOO_COORDINATOR_ADDRESS")
     if coord:
-        out.append(("coordinator", True,
-                    f"{coord} (world {os.environ.get('ZOO_NUM_PROCESSES')}"
-                    f", rank {os.environ.get('ZOO_PROCESS_ID')})"))
+        world = os.environ.get("ZOO_NUM_PROCESSES")
+        rank = os.environ.get("ZOO_PROCESS_ID")
+        # init_orca_context reads all three unconditionally — a partial
+        # launcher config must FAIL the preflight, not pass as healthy
+        ok = world is not None and rank is not None
+        out.append(("coordinator", ok,
+                    f"{coord} (world {world}, rank {rank})"
+                    + ("" if ok else
+                       " — ZOO_NUM_PROCESSES/ZOO_PROCESS_ID missing")))
 
     try:
         from zoo_tpu.common.context import get_runtime_context
@@ -55,13 +61,15 @@ def collect() -> List[Tuple[str, bool, str]]:
     except Exception as e:  # noqa: BLE001
         out.append(("orca context", False, repr(e)))
 
+    # native lib is OPTIONAL by design (documented python fallbacks);
+    # None and an exception are the same condition — report, never fail
     try:
         from zoo_tpu import native as loader
         lib = loader.load()
-        out.append(("native IO (zoo_native)", lib is not None,
+        out.append(("native IO (zoo_native)", True,
                     "loaded" if lib is not None else
-                    "missing — TFRecord CRC + tiered cache fall back to "
-                    "python"))
+                    "python fallback (TFRecord CRC + tiered cache run "
+                    "in python)"))
     except Exception as e:  # noqa: BLE001
         out.append(("native IO (zoo_native)", True,
                     f"python fallback ({e.__class__.__name__})"))
@@ -71,7 +79,8 @@ def collect() -> List[Tuple[str, bool, str]]:
                           ("tensorflow", False), ("torch", False),
                           ("pandas", True), ("pyarrow", False)):
         try:
-            m = __import__(mod)
+            import importlib
+            m = importlib.import_module(mod)  # leaf module, not package
             out.append((mod, True, getattr(m, "__version__", "ok")))
         except ImportError:
             out.append((mod, not required, "not installed"

@@ -8,9 +8,11 @@ Axes (BASELINE.md "rebuild targets"):
   * NCF (MovieLens-1M scale) train samples/s/chip
   * Llama causal-LM tokens/s (+ MFU)
 
-Measurement protocol (round 4 — variance-robust):
+Measurement protocol (round 4, spread redefined round 5):
   * every metric is the MEDIAN of N>=5 timed epochs, published with a
-    ``*_p50`` key plus ``*_spread`` = (max-min)/median over the window;
+    ``*_p50`` key plus ``*_spread`` = IQR/median over the window
+    (inclusive quartiles; windows < 5 fall back to range/median —
+    see ``_stats``; BENCH_r01-r04 spreads were range/median);
   * one sync discipline everywhere: a forced host read of a scalar
     (``float(np.asarray(...))``) — ``block_until_ready`` is not a true
     sync over tunneled PJRT transports;
@@ -76,10 +78,27 @@ def _sync(x) -> float:
 
 
 def _stats(rates):
-    """(p50, spread) for a window of per-epoch rates."""
+    """(p50, spread) for a window of per-epoch rates.
+
+    ``spread`` (round-5 definition): interquartile range / p50 when the
+    window has >= 5 samples, full range / p50 otherwise. The tunnel's
+    per-dispatch latency spikes put one slow epoch in most windows;
+    range-based spread was dominated by that single spike (0.6-1.1 on
+    headline rows), making round-over-round p50 deltas unreadable. IQR
+    ignores the spike tails while still exposing genuine instability —
+    the p50s themselves agreed to 0.2% across two independent round-5
+    runs under both definitions."""
     p50 = statistics.median(rates)
-    spread = (max(rates) - min(rates)) / p50 if p50 > 0 else float("nan")
-    return p50, spread
+    if p50 <= 0:
+        return p50, float("nan")
+    if len(rates) >= 5:
+        # method="inclusive": q1/q3 land ON order statistics, so a
+        # single spike epoch is fully excluded from a 5-sample window
+        # (the default "exclusive" method would still blend ~half of
+        # its excursion into q3)
+        q = statistics.quantiles(rates, n=4, method="inclusive")
+        return p50, (q[2] - q[0]) / p50
+    return p50, (max(rates) - min(rates)) / p50
 
 
 def _timed_fit(model, xs, y, batch_size, epochs=5):

@@ -140,3 +140,64 @@ def test_deliberate_stop_is_not_failure(tmp_path):
     time.sleep(0.6)  # let the watcher observe the killed worker
     assert mon._failed is None
     mon.wait(timeout=5)  # returns: deliberate stop, not a crash
+
+
+def test_hung_worker_detected_by_heartbeat(tmp_path):
+    """A worker that stops beating (hung, not exited) is killed and
+    charged to the restart budget like any crash."""
+    hb = str(tmp_path / "w.heartbeat")
+    script = _script(tmp_path, f"""
+        import sys, time
+        sys.path.insert(0, {os.getcwd()!r})
+        from zoo_tpu.util.resilience import touch_heartbeat
+        touch_heartbeat({hb!r})
+        time.sleep(600)  # hangs: never beats again
+    """, name="hung.py")
+    w = WorkerProcess([sys.executable, script], dict(os.environ), "hw",
+                      heartbeat_file=hb)
+    mon = ProcessMonitor([w], max_restarts=0, poll_interval=0.1,
+                         heartbeat_timeout=1.0).start()
+    with pytest.raises(RuntimeError, match="heartbeat stale"):
+        mon.wait(timeout=60)
+    assert w.returncode is not None  # the hung process was killed
+
+
+def test_hung_worker_restarts_within_budget(tmp_path):
+    """First incarnation hangs after stamping once; the respawned one
+    completes. The heartbeat path must spend the restart budget, not
+    tear the group down."""
+    marker = str(tmp_path / "hung_once")
+    hb = str(tmp_path / "w2.heartbeat")
+    script = _script(tmp_path, f"""
+        import os, sys, time
+        sys.path.insert(0, {os.getcwd()!r})
+        from zoo_tpu.util.resilience import touch_heartbeat
+        touch_heartbeat({hb!r})
+        if not os.path.exists({marker!r}):
+            open({marker!r}, "w").close()
+            time.sleep(600)  # first run hangs
+        open({marker!r} + ".ok", "w").close()
+    """, name="hang_once.py")
+    w = WorkerProcess([sys.executable, script], dict(os.environ), "hw2",
+                      heartbeat_file=hb)
+    mon = ProcessMonitor([w], max_restarts=1, poll_interval=0.1,
+                         heartbeat_timeout=1.0).start()
+    mon.wait(timeout=60)
+    assert os.path.exists(marker + ".ok")
+    assert w.restarts == 1
+
+
+def test_heartbeat_env_reaches_workers(tmp_path):
+    """launch_local_cluster with heartbeat_timeout hands every worker a
+    ZOO_HEARTBEAT_FILE and the supervisor watches it."""
+    script = _script(tmp_path, """
+        import os
+        assert os.environ.get("ZOO_HEARTBEAT_FILE"), "no heartbeat env"
+        assert float(os.environ["ZOO_HEARTBEAT_INTERVAL"]) > 0
+    """, name="hb_env.py")
+    mon = launch_local_cluster(2, script, heartbeat_timeout=30.0,
+                               log_dir=str(tmp_path / "logs"))
+    mon.wait(timeout=60)
+    assert mon.heartbeat_timeout == 30.0
+    for w in mon.workers:
+        assert w.heartbeat_file and os.path.exists(w.heartbeat_file)

@@ -1,0 +1,198 @@
+"""Chaos tests: crash-safety of CheckpointManager under SIGKILL.
+
+The elastic supervisor (``run_elastic``) tears down a failed world and
+relaunches it "resuming from the latest checkpoint" — so a worker killed
+at ANY instant during ``save`` must never poison ``restore``. Each test
+forks a real child process, SIGKILLs it at a chosen (or random) point
+mid-save via the fault-injection registry, then asserts the parent
+restores the newest VERIFIED step with intact content.
+
+The children run the pickle codec (orbax is disabled pre-fork: its async
+machinery is not fork-safe, and the crash protocol under test — temp dir,
+fsync, manifest, atomic rename — is codec-independent).
+"""
+
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from zoo_tpu.orca.learn.ckpt import (
+    MANIFEST,
+    CheckpointCorruptError,
+    CheckpointManager,
+)
+from zoo_tpu.util.resilience import default_injector
+
+# forked children run pure file I/O (pickle + rename) then os._exit —
+# they never touch JAX's thread pools, so its fork warning doesn't apply
+pytestmark = [
+    pytest.mark.chaos,
+    pytest.mark.filterwarnings(
+        "ignore:os.fork\\(\\) was called:RuntimeWarning"),
+]
+
+KILL_SITES = ["ckpt.pre_write", "ckpt.pre_manifest", "ckpt.pre_rename"]
+
+
+def _mgr(tmp_path):
+    m = CheckpointManager(str(tmp_path / "ck"))
+    m._ckptr = None  # pickle codec: fork-safe (see module docstring)
+    m._ocp = None
+    return m
+
+
+def _state(step):
+    return {"step": step,
+            "w": np.full((64, 64), float(step), np.float32)}
+
+
+def _assert_step(state, step):
+    assert state["step"] == step
+    np.testing.assert_array_equal(
+        state["w"], np.full((64, 64), float(step), np.float32))
+
+
+def _fork_save_and_kill(mgr, step, site):
+    """Fork; the child arms a self-SIGKILL at ``site`` and saves ``step``.
+    Returns once the child is dead."""
+    pid = os.fork()
+    if pid == 0:  # child — never touch pytest machinery, never return
+        try:
+            default_injector.inject(
+                site, action=lambda **_: os.kill(os.getpid(),
+                                                 signal.SIGKILL))
+            mgr.save(step, _state(step))
+        finally:
+            os._exit(0)  # only reached if the kill site never fired
+    _, status = os.waitpid(pid, 0)
+    return status
+
+
+@pytest.mark.parametrize("site", KILL_SITES)
+def test_sigkill_mid_save_preserves_previous_step(tmp_path, site):
+    mgr = _mgr(tmp_path)
+    mgr.save(1, _state(1))
+    status = _fork_save_and_kill(mgr, 2, site)
+    assert os.WIFSIGNALED(status) and os.WTERMSIG(status) == signal.SIGKILL
+
+    _assert_step(mgr.restore(), 1)  # never raises, never step-2 debris
+    assert mgr.latest_verified_step() == 1
+
+
+def test_sigkill_after_rename_commits_the_step(tmp_path):
+    mgr = _mgr(tmp_path)
+    mgr.save(1, _state(1))
+    status = _fork_save_and_kill(mgr, 2, "ckpt.post_rename")
+    assert os.WIFSIGNALED(status)
+    # rename happened before the kill: step 2 is fully committed
+    _assert_step(mgr.restore(), 2)
+    assert mgr.latest_verified_step() == 2
+
+
+def test_sigkill_at_random_instants_never_corrupts_resume(tmp_path):
+    """Timing-based kills: the child saves steps continuously while the
+    parent SIGKILLs it after an arbitrary delay. Whatever the instant,
+    restore() must yield SOME verified step with self-consistent
+    content."""
+    import time
+
+    mgr = _mgr(tmp_path)
+    mgr.save(1, _state(1))
+    for i, delay_ms in enumerate([2, 5, 9, 14, 23]):
+        pid = os.fork()
+        if pid == 0:  # child: hammer saves until killed
+            try:
+                step = 2
+                while True:
+                    mgr.save(step, _state(step))
+                    step += 1
+            finally:
+                os._exit(0)
+        time.sleep(delay_ms / 1000.0)
+        os.kill(pid, signal.SIGKILL)
+        os.waitpid(pid, 0)
+
+        state = mgr.restore()
+        _assert_step(state, state["step"])  # content matches its step
+        assert state["step"] >= 1
+
+
+def test_stale_staging_dirs_are_garbage_collected(tmp_path):
+    mgr = _mgr(tmp_path)
+    mgr.save(1, _state(1))
+    _fork_save_and_kill(mgr, 2, "ckpt.pre_rename")
+    # the killed child's staging dir may linger; the next save's GC
+    # removes it once the owning pid is gone
+    mgr.save(3, _state(3))
+    leftovers = [n for n in os.listdir(mgr.directory)
+                 if n.startswith(".tmp-")]
+    assert leftovers == []
+    _assert_step(mgr.restore(), 3)
+
+
+def test_bitrot_quarantined_and_older_step_restored(tmp_path):
+    mgr = _mgr(tmp_path)
+    mgr.save(1, _state(1))
+    mgr.save(2, _state(2))
+    with open(os.path.join(mgr.directory, "2", "state.pkl"), "r+b") as f:
+        f.seek(10)
+        f.write(b"\xde\xad\xbe\xef")  # flip bytes: size same, hash not
+
+    _assert_step(mgr.restore(), 1)
+    names = os.listdir(mgr.directory)
+    assert "2.corrupt" in names and "2" not in names  # quarantined
+    # explicit request for the corrupt step fails loudly, never silently
+    with pytest.raises((CheckpointCorruptError, FileNotFoundError)):
+        mgr.restore(2)
+
+
+def test_missing_manifest_file_is_incomplete(tmp_path):
+    mgr = _mgr(tmp_path)
+    mgr.save(1, _state(1))
+    mgr.save(2, _state(2))
+    os.remove(os.path.join(mgr.directory, "2", "state.pkl"))
+    # manifest promises state.pkl; its absence means a torn step
+    _assert_step(mgr.restore(), 1)
+    assert mgr.latest_verified_step() == 1
+
+
+def test_truncated_manifest_is_corrupt(tmp_path):
+    mgr = _mgr(tmp_path)
+    mgr.save(1, _state(1))
+    mgr.save(2, _state(2))
+    mpath = os.path.join(mgr.directory, "2", MANIFEST)
+    with open(mpath, "r+b") as f:
+        f.truncate(os.path.getsize(mpath) // 2)
+    _assert_step(mgr.restore(), 1)
+
+
+def test_legacy_manifestless_checkpoint_still_restores(tmp_path):
+    """Steps written before the manifest era have no manifest.json; they
+    predate the atomic-rename protocol so presence implies completion —
+    they must keep restoring (no quarantine of old training runs)."""
+    import pickle
+
+    mgr = _mgr(tmp_path)
+    legacy = os.path.join(mgr.directory, "7")
+    os.makedirs(legacy)
+    with open(os.path.join(legacy, "state.pkl"), "wb") as f:
+        pickle.dump(_state(7), f)
+    _assert_step(mgr.restore(), 7)
+    assert mgr.latest_verified_step() == 7
+
+
+def test_restore_aux_follows_verified_step(tmp_path):
+    """restore() falling back to step N must pair with restore_aux()
+    from the SAME step — params and optimizer state from different
+    snapshots would silently diverge the trajectory."""
+    mgr = _mgr(tmp_path)
+    mgr.save(1, _state(1), aux={"moment": np.ones(3)})
+    mgr.save(2, _state(2), aux={"moment": np.full(3, 2.0)})
+    with open(os.path.join(mgr.directory, "2", "state.pkl"), "r+b") as f:
+        f.write(b"garbage")
+    _assert_step(mgr.restore(), 1)
+    aux = mgr.restore_aux()
+    np.testing.assert_array_equal(aux["moment"], np.ones(3))

@@ -1,0 +1,161 @@
+"""Fault-injected transient failures on the wired seams: the shard data
+plane and the serving stack recover within their retry budgets; a broken
+model trips the serving circuit breaker into load shedding."""
+
+import time
+
+import numpy as np
+import pytest
+
+from zoo_tpu.orca.data.plane import ShardExchange
+from zoo_tpu.serving.server import ServingServer
+from zoo_tpu.serving.tcp_client import TCPInputQueue
+from zoo_tpu.util.resilience import (
+    CircuitBreaker,
+    RetryError,
+    RetryPolicy,
+    clear_faults,
+    inject,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    clear_faults()
+    yield
+    clear_faults()
+
+
+def _fast_retry(attempts=4):
+    return RetryPolicy(max_attempts=attempts, base_delay=0.01,
+                       max_delay=0.05)
+
+
+# ---------------------------------------------------------------------------
+# shard.fetch
+# ---------------------------------------------------------------------------
+
+def test_shard_fetch_recovers_from_transient_faults():
+    shards = {3: {"x": np.arange(8, dtype=np.float32)}}
+    ex = ShardExchange(shards, bind="127.0.0.1")
+    try:
+        with inject("shard.fetch", exc=ConnectionError("flaky link"),
+                    times=2) as armed:
+            t0 = time.monotonic()
+            got = ShardExchange.fetch(("127.0.0.1", ex.port), 3,
+                                      retry=_fast_retry())
+            assert time.monotonic() - t0 < 1.0  # backoff stays tiny
+            assert armed.fired == 2  # both injected failures were hit
+        np.testing.assert_array_equal(got["x"], shards[3]["x"])
+    finally:
+        ex.close()
+
+
+def test_shard_fetch_exhausts_budget_on_permanent_fault():
+    shards = {3: {"x": np.zeros(2, np.float32)}}
+    ex = ShardExchange(shards, bind="127.0.0.1")
+    try:
+        with inject("shard.fetch", exc=ConnectionError("dead peer")):
+            with pytest.raises(RetryError) as ei:
+                ShardExchange.fetch(("127.0.0.1", ex.port), 3,
+                                    retry=_fast_retry(attempts=3))
+            assert ei.value.attempts == 3
+    finally:
+        ex.close()
+
+
+def test_shard_fetch_missing_shard_is_not_retried():
+    """KeyError (peer answers: not held) is a plan bug, not a transient —
+    it must not burn the retry budget."""
+    ex = ShardExchange({1: {"x": np.zeros(1, np.float32)}},
+                       bind="127.0.0.1")
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(KeyError):
+            ShardExchange.fetch(("127.0.0.1", ex.port), 99,
+                                retry=RetryPolicy(max_attempts=5,
+                                                  base_delay=0.5))
+        assert time.monotonic() - t0 < 0.5  # no backoff sleeps happened
+    finally:
+        ex.close()
+
+
+# ---------------------------------------------------------------------------
+# serving client retry + server load shedding
+# ---------------------------------------------------------------------------
+
+class _DoublerModel:
+    def predict(self, x, batch_size=None):
+        return np.asarray(x) * 2.0
+
+
+class _BrokenModel:
+    def __init__(self):
+        self.calls = 0
+        self.healthy = False
+
+    def predict(self, x, batch_size=None):
+        self.calls += 1
+        if not self.healthy:
+            raise RuntimeError("model exploded")
+        return np.asarray(x)
+
+
+def test_serving_client_recovers_from_transient_faults():
+    srv = ServingServer(_DoublerModel(), max_wait_ms=1.0).start()
+    try:
+        q = TCPInputQueue(host=srv.host, port=srv.port)
+        q._conn._retry = _fast_retry()
+        with inject("serving.request", exc=ConnectionError("blip"),
+                    times=2) as armed:
+            t0 = time.monotonic()
+            out = q.predict(np.ones((2, 3), np.float32))
+            assert time.monotonic() - t0 < 1.0
+            assert armed.fired == 2
+        np.testing.assert_array_equal(
+            np.asarray(out), np.full((2, 3), 2.0, np.float32))
+        q.close()
+    finally:
+        srv.stop()
+
+
+def test_serving_client_reconnects_after_dropped_connection():
+    """A poisoned stream (peer closed mid-RPC) must re-dial, not wedge."""
+    srv = ServingServer(_DoublerModel(), max_wait_ms=1.0).start()
+    try:
+        q = TCPInputQueue(host=srv.host, port=srv.port)
+        q._conn._retry = _fast_retry()
+        q._conn._sock.close()  # simulate the server dropping us
+        out = q.predict(np.ones((1, 2), np.float32))
+        np.testing.assert_array_equal(
+            np.asarray(out), np.full((1, 2), 2.0, np.float32))
+        q.close()
+    finally:
+        srv.stop()
+
+
+def test_breaker_sheds_load_and_recovers():
+    model = _BrokenModel()
+    breaker = CircuitBreaker(failure_threshold=1, recovery_timeout=0.2)
+    srv = ServingServer(model, max_wait_ms=1.0, breaker=breaker).start()
+    try:
+        q = TCPInputQueue(host=srv.host, port=srv.port)
+        # 1st request reaches the model and fails -> breaker opens
+        with pytest.raises(RuntimeError, match="model exploded"):
+            q.predict(np.ones((1, 2), np.float32))
+        calls_after_trip = model.calls
+        # while open, requests are rejected at the door: model untouched
+        with pytest.raises(RuntimeError, match="shedding load"):
+            q.predict(np.ones((1, 2), np.float32))
+        assert model.calls == calls_after_trip
+        # model heals; after the recovery timeout a probe closes the loop
+        model.healthy = True
+        time.sleep(0.25)
+        out = q.predict(np.ones((1, 2), np.float32))
+        assert np.asarray(out).shape == (1, 2)
+        assert breaker.state == CircuitBreaker.CLOSED
+        q.close()
+    finally:
+        srv.stop()

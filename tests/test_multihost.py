@@ -306,3 +306,61 @@ def test_spark_multihost_single_staging(tmp_path):
     v0 = [ln for ln in outs[0].splitlines() if "VALS=" in ln][0]
     v1 = [ln for ln in outs[1].splitlines() if "VALS=" in ln][0]
     assert v0.split("VALS=")[1] != v1.split("VALS=")[1]
+
+
+_DEAD_PEER_WORKER = r"""
+import os, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+coord, pid, pcnt = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+jax.distributed.initialize(coordinator_address=coord, num_processes=pcnt,
+                           process_id=pid)
+
+from zoo_tpu.orca import init_orca_context, stop_orca_context
+from zoo_tpu.orca.data import LocalXShards, rebalance_shards
+from zoo_tpu.util.resilience import inject
+
+init_orca_context(cluster_mode="tpu")
+
+# imbalanced: host1 must fetch host0's surplus shards over the network
+rs = np.random.RandomState(0)
+shard = lambda i: {"x": rs.randn(8, 4).astype(np.float32)}
+mine = LocalXShards([shard(i) for i in range(6)] if pid == 0
+                    else [shard(i) for i in range(2)])
+if pid == 1:
+    # every fetch attempt fails permanently == the serving peer is dead
+    inject("shard.fetch", exc=ConnectionError("injected dead peer"))
+try:
+    rebalance_shards(mine, bind_ip="127.0.0.1", deadline=60.0)
+    print(f"proc {pid} NO-ERROR")  # the bug: a host sailed through
+except RuntimeError as e:
+    assert "host" in str(e), e  # names the failed host(s)
+    print(f"proc {pid} RAISED OK")
+stop_orca_context()
+"""
+
+
+@pytest.mark.chaos
+@pytest.mark.timeout(300)
+def test_rebalance_dead_peer_raises_on_every_host(tmp_path):
+    """A host whose fetch phase fails permanently must NOT strand its
+    peers inside the teardown barrier: every host raises a RuntimeError
+    naming the failed host(s), within the deadline (the pre-fix behavior
+    was a cluster-wide hang in sync_global_devices)."""
+    port = _free_port()
+    coord = f"127.0.0.1:{port}"
+    script = tmp_path / "worker.py"
+    script.write_text(_DEAD_PEER_WORKER)
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.getcwd() + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), coord, str(i), "2"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for i in range(2)]
+    outs = [p.communicate(timeout=240)[0] for p in procs]
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
+        assert f"proc {i} RAISED OK" in out, out[-2000:]

@@ -1,0 +1,268 @@
+"""Unit tests for the shared resilience core (zoo_tpu.util.resilience):
+retry backoff math, circuit-breaker state machine, fault-injection
+registry, heartbeat helpers, and the coordinator-port TOCTOU retry."""
+
+import os
+import socket
+import time
+
+import pytest
+
+from zoo_tpu.util.resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    FaultInjector,
+    InjectedFault,
+    RetryError,
+    RetryPolicy,
+    clear_faults,
+    fault_point,
+    heartbeat_age,
+    inject,
+    touch_heartbeat,
+)
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+def _recording_policy(**kw):
+    sleeps = []
+    kw.setdefault("jitter", False)
+    policy = RetryPolicy(sleep=sleeps.append, **kw)
+    return policy, sleeps
+
+
+def test_retry_succeeds_after_transients():
+    policy, sleeps = _recording_policy(max_attempts=4, base_delay=0.1)
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    assert policy.call(flaky) == "ok"
+    assert len(calls) == 3
+    # exponential: 0.1 after the 1st failure, 0.2 after the 2nd
+    assert sleeps == [pytest.approx(0.1), pytest.approx(0.2)]
+
+
+def test_retry_budget_exhausted_raises_with_cause():
+    policy, _ = _recording_policy(max_attempts=2, base_delay=0.01)
+
+    def dead():
+        raise ConnectionError("always down")
+
+    with pytest.raises(RetryError) as ei:
+        policy.call(dead)
+    assert ei.value.attempts == 2
+    assert isinstance(ei.value.__cause__, ConnectionError)
+
+
+def test_non_retryable_propagates_immediately():
+    policy, sleeps = _recording_policy(max_attempts=5, base_delay=0.01)
+    calls = []
+
+    def bad_request():
+        calls.append(1)
+        raise KeyError("not a network problem")
+
+    with pytest.raises(KeyError):
+        policy.call(bad_request)
+    assert len(calls) == 1 and sleeps == []
+
+
+def test_retry_deadline_bounds_total_wait():
+    # backoff after the first failure (1.0s) would blow the 0.5s
+    # deadline: the policy must give up instead of sleeping past it
+    policy, sleeps = _recording_policy(
+        max_attempts=10, base_delay=1.0, deadline=0.5)
+
+    def dead():
+        raise ConnectionError("down")
+
+    t0 = time.monotonic()
+    with pytest.raises(RetryError, match="deadline"):
+        policy.call(dead)
+    assert time.monotonic() - t0 < 0.5
+    assert sleeps == []
+
+
+def test_backoff_caps_at_max_delay():
+    policy, _ = _recording_policy(base_delay=0.1, max_delay=0.3)
+    assert policy.backoff(1) == pytest.approx(0.1)
+    assert policy.backoff(2) == pytest.approx(0.2)
+    assert policy.backoff(5) == pytest.approx(0.3)  # capped
+
+
+def test_jitter_stays_within_raw_backoff():
+    policy = RetryPolicy(base_delay=0.1, jitter=True, rng=lambda: 0.5)
+    assert policy.backoff(1) == pytest.approx(0.05)
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker
+# ---------------------------------------------------------------------------
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_breaker_opens_after_threshold_and_recovers():
+    clock = _Clock()
+    br = CircuitBreaker(failure_threshold=2, recovery_timeout=10.0,
+                        clock=clock)
+    assert br.state == CircuitBreaker.CLOSED and br.allow()
+    br.record_failure()
+    assert br.state == CircuitBreaker.CLOSED  # one failure: still closed
+    br.record_failure()
+    assert br.state == CircuitBreaker.OPEN
+    assert not br.allow()
+
+    clock.t = 11.0  # recovery timeout passed: half-open admits one probe
+    assert br.state == CircuitBreaker.HALF_OPEN
+    assert br.allow()
+    assert not br.allow()  # only half_open_max probes
+    br.record_success()
+    assert br.state == CircuitBreaker.CLOSED
+    assert br.allow()
+
+
+def test_breaker_half_open_failure_reopens():
+    clock = _Clock()
+    br = CircuitBreaker(failure_threshold=1, recovery_timeout=5.0,
+                        clock=clock)
+    br.record_failure()
+    clock.t = 6.0
+    assert br.allow()  # the probe
+    br.record_failure()
+    assert br.state == CircuitBreaker.OPEN
+    assert not br.allow()
+
+
+def test_breaker_success_resets_consecutive_count():
+    br = CircuitBreaker(failure_threshold=2)
+    br.record_failure()
+    br.record_success()
+    br.record_failure()  # not consecutive: stays closed
+    assert br.state == CircuitBreaker.CLOSED
+
+
+def test_breaker_call_wraps_and_raises_when_open():
+    br = CircuitBreaker(failure_threshold=1, recovery_timeout=60.0)
+    with pytest.raises(ValueError):
+        br.call(lambda: (_ for _ in ()).throw(ValueError("boom")))
+    with pytest.raises(CircuitOpenError):
+        br.call(lambda: "never runs")
+
+
+# ---------------------------------------------------------------------------
+# FaultInjector
+# ---------------------------------------------------------------------------
+
+def test_inject_times_bounded_then_disarms():
+    inj = FaultInjector()
+    inj.inject("site.a", exc=ConnectionError("flaky"), times=2)
+    for _ in range(2):
+        with pytest.raises(ConnectionError):
+            inj.fire("site.a")
+    inj.fire("site.a")  # 3rd call: disarmed, no raise
+    assert inj.fired("site.a") == 2
+
+
+def test_inject_action_callback_receives_context():
+    inj = FaultInjector()
+    seen = []
+    inj.inject("site.b", action=lambda **ctx: seen.append(ctx))
+    inj.fire("site.b", gid=7)
+    assert seen == [{"site": "site.b", "gid": 7}]
+
+
+def test_inject_default_exception_and_clear():
+    inj = FaultInjector()
+    inj.inject("site.c")
+    with pytest.raises(InjectedFault):
+        inj.fire("site.c")
+    inj.clear("site.c")
+    inj.fire("site.c")  # cleared: no-op
+
+
+def test_module_level_context_manager_clears_on_exit():
+    with inject("site.d", exc=OSError("x"), times=1) as armed:
+        with pytest.raises(OSError):
+            fault_point("site.d")
+        assert armed.fired == 1
+    fault_point("site.d")  # disarmed by __exit__
+    clear_faults()
+
+
+def test_unarmed_site_is_noop():
+    fault_point("never.armed", anything="goes")
+
+
+# ---------------------------------------------------------------------------
+# heartbeat helpers
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_touch_and_age(tmp_path):
+    hb = str(tmp_path / "w0.heartbeat")
+    assert heartbeat_age(hb) is None  # not created yet: still booting
+    touch_heartbeat(hb)
+    age = heartbeat_age(hb)
+    assert age is not None and age < 5.0
+
+
+def test_heartbeat_touch_without_config_is_noop(monkeypatch):
+    monkeypatch.delenv("ZOO_HEARTBEAT_FILE", raising=False)
+    touch_heartbeat()  # no path anywhere: must not raise
+
+
+# ---------------------------------------------------------------------------
+# coordinator port TOCTOU retry (zoo_tpu.orca.bootstrap satellite)
+# ---------------------------------------------------------------------------
+
+def test_pick_coordinator_port_retries_taken_port(monkeypatch):
+    from zoo_tpu.orca import bootstrap
+
+    blocker = socket.socket()
+    blocker.bind(("127.0.0.1", 0))
+    taken = blocker.getsockname()[1]
+    try:
+        real = bootstrap.free_port
+        served = []
+
+        def first_taken():
+            # the TOCTOU race made concrete: the first candidate is
+            # already owned by someone else by the time we re-probe
+            served.append(1)
+            return taken if len(served) == 1 else real()
+
+        monkeypatch.setattr(bootstrap, "free_port", first_taken)
+        port = bootstrap._pick_coordinator_port()
+        assert port != taken
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", port))  # actually bindable
+        assert len(served) >= 2  # it retried rather than failing
+    finally:
+        blocker.close()
+
+
+def test_pick_coordinator_port_gives_up_with_clear_error(monkeypatch):
+    from zoo_tpu.orca import bootstrap
+
+    blocker = socket.socket()
+    blocker.bind(("127.0.0.1", 0))
+    taken = blocker.getsockname()[1]
+    try:
+        monkeypatch.setattr(bootstrap, "free_port", lambda: taken)
+        with pytest.raises(RuntimeError, match="coordinator port"):
+            bootstrap._pick_coordinator_port(retries=3)
+    finally:
+        blocker.close()

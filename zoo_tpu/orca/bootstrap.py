@@ -41,6 +41,12 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence
 
+from zoo_tpu.util.resilience import (
+    HEARTBEAT_FILE_ENV,
+    HEARTBEAT_INTERVAL_ENV,
+    heartbeat_age,
+)
+
 logger = logging.getLogger(__name__)
 
 _PR_SET_PDEATHSIG = 1
@@ -63,24 +69,65 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
+def _pick_coordinator_port(retries: int = 16) -> int:
+    """A free port for the JAX coordinator, re-probed immediately before
+    use. ``free_port`` releases the port when it returns, so another
+    process can grab it before worker 0 binds (the classic TOCTOU race);
+    re-probing right here and retrying with a fresh candidate shrinks
+    that window from "whole launch setup" to microseconds instead of
+    failing the entire launch on a stale candidate."""
+    last: Optional[OSError] = None
+    for _ in range(max(1, retries)):
+        port = free_port()
+        try:
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", port))
+            return port
+        except OSError as e:  # taken since the probe: try a fresh one
+            last = e
+            logger.warning("coordinator port %d taken between probe and "
+                           "use; retrying with a fresh port", port)
+    raise RuntimeError(
+        f"could not reserve a coordinator port after {retries} "
+        "attempts") from last
+
+
 class WorkerProcess:
     """One supervised worker (reference: a ray start subprocess tracked by
     ``ProcessInfo``)."""
 
     def __init__(self, cmd: Sequence[str], env: Dict[str, str],
-                 name: str, log_dir: Optional[str] = None):
+                 name: str, log_dir: Optional[str] = None,
+                 heartbeat_file: Optional[str] = None):
         self.cmd = list(cmd)
         self.env = dict(env)
         self.name = name
         self.log_dir = log_dir
+        self.heartbeat_file = heartbeat_file
+        if heartbeat_file:
+            self.env[HEARTBEAT_FILE_ENV] = heartbeat_file
         self.proc: Optional[subprocess.Popen] = None
         self.restarts = 0
         self._log_fh = None
+        self.heartbeat_spawn_mtime: Optional[float] = None
 
     def spawn(self):
         if self._log_fh:  # restart: release the previous run's handle
             self._log_fh.close()
             self._log_fh = None
+        if self.heartbeat_file:
+            # stamp at spawn so staleness is measured from launch even if
+            # the worker never gets far enough to beat on its own; record
+            # the stamp so the monitor can tell "never beat yet (still
+            # booting — import jax alone can take many seconds)" from
+            # "beat, then went silent (hung)"
+            from zoo_tpu.util.resilience import touch_heartbeat
+            touch_heartbeat(self.heartbeat_file)
+            try:
+                self.heartbeat_spawn_mtime = \
+                    os.stat(self.heartbeat_file).st_mtime
+            except OSError:
+                self.heartbeat_spawn_mtime = None
         if self.log_dir:
             os.makedirs(self.log_dir, exist_ok=True)
             self._log_fh = open(
@@ -124,13 +171,28 @@ class ProcessMonitor:
     ``max_restarts``: per-worker crash budget. Within budget a crashed
     worker is respawned; past it the whole group is torn down and
     :meth:`wait` raises. Exit code 0 counts as completion, not a crash.
+
+    ``heartbeat_timeout``: optional hung-worker detection. Workers whose
+    :class:`WorkerProcess` carries a ``heartbeat_file`` (stamped by
+    ``touch_heartbeat`` / the ``init_orca_context`` heartbeat thread) are
+    SIGKILLed and charged against the restart budget when the file goes
+    stale for longer than this many seconds — a worker stuck in a dead
+    collective is a crash the same as one that exited nonzero.
     """
 
     def __init__(self, workers: List[WorkerProcess], max_restarts: int = 0,
-                 poll_interval: float = 0.2):
+                 poll_interval: float = 0.2,
+                 heartbeat_timeout: Optional[float] = None,
+                 heartbeat_boot_grace: float = 120.0):
         self.workers = workers
         self.max_restarts = int(max_restarts)
         self.poll_interval = poll_interval
+        self.heartbeat_timeout = heartbeat_timeout
+        # until a worker has beaten ON ITS OWN at least once it is
+        # booting, not hung — a cold `import jax` alone can outlast a
+        # tight heartbeat_timeout; the boot window gets the larger bound
+        self.heartbeat_boot_grace = max(heartbeat_boot_grace,
+                                        heartbeat_timeout or 0.0)
         self._failed: Optional[str] = None
         self._stop = threading.Event()
         self._lock = threading.Lock()  # serializes respawn vs teardown
@@ -146,11 +208,39 @@ class ProcessMonitor:
         self._thread.start()
         return self
 
+    def _crash_reason(self, w: WorkerProcess) -> Optional[str]:
+        """A crash description for worker ``w``, or None while healthy.
+        Hung workers (stale heartbeat) are killed here so the respawn /
+        teardown path treats them exactly like a nonzero exit."""
+        rc = w.returncode
+        if rc is not None:
+            return None if rc == 0 else f"exited rc={rc}"
+        if self.heartbeat_timeout and w.heartbeat_file:
+            age = heartbeat_age(w.heartbeat_file)
+            try:
+                mtime = os.stat(w.heartbeat_file).st_mtime
+            except OSError:
+                mtime = None
+            booted = (mtime is not None
+                      and w.heartbeat_spawn_mtime is not None
+                      and mtime > w.heartbeat_spawn_mtime)
+            limit = self.heartbeat_timeout if booted \
+                else self.heartbeat_boot_grace
+            if age is not None and age > limit:
+                logger.warning(
+                    "%s heartbeat stale (%.1fs > %.1fs%s); killing the "
+                    "hung worker", w.name, age, limit,
+                    "" if booted else ", boot grace")
+                w.kill()
+                return (f"hung (heartbeat stale {age:.1f}s > "
+                        f"{limit}s limit)")
+        return None
+
     def _watch(self):
         while not self._stop.is_set():
             for w in self.workers:
-                rc = w.returncode
-                if rc is None or rc == 0:
+                reason = self._crash_reason(w)
+                if reason is None:
                     continue
                 if w.restarts < self.max_restarts:
                     with self._lock:
@@ -158,7 +248,7 @@ class ProcessMonitor:
                             return  # teardown won the race: no respawn
                         w.restarts += 1
                         logger.warning(
-                            "%s exited rc=%d; restart %d/%d", w.name, rc,
+                            "%s %s; restart %d/%d", w.name, reason,
                             w.restarts, self.max_restarts)
                         w.spawn()
                 else:
@@ -166,7 +256,7 @@ class ProcessMonitor:
                         if self._stop.is_set():
                             return  # deliberate stop(), not a crash
                         self._failed = (
-                            f"{w.name} exited rc={rc} with no restart "
+                            f"{w.name} {reason} with no restart "
                             f"budget left "
                             f"({w.restarts}/{self.max_restarts})")
                         logger.error("%s — tearing the group down",
@@ -216,7 +306,8 @@ def launch_local_cluster(nproc: int, script: str,
                          local_devices_per_proc: int = 1,
                          max_restarts: int = 0,
                          log_dir: Optional[str] = None,
-                         env: Optional[Dict[str, str]] = None
+                         env: Optional[Dict[str, str]] = None,
+                         heartbeat_timeout: Optional[float] = None
                          ) -> ProcessMonitor:
     """Boot an ``nproc``-process JAX CPU cluster running ``script`` on
     this machine (the reference's local RayContext). Each worker gets
@@ -224,8 +315,20 @@ def launch_local_cluster(nproc: int, script: str,
     ``ZOO_PROCESS_ID`` plus a forced-CPU JAX platform with
     ``local_devices_per_proc`` virtual devices, so
     ``init_orca_context(cluster_mode="tpu")`` forms the same process mesh
-    it would on a pod."""
-    coord = f"127.0.0.1:{free_port()}"
+    it would on a pod.
+
+    ``heartbeat_timeout``: enable hung-worker detection — each worker is
+    handed a heartbeat file (``ZOO_HEARTBEAT_FILE``; stamped by the
+    ``init_orca_context`` heartbeat thread) and is killed + charged to
+    the restart budget when the stamp goes stale for longer than this
+    many seconds."""
+    import tempfile
+
+    coord = f"127.0.0.1:{_pick_coordinator_port()}"
+    hb_dir = None
+    if heartbeat_timeout:
+        hb_dir = log_dir or tempfile.mkdtemp(prefix="zoo-heartbeat-")
+        os.makedirs(hb_dir, exist_ok=True)
     workers = []
     for pid in range(nproc):
         wenv = dict(os.environ)
@@ -239,10 +342,18 @@ def launch_local_cluster(nproc: int, script: str,
                           " --xla_force_host_platform_device_count="
                           f"{local_devices_per_proc}").strip(),
         })
+        hb_file = None
+        if hb_dir:
+            hb_file = os.path.join(hb_dir, f"worker-{pid}.heartbeat")
+            # beat at a quarter of the timeout: three missed beats of
+            # slack before a healthy-but-busy worker reads as hung
+            wenv[HEARTBEAT_INTERVAL_ENV] = str(
+                max(0.05, heartbeat_timeout / 4.0))
         workers.append(WorkerProcess(
             [sys.executable, script, *args], wenv, f"worker-{pid}",
-            log_dir=log_dir))
-    return ProcessMonitor(workers, max_restarts=max_restarts).start()
+            log_dir=log_dir, heartbeat_file=hb_file))
+    return ProcessMonitor(workers, max_restarts=max_restarts,
+                          heartbeat_timeout=heartbeat_timeout).start()
 
 
 def run_elastic(nproc: int, script: str, args: Sequence[str] = (),
@@ -250,7 +361,8 @@ def run_elastic(nproc: int, script: str, args: Sequence[str] = (),
                 local_devices_per_proc: int = 1,
                 log_dir: Optional[str] = None,
                 env: Optional[Dict[str, str]] = None,
-                wait_timeout: Optional[float] = None) -> int:
+                wait_timeout: Optional[float] = None,
+                heartbeat_timeout: Optional[float] = None) -> int:
     """Scale-down elastic supervision (SURVEY §5.3; reference:
     ``Topology.scala:1255-1337`` retries within the job from the latest
     snapshot — this is that mechanism lifted to the supervisor, plus the
@@ -273,7 +385,8 @@ def run_elastic(nproc: int, script: str, args: Sequence[str] = (),
         mon = launch_local_cluster(
             n, script, args, max_restarts=max_restarts,
             local_devices_per_proc=local_devices_per_proc,
-            log_dir=log_dir, env=wenv)
+            log_dir=log_dir, env=wenv,
+            heartbeat_timeout=heartbeat_timeout)
         try:
             mon.wait(timeout=wait_timeout)
             return n
@@ -306,6 +419,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "worker loss, relaunch the job on a smaller "
                          "mesh (resuming from the latest checkpoint) "
                          "down to this world size")
+    ap.add_argument("--heartbeat-timeout", type=float, default=None,
+                    help="kill a worker whose heartbeat file goes stale "
+                         "for this many seconds (hung-worker detection; "
+                         "charged to the restart budget)")
     ap.add_argument("script")
     ap.add_argument("args", nargs=argparse.REMAINDER)
     ns = ap.parse_args(argv)
@@ -315,12 +432,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         min_workers=ns.elastic_min_workers,
                         max_restarts=ns.max_restarts,
                         local_devices_per_proc=ns.devices_per_proc,
-                        log_dir=ns.log_dir)
+                        log_dir=ns.log_dir,
+                        heartbeat_timeout=ns.heartbeat_timeout)
             return 0
         mon = launch_local_cluster(
             ns.nproc, ns.script, ns.args,
             local_devices_per_proc=ns.devices_per_proc,
-            max_restarts=ns.max_restarts, log_dir=ns.log_dir)
+            max_restarts=ns.max_restarts, log_dir=ns.log_dir,
+            heartbeat_timeout=ns.heartbeat_timeout)
         mon.wait()
         return 0
     except (RuntimeError, KeyboardInterrupt) as e:

@@ -167,6 +167,12 @@ def init_orca_context(cluster_mode: str = "local",
 
     _maybe_init_distributed(cluster_mode, num_nodes)
 
+    # supervised workers (zoo_tpu.orca.bootstrap with hung-worker
+    # detection) hand us a heartbeat file through the env; start beating
+    # so the supervisor can tell hung from healthy. No-op otherwise.
+    from zoo_tpu.util.resilience import start_heartbeat_thread
+    start_heartbeat_thread()
+
     import jax
     from zoo_tpu.parallel.mesh import build_mesh
 

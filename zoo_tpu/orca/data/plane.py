@@ -28,14 +28,20 @@ Shards must be dicts of numpy arrays (the estimator feed format); use
 from __future__ import annotations
 
 import io
+import logging
 import socket
 import struct
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from zoo_tpu.util.resilience import RetryPolicy, fault_point
+
 __all__ = ["ShardExchange", "assign_shards", "rebalance_shards"]
+
+logger = logging.getLogger(__name__)
 
 _MAGIC = b"ZSX1"
 
@@ -136,13 +142,29 @@ class ShardExchange:
             pass
 
     @staticmethod
-    def fetch(addr: Tuple[str, int], gid: int) -> Dict[str, np.ndarray]:
-        with socket.create_connection(addr, timeout=60) as sock:
-            sock.sendall(_MAGIC + struct.pack("!I", gid))
-            (n,) = struct.unpack("!I", _recv_exact(sock, 4))
-            if n == 0:
-                raise KeyError(f"peer {addr} does not hold shard {gid}")
-            return _decode_shard(_recv_exact(sock, n))
+    def fetch(addr: Tuple[str, int], gid: int, timeout: float = 60.0,
+              retry: Optional[RetryPolicy] = None
+              ) -> Dict[str, np.ndarray]:
+        """Fetch shard ``gid`` from ``addr`` with bounded retries.
+
+        Connect/read failures (flaky network, peer restarting) are
+        transient: retried under ``retry`` (default: 3 attempts,
+        exponential backoff). A ``KeyError`` — the peer answers but does
+        not hold the shard — is a plan bug, never retried."""
+        retry = retry or RetryPolicy(max_attempts=3, base_delay=0.1,
+                                     max_delay=2.0, deadline=timeout)
+
+        def _once():
+            fault_point("shard.fetch", addr=addr, gid=gid)
+            with socket.create_connection(addr, timeout=timeout) as sock:
+                sock.sendall(_MAGIC + struct.pack("!I", gid))
+                (n,) = struct.unpack("!I", _recv_exact(sock, 4))
+                if n == 0:
+                    raise KeyError(
+                        f"peer {addr} does not hold shard {gid}")
+                return _decode_shard(_recv_exact(sock, n))
+
+        return retry.call(_once)
 
 
 def assign_shards(counts: Sequence[int]) -> List[List[int]]:
@@ -173,13 +195,65 @@ def assign_shards(counts: Sequence[int]) -> List[List[int]]:
     return out
 
 
-def rebalance_shards(shards, bind_ip: Optional[str] = None):
+_rebal_generation = 0
+_rebal_gen_lock = threading.Lock()
+
+
+def _coordination_client():
+    """The JAX coordination-service KV client (present whenever
+    ``jax.distributed.initialize`` ran — exactly the multi-process
+    case). The rebalance *control plane* rides on it rather than on XLA
+    device collectives: key-value allgather works on every backend (CPU
+    included, where cross-process XLA computations may not), and its
+    blocking gets carry timeouts — which is what turns a dead peer into
+    a raised error instead of an eternal barrier."""
+    try:
+        from jax._src import distributed
+        return distributed.global_state.client
+    except Exception:  # pragma: no cover - jax internals moved
+        return None
+
+
+def _kv_allgather(client, gen: int, tag: str, pid: int, nprocs: int,
+                  value: str, timeout_s: float) -> List[str]:
+    """Publish ``value`` under this process's key, then collect every
+    peer's. Doubles as a barrier: nobody returns until all processes
+    have published. A peer that never publishes (crashed, hung) makes
+    the blocking get raise within ``timeout_s`` on every waiter."""
+    prefix = f"zoo:rebalance:{gen}:{tag}:"
+    client.key_value_set(prefix + str(pid), value)
+    # one deadline for the WHOLE phase, re-derived per get — giving every
+    # key the full budget would let N slow peers stack to N x timeout_s
+    phase_deadline = time.monotonic() + timeout_s
+    out = []
+    for p in range(nprocs):
+        ms = max(1000, int((phase_deadline - time.monotonic()) * 1000))
+        try:
+            out.append(client.blocking_key_value_get(prefix + str(p), ms))
+        except Exception as e:
+            raise TimeoutError(
+                f"host {p} never reached rebalance phase {tag!r} within "
+                f"{timeout_s:.0f}s (crashed or hung peer): {e}") from e
+    return out
+
+
+def rebalance_shards(shards, bind_ip: Optional[str] = None,
+                     deadline: float = 120.0):
     """Exchange shards so every process holds a balanced, disjoint set.
 
     ``shards``: this process's :class:`LocalXShards` of dict-of-ndarray
     shards (each host contributes what it has — counts may differ).
     Returns this process's rebalanced ``LocalXShards``. Single-process:
     returns the input unchanged.
+
+    Failure semantics: every phase is bounded by ``deadline`` seconds,
+    and every host *always* reaches the post-fetch status exchange — a
+    raised fetch error on one host surfaces as ``RuntimeError`` on ALL
+    hosts (naming the failed ones), and a peer that dies outright makes
+    everyone else time out within the deadline. The pre-fix behavior —
+    one host skipping the teardown barrier and deadlocking every healthy
+    peer — cannot recur: the status exchange *is* the barrier and is
+    reached from both the success and the failure path.
     """
     import jax
 
@@ -189,33 +263,74 @@ def rebalance_shards(shards, bind_ip: Optional[str] = None):
     if jax.process_count() == 1:
         return LocalXShards(parts)
 
-    from jax.experimental import multihost_utils
+    global _rebal_generation
+    with _rebal_gen_lock:
+        _rebal_generation += 1
+        gen = _rebal_generation
 
-    pid = jax.process_index()
+    pid, nprocs = jax.process_index(), jax.process_count()
+    client = _coordination_client()
+    if client is None:  # pragma: no cover - jax internals moved
+        raise RuntimeError(
+            "rebalance_shards needs the JAX coordination service "
+            "(jax.distributed.initialize) in multi-process mode")
     ip = bind_ip or _default_ip()
-    # announce (ip, port, count) through the coordination service; the
-    # exchange must outlive the fetch phase on every host
-    counts_probe = multihost_utils.process_allgather(
-        np.asarray([len(parts)], np.int32)).reshape(-1)
-    offsets = np.concatenate([[0], np.cumsum(counts_probe)]).astype(int)
+    t0 = time.monotonic()
+
+    def remaining() -> float:
+        left = deadline - (time.monotonic() - t0)
+        if left <= 0:
+            raise TimeoutError(
+                f"shard rebalance deadline ({deadline}s) exhausted")
+        return left
+
+    counts = [int(c) for c in _kv_allgather(
+        client, gen, "counts", pid, nprocs, str(len(parts)), remaining())]
+    offsets = np.concatenate([[0], np.cumsum(counts)]).astype(int)
+    # serve our shards (keyed by global id), then announce (ip, port)
+    # through the coordination service — the address allgather is also
+    # the start barrier, so no peer fetches before every server is up;
+    # the exchange must outlive the fetch phase on every host
     exchange = ShardExchange(
-        {int(offsets[pid] + i): s for i, s in enumerate(parts)},
-        bind=ip)
+        {int(offsets[pid] + i): s for i, s in enumerate(parts)}, bind=ip)
     try:
-        me = np.asarray(list(_ip_to_words(ip)) + [exchange.port],
-                        np.int64)
-        table = multihost_utils.process_allgather(me)
-        addrs = [(_words_to_ip(row[:-1]), int(row[-1])) for row in table]
-        plan = assign_shards([int(c) for c in counts_probe])
-        mine = []
-        for gid in plan[pid]:
-            src = int(np.searchsorted(offsets, gid, side="right") - 1)
-            if src == pid:
-                mine.append(parts[gid - offsets[pid]])
-            else:
-                mine.append(ShardExchange.fetch(addrs[src], gid))
-        # barrier: nobody tears their server down while a peer still fetches
-        multihost_utils.sync_global_devices("zoo_tpu_shard_rebalance")
+        table = _kv_allgather(client, gen, "addr", pid, nprocs,
+                              f"{ip}:{exchange.port}", remaining())
+        addrs = []
+        for row in table:
+            host, port = row.rsplit(":", 1)
+            addrs.append((host, int(port)))
+        plan = assign_shards(counts)
+        mine, error = [], None
+        try:
+            for gid in plan[pid]:
+                src = int(np.searchsorted(offsets, gid, side="right") - 1)
+                if src == pid:
+                    mine.append(parts[gid - offsets[pid]])
+                    continue
+                mine.append(ShardExchange.fetch(
+                    addrs[src], gid, timeout=min(remaining(), 60.0)))
+        except Exception as e:  # noqa: BLE001 — reported to every host
+            error = e
+            logger.error("shard fetch phase failed on host %d: %r",
+                         pid, e)
+        # status exchange doubles as the teardown barrier: every host
+        # reaches it whether its fetches succeeded or not, and nobody
+        # closes its shard server until all hosts have finished fetching.
+        # Computed WITHOUT remaining() — which raises once the deadline
+        # is spent — because the status publish must happen even (above
+        # all) on the host that blew the deadline, or its peers stall
+        # waiting for a verdict that never comes
+        status_wait = max(5.0, deadline - (time.monotonic() - t0))
+        status = _kv_allgather(
+            client, gen, "status", pid, nprocs,
+            "ok" if error is None else f"err:{error!r:.500}",
+            status_wait)
+        bad = {i: s for i, s in enumerate(status) if s != "ok"}
+        if bad:
+            raise RuntimeError(
+                f"shard rebalance failed on host(s) {sorted(bad)}: "
+                f"{bad}") from error
     finally:
         exchange.close()
     return LocalXShards(mine)
@@ -232,11 +347,3 @@ def _default_ip() -> str:
         return "127.0.0.1"
     finally:
         s.close()
-
-
-def _ip_to_words(ip: str):
-    return [int(b) for b in socket.inet_aton(ip)]
-
-
-def _words_to_ip(words) -> str:
-    return socket.inet_ntoa(bytes(int(w) for w in words))

@@ -1,25 +1,53 @@
-"""Versioned training checkpoints (orbax-backed).
+"""Versioned training checkpoints (orbax-backed), crash-safe.
 
 Rebuild of the reference's checkpoint dir convention — time-stamped dir with
 ``model.N`` / ``optimMethod-<name>.N`` snapshots, resumed by
 ``load_orca_checkpoint(path, version)`` picking the latest N
 (``Topology.scala:1245-1252``, ``orca/learn/tf/estimator.py:270``,
-``pytorch/estimator.py:555``). Here a checkpoint is one orbax step directory
-holding the whole train state pytree (params + optimizer state), written
-asynchronously off the training loop.
+``pytorch/estimator.py:555``). Here a checkpoint is one step directory
+holding the whole train state pytree (params + optimizer state).
+
+Crash-safety contract (what ``run_elastic``'s scale-down resume assumes):
+a worker may be ``kill -9``'d at ANY instant during :meth:`save` and
+:meth:`restore` still returns the newest *verified* step.
+
+* every save is staged into a dot-prefixed temp dir on the same
+  filesystem, each file fsynced, then atomically renamed into place —
+  readers never observe a half-written step directory;
+* ``manifest.json`` records per-file size + sha256; :meth:`restore`
+  verifies it, renames corrupt/incomplete steps to ``<step>.corrupt``
+  (quarantine, kept for forensics) and falls back to the next-newest
+  verified step;
+* stale temp dirs left by killed savers are garbage-collected once their
+  owning pid is gone.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
+import logging
 import os
 import pickle
 import re
-from typing import Any, Optional
+import shutil
+from typing import Any, Dict, List, Optional
 
 import jax
 import numpy as np
 
+from zoo_tpu.util.resilience import fault_point
+
+logger = logging.getLogger(__name__)
+
 _STEP_RE = re.compile(r"^(\d+)$")
+_TMP_RE = re.compile(r"^\.tmp-(\d+)-(\d+)$")  # .tmp-<step>-<pid>
+_STALE_RE = re.compile(r"^(\d+)\.stale-(\d+)$")  # <step>.stale-<pid>
+MANIFEST = "manifest.json"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """An explicitly requested step failed manifest verification."""
 
 
 def _ensure_host(tree):
@@ -41,13 +69,48 @@ def _ensure_host(tree):
     return jax.tree_util.tree_map(to_host, tree)
 
 
+def _fsync_dir(path: str):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _write_durable(path: str, data: bytes):
+    with open(path, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _walk_files(root: str) -> List[str]:
+    out = []
+    for dirpath, _, names in os.walk(root):
+        for name in names:
+            out.append(os.path.relpath(os.path.join(dirpath, name), root))
+    return sorted(out)
+
+
 class CheckpointManager:
-    """Thin orbax wrapper with a pickle fallback for exotic pytrees."""
+    """Crash-safe orbax wrapper with a pickle fallback for exotic pytrees."""
 
     def __init__(self, directory: str, max_to_keep: int = 5):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.max_to_keep = max_to_keep
+        # steps this process already hash-verified: restore(None) followed
+        # by restore_aux(None) — the elastic resume path — must not read
+        # and sha256 a multi-GB snapshot twice
+        self._verified_ok: set = set()
         try:
             import orbax.checkpoint as ocp
             self._ocp = ocp
@@ -61,32 +124,81 @@ class CheckpointManager:
         """``aux`` is an optional side pytree (e.g. optax optimizer state,
         whose NamedTuple structure orbax would flatten) stored pickled next
         to the main state — the reference writes ``optimMethod-<name>.N``
-        beside ``model.N`` the same way."""
-        path = os.path.join(self.directory, str(step))
+        beside ``model.N`` the same way.
+
+        The step is staged under ``.tmp-<step>-<pid>`` (same filesystem),
+        fsynced, manifested, then renamed into place in one atomic step —
+        a crash at any point leaves either the previous verified state or
+        the complete new one, never a torn directory.
+        """
+        final = os.path.join(self.directory, str(step))
+        tmp = os.path.join(self.directory, f".tmp-{step}-{os.getpid()}")
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
         host_state = _ensure_host(state)
+        fault_point("ckpt.pre_write", step=step, dir=tmp)
         saved = False
         # orbax's save runs a cross-process barrier; a single-rank save
         # (the estimator checkpoints from rank 0 only) would deadlock
         # every other rank's next collective — use the pickle path
         if self._ckptr is not None and jax.process_count() == 1:
+            ocp_dir = os.path.join(tmp, "ocp")
             try:
-                self._ckptr.save(path, host_state, force=True)
+                self._ckptr.save(ocp_dir, host_state, force=True)
                 self._ckptr.wait_until_finished()
                 saved = True
-            except Exception:
-                pass
+            except Exception as e:
+                logger.warning(
+                    "orbax save for step %d failed (%s: %s); falling "
+                    "back to the pickle codec at %s", step,
+                    type(e).__name__, e, os.path.join(tmp, "state.pkl"))
+                shutil.rmtree(ocp_dir, ignore_errors=True)
         if not saved:
-            os.makedirs(path, exist_ok=True)
-            with open(os.path.join(path, "state.pkl"), "wb") as f:
-                pickle.dump(host_state, f, protocol=pickle.HIGHEST_PROTOCOL)
+            _write_durable(
+                os.path.join(tmp, "state.pkl"),
+                pickle.dumps(host_state, protocol=pickle.HIGHEST_PROTOCOL))
         if aux is not None:
-            with open(os.path.join(path, "aux.pkl"), "wb") as f:
-                pickle.dump(_ensure_host(aux), f,
-                            protocol=pickle.HIGHEST_PROTOCOL)
+            _write_durable(
+                os.path.join(tmp, "aux.pkl"),
+                pickle.dumps(_ensure_host(aux),
+                             protocol=pickle.HIGHEST_PROTOCOL))
+        fault_point("ckpt.pre_manifest", step=step, dir=tmp)
+        manifest = {"step": int(step), "files": {}}
+        for rel in _walk_files(tmp):
+            full = os.path.join(tmp, rel)
+            # orbax already fsyncs its own payload? not guaranteed — fsync
+            # everything we are about to vouch for in the manifest
+            with open(full, "rb+") as f:
+                os.fsync(f.fileno())
+            manifest["files"][rel] = {
+                "size": os.path.getsize(full), "sha256": _sha256(full)}
+        _write_durable(os.path.join(tmp, MANIFEST),
+                       json.dumps(manifest, indent=1).encode())
+        for dirpath, _, _ in os.walk(tmp):
+            _fsync_dir(dirpath)
+        fault_point("ckpt.pre_rename", step=step, dir=tmp)
+        stale = None
+        if os.path.isdir(final):
+            # re-save of an existing step: move the old copy aside (not
+            # delete!) so that at every instant either the old verified
+            # step or the new one is in place — the stale copy is dropped
+            # only AFTER the commit rename; a crash in between leaves a
+            # .stale-* orphan that _gc sweeps, never a missing step
+            stale = final + f".stale-{os.getpid()}"
+            shutil.rmtree(stale, ignore_errors=True)
+            os.rename(final, stale)
+        os.rename(tmp, final)  # the atomic commit point
+        if stale is not None:
+            shutil.rmtree(stale, ignore_errors=True)
+        self._verified_ok.discard(step)  # content changed: re-verify on read
+        _fsync_dir(self.directory)
+        fault_point("ckpt.post_rename", step=step, dir=final)
         self._gc()
 
     # -- read -------------------------------------------------------------
-    def all_steps(self):
+    def all_steps(self) -> List[int]:
+        """Committed step numbers (temp ``.tmp-*`` and quarantined
+        ``*.corrupt`` directories never match)."""
         steps = []
         if not os.path.isdir(self.directory):
             return steps
@@ -100,14 +212,95 @@ class CheckpointManager:
         steps = self.all_steps()
         return steps[-1] if steps else None
 
+    def latest_verified_step(self) -> Optional[int]:
+        """Newest step that passes manifest verification; corrupt steps
+        found on the way are quarantined."""
+        for s in reversed(self.all_steps()):
+            if self._verify_or_quarantine(s):
+                return s
+        return None
+
+    def verify(self, step: int) -> bool:
+        """Does ``step`` pass its manifest (sizes + checksums)? Steps
+        written before the manifest era (no ``manifest.json``) are
+        accepted when a payload file is present — they predate the
+        atomic-rename protocol, so their presence implies a completed
+        legacy save."""
+        path = os.path.join(self.directory, str(step))
+        if not os.path.isdir(path):
+            return False
+        mpath = os.path.join(path, MANIFEST)
+        if not os.path.exists(mpath):
+            return os.path.exists(os.path.join(path, "state.pkl")) or \
+                bool(os.listdir(path))
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+            files: Dict[str, Dict] = manifest["files"]
+        except (OSError, ValueError, KeyError) as e:
+            logger.warning("step %d: unreadable manifest (%s)", step, e)
+            return False
+        present = set(_walk_files(path)) - {MANIFEST}
+        if set(files) - present:
+            logger.warning("step %d: missing files %s", step,
+                           sorted(set(files) - present))
+            return False
+        for rel, meta in files.items():
+            full = os.path.join(path, rel)
+            if os.path.getsize(full) != meta["size"]:
+                logger.warning("step %d: %s size mismatch", step, rel)
+                return False
+            if _sha256(full) != meta["sha256"]:
+                logger.warning("step %d: %s checksum mismatch", step, rel)
+                return False
+        return True
+
+    def _verify_or_quarantine(self, step: int) -> bool:
+        if step in self._verified_ok and \
+                os.path.isdir(os.path.join(self.directory, str(step))):
+            return True
+        if self.verify(step):
+            self._verified_ok.add(step)
+            return True
+        self._verified_ok.discard(step)
+        path = os.path.join(self.directory, str(step))
+        dest = path + ".corrupt"
+        n = 0
+        while os.path.exists(dest):
+            n += 1
+            dest = f"{path}.corrupt.{n}"
+        try:
+            os.rename(path, dest)
+            logger.warning(
+                "quarantined corrupt/incomplete checkpoint step %d -> %s",
+                step, os.path.basename(dest))
+        except OSError as e:  # raced with another quarantiner: fine
+            logger.warning("could not quarantine step %d: %s", step, e)
+        return False
+
     def restore(self, step: Optional[int] = None, target: Any = None) -> Any:
-        """Load checkpoint ``step`` (None → latest; reference
-        ``find_latest_checkpoint`` filename-convention scan)."""
-        if step is None:
-            step = self.latest_step()
-        if step is None:
-            raise FileNotFoundError(
-                f"no checkpoints under {self.directory}")
+        """Load checkpoint ``step``. ``step=None`` picks the newest
+        VERIFIED step — corrupt or torn steps (a saver killed mid-write)
+        are quarantined to ``<step>.corrupt`` and skipped. An explicit
+        ``step`` that fails verification raises
+        :class:`CheckpointCorruptError` after quarantining it."""
+        if step is not None:
+            if not os.path.isdir(os.path.join(self.directory, str(step))):
+                raise FileNotFoundError(
+                    f"no checkpoint step {step} under {self.directory}")
+            if not self._verify_or_quarantine(step):
+                raise CheckpointCorruptError(
+                    f"checkpoint step {step} under {self.directory} is "
+                    "corrupt or incomplete (quarantined to "
+                    f"{step}.corrupt)")
+            return self._load(step, target)
+        for s in reversed(self.all_steps()):
+            if self._verify_or_quarantine(s):
+                return self._load(s, target)
+        raise FileNotFoundError(
+            f"no verified checkpoints under {self.directory}")
+
+    def _load(self, step: int, target: Any = None) -> Any:
         path = os.path.join(self.directory, str(step))
         pkl = os.path.join(path, "state.pkl")
         if os.path.exists(pkl):
@@ -115,15 +308,19 @@ class CheckpointManager:
                 return pickle.load(f)
         if self._ckptr is None:
             raise FileNotFoundError(path)
+        ocp_dir = os.path.join(path, "ocp")
+        src = ocp_dir if os.path.isdir(ocp_dir) else path  # legacy layout
         if target is not None:
-            return self._ckptr.restore(path, target=_ensure_host(target))
-        return self._ckptr.restore(path)
+            return self._ckptr.restore(src, target=_ensure_host(target))
+        return self._ckptr.restore(src)
 
     def restore_aux(self, step: Optional[int] = None) -> Any:
         """Load the side pytree written with ``save(..., aux=...)``;
-        None if the step has none."""
+        None if the step has none. ``step=None`` follows the same
+        newest-VERIFIED-step rule as :meth:`restore`, so params and
+        optimizer state always come from the same snapshot."""
         if step is None:
-            step = self.latest_step()
+            step = self.latest_verified_step()
         if step is None:
             return None
         path = os.path.join(self.directory, str(step), "aux.pkl")
@@ -132,10 +329,35 @@ class CheckpointManager:
         with open(path, "rb") as f:
             return pickle.load(f)
 
+    # -- housekeeping ------------------------------------------------------
     def _gc(self):
         steps = self.all_steps()
         while len(steps) > self.max_to_keep:
             victim = steps.pop(0)
-            import shutil
             shutil.rmtree(os.path.join(self.directory, str(victim)),
                           ignore_errors=True)
+        # prune quarantined dirs oldest-STEP-first (numeric, not
+        # lexicographic — "10.corrupt" is newer forensics than "2.corrupt")
+        corrupt = sorted(
+            (n for n in os.listdir(self.directory) if ".corrupt" in n),
+            key=lambda n: int(re.match(r"\d+", n).group()
+                              if re.match(r"\d+", n) else 0))
+        while len(corrupt) > self.max_to_keep:
+            shutil.rmtree(os.path.join(self.directory, corrupt.pop(0)),
+                          ignore_errors=True)
+        for name in os.listdir(self.directory):
+            m = _TMP_RE.match(name) or _STALE_RE.match(name)
+            if not m:
+                continue
+            pid = int(m.group(2))
+            if pid == os.getpid():
+                continue
+            try:
+                os.kill(pid, 0)  # saver still alive: leave its staging dir
+            except ProcessLookupError:
+                shutil.rmtree(os.path.join(self.directory, name),
+                              ignore_errors=True)
+                logger.info("removed stale checkpoint staging dir %s "
+                            "(saver pid %d is gone)", name, pid)
+            except PermissionError:
+                pass  # pid exists under another uid: leave it

@@ -30,6 +30,8 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from zoo_tpu.util.resilience import CircuitBreaker, fault_point
+
 
 class StageTimer:
     """Per-stage avg/max/min running stats (reference: ``Timer.scala``)."""
@@ -113,13 +115,22 @@ class ServingServer:
     def __init__(self, model, host: str = "127.0.0.1", port: int = 0,
                  batch_size: int = 8, max_wait_ms: float = 5.0,
                  num_replicas: int = 1, models=None,
-                 certfile: str = None, keyfile: str = None):
+                 certfile: str = None, keyfile: str = None,
+                 breaker: Optional[CircuitBreaker] = None):
         """``certfile``/``keyfile``: serve over TLS — the trusted-
         serving door of the reference's PPML trusted-realtime-ml story
         (``ppml/trusted-realtime-ml/``: encrypted transport in front of
         the serving pipeline; model-at-rest encryption is
-        ``InferenceModel.load_encrypted``)."""
+        ``InferenceModel.load_encrypted``).
+
+        ``breaker``: optional :class:`CircuitBreaker` for load shedding —
+        after its consecutive-failure threshold trips, predict requests
+        are rejected immediately at the front door (error mentions
+        "shedding load") instead of queueing behind a dead model; the
+        breaker half-opens after its recovery timeout and closes again on
+        the first successful batch."""
         self.model = model
+        self.breaker = breaker
         self._replicas = list(models) if models else \
             [model] * max(1, int(num_replicas))
         self.batch_size = batch_size
@@ -163,6 +174,17 @@ class ServingServer:
                     if msg is None:
                         return
                     if msg.get("op") == "predict":
+                        if outer.breaker is not None and \
+                                not outer.breaker.allow():
+                            # load shedding: fail fast at the door while
+                            # the model is known-broken, instead of
+                            # parking the caller behind a dead batcher
+                            _send_msg(self.request, {
+                                "uri": msg.get("uri"), "shed": True,
+                                "error": "server shedding load (circuit "
+                                         "open after repeated inference "
+                                         "failures; retry later)"})
+                            continue
                         req = _Request(msg["uri"], msg["data"])
                         t0 = time.perf_counter()
                         outer._queue.put(req)
@@ -229,6 +251,7 @@ class ServingServer:
 
             t1 = time.perf_counter()
             try:
+                fault_point("serving.infer", batch=len(batch))
                 arrays = [np.asarray(r.data) for r in batch]
                 stacked = np.concatenate(arrays, axis=0)
                 preds = model.predict(stacked,
@@ -237,7 +260,11 @@ class ServingServer:
                 for r, a in zip(batch, arrays):
                     r.result = np.asarray(preds[offset:offset + len(a)])
                     offset += len(a)
+                if self.breaker is not None:
+                    self.breaker.record_success()
             except Exception as e:  # route the error to every caller
+                if self.breaker is not None:
+                    self.breaker.record_failure()
                 for r in batch:
                     r.error = repr(e)
             self.timers["inference"].record(time.perf_counter() - t1)

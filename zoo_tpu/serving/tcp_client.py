@@ -15,35 +15,71 @@ from typing import Dict, Optional
 import numpy as np
 
 from zoo_tpu.serving.server import _recv_msg, _send_msg
+from zoo_tpu.util.resilience import RetryPolicy, fault_point
 
 
 class _Connection:
+    """One RPC connection with reconnect-and-retry.
+
+    Transient transport failures (server restarting, connection reset
+    mid-RPC) are retried under ``retry`` with exponential backoff,
+    re-dialing a fresh socket each attempt; server-side *application*
+    errors come back as normal responses and are never retried here."""
+
     def __init__(self, host: str, port: int, tls: bool = False,
-                 cafile: str = None, verify: bool = True):
-        self._sock = socket.create_connection((host, port))
-        if tls:
+                 cafile: str = None, verify: bool = True,
+                 retry: Optional[RetryPolicy] = None):
+        self._host, self._port = host, port
+        self._tls, self._cafile, self._verify = tls, cafile, verify
+        self._retry = retry or RetryPolicy(max_attempts=3,
+                                           base_delay=0.05, max_delay=1.0)
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._open()
+
+    def _open(self):
+        sock = socket.create_connection((self._host, self._port))
+        if self._tls:
             import ssl
-            ctx = ssl.create_default_context(cafile=cafile)
-            if not verify:
+            ctx = ssl.create_default_context(cafile=self._cafile)
+            if not self._verify:
                 # EXPLICIT opt-out only (self-signed dev certs):
                 # encryption without server authentication — never
                 # inferred from a missing cafile
                 ctx.check_hostname = False
                 ctx.verify_mode = ssl.CERT_NONE
-            self._sock = ctx.wrap_socket(self._sock,
-                                         server_hostname=host)
-        self._lock = threading.Lock()
+            sock = ctx.wrap_socket(sock, server_hostname=self._host)
+        self._sock = sock
+
+    def _drop(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _rpc_once(self, msg: Dict) -> Dict:
+        fault_point("serving.request", op=msg.get("op"))
+        with self._lock:
+            if self._sock is None:
+                self._open()
+            try:
+                _send_msg(self._sock, msg)
+                resp = _recv_msg(self._sock)
+            except OSError:
+                self._drop()  # poisoned stream: next attempt re-dials
+                raise
+            if resp is None:
+                self._drop()
+                raise ConnectionError("serving connection closed")
+            return resp
 
     def rpc(self, msg: Dict) -> Dict:
-        with self._lock:
-            _send_msg(self._sock, msg)
-            resp = _recv_msg(self._sock)
-        if resp is None:
-            raise ConnectionError("serving connection closed")
-        return resp
+        return self._retry.call(self._rpc_once, msg)
 
     def close(self):
-        self._sock.close()
+        self._drop()
 
 
 class TCPInputQueue:

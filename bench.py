@@ -615,13 +615,61 @@ def main():
 
     extra.pop("_peak", None)
     ok = bert_mfu == bert_mfu
-    print(json.dumps({
+    print(json.dumps(_publish_result(bert_mfu if ok else None, extra)))
+
+
+def _publish_result(headline_mfu, extra):
+    """Route the result line through the obs registry: every numeric axis
+    becomes a ``zoo_bench_extra{key=...}`` gauge and the printed JSON is
+    rebuilt from the registry *snapshot* — the same dict a snapshot file
+    or the multihost aggregator would carry — so bench output and live
+    telemetry can never drift apart. ``$ZOO_OBS_SNAPSHOT`` additionally
+    appends the full snapshot as one JSONL record."""
+    import os
+
+    from zoo_tpu.obs import get_registry, write_snapshot
+
+    reg = get_registry()
+    if not reg.enabled:
+        # a disabled registry drops every set(); snapshot values would
+        # all read 0.0 — report the raw numbers rather than silently
+        # zeroed ones
+        return {
+            "metric": "bert_base_train_mfu",
+            "value": round(headline_mfu, 4)
+            if headline_mfu is not None else None,
+            "unit": "MFU",
+            "vs_baseline": round(headline_mfu / 0.40, 3)
+            if headline_mfu is not None else None,
+            "extra": extra,
+        }
+    g_extra = reg.gauge("zoo_bench_extra",
+                        "bench.py numeric result axes", labels=("key",))
+    g_head = reg.gauge("zoo_bench_bert_base_train_mfu",
+                       "bench.py headline metric (BERT-base train MFU)")
+    for k, v in extra.items():
+        if isinstance(v, (int, float)) and not isinstance(v, bool) \
+                and v == v:
+            g_extra.labels(key=k).set(float(v))
+    if headline_mfu is not None:
+        g_head.set(round(headline_mfu, 4))
+    snap = reg.snapshot()
+    snap_extra = {e["labels"]["key"]: e["value"] for e in snap["gauges"]
+                  if e["name"] == "zoo_bench_extra"}
+    snap_head = [e["value"] for e in snap["gauges"]
+                 if e["name"] == "zoo_bench_bert_base_train_mfu"]
+    value = snap_head[0] if headline_mfu is not None and snap_head else None
+    out_extra = {k: snap_extra.get(k, v) for k, v in extra.items()}
+    path = os.environ.get("ZOO_OBS_SNAPSHOT")
+    if path:
+        write_snapshot(path, reg)
+    return {
         "metric": "bert_base_train_mfu",
-        "value": round(bert_mfu, 4) if ok else None,
+        "value": value,
         "unit": "MFU",
-        "vs_baseline": round(bert_mfu / 0.40, 3) if ok else None,
-        "extra": extra,
-    }))
+        "vs_baseline": round(value / 0.40, 3) if value is not None else None,
+        "extra": out_extra,
+    }
 
 
 if __name__ == "__main__":

@@ -1,0 +1,86 @@
+"""Multihost obs: coordinator-side metric aggregation and trace-id
+propagation on a real 2-process JAX CPU cluster (the reference's
+"local topology, real fabric" trick, SURVEY §4.3). Workers record
+different counter/gauge/histogram values; ``aggregate_cluster`` must
+return the same merged view on both — counters summed, gauges
+max/min'd, histogram buckets added — and ``share_trace_id`` must hand
+every process the coordinator's trace id."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+# 2-process jax.distributed clusters — fresh JAX compile per process
+pytestmark = [pytest.mark.slow, pytest.mark.obs]
+
+_WORKER = r"""
+import json, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+coord, pid, pcnt = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+jax.distributed.initialize(coordinator_address=coord, num_processes=pcnt,
+                           process_id=pid)
+
+from zoo_tpu.obs import (MetricsRegistry, aggregate_cluster,
+                         share_trace_id, current_trace_id)
+
+reg = MetricsRegistry()
+# proc 0 records 3, proc 1 records 5 -> cluster total must be 8
+reg.counter("t_retries_total", "x").inc(3 if pid == 0 else 5)
+reg.gauge("t_depth", "x").set(10 * (pid + 1))         # 10 and 20
+h = reg.histogram("t_lat_seconds", "x", buckets=(0.1, 1.0))
+h.observe(0.05)                                        # both: bucket 0
+if pid == 1:
+    h.observe(5.0)                                     # only p1: +Inf
+
+merged = aggregate_cluster(registry=reg, timeout_s=60)
+assert merged["processes"] == 2, merged
+c = {e["name"]: e["value"] for e in merged["counters"]}
+assert c["t_retries_total"] == 8, merged["counters"]
+g = {e["name"]: e for e in merged["gauges"]}
+assert g["t_depth"]["max"] == 20 and g["t_depth"]["min"] == 10, g
+hh = merged["histograms"][0]
+assert hh["counts"] == [2, 0, 1], hh
+assert hh["count"] == 3, hh
+
+tid = share_trace_id(timeout_s=60)
+assert tid == current_trace_id()
+print(f"proc {pid} OK total={c['t_retries_total']} trace={tid}")
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.timeout(300)
+def test_two_process_aggregation_and_trace_id(tmp_path):
+    coord = f"127.0.0.1:{_free_port()}"
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.getcwd() + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), coord, str(i), "2"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for i in range(2)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
+        assert f"proc {i} OK total=8.0" in out
+    # both processes adopted the SAME trace id (the coordinator's)
+    tids = {out.strip().rsplit("trace=", 1)[1].splitlines()[0]
+            for out in outs}
+    assert len(tids) == 1, tids

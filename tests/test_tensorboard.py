@@ -93,3 +93,50 @@ def test_negative_and_large_steps(tmp_path):
     w.close()
     got = tb.read_scalars(str(tmp_path), "t")
     assert got["t"][0][0] == 2 ** 40
+
+
+def test_read_scalars_survives_truncated_tail(tmp_path):
+    """Crash-safety parity with the checkpoint reader: a writer killed
+    mid-record leaves a torn tail; read-back must return every scalar
+    before the damage, not raise."""
+    w = tb.EventWriter(str(tmp_path))
+    for i in range(8):
+        w.add_scalar("Loss", float(i), i)
+    w.flush()
+    w.close()
+    fname = [f for f in os.listdir(tmp_path) if "tfevents" in f][0]
+    path = str(tmp_path / fname)
+    whole = open(path, "rb").read()
+    # chop the last record mid-payload (header intact, payload short)
+    with open(path, "wb") as f:
+        f.write(whole[:-7])
+    got = tb.read_scalars(str(tmp_path), "Loss")
+    steps = [s for s, _, _ in got["Loss"]]
+    assert steps == list(range(7))  # all but the torn final record
+
+
+def test_read_scalars_skips_corrupt_record_keeps_earlier(tmp_path):
+    w = tb.EventWriter(str(tmp_path))
+    for i in range(5):
+        w.add_scalar("acc", 0.1 * i, i)
+    w.flush()
+    w.close()
+    fname = [f for f in os.listdir(tmp_path) if "tfevents" in f][0]
+    path = str(tmp_path / fname)
+    data = bytearray(open(path, "rb").read())
+    # flip bytes inside the LAST record's payload: framing stays intact,
+    # the payload CRC fails, the reader skips just that record
+    data[-10] ^= 0xFF
+    data[-11] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(data)
+    got = tb.read_scalars(str(tmp_path), "acc")
+    steps = [s for s, _, _ in got["acc"]]
+    assert steps == list(range(4))
+
+    # garbage appended after valid records (corrupt length header):
+    # reader stops there, earlier scalars still come back
+    with open(path, "ab") as f:
+        f.write(os.urandom(64))
+    got = tb.read_scalars(str(tmp_path), "acc")
+    assert [s for s, _, _ in got["acc"]] == list(range(4))

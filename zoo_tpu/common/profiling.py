@@ -23,29 +23,17 @@ import contextlib
 import time
 from typing import Dict, Iterator, Optional
 
+from zoo_tpu.obs.metrics import StatTimer, histogram
 
-class PhaseTimer:
-    """Running stats for one named phase (reference ``Timer.scala``)."""
+# PhaseTimer and serving's StageTimer were copy-pasted twins of the
+# reference's Timer.scala; the one implementation now lives in
+# zoo_tpu.obs. The old name stays importable from here.
+PhaseTimer = StatTimer
 
-    __slots__ = ("n", "total", "max", "min")
-
-    def __init__(self):
-        self.n = 0
-        self.total = 0.0
-        self.max = 0.0
-        self.min = float("inf")
-
-    def record(self, dt: float):
-        self.n += 1
-        self.total += dt
-        self.max = max(self.max, dt)
-        self.min = min(self.min, dt)
-
-    def stats(self) -> Dict[str, float]:
-        return {"count": self.n,
-                "avg_ms": 1000 * self.total / max(self.n, 1),
-                "max_ms": 1000 * self.max,
-                "min_ms": 0.0 if self.n == 0 else 1000 * self.min}
+_phase_seconds = histogram(
+    "zoo_step_phase_seconds",
+    "Training-loop per-phase wall time (data wait / reshard / step / eval)",
+    labels=("phase",))
 
 
 class StepProfiler:
@@ -79,7 +67,14 @@ class StepProfiler:
 
     def record(self, name: str, dt: float):
         self.timers.setdefault(name, PhaseTimer()).record(dt)
-        self.cumulative.setdefault(name, PhaseTimer()).record(dt)
+        cum = self.cumulative.get(name)
+        if cum is None:
+            # the cumulative timer mirrors into the shared registry so
+            # phase times show up on /metrics next to serving/checkpoint/
+            # retry stats, not only in this profiler's TensorBoard scalars
+            cum = self.cumulative[name] = PhaseTimer(
+                histogram=_phase_seconds.labels(phase=name))
+        cum.record(dt)
 
     def timed_iter(self, it: Iterator, name: str = "data") -> Iterator:
         """Yield from ``it`` recording the host wait per item."""

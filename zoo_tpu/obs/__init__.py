@@ -1,0 +1,75 @@
+"""zoo_tpu.obs — unified telemetry: metrics, traces, exporters, cluster view.
+
+The observability layer the reference platform never had in one place
+(its instruments were a serving ``Timer``, optimizer wall-clock logs and
+TensorBoard summaries, each blind to the others — SURVEY §5.1). Four
+pieces:
+
+* :mod:`zoo_tpu.obs.metrics`    — process-global registry of Counters /
+  Gauges / Histograms with labels; near-zero-cost when disabled.
+* :mod:`zoo_tpu.obs.tracing`    — ``span("name", **attrs)`` JSONL trace
+  events with cross-host trace-id propagation over the JAX
+  coordination service.
+* :mod:`zoo_tpu.obs.exporters`  — loopback HTTP ``/metrics`` (Prometheus
+  text) + ``/healthz`` (heartbeat freshness) + ``/cluster``; JSONL
+  snapshot writer for offline analysis.
+* :mod:`zoo_tpu.obs.aggregate`  — workers publish snapshots into the KV
+  store; the merge sums counters, max/mins gauges, bucket-merges
+  histograms into one cluster view.
+
+Every layer of the stack records here: retries/breakers/fault trips
+(``util.resilience``), checkpoint save/restore/verify
+(``orca.learn.ckpt``), shard-exchange fetches and rebalance barriers
+(``orca.data.plane``), serving queue/batch/stage latency
+(``serving.server``), per-phase step times (``common.profiling``),
+worker restarts (``orca.bootstrap``) and the bench harness. See
+``docs/observability.md``.
+"""
+
+# metrics must import first: the other submodules (and every instrumented
+# zoo_tpu module) depend on it, and exporters lazily re-enters zoo_tpu
+# code that imports us back
+from zoo_tpu.obs.metrics import (  # noqa: F401
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    StatTimer,
+    counter,
+    gauge,
+    get_registry,
+    histogram,
+)
+from zoo_tpu.obs.tracing import (  # noqa: F401
+    TRACE_DIR_ENV,
+    current_trace_id,
+    read_trace,
+    set_trace_id,
+    share_trace_id,
+    span,
+    stop_tracing,
+    trace_to,
+    tracing_enabled,
+)
+from zoo_tpu.obs.exporters import (  # noqa: F401
+    MetricsExporter,
+    start_snapshot_thread,
+    validate_prometheus_text,
+    write_snapshot,
+)
+from zoo_tpu.obs.aggregate import (  # noqa: F401
+    aggregate_cluster,
+    last_cluster_view,
+    merge_snapshots,
+)
+
+__all__ = [
+    "DEFAULT_BUCKETS", "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "StatTimer", "counter", "gauge", "get_registry", "histogram",
+    "TRACE_DIR_ENV", "current_trace_id", "read_trace", "set_trace_id",
+    "share_trace_id", "span", "stop_tracing", "trace_to", "tracing_enabled",
+    "MetricsExporter", "start_snapshot_thread", "validate_prometheus_text",
+    "write_snapshot",
+    "aggregate_cluster", "last_cluster_view", "merge_snapshots",
+]

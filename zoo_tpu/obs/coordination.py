@@ -1,0 +1,25 @@
+"""The JAX coordination-service KV client, in one place.
+
+Present whenever ``jax.distributed.initialize`` ran — exactly the
+multi-process case. The obs control planes (trace-id propagation,
+snapshot aggregation) and the data plane's ``rebalance_shards`` all ride
+it rather than XLA device collectives: key-value ops work on every
+backend (CPU included) and the blocking gets carry timeouts, so a dead
+peer becomes a raised error instead of an eternal barrier. The import
+reaches into ``jax._src`` — when that internal path moves, this is the
+single spot to fix.
+"""
+
+from __future__ import annotations
+
+__all__ = ["coordination_client"]
+
+
+def coordination_client():
+    """The KV client, or None outside an initialized multi-process
+    cluster (callers raise their own, context-specific error)."""
+    try:
+        from jax._src import distributed
+        return distributed.global_state.client
+    except Exception:  # pragma: no cover - jax internals moved
+        return None

@@ -1,0 +1,239 @@
+"""Trace spans: per-process JSONL event log + cross-host trace ids.
+
+:func:`span` is a context manager marking one timed region::
+
+    with span("ckpt.save", step=120):
+        ...
+
+Each span emits two JSONL records into the process's trace file — a
+``B`` (begin) event and an ``E`` (end) event carrying the monotonic
+duration and error flag — with a ``span`` id, its ``parent`` span id
+(spans nest per thread), and the process-wide ``trace`` id. One training
+step or serving request can therefore be followed across hosts: the
+coordinator mints a trace id and :func:`share_trace_id` propagates it to
+every process over the same JAX coordination-service KV store that
+``rebalance_shards`` uses, so all hosts' trace files stitch on the
+shared id.
+
+Tracing is off until a sink exists: call :func:`trace_to` or set
+``$ZOO_TRACE_DIR``. A disabled :func:`span` costs one global check and a
+no-op context manager — safe to leave in hot paths.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import socket
+import threading
+import time
+import uuid
+from typing import Iterator, List, Optional
+
+from zoo_tpu.obs.coordination import coordination_client
+
+__all__ = [
+    "span", "trace_to", "stop_tracing", "tracing_enabled",
+    "current_trace_id", "set_trace_id", "share_trace_id",
+    "read_trace", "TRACE_DIR_ENV",
+]
+
+logger = logging.getLogger(__name__)
+
+TRACE_DIR_ENV = "ZOO_TRACE_DIR"
+
+_lock = threading.Lock()
+_sink = None            # type: Optional[_TraceLog]
+_env_checked = False
+_trace_id: Optional[str] = None
+_tls = threading.local()  # .stack: span-id stack per thread
+
+
+class _TraceLog:
+    """Append-only JSONL writer for one process's trace events."""
+
+    def __init__(self, dir_path: str):
+        os.makedirs(dir_path, exist_ok=True)
+        self.path = os.path.join(
+            dir_path,
+            f"trace-{socket.gethostname()}-{os.getpid()}.jsonl")
+        self._f = open(self.path, "a", encoding="utf-8")
+        self._wlock = threading.Lock()
+
+    def write(self, event: dict):
+        line = json.dumps(event, separators=(",", ":"), default=str)
+        try:
+            with self._wlock:
+                self._f.write(line + "\n")
+                self._f.flush()
+        except (OSError, ValueError) as e:
+            # telemetry must never fail the instrumented operation (a
+            # full disk, or stop_tracing() racing a span in another
+            # thread) — and an error raised from span()'s finally would
+            # even MASK the operation's own exception
+            logger.debug("trace write dropped: %s", e)
+
+    def close(self):
+        with self._wlock:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+
+
+def trace_to(dir_path: str) -> str:
+    """Start writing span events under ``dir_path``; returns the trace
+    file path for this process."""
+    global _sink
+    with _lock:
+        if _sink is not None:
+            _sink.close()
+        _sink = _TraceLog(dir_path)
+        return _sink.path
+
+
+def stop_tracing():
+    global _sink, _env_checked
+    with _lock:
+        if _sink is not None:
+            _sink.close()
+        _sink = None
+        _env_checked = True  # an explicit stop beats the env default
+
+
+def _active_sink() -> "Optional[_TraceLog]":
+    global _sink, _env_checked
+    if _sink is not None:
+        return _sink
+    if _env_checked:
+        return None
+    with _lock:
+        if _sink is None and not _env_checked:
+            _env_checked = True
+            d = os.environ.get(TRACE_DIR_ENV)
+            if d:
+                try:
+                    _sink = _TraceLog(d)
+                except OSError as e:  # bad dir must not kill the caller
+                    logger.warning("cannot open trace dir %s: %s", d, e)
+        return _sink
+
+
+def tracing_enabled() -> bool:
+    return _active_sink() is not None
+
+
+# ------------------------------------------------------------- trace ids
+
+def current_trace_id() -> str:
+    """This process's trace id (minted on first use)."""
+    global _trace_id
+    with _lock:
+        if _trace_id is None:
+            _trace_id = uuid.uuid4().hex
+        return _trace_id
+
+
+def set_trace_id(trace_id: str):
+    global _trace_id
+    with _lock:
+        _trace_id = str(trace_id)
+
+
+_share_generation = 0
+_share_gen_lock = threading.Lock()
+
+
+def share_trace_id(timeout_s: float = 30.0) -> str:
+    """Adopt one cluster-wide trace id (collective: call on every
+    process). Process 0 publishes its trace id through the coordination
+    service; everyone else blocks for it and adopts it, so all hosts'
+    span events stitch into one distributed trace. Single-process: just
+    returns the local id."""
+    import jax
+
+    if jax.process_count() == 1:
+        return current_trace_id()
+    client = coordination_client()
+    if client is None:
+        raise RuntimeError(
+            "share_trace_id needs the JAX coordination service "
+            "(jax.distributed.initialize) in multi-process mode")
+    global _share_generation
+    with _share_gen_lock:
+        _share_generation += 1
+        gen = _share_generation
+    key = f"zoo:obs:trace:{gen}"
+    if jax.process_index() == 0:
+        client.key_value_set(key, current_trace_id())
+    tid = client.blocking_key_value_get(key, int(timeout_s * 1000))
+    if isinstance(tid, bytes):
+        tid = tid.decode()
+    set_trace_id(tid)
+    return tid
+
+
+# ----------------------------------------------------------------- spans
+
+def _stack() -> List[str]:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs) -> Iterator[Optional[str]]:
+    """Timed, nested trace region; yields the span id (None when tracing
+    is off). Exceptions propagate; the end event records ``ok: false``."""
+    sink = _active_sink()
+    if sink is None:
+        yield None
+        return
+    sid = uuid.uuid4().hex[:16]
+    st = _stack()
+    parent = st[-1] if st else None
+    ev = {"ev": "B", "name": name, "trace": current_trace_id(),
+          "span": sid, "parent": parent, "pid": os.getpid(),
+          "ts": time.time()}
+    if attrs:
+        ev["attrs"] = attrs
+    sink.write(ev)
+    st.append(sid)
+    t0 = time.perf_counter()
+    ok = True
+    try:
+        yield sid
+    except BaseException:
+        ok = False
+        raise
+    finally:
+        st.pop()
+        sink.write({"ev": "E", "name": name,
+                    "trace": ev["trace"], "span": sid,
+                    "ts": time.time(),
+                    "dur_s": time.perf_counter() - t0, "ok": ok})
+
+
+def read_trace(dir_path: str) -> List[dict]:
+    """Load every span event under ``dir_path`` (all hosts' files),
+    sorted by wall timestamp — the offline-analysis read-back."""
+    events: List[dict] = []
+    if not os.path.isdir(dir_path):
+        return events
+    for fname in sorted(os.listdir(dir_path)):
+        if not (fname.startswith("trace-") and fname.endswith(".jsonl")):
+            continue
+        with open(os.path.join(dir_path, fname), encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except ValueError:
+                    continue  # torn tail write: skip, keep the rest
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    return events

@@ -41,6 +41,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence
 
+from zoo_tpu.obs.metrics import counter
 from zoo_tpu.util.resilience import (
     HEARTBEAT_FILE_ENV,
     HEARTBEAT_INTERVAL_ENV,
@@ -48,6 +49,13 @@ from zoo_tpu.util.resilience import (
 )
 
 logger = logging.getLogger(__name__)
+
+_worker_restarts = counter(
+    "zoo_worker_restarts_total",
+    "Supervised workers respawned after a crash or hang")
+_workers_hung = counter(
+    "zoo_worker_hung_total",
+    "Supervised workers killed for a stale heartbeat")
 
 _PR_SET_PDEATHSIG = 1
 
@@ -231,6 +239,7 @@ class ProcessMonitor:
                     "%s heartbeat stale (%.1fs > %.1fs%s); killing the "
                     "hung worker", w.name, age, limit,
                     "" if booted else ", boot grace")
+                _workers_hung.inc()
                 w.kill()
                 return (f"hung (heartbeat stale {age:.1f}s > "
                         f"{limit}s limit)")
@@ -247,6 +256,7 @@ class ProcessMonitor:
                         if self._stop.is_set():
                             return  # teardown won the race: no respawn
                         w.restarts += 1
+                        _worker_restarts.inc()
                         logger.warning(
                             "%s %s; restart %d/%d", w.name, reason,
                             w.restarts, self.max_restarts)

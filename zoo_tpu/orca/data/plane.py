@@ -37,11 +37,29 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from zoo_tpu.obs.coordination import (
+    # the rebalance control plane rides the coordination-service KV
+    # store rather than XLA device collectives — see that module (the
+    # helper is shared with trace-id propagation and metric aggregation)
+    coordination_client as _coordination_client,
+)
+from zoo_tpu.obs.metrics import counter, histogram
+from zoo_tpu.obs.tracing import span
 from zoo_tpu.util.resilience import RetryPolicy, fault_point
 
 __all__ = ["ShardExchange", "assign_shards", "rebalance_shards"]
 
 logger = logging.getLogger(__name__)
+
+_fetch_seconds = histogram(
+    "zoo_shard_fetch_seconds",
+    "Cross-host shard fetch latency (one successful attempt)")
+_fetch_bytes = counter(
+    "zoo_shard_fetch_bytes_total", "Shard payload bytes fetched from peers")
+_barrier_wait = histogram(
+    "zoo_rebalance_barrier_wait_seconds",
+    "Wall time spent in each rebalance KV-store barrier phase",
+    labels=("phase",))
 
 _MAGIC = b"ZSX1"
 
@@ -156,13 +174,17 @@ class ShardExchange:
 
         def _once():
             fault_point("shard.fetch", addr=addr, gid=gid)
+            t0 = time.perf_counter()
             with socket.create_connection(addr, timeout=timeout) as sock:
                 sock.sendall(_MAGIC + struct.pack("!I", gid))
                 (n,) = struct.unpack("!I", _recv_exact(sock, 4))
                 if n == 0:
                     raise KeyError(
                         f"peer {addr} does not hold shard {gid}")
-                return _decode_shard(_recv_exact(sock, n))
+                out = _decode_shard(_recv_exact(sock, n))
+            _fetch_seconds.observe(time.perf_counter() - t0)
+            _fetch_bytes.inc(n)
+            return out
 
         return retry.call(_once)
 
@@ -199,19 +221,6 @@ _rebal_generation = 0
 _rebal_gen_lock = threading.Lock()
 
 
-def _coordination_client():
-    """The JAX coordination-service KV client (present whenever
-    ``jax.distributed.initialize`` ran — exactly the multi-process
-    case). The rebalance *control plane* rides on it rather than on XLA
-    device collectives: key-value allgather works on every backend (CPU
-    included, where cross-process XLA computations may not), and its
-    blocking gets carry timeouts — which is what turns a dead peer into
-    a raised error instead of an eternal barrier."""
-    try:
-        from jax._src import distributed
-        return distributed.global_state.client
-    except Exception:  # pragma: no cover - jax internals moved
-        return None
 
 
 def _kv_allgather(client, gen: int, tag: str, pid: int, nprocs: int,
@@ -221,6 +230,7 @@ def _kv_allgather(client, gen: int, tag: str, pid: int, nprocs: int,
     have published. A peer that never publishes (crashed, hung) makes
     the blocking get raise within ``timeout_s`` on every waiter."""
     prefix = f"zoo:rebalance:{gen}:{tag}:"
+    t0 = time.perf_counter()
     client.key_value_set(prefix + str(pid), value)
     # one deadline for the WHOLE phase, re-derived per get — giving every
     # key the full budget would let N slow peers stack to N x timeout_s
@@ -234,6 +244,9 @@ def _kv_allgather(client, gen: int, tag: str, pid: int, nprocs: int,
             raise TimeoutError(
                 f"host {p} never reached rebalance phase {tag!r} within "
                 f"{timeout_s:.0f}s (crashed or hung peer): {e}") from e
+    # the time a host sits here is the stragglers' lead over it — the
+    # cluster-wide max of this histogram is the rebalance skew
+    _barrier_wait.labels(phase=tag).observe(time.perf_counter() - t0)
     return out
 
 
@@ -294,43 +307,45 @@ def rebalance_shards(shards, bind_ip: Optional[str] = None,
     exchange = ShardExchange(
         {int(offsets[pid] + i): s for i, s in enumerate(parts)}, bind=ip)
     try:
-        table = _kv_allgather(client, gen, "addr", pid, nprocs,
-                              f"{ip}:{exchange.port}", remaining())
-        addrs = []
-        for row in table:
-            host, port = row.rsplit(":", 1)
-            addrs.append((host, int(port)))
-        plan = assign_shards(counts)
-        mine, error = [], None
-        try:
-            for gid in plan[pid]:
-                src = int(np.searchsorted(offsets, gid, side="right") - 1)
-                if src == pid:
-                    mine.append(parts[gid - offsets[pid]])
-                    continue
-                mine.append(ShardExchange.fetch(
-                    addrs[src], gid, timeout=min(remaining(), 60.0)))
-        except Exception as e:  # noqa: BLE001 — reported to every host
-            error = e
-            logger.error("shard fetch phase failed on host %d: %r",
-                         pid, e)
-        # status exchange doubles as the teardown barrier: every host
-        # reaches it whether its fetches succeeded or not, and nobody
-        # closes its shard server until all hosts have finished fetching.
-        # Computed WITHOUT remaining() — which raises once the deadline
-        # is spent — because the status publish must happen even (above
-        # all) on the host that blew the deadline, or its peers stall
-        # waiting for a verdict that never comes
-        status_wait = max(5.0, deadline - (time.monotonic() - t0))
-        status = _kv_allgather(
-            client, gen, "status", pid, nprocs,
-            "ok" if error is None else f"err:{error!r:.500}",
-            status_wait)
-        bad = {i: s for i, s in enumerate(status) if s != "ok"}
-        if bad:
-            raise RuntimeError(
-                f"shard rebalance failed on host(s) {sorted(bad)}: "
-                f"{bad}") from error
+        with span("rebalance_shards", gen=gen, pid=pid, nprocs=nprocs):
+            table = _kv_allgather(client, gen, "addr", pid, nprocs,
+                                  f"{ip}:{exchange.port}", remaining())
+            addrs = []
+            for row in table:
+                host, port = row.rsplit(":", 1)
+                addrs.append((host, int(port)))
+            plan = assign_shards(counts)
+            mine, error = [], None
+            try:
+                for gid in plan[pid]:
+                    src = int(np.searchsorted(offsets, gid,
+                                              side="right") - 1)
+                    if src == pid:
+                        mine.append(parts[gid - offsets[pid]])
+                        continue
+                    mine.append(ShardExchange.fetch(
+                        addrs[src], gid, timeout=min(remaining(), 60.0)))
+            except Exception as e:  # noqa: BLE001 — reported to every host
+                error = e
+                logger.error("shard fetch phase failed on host %d: %r",
+                             pid, e)
+            # status exchange doubles as the teardown barrier: every host
+            # reaches it whether its fetches succeeded or not, and nobody
+            # closes its shard server until all hosts have finished
+            # fetching. Computed WITHOUT remaining() — which raises once
+            # the deadline is spent — because the status publish must
+            # happen even (above all) on the host that blew the deadline,
+            # or its peers stall waiting for a verdict that never comes
+            status_wait = max(5.0, deadline - (time.monotonic() - t0))
+            status = _kv_allgather(
+                client, gen, "status", pid, nprocs,
+                "ok" if error is None else f"err:{error!r:.500}",
+                status_wait)
+            bad = {i: s for i, s in enumerate(status) if s != "ok"}
+            if bad:
+                raise RuntimeError(
+                    f"shard rebalance failed on host(s) {sorted(bad)}: "
+                    f"{bad}") from error
     finally:
         exchange.close()
     return LocalXShards(mine)

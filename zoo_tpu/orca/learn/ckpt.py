@@ -31,14 +31,28 @@ import os
 import pickle
 import re
 import shutil
+import time
 from typing import Any, Dict, List, Optional
 
 import jax
 import numpy as np
 
+from zoo_tpu.obs.metrics import counter, histogram
+from zoo_tpu.obs.tracing import span
 from zoo_tpu.util.resilience import fault_point
 
 logger = logging.getLogger(__name__)
+
+_save_seconds = histogram(
+    "zoo_ckpt_save_seconds", "Checkpoint save wall time (stage + fsync + "
+    "manifest + atomic rename)")
+_restore_seconds = histogram(
+    "zoo_ckpt_restore_seconds", "Checkpoint restore wall time (verify + load)")
+_verify_seconds = histogram(
+    "zoo_ckpt_verify_seconds", "Manifest verification wall time")
+_quarantined = counter(
+    "zoo_ckpt_quarantined_total",
+    "Corrupt/incomplete checkpoint steps moved to <step>.corrupt")
 
 _STEP_RE = re.compile(r"^(\d+)$")
 _TMP_RE = re.compile(r"^\.tmp-(\d+)-(\d+)$")  # .tmp-<step>-<pid>
@@ -131,6 +145,10 @@ class CheckpointManager:
         a crash at any point leaves either the previous verified state or
         the complete new one, never a torn directory.
         """
+        with span("ckpt.save", step=int(step)), _save_seconds.time():
+            self._save(step, state, aux)
+
+    def _save(self, step: int, state: Any, aux: Any = None):
         final = os.path.join(self.directory, str(step))
         tmp = os.path.join(self.directory, f".tmp-{step}-{os.getpid()}")
         shutil.rmtree(tmp, ignore_errors=True)
@@ -226,6 +244,10 @@ class CheckpointManager:
         accepted when a payload file is present — they predate the
         atomic-rename protocol, so their presence implies a completed
         legacy save."""
+        with _verify_seconds.time():
+            return self._verify(step)
+
+    def _verify(self, step: int) -> bool:
         path = os.path.join(self.directory, str(step))
         if not os.path.isdir(path):
             return False
@@ -271,6 +293,7 @@ class CheckpointManager:
             dest = f"{path}.corrupt.{n}"
         try:
             os.rename(path, dest)
+            _quarantined.inc()
             logger.warning(
                 "quarantined corrupt/incomplete checkpoint step %d -> %s",
                 step, os.path.basename(dest))
@@ -284,6 +307,10 @@ class CheckpointManager:
         are quarantined to ``<step>.corrupt`` and skipped. An explicit
         ``step`` that fails verification raises
         :class:`CheckpointCorruptError` after quarantining it."""
+        with span("ckpt.restore", step=step), _restore_seconds.time():
+            return self._restore(step, target)
+
+    def _restore(self, step: Optional[int] = None, target: Any = None) -> Any:
         if step is not None:
             if not os.path.isdir(os.path.join(self.directory, str(step))):
                 raise FileNotFoundError(

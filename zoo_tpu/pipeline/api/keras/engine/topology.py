@@ -615,7 +615,7 @@ class KerasNet:
             self._jit_train = self._own_jit_train = \
                 self._build_train_step()
         for epoch in range(nb_epoch):
-            t0 = time.time()
+            t0 = time.perf_counter()  # monotonic: NTP-step-proof Throughput
             loss_sum, n_steps = None, 0
             if use_epoch:
                 kk = n // local_bs
@@ -755,7 +755,7 @@ class KerasNet:
             self.train_summary.add_scalar("Loss", epoch_loss, self._step)
             self.train_summary.add_scalar(
                 "Throughput",
-                n_steps * batch_size / max(time.time() - t0, 1e-9),
+                n_steps * batch_size / max(time.perf_counter() - t0, 1e-9),
                 self._step)
             if val_arrays is not None:
                 vx, vy = val_arrays

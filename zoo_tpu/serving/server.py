@@ -26,33 +26,32 @@ import socketserver
 import struct
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 import numpy as np
 
+from zoo_tpu.obs.metrics import StatTimer, counter, gauge, histogram
+from zoo_tpu.obs.tracing import span
 from zoo_tpu.util.resilience import CircuitBreaker, fault_point
 
+# StageTimer and profiling's PhaseTimer were copy-pasted twins of the
+# reference's Timer.scala; both are now obs.StatTimer. The old name stays
+# importable (cluster_serving and user code import it from here).
+StageTimer = StatTimer
 
-class StageTimer:
-    """Per-stage avg/max/min running stats (reference: ``Timer.scala``)."""
-
-    def __init__(self):
-        self.n = 0
-        self.total = 0.0
-        self.max = 0.0
-        self.min = float("inf")
-
-    def record(self, dt: float):
-        self.n += 1
-        self.total += dt
-        self.max = max(self.max, dt)
-        self.min = min(self.min, dt)
-
-    def stats(self) -> Dict[str, float]:
-        return {"count": self.n,
-                "avg_ms": 1000 * self.total / max(self.n, 1),
-                "max_ms": 1000 * self.max,
-                "min_ms": 0.0 if self.n == 0 else 1000 * self.min}
+_queue_depth = gauge(
+    "zoo_serving_queue_depth", "Predict requests waiting in the batcher "
+    "queue of this process")
+_batch_occupancy = histogram(
+    "zoo_serving_batch_occupancy", "Requests per inference micro-batch",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128))
+_stage_seconds = histogram(
+    "zoo_serving_stage_seconds",
+    "Per-stage serving latency (batch assembly / inference / total "
+    "round-trip)", labels=("stage",))
+_requests = counter(
+    "zoo_serving_requests_total", "Predict requests by outcome "
+    "(ok / error / shed)", labels=("outcome",))
 
 
 def _send_msg(sock: socket.socket, obj):
@@ -140,8 +139,12 @@ class ServingServer:
             import ssl
             self._ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             self._ssl_ctx.load_cert_chain(certfile, keyfile)
-        self.timers = {"batch": StageTimer(), "inference": StageTimer(),
-                       "total": StageTimer()}
+        # local per-stage stats (the reference Timer.scala view, served
+        # by the "stats" op) double-published into the shared registry's
+        # stage-latency histogram for /metrics scrapes
+        self.timers = {
+            name: StageTimer(histogram=_stage_seconds.labels(stage=name))
+            for name in ("batch", "inference", "total")}
         self._queue: "queue.Queue[_Request]" = queue.Queue()
         self._stop = threading.Event()
 
@@ -179,6 +182,7 @@ class ServingServer:
                             # load shedding: fail fast at the door while
                             # the model is known-broken, instead of
                             # parking the caller behind a dead batcher
+                            _requests.labels(outcome="shed").inc()
                             _send_msg(self.request, {
                                 "uri": msg.get("uri"), "shed": True,
                                 "error": "server shedding load (circuit "
@@ -188,6 +192,7 @@ class ServingServer:
                         req = _Request(msg["uri"], msg["data"])
                         t0 = time.perf_counter()
                         outer._queue.put(req)
+                        _queue_depth.set(outer._queue.qsize())
                         done = req.event.wait(timeout=120)
                         outer.timers["total"].record(
                             time.perf_counter() - t0)
@@ -196,9 +201,11 @@ class ServingServer:
                                          "inference (first request may be "
                                          "paying XLA compile)")
                         if req.error is not None:
+                            _requests.labels(outcome="error").inc()
                             _send_msg(self.request,
                                       {"uri": req.uri, "error": req.error})
                         else:
+                            _requests.labels(outcome="ok").inc()
                             _send_msg(self.request,
                                       {"uri": req.uri, "result": req.result})
                     elif msg.get("op") == "stats":
@@ -248,18 +255,21 @@ class ServingServer:
                 except queue.Empty:
                     break
             self.timers["batch"].record(time.perf_counter() - t0)
+            _batch_occupancy.observe(len(batch))
+            _queue_depth.set(self._queue.qsize())
 
             t1 = time.perf_counter()
             try:
-                fault_point("serving.infer", batch=len(batch))
-                arrays = [np.asarray(r.data) for r in batch]
-                stacked = np.concatenate(arrays, axis=0)
-                preds = model.predict(stacked,
-                                      batch_size=self.batch_size)
-                offset = 0
-                for r, a in zip(batch, arrays):
-                    r.result = np.asarray(preds[offset:offset + len(a)])
-                    offset += len(a)
+                with span("serving.batch", size=len(batch)):
+                    fault_point("serving.infer", batch=len(batch))
+                    arrays = [np.asarray(r.data) for r in batch]
+                    stacked = np.concatenate(arrays, axis=0)
+                    preds = model.predict(stacked,
+                                          batch_size=self.batch_size)
+                    offset = 0
+                    for r, a in zip(batch, arrays):
+                        r.result = np.asarray(preds[offset:offset + len(a)])
+                        offset += len(a)
                 if self.breaker is not None:
                     self.breaker.record_success()
             except Exception as e:  # route the error to every caller

@@ -137,18 +137,33 @@ class EventWriter:
 # ------------------------------------------------------------- read-back
 
 def iter_event_records(path: str):
-    """Yield raw Event payloads from one event file (no CRC verify — the
-    reference's read-back skips it too for speed)."""
+    """Yield raw Event payloads from one event file, stopping (not
+    raising) at the first corrupt or truncated record.
+
+    Crash-safety parity with the checkpoint reader: a writer killed
+    mid-record, a torn tail, or flipped bytes must cost only the records
+    at and after the damage — everything before it still parses. The
+    length header's CRC is verified (a corrupt length would otherwise
+    send the reader seeking megabytes into garbage); a record whose
+    *payload* CRC fails is skipped while the scan continues, since the
+    framing itself is still intact."""
     with open(path, "rb") as f:
         while True:
             hdr = f.read(12)
             if len(hdr) < 12:
-                return
+                return  # clean EOF or truncated header
             (length,) = struct.unpack("<Q", hdr[:8])
+            (len_crc,) = struct.unpack("<I", hdr[8:])
+            if _masked_crc(hdr[:8]) != len_crc:
+                return  # corrupt length: cannot resync past it
             payload = f.read(length + 4)
             if len(payload) < length + 4:
-                return
-            yield payload[:length]
+                return  # truncated record (writer died mid-write)
+            data, (data_crc,) = payload[:-4], struct.unpack(
+                "<I", payload[-4:])
+            if _masked_crc(data) != data_crc:
+                continue  # bit-rotted payload: skip, framing still holds
+            yield data
 
 
 def read_scalars(log_dir: str, tag: Optional[str] = None
@@ -162,30 +177,41 @@ def read_scalars(log_dir: str, tag: Optional[str] = None
                    if f.startswith("events.out.tfevents"))
     for fname in files:
         for rec in iter_event_records(os.path.join(log_dir, fname)):
-            fields = proto.parse_fields(rec)
-            if _EV_SUMMARY not in fields:
-                continue
-            wall = float(fields.get(_EV_WALL_TIME, [0.0])[0])
-            step = proto.zigzag_to_int64(int(fields.get(_EV_STEP, [0])[0]))
-            for summary in fields[_EV_SUMMARY]:
-                for fld, wire, sval in proto.iter_fields(summary):
-                    # only Summary.value (field 1, length-delimited); a
-                    # varint/fixed field from another producer would be an
-                    # int here and must not reach parse_fields
-                    if fld != _SUM_VALUE or wire != 2 or not isinstance(sval, bytes):
-                        continue
-                    vf = proto.parse_fields(sval)
-                    if _VAL_TAG not in vf:
-                        continue
-                    t = vf[_VAL_TAG][0].decode("utf-8")
-                    if tag is not None and t != tag:
-                        continue
-                    val = _extract_value(vf)
-                    if val is not None:
-                        out.setdefault(t, []).append((step, wall, val))
+            try:
+                _scan_record(rec, tag, out)
+            except (ValueError, struct.error, IndexError, TypeError,
+                    UnicodeDecodeError):
+                continue  # CRC-valid but unparseable: skip, keep reading
     for v in out.values():
         v.sort(key=lambda r: r[0])
     return out
+
+
+def _scan_record(rec: bytes, tag: Optional[str],
+                 out: Dict[str, List[Tuple[int, float, float]]]):
+    """Collect the scalars of one Event record into ``out`` (raises on a
+    malformed record; ``read_scalars`` skips those)."""
+    fields = proto.parse_fields(rec)
+    if _EV_SUMMARY not in fields:
+        return
+    wall = float(fields.get(_EV_WALL_TIME, [0.0])[0])
+    step = proto.zigzag_to_int64(int(fields.get(_EV_STEP, [0])[0]))
+    for summary in fields[_EV_SUMMARY]:
+        for fld, wire, sval in proto.iter_fields(summary):
+            # only Summary.value (field 1, length-delimited); a
+            # varint/fixed field from another producer would be an
+            # int here and must not reach parse_fields
+            if fld != _SUM_VALUE or wire != 2 or not isinstance(sval, bytes):
+                continue
+            vf = proto.parse_fields(sval)
+            if _VAL_TAG not in vf:
+                continue
+            t = vf[_VAL_TAG][0].decode("utf-8")
+            if tag is not None and t != tag:
+                continue
+            val = _extract_value(vf)
+            if val is not None:
+                out.setdefault(t, []).append((step, wall, val))
 
 
 def _extract_value(vf) -> Optional[float]:

@@ -34,7 +34,28 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional, Tuple, Type
 
+from zoo_tpu.obs.metrics import counter, gauge
+
 logger = logging.getLogger(__name__)
+
+# Registry wiring (docs/observability.md): PR 1 built this layer, PR 2
+# makes it visible at runtime — a live cluster can now answer "how many
+# retries fired?" / "is a breaker open?" from GET /metrics.
+_retry_attempts = counter(
+    "zoo_retry_attempts_total", "RetryPolicy attempts executed "
+    "(including each call's first try)")
+_retry_giveups = counter(
+    "zoo_retry_giveups_total", "Retry budgets exhausted (RetryError raised)")
+_breaker_transitions = counter(
+    "zoo_breaker_transitions_total",
+    "Circuit-breaker state transitions, labelled by the state entered",
+    labels=("state",))
+_breakers_open = gauge(
+    "zoo_breaker_open", "Circuit breakers currently open (or probing "
+    "half-open) in this process")
+_fault_trips = counter(
+    "zoo_fault_injections_total", "Armed fault-site firings",
+    labels=("site",))
 
 __all__ = [
     "RetryPolicy", "RetryError",
@@ -101,6 +122,7 @@ class RetryPolicy:
         start = time.monotonic()
         last: Optional[BaseException] = None
         for attempt in range(1, self.max_attempts + 1):
+            _retry_attempts.inc()
             try:
                 return fn(*args, **kwargs)
             except self.retry_on as e:
@@ -110,12 +132,14 @@ class RetryPolicy:
                 delay = self.backoff(attempt)
                 if self.deadline is not None and \
                         time.monotonic() - start + delay > self.deadline:
+                    _retry_giveups.inc()
                     raise RetryError(
                         f"deadline {self.deadline}s exhausted after "
                         f"{attempt} attempt(s): {e!r}", attempt) from e
                 logger.debug("retry %d/%d in %.3fs after %r", attempt,
                              self.max_attempts, delay, e)
                 self._sleep(delay)
+        _retry_giveups.inc()
         raise RetryError(
             f"gave up after {self.max_attempts} attempt(s): {last!r}",
             self.max_attempts) from last
@@ -174,6 +198,7 @@ class CircuitBreaker:
                 self._clock() - self._opened_at >= self.recovery_timeout:
             self._state = self.HALF_OPEN
             self._probes = 0
+            _breaker_transitions.labels(state=self.HALF_OPEN).inc()
 
     def allow(self) -> bool:
         """May a call proceed right now? (HALF_OPEN admits probes.)"""
@@ -192,6 +217,8 @@ class CircuitBreaker:
             self._failures = 0
             if self._state != self.CLOSED:
                 logger.info("circuit breaker closing after probe success")
+                _breaker_transitions.labels(state=self.CLOSED).inc()
+                _breakers_open.dec()
             self._state = self.CLOSED
 
     def record_failure(self):
@@ -204,6 +231,11 @@ class CircuitBreaker:
                         "circuit breaker OPEN after %d consecutive "
                         "failure(s); shedding load for %.1fs",
                         self._failures, self.recovery_timeout)
+                    _breaker_transitions.labels(state=self.OPEN).inc()
+                    if self._state == self.CLOSED:
+                        # CLOSED->OPEN only: a reopening HALF_OPEN
+                        # breaker is already counted in the gauge
+                        _breakers_open.inc()
                 self._state = self.OPEN
                 self._opened_at = self._clock()
 
@@ -297,6 +329,7 @@ class FaultInjector:
                 return
             f.fired += 1
             exc, action = f.exc, f.action
+        _fault_trips.labels(site=site).inc()
         if action is not None:
             action(site=site, **ctx)
         if exc is not None:
@@ -352,23 +385,42 @@ HEARTBEAT_INTERVAL_ENV = "ZOO_HEARTBEAT_INTERVAL"
 
 
 def touch_heartbeat(path: Optional[str] = None):
-    """Stamp the heartbeat file (create or update mtime). ``path`` defaults
-    to ``$ZOO_HEARTBEAT_FILE``; silently a no-op when neither is set, so
-    worker code can call it unconditionally."""
+    """Stamp the heartbeat file (mtime + a ``time.monotonic()`` payload).
+    ``path`` defaults to ``$ZOO_HEARTBEAT_FILE``; silently a no-op when
+    neither is set, so worker code can call it unconditionally.
+
+    The payload is the monotonic clock, not wall time: CLOCK_MONOTONIC
+    is system-wide on Linux, so the supervising process on the same host
+    computes ages immune to NTP steps — a 30 s clock correction used to
+    read as a 30 s-stale heartbeat and could kill a healthy worker."""
     path = path or os.environ.get(HEARTBEAT_FILE_ENV)
     if not path:
         return
     try:
-        with open(path, "a"):
-            pass
-        os.utime(path, None)
+        # write-then-replace: a reader must never see a half-written
+        # stamp (a truncated float would parse as an ancient beat and
+        # read as a hang)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            f.write(repr(time.monotonic()))
+        os.replace(tmp, path)
     except OSError as e:  # a missing dir must not kill the worker
         logger.debug("heartbeat touch failed: %s", e)
 
 
 def heartbeat_age(path: str) -> Optional[float]:
     """Seconds since the heartbeat file was last stamped; None when the
-    file does not exist yet (worker still booting)."""
+    file does not exist yet (worker still booting). Prefers the
+    monotonic payload :func:`touch_heartbeat` writes; an empty or
+    foreign file (plain ``touch``) falls back to wall-clock mtime."""
+    try:
+        with open(path) as f:
+            stamp = float(f.read().strip())
+        now = time.monotonic()
+        if 0.0 <= stamp <= now:  # a stamp from before a reboot is junk
+            return now - stamp
+    except (OSError, ValueError):
+        pass
     try:
         return max(0.0, time.time() - os.stat(path).st_mtime)
     except OSError:

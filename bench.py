@@ -462,8 +462,87 @@ def bench_resnet50_int8_infer(batch_size=128, steps=8, reps=5):
         return _stats(rates)
 
     fstats = timed_forward(m)
-    qstats = timed_forward(quantize_model(m))
+    # explicit mode="force" (which beats any ambient ZOO_INT8_MODE):
+    # this row measures the RAW int8 kernel; the serving path's auto
+    # mode falls back to bf16 whenever this ratio is < 1
+    qstats = timed_forward(quantize_model(m, mode="force"))
     return fstats, qstats
+
+
+def bench_shard_exchange(extra, n_shards=64, rows=128, cols=64, reps=3):
+    """Shard-exchange microbench on loopback: the per-connection serial
+    fetch (the pre-v2 client behavior — one fresh TCP dial per shard,
+    strictly sequential) against the v2 pipelined+pooled multi-get
+    chained into the async device-ingest pipeline. Reports bytes/s for
+    both, TCP connections opened by each, and the fetch/put overlap
+    ratio (stage-busy seconds / wall; >1 = real overlap). The transport
+    gap this pins: BENCH_r05 lost ~62% of NCF throughput end-to-end to
+    exactly this path."""
+    import jax
+
+    from zoo_tpu.orca.data import plane
+    from zoo_tpu.orca.data.ingest import PipelineStats, staged_pipeline
+    from zoo_tpu.orca.data.plane import ShardExchange, iter_fetch
+
+    rs = np.random.RandomState(0)
+    shards = {i: {"x": rs.randn(rows, cols).astype(np.float32)}
+              for i in range(n_shards)}
+    total = sum(sum(v.nbytes for v in s.values())
+                for s in shards.values())
+    ex = ShardExchange(shards, bind="127.0.0.1")
+    addr = ("127.0.0.1", ex.port)
+    try:
+        # warm the device transfer path so the pipelined window is not
+        # charged jax's first-touch setup
+        jax.block_until_ready(jax.device_put(shards[0]))
+        serial, conns_serial = [], 0
+        for _ in range(reps):
+            c0 = ex.connections_accepted
+            t0 = time.perf_counter()
+            for gid in range(n_shards):
+                ShardExchange.fetch(addr, gid, pool=False)
+            serial.append(total / (time.perf_counter() - t0))
+            conns_serial = ex.connections_accepted - c0
+
+        piped, conns_piped = [], None
+        for _ in range(reps):
+            c0 = ex.connections_accepted
+            t0 = time.perf_counter()
+            got = len(list(iter_fetch([(addr, list(range(n_shards)))])))
+            piped.append(total / (time.perf_counter() - t0))
+            if got != n_shards:
+                raise RuntimeError(f"pipelined fetch returned {got} of "
+                                   f"{n_shards} shards")
+            if conns_piped is None:  # cold-pool rep = the honest count
+                conns_piped = ex.connections_accepted - c0
+
+        # fetch→device_put overlap, measured on the staged ingest
+        # pipeline (the rebalance stage_fn path): stage-busy seconds /
+        # wall. Reported separately from the fetch bytes/s — at
+        # loopback shard sizes the per-item device_put cost would
+        # otherwise swamp the wire comparison.
+        stats = PipelineStats()
+        with staged_pipeline(
+                iter_fetch([(addr, list(range(n_shards)))]),
+                [("device_put",
+                  lambda kv: (kv[0], jax.device_put(kv[1])))],
+                depth=4, stats=stats) as pipe:
+            for _gid, placed in pipe:
+                jax.block_until_ready(placed)
+        overlap = stats.overlap_ratio()
+    finally:
+        ex.close()
+        plane._pool.clear()
+    s50, s_sp = _stats(serial)
+    p50, p_sp = _stats(piped)
+    extra["shard_exchange_serial_mbs"] = round(s50 / 1e6, 1)
+    extra["shard_exchange_serial_spread"] = round(s_sp, 3)
+    extra["shard_exchange_pipelined_mbs"] = round(p50 / 1e6, 1)
+    extra["shard_exchange_pipelined_spread"] = round(p_sp, 3)
+    extra["shard_exchange_speedup"] = round(p50 / s50, 2)
+    extra["shard_exchange_conns_serial"] = conns_serial
+    extra["shard_exchange_conns_pipelined"] = max(conns_piped or 0, 1)
+    extra["shard_ingest_overlap_ratio"] = round(overlap, 3)
 
 
 def bench_serving(extra, n_requests=200, clients=8, feat=64):
@@ -573,12 +652,27 @@ def main():
         except Exception as e:  # noqa: BLE001
             extra["serving_error"] = repr(e)
         try:
+            bench_shard_exchange(extra)
+        except Exception as e:  # noqa: BLE001
+            extra["shard_exchange_error"] = repr(e)
+        try:
             (f_p50, f_sp), (q_p50, q_sp) = bench_resnet50_int8_infer()
             extra["resnet50_infer_samples_per_sec"] = round(f_p50, 1)
             extra["resnet50_infer_spread"] = round(f_sp, 3)
             extra["resnet50_int8_infer_samples_per_sec"] = round(q_p50, 1)
             extra["resnet50_int8_infer_spread"] = round(q_sp, 3)
             extra["resnet50_int8_speedup"] = round(q_p50 / f_p50, 3)
+            # the path quantize_model(mode="auto") — the serving
+            # loaders' default — would pick at this measured ratio
+            # (same threshold constant as auto's own decision; auto
+            # microbenches at a smaller batch, so a ratio straddling
+            # the threshold can differ from a live auto call)
+            from zoo_tpu.pipeline.inference.inference_model import (
+                INT8_MIN_SPEEDUP,
+            )
+            extra["resnet50_int8_path"] = (
+                "int8" if q_p50 / f_p50 >= INT8_MIN_SPEEDUP
+                else "bf16-fallback")
         except Exception as e:  # noqa: BLE001
             extra["resnet50_int8_error"] = repr(e)
         bert_mfu = float("nan")

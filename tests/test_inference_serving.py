@@ -332,3 +332,110 @@ open(sys.argv[2], "wb").write(k.private_bytes(
             q2.predict(np.zeros((1, 3), np.float32))
     finally:
         server.stop()
+
+
+class _ShapeRecordingModel:
+    """Fake InferenceModel: records every batch row-count it was asked
+    to run and returns row-identified outputs (catches padding leaks)."""
+
+    def __init__(self, delay: float = 0.0):
+        self.calls = []
+        self.delay = delay
+        self._lock = threading.Lock()
+
+    def predict(self, x, batch_size=None):
+        x = np.asarray(x)
+        with self._lock:
+            self.calls.append(x.shape[0])
+        if self.delay:
+            import time
+            time.sleep(self.delay)
+        return x * 2.0
+
+
+def test_serving_pads_to_one_executable_shape():
+    """The micro-batcher pads every inference batch UP to a whole
+    multiple of batch_size: one compiled shape serves every occupancy
+    (the bs8 p99 pathology was a fresh XLA compile per distinct
+    occupancy), and padded rows never leak into responses."""
+    from zoo_tpu.serving.server import ServingServer
+    from zoo_tpu.serving.tcp_client import TCPInputQueue
+
+    model = _ShapeRecordingModel()
+    server = ServingServer(model, port=0, batch_size=8,
+                           max_wait_ms=1.0).start()
+    try:
+        results = {}
+        lock = threading.Lock()
+
+        def client(k):
+            q = TCPInputQueue(server.host, server.port)
+            for i in range(10):
+                x = np.full((1, 4), 10.0 * k + i, np.float32)
+                out = np.asarray(q.predict(x))
+                with lock:
+                    results[(k, i)] = (x, out)
+            q.close()
+
+        threads = [threading.Thread(target=client, args=(k,))
+                   for k in range(5)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # every inference ran at the ONE padded shape
+        assert model.calls and all(c == 8 for c in model.calls), \
+            sorted(set(model.calls))
+        # responses are per-request exact — no padded-row leakage
+        for (k, i), (x, out) in results.items():
+            assert out.shape == x.shape, (k, i)
+            np.testing.assert_allclose(out, x * 2.0)
+    finally:
+        server.stop()
+
+
+def test_serving_tail_latency_sane_under_concurrency():
+    """Regression for the bs8 pathology (serving_bs8_p99_ms = 8643 vs
+    110 at bs32): with the fixed-shape batcher, p99 under concurrent
+    clients stays within a sane multiple of p50 — no multi-second
+    stragglers."""
+    import time
+
+    from zoo_tpu.serving.server import ServingServer
+    from zoo_tpu.serving.tcp_client import TCPInputQueue
+
+    model = _ShapeRecordingModel(delay=0.002)
+    server = ServingServer(model, port=0, batch_size=8,
+                           max_wait_ms=1.0, num_replicas=2).start()
+    try:
+        # warm the whole path before timing (connection setup etc.)
+        TCPInputQueue(server.host, server.port).predict(
+            np.zeros((1, 4), np.float32))
+        lats, lock = [], threading.Lock()
+
+        def client(k):
+            q = TCPInputQueue(server.host, server.port)
+            mine = []
+            for _ in range(25):
+                t0 = time.perf_counter()
+                q.predict(np.zeros((1, 4), np.float32))
+                mine.append(time.perf_counter() - t0)
+            with lock:
+                lats.extend(mine)
+            q.close()
+
+        threads = [threading.Thread(target=client, args=(k,))
+                   for k in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        lats_ms = np.sort(np.asarray(lats)) * 1e3
+        p50 = float(np.percentile(lats_ms, 50))
+        p99 = float(np.percentile(lats_ms, 99))
+        # generous CI bounds; the pre-fix pathology was ~80x p50 and
+        # multi-SECOND absolute
+        assert p99 < 1000.0, f"p99 {p99:.0f}ms is a multi-second tail"
+        assert p99 <= max(30.0 * p50, 250.0), (p50, p99)
+    finally:
+        server.stop()

@@ -1,5 +1,9 @@
 from zoo_tpu.orca.data.shard import XShards, LocalXShards
-from zoo_tpu.orca.data.plane import rebalance_shards
+from zoo_tpu.orca.data.plane import fetch_many, rebalance_shards
+from zoo_tpu.orca.data.ingest import (
+    async_device_ingest,
+    staged_pipeline,
+)
 
 
 class SharedValue:
@@ -11,4 +15,5 @@ class SharedValue:
         self.value = value
 
 
-__all__ = ["XShards", "LocalXShards", "rebalance_shards", "SharedValue"]
+__all__ = ["XShards", "LocalXShards", "rebalance_shards", "fetch_many",
+           "staged_pipeline", "async_device_ingest", "SharedValue"]

@@ -1,0 +1,205 @@
+"""Staged async ingest: overlap fetch / decode / device placement.
+
+The transport gap measured in BENCH_r05 — NCF at 5.07M samples/s
+device-side but 1.91M end-to-end — is serial plumbing, not bandwidth:
+each leg of the ingest path (network fetch, host decode/slice,
+``jax.device_put``) waited for the previous one. This module chains the
+legs into a pipeline of :class:`~zoo_tpu.orca.data.cache.
+DoubleBufferedIterator` stages, one daemon thread per stage, so stage
+``i`` of item ``k`` runs while stage ``i-1`` prepares item ``k+1`` —
+device transfer of shard *k* overlaps the network fetch of shard *k+1*,
+the same overlap the reference gets from Spark's prefetching iterators
+feeding BigDL's per-executor miniBatch queues.
+
+Every stage records its busy time into the
+``zoo_shard_pipeline_stage_seconds{stage=...}`` histogram, and a
+:class:`PipelineStats` passed to :func:`staged_pipeline` accumulates
+per-stage busy seconds so callers (``bench.py``,
+``scripts/check_data_plane.py``) can report the **overlap ratio** —
+total stage-busy seconds divided by pipeline wall time; 1.0 means the
+stages ran back-to-back serially, above 1.0 means real overlap.
+
+Used by:
+
+* :func:`zoo_tpu.orca.data.plane.rebalance_shards` (``stage_fn=`` —
+  device placement streams behind the shard exchange);
+* the estimator feed (``pipeline/api/keras/engine/topology.py``): the
+  host-fed superbatch path splits its old slice+put staging thread into
+  a slice stage and a device-put stage, so ``fit`` steps on batch ``k``
+  while batch ``k+1`` transfers and batch ``k+2`` is sliced.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+from zoo_tpu.obs.metrics import histogram
+from zoo_tpu.orca.data.cache import DoubleBufferedIterator
+
+__all__ = ["PipelineStats", "StagedPipeline", "staged_pipeline",
+           "async_device_ingest"]
+
+_stage_seconds = histogram(
+    "zoo_shard_pipeline_stage_seconds",
+    "Busy time per ingest pipeline stage (fetch / decode / slice / "
+    "device put)", labels=("stage",))
+
+
+class PipelineStats:
+    """Per-stage busy-seconds accumulator + wall clock for one pipeline.
+
+    ``overlap_ratio()`` = sum of stage busy time / wall time since the
+    pipeline started. A perfectly serial pipeline scores ~1.0; each
+    fully-hidden stage adds ~its share above that. Thread-safe — stages
+    record from their own daemon threads."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.busy: Dict[str, float] = {}
+        self.items: Dict[str, int] = {}
+        self._t0 = time.perf_counter()
+        self._t_end: Optional[float] = None
+
+    def record(self, stage: str, dt: float):
+        with self._lock:
+            self.busy[stage] = self.busy.get(stage, 0.0) + dt
+            self.items[stage] = self.items.get(stage, 0) + 1
+
+    def finish(self):
+        """Pin the wall clock (called when the pipeline is exhausted or
+        closed; idempotent — first call wins)."""
+        if self._t_end is None:
+            self._t_end = time.perf_counter()
+
+    def wall(self) -> float:
+        return (self._t_end or time.perf_counter()) - self._t0
+
+    def busy_total(self) -> float:
+        with self._lock:
+            return sum(self.busy.values())
+
+    def overlap_ratio(self) -> float:
+        wall = self.wall()
+        if wall <= 0:
+            return float("nan")
+        return self.busy_total() / wall
+
+
+def _timed_source(source: Iterable[Any],
+                  stats: Optional[PipelineStats]):
+    """Record the time spent blocked on the raw source's ``next()`` as
+    the ``source`` stage (the network-fetch leg when the source is a
+    streaming fetch generator) — without it the overlap ratio would
+    miss the very leg the pipeline exists to hide."""
+    it = iter(source)
+    child = _stage_seconds.labels(stage="source")
+    while True:
+        t0 = time.perf_counter()
+        try:
+            item = next(it)
+        except StopIteration:
+            return
+        dt = time.perf_counter() - t0
+        child.observe(dt)
+        if stats is not None:
+            stats.record("source", dt)
+        yield item
+
+
+class StagedPipeline:
+    """A chain of double-buffered stages over ``source``.
+
+    Iterating yields fully-staged items; ``close()`` (or exiting the
+    context manager) stops every stage thread, outermost first, so an
+    early-exiting consumer cannot strand a producer pinning staged
+    device buffers."""
+
+    def __init__(self, source: Iterable[Any],
+                 stages: List[Tuple[str, Optional[Callable[[Any], Any]]]],
+                 depth: int = 2, stats: Optional[PipelineStats] = None):
+        self.stats = stats
+        self._iters: List[DoubleBufferedIterator] = []
+        it: Iterable[Any] = _timed_source(source, self.stats)
+        for name, fn in stages:
+            it = DoubleBufferedIterator(it,
+                                        stage_fn=self._timed(name, fn),
+                                        depth=depth)
+            self._iters.append(it)
+        self._tail = it
+
+    def _timed(self, name: str, fn: Optional[Callable[[Any], Any]]):
+        stats = self.stats
+        child = _stage_seconds.labels(stage=name)
+
+        def run(item):
+            t0 = time.perf_counter()
+            out = fn(item) if fn is not None else item
+            dt = time.perf_counter() - t0
+            child.observe(dt)
+            if stats is not None:
+                stats.record(name, dt)
+            return out
+
+        return run
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self._tail)
+        except StopIteration:
+            if self.stats is not None:
+                self.stats.finish()
+            raise
+
+    def close(self):
+        # outermost first: stop consumers before their producers so the
+        # inner close never races a stage thread mid-put
+        for it in reversed(self._iters):
+            it.close()
+        if self.stats is not None:
+            self.stats.finish()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def staged_pipeline(source: Iterable[Any],
+                    stages: List[Tuple[str,
+                                       Optional[Callable[[Any], Any]]]],
+                    depth: int = 2,
+                    stats: Optional[PipelineStats] = None
+                    ) -> StagedPipeline:
+    """Chain ``stages`` = [(name, fn-or-None), ...] over ``source``.
+
+    Each stage gets its own staging thread and a bounded queue of
+    ``depth`` in-flight items. A stage with ``fn=None`` is a pure
+    prefetch stage — useful to give a slow *source* (a network fetch
+    generator) its own thread so downstream stages overlap it."""
+    return StagedPipeline(source, stages, depth=depth, stats=stats)
+
+
+def async_device_ingest(shards: Iterable[Any], put_fn=None,
+                        depth: int = 2,
+                        stats: Optional[PipelineStats] = None
+                        ) -> StagedPipeline:
+    """Iterate ``shards`` with device placement running one item ahead.
+
+    ``put_fn`` defaults to ``jax.device_put`` (applied to the whole
+    shard pytree). The source iterable is drained on a prefetch thread
+    and placement happens on a second stage thread, so the consumer's
+    compute, the device transfer, and the source's own work (e.g. a
+    streaming shard fetch) all overlap."""
+    if put_fn is None:
+        import jax
+        put_fn = jax.device_put
+    return staged_pipeline(iter(shards),
+                           [("fetch", None), ("device_put", put_fn)],
+                           depth=depth, stats=stats)

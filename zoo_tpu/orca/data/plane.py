@@ -7,33 +7,51 @@ assigns actors to co-located partitions so only the imbalance actually
 moves). The TPU-native shape of the same capability:
 
 * every JAX process serves its local shards over an ephemeral TCP port
-  (:class:`ShardExchange`) using a **non-executable** codec (length-framed
-  ``.npz`` — ``numpy.load(allow_pickle=False)``, never pickle);
+  (:class:`ShardExchange`) using a **non-executable** wire codec
+  (protocol v2: per-array binary headers + raw tensor buffers decoded
+  with ``np.frombuffer`` — never pickle, nothing on the wire can
+  execute);
+* shards are served **lazily from the original arrays** — nothing is
+  pre-encoded, so serving N shards costs no extra resident memory and
+  the payload bytes go from the array's own buffer to the socket via
+  ``memoryview`` (no intermediate serialize copy);
+* clients keep **persistent pooled connections** per peer and batch
+  many global ids into one **multi-get** request whose responses stream
+  back on the same connection, so per-fetch latency amortizes across
+  the exchange (``ZOO_SHARD_POOL_SIZE`` idle connections per peer);
 * peer discovery rides the JAX distributed runtime itself —
-  ``multihost_utils.process_allgather`` of each host's (ip, port, count)
-  triple, so there is no extra coordinator and no driver-side collect;
+  the coordination-service KV store carries each host's (ip, port,
+  count) triple, so there is no extra coordinator and no driver-side
+  collect;
 * :func:`assign_shards` computes the same deterministic, locality-first
   plan on every host: each host keeps as many of its own shards as the
   balanced target allows, and only surplus shards are fetched by deficit
   hosts;
-* :func:`rebalance_shards` runs the whole exchange and returns this
-  process's balanced, disjoint shard set — ready for the estimator's
-  per-process feed into ``host_local_to_global``
-  (``parallel/mesh.py:152``).
+* :func:`rebalance_shards` runs the whole exchange — fetches run
+  concurrently across peers (``ZOO_SHARD_FETCH_CONCURRENCY`` threads,
+  default 4) and can stream through a staged ingest pipeline
+  (``stage_fn=jax.device_put``: device transfer of shard *k* overlaps
+  the network fetch of shard *k+1* — see
+  :mod:`zoo_tpu.orca.data.ingest`) — and returns this process's
+  balanced, disjoint shard set, ready for the estimator's per-process
+  feed into ``host_local_to_global`` (``parallel/mesh.py:152``).
 
 Shards must be dicts of numpy arrays (the estimator feed format); use
 ``XShards.partition({"x": ..., "y": ...})``.
+
+See ``docs/data_plane.md`` for the wire format and tuning knobs.
 """
 
 from __future__ import annotations
 
-import io
 import logging
+import os
 import socket
 import struct
 import threading
 import time
-from typing import Dict, List, Optional, Sequence, Tuple
+from concurrent.futures import ThreadPoolExecutor, as_completed
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -47,50 +65,210 @@ from zoo_tpu.obs.metrics import counter, histogram
 from zoo_tpu.obs.tracing import span
 from zoo_tpu.util.resilience import RetryPolicy, fault_point
 
-__all__ = ["ShardExchange", "assign_shards", "rebalance_shards"]
+__all__ = ["ShardExchange", "assign_shards", "rebalance_shards",
+           "fetch_many", "ProtocolError"]
 
 logger = logging.getLogger(__name__)
 
 _fetch_seconds = histogram(
     "zoo_shard_fetch_seconds",
-    "Cross-host shard fetch latency (one successful attempt)")
+    "Cross-host shard fetch latency (one successful attempt; multi-get "
+    "batches count once)")
 _fetch_bytes = counter(
     "zoo_shard_fetch_bytes_total", "Shard payload bytes fetched from peers")
+_fetch_requests = counter(
+    "zoo_shard_fetch_requests_total",
+    "Fetch requests by wire mode (single get vs pipelined multi-get)",
+    labels=("mode",))
+_pool_conns = counter(
+    "zoo_shard_pool_connections_total",
+    "Peer connections by pool event (opened = fresh TCP dial, reused = "
+    "checked out of the per-peer pool)", labels=("event",))
 _barrier_wait = histogram(
     "zoo_rebalance_barrier_wait_seconds",
     "Wall time spent in each rebalance KV-store barrier phase",
     labels=("phase",))
 
-_MAGIC = b"ZSX1"
+_MAGIC_V1 = b"ZSX1"
+_MAGIC = b"ZSX2"
+def _multiget_chunk() -> int:
+    """Gids per multi-get: bounds the cost of a retried attempt (a
+    mid-stream peer death refetches one chunk, not the whole plan) and
+    keeps responses flowing while later chunks are queued. Read per
+    call like the sibling knobs, so runtime env changes take effect."""
+    return max(1, min(int(os.environ.get("ZOO_SHARD_MULTIGET", "32")),
+                      0xFFFF))
 
 
-def _encode_shard(shard: Dict[str, np.ndarray]) -> bytes:
+class ProtocolError(RuntimeError):
+    """Peer spoke a different exchange protocol (e.g. a v1 ``ZSX1``
+    process in a mixed-version cluster). Deliberately loud AND
+    deliberately not a ``ConnectionError``: a version mismatch is
+    deterministic, so the retry policy must not burn its budget on
+    it — upgrade peers in lockstep rather than silently corrupting
+    shards."""
+
+
+# --------------------------------------------------------------------- codec
+# Wire codec v2: raw tensor framing. Per shard: i32 array count; per
+# array: u16-length name, u16-length dtype descriptor, u8 rank, rank x
+# u64 dims, u64 payload bytes, then the raw (C-order) buffer. Decoding
+# is np.frombuffer over the received buffer — zero-copy, non-executable.
+
+def _dtype_descr(dt: np.dtype) -> bytes:
+    # '<f4'-style descriptors round-trip exactly (endianness included);
+    # extension dtypes (bfloat16 via ml_dtypes) don't — their .str is a
+    # raw-void alias — so ship the registered name instead. Anything
+    # that round-trips NEITHER way (structured/record dtypes: .str is a
+    # bare void alias and .name like 'void64' does not parse) must be
+    # rejected HERE, at encode time, not as a confusing decode error on
+    # the peer after bytes are already on the wire.
+    s = dt.str
+    try:
+        if np.dtype(s) == dt:
+            return s.encode("ascii")
+    except TypeError:
+        pass
+    try:
+        if np.dtype(dt.name) == dt:
+            return dt.name.encode("ascii")
+    except TypeError:
+        pass
+    raise TypeError(
+        f"dtype {dt} has no round-trippable wire descriptor — the "
+        "exchange codec ships plain numeric/bool/extension dtypes only "
+        "(split structured arrays into one plain array per field)")
+
+
+def _dtype_from_descr(descr: str) -> np.dtype:
+    try:
+        dt = np.dtype(descr)
+    except TypeError:
+        # extension dtypes register by name on import (jax always ships
+        # ml_dtypes; bench/test processes may not have touched it yet)
+        import ml_dtypes  # noqa: F401
+        dt = np.dtype(descr)
+    if dt.hasobject:
+        raise ProtocolError(
+            f"refusing object dtype {descr!r} from the wire (pickle "
+            "vector; the exchange codec is non-executable)")
+    return dt
+
+
+def _payload_view(arr: np.ndarray) -> memoryview:
+    """The array's raw bytes WITHOUT a serialize copy (contiguous
+    arrays; a non-contiguous shard pays one compaction copy)."""
+    a = np.ascontiguousarray(arr)
+    if a.nbytes == 0:
+        return memoryview(b"")
+    try:
+        return memoryview(a).cast("B")
+    except (ValueError, TypeError):
+        # extension dtypes (bfloat16) refuse the buffer protocol; a
+        # uint8 view of the same memory does not copy
+        return memoryview(a.reshape(-1).view(np.uint8))
+
+
+def _check_shard(shard) -> None:
     if not isinstance(shard, dict) or not all(
             isinstance(v, np.ndarray) for v in shard.values()):
         raise TypeError(
             "the shard exchange ships dict-of-ndarray shards only; got "
             f"{type(shard).__name__} (convert DataFrame shards with "
             "to_dict('series') -> numpy first)")
-    buf = io.BytesIO()
-    np.savez(buf, **shard)
-    blob = buf.getvalue()
-    if len(blob) > 0xFFFFFFFF:
-        raise ValueError(
-            f"shard encodes to {len(blob)} bytes, over the exchange's "
-            "u32 frame limit (4 GiB) — split it before shipping")
-    return blob
+    for k, v in shard.items():
+        if v.dtype.hasobject:
+            raise TypeError(
+                f"array {k!r} has object dtype — the exchange codec is "
+                "non-executable and refuses pickle-bearing arrays")
+        _dtype_descr(v.dtype)  # unshippable dtypes fail fast, pre-wire
 
 
-def _decode_shard(blob: bytes) -> Dict[str, np.ndarray]:
-    with np.load(io.BytesIO(blob), allow_pickle=False) as z:
-        return {k: z[k] for k in z.files}
+def _array_header(name: str, arr: np.ndarray) -> bytes:
+    nb = name.encode("utf-8")
+    db = _dtype_descr(arr.dtype)
+    return (struct.pack("!H", len(nb)) + nb +
+            struct.pack("!H", len(db)) + db +
+            struct.pack("!B", arr.ndim) +
+            struct.pack(f"!{arr.ndim}Q", *arr.shape) +
+            struct.pack("!Q", arr.nbytes))
+
+
+def _encode_shard(shard: Dict[str, np.ndarray]) -> bytes:
+    """Whole-shard v2 blob (header+payload frames). The server never
+    calls this — it streams headers and payload views separately — but
+    the framing is identical, so tests and file staging share it."""
+    _check_shard(shard)
+    parts: List[bytes] = [struct.pack("!i", len(shard))]
+    for name, arr in shard.items():
+        parts.append(_array_header(name, arr))
+        parts.append(bytes(_payload_view(arr)))
+    return b"".join(parts)
+
+
+def _decode_shard(blob) -> Dict[str, np.ndarray]:
+    view = memoryview(blob)
+    (count,) = struct.unpack("!i", view[:4])
+    off = 4
+    out: Dict[str, np.ndarray] = {}
+    for _ in range(count):
+        name, arr, off = _decode_array(view, off)
+        out[name] = arr
+    return out
+
+
+def _parse_array_header(read) -> Tuple[str, np.dtype, Tuple[int, ...],
+                                       int, int]:
+    """Parse one array header via ``read(n) -> buffer`` (a socket's
+    recv-exact or a memoryview cursor — ONE parser for both, so the
+    wire layout cannot drift between them). Returns (name, dtype,
+    shape, payload bytes, header bytes consumed).
+
+    The payload length is validated against prod(shape) * itemsize
+    BEFORE anyone allocates for it: a corrupt or desynchronized peer
+    must surface as a loud :class:`ProtocolError`, not a ~2^60-byte
+    ``bytearray`` feeding the OOM killer."""
+    (nlen,) = struct.unpack("!H", read(2))
+    name = bytes(read(nlen)).decode("utf-8")
+    (dlen,) = struct.unpack("!H", read(2))
+    dt = _dtype_from_descr(bytes(read(dlen)).decode("ascii"))
+    (ndim,) = struct.unpack("!B", read(1))
+    shape = struct.unpack(f"!{ndim}Q", read(8 * ndim))
+    (nbytes,) = struct.unpack("!Q", read(8))
+    expected = dt.itemsize
+    for d in shape:
+        expected *= int(d)  # python ints: dims cannot overflow this
+    if nbytes != expected:
+        raise ProtocolError(
+            f"array {name!r}: payload length {nbytes} does not match "
+            f"shape {tuple(int(d) for d in shape)} x dtype {dt} "
+            f"({expected} bytes) — corrupt or desynchronized stream")
+    return name, dt, shape, nbytes, 13 + nlen + dlen + 8 * ndim
+
+
+def _decode_array(view: memoryview, off: int
+                  ) -> Tuple[str, np.ndarray, int]:
+    pos = [off]
+
+    def read(n: int):
+        out = view[pos[0]:pos[0] + n]
+        if len(out) != n:
+            raise ProtocolError("truncated shard blob")
+        pos[0] += n
+        return out
+
+    name, dt, shape, nbytes, _ = _parse_array_header(read)
+    # frombuffer shares the received buffer: the decoded array is the
+    # recv buffer, no copy (writable because the buffer is a bytearray)
+    arr = np.frombuffer(read(nbytes), dtype=dt).reshape(shape)
+    return name, arr, pos[0]
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytearray:
     # preallocate + recv_into: shards are tens of MB, so quadratic
     # bytes-concat accumulation would dominate the exchange; return the
     # bytearray itself — bytes(out) would re-copy the whole blob, and
-    # every caller (magic compare, struct.unpack, BytesIO) takes it
+    # every caller (magic compare, struct.unpack, frombuffer) takes it
     out = bytearray(n)
     view = memoryview(out)
     got = 0
@@ -102,25 +280,101 @@ def _recv_exact(sock: socket.socket, n: int) -> bytearray:
     return out
 
 
+# ---------------------------------------------------------------- conn pool
+
+class _ConnPool:
+    """Per-peer idle-connection pool. ``acquire`` hands back a pooled
+    socket (metric event ``reused``) or dials a fresh one (``opened``);
+    ``release`` returns it for the next fetch. A connection that errors
+    mid-RPC must be closed and the peer's pool invalidated — the stream
+    is poisoned and every idle sibling probably points at the same dead
+    peer."""
+
+    def __init__(self, max_idle_per_peer: Optional[int] = None):
+        self._idle: Dict[Tuple[str, int], List[socket.socket]] = {}
+        self._lock = threading.Lock()
+        self._max_idle = max_idle_per_peer
+
+    @property
+    def max_idle(self) -> int:
+        if self._max_idle is not None:
+            return self._max_idle
+        return max(1, int(os.environ.get("ZOO_SHARD_POOL_SIZE", "4")))
+
+    def acquire(self, addr: Tuple[str, int],
+                timeout: float) -> socket.socket:
+        with self._lock:
+            lst = self._idle.get(addr)
+            sock = lst.pop() if lst else None
+        if sock is not None:
+            _pool_conns.labels(event="reused").inc()
+            sock.settimeout(timeout)
+            return sock
+        sock = socket.create_connection(addr, timeout=timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        _pool_conns.labels(event="opened").inc()
+        return sock
+
+    def release(self, addr: Tuple[str, int], sock: socket.socket):
+        with self._lock:
+            lst = self._idle.setdefault(addr, [])
+            if len(lst) < self.max_idle:
+                lst.append(sock)
+                return
+        sock.close()
+
+    def invalidate(self, addr: Tuple[str, int]):
+        with self._lock:
+            stale = self._idle.pop(addr, [])
+        for s in stale:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def clear(self):
+        with self._lock:
+            all_addrs = list(self._idle)
+        for a in all_addrs:
+            self.invalidate(a)
+
+
+_pool = _ConnPool()
+
+
+# ------------------------------------------------------------------- server
+
 class ShardExchange:
     """Serve this process's shards (by global id) to peer hosts.
 
-    Protocol: request = ``ZSX1`` + u32 global id; response = u32 length +
-    npz bytes (length 0 = not held here). The codec cannot execute code
-    on either end. The port is ephemeral, announced only through the JAX
-    coordination service, and the server thread dies with the process.
+    Protocol v2: request = ``ZSX2`` + u16 count + count x u32 global
+    ids (a multi-get — count=1 is the single fetch); response, per gid
+    in request order = ``ZSX2`` + u32 gid + i32 array count (-1 = not
+    held here) + the raw-tensor frames of the shard. Payloads leave
+    through ``memoryview`` of the original arrays — nothing is
+    pre-encoded and nothing on the wire can execute code. A ``ZSX1``
+    (protocol v1) request is rejected loudly and the connection
+    dropped: mixed-version clusters must fail, not corrupt. The port is
+    ephemeral, announced only through the JAX coordination service, and
+    the server thread dies with the process.
     """
 
     def __init__(self, shards_by_gid: Dict[int, Dict[str, np.ndarray]],
                  bind: str = "0.0.0.0"):
-        self._blobs = {gid: _encode_shard(s)
-                       for gid, s in shards_by_gid.items()}
+        for s in shards_by_gid.values():
+            _check_shard(s)
+        # served lazily from the caller's arrays: no blob copies, no
+        # doubled resident memory while the exchange is open
+        self._shards = dict(shards_by_gid)
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((bind, 0))
-        self._srv.listen(16)
+        self._srv.listen(64)
         self.port = self._srv.getsockname()[1]
+        self.connections_accepted = 0  # pool-reuse observability/tests
         self._closed = False
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
         self._thread = threading.Thread(target=self._serve, daemon=True)
         self._thread.start()
 
@@ -130,6 +384,10 @@ class ShardExchange:
                 conn, _ = self._srv.accept()
             except OSError:
                 return
+            self.connections_accepted += 1
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conns_lock:
+                self._conns.add(conn)
             threading.Thread(target=self._handle, args=(conn,),
                              daemon=True).start()
 
@@ -138,55 +396,245 @@ class ShardExchange:
             with conn:
                 while True:
                     try:
-                        head = _recv_exact(conn, 8)
+                        magic = _recv_exact(conn, 4)
                     except ConnectionError:
                         return
-                    if head[:4] != _MAGIC:
+                    if magic == _MAGIC_V1:
+                        logger.error(
+                            "shard exchange: protocol-v1 (ZSX1) peer "
+                            "contacted this v2 server — mixed exchange "
+                            "versions in one cluster; upgrade every "
+                            "host in lockstep. Dropping the connection.")
+                        return
+                    if magic != _MAGIC:
                         return  # not our protocol: drop the connection
-                    (gid,) = struct.unpack("!I", head[4:])
-                    blob = self._blobs.get(gid)
-                    if blob is None:
-                        conn.sendall(struct.pack("!I", 0))
-                    else:
-                        conn.sendall(struct.pack("!I", len(blob)) + blob)
+                    (count,) = struct.unpack("!H", _recv_exact(conn, 2))
+                    gids = struct.unpack(f"!{count}I",
+                                         _recv_exact(conn, 4 * count))
+                    for gid in gids:
+                        fault_point("shard.serve", gid=gid)
+                        self._send_shard(conn, gid)
         except OSError:
             pass
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+
+    def _send_shard(self, conn: socket.socket, gid: int):
+        shard = self._shards.get(gid)
+        if shard is None:
+            conn.sendall(_MAGIC + struct.pack("!Ii", gid, -1))
+            return
+        conn.sendall(_MAGIC + struct.pack("!Ii", gid, len(shard)))
+        for name, arr in shard.items():
+            conn.sendall(_array_header(name, arr))
+            payload = _payload_view(arr)
+            if payload.nbytes:
+                conn.sendall(payload)
 
     def close(self):
         self._closed = True
         try:
+            # wake the accept() thread (it holds the kernel socket — and
+            # the port — alive through a bare close(); shutdown makes the
+            # blocked accept return EINVAL immediately)
+            self._srv.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self._srv.close()
         except OSError:
             pass
+        self._thread.join(timeout=5.0)
+        # drop live per-connection sockets too: clients of a closed
+        # exchange must fail fast (and free the port for a restart)
+        # instead of hanging on a half-dead stream. SO_LINGER 0 sends
+        # RST and destroys the socket outright — a graceful FIN would
+        # park the 4-tuple in FIN_WAIT_2 against every pooled client
+        # connection, keeping the port unusable for ~a minute
+        with self._conns_lock:
+            stale = list(self._conns)
+            self._conns.clear()
+        for c in stale:
+            try:
+                c.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                             struct.pack("ii", 1, 0))
+                c.close()
+            except OSError:
+                pass
 
     @staticmethod
     def fetch(addr: Tuple[str, int], gid: int, timeout: float = 60.0,
-              retry: Optional[RetryPolicy] = None
+              retry: Optional[RetryPolicy] = None, pool: bool = True
               ) -> Dict[str, np.ndarray]:
         """Fetch shard ``gid`` from ``addr`` with bounded retries.
 
         Connect/read failures (flaky network, peer restarting) are
         transient: retried under ``retry`` (default: 3 attempts,
-        exponential backoff). A ``KeyError`` — the peer answers but does
-        not hold the shard — is a plan bug, never retried."""
-        retry = retry or RetryPolicy(max_attempts=3, base_delay=0.1,
-                                     max_delay=2.0, deadline=timeout)
+        exponential backoff), each attempt on a FRESH connection (the
+        pooled one is invalidated — its stream is poisoned). A
+        ``KeyError`` — the peer answers but does not hold the shard —
+        is a plan bug, never retried. ``pool=False`` opens and closes
+        one connection per call (the pre-v2 behavior; kept as the
+        microbench baseline)."""
+        return fetch_many(addr, [gid], timeout=timeout, retry=retry,
+                          pool=pool)[gid]
 
-        def _once():
-            fault_point("shard.fetch", addr=addr, gid=gid)
-            t0 = time.perf_counter()
-            with socket.create_connection(addr, timeout=timeout) as sock:
-                sock.sendall(_MAGIC + struct.pack("!I", gid))
-                (n,) = struct.unpack("!I", _recv_exact(sock, 4))
-                if n == 0:
-                    raise KeyError(
-                        f"peer {addr} does not hold shard {gid}")
-                out = _decode_shard(_recv_exact(sock, n))
-            _fetch_seconds.observe(time.perf_counter() - t0)
-            _fetch_bytes.inc(n)
-            return out
 
-        return retry.call(_once)
+# ------------------------------------------------------------------- client
+
+def _read_shard(sock: socket.socket) -> Tuple[int, Optional[Dict], int]:
+    """One response frame → (gid, shard-or-None, bytes received)."""
+    head = _recv_exact(sock, 12)
+    if head[:4] != _MAGIC:
+        raise ProtocolError(
+            f"peer answered with magic {bytes(head[:4])!r}, expected "
+            f"{_MAGIC!r} — protocol version mismatch (v1 peer in a v2 "
+            "cluster?)")
+    gid, count = struct.unpack("!Ii", bytes(head[4:]))
+    if count < 0:
+        return gid, None, 12
+    shard: Dict[str, np.ndarray] = {}
+    total = 12
+    for _ in range(count):
+        name, dt, shape, nbytes, header_len = _parse_array_header(
+            lambda n: _recv_exact(sock, n))
+        buf = _recv_exact(sock, nbytes) if nbytes else b""
+        # the decoded array WRAPS the recv buffer — no copy
+        shard[name] = np.frombuffer(memoryview(buf),
+                                    dtype=dt).reshape(shape)
+        total += header_len + nbytes
+    return gid, shard, total
+
+
+def _fetch_chunk_once(addr: Tuple[str, int], gids: Sequence[int],
+                      timeout: float, pool: bool) -> Dict[int, Dict]:
+    """One pipelined multi-get attempt: N gids in one write, responses
+    streamed back on the same connection."""
+    for gid in gids:
+        fault_point("shard.fetch", addr=addr, gid=gid)
+    _fetch_requests.labels(
+        mode="multi" if len(gids) > 1 else "single").inc()
+    t0 = time.perf_counter()
+    if pool:
+        sock = _pool.acquire(addr, timeout)
+    else:
+        sock = socket.create_connection(addr, timeout=timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        _pool_conns.labels(event="opened").inc()
+    reusable = False
+    try:
+        sock.settimeout(timeout)
+        sock.sendall(_MAGIC + struct.pack(f"!H{len(gids)}I",
+                                          len(gids), *gids))
+        out: Dict[int, Dict] = {}
+        total = 0
+        for want in gids:
+            gid, shard, nbytes = _read_shard(sock)
+            if gid != want:
+                raise ProtocolError(
+                    f"peer {addr} answered gid {gid} for request {want} "
+                    "— desynchronized stream")
+            if shard is None:
+                raise KeyError(f"peer {addr} does not hold shard {gid}")
+            out[gid] = shard
+            total += nbytes
+        reusable = pool
+        _fetch_seconds.observe(time.perf_counter() - t0)
+        _fetch_bytes.inc(total)
+        return out
+    except (ConnectionError, OSError):
+        # poisoned stream AND probably a dead peer: every pooled
+        # sibling connection is suspect — drop them so the retry dials
+        # fresh instead of drawing another corpse from the pool
+        _pool.invalidate(addr)
+        raise
+    finally:
+        if reusable:
+            _pool.release(addr, sock)
+        else:
+            # KeyError leaves unread responses in flight; error paths
+            # leave a torn stream — never pool either
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+def fetch_many(addr: Tuple[str, int], gids: Sequence[int],
+               timeout: float = 60.0,
+               retry: Optional[RetryPolicy] = None,
+               pool: bool = True) -> Dict[int, Dict[str, np.ndarray]]:
+    """Fetch many shards from one peer with pipelined multi-gets.
+
+    ``gids`` are split into chunks of ``ZOO_SHARD_MULTIGET`` (default
+    32); each chunk is one wire round trip (one request write, streamed
+    responses) retried independently under ``retry`` — a peer dying
+    mid-stream costs one chunk's refetch on a fresh connection, and
+    ``fault_point("shard.fetch")`` fires per gid per attempt exactly as
+    it did for single fetches."""
+    gids = [int(g) for g in gids]
+    retry = retry or RetryPolicy(max_attempts=3, base_delay=0.1,
+                                 max_delay=2.0, deadline=timeout)
+    out: Dict[int, Dict[str, np.ndarray]] = {}
+    chunk = _multiget_chunk()
+    for i in range(0, len(gids), chunk):
+        part = gids[i:i + chunk]
+        out.update(retry.call(_fetch_chunk_once, addr, part, timeout,
+                              pool))
+    return out
+
+
+def iter_fetch(sources: Sequence[Tuple[Tuple[str, int], Sequence[int]]],
+               timeout=60.0,
+               concurrency: Optional[int] = None,
+               retry: Optional[RetryPolicy] = None
+               ) -> Iterable[Tuple[int, Dict[str, np.ndarray]]]:
+    """Stream ``(gid, shard)`` pairs from many peers as they arrive.
+
+    ``sources`` = [(addr, gids), ...]. Chunks fan out over a bounded
+    thread pool (``ZOO_SHARD_FETCH_CONCURRENCY``, default 4) and
+    completed chunks yield immediately — the generator is the *fetch
+    stage* of the ingest pipeline, so a consumer wrapping it in
+    :func:`zoo_tpu.orca.data.ingest.staged_pipeline` overlaps device
+    transfer of earlier shards with the network fetch of later ones.
+    Ordering across peers is completion order, not plan order.
+
+    ``timeout`` may be a callable re-evaluated when each chunk STARTS
+    (not when it was queued) — rebalance passes its ``remaining()``
+    budget so queued chunks cannot stack fresh 60s retry deadlines past
+    the phase deadline; once the budget is spent the callable raises
+    and every pending chunk fails fast."""
+    if concurrency is None:
+        concurrency = max(1, int(os.environ.get(
+            "ZOO_SHARD_FETCH_CONCURRENCY", "4")))
+    timeout_fn = timeout if callable(timeout) else (lambda: timeout)
+    chunk = _multiget_chunk()
+    tasks = []
+    for addr, gids in sources:
+        gids = list(gids)
+        for i in range(0, len(gids), chunk):
+            tasks.append((addr, gids[i:i + chunk]))
+    if not tasks:
+        return
+
+    def _run(addr, part):
+        return fetch_many(addr, part, timeout=timeout_fn(), retry=retry)
+
+    tp = ThreadPoolExecutor(max_workers=min(concurrency, len(tasks)),
+                            thread_name_prefix="zoo-shard-fetch")
+    futs = [tp.submit(_run, addr, part) for addr, part in tasks]
+    try:
+        for fut in as_completed(futs):
+            yield from fut.result().items()
+        tp.shutdown(wait=True)
+    except BaseException:
+        # early exit (consumer broke out / pipeline torn down / a chunk
+        # raised): nobody will consume the remaining chunks, so do NOT
+        # sit out their full retry budgets — drop queued work and leave
+        # in-flight chunks to finish on their own threads
+        tp.shutdown(wait=False, cancel_futures=True)
+        raise
 
 
 def assign_shards(counts: Sequence[int]) -> List[List[int]]:
@@ -251,13 +699,21 @@ def _kv_allgather(client, gen: int, tag: str, pid: int, nprocs: int,
 
 
 def rebalance_shards(shards, bind_ip: Optional[str] = None,
-                     deadline: float = 120.0):
+                     deadline: float = 120.0, stage_fn=None):
     """Exchange shards so every process holds a balanced, disjoint set.
 
     ``shards``: this process's :class:`LocalXShards` of dict-of-ndarray
     shards (each host contributes what it has — counts may differ).
     Returns this process's rebalanced ``LocalXShards``. Single-process:
-    returns the input unchanged.
+    returns the input unchanged (staged through ``stage_fn`` if given).
+
+    ``stage_fn``: optional per-shard ingest hook (e.g.
+    ``jax.device_put``). Fetched shards stream through a staged
+    pipeline (:mod:`zoo_tpu.orca.data.ingest`) while later fetches are
+    still in flight, so device transfer overlaps the network exchange;
+    locally-kept shards are staged inline during final assembly. The
+    returned shard ORDER is identical with and without ``stage_fn`` —
+    the deterministic :func:`assign_shards` plan.
 
     Failure semantics: every phase is bounded by ``deadline`` seconds,
     and every host *always* reaches the post-fetch status exchange — a
@@ -274,6 +730,11 @@ def rebalance_shards(shards, bind_ip: Optional[str] = None,
 
     parts = shards.collect() if hasattr(shards, "collect") else list(shards)
     if jax.process_count() == 1:
+        if stage_fn is not None:
+            from zoo_tpu.orca.data.ingest import staged_pipeline
+            with staged_pipeline(iter(parts),
+                                 [("ingest", stage_fn)]) as pipe:
+                parts = list(pipe)
         return LocalXShards(parts)
 
     global _rebal_generation
@@ -317,14 +778,8 @@ def rebalance_shards(shards, bind_ip: Optional[str] = None,
             plan = assign_shards(counts)
             mine, error = [], None
             try:
-                for gid in plan[pid]:
-                    src = int(np.searchsorted(offsets, gid,
-                                              side="right") - 1)
-                    if src == pid:
-                        mine.append(parts[gid - offsets[pid]])
-                        continue
-                    mine.append(ShardExchange.fetch(
-                        addrs[src], gid, timeout=min(remaining(), 60.0)))
+                mine = _fetch_plan(plan[pid], pid, offsets, addrs, parts,
+                                   remaining, stage_fn)
             except Exception as e:  # noqa: BLE001 — reported to every host
                 error = e
                 logger.error("shard fetch phase failed on host %d: %r",
@@ -348,7 +803,59 @@ def rebalance_shards(shards, bind_ip: Optional[str] = None,
                     f"{bad}") from error
     finally:
         exchange.close()
+        # the exchange is gone with its port: pooled connections to ANY
+        # peer's per-rebalance server are dead weight after teardown
+        _pool.clear()
     return LocalXShards(mine)
+
+
+def _fetch_plan(my_plan: Sequence[int], pid: int, offsets, addrs,
+                parts, remaining, stage_fn) -> List:
+    """Materialize this host's planned shard list: local shards by
+    reference, remote ones via concurrent pipelined multi-gets (grouped
+    per source peer), optionally streamed through the ingest pipeline
+    so device placement overlaps the network fetch."""
+    import itertools
+
+    local_gids: List[int] = []
+    by_src: Dict[int, List[int]] = {}
+    for gid in my_plan:
+        src = int(np.searchsorted(offsets, gid, side="right") - 1)
+        if src == pid:
+            local_gids.append(gid)
+        else:
+            by_src.setdefault(src, []).append(gid)
+    source_list = [(addrs[src], gids) for src, gids in by_src.items()]
+    staged: Dict[int, Dict] = {}
+    # the phase budget is re-read when each chunk starts: N queued
+    # chunks must not stack N fresh 60s retry deadlines past the
+    # rebalance deadline (remaining() raises once it is spent, so
+    # pending chunks fail fast and every host reaches the status
+    # barrier together)
+    stream = iter_fetch(source_list,
+                        timeout=lambda: min(remaining(), 60.0))
+    if stage_fn is None:
+        for gid, shard in stream:
+            staged[gid] = shard
+        local_set = set(local_gids)
+        return [parts[gid - offsets[pid]] if gid in local_set
+                else staged[gid] for gid in my_plan]
+    from zoo_tpu.orca.data.ingest import staged_pipeline
+    # ONE stream for local and remote shards: locals lead (available
+    # immediately, so their device placement starts before the first
+    # fetch completes — on the locality-first plan most shards are
+    # local, and staging them after the network phase would waste the
+    # whole fetch window), then fetched shards as they arrive. The
+    # pipeline's producer thread drains the stream while its stage
+    # thread runs stage_fn (device_put): transfer of shard k overlaps
+    # the fetch of shard k+1.
+    locals_iter = ((gid, parts[gid - offsets[pid]]) for gid in local_gids)
+    with staged_pipeline(
+            itertools.chain(locals_iter, stream),
+            [("ingest", lambda kv: (kv[0], stage_fn(kv[1])))]) as pipe:
+        for gid, shard in pipe:
+            staged[gid] = shard
+    return [staged[gid] for gid in my_plan]
 
 
 def _default_ip() -> str:

@@ -554,7 +554,7 @@ class KerasNet:
                 feature_cols, label_cols)
             val_arrays = (self._adapt_inputs(val_arrays[0]), val_arrays[1])
         history: Dict[str, List[float]] = {"loss": []}
-        from zoo_tpu.orca.data.cache import DoubleBufferedIterator
+        from zoo_tpu.orca.data.ingest import staged_pipeline
         arrs = xs + ys_list
         sample_bytes = sum(a[:1].nbytes for a in arrs)
         # Host→HBM transfers are chunked into SUPERBATCHES (many training
@@ -662,18 +662,32 @@ class KerasNet:
                         k = len(idx) // local_bs if use_scan else 0
                         return self._jit_stage(arrs, jnp.asarray(idx), k,
                                                local_bs)
+                    # device-side gather: one stage (the work IS the
+                    # dispatch; splitting it buys nothing)
+                    stages = [("stage", _stage)]
                 else:
-                    def _stage(idx):
+                    # host-fed path: separate slice and device-put
+                    # stages, each on its own staging thread — the step
+                    # on superbatch k overlaps the host→device transfer
+                    # of k+1 AND the host slicing of k+2 (the async
+                    # ingest pipeline; see orca/data/ingest.py)
+                    def _slice(idx):
                         sliced = [a[idx] for a in arrs]
                         if use_scan:  # (k*bs,...) -> (k, bs, ...) for scan
                             sliced = [a.reshape((len(idx) // local_bs,
                                                  local_bs)
                                                 + a.shape[1:])
                                       for a in sliced]
+                        return sliced
+
+                    def _put(sliced):
+                        if use_scan:
                             return self._put_stacked(sliced)
                         return self._put_batch(sliced)
 
-                # the stage_fn runs on the iterator's daemon thread; pin
+                    stages = [("slice", _slice), ("device_put", _put)]
+
+                # stage fns run on the pipeline's daemon threads; pin
                 # the CALLER's runtime context (possibly a thread-local
                 # sub-mesh scope, e.g. concurrent AutoML trials) so the
                 # staged batches land on the same mesh as the params
@@ -683,14 +697,22 @@ class KerasNet:
                         runtime_context_scope,
                     )
 
-                    def _stage(idx, _orig=_stage, _ctx=_caller_ctx):
-                        with runtime_context_scope(_ctx):
-                            return _orig(idx)
+                    def _pin(fn, _ctx=_caller_ctx):
+                        def pinned(item, _fn=fn):
+                            with runtime_context_scope(_ctx):
+                                return _fn(item)
+                        return pinned
 
-                batches = DoubleBufferedIterator(
+                    stages = [(name, _pin(fn)) for name, fn in stages]
+
+                # depth=1: superbatches are large by design, and two
+                # depth-2 stages would keep ~3 extra host copies
+                # resident; one buffer per stage is all the overlap
+                # needs (slice k+2 | transfer k+1 | step k)
+                batches = staged_pipeline(
                     data_utils.batch_slices(n, local_bs, shuffle, nprng,
                                             group=group),
-                    stage_fn=_stage)
+                    stages, depth=1)
                 try:
                     with (prof.epoch_trace() if prof
                           else contextlib.nullcontext()):

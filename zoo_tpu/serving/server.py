@@ -152,6 +152,14 @@ class ServingServer:
 
         class Handler(socketserver.BaseRequestHandler):
             def setup(self):
+                # small request/response frames ping-pong on each
+                # connection: Nagle + delayed-ACK interactions add
+                # spurious tail latency under concurrent clients
+                try:
+                    self.request.setsockopt(socket.IPPROTO_TCP,
+                                            socket.TCP_NODELAY, 1)
+                except OSError:
+                    pass
                 # TLS handshake PER CONNECTION THREAD — in get_request
                 # it would run on the accept loop, where one idle client
                 # blocks every other connection (and stop())
@@ -263,9 +271,30 @@ class ServingServer:
                 with span("serving.batch", size=len(batch)):
                     fault_point("serving.infer", batch=len(batch))
                     arrays = [np.asarray(r.data) for r in batch]
-                    stacked = np.concatenate(arrays, axis=0)
+                    # pad UP to a whole multiple of batch_size so ONE
+                    # XLA executable serves every occupancy. Without
+                    # this, each distinct request count compiled its
+                    # own forward — under concurrent clients the first
+                    # window ate up to batch_size compiles, the
+                    # multi-second p99 pathology (8.6s at bs8 in
+                    # BENCH_r05 while bs32, running second on a warm
+                    # jit cache, saw 110ms). One concatenate builds the
+                    # padded batch — this is the per-window hot path.
+                    real = sum(len(a) for a in arrays)
+                    # zero-fill padding (a repeat of the last row would
+                    # yield an EMPTY pad when a zero-row request lands
+                    # last, silently reintroducing the variable shape);
+                    # max() keeps an all-empty window a full batch too
+                    padded = max(self.batch_size,
+                                 -(-real // self.batch_size)
+                                 * self.batch_size)
+                    to_stack = arrays if padded == real else arrays + [
+                        np.zeros((padded - real,) + arrays[0].shape[1:],
+                                 arrays[0].dtype)]
+                    stacked = np.concatenate(to_stack, axis=0)
                     preds = model.predict(stacked,
                                           batch_size=self.batch_size)
+                    preds = np.asarray(preds)[:real]
                     offset = 0
                     for r, a in zip(batch, arrays):
                         r.result = np.asarray(preds[offset:offset + len(a)])

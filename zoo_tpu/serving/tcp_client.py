@@ -39,6 +39,7 @@ class _Connection:
 
     def _open(self):
         sock = socket.create_connection((self._host, self._port))
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         if self._tls:
             import ssl
             ctx = ssl.create_default_context(cafile=self._cafile)

@@ -545,6 +545,54 @@ def bench_shard_exchange(extra, n_shards=64, rows=128, cols=64, reps=3):
     extra["shard_ingest_overlap_ratio"] = round(overlap, 3)
 
 
+def bench_guard(extra, n=16384, feat=64, batch_size=512, epochs=3, reps=3):
+    """Training-guardian overhead: samples/s of an identical MLP fit
+    with the in-step health guard (isfinite(loss) + grad global-norm +
+    where-fold + device counters, read once per superbatch boundary)
+    versus the bare step. The guard's acceptance bar is "within noise":
+    ``guard_overhead_pct`` should sit inside the A/B spread, because
+    the check adds one fused select + a small reduce per step and NO
+    per-step host sync (docs/fault_tolerance.md)."""
+    from zoo_tpu.orca.learn.guard import GuardConfig, TrainingGuard
+    from zoo_tpu.pipeline.api.keras import Sequential
+    from zoo_tpu.pipeline.api.keras.layers import Dense
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(n, feat).astype(np.float32)
+    y = (x @ rs.randn(feat, 1)).astype(np.float32)
+
+    def build(guarded):
+        m = Sequential()
+        m.add(Dense(256, input_shape=(feat,), activation="relu"))
+        m.add(Dense(256, activation="relu"))
+        m.add(Dense(1))
+        m.compile(optimizer="adam", loss="mse")
+        if guarded:
+            m.set_guard(TrainingGuard(
+                config=GuardConfig(enabled=True, preempt_signal="none")))
+        m.fit(x, y, batch_size=batch_size, nb_epoch=1, shuffle=False,
+              verbose=0)  # warm the jit cache
+        return m
+
+    mu, mg = build(False), build(True)
+    bare, guarded = [], []
+    for _ in range(reps):  # interleaved A/B: same chip window
+        t0 = time.perf_counter()
+        mu.fit(x, y, batch_size=batch_size, nb_epoch=epochs,
+               shuffle=False, verbose=0)
+        bare.append(n * epochs / (time.perf_counter() - t0))
+        t0 = time.perf_counter()
+        mg.fit(x, y, batch_size=batch_size, nb_epoch=epochs,
+               shuffle=False, verbose=0)
+        guarded.append(n * epochs / (time.perf_counter() - t0))
+    (u50, usp), (g50, gsp) = _stats(bare), _stats(guarded)
+    extra["guard_unguarded_samples_per_sec"] = round(u50, 1)
+    extra["guard_unguarded_spread"] = round(usp, 3)
+    extra["guard_guarded_samples_per_sec"] = round(g50, 1)
+    extra["guard_guarded_spread"] = round(gsp, 3)
+    extra["guard_overhead_pct"] = round((u50 / g50 - 1.0) * 100, 2)
+
+
 def bench_serving(extra, n_requests=200, clients=8, feat=64):
     """Hermetic serving numbers (VERDICT r4 #7): an MLP behind the TCP
     micro-batcher on loopback, ``clients`` concurrent connections; p50 /
@@ -655,6 +703,10 @@ def main():
             bench_shard_exchange(extra)
         except Exception as e:  # noqa: BLE001
             extra["shard_exchange_error"] = repr(e)
+        try:
+            bench_guard(extra)
+        except Exception as e:  # noqa: BLE001
+            extra["guard_error"] = repr(e)
         try:
             (f_p50, f_sp), (q_p50, q_sp) = bench_resnet50_int8_infer()
             extra["resnet50_infer_samples_per_sec"] = round(f_p50, 1)

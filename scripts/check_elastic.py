@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Smoke-check ``run_elastic`` scale-down END TO END on CPU: a REAL
+2-worker supervised launch where worker 1 dies permanently mid-job
+(restart budget 0), the supervisor relaunches the survivor as a
+1-worker world, and the relaunched run RESUMES from the checkpoint that
+``ZOO_ELASTIC_ATTEMPT > 0`` signals — proving the contract the
+``docs/fault_tolerance.md`` elastic layer promises, in tier-1 time
+(each worker trains a 2-unit Dense head for a couple of epochs; the
+cost is the two jax imports, not the math).
+
+Heartbeat liveness is enabled across both attempts, so this also
+regression-checks the stale-heartbeat-file carryover fixes: a worker
+must never inherit the supervisor's ``ZOO_HEARTBEAT_FILE``, and attempt
+N+1 must not read attempt N's stale stamp as its own first beat.
+
+Run directly (``python scripts/check_elastic.py``) or from the suite
+(``tests/test_elastic.py`` runs it under the ``chaos`` marker).
+"""
+
+import os
+import re
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_WORKER = r"""
+import os, sys, time
+import numpy as np
+
+rank = int(os.environ.get("ZOO_PROCESS_ID", "0"))
+attempt = int(os.environ.get("ZOO_ELASTIC_ATTEMPT", "0"))
+model_dir = sys.argv[1]
+
+# prove the launcher handed THIS worker its own heartbeat file (never
+# the supervisor's) and start beating on it
+hb = os.environ.get("ZOO_HEARTBEAT_FILE", "")
+assert f"worker-{rank}" in hb, f"wrong heartbeat file for rank {rank}: {hb!r}"
+from zoo_tpu.util.resilience import start_heartbeat_thread
+start_heartbeat_thread()
+
+if rank == 1:
+    # the doomed worker: wait until rank 0 has committed a checkpoint,
+    # then die permanently (budget 0 -> scale-down to world 1)
+    flag = os.path.join(model_dir, "ckpt.ready")
+    for _ in range(600):
+        if os.path.exists(flag):
+            break
+        time.sleep(0.1)
+    print(f"rank 1 exiting permanently (attempt {attempt})", flush=True)
+    os._exit(1)
+
+from zoo_tpu.orca.learn.keras import Estimator
+from zoo_tpu.pipeline.api.keras.engine.topology import Sequential
+from zoo_tpu.pipeline.api.keras.layers import Dense
+
+rs = np.random.RandomState(0)
+x = rs.randn(64, 4).astype(np.float32)
+y = (x @ rs.randn(4, 1)).astype(np.float32)
+
+m = Sequential()
+m.add(Dense(2, input_shape=(4,)))
+m.add(Dense(1))
+m.compile(optimizer="adam", loss="mse")
+est = Estimator.from_keras(m, model_dir=model_dir)
+if attempt > 0:
+    est.load_orca_checkpoint(path=model_dir)
+    print(f"RESUMED attempt={attempt} at epoch {est._epoch}", flush=True)
+    assert est._epoch >= 1, "resume must start from the saved epoch"
+
+TOTAL = 3
+est.fit({"x": x, "y": y}, epochs=2 - min(est._epoch, 1), batch_size=16)
+open(os.path.join(model_dir, "ckpt.ready"), "w").close()
+if attempt == 0:
+    # keep the world alive so the sibling's crash lands mid-job, not
+    # after a clean exit (the supervisor tears us down)
+    print(f"EPOCH {est._epoch} attempt=0", flush=True)
+    time.sleep(600)
+while est._epoch < TOTAL:
+    est.fit({"x": x, "y": y}, epochs=1, batch_size=16)
+print(f"DONE attempt={attempt} epoch={est._epoch}", flush=True)
+"""
+
+
+def check(verbose: bool = True) -> int:
+    from zoo_tpu.orca.bootstrap import run_elastic
+
+    tmp = tempfile.mkdtemp(prefix="zoo-elastic-smoke-")
+    script = os.path.join(tmp, "worker.py")
+    with open(script, "w") as f:
+        f.write(_WORKER)
+    model_dir = os.path.join(tmp, "model")
+    os.makedirs(model_dir, exist_ok=True)
+    log_dir = os.path.join(tmp, "logs")
+    env = {
+        "PYTHONPATH": os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))) + os.pathsep +
+        os.environ.get("PYTHONPATH", ""),
+        "JAX_COMPILATION_CACHE_DIR": os.path.join(tmp, "jaxcache"),
+        # the guard's SIGTERM handler must not turn the teardown of the
+        # still-sleeping attempt-0 survivor into a preempt checkpoint
+        "ZOO_PREEMPT": "none",
+    }
+    final_world = run_elastic(
+        2, script, [model_dir], min_workers=1, max_restarts=0,
+        log_dir=log_dir, env=env, wait_timeout=240,
+        heartbeat_timeout=60.0)
+    assert final_world == 1, f"expected scale-down to 1, got {final_world}"
+
+    logs = ""
+    import glob
+    for path in sorted(glob.glob(os.path.join(log_dir, "*.log"))):
+        with open(path) as f:
+            logs += f.read()
+    resumed = re.search(r"RESUMED attempt=(\d+) at epoch (\d+)", logs)
+    assert resumed, f"relaunched world never resumed:\n{logs[-2000:]}"
+    assert int(resumed.group(1)) >= 1 and int(resumed.group(2)) >= 1, \
+        resumed.group(0)
+    assert re.search(r"DONE attempt=\d+ epoch=3", logs), \
+        f"resumed run never completed:\n{logs[-2000:]}"
+    if verbose:
+        print(f"ELASTIC OK: world 2 -> 1, {resumed.group(0)!r}, "
+              "completed epoch 3 from the ZOO_ELASTIC_ATTEMPT checkpoint")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(check())

@@ -172,3 +172,24 @@ def test_checkpoint_roundtrip_with_sharded_state(tmp_path):
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-6),
         cont, resumed)
+
+
+@pytest.mark.chaos
+@pytest.mark.timeout(300)
+def test_check_elastic_script_runs():
+    """The 2→1 scale-down CPU smoke (scripts/check_elastic.py): worker 1
+    dies permanently, the supervisor relaunches world 1, and the
+    relaunched run resumes from the checkpoint ``ZOO_ELASTIC_ATTEMPT``
+    signals — with heartbeat liveness enabled across both attempts (the
+    stale-heartbeat-file carryover regression)."""
+    import os
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join("scripts", "check_elastic.py")],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True, text=True, timeout=280)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "ELASTIC OK" in out.stdout, out.stdout + out.stderr

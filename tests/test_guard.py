@@ -1,0 +1,275 @@
+"""Training guardian (zoo_tpu/orca/learn/guard.py): the escalation
+ladder against REAL guarded fits — an injected NaN batch is skipped
+without corrupting params, a forced divergence rolls back to the last
+verified checkpoint and the run still converges, budget exhaustion
+raises ``TrainingDiverged`` (never retried), and a preemption request
+produces a checkpoint a fresh run resumes from. The jitted fold itself
+must be a bit-exact no-op on clean data (guarded == unguarded losses).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from zoo_tpu.orca.learn.guard import (
+    PREEMPT_EXIT_CODE,
+    GuardConfig,
+    Preempted,
+    TrainingDiverged,
+    TrainingGuard,
+)
+from zoo_tpu.orca.learn.keras import Estimator
+from zoo_tpu.pipeline.api.keras.engine.topology import Sequential
+from zoo_tpu.pipeline.api.keras.layers import Dense
+from zoo_tpu.util.resilience import inject
+
+pytestmark = [pytest.mark.guard, pytest.mark.chaos]
+
+
+def _data(n=256, feat=8, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, feat).astype(np.float32)
+    w = rs.randn(feat, 1).astype(np.float32)
+    return {"x": x, "y": (x @ w).astype(np.float32)}
+
+
+def _model(seed=0):
+    m = Sequential()
+    m.add(Dense(16, input_shape=(8,), activation="relu"))
+    m.add(Dense(1))
+    m.compile(optimizer="adam", loss="mse")
+    return m
+
+
+def _poison(site=None, arrays=None, idx=None, **_):
+    for a in arrays:
+        a[:] = np.nan
+
+
+def test_guarded_matches_unguarded_on_clean_data(tmp_path):
+    """The in-step fold must not perturb healthy training by one ulp:
+    the cond's good branch IS the unguarded update."""
+    data = _data()
+    e1 = Estimator.from_keras(_model(), guard=False)
+    h1 = e1.fit(data, epochs=3, batch_size=32)
+    e2 = Estimator.from_keras(_model(), guard=TrainingGuard(
+        config=GuardConfig(enabled=True, preempt_signal="none")))
+    h2 = e2.fit(data, epochs=3, batch_size=32)
+    assert h1["loss"] == h2["loss"], (h1["loss"], h2["loss"])
+
+
+def test_nan_batch_skipped_params_stay_finite(tmp_path):
+    """Layer 1: a poison batch mid-fit is folded away — finite loss,
+    finite params, quarantine JSONL + obs counter record the skip."""
+    import jax
+
+    data = _data()
+    guard = TrainingGuard(config=GuardConfig(
+        enabled=True, max_skips=100, preempt_signal="none"))
+    est = Estimator.from_keras(_model(), model_dir=str(tmp_path),
+                               guard=guard)
+    est.fit(data, epochs=1, batch_size=32)
+    with inject("fit.batch", action=_poison, exc=None, times=1) as armed:
+        h = est.fit(data, epochs=1, batch_size=32)
+    assert armed.fired == 1
+    assert guard.nonfinite_steps > 0
+    assert np.isfinite(h["loss"]).all(), h["loss"]
+    leaves = jax.tree_util.tree_leaves(est.model.params)
+    assert all(np.isfinite(np.asarray(a)).all() for a in leaves)
+    qpath = os.path.join(str(tmp_path), "guard", "quarantine.jsonl")
+    events = [json.loads(line) for line in open(qpath)]
+    skip = [e for e in events if e["event"] == "nonfinite_steps"]
+    assert skip and skip[0]["bad_in_window"] > 0
+    assert skip[0]["batch_lo"] is not None  # provenance hint recorded
+
+
+def test_divergence_rolls_back_and_converges(tmp_path):
+    """Layer 2: a streak of poisoned superbatches triggers restore from
+    the last verified checkpoint; once the fault schedule ends the run
+    converges below its pre-fault loss."""
+    data = _data()
+    guard = TrainingGuard(config=GuardConfig(
+        enabled=True, max_skips=4, preempt_signal="none"))
+    est = Estimator.from_keras(_model(), model_dir=str(tmp_path),
+                               guard=guard)
+    h0 = est.fit(data, epochs=1, batch_size=32)
+    with inject("fit.batch", action=_poison, exc=None, times=2):
+        h = est.fit(data, epochs=4, batch_size=32)
+    assert guard.rollbacks >= 1
+    assert np.isfinite(h["loss"]).all()
+    assert h["loss"][-1] < h0["loss"][0], (h0["loss"], h["loss"])
+    events = [json.loads(line) for line in open(
+        os.path.join(str(tmp_path), "guard", "quarantine.jsonl"))]
+    assert any(e["event"] == "rollback" for e in events)
+
+
+def test_budget_exhaustion_raises_diverged_not_retried(tmp_path):
+    """A permanently poisoned stream exhausts the rollback budget and
+    raises TrainingDiverged straight through the estimator's retry
+    perimeter (retrying the same snapshot would diverge again)."""
+    data = _data()
+    data["x"][:128] = np.nan  # half the rows: every shuffled batch dies
+    guard = TrainingGuard(config=GuardConfig(
+        enabled=True, max_skips=4, rollback_budget=2,
+        preempt_signal="none"))
+    est = Estimator.from_keras(_model(), model_dir=str(tmp_path),
+                               guard=guard)
+    est.model.build()
+    with pytest.raises(TrainingDiverged):
+        est.fit(data, epochs=8, batch_size=32)
+    assert guard.rollbacks == 2  # budget spent, then gave up
+
+
+def test_no_checkpoint_escalates_to_diverged():
+    """Without a model_dir there is nothing to roll back to: the ladder
+    skips straight from streak to TrainingDiverged."""
+    data = _data()
+    data["x"][:] = np.nan
+    guard = TrainingGuard(config=GuardConfig(
+        enabled=True, max_skips=4, preempt_signal="none"))
+    est = Estimator.from_keras(_model(), guard=guard)
+    with pytest.raises(TrainingDiverged):
+        est.fit(data, epochs=2, batch_size=32)
+    assert guard.rollbacks == 0
+
+
+def test_preempt_checkpoints_and_resumes(tmp_path):
+    """Layer 3: a preemption request checkpoints at the next step
+    boundary and exits with the resume-don't-retry code; a fresh run
+    resumes from that snapshot and completes."""
+    data = _data()
+    guard = TrainingGuard(config=GuardConfig(
+        enabled=True, preempt_signal="none"))
+    est = Estimator.from_keras(_model(), model_dir=str(tmp_path),
+                               guard=guard)
+    est.fit(data, epochs=1, batch_size=32)
+    guard.request_preempt()
+    with pytest.raises(Preempted) as ei:
+        est.fit(data, epochs=5, batch_size=32)
+    assert ei.value.code == PREEMPT_EXIT_CODE == 75
+    assert guard.preempt_checkpoints == 1
+    assert issubclass(Preempted, SystemExit)  # uncaught ⇒ exit code 75
+
+    est2 = Estimator.from_keras(_model(), model_dir=str(tmp_path))
+    est2.load_orca_checkpoint(path=str(tmp_path))
+    h = est2.fit(data, epochs=2, batch_size=32)
+    assert np.isfinite(h["loss"]).all()
+
+
+def test_sigterm_routes_to_guard_during_fit(tmp_path):
+    """During a guarded fit SIGTERM means checkpoint-and-exit(75), and
+    the previous handler is restored afterwards."""
+    import signal
+
+    data = _data()
+    before = signal.getsignal(signal.SIGTERM)
+    guard = TrainingGuard(config=GuardConfig(enabled=True))
+    est = Estimator.from_keras(_model(), model_dir=str(tmp_path),
+                               guard=guard)
+    est.fit(data, epochs=1, batch_size=32)
+    installed = {}
+
+    # raise the signal from inside the fit via a poison-free fault hook
+    def kick(site=None, arrays=None, idx=None, **_):
+        if not installed:
+            installed["x"] = True
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    with inject("fit.batch", action=kick, exc=None, times=1):
+        with pytest.raises(Preempted):
+            est.fit(data, epochs=5, batch_size=32)
+    assert guard.preempt_checkpoints == 1
+    assert signal.getsignal(signal.SIGTERM) == before  # restored
+
+
+def test_guard_disabled_by_env(monkeypatch, tmp_path):
+    monkeypatch.setenv("ZOO_GUARD", "0")
+    est = Estimator.from_keras(_model(), model_dir=str(tmp_path))
+    assert est._guard is None
+    assert est.model._active_guard() is None
+
+
+def test_epoch_dispatch_path_guarded(tmp_path):
+    """Device-resident small datasets take the whole-epoch-in-one-
+    dispatch path; the guard's counters must flow through it too."""
+    import jax.numpy as jnp
+
+    data = _data()
+    xd, yd = jnp.asarray(data["x"]), jnp.asarray(data["y"])
+    guard = TrainingGuard(config=GuardConfig(
+        enabled=True, preempt_signal="none"))
+    est = Estimator.from_keras(_model(), model_dir=str(tmp_path),
+                               guard=guard)
+    h = est.fit({"x": xd, "y": yd}, epochs=2, batch_size=32)
+    assert np.isfinite(h["loss"]).all()
+    assert est.model._opt_state is not None
+    # the carry was shed: saved aux must be plain optimizer state
+    assert not (isinstance(est.model._opt_state, tuple)
+                and len(est.model._opt_state) == 2
+                and isinstance(est.model._opt_state[1], dict)
+                and "bad" in est.model._opt_state[1])
+
+
+def test_gan_guard_skips_poison_batch():
+    """The GAN estimator's adversarial iteration folds away whole when
+    a sub-loss goes non-finite."""
+    from zoo_tpu.orca.learn.gan import GANEstimator
+
+    rs = np.random.RandomState(0)
+    real = rs.randn(64, 8).astype(np.float32)
+    g = Sequential()
+    g.add(Dense(16, input_shape=(8,), activation="relu"))
+    g.add(Dense(8))
+    d = Sequential()
+    d.add(Dense(16, input_shape=(8,), activation="relu"))
+    d.add(Dense(1))
+    guard = TrainingGuard(config=GuardConfig(
+        enabled=True, max_skips=1000, preempt_signal="none"))
+    gan = GANEstimator(g, d, noise_dim=8, guard=guard)
+    poisoned = real.copy()
+    poisoned[:16] = np.nan
+    h = gan.fit({"x": poisoned}, epochs=2, batch_size=16)
+    assert guard.nonfinite_steps > 0
+    assert np.isfinite(h["d_loss"]).all() and np.isfinite(
+        h["g_loss"]).all()
+    import jax
+    for net in (gan.g, gan.d):
+        assert all(np.isfinite(np.asarray(a)).all()
+                   for a in jax.tree_util.tree_leaves(net.params))
+
+
+def test_chronos_forecaster_inherits_guard(monkeypatch):
+    """Chronos forecasters train through the guarded step: a poisoned
+    window skips instead of NaN-ing the model."""
+    from zoo_tpu.chronos.forecaster.lstm_forecaster import LSTMForecaster
+
+    monkeypatch.setenv("ZOO_GUARD_MAX_SKIPS", "1000")
+    monkeypatch.setenv("ZOO_PREEMPT", "none")
+    rs = np.random.RandomState(0)
+    x = rs.randn(128, 12, 2).astype(np.float32)
+    y = rs.randn(128, 1, 2).astype(np.float32)
+    f = LSTMForecaster(past_seq_len=12, input_feature_num=2,
+                       output_feature_num=2)
+    with inject("fit.batch", action=_poison, exc=None, times=1) as armed:
+        f.fit((x, y), epochs=1, batch_size=32)
+    assert armed.fired == 1
+    g = f.model._active_guard()
+    assert g is not None and g.nonfinite_steps > 0
+    preds = f.predict((x, None))
+    assert np.isfinite(preds).all()
+
+
+def test_check_guard_script_runs():
+    """The jax-free escalation-ladder smoke (scripts/check_guard.py)
+    passes in-suite, like the perf/obs smokes."""
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join("scripts", "check_guard.py")],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "GUARD OK" in out.stdout, out.stdout + out.stderr

@@ -1,0 +1,129 @@
+"""Preemption protocol on a REAL 2-process cluster: SIGTERM lands on
+ONE rank mid-fit, the request propagates over the JAX coordination-
+service KV store, both ranks stop at the SAME agreed global step, rank 0
+writes exactly one checkpoint, every worker exits with
+``PREEMPT_EXIT_CODE``, and ``run_elastic`` relaunches at the SAME world
+size — the resumed run completes from the preemption snapshot.
+"""
+
+import json
+import os
+import re
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = [pytest.mark.slow, pytest.mark.guard, pytest.mark.chaos]
+
+_WORKER = r"""
+import os, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+# join the coordination service (the KV store the preempt protocol
+# rides) WITHOUT a cross-process device mesh: XLA's CPU backend has no
+# multiprocess computations, so each rank fits its own replica of the
+# deterministic SPMD program — identical steps, identical counters —
+# which is exactly the lockstep the protocol assumes on a real pod
+jax.distributed.initialize(os.environ["ZOO_COORDINATOR_ADDRESS"],
+                           int(os.environ["ZOO_NUM_PROCESSES"]),
+                           int(os.environ["ZOO_PROCESS_ID"]))
+world, pid = jax.process_count(), jax.process_index()
+attempt = int(os.environ.get("ZOO_ELASTIC_ATTEMPT", "0"))
+model_dir = sys.argv[1]
+
+from zoo_tpu.orca.learn.ckpt import CheckpointManager
+from zoo_tpu.orca.learn.guard import GuardConfig, TrainingGuard
+from zoo_tpu.orca.learn.keras import Estimator
+from zoo_tpu.pipeline.api.keras.engine.topology import Sequential
+from zoo_tpu.pipeline.api.keras.layers import Dense
+
+rs = np.random.RandomState(0)
+x = rs.randn(96, 8).astype(np.float32)
+w = rs.randn(8, 1).astype(np.float32)
+y = (x @ w).astype(np.float32)
+
+m = Sequential()
+m.add(Dense(8, input_shape=(8,), activation="relu"))
+m.add(Dense(1))
+m.compile(optimizer="adam", loss="mse")
+guard = TrainingGuard(
+    config=GuardConfig(enabled=True),
+    quarantine_path=os.path.join(model_dir, f"guard-rank{pid}.jsonl"))
+# rank 0 owns the checkpoint dir (DP params are replicated); every rank
+# can READ it on a shared filesystem, so rollback capability is global
+est = Estimator.from_keras(m, model_dir=model_dir if pid == 0 else None,
+                           guard=guard)
+if pid > 0:
+    mgr = CheckpointManager(os.path.join(model_dir, "ckpts"))
+    guard.bind(restore_fn=lambda: mgr.restore_with_aux(None)[1:])
+if attempt > 0:
+    est.load_orca_checkpoint(path=model_dir)
+    print(f"proc {pid} RESUMED attempt={attempt} epoch={est._epoch}",
+          flush=True)
+
+TOTAL = 3
+if attempt == 0:
+    est.fit({"x": x, "y": y}, epochs=1, batch_size=24)
+    if pid == 0:
+        # the TPU maintenance event: SIGTERM on ONE host, mid-fit;
+        # the KV protocol must stop BOTH ranks at the same step
+        import signal
+        from zoo_tpu.util.resilience import inject
+
+        def kick(**_):
+            os.kill(os.getpid(), signal.SIGTERM)
+
+        inject("fit.batch", action=kick, exc=None, times=1)
+    est.fit({"x": x, "y": y}, epochs=TOTAL - est._epoch, batch_size=24)
+    print(f"proc {pid} UNEXPECTED completion", flush=True)
+else:
+    while est._epoch < TOTAL:
+        est.fit({"x": x, "y": y}, epochs=1, batch_size=24)
+    print(f"proc {pid} DONE epoch={est._epoch}", flush=True)
+"""
+
+
+@pytest.mark.timeout(480)
+def test_sigterm_coordinated_checkpoint_and_resume(tmp_path):
+    from zoo_tpu.orca.bootstrap import run_elastic
+
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    model_dir = tmp_path / "model"
+    model_dir.mkdir()
+    log_dir = tmp_path / "logs"
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": os.getcwd() + os.pathsep
+        + os.environ.get("PYTHONPATH", ""),
+        "JAX_COMPILATION_CACHE_DIR": str(tmp_path / "jaxcache"),
+    }
+    final_world = run_elastic(
+        2, str(script), [str(model_dir)], min_workers=1,
+        max_restarts=0, log_dir=str(log_dir), env=env,
+        wait_timeout=420)
+    # preemption must NOT scale the world down
+    assert final_world == 2
+
+    logs = ""
+    for f in sorted(log_dir.glob("*.log")):
+        logs += f.read_text()
+    assert "UNEXPECTED completion" not in logs, logs[-2000:]
+    assert re.search(r"proc \d+ RESUMED attempt=1", logs), logs[-2000:]
+    assert re.search(r"proc \d+ DONE epoch=3", logs), logs[-2000:]
+
+    # exactly ONE coordinated checkpoint, both ranks at the SAME step
+    steps = {}
+    for pid in (0, 1):
+        events = [json.loads(line) for line in
+                  open(model_dir / f"guard-rank{pid}.jsonl")]
+        pre = [e for e in events if e["event"] == "preempt_checkpoint"]
+        assert len(pre) == 1, (pid, events)
+        steps[pid] = pre[0]["step"]
+        # only rank 0 holds the save callback
+        assert pre[0]["saved"] == (pid == 0)
+    assert steps[0] == steps[1], f"ranks checkpointed different steps: " \
+                                 f"{steps}"

@@ -88,14 +88,32 @@ class Forecaster:
             raise ValueError("fit requires rolled targets")
         if self.model is None:
             self._build()
+        # training guardian (docs/fault_tolerance.md): forecasters train
+        # through the same guarded jitted step as the orca estimators —
+        # a poison window in a production telemetry stream skips instead
+        # of NaN-ing the whole model. No checkpoint manager here, so
+        # divergence raises TrainingDiverged rather than rolling back.
+        from zoo_tpu.orca.learn.guard import TrainingGuard
+        if getattr(self.model, "_guard", None) is None:
+            g = TrainingGuard.from_env(name=type(self).__name__)
+            if g is not None:
+                self.model.set_guard(g)
+        guard = getattr(self.model, "_guard", None)
         y = y.reshape(y.shape[0], -1)  # flatten (horizon, feat) for the head
         val = None
         if validation_data is not None:
             vx, vy = self._unpack(validation_data)
             val = (vx, vy.reshape(vy.shape[0], -1))
-        hist = self.model.fit(x, y, batch_size=min(batch_size, len(x)),
-                              nb_epoch=epochs, validation_data=val,
-                              verbose=0, seed=seed)
+        if guard is not None:
+            guard.install_signal_handler()
+        try:
+            hist = self.model.fit(x, y,
+                                  batch_size=min(batch_size, len(x)),
+                                  nb_epoch=epochs, validation_data=val,
+                                  verbose=0, seed=seed)
+        finally:
+            if guard is not None:
+                guard.uninstall_signal_handler()
         self.fitted = True
         return hist
 

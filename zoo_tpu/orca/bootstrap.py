@@ -42,6 +42,7 @@ import time
 from typing import Dict, List, Optional, Sequence
 
 from zoo_tpu.obs.metrics import counter
+from zoo_tpu.orca.learn.guard import PREEMPT_EXIT_CODE
 from zoo_tpu.util.resilience import (
     HEARTBEAT_FILE_ENV,
     HEARTBEAT_INTERVAL_ENV,
@@ -49,6 +50,14 @@ from zoo_tpu.util.resilience import (
 )
 
 logger = logging.getLogger(__name__)
+
+
+class WorkersPreempted(RuntimeError):
+    """Every worker exited with :data:`PREEMPT_EXIT_CODE` — a
+    preemption-triggered coordinated checkpoint, not a crash. The
+    supervisor should relaunch at the SAME world size and let the job
+    resume from the checkpoint (``run_elastic`` does exactly that;
+    resume-don't-retry)."""
 
 _worker_restarts = counter(
     "zoo_worker_restarts_total",
@@ -202,6 +211,7 @@ class ProcessMonitor:
         self.heartbeat_boot_grace = max(heartbeat_boot_grace,
                                         heartbeat_timeout or 0.0)
         self._failed: Optional[str] = None
+        self._preempted = False
         self._stop = threading.Event()
         self._lock = threading.Lock()  # serializes respawn vs teardown
         self._thread: Optional[threading.Thread] = None
@@ -222,7 +232,11 @@ class ProcessMonitor:
         teardown path treats them exactly like a nonzero exit."""
         rc = w.returncode
         if rc is not None:
-            return None if rc == 0 else f"exited rc={rc}"
+            # PREEMPT_EXIT_CODE is a deliberate checkpoint-and-exit
+            # (training guardian, docs/fault_tolerance.md): completion,
+            # never a crash — no respawn, no restart-budget charge
+            return None if rc in (0, PREEMPT_EXIT_CODE) \
+                else f"exited rc={rc}"
         if self.heartbeat_timeout and w.heartbeat_file:
             age = heartbeat_age(w.heartbeat_file)
             try:
@@ -275,18 +289,32 @@ class ProcessMonitor:
                         for other in self.workers:
                             other.kill()
                     return
-            if all(w.returncode == 0 for w in self.workers):
+            rcs = [w.returncode for w in self.workers]
+            if all(rc is not None and rc in (0, PREEMPT_EXIT_CODE)
+                   for rc in rcs):
+                if PREEMPT_EXIT_CODE in rcs:
+                    self._preempted = True
                 self._stop.set()
                 return
             time.sleep(self.poll_interval)
 
     def wait(self, timeout: Optional[float] = None):
-        """Block until every worker exits 0; raise on fatal failure."""
+        """Block until every worker exits 0; raise on fatal failure.
+        Raises :class:`WorkersPreempted` when the group completed via a
+        coordinated preemption checkpoint (exit :data:`PREEMPT_EXIT_CODE`)
+        so the caller relaunches-and-resumes instead of scaling down."""
         deadline = time.time() + timeout if timeout is not None else None
         while True:
             if self._failed:
                 raise RuntimeError(self._failed)
-            if all(w.returncode == 0 for w in self.workers):
+            rcs = [w.returncode for w in self.workers]
+            if all(rc is not None and rc in (0, PREEMPT_EXIT_CODE)
+                   for rc in rcs):
+                if PREEMPT_EXIT_CODE in rcs:
+                    raise WorkersPreempted(
+                        f"{rcs.count(PREEMPT_EXIT_CODE)}/{len(rcs)} "
+                        "worker(s) exited via the preemption checkpoint "
+                        "protocol; relaunch and resume")
                 return
             if self._stop.is_set():
                 # the watch thread assigns _failed BEFORE setting _stop;
@@ -294,6 +322,10 @@ class ProcessMonitor:
                 # mistaken for a deliberate stop()
                 if self._failed:
                     raise RuntimeError(self._failed)
+                if self._preempted:
+                    raise WorkersPreempted(
+                        "workers exited via the preemption checkpoint "
+                        "protocol; relaunch and resume")
                 return  # deliberate stop(): termination, not failure
             if deadline is not None and time.time() > deadline:
                 self.stop()
@@ -352,9 +384,19 @@ def launch_local_cluster(nproc: int, script: str,
                           " --xla_force_host_platform_device_count="
                           f"{local_devices_per_proc}").strip(),
         })
+        # never let a worker inherit the SUPERVISOR's heartbeat file
+        # (nested launches: every child stamping the parent's file would
+        # mask a hung sibling); each worker gets its own below, or none
+        wenv.pop(HEARTBEAT_FILE_ENV, None)
         hb_file = None
         if hb_dir:
             hb_file = os.path.join(hb_dir, f"worker-{pid}.heartbeat")
+            # a stale stamp carried over from a previous elastic attempt
+            # in the same log_dir must not count as this attempt's beat
+            try:
+                os.unlink(hb_file)
+            except OSError:
+                pass
             # beat at a quarter of the timeout: three missed beats of
             # slack before a healthy-but-busy worker reads as hung
             wenv[HEARTBEAT_INTERVAL_ENV] = str(
@@ -372,7 +414,8 @@ def run_elastic(nproc: int, script: str, args: Sequence[str] = (),
                 log_dir: Optional[str] = None,
                 env: Optional[Dict[str, str]] = None,
                 wait_timeout: Optional[float] = None,
-                heartbeat_timeout: Optional[float] = None) -> int:
+                heartbeat_timeout: Optional[float] = None,
+                max_preempts: int = 100) -> int:
     """Scale-down elastic supervision (SURVEY §5.3; reference:
     ``Topology.scala:1255-1337`` retries within the job from the latest
     snapshot — this is that mechanism lifted to the supervisor, plus the
@@ -387,8 +430,14 @@ def run_elastic(nproc: int, script: str, args: Sequence[str] = (),
     (``est.load_orca_checkpoint()``), which the env var
     ``ZOO_ELASTIC_ATTEMPT`` (> "0") signals. Stops scaling at
     ``min_workers``; returns the world size that completed.
+
+    A group that exits through the training guardian's preemption
+    protocol (every worker exited :data:`PREEMPT_EXIT_CODE` after ONE
+    coordinated checkpoint) is **resumed at the same world size** —
+    preemption is the platform reclaiming a machine, not the job
+    failing — bounded by ``max_preempts`` relaunches.
     """
-    n, attempt = int(nproc), 0
+    n, attempt, preempts = int(nproc), 0, 0
     while True:
         wenv = dict(env or {})
         wenv["ZOO_ELASTIC_ATTEMPT"] = str(attempt)
@@ -400,6 +449,18 @@ def run_elastic(nproc: int, script: str, args: Sequence[str] = (),
         try:
             mon.wait(timeout=wait_timeout)
             return n
+        except WorkersPreempted as e:
+            mon.stop()
+            preempts += 1
+            if preempts > max_preempts:
+                raise RuntimeError(
+                    f"preempted {preempts} times (> max_preempts="
+                    f"{max_preempts}); giving up") from e
+            logger.warning(
+                "world size %d preempted (%s); relaunching at the same "
+                "size, resuming from the preemption checkpoint "
+                "(attempt %d)", n, e, attempt + 1)
+            attempt += 1
         except RuntimeError as e:
             mon.stop()
             if n - 1 < min_workers:

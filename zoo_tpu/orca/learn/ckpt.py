@@ -341,6 +341,21 @@ class CheckpointManager:
             return self._ckptr.restore(src, target=_ensure_host(target))
         return self._ckptr.restore(src)
 
+    def restore_with_aux(self, step: Optional[int] = None,
+                         target: Any = None):
+        """``(step, state, aux)`` from one verified snapshot — the
+        resume/rollback primitive: params and optimizer state are
+        guaranteed to come from the SAME step (``restore`` followed by a
+        separate ``restore_aux(None)`` could straddle a concurrent save).
+        ``step=None`` picks the newest verified step; raises
+        ``FileNotFoundError`` when none exists."""
+        if step is None:
+            step = self.latest_verified_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no verified checkpoints under {self.directory}")
+        return step, self.restore(step, target), self.restore_aux(step)
+
     def restore_aux(self, step: Optional[int] = None) -> Any:
         """Load the side pytree written with ``save(..., aux=...)``;
         None if the step has none. ``step=None`` follows the same

@@ -31,7 +31,9 @@ class GANEstimator:
 
     def __init__(self, generator, discriminator,
                  g_optimizer="adam", d_optimizer="adam",
-                 noise_dim: int = 64, d_steps: int = 1, g_steps: int = 1):
+                 noise_dim: int = 64, d_steps: int = 1, g_steps: int = 1,
+                 guard=None):
+        from zoo_tpu.orca.learn.guard import TrainingGuard
         from zoo_tpu.pipeline.api.keras.optimizers import get_optimizer
 
         self.g = generator
@@ -43,6 +45,15 @@ class GANEstimator:
         self.g_steps = int(g_steps)
         self._jit_step = None
         self._state = None
+        # training guardian: adversarial training is the classic NaN
+        # factory (saturated discriminators); a bad iteration folds away
+        # whole (docs/fault_tolerance.md). No checkpoint manager here,
+        # so divergence escalates straight to TrainingDiverged.
+        if guard is False:
+            self._guard = None
+        else:
+            self._guard = guard if guard is not None \
+                else TrainingGuard.from_env(name="gan")
 
     # -- the jitted adversarial iteration ---------------------------------
     def _build_step(self):
@@ -76,9 +87,16 @@ class GANEstimator:
                                     training=True, rng=None, collect=None)
             return _bce_logits(fake_logit, 1.0)  # non-saturating
 
+        guard = self._guard if (self._guard is not None
+                                and self._guard.active) else None
+
         def step(state, rng, real):
+            if guard is not None:
+                state, gstate = state
             g_tr, g_st, d_tr, d_st, g_opt, d_opt = state
+            old = state
             d_loss = g_loss = 0.0
+            d_grads = g_grads = None
             for _ in range(d_steps):
                 rng, zk = jax.random.split(rng)
                 z = jax.random.normal(zk, (real.shape[0], self.noise_dim))
@@ -93,8 +111,18 @@ class GANEstimator:
                     g_tr, g_st, d_tr, d_st, z)
                 upd, g_opt = g_tx.update(g_grads, g_opt, g_tr)
                 g_tr = optax.apply_updates(g_tr, upd)
-            return ((g_tr, g_st, d_tr, d_st, g_opt, d_opt), rng,
-                    d_loss, g_loss)
+            new = (g_tr, g_st, d_tr, d_st, g_opt, d_opt)
+            if guard is not None:
+                # one non-finite sub-loss/grad poisons the whole
+                # adversarial iteration: fold it away as a unit
+                ok = guard.grad_norm_ok(d_loss + g_loss,
+                                        (d_grads, g_grads))
+                new = guard.health_fold(ok, new, old)
+                gstate = guard.gstate_update(gstate, ok)
+                return ((new, gstate), rng,
+                        jnp.where(ok, d_loss, 0.0),
+                        jnp.where(ok, g_loss, 0.0))
+            return (new, rng, d_loss, g_loss)
 
         return jax.jit(step, donate_argnums=(0, 1))
 
@@ -125,22 +153,57 @@ class GANEstimator:
         rng = jax.random.PRNGKey(seed + 2)
         n = (len(real) // batch_size) * batch_size
         history = {"d_loss": [], "g_loss": []}
-        for epoch in range(epochs):
-            # permute the FULL set, then drop the ragged tail — different
-            # rows fall off each epoch, so no row is permanently excluded
-            perm = np.random.RandomState(seed + epoch).permutation(
-                len(real))[:n]
-            d_sum = g_sum = None
-            steps = 0
-            for lo in range(0, n, batch_size):
-                batch = jnp.asarray(real[perm[lo:lo + batch_size]])
-                self._state, rng, d_loss, g_loss = self._jit_step(
-                    self._state, rng, batch)
-                d_sum = d_loss if d_sum is None else d_sum + d_loss
-                g_sum = g_loss if g_sum is None else g_sum + g_loss
-                steps += 1
-            history["d_loss"].append(float(np.asarray(d_sum)) / steps)
-            history["g_loss"].append(float(np.asarray(g_sum)) / steps)
+        guard = self._guard if (self._guard is not None
+                                and self._guard.active) else None
+        if guard is not None:
+            guard.begin_fit()
+            guard.install_signal_handler()
+            self._state = (self._state, guard.device_init())
+        bad_seen = 0
+        try:
+            for epoch in range(epochs):
+                # permute the FULL set, then drop the ragged tail —
+                # different rows fall off each epoch, so no row is
+                # permanently excluded
+                perm = np.random.RandomState(seed + epoch).permutation(
+                    len(real))[:n]
+                d_sum = g_sum = None
+                steps = 0
+                for lo in range(0, n, batch_size):
+                    batch = jnp.asarray(real[perm[lo:lo + batch_size]])
+                    self._state, rng, d_loss, g_loss = self._jit_step(
+                        self._state, rng, batch)
+                    d_sum = d_loss if d_sum is None else d_sum + d_loss
+                    g_sum = g_loss if g_sum is None else g_sum + g_loss
+                    steps += 1
+                good = steps
+                if guard is not None:
+                    g = jax.device_get(self._state[1])
+                    act = guard.on_boundary(
+                        bad_total=int(g["bad"]), streak=int(g["streak"]),
+                        window_loss=float(np.asarray(d_sum + g_sum)),
+                        window_steps=steps,
+                        global_step=(epoch + 1) * steps, epoch=epoch)
+                    good = max(steps - (int(g["bad"]) - bad_seen), 1)
+                    bad_seen = int(g["bad"])
+                    if act == "rollback":
+                        # no checkpoint manager on the GAN path: this
+                        # raises TrainingDiverged unless the caller
+                        # bound restore/save callbacks on the guard
+                        state, _aux, _lr = guard.rollback()
+                        self._state = (state["gan_state"],
+                                       guard.device_init())
+                        bad_seen = 0
+                        continue
+                    if act == "preempt":
+                        guard.preempt_checkpoint(
+                            step=(epoch + 1) * steps)
+                history["d_loss"].append(float(np.asarray(d_sum)) / good)
+                history["g_loss"].append(float(np.asarray(g_sum)) / good)
+        finally:
+            if guard is not None:
+                guard.uninstall_signal_handler()
+                self._state = self._state[0]
         g_tr, g_st, d_tr, d_st = self._state[:4]
         self.g.params = _merge_state(g_tr, g_st)
         self.d.params = _merge_state(d_tr, d_st)

@@ -1,0 +1,621 @@
+"""Training guardian: step-level numeric health, rollback, preemption.
+
+The reference's only in-job recovery is retry-the-whole-job from the
+latest snapshot (``Topology.scala:1255-1337``); PR 1 lifted that to
+process supervision (``run_elastic``). This module handles the failure
+at the layer where it happens, with the cheap fix tried before the
+expensive one before the catastrophic one:
+
+1. **In-step health guard** — the jitted train step checks
+   ``isfinite(loss)`` and the gradient global-norm *inside* the XLA
+   computation. On a bad step params and optimizer state pass through
+   unchanged (``where``-folded — no host sync, no branch); a device-side
+   ``(bad, streak)`` counter rides the optimizer-state carry and is read
+   only at superbatch boundaries. Offending windows are quarantined to a
+   JSONL journal plus obs counters.
+2. **Divergence rollback** — ``max_skips`` consecutive skipped steps, or
+   a window loss beyond ``spike_factor``× the rolling-window median,
+   restores the last verified :class:`CheckpointManager` step (optional
+   LR backoff on resume), bounded by ``rollback_budget`` before raising
+   :class:`TrainingDiverged`.
+3. **Preemption-safe exit** — SIGTERM (or the ``$ZOO_PREEMPT`` signal;
+   the TPU maintenance-event notice) requests checkpoint-and-exit at the
+   next step boundary, coordinated across hosts over the JAX
+   coordination-service KV store so every process stops at the SAME
+   global step; the process exits :data:`PREEMPT_EXIT_CODE` (75,
+   EX_TEMPFAIL), which ``run_elastic`` treats as resume-don't-retry.
+
+This module must import WITHOUT jax (``scripts/check_guard.py`` drives
+the escalation ladder jax-free); everything device-side imports jax
+lazily.
+
+Knobs (all overridable per-instance via :class:`GuardConfig`):
+
+=============================  =============================================
+``ZOO_GUARD``                  "0" disables the guard estimators attach
+``ZOO_GUARD_MAX_SKIPS``        consecutive skipped steps before rollback (8)
+``ZOO_GUARD_SPIKE_FACTOR``     window-loss spike multiple vs rolling median
+                               triggering rollback (10.0)
+``ZOO_GUARD_WINDOW``           rolling-loss window length in boundaries (32)
+``ZOO_GUARD_MIN_WINDOW``       boundaries before spike detection arms (5)
+``ZOO_GUARD_ROLLBACK_BUDGET``  rollbacks before TrainingDiverged (3)
+``ZOO_GUARD_LR_BACKOFF``       LR multiplier applied on rollback resume (0.5)
+``ZOO_GUARD_CHECK_EVERY``      read the device counter every N boundaries (1)
+``ZOO_GUARD_MAX_GNORM``        optional hard gradient-norm ceiling (off)
+``ZOO_GUARD_QUARANTINE``       JSONL journal path (default
+                               <model_dir>/guard/quarantine.jsonl)
+``ZOO_PREEMPT``                preemption signal name ("SIGTERM"; "0"/"none"
+                               disables the handler)
+=============================  =============================================
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal as _signal
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from zoo_tpu.obs.metrics import counter, gauge
+
+logger = logging.getLogger(__name__)
+
+#: Exit code of a preemption-triggered graceful exit (EX_TEMPFAIL).
+#: ``ProcessMonitor``/``run_elastic`` treat it as "checkpointed, relaunch
+#: me at the same world size and resume" — never as a crash.
+PREEMPT_EXIT_CODE = 75
+
+_nonfinite_steps = counter(
+    "zoo_guard_nonfinite_steps_total",
+    "Training steps skipped by the in-step health guard (non-finite loss "
+    "or gradient norm; params/opt state passed through unchanged)")
+_rollbacks = counter(
+    "zoo_guard_rollbacks_total",
+    "Divergence rollbacks: restores from the last verified checkpoint "
+    "triggered by skip streaks or loss spikes")
+_preempt_ckpts = counter(
+    "zoo_guard_preempt_checkpoints_total",
+    "Coordinated checkpoint-and-exit sequences completed after a "
+    "preemption signal")
+_diverged = counter(
+    "zoo_guard_diverged_total",
+    "Fits abandoned with TrainingDiverged (rollback budget exhausted or "
+    "no checkpoint to restore)")
+_rolling_loss = gauge(
+    "zoo_guard_rolling_loss",
+    "Mean per-step training loss over the guard's most recent boundary "
+    "window (skipped steps excluded)")
+
+
+class TrainingDiverged(RuntimeError):
+    """The guard's escalation ladder is exhausted: skip didn't help,
+    the rollback budget is spent (or there is nothing to restore), and
+    the loss is still not trainable."""
+
+
+class Preempted(SystemExit):
+    """Raised after a preemption-triggered checkpoint. Subclasses
+    ``SystemExit`` with :data:`PREEMPT_EXIT_CODE`, so a worker script
+    needs no handling at all — letting it propagate exits the process
+    with the code ``run_elastic`` recognizes as resume-don't-retry.
+    ``except Exception`` retry perimeters never swallow it."""
+
+    def __init__(self, step: int):
+        super().__init__(PREEMPT_EXIT_CODE)
+        self.step = int(step)
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        logger.warning("bad %s=%r; using %s", name, os.environ.get(name),
+                       default)
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    return int(_env_float(name, default))
+
+
+class GuardConfig:
+    """Escalation-ladder knobs; every field defaults from ``ZOO_GUARD_*``
+    env so supervised workers configure through their launcher."""
+
+    def __init__(self, enabled: Optional[bool] = None,
+                 max_skips: Optional[int] = None,
+                 spike_factor: Optional[float] = None,
+                 window: Optional[int] = None,
+                 min_window: Optional[int] = None,
+                 rollback_budget: Optional[int] = None,
+                 lr_backoff: Optional[float] = None,
+                 check_every: Optional[int] = None,
+                 max_grad_norm: Optional[float] = None,
+                 preempt_signal: Optional[str] = None):
+        self.enabled = (os.environ.get("ZOO_GUARD", "1") != "0"
+                        if enabled is None else bool(enabled))
+        self.max_skips = (_env_int("ZOO_GUARD_MAX_SKIPS", 8)
+                          if max_skips is None else int(max_skips))
+        self.spike_factor = (_env_float("ZOO_GUARD_SPIKE_FACTOR", 10.0)
+                             if spike_factor is None
+                             else float(spike_factor))
+        self.window = (_env_int("ZOO_GUARD_WINDOW", 32)
+                       if window is None else int(window))
+        self.min_window = (_env_int("ZOO_GUARD_MIN_WINDOW", 5)
+                           if min_window is None else int(min_window))
+        self.rollback_budget = (_env_int("ZOO_GUARD_ROLLBACK_BUDGET", 3)
+                                if rollback_budget is None
+                                else int(rollback_budget))
+        self.lr_backoff = (_env_float("ZOO_GUARD_LR_BACKOFF", 0.5)
+                           if lr_backoff is None else float(lr_backoff))
+        self.check_every = max(1, _env_int("ZOO_GUARD_CHECK_EVERY", 1)
+                               if check_every is None
+                               else int(check_every))
+        env_gn = os.environ.get("ZOO_GUARD_MAX_GNORM")
+        self.max_grad_norm = (float(env_gn) if env_gn and
+                              max_grad_norm is None
+                              else max_grad_norm)
+        sig = (os.environ.get("ZOO_PREEMPT", "SIGTERM")
+               if preempt_signal is None else preempt_signal)
+        self.preempt_signal = None if str(sig).lower() in (
+            "", "0", "none", "off") else str(sig)
+
+
+def _world() -> Tuple[int, int]:
+    """(process_count, process_index); (1, 0) when jax is not already
+    loaded (no jax ⇒ no cluster) or uninitialized. Reads
+    ``sys.modules`` instead of importing so the jax-free script path
+    stays jax-free."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return 1, 0
+    try:
+        return jax.process_count(), jax.process_index()
+    except Exception:
+        return 1, 0
+
+
+def _kv_client():
+    try:
+        from zoo_tpu.obs.coordination import coordination_client
+        return coordination_client()
+    except Exception:
+        return None
+
+
+class TrainingGuard:
+    """Host-side controller of the three guard layers.
+
+    The fit loop owns the device state (a ``{"bad", "streak"}`` int32
+    pair created by :meth:`device_init`, updated inside the jitted step
+    by the topology/graph/gan step builders) and calls
+    :meth:`on_boundary` at superbatch boundaries with its host-read
+    values. The guard decides ``None`` (keep going), ``"rollback"``
+    (call :meth:`rollback`, splice the returned state in), or
+    ``"preempt"`` (call :meth:`preempt_checkpoint`, which saves and
+    raises :class:`Preempted`).
+
+    ``save_fn``/``restore_fn`` come from the owning estimator:
+    ``save_fn()`` snapshots its current train state through its
+    :class:`CheckpointManager`; ``restore_fn()`` returns
+    ``(state_dict, aux)`` from the last verified step. Either may be
+    None (no ``model_dir``): layers 1 and 3 still work; layer 2 then
+    escalates straight to :class:`TrainingDiverged`.
+
+    Multi-process decisions need no message exchange: the step math is
+    SPMD-identical on every process, so bad counters, streaks, and
+    window losses agree bit-for-bit and every rank reaches the same
+    verdict at the same boundary. Only preemption (which starts from a
+    single-host signal) coordinates over the KV store.
+    """
+
+    _seq = 0  # per-process fit counter; advances in SPMD lockstep
+
+    def __init__(self, config: Optional[GuardConfig] = None,
+                 save_fn: Optional[Callable[[], None]] = None,
+                 restore_fn: Optional[Callable[[], Tuple[Any, Any]]] = None,
+                 quarantine_path: Optional[str] = None,
+                 name: str = "fit"):
+        self.config = config or GuardConfig()
+        self.save_fn = save_fn
+        self.restore_fn = restore_fn
+        self.quarantine_path = quarantine_path or \
+            os.environ.get("ZOO_GUARD_QUARANTINE")
+        self.name = name
+        # host-visible tallies (tests/scripts read these);
+        # nonfinite_steps is CUMULATIVE across fits — the device counter
+        # restarts at zero each fit/rollback, tracked by _bad_seen
+        self.nonfinite_steps = 0
+        self._bad_seen = 0
+        self.rollbacks = 0
+        self.preempt_checkpoints = 0
+        self._window: deque = deque(maxlen=max(2, self.config.window))
+        self._lock = threading.Lock()
+        # preemption machinery
+        self._preempt_flag = threading.Event()
+        self._prev_handler = None
+        self._installed_signum = None
+        self._install_depth = 0
+        self._kv_prefix: Optional[str] = None
+        self._preempt_published = False
+        self._preempt_acked = False
+        self._preempt_target: Optional[int] = None
+        self._all_can_restore: Optional[bool] = None
+        self._boundary_calls = 0
+
+    # -- wiring ------------------------------------------------------------
+    @classmethod
+    def from_env(cls, **kwargs) -> Optional["TrainingGuard"]:
+        """A guard configured from ``ZOO_GUARD_*``, or None when
+        ``ZOO_GUARD=0`` — estimators attach this by default."""
+        cfg = kwargs.pop("config", None) or GuardConfig()
+        if not cfg.enabled:
+            return None
+        return cls(config=cfg, **kwargs)
+
+    def bind(self, save_fn=None, restore_fn=None, quarantine_path=None):
+        """(Re)attach the checkpoint callbacks — estimators that build
+        their CheckpointManager lazily (pytorch) rebind here."""
+        if save_fn is not None:
+            self.save_fn = save_fn
+        if restore_fn is not None:
+            self.restore_fn = restore_fn
+        if quarantine_path is not None and self.quarantine_path is None:
+            self.quarantine_path = quarantine_path
+        return self
+
+    @property
+    def active(self) -> bool:
+        return self.config.enabled
+
+    @property
+    def preempt_requested(self) -> bool:
+        return self._preempt_flag.is_set()
+
+    # -- device-side pieces (lazy jax) ------------------------------------
+    def device_init(self):
+        """Fresh ``{"bad", "streak"}`` int32 counters for the optimizer-
+        state carry."""
+        import jax.numpy as jnp
+        return {"bad": jnp.zeros((), jnp.int32),
+                "streak": jnp.zeros((), jnp.int32)}
+
+    def health_fold(self, ok, new_tree, old_tree):
+        """``where``-fold two identically-structured pytrees on the
+        scalar predicate ``ok`` — the no-host-sync skip primitive. Used
+        inside jitted steps only."""
+        import jax
+        import jax.numpy as jnp
+        return jax.tree_util.tree_map(
+            lambda n, o: jnp.where(ok, n, o), new_tree, old_tree)
+
+    def gstate_update(self, gstate, ok):
+        """Advance the device counter pair for one step."""
+        import jax.numpy as jnp
+        bad = (~ok).astype(jnp.int32)
+        return {"bad": gstate["bad"] + bad,
+                "streak": jnp.where(ok, 0, gstate["streak"] + 1)}
+
+    def grad_norm_ok(self, loss, grads):
+        """The in-step health predicate: finite loss AND finite gradient
+        global-norm (AND under the optional hard ceiling)."""
+        import jax
+        import jax.numpy as jnp
+        gnorm_sq = sum(
+            jnp.sum(jnp.square(g.astype(jnp.float32)))
+            for g in jax.tree_util.tree_leaves(grads)) \
+            if jax.tree_util.tree_leaves(grads) else jnp.zeros(())
+        ok = jnp.isfinite(loss) & jnp.isfinite(gnorm_sq)
+        if self.config.max_grad_norm:
+            ok = ok & (gnorm_sq <= self.config.max_grad_norm ** 2)
+        return ok
+
+    # -- fit lifecycle ----------------------------------------------------
+    def begin_fit(self):
+        """Called by the fit loop before the first step: (multi-process)
+        allocates this fit's KV namespace and exchanges restore
+        capability. Signal-handler install is the guard OWNER's job
+        (estimator/forecaster fit entry, via
+        :meth:`install_signal_handler`) — once per outer fit, not once
+        per epoch.
+
+        Preemption state deliberately survives across fits: the request
+        rides a JOB-global KV namespace (ranks drift in wall time, so a
+        rank one epoch ahead must still see a request published from an
+        earlier fit; global step counts stay monotonic and comparable),
+        and the whole job exits once it is honored."""
+        self._boundary_calls = 0
+        self._bad_seen = 0  # fresh device counters accompany each fit
+        pc, pid = _world()
+        TrainingGuard._seq += 1
+        self._kv_prefix = f"zoo/guard/{TrainingGuard._seq}/"
+        if pc > 1:
+            client = _kv_client()
+            if client is not None:
+                try:
+                    client.key_value_set(
+                        f"{self._kv_prefix}cap/{pid}",
+                        "1" if self.restore_fn else "0")
+                    caps = [client.blocking_key_value_get(
+                        f"{self._kv_prefix}cap/{p}", 30_000)
+                        for p in range(pc)]
+                    self._all_can_restore = all(c == "1" for c in caps)
+                except Exception as e:  # degraded: act alone
+                    logger.warning("guard capability exchange failed "
+                                   "(%s); rollback decisions fall back "
+                                   "to local capability", e)
+                    self._all_can_restore = None
+
+    def end_fit(self):
+        self.uninstall_signal_handler()
+
+    # -- signal handling ---------------------------------------------------
+    def _signum(self) -> Optional[int]:
+        name = self.config.preempt_signal
+        if not name:
+            return None
+        if name.isdigit():
+            return int(name)
+        return getattr(_signal, name if name.startswith("SIG")
+                       else "SIG" + name, None)
+
+    def install_signal_handler(self):
+        """Idempotent (depth-counted); silently skipped off the main
+        thread — a concurrent-AutoML trial fit must not fight over
+        process signals."""
+        signum = self._signum()
+        if signum is None:
+            return
+        self._install_depth += 1
+        if self._install_depth > 1:
+            return
+        try:
+            self._prev_handler = _signal.signal(
+                signum, lambda s, f: self.request_preempt())
+            self._installed_signum = signum
+        except ValueError:  # not the main thread
+            self._prev_handler = None
+            self._installed_signum = None
+
+    def uninstall_signal_handler(self):
+        self._install_depth = max(0, self._install_depth - 1)
+        if self._install_depth == 0 and self._installed_signum is not None:
+            try:
+                _signal.signal(self._installed_signum,
+                               self._prev_handler or _signal.SIG_DFL)
+            except ValueError:
+                pass
+            self._installed_signum = None
+
+    def request_preempt(self):
+        """Ask for checkpoint-and-exit at the next step boundary (the
+        signal handler's body; tests call it directly)."""
+        if not self._preempt_flag.is_set():
+            logger.warning(
+                "%s: preemption requested — checkpoint-and-exit at the "
+                "next step boundary", self.name)
+        self._preempt_flag.set()
+
+    # -- the boundary decision --------------------------------------------
+    def on_boundary(self, bad_total: int, streak: int,
+                    window_loss: float, window_steps: int,
+                    global_step: int, epoch: int = 0,
+                    batch_hint: Optional[Tuple[int, int]] = None
+                    ) -> Optional[str]:
+        """One superbatch boundary. ``bad_total``/``streak`` are the
+        host-read device counters; ``window_loss`` is the (sanitized —
+        skipped steps contribute 0) loss sum since the previous boundary
+        over ``window_steps`` steps. Returns None, ``"rollback"`` or
+        ``"preempt"``."""
+        self._boundary_calls += 1
+        # bad_total restarts at zero each fit/rollback (fresh device
+        # counters); _bad_seen is the per-incarnation baseline, while
+        # nonfinite_steps accumulates across the guard's whole life
+        delta = bad_total - self._bad_seen
+        self._bad_seen = bad_total
+        if delta > 0:
+            _nonfinite_steps.inc(delta)
+            self.nonfinite_steps += delta
+            self._journal({
+                "event": "nonfinite_steps", "epoch": int(epoch),
+                "global_step": int(global_step), "bad_in_window": delta,
+                "bad_total": self.nonfinite_steps, "streak": int(streak),
+                "batch_lo": None if batch_hint is None
+                else int(batch_hint[0]),
+                "batch_hi": None if batch_hint is None
+                else int(batch_hint[1]),
+            })
+            logger.warning(
+                "%s: skipped %d non-finite step(s) in the last window "
+                "(total %d, streak %d) at step %d", self.name, delta,
+                self.nonfinite_steps, streak, global_step)
+        good = window_steps - delta
+        mean = None
+        if good > 0:
+            mean = window_loss / good
+            _rolling_loss.set(mean)
+        spike = (mean is not None and len(self._window) >=
+                 self.config.min_window and
+                 mean > self.config.spike_factor *
+                 max(self._rolling_median(), 1e-12))
+        if mean is not None and not spike:
+            self._window.append(mean)
+        if self._preempt_step(global_step):
+            return "preempt"
+        if streak >= self.config.max_skips:
+            logger.error(
+                "%s: %d consecutive steps skipped (>= max_skips=%d) — "
+                "escalating to rollback", self.name, streak,
+                self.config.max_skips)
+            return "rollback"
+        if spike:
+            logger.error(
+                "%s: window loss %.6g spiked beyond %gx the rolling "
+                "median %.6g — escalating to rollback", self.name, mean,
+                self.config.spike_factor, self._rolling_median())
+            return "rollback"
+        return None
+
+    def _rolling_median(self) -> float:
+        vals = sorted(self._window)
+        if not vals:
+            return 0.0
+        mid = len(vals) // 2
+        return vals[mid] if len(vals) % 2 else \
+            0.5 * (vals[mid - 1] + vals[mid])
+
+    # -- layer 2: rollback -------------------------------------------------
+    def rollback(self) -> Tuple[Any, Any, float]:
+        """Restore the last verified snapshot. Returns ``(state, aux,
+        lr_scale)``; raises :class:`TrainingDiverged` when the budget is
+        spent or no process can restore (the capability is exchanged at
+        ``begin_fit`` so every SPMD rank takes the same branch)."""
+        can = self.restore_fn is not None if self._all_can_restore is None \
+            else self._all_can_restore
+        if not can or self.rollbacks >= self.config.rollback_budget:
+            _diverged.inc()
+            self._journal({"event": "diverged",
+                           "rollbacks": self.rollbacks,
+                           "budget": self.config.rollback_budget,
+                           "restorable": bool(can)})
+            raise TrainingDiverged(
+                f"{self.name}: training diverged and the guard is out of "
+                f"options (rollbacks {self.rollbacks}/"
+                f"{self.config.rollback_budget}, "
+                f"restore {'un' if not can else ''}available)")
+        try:
+            state, aux = self.restore_fn()
+        except Exception as e:  # noqa: BLE001 — no snapshot ≡ no ladder
+            _diverged.inc()
+            self._journal({"event": "diverged", "restore_error": repr(e)})
+            raise TrainingDiverged(
+                f"{self.name}: rollback restore failed ({e!r})") from e
+        self.rollbacks += 1
+        _rollbacks.inc()
+        lr_scale = self.config.lr_backoff if self.config.lr_backoff \
+            and self.config.lr_backoff != 1.0 else 1.0
+        self._window.clear()
+        self._bad_seen = 0  # fresh device counters follow the restore
+        self._journal({"event": "rollback", "n": self.rollbacks,
+                       "lr_scale": lr_scale,
+                       "restored_step": state.get("epoch")
+                       if isinstance(state, dict) else None})
+        logger.warning(
+            "%s: rollback %d/%d restored last verified checkpoint "
+            "(lr x%g on resume)", self.name, self.rollbacks,
+            self.config.rollback_budget, lr_scale)
+        return state, aux, lr_scale
+
+    # -- layer 3: preemption ----------------------------------------------
+    def _preempt_step(self, global_step: int) -> bool:
+        """Advance the cross-host agreement; True once THIS boundary is
+        the agreed checkpoint step."""
+        pc, pid = _world()
+        client = _kv_client() if pc > 1 else None
+        if pc > 1 and client is not None:
+            # job-global namespace (NOT per-fit): the KV store dies with
+            # the coordinator, and a preempted job exits — stale keys
+            # cannot leak into the relaunched attempt's fresh store
+            p = "zoo/guard/preempt/"
+            if self._preempt_flag.is_set() and not self._preempt_published:
+                try:
+                    client.key_value_set(f"{p}req", "1")
+                except Exception:
+                    pass  # a re-set from another rank races: fine
+                self._preempt_published = True
+            if not self._preempt_flag.is_set():
+                # cheap poll: has any other rank requested?
+                try:
+                    client.blocking_key_value_get(f"{p}req", 1)
+                    self._preempt_flag.set()
+                except Exception:
+                    return False
+            if not self._preempt_acked:
+                try:
+                    client.key_value_set(f"{p}ack/{pid}",
+                                         str(int(global_step)))
+                except Exception:
+                    pass
+                self._preempt_acked = True
+            if self._preempt_target is None:
+                try:
+                    if pid == 0:
+                        acks = [int(client.blocking_key_value_get(
+                            f"{p}ack/{q}", 60_000)) for q in range(pc)]
+                        self._preempt_target = max(acks)
+                        client.key_value_set(f"{p}target",
+                                             str(self._preempt_target))
+                    else:
+                        self._preempt_target = int(
+                            client.blocking_key_value_get(
+                                f"{p}target", 60_000))
+                except Exception as e:
+                    logger.warning(
+                        "preempt-step agreement failed (%s); falling "
+                        "back to an uncoordinated local checkpoint", e)
+                    self._preempt_target = int(global_step)
+            return global_step >= self._preempt_target
+        return self._preempt_flag.is_set()
+
+    def preempt_checkpoint(self, save_cb: Optional[Callable[[], None]]
+                           = None, step: int = 0):
+        """Checkpoint once (rank 0, or whoever holds a ``save_fn``),
+        publish completion over the KV store so no rank exits before the
+        snapshot is committed, then raise :class:`Preempted`."""
+        pc, pid = _world()
+        saver = save_cb or self.save_fn
+        saved = False
+        if saver is not None:
+            saver()
+            saved = True
+        elif pid == 0:
+            logger.warning(
+                "%s: preempted with no checkpoint callback configured — "
+                "exiting without a fresh snapshot (resume falls back to "
+                "the previous one)", self.name)
+        if pc > 1:
+            client = _kv_client()
+            if client is not None:
+                p = "zoo/guard/preempt/"
+                try:
+                    if pid == 0:
+                        client.key_value_set(f"{p}done", "1")
+                    else:
+                        client.blocking_key_value_get(f"{p}done", 120_000)
+                except Exception as e:
+                    logger.warning("preempt done-barrier failed (%s); "
+                                   "exiting anyway", e)
+        if saved:
+            self.preempt_checkpoints += 1
+            _preempt_ckpts.inc()
+        self._journal({"event": "preempt_checkpoint", "step": int(step),
+                       "saved": saved, "rank": pid})
+        logger.warning(
+            "%s: preemption checkpoint at step %d complete; exiting "
+            "with code %d (resume-don't-retry)", self.name, step,
+            PREEMPT_EXIT_CODE)
+        raise Preempted(step)
+
+    # -- journal -----------------------------------------------------------
+    def _journal(self, record: Dict):
+        """Append one event to the quarantine/transition JSONL. Never
+        raises — a journal failure must not take training down with it
+        (numpy scalars from restored checkpoints coerce via default=)."""
+        path = self.quarantine_path
+        if not path:
+            return
+        record = {"ts": time.time(), "guard": self.name, **record}
+        try:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            with self._lock, open(path, "a") as f:
+                f.write(json.dumps(
+                    record,
+                    default=lambda o: o.item()
+                    if hasattr(o, "item") else repr(o)) + "\n")
+        except Exception as e:  # noqa: BLE001 — best-effort forensics
+            logger.debug("guard journal write failed: %s", e)

@@ -21,6 +21,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from zoo_tpu.orca.learn.ckpt import CheckpointManager
+from zoo_tpu.orca.learn.guard import TrainingDiverged, TrainingGuard
 from zoo_tpu.orca.learn.trigger import EveryEpoch, Trigger
 
 
@@ -29,11 +30,19 @@ class Estimator:
 
     @staticmethod
     def from_keras(model, model_dir: Optional[str] = None,
-                   max_ckpt_to_keep: int = 5) -> "KerasEstimator":
+                   max_ckpt_to_keep: int = 5,
+                   guard=None) -> "KerasEstimator":
         """Wrap a compiled Keras-facade model (reference:
-        ``orca/learn/bigdl/estimator.py:72`` ``Estimator.from_bigdl``)."""
+        ``orca/learn/bigdl/estimator.py:72`` ``Estimator.from_bigdl``).
+
+        ``guard``: a :class:`zoo_tpu.orca.learn.guard.TrainingGuard` (or
+        False to disable). Default: one configured from ``ZOO_GUARD_*``
+        env — the in-step numeric-health guard, divergence rollback and
+        preemption-safe checkpointing described in
+        docs/fault_tolerance.md."""
         return KerasEstimator(model, model_dir=model_dir,
-                              max_ckpt_to_keep=max_ckpt_to_keep)
+                              max_ckpt_to_keep=max_ckpt_to_keep,
+                              guard=guard)
 
     @staticmethod
     def from_bigdl(*, model, loss=None, optimizer=None, metrics=None,
@@ -55,7 +64,7 @@ class Estimator:
 
 class KerasEstimator:
     def __init__(self, model, model_dir: Optional[str] = None,
-                 max_ckpt_to_keep: int = 5):
+                 max_ckpt_to_keep: int = 5, guard=None):
         self.model = model
         self.model_dir = model_dir
         self._epoch = 0
@@ -65,6 +74,33 @@ class KerasEstimator:
                 os.path.join(model_dir, "ckpts"),
                 max_to_keep=max_ckpt_to_keep)
             self.model.set_tensorboard(model_dir, "summaries")
+        # training guardian (docs/fault_tolerance.md): in-step NaN/inf
+        # skip, divergence rollback from the last verified checkpoint,
+        # preemption-safe checkpoint-and-exit. On by default; pass
+        # guard=False or set ZOO_GUARD=0 to run unguarded.
+        if guard is False:
+            self._guard = None
+        elif guard is not None:
+            self._guard = guard
+        else:
+            self._guard = TrainingGuard.from_env()
+        self._bind_guard()
+
+    def _bind_guard(self):
+        """(Re)wire the guard's checkpoint callbacks to the current
+        CheckpointManager; called again by estimators that build their
+        manager lazily (pytorch)."""
+        if self._guard is None:
+            return
+        if self._ckpt is not None:
+            self._guard.bind(
+                save_fn=self._save_checkpoint,
+                restore_fn=lambda: self._ckpt.restore_with_aux(None)[1:],
+                quarantine_path=os.path.join(
+                    self.model_dir, "guard", "quarantine.jsonl")
+                if self.model_dir else None)
+        if self.model is not None:
+            self.model.set_guard(self._guard)
 
     # -- training ---------------------------------------------------------
     def fit(self, data, epochs: int = 1, batch_size: int = 32,
@@ -88,18 +124,35 @@ class KerasEstimator:
         ``retryTimeInterval`` sysprops, defaults 5 / 120s). Without a
         checkpoint manager there is nothing to restore, so failures
         propagate immediately."""
-        import logging
-        import time as _time
-
         if checkpoint_trigger is None and self._ckpt is not None:
             checkpoint_trigger = EveryEpoch()
-        history: Dict[str, List[float]] = {}
-        retries, no_progress, last_failure = 0, 0, 0.0
         if self._ckpt is not None and self._ckpt.latest_step() is None \
                 and self.model.params is not None:
             # snapshot the starting point so a first-epoch failure has
             # somewhere to restore to
             self._save_checkpoint()
+        if self._guard is not None:
+            # the preemption signal (SIGTERM / $ZOO_PREEMPT) is owned for
+            # the whole fit, including the gaps between epochs; the guard
+            # acts on it at the next step boundary
+            self._guard.install_signal_handler()
+        try:
+            return self._fit_epochs(
+                data, epochs, batch_size, feature_cols, label_cols,
+                validation_data, checkpoint_trigger, shuffle,
+                max_failure_retries, retry_time_interval)
+        finally:
+            if self._guard is not None:
+                self._guard.uninstall_signal_handler()
+
+    def _fit_epochs(self, data, epochs, batch_size, feature_cols,
+                    label_cols, validation_data, checkpoint_trigger,
+                    shuffle, max_failure_retries, retry_time_interval):
+        import logging
+        import time as _time
+
+        history: Dict[str, List[float]] = {}
+        retries, no_progress, last_failure = 0, 0, 0.0
         # train until the epoch counter reaches target — a rollback lowers
         # the counter, so lost epochs are retrained (reference endWhen)
         start_epoch = self._epoch
@@ -111,6 +164,10 @@ class KerasEstimator:
                     validation_data=validation_data,
                     feature_cols=feature_cols, label_cols=label_cols,
                     shuffle=shuffle, seed=self._epoch, verbose=0)
+            except TrainingDiverged:
+                # the guard already exhausted its in-fit rollback budget;
+                # retrying from the same snapshot would diverge again
+                raise
             except Exception as e:  # noqa: BLE001 — the retry perimeter
                 now = _time.monotonic()
                 if now - last_failure > retry_time_interval:
@@ -163,10 +220,11 @@ class KerasEstimator:
     def _restore_latest(self):
         """Reload the newest snapshot: params, optimizer state, epoch
         counter — the reference's retry loop reloads ``model.N`` +
-        ``optimMethod-*.N`` the same way."""
-        state = self._ckpt.restore(None)
+        ``optimMethod-*.N`` the same way. ``restore_with_aux`` pins both
+        pytrees to ONE verified step."""
+        _, state, aux = self._ckpt.restore_with_aux(None)
         self.model.params = state["params"]
-        self.model._opt_state = self._ckpt.restore_aux(None)
+        self.model._opt_state = aux
         self._epoch = int(state.get("epoch", 0))
 
     def load_orca_checkpoint(self, path: Optional[str] = None,

@@ -98,7 +98,8 @@ class Estimator:
                    config: Optional[dict] = None,
                    metrics=None, model_dir: Optional[str] = None,
                    backend: str = "tpu",
-                   dtype_policy: str = "float32") -> "PyTorchEstimator":
+                   dtype_policy: str = "float32",
+                   guard=None) -> "PyTorchEstimator":
         """reference signature: ``Estimator.from_torch(model=..., optimizer,
         loss, model_creator, ...)`` (``pytorch/estimator.py:33``). Either
         pass instances or the reference's creator functions (called with
@@ -115,7 +116,7 @@ class Estimator:
                 else loss_creator
         return PyTorchEstimator(model, optimizer, loss, metrics=metrics,
                                 model_dir=model_dir,
-                                dtype_policy=dtype_policy)
+                                dtype_policy=dtype_policy, guard=guard)
 
 
 class PyTorchEstimator(KerasEstimator):
@@ -124,7 +125,7 @@ class PyTorchEstimator(KerasEstimator):
 
     def __init__(self, torch_model, optimizer, loss, metrics=None,
                  model_dir: Optional[str] = None,
-                 dtype_policy: str = "float32"):
+                 dtype_policy: str = "float32", guard=None):
         self.torch_model = torch_model
         self._optimizer_arg = _convert_optimizer(optimizer)
         self._loss_arg = _convert_loss(loss)
@@ -132,7 +133,7 @@ class PyTorchEstimator(KerasEstimator):
         self._model_dir_arg = model_dir
         self._dtype_policy = dtype_policy
         self._converted = False
-        super().__init__(model=None, model_dir=None)
+        super().__init__(model=None, model_dir=None, guard=guard)
         self.model_dir = model_dir
 
     def _ensure_converted(self, xs):
@@ -153,6 +154,10 @@ class PyTorchEstimator(KerasEstimator):
             self._ckpt = CheckpointManager(
                 os.path.join(self._model_dir_arg, "ckpts"))
             self.model.set_tensorboard(self._model_dir_arg, "summaries")
+        # the manager and the converted model exist only now: rewire the
+        # training guardian's checkpoint callbacks and attach it to the
+        # freshly built KerasNet
+        self._bind_guard()
         self._converted = True
 
     def _normalize(self, data, feature_cols, label_cols):

@@ -113,7 +113,7 @@ class Estimator:
     def from_graph(*, inputs=None, outputs=None, labels=None, loss=None,
                    optimizer=None, metrics=None, clip_norm=None,
                    clip_value=None, updates=None, sess=None,
-                   model_dir=None, backend="bigdl", **_):
+                   model_dir=None, backend="bigdl", guard=None, **_):
         """reference ``orca/learn/tf/estimator.py:291`` — train a
         user-built TF1 graph (placeholder inputs/labels + scalar loss
         tensor). The reference drives the session graph on the JVM
@@ -131,7 +131,8 @@ class Estimator:
                                 optimizer=optimizer, metrics=metrics,
                                 clip_norm=clip_norm,
                                 clip_value=clip_value, updates=updates,
-                                sess=sess, model_dir=model_dir)
+                                sess=sess, model_dir=model_dir,
+                                guard=guard)
 
     @staticmethod
     def from_keras(*, model_creator: Callable,
@@ -139,19 +140,24 @@ class Estimator:
                    model_dir: Optional[str] = None,
                    backend: str = "tpu",
                    workers_per_node: int = 1,
-                   compile_args: Optional[dict] = None) -> "TF2Estimator":
+                   compile_args: Optional[dict] = None,
+                   guard=None) -> "TF2Estimator":
         """reference signature: ``Estimator.from_keras(model_creator=...,
         config=..., workers_per_node=..., backend="tf2")``
-        (``tf2/estimator.py:38``)."""
+        (``tf2/estimator.py:38``).
+
+        ``guard``: training guardian override (``TrainingGuard`` instance
+        or False); defaults to the env-configured guard — see
+        docs/fault_tolerance.md."""
         return TF2Estimator(model_creator, config=config,
                             model_dir=model_dir,
-                            compile_args=compile_args)
+                            compile_args=compile_args, guard=guard)
 
 
 class TF2Estimator(KerasEstimator):
     def __init__(self, model_creator: Callable, config: Optional[dict],
                  model_dir: Optional[str] = None,
-                 compile_args: Optional[dict] = None):
+                 compile_args: Optional[dict] = None, guard=None):
         self.config = dict(config or {})
         kmodel = model_creator(self.config)
         self._kmodel = kmodel
@@ -166,7 +172,7 @@ class TF2Estimator(KerasEstimator):
             loss=ca.get("loss",
                         _convert_loss(getattr(kmodel, "loss", None))),
             metrics=ca.get("metrics", _convert_metrics(kmodel)))
-        super().__init__(zmodel, model_dir=model_dir)
+        super().__init__(zmodel, model_dir=model_dir, guard=guard)
 
     # -- data adapters -----------------------------------------------------
     def _materialize(self, data, batch_size):

@@ -96,12 +96,13 @@ class GraphTrainer:
 
     def __init__(self, trainable, optimizer=None,
                  clip_norm: Optional[float] = None,
-                 clip_value=None):
+                 clip_value=None, guard=None):
         import optax
 
         from zoo_tpu.pipeline.api.keras.optimizers import get_optimizer
 
         self.t = trainable
+        self.guard = guard  # TrainingGuard (orca/learn/guard.py)
         tx = get_optimizer(_resolve_optimizer(optimizer)).make()
         chain = []
         if clip_norm is not None:
@@ -162,20 +163,38 @@ class GraphTrainer:
             else replicated_sharding(mesh)) for a in arrs]
 
     # -- jitted programs --------------------------------------------------
+    def _active_guard(self):
+        g = self.guard
+        return g if g is not None and g.active else None
+
     def _build_step(self):
         import optax
 
         n_in = len(self.t.input_names)
+        guard = self._active_guard()
 
         def step(params, opt_state, *data):
+            if guard is not None:
+                opt_state, gstate = opt_state
             inputs, labels = data[:n_in], data[n_in:]
 
             def lf(p):
                 return self.t.loss_fn(p, inputs, labels)
 
             loss, grads = jax.value_and_grad(lf)(params)
+            old_params, old_opt = params, opt_state
             upd, opt_state = self.tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, upd)
+            if guard is not None:
+                # in-step health guard, same contract as topology's fit
+                # step: a non-finite loss/grad-norm folds the whole
+                # update away; the counter pair rides the opt carry
+                ok = guard.grad_norm_ok(loss, grads)
+                params = guard.health_fold(ok, params, old_params)
+                opt_state = guard.health_fold(ok, opt_state, old_opt)
+                return (params,
+                        (opt_state, guard.gstate_update(gstate, ok)),
+                        jnp.where(ok, loss, 0.0))
             return params, opt_state, loss
 
         return jax.jit(step, donate_argnums=(0, 1))
@@ -203,28 +222,67 @@ class GraphTrainer:
         rng = np.random.default_rng(seed)
         history: Dict[str, List[float]] = {"loss": []}
         steps_done = 0
-        for _ in range(int(epochs)):
-            order = rng.permutation(n) if shuffle else np.arange(n)
-            losses = []
-            # drop the ragged tail batch like the reference fabric does
-            # (a second compiled shape for <1 batch of data isn't worth it)
-            usable = max(n - n % batch_size, batch_size) \
-                if n >= batch_size else n
-            for lo in range(0, usable, batch_size):
+        guard = self._active_guard()
+        wrapped = False
+        if guard is not None:
+            guard.begin_fit()
+            self.opt_state = (self.opt_state, guard.device_init())
+            wrapped = True
+        bad_seen = 0
+        try:
+            for _ in range(int(epochs)):
+                order = rng.permutation(n) if shuffle else np.arange(n)
+                losses = []
+                # drop the ragged tail batch like the reference fabric
+                # does (a second compiled shape for <1 batch of data
+                # isn't worth it)
+                usable = max(n - n % batch_size, batch_size) \
+                    if n >= batch_size else n
+                for lo in range(0, usable, batch_size):
+                    if max_steps is not None and steps_done >= max_steps:
+                        break
+                    idx = order[lo:lo + batch_size]
+                    batch = self._put_batch(
+                        [np.asarray(a)[idx] for a in (*xs, *ys)])
+                    self.params, self.opt_state, loss = self._jit_step(
+                        self.params, self.opt_state, *batch)
+                    losses.append(loss)
+                    steps_done += 1
+                if guard is not None:
+                    # epoch-boundary guard check (graph models dispatch
+                    # per step, so the counter read syncs nothing extra)
+                    g = jax.device_get(self.opt_state[1])
+                    window = float(np.sum([np.asarray(v)
+                                           for v in losses])) \
+                        if losses else 0.0
+                    act = guard.on_boundary(
+                        bad_total=int(g["bad"]), streak=int(g["streak"]),
+                        window_loss=window, window_steps=len(losses),
+                        global_step=steps_done)
+                    bad_epoch = int(g["bad"]) - bad_seen
+                    bad_seen = int(g["bad"])
+                    if act == "rollback":
+                        state, aux, _lr = guard.rollback()
+                        self.params = {k: jnp.asarray(v) for k, v in
+                                       state["params"].items()}
+                        inner = aux if aux is not None \
+                            else self.tx.init(self.params)
+                        self.opt_state = (inner, guard.device_init())
+                        bad_seen = 0
+                        continue  # retrain the epoch from the snapshot
+                    if act == "preempt":
+                        guard.preempt_checkpoint(step=steps_done)
+                    if losses:
+                        history["loss"].append(
+                            window / max(len(losses) - bad_epoch, 1))
+                elif losses:
+                    history["loss"].append(
+                        float(np.mean([np.asarray(v) for v in losses])))
                 if max_steps is not None and steps_done >= max_steps:
                     break
-                idx = order[lo:lo + batch_size]
-                batch = self._put_batch(
-                    [np.asarray(a)[idx] for a in (*xs, *ys)])
-                self.params, self.opt_state, loss = self._jit_step(
-                    self.params, self.opt_state, *batch)
-                losses.append(loss)
-                steps_done += 1
-            if losses:
-                history["loss"].append(
-                    float(np.mean([np.asarray(v) for v in losses])))
-            if max_steps is not None and steps_done >= max_steps:
-                break
+        finally:
+            if wrapped:
+                self.opt_state = self.opt_state[0]
         return history
 
     def predict(self, xs: List[np.ndarray], batch_size: int = 256):
@@ -285,7 +343,7 @@ class TFGraphEstimator:
     def __init__(self, *, inputs, outputs=None, labels=None, loss=None,
                  optimizer=None, metrics=None, clip_norm=None,
                  clip_value=None, updates=None, sess=None,
-                 model_dir=None):
+                 model_dir=None, guard=None):
         from zoo_tpu.bridges.tf_graph import capture_trainable_graph
 
         inputs = list(inputs) if isinstance(inputs, (list, tuple)) \
@@ -312,6 +370,31 @@ class TFGraphEstimator:
                                     clip_value=clip_value)
         self.model_dir = model_dir
         self._epoch = 0
+        # training guardian (docs/fault_tolerance.md): attach before the
+        # first fit so the jitted step is built guarded
+        from zoo_tpu.orca.learn.guard import TrainingGuard
+        if guard is False:
+            self._guard = None
+        else:
+            self._guard = guard if guard is not None \
+                else TrainingGuard.from_env(name="tf_graph")
+        if self._guard is not None:
+            self.trainer.guard = self._guard
+            if model_dir:
+                import os
+                import pickle
+
+                def _restore():
+                    path = os.path.join(model_dir, "tf_graph_ckpt.pkl")
+                    with open(path, "rb") as f:
+                        return pickle.load(f), None
+
+                self._guard.bind(
+                    save_fn=lambda: (self._write_back(),
+                                     self.save_checkpoint()),
+                    restore_fn=_restore,
+                    quarantine_path=os.path.join(
+                        model_dir, "guard", "quarantine.jsonl"))
 
     # -- data -------------------------------------------------------------
     def _norm(self, data, feature_cols, label_cols, need_y):
@@ -349,21 +432,27 @@ class TFGraphEstimator:
             val = self._norm(validation_data, feature_cols, label_cols,
                              need_y=True)
         hist: Dict[str, List[float]] = {}
-        for _ in range(int(epochs)):
-            h = self.trainer.fit(xs, ys, epochs=1,
-                                 batch_size=batch_size, shuffle=shuffle,
-                                 seed=self._epoch)
-            for k, v in h.items():
-                hist.setdefault(k, []).extend(v)
-            self._epoch += 1
-            if val is not None:
-                for k, v in self.trainer.evaluate(
-                        *val, batch_size=batch_size).items():
-                    hist.setdefault(f"val_{k}", []).append(v)
-            if self.model_dir and checkpoint_trigger is not None and \
-                    checkpoint_trigger.fire_on_epoch(self._epoch):
-                self._write_back()
-                self.save_checkpoint()
+        if self._guard is not None:
+            self._guard.install_signal_handler()
+        try:
+            for _ in range(int(epochs)):
+                h = self.trainer.fit(xs, ys, epochs=1,
+                                     batch_size=batch_size,
+                                     shuffle=shuffle, seed=self._epoch)
+                for k, v in h.items():
+                    hist.setdefault(k, []).extend(v)
+                self._epoch += 1
+                if val is not None:
+                    for k, v in self.trainer.evaluate(
+                            *val, batch_size=batch_size).items():
+                        hist.setdefault(f"val_{k}", []).append(v)
+                if self.model_dir and checkpoint_trigger is not None and \
+                        checkpoint_trigger.fire_on_epoch(self._epoch):
+                    self._write_back()
+                    self.save_checkpoint()
+        finally:
+            if self._guard is not None:
+                self._guard.uninstall_signal_handler()
         self._write_back()
         if self.model_dir:
             self.save_checkpoint()

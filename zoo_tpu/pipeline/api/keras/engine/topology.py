@@ -91,6 +91,7 @@ class KerasNet:
         self._jit_pred = None
         self._built_shapes: Optional[List[Tuple]] = None
         self._grad_clip: Optional[Tuple] = None
+        self._guard = None  # TrainingGuard (orca/learn/guard.py)
 
     # -- param keys --------------------------------------------------------
     def _param_keys(self) -> Dict[int, str]:
@@ -230,6 +231,24 @@ class KerasNet:
         scale = jnp.minimum(1.0, norm / (gnorm + 1e-12))
         return jax.tree_util.tree_map(lambda g: g * scale, grads)
 
+    def set_guard(self, guard):
+        """Attach a :class:`zoo_tpu.orca.learn.guard.TrainingGuard`. The
+        guard changes the traced step (health fold + device counters in
+        the optimizer-state carry), so every train-step cache drops —
+        attach once, before training, like the estimators do."""
+        self._guard = guard
+        self._drop_train_caches()
+        return self
+
+    def clear_guard(self):
+        self._guard = None
+        self._drop_train_caches()
+        return self
+
+    def _active_guard(self):
+        g = getattr(self, "_guard", None)
+        return g if g is not None and g.active else None
+
     def set_tensorboard(self, log_dir: str, app_name: str):
         """reference: ``Topology.scala:162-168``."""
         self.train_summary = TrainSummary(log_dir, app_name + "/train")
@@ -330,11 +349,17 @@ class KerasNet:
     def _make_step_fn(self):
         tx = self.optimizer.make()
         n_inputs = self._n_inputs()
+        guard = self._active_guard()
 
         def step(params, opt_state, rng, *batch):
             # rng advances inside the jitted step — a host-side split per
             # step would be an extra dispatch (and a real cost when the
             # device sits behind a high-latency transport)
+            if guard is not None:
+                # the guard's device counters ride the opt-state carry so
+                # the step keeps its (params, opt_state, rng, *batch)
+                # signature through scan/jit/donation unchanged
+                opt_state, gstate = opt_state
             step_rng, new_rng = jax.random.split(rng)
             xs = list(batch[:n_inputs])
             labels = list(batch[n_inputs:])
@@ -361,15 +386,40 @@ class KerasNet:
             (loss, collect), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(trainable)
             grads = self._apply_grad_clip(grads)
-            if getattr(self.optimizer, "fused", False):
-                # direct-apply path: the Pallas fused kernel writes new
-                # params in one pass, no optax updates/apply round trip
-                trainable, opt_state = self.optimizer.apply_fused(
-                    grads, opt_state, trainable)
-            else:
-                updates, opt_state = tx.update(grads, opt_state, trainable)
+
+            def _update(tr, opt, g):
+                if getattr(self.optimizer, "fused", False):
+                    # direct-apply path: the Pallas fused kernel writes
+                    # new params in one pass, no optax updates/apply
+                    # round trip
+                    return self.optimizer.apply_fused(g, opt, tr)
+                upd, opt = tx.update(g, opt, tr)
                 import optax
-                trainable = optax.apply_updates(trainable, updates)
+                return optax.apply_updates(tr, upd), opt
+
+            if guard is not None:
+                # in-step health guard: the whole optimizer update runs
+                # under lax.cond — a non-finite loss/grad-norm takes the
+                # identity branch, so params, opt state and running
+                # stats pass through UNCHANGED (buffers forwarded; no
+                # host sync, and good steps pay only the norm reduce)
+                ok = guard.grad_norm_ok(loss, grads)
+
+                def _good(op):
+                    tr, opt = _update(op[0], op[1], op[2])
+                    return tr, opt, op[3]
+
+                def _skip(op):
+                    return op[0], op[1], state
+
+                trainable, opt_state, new_stats = jax.lax.cond(
+                    ok, _good, _skip,
+                    (trainable, opt_state, grads, collect or state))
+                gstate = guard.gstate_update(gstate, ok)
+                loss = jnp.where(ok, loss, 0.0)
+                return (_merge_state(trainable, new_stats),
+                        (opt_state, gstate), new_rng, loss)
+            trainable, opt_state = _update(trainable, opt_state, grads)
             new_params = _merge_state(trainable, collect or state)
             return new_params, opt_state, new_rng, loss
 
@@ -467,6 +517,9 @@ class KerasNet:
             self.optimizer.init_fused(trainable)
             if getattr(self.optimizer, "fused", False) else
             tx.init(trainable))
+        if self._active_guard() is not None:
+            # the guarded step carries the guard counters in opt_state
+            opt_state = (opt_state, self._active_guard().device_init())
         rng = jax.random.PRNGKey(seed + 1)
         local_bs = max(batch_size // jax.process_count(), 1)
         batch = self._put_batch([np.asarray(a[:local_bs])
@@ -541,6 +594,70 @@ class KerasNet:
             self.optimizer.init_fused(trainable)
             if getattr(self.optimizer, "fused", False) else
             tx.init(trainable))
+
+        guard = self._active_guard()
+        if guard is not None:
+            guard.begin_fit()
+            # the guard's device-side (bad, streak) counters ride the
+            # optimizer-state carry; the guarded step unwraps them
+            opt_state = (opt_state, guard.device_init())
+        # boundary bookkeeping: per-epoch cumulative baselines so each
+        # superbatch boundary sees window deltas (reset at epoch start)
+        gb = {"loss": 0.0, "steps": 0, "bad": 0, "bad0": 0, "idx": None,
+              "n": 0}
+
+        def _guard_boundary(epoch, final=False):
+            """Superbatch-boundary guard check: read the device counters
+            (the only host sync the guard adds), escalate to rollback /
+            preempt when the controller says so."""
+            nonlocal params, opt_state, loss_sum, n_steps
+            gb["n"] += 1
+            if not (final or guard.preempt_requested
+                    or gb["n"] % guard.config.check_every == 0):
+                return
+            inner, gstate = opt_state
+            g = jax.device_get(gstate)
+            cur = float(np.asarray(loss_sum)) if loss_sum is not None \
+                else 0.0
+            act = guard.on_boundary(
+                bad_total=int(g["bad"]), streak=int(g["streak"]),
+                window_loss=cur - gb["loss"],
+                window_steps=n_steps - gb["steps"],
+                global_step=self._step, epoch=epoch,
+                batch_hint=gb["idx"])
+            gb["loss"], gb["steps"], gb["bad"] = cur, n_steps, int(g["bad"])
+            if act == "rollback":
+                state, aux, lr_scale = guard.rollback()
+                params = self._place(state["params"])
+                tr, _ = _split_state(params)
+                inner = aux if aux is not None else (
+                    self.optimizer.init_fused(tr)
+                    if getattr(self.optimizer, "fused", False)
+                    else tx.init(tr))
+                hp = getattr(inner, "hyperparams", None)
+                if lr_scale != 1.0 and hp is not None \
+                        and "learning_rate" in hp:
+                    hp["learning_rate"] = jnp.asarray(
+                        float(np.asarray(hp["learning_rate"])) * lr_scale,
+                        jnp.float32)
+                opt_state = (inner, guard.device_init())
+                gb["bad"] = gb["bad0"] = 0
+                if not final:
+                    # the diverged pre-rollback losses must not leak
+                    # into this epoch's reported loss/throughput: the
+                    # epoch restarts its accumulators at the restore
+                    # point (a rollback AT epoch end keeps them — that
+                    # epoch really did diverge, and its loss says so)
+                    loss_sum, n_steps = None, 0
+                    gb["loss"], gb["steps"] = 0.0, 0
+            elif act == "preempt":
+                # commit the CURRENT state to the model so the owner's
+                # save callback snapshots exactly this step, then save
+                # (coordinated across hosts) and exit resume-don't-retry
+                self.params = jax.device_get(params) if mesh is None \
+                    else params
+                self._opt_state = inner
+                guard.preempt_checkpoint(step=self._step)
 
         rng = jax.random.PRNGKey(seed + 1)
         nprng = np.random.RandomState(seed)
@@ -617,6 +734,8 @@ class KerasNet:
         for epoch in range(nb_epoch):
             t0 = time.perf_counter()  # monotonic: NTP-step-proof Throughput
             loss_sum, n_steps = None, 0
+            gb["loss"], gb["steps"] = 0.0, 0  # per-epoch loss baselines
+            gb["bad0"] = gb["bad"]
             if use_epoch:
                 kk = n // local_bs
                 # mesh identity in the key: the built closure bakes the
@@ -673,6 +792,18 @@ class KerasNet:
                     # ingest pipeline; see orca/data/ingest.py)
                     def _slice(idx):
                         sliced = [a[idx] for a in arrs]
+                        if guard is not None:
+                            # chaos seam: armed tests corrupt the host
+                            # batch in place (poison-batch injection);
+                            # the idx hint feeds quarantine records
+                            # (approximate — the slice stage runs one
+                            # superbatch ahead of the step)
+                            gb["idx"] = (int(idx[0]), int(idx[-1]))
+                            from zoo_tpu.util.resilience import (
+                                fault_point,
+                            )
+                            fault_point("fit.batch", arrays=sliced,
+                                        idx=idx)
                         if use_scan:  # (k*bs,...) -> (k, bs, ...) for scan
                             sliced = [a.reshape((len(idx) // local_bs,
                                                  local_bs)
@@ -727,6 +858,8 @@ class KerasNet:
                                 n_steps += k
                                 loss_sum = loss if loss_sum is None \
                                     else loss_sum + loss
+                                if guard is not None:
+                                    _guard_boundary(epoch)
                                 continue
                             n_sub = (staged[0].shape[0] // local_bs
                                      if group > 1 else 1)
@@ -762,9 +895,18 @@ class KerasNet:
                                 # trip — ~100ms over a tunneled PJRT transport)
                                 loss_sum = loss if loss_sum is None \
                                     else loss_sum + loss
+                            if guard is not None:
+                                _guard_boundary(epoch)
                 finally:
                     batches.close()
-            epoch_loss = float(np.asarray(loss_sum)) / max(n_steps, 1)
+            if guard is not None:
+                _guard_boundary(epoch, final=True)
+                # skipped steps contributed 0 to the sanitized loss sum;
+                # keep them out of the mean too
+                denom = max(n_steps - max(0, gb["bad"] - gb["bad0"]), 1)
+            else:
+                denom = max(n_steps, 1)
+            epoch_loss = float(np.asarray(loss_sum)) / denom
             from zoo_tpu.common.context import ZooContext
             if ZooContext.debug_nans and not np.isfinite(epoch_loss):
                 raise FloatingPointError(
@@ -809,7 +951,9 @@ class KerasNet:
                     new_lr = plateau.update(watched)
                     # inject_hyperparams keeps lr in the optimizer state, so
                     # the jitted step picks the new value up as an argument
-                    opt_state.hyperparams["learning_rate"] = jnp.asarray(
+                    _inner_opt = opt_state[0] if guard is not None \
+                        else opt_state
+                    _inner_opt.hyperparams["learning_rate"] = jnp.asarray(
                         new_lr, dtype=jnp.float32)
             if verbose:
                 extra = {k: v[-1] for k, v in history.items() if k != "loss"}
@@ -817,6 +961,8 @@ class KerasNet:
                       f"{epoch_loss:.4f}" +
                       "".join(f" - {k}: {v:.4f}" for k, v in extra.items()))
         self.params = jax.device_get(params) if mesh is None else params
+        if guard is not None:
+            opt_state = opt_state[0]  # shed the guard counters
         self._opt_state = opt_state
         return history
 
@@ -964,6 +1110,7 @@ class KerasNet:
         ts, vs, opt = self.train_summary, self.validation_summary, \
             self._opt_state
         prof = getattr(self, "_profiler", None)
+        grd = getattr(self, "_guard", None)
         params = self.params
         try:
             self._jit_train = self._jit_eval = self._jit_pred = None
@@ -973,6 +1120,7 @@ class KerasNet:
             self._jit_epoch_cache = None
             self._opt_state = None
             self._profiler = None
+            self._guard = None  # holds locks/events; owners re-attach
             self.train_summary = TrainSummary()
             self.validation_summary = TrainSummary()
             if params is not None:
@@ -986,6 +1134,7 @@ class KerasNet:
             self.train_summary, self.validation_summary = ts, vs
             self._opt_state = opt
             self._profiler = prof
+            self._guard = grd
             self.params = params
 
     def save(self, path: str):

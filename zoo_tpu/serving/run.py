@@ -148,7 +148,19 @@ def main(argv=None) -> int:
     for sig in (signal.SIGINT, signal.SIGTERM):
         signal.signal(sig, lambda *_: stop.set())
     stop.wait()
+    # graceful drain: close the HTTP front door first (no new work
+    # enters), let the engine finish its in-flight batch (stop() joins
+    # the worker loop), then flush the final metrics snapshot so the
+    # request tallies survive the process (docs/fault_tolerance.md)
+    fe.stop()
     serving.stop()
+    snap = os.environ.get("ZOO_OBS_SNAPSHOT")
+    if snap:
+        try:
+            from zoo_tpu.obs.exporters import write_snapshot
+            write_snapshot(snap)
+        except Exception as e:  # noqa: BLE001 — flush is best-effort
+            print(f"metrics snapshot flush failed: {e}", file=sys.stderr)
     return 0
 
 

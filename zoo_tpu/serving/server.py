@@ -147,6 +147,17 @@ class ServingServer:
             for name in ("batch", "inference", "total")}
         self._queue: "queue.Queue[_Request]" = queue.Queue()
         self._stop = threading.Event()
+        self._draining = threading.Event()
+        # exact drain accounting: a request is ACCEPTED (under
+        # _accept_lock, so no admission can race the drain flag) before
+        # it is queued, and COMPLETED when its batch resolves — drain is
+        # done iff completed == accepted, with no window for a request
+        # to hide between the queue and the batch loop
+        self._accept_lock = threading.Lock()
+        self._accepted = 0
+        self._completed = 0
+        self._inflight = 0  # batches currently in model.predict
+        self._inflight_lock = threading.Lock()
 
         outer = self
 
@@ -196,6 +207,21 @@ class ServingServer:
                                 "error": "server shedding load (circuit "
                                          "open after repeated inference "
                                          "failures; retry later)"})
+                            continue
+                        with outer._accept_lock:
+                            draining = outer._draining.is_set()
+                            if not draining:
+                                outer._accepted += 1
+                        if draining:
+                            # graceful drain: NEW work is turned away at
+                            # the door; everything already queued or
+                            # in-flight still completes and responds
+                            _requests.labels(outcome="shed").inc()
+                            _send_msg(self.request, {
+                                "uri": msg.get("uri"), "shed": True,
+                                "draining": True,
+                                "error": "server draining (shutting "
+                                         "down); retry another replica"})
                             continue
                         req = _Request(msg["uri"], msg["data"])
                         t0 = time.perf_counter()
@@ -266,6 +292,8 @@ class ServingServer:
             _batch_occupancy.observe(len(batch))
             _queue_depth.set(self._queue.qsize())
 
+            with self._inflight_lock:
+                self._inflight += 1
             t1 = time.perf_counter()
             try:
                 with span("serving.batch", size=len(batch)):
@@ -309,6 +337,9 @@ class ServingServer:
             self.timers["inference"].record(time.perf_counter() - t1)
             for r in batch:
                 r.event.set()
+            with self._inflight_lock:
+                self._inflight -= 1
+                self._completed += len(batch)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "ServingServer":
@@ -322,6 +353,66 @@ class ServingServer:
         for t in self._threads:
             t.start()
         return self
+
+    def drain(self, timeout: float = 30.0,
+              snapshot_path: str = None) -> bool:
+        """Graceful shutdown (the SIGTERM path): stop taking new work,
+        finish everything already accepted, flush the metrics snapshot,
+        then close. Returns True when every queued/in-flight request was
+        answered inside ``timeout`` (False = timed out and force-closed;
+        the stragglers get their normal timeout error).
+
+        Order matters: (1) ``_draining`` is raised under the accept
+        lock, so no handler can slip a request past the closing door —
+        admission and the flag flip are mutually exclusive; (2) wait
+        until every accepted request has completed (exact counters — a
+        request between queue-pop and batch dispatch still counts as
+        outstanding); (3) write the metrics snapshot (``snapshot_path``
+        or ``$ZOO_OBS_SNAPSHOT``) so the final request tallies survive
+        the process; (4) ``stop()``."""
+        with self._accept_lock:
+            self._draining.set()
+            outstanding_at_close = self._accepted
+        deadline = time.monotonic() + timeout
+        drained = False
+        while time.monotonic() < deadline:
+            with self._inflight_lock:
+                done = self._completed
+            if done >= outstanding_at_close and \
+                    self._queue.qsize() == 0:
+                drained = True
+                break
+            time.sleep(0.01)
+        import os
+        path = snapshot_path or os.environ.get("ZOO_OBS_SNAPSHOT")
+        if path:
+            try:
+                from zoo_tpu.obs.exporters import write_snapshot
+                write_snapshot(path)
+            except Exception as e:  # noqa: BLE001 — flush is best-effort
+                import logging
+                logging.getLogger(__name__).warning(
+                    "drain: metrics snapshot flush failed: %s", e)
+        self.stop()
+        return drained
+
+    def install_drain_handler(self, signals=None, timeout: float = 30.0,
+                              snapshot_path: str = None):
+        """Route SIGTERM (default) to :meth:`drain` on a helper thread —
+        the orchestrator's stop signal finishes in-flight work instead
+        of dropping it. Main-thread only; returns False elsewhere."""
+        import signal as _signal
+        sigs = signals or (_signal.SIGTERM,)
+        try:
+            for s in sigs:
+                _signal.signal(s, lambda *_: threading.Thread(
+                    target=self.drain,
+                    kwargs={"timeout": timeout,
+                            "snapshot_path": snapshot_path},
+                    daemon=True, name="zoo-serving-drain").start())
+            return True
+        except ValueError:  # not the main thread
+            return False
 
     def stop(self):
         self._stop.set()

@@ -285,11 +285,33 @@ class FaultInjector:
     before) raising — chaos tests use it to SIGKILL the process mid-save.
     ``times=N`` disarms the site after N firings; ``p`` fires
     probabilistically. Unarmed sites cost a single dict lookup.
+
+    **Deterministic replay**: probabilistic (``p < 1``) firings draw from
+    the injector's OWN ``random.Random``, seeded from ``seed=`` or
+    ``$ZOO_FAULT_SEED`` — so a chaos run that found a bug replays the
+    exact same fault schedule (same arm order + same seed = same trips).
+    :meth:`reseed` restarts the sequence mid-process. Unseeded injectors
+    keep fresh entropy per process, like before.
     """
 
-    def __init__(self):
+    def __init__(self, seed: Optional[int] = None):
         self._lock = threading.Lock()
         self._sites: Dict[str, _Fault] = {}
+        if seed is None:
+            env = os.environ.get("ZOO_FAULT_SEED")
+            seed = int(env) if env else None
+        self.fault_seed = seed
+        self._rng = random.Random(seed)
+
+    def reseed(self, seed: Optional[int] = None):
+        """Restart the fault schedule (``seed=None`` re-reads
+        ``$ZOO_FAULT_SEED``, falling back to fresh entropy)."""
+        if seed is None:
+            env = os.environ.get("ZOO_FAULT_SEED")
+            seed = int(env) if env else None
+        self.fault_seed = seed
+        self._rng = random.Random(seed)
+        return self
 
     def inject(self, site: str,
                exc: Optional[BaseException] = None,
@@ -325,7 +347,7 @@ class FaultInjector:
                 return
             if f.times is not None and f.fired >= f.times:
                 return
-            if f.p < 1.0 and random.random() >= f.p:
+            if f.p < 1.0 and self._rng.random() >= f.p:
                 return
             f.fired += 1
             exc, action = f.exc, f.action

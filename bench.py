@@ -655,6 +655,108 @@ def bench_serving(extra, n_requests=200, clients=8, feat=64):
             server.stop()
 
 
+def bench_serving_ha(extra, n_requests=240, clients=6, feat=16):
+    """Serving-HA numbers (docs/serving_ha.md): p50/p99 and
+    failed-request count for a 3-replica group with one replica
+    SIGKILLed mid-run, against a single-replica baseline under the same
+    load. Synthetic replicas (y = 2x after 2 ms) pin the
+    transport + failover + hedging cost, not XLA — every response is
+    verified, so a wrong-caller mismatch would show up as a failure.
+    Hedge/failover tallies come from the obs registry delta, the same
+    series a live scrape sees."""
+    import threading
+
+    from zoo_tpu.obs.metrics import get_registry
+    from zoo_tpu.serving.ha import ReplicaGroup
+    from zoo_tpu.serving.ha_client import HAServingClient
+
+    def counter_value(name, **labels):
+        total = 0.0
+        for c in get_registry().snapshot()["counters"]:
+            if c["name"] == name and all(
+                    c["labels"].get(k) == v for k, v in labels.items()):
+                total += c["value"]
+        return total
+
+    def run(num_replicas, kill_one):
+        group = ReplicaGroup("synthetic:double:2",
+                             num_replicas=num_replicas, batch_size=8,
+                             max_wait_ms=2.0, max_restarts=3)
+        group.start(timeout=60)
+        client = HAServingClient(group.endpoints(), deadline_ms=10000)
+        lats, failures = [], []
+        lock = threading.Lock()
+        done = [0]
+        killed = threading.Event()
+
+        def one_client(k):
+            rs_c = np.random.RandomState(k)
+            for i in range(n_requests // clients):
+                x = rs_c.randn(1, feat).astype(np.float32)
+                t0 = time.perf_counter()
+                try:
+                    out = np.asarray(client.predict(x))
+                    if not np.allclose(out, x * 2.0, atol=1e-6):
+                        raise AssertionError("response mismatch")
+                    with lock:
+                        lats.append(time.perf_counter() - t0)
+                except Exception as e:  # noqa: BLE001 — tally, keep going
+                    with lock:
+                        failures.append(repr(e))
+                with lock:
+                    done[0] += 1
+                # one SIGKILL mid-run, while load is flowing
+                if kill_one and not killed.is_set() and \
+                        done[0] >= n_requests // 3:
+                    if not killed.is_set():
+                        killed.set()
+                        group.kill_replica(1)
+
+        try:
+            threads = [threading.Thread(target=one_client, args=(k,))
+                       for k in range(clients)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+        finally:
+            group.stop()
+        lats_ms = np.asarray(sorted(lats)) * 1e3
+        return {
+            "p50": float(np.percentile(lats_ms, 50)) if len(lats) else
+            float("nan"),
+            "p99": float(np.percentile(lats_ms, 99)) if len(lats) else
+            float("nan"),
+            "failed": len(failures),
+            "req_per_sec": len(lats) / wall,
+            "restarts": group.restarts(),
+        }
+
+    hedge0 = counter_value("zoo_serve_hedge_total", event="fired")
+    won0 = counter_value("zoo_serve_hedge_total", event="won")
+    fo0 = counter_value("zoo_serve_failover_total")
+
+    single = run(1, kill_one=False)
+    extra["serving_ha_single_p50_ms"] = round(single["p50"], 2)
+    extra["serving_ha_single_p99_ms"] = round(single["p99"], 2)
+    extra["serving_ha_single_failed"] = single["failed"]
+
+    ha = run(3, kill_one=True)
+    extra["serving_ha_kill_p50_ms"] = round(ha["p50"], 2)
+    extra["serving_ha_kill_p99_ms"] = round(ha["p99"], 2)
+    extra["serving_ha_kill_failed"] = ha["failed"]
+    extra["serving_ha_kill_req_per_sec"] = round(ha["req_per_sec"], 1)
+    extra["serving_ha_kill_restarts"] = ha["restarts"]
+    extra["serving_ha_hedge_fired"] = int(
+        counter_value("zoo_serve_hedge_total", event="fired") - hedge0)
+    extra["serving_ha_hedge_won"] = int(
+        counter_value("zoo_serve_hedge_total", event="won") - won0)
+    extra["serving_ha_failovers"] = int(
+        counter_value("zoo_serve_failover_total") - fo0)
+
+
 def main():
     import jax
 
@@ -699,6 +801,10 @@ def main():
             bench_serving(extra)
         except Exception as e:  # noqa: BLE001
             extra["serving_error"] = repr(e)
+        try:
+            bench_serving_ha(extra)
+        except Exception as e:  # noqa: BLE001
+            extra["serving_ha_error"] = repr(e)
         try:
             bench_shard_exchange(extra)
         except Exception as e:  # noqa: BLE001

@@ -373,6 +373,65 @@ def test_metrics_end_to_end_serving_fit_checkpoint(orca_ctx, tmp_path):
     br = CircuitBreaker(failure_threshold=1, recovery_timeout=60)
     br.record_failure()
 
+    # 4b. the serving-HA paths (docs/serving_ha.md) — shed at an
+    # open-breaker door, a dead-on-arrival deadline, a failover past a
+    # dead endpoint, and a hedge that wins over a stalled primary — so
+    # the scrape below carries every zoo_serve_* family with real counts
+    from zoo_tpu.serving.ha_client import HAServingClient
+    from zoo_tpu.serving.tcp_client import _Connection
+
+    class _Stall:
+        def __init__(self, factor, delay):
+            self.factor, self.delay = factor, delay
+
+        def predict(self, xx, batch_size=None):
+            import time as _t
+            if self.delay:
+                _t.sleep(self.delay)
+            return np.asarray(xx) * self.factor
+
+    tripped = CircuitBreaker(failure_threshold=1, recovery_timeout=60)
+    tripped.record_failure()
+    shed_srv = ServingServer(_Stall(2.0, 0.0), port=0, batch_size=2,
+                             max_wait_ms=1.0, breaker=tripped).start()
+    slow_srv = ServingServer(_Stall(3.0, 0.5), port=0, batch_size=1,
+                             max_wait_ms=0.0).start()
+    fast_srv = ServingServer(_Stall(2.0, 0.0), port=0, batch_size=2,
+                             max_wait_ms=1.0).start()
+    try:
+        conn = _Connection(shed_srv.host, shed_srv.port)
+        resp = conn.rpc({"op": "predict", "uri": "u",
+                         "data": np.zeros((1, 2), np.float32)})
+        assert resp.get("shed") and resp.get("retryable")
+        conn.close()
+        conn = _Connection(fast_srv.host, fast_srv.port)
+        resp = conn.rpc({"op": "predict", "uri": "u",
+                         "data": np.zeros((1, 2), np.float32),
+                         "deadline_ms": 0.0})
+        assert resp.get("expired")
+        conn.close()
+        import socket as _socket
+        probe = _socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        dead = probe.getsockname()
+        probe.close()
+        cli = HAServingClient([dead, (fast_srv.host, fast_srv.port)],
+                              hedge=False, deadline_ms=8000)
+        assert np.asarray(cli.predict(
+            np.ones((1, 2), np.float32))).shape == (1, 2)
+        cli.close()
+        cli2 = HAServingClient(
+            [(slow_srv.host, slow_srv.port),
+             (fast_srv.host, fast_srv.port)],
+            hedge=True, hedge_delay_ms=20, deadline_ms=8000)
+        hedged = np.asarray(cli2.predict(np.ones((1, 2), np.float32)))
+        np.testing.assert_allclose(hedged, 2.0)  # the fast replica won
+        cli2.close()
+    finally:
+        shed_srv.stop()
+        slow_srv.stop()
+        fast_srv.stop()
+
     # 5. one scrape sees all of it
     ex = MetricsExporter().start()  # process-global registry
     try:
@@ -391,6 +450,11 @@ def test_metrics_end_to_end_serving_fit_checkpoint(orca_ctx, tmp_path):
             "zoo_ckpt_save_seconds_bucket",
             "zoo_ckpt_restore_seconds_count",
             'zoo_step_phase_seconds_bucket{phase="step"',
+            'zoo_serve_shed_total{reason="breaker_open"}',
+            'zoo_serve_deadline_expired_total{stage="admission"}',
+            "zoo_serve_failover_total",
+            'zoo_serve_hedge_total{event="fired"}',
+            'zoo_serve_hedge_total{event="won"}',
     ):
         assert needle in text, f"/metrics is missing {needle}"
     # the fit really recorded step phases (count > 0, not just a family)

@@ -1,0 +1,516 @@
+"""Serving high availability (docs/serving_ha.md): deadline propagation
+and per-stage enforcement, bounded-queue admission control, request-id
+idempotency (server dedup + client stale-frame discard), the HA client's
+failover/hedging, and the 3-replica SIGKILL chaos smoke.
+
+Everything here runs against stand-in models (no jax in the serving
+path), so the whole file is tier-1 fast; the subprocess chaos smoke
+carries the ``chaos`` marker like its siblings.
+"""
+
+import os
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from zoo_tpu.serving.server import ServingServer
+from zoo_tpu.serving.tcp_client import TCPInputQueue, _Connection
+from zoo_tpu.util.resilience import (
+    Deadline,
+    DeadlineExceeded,
+    RetryPolicy,
+    clear_faults,
+    inject,
+)
+
+
+class _MarkerModel:
+    """y = 2x, recording the marker value (column 0) of every row it
+    actually computed — the witness that dropped/deduped requests never
+    reached inference."""
+
+    def __init__(self, delay: float = 0.0):
+        self.delay = delay
+        self.rows = []
+        self._lock = threading.Lock()
+
+    def predict(self, x, batch_size=None):
+        x = np.asarray(x)
+        with self._lock:
+            self.rows.extend(float(v) for v in x[:, 0])
+        if self.delay:
+            time.sleep(self.delay)
+        return x * 2.0
+
+    def seen(self, marker: float) -> int:
+        with self._lock:
+            return sum(1 for v in self.rows if v == marker)
+
+
+def _x(marker: float, rows: int = 1) -> np.ndarray:
+    return np.full((rows, 4), float(marker), np.float32)
+
+
+# ------------------------------------------------------------ deadlines
+
+def test_deadline_helper_semantics():
+    assert Deadline.from_ms(None) is None
+    dl = Deadline.from_ms(0)
+    assert dl is not None and dl.expired()
+    dl2 = Deadline.from_ms(60000)
+    assert not dl2.expired()
+    assert 59.0 < dl2.remaining() <= 60.0
+    assert dl2.remaining_ms() > 59000
+
+
+def test_deadline_expired_at_admission_never_computed():
+    model = _MarkerModel()
+    server = ServingServer(model, port=0, batch_size=4,
+                           max_wait_ms=1.0).start()
+    try:
+        conn = _Connection(server.host, server.port)
+        resp = conn.rpc({"op": "predict", "uri": "u", "data": _x(7.0),
+                         "deadline_ms": 0.0})
+        assert resp.get("expired") is True
+        assert "deadline" in resp["error"]
+        assert model.seen(7.0) == 0
+        conn.close()
+    finally:
+        server.stop()
+
+
+def test_deadline_expiry_before_inference_drops_unexecuted():
+    """A request that expires while queued behind a slow batch is
+    dropped at batch formation — answered "expired", never computed."""
+    model = _MarkerModel(delay=0.35)
+    server = ServingServer(model, port=0, batch_size=1,
+                           max_wait_ms=0.0).start()
+    try:
+        occupant = threading.Thread(
+            target=lambda: TCPInputQueue(server.host,
+                                         server.port).predict(_x(1.0)))
+        occupant.start()
+        time.sleep(0.05)  # the batcher is now inside the slow predict
+        q = TCPInputQueue(server.host, server.port)
+        with pytest.raises(RuntimeError, match="deadline"):
+            q.predict(_x(7.0), deadline_ms=100)
+        occupant.join()
+        # give the batcher time to pop-and-drop the stale entry
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and server._queue.qsize():
+            time.sleep(0.01)
+        time.sleep(0.05)
+        assert model.seen(7.0) == 0, "expired request was computed"
+        assert model.seen(1.0) == 1
+        q.close()
+    finally:
+        server.stop()
+
+
+def test_request_wait_knob_replaces_hardcoded_timeout(monkeypatch):
+    """ZOO_SERVE_REQUEST_TIMEOUT bounds the no-deadline reply wait (the
+    former hardcoded 120 s); the env knob is read at server build."""
+    monkeypatch.setenv("ZOO_SERVE_REQUEST_TIMEOUT", "0.2")
+    monkeypatch.setenv("ZOO_SERVE_HANDSHAKE_TIMEOUT", "3.5")
+    model = _MarkerModel(delay=10.0)  # far past the knob
+    server = ServingServer(model, port=0, batch_size=1,
+                           max_wait_ms=0.0).start()
+    try:
+        assert server.request_timeout == 0.2
+        assert server.handshake_timeout == 3.5
+        q = TCPInputQueue(server.host, server.port)
+        t0 = time.perf_counter()
+        with pytest.raises(RuntimeError,
+                           match="ZOO_SERVE_REQUEST_TIMEOUT"):
+            q.predict(_x(1.0))
+        assert time.perf_counter() - t0 < 5.0
+        q.close()
+    finally:
+        server.stop()
+
+
+# ----------------------------------------------------- admission control
+
+def test_queue_overflow_sheds_with_retry_hint():
+    model = _MarkerModel(delay=0.25)
+    server = ServingServer(model, port=0, batch_size=1, max_wait_ms=0.0,
+                           max_queue=1).start()
+    try:
+        results = {"ok": 0, "shed": []}
+        lock = threading.Lock()
+
+        def hit(i):
+            conn = _Connection(server.host, server.port)
+            resp = conn.rpc({"op": "predict", "uri": f"r{i}",
+                             "data": _x(float(i))})
+            with lock:
+                if resp.get("shed"):
+                    results["shed"].append(resp)
+                else:
+                    assert "result" in resp
+                    results["ok"] += 1
+            conn.close()
+
+        threads = [threading.Thread(target=hit, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert results["ok"] >= 1
+        assert results["shed"], "bounded queue never shed"
+        for resp in results["shed"]:
+            assert resp["retryable"] is True
+            assert isinstance(resp["retry_after_ms"], int)
+            assert "queue full" in resp["error"]
+    finally:
+        server.stop()
+
+
+# ------------------------------------------------- request-id idempotency
+
+def test_request_id_echoed_and_replayed_not_reexecuted():
+    model = _MarkerModel()
+    server = ServingServer(model, port=0, batch_size=2,
+                           max_wait_ms=1.0).start()
+    try:
+        conn = _Connection(server.host, server.port)
+        r1 = conn.rpc({"op": "predict", "uri": "u", "data": _x(9.0),
+                       "id": "fixed-req-id"})
+        r2 = conn.rpc({"op": "predict", "uri": "u", "data": _x(9.0),
+                       "id": "fixed-req-id"})
+        assert r1["id"] == r2["id"] == "fixed-req-id"
+        np.testing.assert_array_equal(r1["result"], r2["result"])
+        assert model.seen(9.0) == 1, "duplicate id re-executed the model"
+        conn.close()
+    finally:
+        server.stop()
+
+
+def test_mid_rpc_reset_retry_is_idempotent():
+    """Regression (fault-injected mid-RPC reset): the connection dies
+    AFTER the request reached the server; the client's retry re-sends
+    the SAME id and the server dedups — the model runs exactly once and
+    the caller still gets the right answer."""
+    model = _MarkerModel()
+    server = ServingServer(model, port=0, batch_size=2,
+                           max_wait_ms=1.0).start()
+    try:
+        clear_faults()
+        with inject("serving.client.recv",
+                    exc=ConnectionResetError("mid-RPC reset"),
+                    times=1) as armed:
+            q = TCPInputQueue(server.host, server.port)
+            out = np.asarray(q.predict(_x(13.0)))
+            assert armed.fired == 1
+        np.testing.assert_allclose(out, _x(13.0) * 2.0)
+        assert model.seen(13.0) == 1, \
+            "retry after mid-RPC reset double-executed the request"
+        q.close()
+    finally:
+        clear_faults()
+        server.stop()
+
+
+def test_stale_response_discarded_never_mismatched():
+    """A frame carrying a DIFFERENT request id (a stale attempt's reply
+    buffered on the stream) is discarded, never handed to the caller."""
+    from zoo_tpu.serving.codec import dumps
+
+    listener = socket.socket()
+    listener.bind(("127.0.0.1", 0))
+    listener.listen(1)
+    host, port = listener.getsockname()
+
+    def frame(obj) -> bytes:
+        payload = dumps(obj)
+        return struct.pack(">I", len(payload)) + payload
+
+    def fake_server():
+        s, _ = listener.accept()
+        from zoo_tpu.serving.server import _recv_msg
+        msg = _recv_msg(s)
+        # a stale frame first (wrong id, poisoned payload), then the
+        # real answer
+        s.sendall(frame({"uri": "u", "id": "SOMEONE-ELSE",
+                         "result": np.full((1, 4), -1.0, np.float32)}))
+        s.sendall(frame({"uri": "u", "id": msg["id"],
+                         "result": np.full((1, 4), 42.0, np.float32)}))
+        s.close()
+
+    t = threading.Thread(target=fake_server, daemon=True)
+    t.start()
+    try:
+        conn = _Connection(host, port)
+        resp = conn.rpc({"op": "predict", "uri": "u", "data": _x(5.0)})
+        np.testing.assert_allclose(resp["result"], 42.0)
+        conn.close()
+        t.join(timeout=5)
+    finally:
+        listener.close()
+
+
+# --------------------------------------------------------- the HA client
+
+def _dead_endpoint():
+    """A (host, port) with nothing listening."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return ("127.0.0.1", port)
+
+
+def test_ha_client_fails_over_to_healthy_replica():
+    from zoo_tpu.serving.ha_client import HAServingClient
+
+    model = _MarkerModel()
+    server = ServingServer(model, port=0, batch_size=4,
+                           max_wait_ms=1.0).start()
+    try:
+        cli = HAServingClient(
+            [_dead_endpoint(), (server.host, server.port)],
+            hedge=False, deadline_ms=8000)
+        for i in range(4):  # every rotation start still lands somewhere
+            out = np.asarray(cli.predict(_x(float(i))))
+            np.testing.assert_allclose(out, _x(float(i)) * 2.0)
+        cli.close()
+    finally:
+        server.stop()
+
+
+def test_ha_client_hedge_wins_over_slow_primary():
+    """Primary stalls past the hedge delay → ONE duplicate goes to the
+    other replica (same id) and its answer is used. The replicas return
+    different values so the winner is unambiguous."""
+    from zoo_tpu.serving.ha_client import HAServingClient
+
+    class _Scaled(_MarkerModel):
+        def __init__(self, factor, delay):
+            super().__init__(delay)
+            self.factor = factor
+
+        def predict(self, x, batch_size=None):
+            super().predict(x, batch_size)
+            return np.asarray(x) * self.factor
+
+    slow = ServingServer(_Scaled(3.0, 0.6), port=0, batch_size=1,
+                         max_wait_ms=0.0).start()
+    fast = ServingServer(_Scaled(2.0, 0.0), port=0, batch_size=1,
+                         max_wait_ms=0.0).start()
+    try:
+        cli = HAServingClient(
+            [(slow.host, slow.port), (fast.host, fast.port)],
+            hedge=True, hedge_delay_ms=20, deadline_ms=8000)
+        from zoo_tpu.obs.metrics import get_registry
+
+        def hedge_count(event):
+            return sum(
+                c["value"] for c in get_registry().snapshot()["counters"]
+                if c["name"] == "zoo_serve_hedge_total"
+                and c["labels"].get("event") == event)
+
+        fired0, won0 = hedge_count("fired"), hedge_count("won")
+        out = np.asarray(cli.predict(_x(4.0)))
+        np.testing.assert_allclose(out, _x(4.0) * 2.0)  # the FAST replica
+        assert hedge_count("fired") == fired0 + 1
+        assert hedge_count("won") == won0 + 1
+        cli.close()
+    finally:
+        slow.stop()
+        fast.stop()
+
+
+def test_ha_client_deadline_exhaustion_raises_typed_error():
+    from zoo_tpu.serving.ha_client import HAServingClient
+
+    model = _MarkerModel(delay=0.5)
+    server = ServingServer(model, port=0, batch_size=1,
+                           max_wait_ms=0.0).start()
+    try:
+        cli = HAServingClient([(server.host, server.port)], hedge=False,
+                              deadline_ms=100)
+        with pytest.raises(DeadlineExceeded):
+            cli.predict(_x(1.0))
+        cli.close()
+    finally:
+        server.stop()
+
+
+def test_ha_client_all_replicas_down_is_retryable_error():
+    from zoo_tpu.serving.ha_client import (
+        HAServingClient,
+        NoReplicaAvailable,
+    )
+
+    cli = HAServingClient([_dead_endpoint(), _dead_endpoint()],
+                          hedge=False, deadline_ms=2000)
+    with pytest.raises(NoReplicaAvailable):
+        cli.predict(_x(1.0))
+    cli.close()
+
+
+def test_ha_client_retries_past_shedding_replica():
+    """A retryable shed (breaker-open door) fails over to the next
+    replica instead of surfacing to the caller."""
+    from zoo_tpu.serving.ha_client import HAServingClient
+    from zoo_tpu.util.resilience import CircuitBreaker
+
+    tripped = CircuitBreaker(failure_threshold=1, recovery_timeout=60.0)
+    tripped.record_failure()  # open: its door sheds everything
+    shedding = ServingServer(_MarkerModel(), port=0, batch_size=2,
+                             max_wait_ms=1.0, breaker=tripped).start()
+    healthy = ServingServer(_MarkerModel(), port=0, batch_size=2,
+                            max_wait_ms=1.0).start()
+    try:
+        cli = HAServingClient(
+            [(shedding.host, shedding.port), (healthy.host, healthy.port)],
+            hedge=False, deadline_ms=8000)
+        for i in range(3):
+            out = np.asarray(cli.predict(_x(float(i))))
+            np.testing.assert_allclose(out, _x(float(i)) * 2.0)
+        cli.close()
+    finally:
+        shedding.stop()
+        healthy.stop()
+
+
+def test_reused_msg_dict_never_inherits_a_stale_id():
+    """rpc() must not write the auto-stamped id into the caller's dict:
+    a reused dict would silently replay the previous answer from the
+    server's dedup cache."""
+    model = _MarkerModel()
+    server = ServingServer(model, port=0, batch_size=2,
+                           max_wait_ms=1.0).start()
+    try:
+        conn = _Connection(server.host, server.port)
+        msg = {"op": "predict", "uri": "u", "data": _x(1.0)}
+        r1 = conn.rpc(msg)
+        assert "id" not in msg and "deadline_ms" not in msg
+        msg["data"] = _x(2.0)
+        r2 = conn.rpc(msg, deadline=Deadline.from_ms(30000))
+        np.testing.assert_allclose(np.asarray(r1["result"]), 2.0)
+        np.testing.assert_allclose(np.asarray(r2["result"]), 4.0)
+        assert model.seen(2.0) == 1, "second request was dedup-replayed"
+        conn.close()
+    finally:
+        server.stop()
+
+
+@pytest.mark.chaos
+def test_ha_client_stats_tolerates_down_replica():
+    """stats() returns None for a dead seat — even one whose connection
+    was pooled while it was alive — instead of raising (regression: a
+    pooled connection's failure surfaces as RetryError, not OSError)."""
+    from zoo_tpu.serving.ha import ReplicaGroup
+    from zoo_tpu.serving.ha_client import HAServingClient
+
+    group = ReplicaGroup("synthetic:double", num_replicas=1,
+                         max_restarts=0).start(timeout=60)
+    cli = HAServingClient(group.endpoints(), hedge=False,
+                          deadline_ms=5000)
+    try:
+        cli.predict(_x(1.0))  # pools a live connection to the endpoint
+        assert cli.stats()[0] is not None
+        group.kill_replica(0)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            out = cli.stats()  # must never raise while the seat is dead
+            if out == [None]:
+                break
+            time.sleep(0.1)
+        assert out == [None], out
+    finally:
+        group.stop()
+        cli.close()
+
+
+# ------------------------------------------------------ HTTP front door
+
+def test_frontend_rejects_expired_http_deadline():
+    from zoo_tpu.serving.cluster_serving import FrontEnd
+    import json
+    import urllib.error
+    import urllib.request
+
+    class _Serving:
+        def metrics(self):
+            return {}
+
+    class _IQ:
+        def predict(self, data):
+            return np.zeros((1, 1), np.float32)
+
+    fe = FrontEnd(_Serving(), _IQ(), host="127.0.0.1", port=0).start()
+    try:
+        body = json.dumps({"instances": [{"t": [1.0]}]}).encode()
+        req = urllib.request.Request(
+            f"http://{fe.host}:{fe.port}/predict", data=body,
+            headers={"X-Zoo-Deadline-Ms": "0"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 504
+        payload = json.loads(ei.value.read().decode())
+        assert payload["expired"] is True
+        # a live budget still serves
+        req2 = urllib.request.Request(
+            f"http://{fe.host}:{fe.port}/predict", data=body,
+            headers={"X-Zoo-Deadline-Ms": "30000"})
+        with urllib.request.urlopen(req2, timeout=10) as resp:
+            assert resp.status == 200
+    finally:
+        fe.stop()
+
+
+# ------------------------------------------------------------ chaos smoke
+
+@pytest.mark.chaos
+def test_check_serving_ha_script_runs():
+    """The 3-replica SIGKILL smoke (scripts/check_serving_ha.py): a real
+    supervised replica group survives one replica kill under sustained
+    load with zero client-visible failures, respawns the seat, and
+    probes 3/3 healthy — as a subprocess, the operator invocation."""
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join("scripts", "check_serving_ha.py")],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "SERVING HA OK" in proc.stdout
+
+
+@pytest.mark.chaos
+def test_replica_group_restarts_dead_replica_inproc():
+    """ReplicaGroup direct API: kill a replica, the supervisor respawns
+    it on the SAME port, and a plain ping round-trips again."""
+    from zoo_tpu.serving.ha import ReplicaGroup
+
+    group = ReplicaGroup("synthetic:double", num_replicas=2,
+                         max_restarts=1).start(timeout=60)
+    try:
+        eps = group.endpoints()
+        assert len(eps) == 2
+        group.kill_replica(0)
+        deadline = time.monotonic() + 30
+        revived = False
+        from zoo_tpu.util.resilience import RetryError
+        while time.monotonic() < deadline:
+            try:
+                conn = _Connection(*eps[0],
+                                   retry=RetryPolicy(max_attempts=1))
+                if conn.rpc({"op": "ping"}).get("ok"):
+                    conn.close()
+                    revived = True
+                    break
+            except (OSError, RetryError):
+                time.sleep(0.1)
+        assert revived, "killed replica never came back on its port"
+        assert group.restarts() == 1
+    finally:
+        group.stop()

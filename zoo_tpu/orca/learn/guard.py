@@ -110,17 +110,10 @@ class Preempted(SystemExit):
         self.step = int(step)
 
 
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except ValueError:
-        logger.warning("bad %s=%r; using %s", name, os.environ.get(name),
-                       default)
-        return default
-
-
-def _env_int(name: str, default: int) -> int:
-    return int(_env_float(name, default))
+# the shared ZOO_* knob parsers (zoo_tpu.util.resilience is jax-free,
+# so importing them keeps this module's no-jax contract)
+from zoo_tpu.util.resilience import env_float as _env_float  # noqa: E402
+from zoo_tpu.util.resilience import env_int as _env_int  # noqa: E402
 
 
 class GuardConfig:

@@ -1,5 +1,10 @@
 from zoo_tpu.serving.client import InputQueue, OutputQueue  # noqa: F401
 from zoo_tpu.serving.cluster_serving import ClusterServing, FrontEnd  # noqa: F401
+from zoo_tpu.serving.ha import ReplicaGroup  # noqa: F401
+from zoo_tpu.serving.ha_client import (  # noqa: F401
+    HAServingClient,
+    NoReplicaAvailable,
+)
 from zoo_tpu.serving.redis_embedded import EmbeddedRedis  # noqa: F401
 from zoo_tpu.serving.server import ServingServer  # noqa: F401
 from zoo_tpu.serving.tcp_client import (  # noqa: F401
@@ -8,4 +13,5 @@ from zoo_tpu.serving.tcp_client import (  # noqa: F401
 )
 
 __all__ = ["ServingServer", "InputQueue", "OutputQueue", "ClusterServing",
-           "FrontEnd", "EmbeddedRedis", "TCPInputQueue", "TCPOutputQueue"]
+           "FrontEnd", "EmbeddedRedis", "TCPInputQueue", "TCPOutputQueue",
+           "ReplicaGroup", "HAServingClient", "NoReplicaAvailable"]

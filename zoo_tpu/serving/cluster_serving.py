@@ -26,7 +26,8 @@ from zoo_tpu.serving.client import (
     encode_ndarray_b64,
 )
 from zoo_tpu.serving.resp import RedisClient, RedisError
-from zoo_tpu.serving.server import StageTimer
+from zoo_tpu.serving.server import StageTimer, _deadline_expired
+from zoo_tpu.util.resilience import Deadline
 
 
 class ClusterServing:
@@ -153,6 +154,19 @@ class FrontEnd:
                 if not self.path.startswith("/predict"):
                     self._reply(404, {"error": "not found"})
                     return
+                # deadline propagation over HTTP (docs/serving_ha.md):
+                # the remaining budget arrives as a header and is
+                # enforced before any instance is computed — expired
+                # work is dropped at the door, and mid-batch expiry
+                # stops the remaining instances
+                dl_ms = self.headers.get("X-Zoo-Deadline-Ms")
+                try:
+                    dl = Deadline.from_ms(float(dl_ms)) \
+                        if dl_ms is not None else None
+                except ValueError:
+                    self._reply(400, {"error": "malformed "
+                                               "X-Zoo-Deadline-Ms"})
+                    return
                 n = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(n).decode()
                 try:
@@ -163,6 +177,12 @@ class FrontEnd:
                     return
                 preds = []
                 for inst in instances:
+                    if dl is not None and dl.expired():
+                        _deadline_expired.labels(stage="http").inc()
+                        self._reply(504, {
+                            "error": "deadline expired", "expired": True,
+                            "completed": len(preds)})
+                        return
                     data = {k: np.asarray(v, np.float32)
                             for k, v in inst.items()}
                     out = front.iq.predict(data)

@@ -1,0 +1,260 @@
+"""Serving replica groups: N supervised ``ServingServer`` processes.
+
+The reference platform's Cluster Serving rode Flink's task-slot
+parallelism and checkpointing for availability; the TPU-native rebuild's
+single ``ServingServer`` front door made one process crash a full
+outage. This module is the replicated topology from Dean & Barroso's
+"The Tail at Scale" (CACM 2013): a :class:`ReplicaGroup` launches N
+replicas of the SAME model directory on per-replica ports, supervises
+them with :class:`zoo_tpu.orca.bootstrap.ProcessMonitor` (dead replicas
+are respawned on their original port, heartbeat files catch hangs), and
+exposes the obs ``/healthz`` door per replica so an external probe sees
+exactly what the supervisor sees. The client half —
+round-robin + failover + hedging over the group's endpoints — is
+:class:`zoo_tpu.serving.ha_client.HAServingClient`.
+
+One replica process = ``python -m zoo_tpu.serving.ha --model ... --port
+...`` (what :class:`ReplicaGroup` spawns): it loads the model, starts a
+``ServingServer`` with a circuit breaker, a ``MetricsExporter``
+(``/metrics`` + ``/healthz``), the heartbeat thread, and a SIGTERM
+drain handler, then blocks until drained.
+
+``synthetic:<kind>[:delay_ms]`` model specs (``synthetic:double:5`` →
+y = 2x after 5 ms) serve without importing jax — chaos smokes and
+transport benches boot a 3-replica group in well under a second.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import urllib.request
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from zoo_tpu.obs.metrics import gauge
+from zoo_tpu.util.resilience import RetryPolicy
+
+_replicas_healthy = gauge(
+    "zoo_serve_replicas_healthy",
+    "Serving replicas whose /healthz answered ok at the last probe")
+_replica_restarts = gauge(
+    "zoo_serve_replica_restarts",
+    "Total replica respawns performed by this ReplicaGroup's supervisor")
+
+SYNTHETIC_PREFIX = "synthetic:"
+
+
+class SyntheticModel:
+    """jax-free stand-in model for chaos tests and transport benches.
+
+    ``synthetic:double[:delay_ms]`` → y = 2x after an optional per-batch
+    delay. Deterministic, so a client can verify every response
+    (``out == 2 * in``) while replicas are being SIGKILLed under it."""
+
+    def __init__(self, factor: float = 2.0, delay_ms: float = 0.0):
+        self.factor = factor
+        self.delay = delay_ms / 1000.0
+
+    @classmethod
+    def parse(cls, spec: str) -> "SyntheticModel":
+        parts = spec[len(SYNTHETIC_PREFIX):].split(":")
+        kind = parts[0] or "double"
+        if kind != "double":
+            raise ValueError(f"unknown synthetic model {spec!r} "
+                             "(supported: synthetic:double[:delay_ms])")
+        delay_ms = float(parts[1]) if len(parts) > 1 else 0.0
+        return cls(2.0, delay_ms)
+
+    def predict(self, x, batch_size=None):
+        if self.delay:
+            time.sleep(self.delay)
+        return np.asarray(x) * self.factor
+
+
+def load_serving_model(spec: str, batch_size: int = 8):
+    """A model from a replica spec: ``synthetic:*`` (jax-free),
+    a TF SavedModel directory, or a serialized ``.zoo`` file (the same
+    resolution order as ``zoo_tpu.serving.run``)."""
+    if spec.startswith(SYNTHETIC_PREFIX):
+        return SyntheticModel.parse(spec)
+    from zoo_tpu.pipeline.inference.inference_model import InferenceModel
+    im = InferenceModel(supported_concurrent_num=2)
+    if os.path.isdir(spec):
+        im.load_tf(spec, batch_size=batch_size)
+    else:
+        im.load(spec, batch_size=batch_size)
+    return im
+
+
+def _free_ports(n: int) -> List[int]:
+    """n distinct free ports, all bound while drawing so no duplicates."""
+    import socket as _socket
+    socks = [_socket.socket() for _ in range(n)]
+    try:
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
+class ReplicaGroup:
+    """Launch and supervise ``num_replicas`` serving processes of one
+    model.
+
+    Ports are fixed at construction (drawn fresh unless ``ports`` is
+    given), so a replica that crashes is respawned on its ORIGINAL port
+    — clients keep a stable endpoint list across restarts and simply
+    fail over while the seat is empty. Each replica additionally serves
+    the obs door (``/metrics`` + ``/healthz``) on its own metrics port;
+    :meth:`healthz` probes them and publishes the
+    ``zoo_serve_replicas_healthy`` gauge.
+
+    ``max_restarts`` is the per-replica respawn budget
+    (:class:`ProcessMonitor` semantics); ``heartbeat_timeout`` enables
+    hung-replica detection on top of crash detection."""
+
+    def __init__(self, model: str, num_replicas: int = 3,
+                 host: str = "127.0.0.1",
+                 ports: Optional[Sequence[int]] = None,
+                 batch_size: int = 8, max_wait_ms: float = 5.0,
+                 max_restarts: int = 3, log_dir: Optional[str] = None,
+                 env: Optional[Dict[str, str]] = None,
+                 heartbeat_timeout: Optional[float] = None):
+        from zoo_tpu.orca.bootstrap import ProcessMonitor, WorkerProcess
+
+        if num_replicas < 1:
+            raise ValueError("num_replicas must be >= 1")
+        self.model = model
+        self.host = host
+        self.num_replicas = int(num_replicas)
+        if ports is not None and len(ports) != self.num_replicas:
+            raise ValueError(
+                f"ports has {len(ports)} entries for "
+                f"{self.num_replicas} replicas")
+        drawn = _free_ports(2 * self.num_replicas)
+        self.ports = list(ports) if ports is not None \
+            else drawn[:self.num_replicas]
+        self.metrics_ports = drawn[self.num_replicas:]
+        self.log_dir = log_dir
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        workers = []
+        for i, (port, mport) in enumerate(zip(self.ports,
+                                              self.metrics_ports)):
+            wenv = dict(os.environ)
+            wenv.update(env or {})
+            wenv["PYTHONPATH"] = root + os.pathsep + \
+                wenv.get("PYTHONPATH", "")
+            hb = os.path.join(log_dir, f"replica-{i}.hb") if log_dir \
+                else None
+            workers.append(WorkerProcess(
+                cmd=[sys.executable, "-m", "zoo_tpu.serving.replica",
+                     "--model", model, "--host", host,
+                     "--port", str(port), "--metrics-port", str(mport),
+                     "--batch-size", str(batch_size),
+                     "--max-wait-ms", str(max_wait_ms)],
+                env=wenv, name=f"serving-replica-{i}", log_dir=log_dir,
+                heartbeat_file=hb))
+        self._monitor = ProcessMonitor(
+            workers, max_restarts=max_restarts,
+            heartbeat_timeout=heartbeat_timeout)
+        self._started = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, timeout: float = 120.0) -> "ReplicaGroup":
+        """Spawn every replica and block until each one answers a TCP
+        ``ping`` (readiness, not just liveness — the model is loaded and
+        the batcher is running). ``timeout`` covers the whole group; a
+        real model pays one jax import per replica, synthetic models are
+        ready in milliseconds."""
+        from zoo_tpu.serving.tcp_client import _Connection
+        from zoo_tpu.util.resilience import RetryError
+
+        self._monitor.start()
+        self._started = True
+        deadline = time.monotonic() + timeout
+        for i, port in enumerate(self.ports):
+            while True:
+                try:
+                    conn = _Connection(
+                        self.host, port,
+                        retry=RetryPolicy(max_attempts=1))
+                    resp = conn.rpc({"op": "ping"})
+                    conn.close()
+                    if resp.get("ok"):
+                        break
+                except (OSError, RetryError):
+                    # refused (still booting) or connected-then-died
+                    # (killed mid-boot; the supervisor is respawning it)
+                    # — keep polling until the group timeout
+                    pass
+                if time.monotonic() > deadline:
+                    self.stop()
+                    raise TimeoutError(
+                        f"replica {i} ({self.host}:{port}) not ready "
+                        f"after {timeout:.0f}s")
+                time.sleep(0.05)
+        return self
+
+    def stop(self):
+        if self._started:
+            self._monitor.stop()
+
+    # -- topology ----------------------------------------------------------
+    def endpoints(self) -> List[Tuple[str, int]]:
+        """The stable ``(host, port)`` list clients round-robin over —
+        unchanged across replica restarts."""
+        return [(self.host, p) for p in self.ports]
+
+    def client(self, **kwargs):
+        """An :class:`HAServingClient` over this group's endpoints."""
+        from zoo_tpu.serving.ha_client import HAServingClient
+        return HAServingClient(self.endpoints(), **kwargs)
+
+    # -- health ------------------------------------------------------------
+    def healthz(self, timeout: float = 2.0) -> List[Optional[Dict]]:
+        """Probe every replica's obs ``/healthz`` door; ``None`` for a
+        replica that did not answer. Publishes the
+        ``zoo_serve_replicas_healthy`` gauge and the restart tally."""
+        out: List[Optional[Dict]] = []
+        for mport in self.metrics_ports:
+            try:
+                with urllib.request.urlopen(
+                        f"http://{self.host}:{mport}/healthz",
+                        timeout=timeout) as resp:
+                    out.append(json.loads(resp.read().decode()))
+            except Exception:  # noqa: BLE001 — a down replica is data
+                out.append(None)
+        _replicas_healthy.set(
+            sum(1 for h in out if h is not None and h.get("ok")))
+        _replica_restarts.set(self.restarts())
+        return out
+
+    def restarts(self) -> int:
+        return sum(w.restarts for w in self._monitor.workers)
+
+    def alive(self) -> List[str]:
+        return self._monitor.alive()
+
+    def kill_replica(self, i: int, sig: Optional[int] = None):
+        """SIGKILL replica ``i`` (chaos hook): the supervisor respawns
+        it on the same port within its restart budget while clients
+        fail over."""
+        import signal as _signal
+        w = self._monitor.workers[i]
+        if w.proc is not None and w.proc.poll() is None:
+            os.kill(w.proc.pid, sig or _signal.SIGKILL)
+
+
+# The single-replica process entry lives in zoo_tpu.serving.replica (a
+# module the package __init__ does NOT import, so `python -m` runs it
+# without the sys.modules double-import warning).

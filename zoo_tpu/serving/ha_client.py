@@ -1,0 +1,359 @@
+"""High-availability serving client: failover + hedging over a replica
+group.
+
+The client half of docs/serving_ha.md, shaped after Dean & Barroso's
+"The Tail at Scale" (CACM 2013):
+
+* **round-robin over healthy replicas** — a per-endpoint
+  :class:`CircuitBreaker` takes a replica out of rotation after
+  consecutive transport failures and probes it back in after a short
+  recovery window, so a dead seat costs one failed attempt, not one per
+  request;
+* **failover** — a transport error (reset, refused, retry budget
+  exhausted) or a retryable shed (``queue full`` / ``draining`` /
+  breaker-open door) moves the request to the next replica inside the
+  SAME deadline budget;
+* **hedged requests** — when the primary has not answered after a
+  p95-tracked delay, ONE duplicate is sent to a different replica and
+  the first answer wins. The duplicate carries the SAME request id, so
+  a hedge that lands on the same replica (or a retry racing its
+  original) is absorbed by the server's dedup cache instead of
+  re-executing the model, and the loser's late frame is discarded by
+  the id check in ``_Connection`` — never mismatched to another caller.
+
+Every request carries one id and one :class:`Deadline` end to end; the
+client re-stamps the *remaining* budget into each attempt, and raises
+:class:`DeadlineExceeded` the moment the budget is gone rather than
+letting attempts pile past it.
+"""
+
+from __future__ import annotations
+
+import os
+import queue as _queue
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from zoo_tpu.obs.metrics import counter, histogram
+from zoo_tpu.serving.tcp_client import _Connection
+from zoo_tpu.util.resilience import (
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceeded,
+    RetryError,
+    RetryPolicy,
+    env_float,
+)
+
+__all__ = ["HAServingClient", "NoReplicaAvailable"]
+
+_hedge = counter(
+    "zoo_serve_hedge_total", "Hedged duplicates, by event (fired = "
+    "duplicate sent after the hedge delay; won = the duplicate's answer "
+    "was the one used)", labels=("event",))
+_failover = counter(
+    "zoo_serve_failover_total",
+    "Requests moved to another replica after a transport failure or a "
+    "retryable shed")
+_attempt_seconds = histogram(
+    "zoo_serve_client_attempt_seconds",
+    "Per-attempt client-observed RPC latency (successful attempts; "
+    "feeds the hedge-delay p95)")
+
+
+class NoReplicaAvailable(ConnectionError):
+    """Every replica in the group failed or shed this request inside its
+    budget; ``__cause__`` / ``last_error`` is the final failure.
+    A :class:`ConnectionError`, so outer retry layers treat it as
+    transient."""
+
+    def __init__(self, msg: str, last_error=None):
+        super().__init__(msg)
+        self.last_error = last_error
+
+
+class _LatencyTracker:
+    """Ring of recent successful-attempt latencies; p95 drives the hedge
+    delay (hedge only the slowest ~5%, the Tail-at-Scale budget that
+    bounds duplicate load to a few percent)."""
+
+    def __init__(self, size: int = 128, min_samples: int = 16):
+        self._ring: List[float] = []
+        self._size = size
+        self._min = min_samples
+        self._i = 0
+        self._lock = threading.Lock()
+
+    def add(self, dt: float):
+        with self._lock:
+            if len(self._ring) < self._size:
+                self._ring.append(dt)
+            else:
+                self._ring[self._i] = dt
+                self._i = (self._i + 1) % self._size
+        _attempt_seconds.observe(dt)
+
+    def p95(self) -> Optional[float]:
+        with self._lock:
+            if len(self._ring) < self._min:
+                return None
+            s = sorted(self._ring)
+        return s[min(len(s) - 1, int(0.95 * len(s)))]
+
+
+class _Endpoint:
+    """One replica seat: address + breaker + a small idle-connection
+    stack (a hedge needs a second live connection while the primary's
+    is blocked in recv, so connections are checked out per attempt)."""
+
+    def __init__(self, host: str, port: int, tls: bool, cafile,
+                 verify: bool, breaker: CircuitBreaker):
+        self.host, self.port = host, int(port)
+        self._tls, self._cafile, self._verify = tls, cafile, verify
+        self.breaker = breaker
+        self._idle: List[_Connection] = []
+        self._lock = threading.Lock()
+
+    def acquire(self) -> _Connection:
+        with self._lock:
+            if self._idle:
+                return self._idle.pop()
+        # in-place transport retries are the failover loop's job: one
+        # attempt per checkout keeps hedge timing predictable
+        return _Connection(self.host, self.port, tls=self._tls,
+                           cafile=self._cafile, verify=self._verify,
+                           retry=RetryPolicy(max_attempts=1))
+
+    def release(self, conn: _Connection, healthy: bool):
+        if not healthy:
+            conn.close()
+            return
+        with self._lock:
+            if len(self._idle) < 4:
+                self._idle.append(conn)
+                return
+        conn.close()
+
+    def close(self):
+        with self._lock:
+            conns, self._idle = self._idle, []
+        for c in conns:
+            c.close()
+
+    def __repr__(self):
+        return f"_Endpoint({self.host}:{self.port})"
+
+
+class HAServingClient:
+    """``HAServingClient(group.endpoints()).predict(x)`` — one logical
+    request over N replicas.
+
+    Knob defaults come from the ``ZOO_SERVE_*`` env
+    (docs/serving_ha.md): ``deadline_ms`` (``ZOO_SERVE_DEADLINE_MS``,
+    default 30 000; <= 0 disables), ``hedge`` (``ZOO_SERVE_HEDGE``,
+    default on), ``hedge_delay_ms`` (``ZOO_SERVE_HEDGE_DELAY_MS``,
+    default 0 = track p95 and use it, starting from 50 ms until enough
+    samples), breaker recovery (``ZOO_SERVE_BREAKER_RECOVERY``,
+    default 1 s — a dead replica is re-probed quickly because its
+    supervisor is respawning it on the same port)."""
+
+    def __init__(self, endpoints: Sequence[Tuple[str, int]],
+                 deadline_ms: Optional[float] = None,
+                 hedge: Optional[bool] = None,
+                 hedge_delay_ms: Optional[float] = None,
+                 tls: bool = False, cafile: Optional[str] = None,
+                 verify: bool = True,
+                 breaker_failures: int = 2,
+                 breaker_recovery: Optional[float] = None):
+        if not endpoints:
+            raise ValueError("HAServingClient needs at least one endpoint")
+        if deadline_ms is None:
+            deadline_ms = env_float("ZOO_SERVE_DEADLINE_MS", 30000.0)
+        self.deadline_ms = deadline_ms if deadline_ms > 0 else None
+        if hedge is None:
+            hedge = os.environ.get("ZOO_SERVE_HEDGE", "1") not in (
+                "0", "false", "off")
+        self.hedge = bool(hedge)
+        if hedge_delay_ms is None:
+            hedge_delay_ms = env_float("ZOO_SERVE_HEDGE_DELAY_MS", 0.0)
+        self._hedge_delay_ms = hedge_delay_ms  # 0 = p95-tracked
+        recovery = breaker_recovery if breaker_recovery is not None \
+            else env_float("ZOO_SERVE_BREAKER_RECOVERY", 1.0)
+        self._eps = [
+            _Endpoint(h, p, tls, cafile, verify,
+                      CircuitBreaker(failure_threshold=breaker_failures,
+                                     recovery_timeout=recovery))
+            for h, p in endpoints]
+        self._rr = 0
+        self._rr_lock = threading.Lock()
+        self._lat = _LatencyTracker()
+
+    # -- public API --------------------------------------------------------
+    def predict(self, x, deadline_ms: Optional[float] = None,
+                uri: str = "_sync_") -> np.ndarray:
+        resp = self.rpc({"op": "predict", "uri": uri,
+                         "data": np.asarray(x)}, deadline_ms=deadline_ms)
+        if "error" in resp:
+            raise RuntimeError(resp["error"])
+        return resp["result"]
+
+    def stats(self) -> List[Optional[Dict]]:
+        """Per-replica stage-timer stats (None for a down replica)."""
+        out = []
+        for ep in self._eps:
+            conn = None
+            try:
+                conn = ep.acquire()
+                out.append(conn.rpc({"op": "stats"}))
+                ep.release(conn, healthy=True)
+            except (OSError, RetryError):
+                # RetryError is how a single-attempt _Connection reports
+                # a transport failure; the conn (a pooled one may have
+                # gone stale since its last use) must not return to the
+                # idle stack
+                if conn is not None:
+                    ep.release(conn, healthy=False)
+                out.append(None)
+        return out
+
+    def close(self):
+        for ep in self._eps:
+            ep.close()
+
+    # -- the hedged failover core -----------------------------------------
+    def _plan(self) -> List[_Endpoint]:
+        """Rotation for one request: every endpoint exactly once,
+        healthy (breaker-admitted) seats first, starting at the
+        round-robin cursor. Open-breaker seats stay at the tail as a
+        last resort so a fully-dark group still probes rather than
+        refusing outright."""
+        with self._rr_lock:
+            start = self._rr
+            self._rr = (self._rr + 1) % len(self._eps)
+        order = [self._eps[(start + i) % len(self._eps)]
+                 for i in range(len(self._eps))]
+        healthy = [ep for ep in order if ep.breaker.allow()]
+        dark = [ep for ep in order if ep not in healthy]
+        return healthy + dark
+
+    def _hedge_delay(self) -> float:
+        if self._hedge_delay_ms > 0:
+            return self._hedge_delay_ms / 1000.0
+        p95 = self._lat.p95()
+        return p95 if p95 is not None else 0.05
+
+    def rpc(self, msg: Dict, deadline_ms: Optional[float] = None) -> Dict:
+        # own copy: the shared id must ride EVERY attempt of this call,
+        # but never leak into the caller's dict (a reused dict would
+        # carry a stale id into its next request and hit the server's
+        # dedup replay)
+        msg = dict(msg)
+        msg.setdefault("id", uuid.uuid4().hex)
+        dl = Deadline.from_ms(
+            deadline_ms if deadline_ms is not None else self.deadline_ms)
+        candidates = self._plan()
+        results: "_queue.Queue" = _queue.Queue()
+        in_flight = 0
+        last_err: Optional[BaseException] = None
+        hedge_ep: Optional[_Endpoint] = None  # who got the duplicate
+
+        def fire(ep: _Endpoint):
+            nonlocal in_flight
+            in_flight += 1
+
+            def run():
+                t0 = time.perf_counter()
+                try:
+                    conn = ep.acquire()
+                except OSError as e:
+                    ep.breaker.record_failure()
+                    results.put(("err", ep, e))
+                    return
+                try:
+                    # per-attempt copy: each attempt stamps its own
+                    # remaining deadline_ms without racing the others
+                    resp = conn.rpc(dict(msg), deadline=dl)
+                except Exception as e:  # noqa: BLE001 — every attempt
+                    # failure must reach the arbiter; a leaked exception
+                    # would strand in_flight and hang the request
+                    ep.release(conn, healthy=False)
+                    if not isinstance(e, DeadlineExceeded):
+                        # RetryError wraps the underlying transport
+                        # failure; either way the seat just failed
+                        ep.breaker.record_failure()
+                    results.put(("err", ep, e))
+                    return
+                ep.release(conn, healthy=True)
+                results.put(("ok", ep, resp, time.perf_counter() - t0))
+
+            threading.Thread(target=run, daemon=True,
+                             name="zoo-ha-attempt").start()
+
+        fire(candidates.pop(0))
+        hedged = False
+        while in_flight:
+            # phase 1: wait only up to the hedge delay, then duplicate
+            # to the next replica (same id — the server dedups)
+            can_hedge = (self.hedge and not hedged and candidates
+                         and (dl is None or not dl.expired()))
+            if can_hedge:
+                delay = self._hedge_delay()
+                if dl is not None:
+                    delay = min(delay, max(0.0, dl.remaining()))
+                try:
+                    item = results.get(timeout=delay)
+                except _queue.Empty:
+                    hedged = True
+                    _hedge.labels(event="fired").inc()
+                    hedge_ep = candidates.pop(0)
+                    fire(hedge_ep)
+                    continue
+            else:
+                timeout = None
+                if dl is not None:
+                    timeout = max(0.0, dl.remaining()) + 0.5
+                try:
+                    item = results.get(timeout=timeout)
+                except _queue.Empty:
+                    raise DeadlineExceeded(
+                        f"deadline expired with {in_flight} attempt(s) "
+                        "still in flight") from last_err
+            in_flight -= 1
+            if item[0] == "ok":
+                _kind, ep, resp, dt = item
+                if resp.get("shed") and resp.get("retryable"):
+                    # overload shed: the replica is alive but full —
+                    # fail over without charging its breaker
+                    last_err = NoReplicaAvailable(
+                        resp.get("error", "shed"), None)
+                    if candidates and (dl is None or not dl.expired()):
+                        _failover.inc()
+                        fire(candidates.pop(0))
+                    continue
+                if resp.get("expired"):
+                    raise DeadlineExceeded(resp.get(
+                        "error", "server reported deadline expired"))
+                ep.breaker.record_success()
+                self._lat.add(dt)
+                if ep is hedge_ep:
+                    # the hedged DUPLICATE answered first (a failover
+                    # attempt winning is not a hedge win)
+                    _hedge.labels(event="won").inc()
+                return resp
+            _kind, ep, err = item
+            last_err = err
+            if isinstance(err, DeadlineExceeded):
+                raise err
+            if candidates and (dl is None or not dl.expired()):
+                _failover.inc()
+                fire(candidates.pop(0))
+        if dl is not None and dl.expired():
+            raise DeadlineExceeded(
+                "deadline expired during failover") from last_err
+        raise NoReplicaAvailable(
+            f"all {len(self._eps)} replica(s) failed or shed the "
+            f"request: {last_err!r}", last_err)

@@ -1,0 +1,70 @@
+"""One serving replica process (spawned by
+:class:`zoo_tpu.serving.ha.ReplicaGroup`).
+
+``python -m zoo_tpu.serving.replica --model m.zoo --port 8980`` loads
+the model (``synthetic:*`` specs stay jax-free), starts a
+:class:`ServingServer` behind a circuit breaker, the obs door
+(``/metrics`` + ``/healthz``) on ``--metrics-port``, the heartbeat
+thread the supervisor watches, and a SIGTERM drain handler, then blocks
+until drained. Kept OUT of the ``zoo_tpu.serving`` package ``__init__``
+so ``python -m`` execution never double-imports the module.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def serve_replica(ns) -> int:
+    from zoo_tpu.obs.exporters import MetricsExporter
+    from zoo_tpu.serving.ha import load_serving_model
+    from zoo_tpu.serving.server import ServingServer
+    from zoo_tpu.util.resilience import (
+        CircuitBreaker,
+        start_heartbeat_thread,
+    )
+
+    start_heartbeat_thread()  # no-op unless the supervisor set the env
+    model = load_serving_model(ns.model, batch_size=ns.batch_size)
+    server = ServingServer(
+        model, host=ns.host, port=ns.port, batch_size=ns.batch_size,
+        max_wait_ms=ns.max_wait_ms,
+        breaker=CircuitBreaker(failure_threshold=5,
+                               recovery_timeout=5.0)).start()
+    exporter = None
+    if ns.metrics_port >= 0:
+        exporter = MetricsExporter(host=ns.host,
+                                   port=ns.metrics_port).start()
+    server.install_drain_handler()
+    print(f"REPLICA READY {server.host}:{server.port}"
+          + (f" metrics={exporter.port}" if exporter else ""),
+          flush=True)
+    try:
+        while not server._stop.is_set():
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        server.drain(timeout=10.0)
+    if exporter is not None:
+        exporter.stop()
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m zoo_tpu.serving.replica",
+        description="one serving replica (spawned by ReplicaGroup)")
+    ap.add_argument("--model", required=True,
+                    help=".zoo file, SavedModel dir, or synthetic:* spec")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--metrics-port", type=int, default=0,
+                    help="obs /metrics + /healthz door (-1 disables)")
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--max-wait-ms", type=float, default=5.0)
+    return serve_replica(ap.parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,6 +48,18 @@ def main(argv=None) -> int:
                          "boot the in-process RESP server; auto: probe "
                          "briefly, then fall back to embedded")
     ap.add_argument("--redis-wait", type=float, default=60.0)
+    ap.add_argument("--tcp-replicas", type=int, default=0,
+                    help="HA mode: serve over the TCP door via a "
+                         "ReplicaGroup of N supervised replica "
+                         "processes instead of the Redis pipeline "
+                         "(docs/serving_ha.md); each replica loads the "
+                         "model itself")
+    ap.add_argument("--tcp-port", type=int, default=0,
+                    help="base TCP port for --tcp-replicas (replica i "
+                         "serves tcp-port+i; 0 = ephemeral ports, "
+                         "printed at startup)")
+    ap.add_argument("--tcp-max-restarts", type=int, default=3,
+                    help="per-replica respawn budget in HA mode")
     ap.add_argument("--encrypted", action="store_true",
                     help="the model file is encrypted at rest (reference "
                          "trusted serving); key material comes from "
@@ -69,6 +81,29 @@ def main(argv=None) -> int:
         ns.batch_size = int(cfg.get("batchSize", ns.batch_size))
     if not ns.model:
         ap.error("--model (or a config with modelPath) is required")
+
+    if ns.tcp_replicas > 0:
+        # HA mode: the replicas load the model themselves (one process
+        # each, supervised + respawned on a fixed port); this process is
+        # only the group supervisor — no Redis, no HTTP frontend
+        from zoo_tpu.serving.ha import ReplicaGroup
+        ports = [ns.tcp_port + i for i in range(ns.tcp_replicas)] \
+            if ns.tcp_port else None
+        group = ReplicaGroup(ns.model, num_replicas=ns.tcp_replicas,
+                             ports=ports, batch_size=ns.batch_size,
+                             max_restarts=ns.tcp_max_restarts)
+        group.start()
+        print("serving-ha: endpoints "
+              + ",".join(f"{h}:{p}" for h, p in group.endpoints()),
+              flush=True)
+        stop = threading.Event()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            signal.signal(sig, lambda *_: stop.set())
+        stop.wait()
+        # replicas drain on their own SIGTERM (ProcessMonitor.stop
+        # group-kills with SIGTERM first, SIGKILL after a grace)
+        group.stop()
+        return 0
 
     from zoo_tpu.pipeline.inference.inference_model import InferenceModel
     from zoo_tpu.serving.client import InputQueue

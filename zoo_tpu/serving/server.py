@@ -20,6 +20,8 @@ there is no authentication on this door (see docs/serving.md).
 
 from __future__ import annotations
 
+import collections
+import os
 import queue
 import socket
 import socketserver
@@ -32,7 +34,13 @@ import numpy as np
 
 from zoo_tpu.obs.metrics import StatTimer, counter, gauge, histogram
 from zoo_tpu.obs.tracing import span
-from zoo_tpu.util.resilience import CircuitBreaker, fault_point
+from zoo_tpu.util.resilience import (
+    CircuitBreaker,
+    Deadline,
+    env_float,
+    env_int,
+    fault_point,
+)
 
 # StageTimer and profiling's PhaseTimer were copy-pasted twins of the
 # reference's Timer.scala; both are now obs.StatTimer. The old name stays
@@ -51,7 +59,22 @@ _stage_seconds = histogram(
     "round-trip)", labels=("stage",))
 _requests = counter(
     "zoo_serving_requests_total", "Predict requests by outcome "
-    "(ok / error / shed)", labels=("outcome",))
+    "(ok / error / shed / expired)", labels=("outcome",))
+# serving-HA families (docs/serving_ha.md): the per-cause shed tally the
+# admission door keeps, the per-stage deadline-drop tally, and the
+# request-id dedup tally that makes retries/hedges idempotent
+_shed = counter(
+    "zoo_serve_shed_total", "Requests rejected at the admission door, "
+    "by cause (queue_full / breaker_open / draining)", labels=("reason",))
+_deadline_expired = counter(
+    "zoo_serve_deadline_expired_total",
+    "Requests dropped because their propagated deadline expired, by the "
+    "stage that caught it (admission / batch / reply / http)",
+    labels=("stage",))
+_dedup = counter(
+    "zoo_serve_dedup_total", "Duplicate request ids absorbed without "
+    "re-executing (inflight = joined a pending request, replay = served "
+    "from the completed-request cache)", labels=("kind",))
 
 
 def _send_msg(sock: socket.socket, obj):
@@ -88,14 +111,48 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytearray]:
 
 
 class _Request:
-    __slots__ = ("uri", "data", "event", "result", "error")
+    __slots__ = ("uri", "data", "event", "result", "error", "id",
+                 "deadline", "expired")
 
-    def __init__(self, uri: str, data):
+    def __init__(self, uri: str, data, rid: Optional[str] = None,
+                 deadline: Optional[Deadline] = None):
         self.uri = uri
         self.data = data
         self.event = threading.Event()
         self.result = None
         self.error = None
+        self.id = rid
+        self.deadline = deadline
+        self.expired = False
+
+
+class _DedupCache:
+    """Request-id → :class:`_Request` LRU, the server half of idempotent
+    retries/hedges: a duplicate id joins the pending request (or replays
+    the finished one) instead of executing the model twice. Entries keep
+    their result arrays until evicted, so the capacity knob
+    (``ZOO_SERVE_DEDUP_CACHE``) bounds memory, not correctness — an
+    evicted id simply re-executes, which is safe for a pure predict."""
+
+    def __init__(self, capacity: int):
+        self._cap = int(capacity)
+        self._lock = threading.Lock()
+        self._entries: "collections.OrderedDict[str, _Request]" = \
+            collections.OrderedDict()
+
+    def get(self, rid: str) -> Optional[_Request]:
+        with self._lock:
+            req = self._entries.get(rid)
+            if req is not None:
+                self._entries.move_to_end(rid)
+            return req
+
+    def put(self, rid: str, req: _Request):
+        with self._lock:
+            self._entries[rid] = req
+            self._entries.move_to_end(rid)
+            while len(self._entries) > self._cap:
+                self._entries.popitem(last=False)
 
 
 class ServingServer:
@@ -115,7 +172,11 @@ class ServingServer:
                  batch_size: int = 8, max_wait_ms: float = 5.0,
                  num_replicas: int = 1, models=None,
                  certfile: str = None, keyfile: str = None,
-                 breaker: Optional[CircuitBreaker] = None):
+                 breaker: Optional[CircuitBreaker] = None,
+                 max_queue: Optional[int] = None,
+                 request_timeout: Optional[float] = None,
+                 handshake_timeout: Optional[float] = None,
+                 dedup_cache: Optional[int] = None):
         """``certfile``/``keyfile``: serve over TLS — the trusted-
         serving door of the reference's PPML trusted-realtime-ml story
         (``ppml/trusted-realtime-ml/``: encrypted transport in front of
@@ -127,9 +188,29 @@ class ServingServer:
         are rejected immediately at the front door (error mentions
         "shedding load") instead of queueing behind a dead model; the
         breaker half-opens after its recovery timeout and closes again on
-        the first successful batch."""
+        the first successful batch.
+
+        Admission / deadline knobs (``None`` → the ``ZOO_SERVE_*`` env,
+        docs/serving_ha.md): ``max_queue`` bounds the batcher queue —
+        past it predicts are rejected at the door with
+        ``retryable: True`` and a ``retry_after_ms`` hint instead of
+        parking behind work the server cannot finish in time (0 =
+        unbounded). ``request_timeout`` is the per-request reply bound
+        when the client propagated NO deadline (requests that carry
+        ``deadline_ms`` use the deadline itself). ``handshake_timeout``
+        bounds the TLS handshake. ``dedup_cache`` sizes the request-id
+        LRU that makes client retries/hedges idempotent (0 = off)."""
         self.model = model
         self.breaker = breaker
+        self.max_queue = max_queue if max_queue is not None else \
+            env_int("ZOO_SERVE_MAX_QUEUE", 1024)
+        self.request_timeout = request_timeout if request_timeout \
+            is not None else env_float("ZOO_SERVE_REQUEST_TIMEOUT", 120.0)
+        self.handshake_timeout = handshake_timeout if handshake_timeout \
+            is not None else env_float("ZOO_SERVE_HANDSHAKE_TIMEOUT", 10.0)
+        cap = dedup_cache if dedup_cache is not None else \
+            env_int("ZOO_SERVE_DEDUP_CACHE", 1024)
+        self._dedup_cache = _DedupCache(cap) if cap > 0 else None
         self._replicas = list(models) if models else \
             [model] * max(1, int(num_replicas))
         self.batch_size = batch_size
@@ -175,7 +256,8 @@ class ServingServer:
                 # it would run on the accept loop, where one idle client
                 # blocks every other connection (and stop())
                 if outer._ssl_ctx is not None:
-                    self.request.settimeout(10.0)  # handshake bound
+                    # handshake bound (ZOO_SERVE_HANDSHAKE_TIMEOUT)
+                    self.request.settimeout(outer.handshake_timeout)
                     self.request = outer._ssl_ctx.wrap_socket(
                         self.request, server_side=True)
                     self.request.settimeout(None)
@@ -190,64 +272,156 @@ class ServingServer:
                     except OSError:
                         pass
 
+            def _reply(self, msg, extra):
+                """One response frame; the request id (when the client
+                sent one) is ALWAYS echoed so the client can discard a
+                stale attempt's frame instead of mismatching it."""
+                out = {}
+                if "uri" in msg:
+                    out["uri"] = msg.get("uri")
+                if msg.get("id") is not None:
+                    out["id"] = msg["id"]
+                out.update(extra)
+                _send_msg(self.request, out)
+
+            def _await_and_reply(self, msg, req, deadline):
+                """Reply stage: wait for the batcher to resolve ``req``
+                under a deadline-derived bound (the propagated deadline
+                when present, else ZOO_SERVE_REQUEST_TIMEOUT) and send
+                the outcome. Used by fresh requests and by duplicates
+                joining an in-flight/completed request."""
+                if deadline is not None:
+                    done = req.event.wait(
+                        timeout=max(0.0, deadline.remaining()))
+                else:
+                    done = req.event.wait(timeout=outer.request_timeout)
+                if not done:
+                    if deadline is not None:
+                        # post-inference reply enforcement: the budget
+                        # ran out while the request sat in the queue or
+                        # the batch — answer "expired" NOW; the batcher
+                        # will drop (or has computed-and-wasted) the
+                        # stale entry on its own
+                        _requests.labels(outcome="expired").inc()
+                        _deadline_expired.labels(stage="reply").inc()
+                        self._reply(msg, {
+                            "expired": True,
+                            "error": "deadline expired before the batch "
+                                     "resolved (request dropped)"})
+                    else:
+                        _requests.labels(outcome="error").inc()
+                        self._reply(msg, {
+                            "error": "timeout waiting for batch inference "
+                                     "(first request may be paying XLA "
+                                     "compile; bound is "
+                                     "$ZOO_SERVE_REQUEST_TIMEOUT "
+                                     f"= {outer.request_timeout:g}s)"})
+                elif req.error is not None:
+                    if req.expired:
+                        _requests.labels(outcome="expired").inc()
+                        self._reply(msg, {"expired": True,
+                                          "error": req.error})
+                    else:
+                        _requests.labels(outcome="error").inc()
+                        self._reply(msg, {"error": req.error})
+                else:
+                    _requests.labels(outcome="ok").inc()
+                    self._reply(msg, {"result": req.result})
+
+            def _handle_predict(self, msg):
+                rid = msg.get("id")
+                deadline = Deadline.from_ms(msg.get("deadline_ms"))
+                # 1. idempotency: a duplicate id (client retry after a
+                # mid-RPC reset, or a hedge landing on the same replica)
+                # joins the original request — never a second execution
+                if rid is not None and outer._dedup_cache is not None:
+                    prior = outer._dedup_cache.get(rid)
+                    if prior is not None:
+                        _dedup.labels(
+                            kind="replay" if prior.event.is_set()
+                            else "inflight").inc()
+                        self._await_and_reply(msg, prior, deadline)
+                        return
+                # 2. breaker load shedding: fail fast at the door while
+                # the model is known-broken, instead of parking the
+                # caller behind a dead batcher
+                if outer.breaker is not None and \
+                        not outer.breaker.allow():
+                    _requests.labels(outcome="shed").inc()
+                    _shed.labels(reason="breaker_open").inc()
+                    self._reply(msg, {
+                        "shed": True, "retryable": True,
+                        "retry_after_ms": int(
+                            1000 * outer.breaker.recovery_timeout),
+                        "error": "server shedding load (circuit "
+                                 "open after repeated inference "
+                                 "failures; retry later)"})
+                    return
+                # 3. dead-on-arrival: the budget was spent in transit or
+                # upstream queues — reject instead of computing a result
+                # nobody is waiting for
+                if deadline is not None and deadline.expired():
+                    _requests.labels(outcome="expired").inc()
+                    _deadline_expired.labels(stage="admission").inc()
+                    self._reply(msg, {
+                        "expired": True,
+                        "error": "deadline expired before admission "
+                                 "(budget exhausted upstream)"})
+                    return
+                # 4. admission control: early rejection at the bounded
+                # queue, with a retry-after hint sized to the backlog —
+                # overload sheds at the door, not after a timeout
+                depth = outer._queue.qsize()
+                if outer.max_queue and depth >= outer.max_queue:
+                    _requests.labels(outcome="shed").inc()
+                    _shed.labels(reason="queue_full").inc()
+                    hint = int(outer.max_wait_ms * max(
+                        1, depth // max(1, outer.batch_size)))
+                    self._reply(msg, {
+                        "shed": True, "retryable": True,
+                        "retry_after_ms": hint,
+                        "error": f"server queue full ({depth} waiting, "
+                                 f"bound {outer.max_queue}); retry "
+                                 f"after ~{hint}ms or another replica"})
+                    return
+                with outer._accept_lock:
+                    draining = outer._draining.is_set()
+                    if not draining:
+                        outer._accepted += 1
+                if draining:
+                    # graceful drain: NEW work is turned away at
+                    # the door; everything already queued or
+                    # in-flight still completes and responds
+                    _requests.labels(outcome="shed").inc()
+                    _shed.labels(reason="draining").inc()
+                    self._reply(msg, {
+                        "shed": True, "draining": True,
+                        "retryable": True,
+                        "error": "server draining (shutting "
+                                 "down); retry another replica"})
+                    return
+                req = _Request(msg["uri"], msg["data"], rid=rid,
+                               deadline=deadline)
+                if rid is not None and outer._dedup_cache is not None:
+                    outer._dedup_cache.put(rid, req)
+                t0 = time.perf_counter()
+                outer._queue.put(req)
+                _queue_depth.set(outer._queue.qsize())
+                self._await_and_reply(msg, req, deadline)
+                outer.timers["total"].record(time.perf_counter() - t0)
+
             def handle(self):
                 while True:
                     msg = _recv_msg(self.request)
                     if msg is None:
                         return
                     if msg.get("op") == "predict":
-                        if outer.breaker is not None and \
-                                not outer.breaker.allow():
-                            # load shedding: fail fast at the door while
-                            # the model is known-broken, instead of
-                            # parking the caller behind a dead batcher
-                            _requests.labels(outcome="shed").inc()
-                            _send_msg(self.request, {
-                                "uri": msg.get("uri"), "shed": True,
-                                "error": "server shedding load (circuit "
-                                         "open after repeated inference "
-                                         "failures; retry later)"})
-                            continue
-                        with outer._accept_lock:
-                            draining = outer._draining.is_set()
-                            if not draining:
-                                outer._accepted += 1
-                        if draining:
-                            # graceful drain: NEW work is turned away at
-                            # the door; everything already queued or
-                            # in-flight still completes and responds
-                            _requests.labels(outcome="shed").inc()
-                            _send_msg(self.request, {
-                                "uri": msg.get("uri"), "shed": True,
-                                "draining": True,
-                                "error": "server draining (shutting "
-                                         "down); retry another replica"})
-                            continue
-                        req = _Request(msg["uri"], msg["data"])
-                        t0 = time.perf_counter()
-                        outer._queue.put(req)
-                        _queue_depth.set(outer._queue.qsize())
-                        done = req.event.wait(timeout=120)
-                        outer.timers["total"].record(
-                            time.perf_counter() - t0)
-                        if not done:
-                            req.error = ("timeout waiting for batch "
-                                         "inference (first request may be "
-                                         "paying XLA compile)")
-                        if req.error is not None:
-                            _requests.labels(outcome="error").inc()
-                            _send_msg(self.request,
-                                      {"uri": req.uri, "error": req.error})
-                        else:
-                            _requests.labels(outcome="ok").inc()
-                            _send_msg(self.request,
-                                      {"uri": req.uri, "result": req.result})
+                        self._handle_predict(msg)
                     elif msg.get("op") == "stats":
-                        _send_msg(self.request,
-                                  {k: t.stats()
-                                   for k, t in outer.timers.items()})
+                        self._reply(msg, {k: t.stats()
+                                          for k, t in outer.timers.items()})
                     elif msg.get("op") == "ping":
-                        _send_msg(self.request, {"ok": True})
+                        self._reply(msg, {"ok": True})
 
         class Server(socketserver.ThreadingTCPServer):
             daemon_threads = True
@@ -270,6 +444,19 @@ class ServingServer:
         self.host, self.port = self._server.server_address
 
     # -- batcher -----------------------------------------------------------
+    def _drop_expired(self, req: _Request):
+        """Answer an expired request WITHOUT computing it: the budget is
+        gone, so inference would be pure waste (the Tail-at-Scale "don't
+        do work nobody is waiting for" rule). Counts toward drain
+        accounting like any completed request."""
+        req.expired = True
+        req.error = ("deadline expired before inference "
+                     "(dropped unexecuted)")
+        _deadline_expired.labels(stage="batch").inc()
+        req.event.set()
+        with self._inflight_lock:
+            self._completed += 1
+
     def _batch_loop(self, model=None):
         model = model if model is not None else self.model
         while not self._stop.is_set():
@@ -277,20 +464,45 @@ class ServingServer:
                 first = self._queue.get(timeout=0.1)
             except queue.Empty:
                 continue
+            if first.deadline is not None and first.deadline.expired():
+                self._drop_expired(first)
+                continue
             t0 = time.perf_counter()
             batch: List[_Request] = [first]
             deadline = time.perf_counter() + self.max_wait_ms / 1000.0
             while len(batch) < self.batch_size:
                 remaining = deadline - time.perf_counter()
+                # the batch window never burns a member's remaining
+                # budget: the tightest propagated deadline in the batch
+                # caps how long we keep assembling
+                tightest = min(
+                    (r.deadline.remaining() for r in batch
+                     if r.deadline is not None), default=remaining)
+                remaining = min(remaining, tightest)
                 if remaining <= 0:
                     break
                 try:
-                    batch.append(self._queue.get(timeout=remaining))
+                    nxt = self._queue.get(timeout=remaining)
                 except queue.Empty:
                     break
+                if nxt.deadline is not None and nxt.deadline.expired():
+                    self._drop_expired(nxt)
+                    continue
+                batch.append(nxt)
+            # final pre-inference gate: anything that expired while the
+            # batch assembled is dropped here, not computed
+            live = []
+            for r in batch:
+                if r.deadline is not None and r.deadline.expired():
+                    self._drop_expired(r)
+                else:
+                    live.append(r)
+            batch = live
             self.timers["batch"].record(time.perf_counter() - t0)
-            _batch_occupancy.observe(len(batch))
             _queue_depth.set(self._queue.qsize())
+            if not batch:
+                continue
+            _batch_occupancy.observe(len(batch))
 
             with self._inflight_lock:
                 self._inflight += 1
@@ -383,7 +595,6 @@ class ServingServer:
                 drained = True
                 break
             time.sleep(0.01)
-        import os
         path = snapshot_path or os.environ.get("ZOO_OBS_SNAPSHOT")
         if path:
             try:

@@ -10,12 +10,18 @@ from __future__ import annotations
 
 import socket
 import threading
+import uuid
 from typing import Dict, Optional
 
 import numpy as np
 
 from zoo_tpu.serving.server import _recv_msg, _send_msg
-from zoo_tpu.util.resilience import RetryPolicy, fault_point
+from zoo_tpu.util.resilience import (
+    Deadline,
+    DeadlineExceeded,
+    RetryPolicy,
+    fault_point,
+)
 
 
 class _Connection:
@@ -24,7 +30,17 @@ class _Connection:
     Transient transport failures (server restarting, connection reset
     mid-RPC) are retried under ``retry`` with exponential backoff,
     re-dialing a fresh socket each attempt; server-side *application*
-    errors come back as normal responses and are never retried here."""
+    errors come back as normal responses and are never retried here.
+
+    Every predict gets a client-stamped request id that the server
+    echoes: retries re-send the SAME id (the server's dedup cache makes
+    them idempotent — a reconnect after a mid-RPC reset never executes
+    the model twice), and any response frame carrying a DIFFERENT id
+    (a stale attempt's reply still buffered on a reused connection) is
+    discarded instead of being mismatched to the wrong caller. A
+    :class:`Deadline` passed to :meth:`rpc` is re-stamped into each
+    attempt's frame as the remaining ``deadline_ms`` budget and bounds
+    the socket wait, so a dead server costs the budget, never a hang."""
 
     def __init__(self, host: str, port: int, tls: bool = False,
                  cafile: str = None, verify: bool = True,
@@ -60,24 +76,59 @@ class _Connection:
                 pass
             self._sock = None
 
-    def _rpc_once(self, msg: Dict) -> Dict:
+    def _rpc_once(self, msg: Dict,
+                  deadline: Optional[Deadline] = None) -> Dict:
         fault_point("serving.request", op=msg.get("op"))
         with self._lock:
+            if deadline is not None and deadline.expired():
+                # terminal, not retryable: another attempt can only
+                # arrive even later
+                raise DeadlineExceeded(
+                    "request deadline expired before send")
             if self._sock is None:
                 self._open()
             try:
+                if deadline is not None:
+                    # re-stamp the REMAINING budget per attempt (a retry
+                    # has less time than the first try had) and bound
+                    # the socket wait by it — plus a small grace so the
+                    # server's own "expired" reply wins the race over a
+                    # raw socket timeout when both fire together
+                    msg["deadline_ms"] = deadline.remaining_ms()
+                    self._sock.settimeout(deadline.remaining() + 0.25)
+                else:
+                    self._sock.settimeout(None)
                 _send_msg(self._sock, msg)
-                resp = _recv_msg(self._sock)
+                # chaos seam: a reset AFTER the request reached the
+                # server (the retry must dedup, never double-execute)
+                fault_point("serving.client.recv", id=msg.get("id"))
+                while True:
+                    resp = _recv_msg(self._sock)
+                    if resp is None:
+                        self._drop()
+                        raise ConnectionError("serving connection closed")
+                    rid = msg.get("id")
+                    if rid is not None and \
+                            resp.get("id") not in (None, rid):
+                        # a stale attempt's frame (hedge loser / timed-
+                        # out retry) still queued on this stream —
+                        # discard, never hand it to the wrong caller
+                        continue
+                    return resp
             except OSError:
                 self._drop()  # poisoned stream: next attempt re-dials
                 raise
-            if resp is None:
-                self._drop()
-                raise ConnectionError("serving connection closed")
-            return resp
 
-    def rpc(self, msg: Dict) -> Dict:
-        return self._retry.call(self._rpc_once, msg)
+    def rpc(self, msg: Dict,
+            deadline: Optional[Deadline] = None) -> Dict:
+        # own copy: the auto-stamped id (and per-attempt deadline_ms)
+        # must never leak into the caller's dict — a reused dict would
+        # carry a stale id into its NEXT request and silently replay the
+        # previous answer from the server's dedup cache
+        msg = dict(msg)
+        if msg.get("op") == "predict" and "id" not in msg:
+            msg["id"] = uuid.uuid4().hex
+        return self._retry.call(self._rpc_once, msg, deadline)
 
     def close(self):
         self._drop()
@@ -112,10 +163,16 @@ class TCPInputQueue:
     def _needs_batch(arr: np.ndarray) -> bool:
         return True  # single-record enqueue always adds the batch dim
 
-    def predict(self, x: np.ndarray) -> np.ndarray:
-        """Synchronous batch predict (reference: ``InputQueue.predict``)."""
+    def predict(self, x: np.ndarray,
+                deadline_ms: Optional[float] = None) -> np.ndarray:
+        """Synchronous batch predict (reference: ``InputQueue.predict``).
+
+        ``deadline_ms``: optional end-to-end budget propagated to the
+        server, which enforces it at admission, batch formation and
+        reply (docs/serving_ha.md); an exhausted budget raises."""
         resp = self._conn.rpc({"op": "predict", "uri": "_sync_",
-                               "data": np.asarray(x)})
+                               "data": np.asarray(x)},
+                              deadline=Deadline.from_ms(deadline_ms))
         if "error" in resp:
             raise RuntimeError(resp["error"])
         return resp["result"]

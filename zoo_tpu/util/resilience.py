@@ -59,12 +59,32 @@ _fault_trips = counter(
 
 __all__ = [
     "RetryPolicy", "RetryError",
+    "Deadline", "DeadlineExceeded",
     "CircuitBreaker", "CircuitOpenError",
     "FaultInjector", "InjectedFault", "inject", "clear_faults",
     "fault_point", "default_injector",
     "touch_heartbeat", "heartbeat_age", "start_heartbeat_thread",
     "HEARTBEAT_FILE_ENV", "HEARTBEAT_INTERVAL_ENV",
+    "env_float", "env_int",
 ]
+
+
+def env_float(name: str, default: float) -> float:
+    """``$name`` as a float, falling back to ``default`` on unset, empty,
+    or malformed values (with a warning for malformed ones) — the one
+    shared parser behind every ``ZOO_*`` numeric knob."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        logger.warning("bad %s=%r; using %s", name, raw, default)
+        return default
+
+
+def env_int(name: str, default: int) -> int:
+    return int(env_float(name, default))
 
 
 # ---------------------------------------------------------------------------
@@ -151,6 +171,59 @@ class RetryPolicy:
         def inner(*args, **kwargs):
             return self.call(fn, *args, **kwargs)
         return inner
+
+
+# ---------------------------------------------------------------------------
+# deadlines
+# ---------------------------------------------------------------------------
+
+class DeadlineExceeded(RuntimeError):
+    """A propagated request deadline expired before the work completed.
+
+    Deliberately NOT a :class:`ConnectionError`/:class:`OSError`: retry
+    layers must treat an exhausted budget as terminal — another attempt
+    can only arrive even later."""
+
+
+class Deadline:
+    """An absolute deadline on the local monotonic clock.
+
+    The serving wire carries *remaining budget* (``deadline_ms``), the
+    gRPC convention, because wall clocks disagree across hosts; each
+    process re-anchors the budget on its own ``time.monotonic()`` the
+    moment the frame arrives. Every stage then derives its wait bound
+    from :meth:`remaining` instead of a hardcoded timeout, and a request
+    whose budget is gone is dropped instead of computed
+    (docs/serving_ha.md)."""
+
+    __slots__ = ("at",)
+
+    def __init__(self, seconds: float):
+        self.at = time.monotonic() + float(seconds)
+
+    @classmethod
+    def from_ms(cls, ms) -> Optional["Deadline"]:
+        """Budget in milliseconds → Deadline; ``None`` stays None (no
+        deadline). ``ms <= 0`` is an already-expired deadline, not "no
+        deadline" — a zero budget must reject, not hang forever."""
+        if ms is None:
+            return None
+        return cls(float(ms) / 1000.0)
+
+    def remaining(self) -> float:
+        """Seconds left (negative once expired)."""
+        return self.at - time.monotonic()
+
+    def remaining_ms(self) -> float:
+        """Milliseconds left, floored at 0 — the value to re-stamp into
+        a forwarded frame."""
+        return max(0.0, 1000.0 * self.remaining())
+
+    def expired(self) -> bool:
+        return time.monotonic() >= self.at
+
+    def __repr__(self):
+        return f"Deadline(remaining={self.remaining():.3f}s)"
 
 
 # ---------------------------------------------------------------------------

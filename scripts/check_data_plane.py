@@ -5,9 +5,15 @@ process fetches), asserting that
 
 * the pipelined+pooled multi-get beats the per-connection serial fetch
   on bytes/s for a 64-shard exchange,
-* it dials at least 4x fewer TCP connections doing so, and
-* the pool-reuse metrics (``zoo_shard_pool_connections_total``,
-  ``zoo_shard_fetch_bytes_total``) export on a live ``/metrics`` scrape.
+* it dials at least 4x fewer TCP connections doing so,
+* the same exchange fetched once over the TCP lane and once forcing the
+  same-host shared-memory lane (``ZOO_SHARD_LANE=shm``) returns
+  **byte-identical** shard contents — the default wire settings are
+  lossless end to end, whatever the transport — and the shm lane
+  leaves no segment files behind, and
+* the pool/lane metrics (``zoo_shard_pool_connections_total``,
+  ``zoo_shard_lane_total``, ``zoo_shard_fetch_bytes_total``) export on
+  a live ``/metrics`` scrape.
 
 Run directly (``python scripts/check_data_plane.py``) or from the test
 suite (``tests/test_data_plane.py`` runs it under the ``perf`` marker) —
@@ -15,6 +21,7 @@ CI exercises the same wire an actual rebalance does. Deliberately
 jax-free so a subprocess run costs milliseconds, not an XLA import.
 """
 
+import glob
 import os
 import subprocess
 import sys
@@ -54,7 +61,13 @@ def check(verbose: bool = True) -> int:
 
     from zoo_tpu.obs import MetricsExporter
     from zoo_tpu.obs.metrics import get_registry
-    from zoo_tpu.orca.data.plane import ShardExchange, _pool, iter_fetch
+    from zoo_tpu.orca.data.plane import (
+        ExchangeConfig,
+        ShardExchange,
+        _pool,
+        iter_fetch,
+    )
+    from zoo_tpu.orca.data.shm import SEGMENT_PREFIX, shm_dir
 
     child = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), "--serve"],
@@ -68,33 +81,55 @@ def check(verbose: bool = True) -> int:
         addr = ("127.0.0.1", int(line.split()[1]))
         expect = _make_shards()
         total = sum(v.nbytes for s in expect.values() for v in s.values())
+        tcp = ExchangeConfig(lane="tcp")
+        shm = ExchangeConfig(lane="shm")
+
+        def counter_value(name, **want) -> float:
+            fam = get_registry().counter(name,
+                                         labels=tuple(sorted(want)))
+            return sum(c.value for c in fam.children()
+                       if all(dict(c.labels_kv).get(k) == v
+                              for k, v in want.items()))
 
         def opened() -> float:
-            fam = get_registry().counter(
-                "zoo_shard_pool_connections_total", labels=("event",))
-            return sum(c.value for c in fam.children()
-                       if dict(c.labels_kv).get("event") == "opened")
+            return counter_value("zoo_shard_pool_connections_total",
+                                 event="opened")
 
         # warm both paths once (page cache, import costs), then time
-        ShardExchange.fetch(addr, 0, pool=False)
-        list(iter_fetch([(addr, list(range(N_SHARDS)))]))
+        ShardExchange.fetch(addr, 0, pool=False, config=tcp)
+        list(iter_fetch([(addr, list(range(N_SHARDS)))], config=tcp))
 
         c0 = opened()
         t0 = time.perf_counter()
-        got_serial = {g: ShardExchange.fetch(addr, g, pool=False)
+        got_serial = {g: ShardExchange.fetch(addr, g, pool=False,
+                                             config=tcp)
                       for g in range(N_SHARDS)}
         serial_s = time.perf_counter() - t0
         conns_serial = opened() - c0
 
         c0 = opened()
         t0 = time.perf_counter()
-        got_piped = dict(iter_fetch([(addr, list(range(N_SHARDS)))]))
+        got_piped = dict(iter_fetch([(addr, list(range(N_SHARDS)))],
+                                    config=tcp))
         piped_s = time.perf_counter() - t0
         # the pool was warmed above, so a steady-state exchange re-dials
         # nothing; count the warm-up's dials as the honest cold cost
         conns_piped = max(opened() - c0, 1.0)
 
-        for got, tag in ((got_serial, "serial"), (got_piped, "pipelined")):
+        # ---- the shared-memory lane: same shards, forced shm payloads
+        _pool.clear()  # fresh connection so the lane re-negotiates
+        shm0 = counter_value("zoo_shard_lane_total", lane="shm")
+        t0 = time.perf_counter()
+        got_shm = dict(iter_fetch([(addr, list(range(N_SHARDS)))],
+                                  config=shm))
+        shm_s = time.perf_counter() - t0
+        if counter_value("zoo_shard_lane_total", lane="shm") - shm0 \
+                < N_SHARDS:
+            problems.append("forced shm lane did not actually carry the "
+                            "shards (lane metric unmoved)")
+
+        for got, tag in ((got_serial, "serial"), (got_piped, "pipelined"),
+                         (got_shm, "shm")):
             if sorted(got) != list(range(N_SHARDS)):
                 problems.append(f"{tag} fetch returned wrong gid set")
                 continue
@@ -102,6 +137,22 @@ def check(verbose: bool = True) -> int:
                 if not np.array_equal(np.asarray(got[g]["x"]),
                                       expect[g]["x"]):
                     problems.append(f"{tag} fetch corrupted shard {g}")
+        # cross-lane bit-identity: the acceptance bar for "lossless by
+        # default" — not allclose, BYTE-equal, across every shard
+        for g in range(N_SHARDS):
+            a = np.asarray(got_piped[g]["x"])
+            b = np.asarray(got_shm[g]["x"])
+            if a.dtype != b.dtype or a.shape != b.shape \
+                    or a.tobytes() != b.tobytes():
+                problems.append(
+                    f"lane mismatch on shard {g}: tcp and shm lanes "
+                    "disagree byte-for-byte")
+                break
+        leftovers = glob.glob(os.path.join(
+            shm_dir(), f"{SEGMENT_PREFIX}p{child.pid}_*"))
+        if leftovers:
+            problems.append(f"shm lane leaked segments: {leftovers}")
+
         if piped_s >= serial_s:
             problems.append(
                 f"pipelined multi-get ({total / piped_s / 1e6:.0f} MB/s) "
@@ -120,11 +171,14 @@ def check(verbose: bool = True) -> int:
         finally:
             exporter.stop()
         for needle in ("zoo_shard_pool_connections_total",
-                       "zoo_shard_fetch_bytes_total"):
+                       "zoo_shard_fetch_bytes_total",
+                       "zoo_shard_lane_total"):
             if needle not in text:
                 problems.append(f"/metrics is missing {needle}")
         if 'event="reused"' not in text:
             problems.append("/metrics shows no pooled-connection reuse")
+        if 'lane="shm"' not in text:
+            problems.append("/metrics shows no shm-lane traffic")
     finally:
         child.stdin.close()
         child.wait(timeout=30)
@@ -135,10 +189,12 @@ def check(verbose: bool = True) -> int:
             for p in problems:
                 print(f"FAIL: {p}", file=sys.stderr)
         else:
-            print(f"ok: pipelined {total / piped_s / 1e6:.0f} MB/s over "
-                  f"{conns_piped:.0f} conn(s) vs serial "
-                  f"{total / serial_s / 1e6:.0f} MB/s over "
-                  f"{conns_serial:.0f}; pool metrics live on /metrics")
+            print(f"ok: pipelined tcp {total / piped_s / 1e6:.0f} MB/s "
+                  f"over {conns_piped:.0f} conn(s), shm lane "
+                  f"{total / shm_s / 1e6:.0f} MB/s (byte-identical "
+                  f"across lanes), serial {total / serial_s / 1e6:.0f} "
+                  f"MB/s over {conns_serial:.0f}; lane metrics live on "
+                  f"/metrics")
     return 1 if problems else 0
 
 

@@ -476,6 +476,179 @@ def test_conn_pool_bounds_idle_sockets():
         srv.close()
 
 
+# ------------------------------------------------- adaptive readahead
+
+def test_readahead_controller_walks_toward_hiding_fetch():
+    """Source-bound windows grow concurrency (then chunk); over-
+    provisioned windows step concurrency back down; bounds hold."""
+    from zoo_tpu.orca.data.ingest import ReadaheadController
+    from zoo_tpu.orca.data.plane import ExchangeConfig
+
+    class FakeStats:
+        def __init__(self):
+            self.busy = {"source": 0.0}
+            self._w = 0.0
+
+        def wall(self):
+            return self._w
+
+    cfg = ExchangeConfig(multiget=8, concurrency=2)
+    st = FakeStats()
+    c = ReadaheadController(cfg, st, window=1, max_concurrency=8,
+                            max_chunk=32)
+    # fetch dominates the window -> concurrency doubles to its cap...
+    for i in range(1, 3):
+        st._w = float(i)
+        st.busy["source"] = 0.9 * i
+        c.on_chunk(8, 1 << 20, 0.1)
+    assert cfg.concurrency == 8
+    assert cfg.multiget == 8  # untouched while concurrency has headroom
+    # ...then the chunk grows instead
+    st._w, st.busy["source"] = 3.0, 2.7
+    c.on_chunk(8, 1 << 20, 0.1)
+    assert cfg.multiget == 16
+    # fetch fully hidden -> unwind: width first, then the chunk back
+    # toward its floor — and never below either floor
+    for i in range(4, 24):
+        st._w = float(i)
+        st.busy["source"] = 2.7  # no new source time at all
+        c.on_chunk(8, 1 << 20, 0.1)
+    assert cfg.concurrency == 1
+    assert cfg.multiget == c.min_chunk
+    assert c.decisions, "controller recorded no decisions"
+
+
+def test_iter_fetch_respects_controller_resizing():
+    """A controller shrinking the chunk mid-exchange still yields every
+    shard exactly once (lazy carving re-reads config.multiget)."""
+    from zoo_tpu.orca.data.plane import ExchangeConfig
+
+    shards = {i: {"x": np.full((8,), float(i), np.float32)}
+              for i in range(24)}
+    ex = ShardExchange(shards, bind="127.0.0.1")
+    cfg = ExchangeConfig(multiget=8, concurrency=2)
+
+    class ShrinkOnce:
+        max_concurrency = 4
+
+        def __init__(self):
+            self.calls = 0
+
+        def on_chunk(self, ngids, nbytes, seconds):
+            self.calls += 1
+            cfg.multiget = 3  # next chunks are carved smaller
+
+    ctl = ShrinkOnce()
+    try:
+        got = dict(iter_fetch([(("127.0.0.1", ex.port), sorted(shards))],
+                              config=cfg, controller=ctl))
+        assert sorted(got) == sorted(shards)
+        for g in shards:
+            np.testing.assert_array_equal(np.asarray(got[g]["x"]),
+                                          shards[g]["x"])
+        assert ctl.calls >= 2
+    finally:
+        ex.close()
+
+
+# ------------------------------------------------- staging buffer pool
+
+def test_staging_buffer_pool_rotates_and_preserves_contents():
+    from zoo_tpu.orca.data.ingest import StagingBufferPool
+
+    rs = np.random.RandomState(0)
+    arrs = [rs.randn(40, 3).astype(np.float32),
+            rs.randint(0, 9, 40).astype(np.int64)]
+    pool = StagingBufferPool(arrs, rows=8, nbufs=3)
+    idx1, idx2 = np.arange(8), np.arange(8, 16)
+    a = pool.take(arrs, idx1)
+    b = pool.take(arrs, idx2)
+    # distinct buffers: writing batch 2 must not disturb batch 1
+    assert a[0].base is not b[0].base
+    np.testing.assert_array_equal(a[0], arrs[0][idx1])
+    np.testing.assert_array_equal(b[1], arrs[1][idx2])
+    pool.recycle()  # oldest (a's buffer) returns to the pool
+    c = pool.take(arrs, np.arange(16, 20))  # ragged tail: prefix view
+    assert c[0].shape == (4, 3)
+    np.testing.assert_array_equal(c[0], arrs[0][16:20])
+    pool.reset()
+
+
+def test_staging_buffer_pool_starvation_is_loud():
+    from zoo_tpu.orca.data.ingest import StagingBufferPool
+
+    arrs = [np.zeros((4, 2), np.float32)]
+    pool = StagingBufferPool(arrs, rows=2, nbufs=1)
+    pool.take(arrs, np.arange(2))
+    with pytest.raises(RuntimeError, match="starved"):
+        pool.take(arrs, np.arange(2), timeout=0.05)
+
+
+def test_staging_buffer_pool_fences_stale_generation():
+    """Stage threads surviving a non-joining pipeline teardown
+    (``DoubleBufferedIterator.close()`` only signals, never joins)
+    must not touch the next epoch's slots: ``take``/``recycle`` calls
+    carrying a superseded generation token get plain slices / no-op
+    instead of popping the new epoch's in-flight buffers mid-DMA."""
+    from zoo_tpu.orca.data.ingest import StagingBufferPool
+
+    arrs = [np.arange(20, dtype=np.float32).reshape(10, 2)]
+    pool = StagingBufferPool(arrs, rows=4, nbufs=2)
+    gen1 = pool.reset()
+    pool.take(arrs, np.arange(4), gen=gen1)           # epoch 1 in flight
+    gen2 = pool.reset()                               # epoch 2 begins
+    new = pool.take(arrs, np.arange(4, 8), gen=gen2)  # epoch 2 oldest slot
+    # zombie put thread from epoch 1 finishes: must NOT free epoch 2's
+    # oldest slot (the silent-corruption path)
+    pool.recycle(gen=gen1)
+    # zombie slice thread from epoch 1: plain copies, pool untouched
+    stale = pool.take(arrs, np.arange(4), gen=gen1)
+    assert stale[0].base is not new[0].base
+    np.testing.assert_array_equal(stale[0], arrs[0][:4])
+    # epoch 2 still owns full capacity: its recycle frees ITS oldest,
+    # and both slots remain reachable (a leaked slot would starve here)
+    pool.recycle(gen=gen2)
+    pool.take(arrs, np.arange(4), gen=gen2, timeout=0.5)
+    pool.take(arrs, np.arange(4), gen=gen2, timeout=0.5)
+
+
+def test_fit_host_feed_uses_staging_pool_and_matches_plain(monkeypatch):
+    """The host-fed superbatch feed stages through the rotating buffer
+    pool (on backends where device_put provably copies) and produces
+    bit-identical training to the plain-allocation path."""
+    import zoo_tpu.orca.data.ingest as ing
+    from zoo_tpu.pipeline.api.keras import Sequential
+    from zoo_tpu.pipeline.api.keras.layers import Dense
+
+    calls = []
+    orig_take = ing.StagingBufferPool.take
+
+    def spy(self, arrs, idx, **kw):
+        calls.append(len(idx))
+        return orig_take(self, arrs, idx, **kw)
+
+    monkeypatch.setattr(ing.StagingBufferPool, "take", spy)
+    rs = np.random.RandomState(0)
+    x = rs.randn(256, 8).astype(np.float32)
+    y = (x @ rs.randn(8, 1)).astype(np.float32)
+
+    def run(staging):
+        monkeypatch.setenv("ZOO_FEED_STAGING", staging)
+        m = Sequential()
+        m.add(Dense(8, input_shape=(8,), activation="relu"))
+        m.add(Dense(1))
+        m.compile(optimizer="sgd", loss="mse")
+        return m.fit(x, y, batch_size=32, nb_epoch=2, shuffle=True,
+                     seed=11, verbose=0)["loss"]
+
+    pooled = run("auto")
+    assert calls, "staging pool never engaged on the host-fed path"
+    n_pooled = len(calls)
+    plain = run("off")
+    assert len(calls) == n_pooled, "ZOO_FEED_STAGING=off did not disable"
+    np.testing.assert_allclose(pooled, plain, rtol=1e-6)
+
+
 # ------------------------------------------------------------ CPU smoke
 
 @pytest.mark.perf

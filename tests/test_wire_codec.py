@@ -1,0 +1,380 @@
+"""Tests for the negotiated wire layer: dtype narrowing / compression
+round trips, ZSXN negotiation (incl. graceful fallback against a
+ZSX2-only peer), the same-host shared-memory lane, and its cleanup
+guarantees under peer death."""
+
+import logging
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from zoo_tpu.orca.data import shm as shm_mod
+from zoo_tpu.orca.data.plane import (
+    ExchangeConfig,
+    ProtocolError,
+    ShardExchange,
+    _pool,
+    fetch_many,
+)
+from zoo_tpu.orca.data.wire_codec import (
+    FLAG_COMPRESSED,
+    FLAG_NARROWED,
+    WirePolicy,
+    decode_payload,
+    encode_array,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_pool():
+    _pool.clear()
+    yield
+    _pool.clear()
+
+
+# ------------------------------------------------------------ codec units
+
+def test_bf16_narrow_widen_tolerance():
+    rs = np.random.RandomState(0)
+    arr = (rs.randn(64, 16) * 100).astype(np.float32)
+    flags, descr, scale, payload = encode_array(arr, WirePolicy("bf16"))
+    assert flags & FLAG_NARROWED
+    assert memoryview(payload).nbytes == arr.nbytes // 2
+    out = decode_payload(payload, flags, arr.dtype, arr.shape,
+                         descr.decode(), scale, "off")
+    assert out.dtype == np.float32 and out.shape == arr.shape
+    # bf16 keeps 8 mantissa bits: relative error bounded by 2^-8
+    np.testing.assert_allclose(out, arr, rtol=1 / 128.0)
+
+
+def test_int8_narrow_widen_tolerance():
+    rs = np.random.RandomState(1)
+    arr = (rs.randn(32, 8) * 5).astype(np.float32)
+    flags, descr, scale, payload = encode_array(arr, WirePolicy("int8"))
+    assert flags & FLAG_NARROWED
+    assert memoryview(payload).nbytes == arr.nbytes // 4
+    out = decode_payload(payload, flags, arr.dtype, arr.shape,
+                         descr.decode(), scale, "off")
+    # absmax/127 quantization step -> half-step absolute error bound
+    atol = float(np.abs(arr).max()) / 127.0 * 0.5 + 1e-7
+    np.testing.assert_allclose(out, arr, atol=atol)
+
+
+def test_narrowing_skips_non_f32():
+    labels = np.arange(10, dtype=np.int64)
+    flags, descr, scale, payload = encode_array(labels,
+                                                WirePolicy("bf16"))
+    assert not flags & FLAG_NARROWED
+    out = decode_payload(payload, flags, labels.dtype, labels.shape,
+                         None, 0.0, "off")
+    np.testing.assert_array_equal(out, labels)
+
+
+def test_compression_round_trip_and_incompressible_fallback():
+    low_entropy = np.zeros((256, 64), np.float32)
+    flags, _, _, payload = encode_array(
+        low_entropy, WirePolicy("off", "zlib"))
+    assert flags & FLAG_COMPRESSED
+    assert memoryview(payload).nbytes < low_entropy.nbytes // 10
+    out = decode_payload(payload, flags, low_entropy.dtype,
+                         low_entropy.shape, None, 0.0, "zlib")
+    np.testing.assert_array_equal(out, low_entropy)
+    # random BYTES do not compress (random f32 still does a little —
+    # IEEE exponent bytes are low-entropy): the attempt is dropped
+    noise = np.random.RandomState(2).randint(
+        0, 256, 1 << 16).astype(np.uint8)
+    flags, _, _, payload = encode_array(noise, WirePolicy("off", "zlib"))
+    assert not flags & FLAG_COMPRESSED
+    assert memoryview(payload).nbytes == noise.nbytes
+
+
+def test_default_policy_is_lossless_passthrough():
+    rs = np.random.RandomState(3)
+    arr = rs.randn(16, 4).astype(np.float32)
+    flags, descr, scale, payload = encode_array(arr, WirePolicy())
+    assert flags == 0 and descr is None
+    out = decode_payload(payload, flags, arr.dtype, arr.shape,
+                         None, 0.0, "off")
+    assert out.tobytes() == arr.tobytes()  # BIT identical, not close
+
+
+def test_wire_policy_validates_loudly():
+    with pytest.raises(ValueError, match="lossy"):
+        WirePolicy("float8")
+    with pytest.raises(ValueError, match="zlib or lz4"):
+        WirePolicy("off", "zstd")
+
+
+def test_compressed_payload_inflation_bounded():
+    """A corrupt/hostile stream must not turn a tiny compressed payload
+    into an arbitrary allocation: inflation is bounded by the size the
+    header promises, BEFORE the bytes become an array."""
+    import zlib
+    bomb = zlib.compress(bytes(64 << 20), 9)  # 64 MB of zeros, ~64 KB
+    with pytest.raises(ValueError, match="header promises 16"):
+        decode_payload(bomb, FLAG_COMPRESSED, np.dtype(np.float32),
+                       (4,), None, 0.0, "zlib")
+    # undershoot is rejected by the same check, not left for frombuffer
+    short = zlib.compress(bytes(8))
+    with pytest.raises(ValueError, match="header promises 16"):
+        decode_payload(short, FLAG_COMPRESSED, np.dtype(np.float32),
+                       (4,), None, 0.0, "zlib")
+
+
+# ---------------------------------------------------- negotiated exchange
+
+def _roundtrip(shards, config):
+    ex = ShardExchange(shards, bind="127.0.0.1")
+    try:
+        return fetch_many(("127.0.0.1", ex.port), sorted(shards),
+                          config=config)
+    finally:
+        ex.close()
+
+
+def test_negotiated_bf16_over_the_wire_widens_on_receipt():
+    rs = np.random.RandomState(4)
+    shards = {0: {"x": rs.randn(32, 8).astype(np.float32),
+                  "y": np.arange(5, dtype=np.int64)}}
+    got = _roundtrip(shards, ExchangeConfig(wire_dtype="bf16",
+                                            lane="tcp"))
+    assert got[0]["x"].dtype == np.float32
+    np.testing.assert_allclose(got[0]["x"], shards[0]["x"], rtol=1 / 128.)
+    # the int labels crossed untouched — narrowing is per-array
+    np.testing.assert_array_equal(got[0]["y"], shards[0]["y"])
+
+
+def test_negotiated_compression_over_the_wire():
+    shards = {0: {"x": np.zeros((128, 64), np.float32)}}
+    got = _roundtrip(shards, ExchangeConfig(wire_compress="zlib",
+                                            lane="tcp"))
+    assert got[0]["x"].tobytes() == shards[0]["x"].tobytes()
+
+
+def test_default_wire_settings_bit_identical_over_both_lanes():
+    rs = np.random.RandomState(5)
+    shards = {i: {"x": rs.randn(16, 16).astype(np.float32)}
+              for i in range(4)}
+    for lane in ("tcp", "shm"):
+        _pool.clear()
+        got = _roundtrip(shards, ExchangeConfig(lane=lane))
+        for g, s in shards.items():
+            assert np.asarray(got[g]["x"]).tobytes() == s["x"].tobytes(), \
+                f"lane {lane} not bit-identical on shard {g}"
+
+
+def test_downgraded_negotiation_keeps_pool_reuse(monkeypatch):
+    """A peer that grants a requested feature DOWN (its build lacks the
+    codec) must not defeat the connection pool: the negotiation memo
+    records what this request actually gets from this peer, so the
+    pooled connection carrying the granted profile is reused instead of
+    being discarded and redialed on every checkout."""
+    from zoo_tpu.orca.data import plane
+    # server side: no codecs importable -> a zlib proposal is granted
+    # as compress="off"
+    monkeypatch.setattr(plane, "supported_codecs", lambda: [])
+    rs = np.random.RandomState(7)
+    shards = {i: {"x": rs.randn(16, 4).astype(np.float32)}
+              for i in range(4)}
+    ex = ShardExchange(shards, bind="127.0.0.1")
+    cfg = ExchangeConfig(wire_compress="zlib", lane="tcp")
+    try:
+        addr = ("127.0.0.1", ex.port)
+        for _ in range(3):
+            got = fetch_many(addr, sorted(shards), config=cfg)
+            for g, s in shards.items():
+                assert got[g]["x"].tobytes() == s["x"].tobytes()
+        assert ex.connections_accepted == 1, \
+            "downgraded profile mismatched the pooled connection"
+    finally:
+        ex.close()
+
+
+def test_bf16_unavailable_peer_negotiates_down_to_lossless(monkeypatch):
+    """A serving build that cannot encode bf16 (no ml_dtypes) grants
+    dtype='off' instead of ImportError-ing mid-response: arrays arrive
+    un-narrowed and bit-identical."""
+    from zoo_tpu.orca.data import plane
+    monkeypatch.setattr(plane, "supported_wire_dtypes",
+                        lambda: ["off", "int8"])
+    shards = {0: {"x": np.arange(32, dtype=np.float32)}}
+    ex = ShardExchange(shards, bind="127.0.0.1")
+    try:
+        got = fetch_many(("127.0.0.1", ex.port), [0],
+                         config=ExchangeConfig(wire_dtype="bf16",
+                                               lane="tcp"))
+        assert got[0]["x"].tobytes() == shards[0]["x"].tobytes()
+    finally:
+        ex.close()
+
+
+def test_legacy_zsx2_peer_graceful_fallback(caplog):
+    """A ZSX2-only peer (pre-negotiation build) drops the hello; the
+    client falls back to the plain protocol — correctly, and loudly
+    when a wire feature was explicitly requested."""
+    shards = {0: {"x": np.arange(8, dtype=np.float32)}}
+    ex = ShardExchange(shards, bind="127.0.0.1", negotiate=False)
+    try:
+        with caplog.at_level(logging.WARNING, "zoo_tpu.orca.data.plane"):
+            got = fetch_many(("127.0.0.1", ex.port), [0],
+                             config=ExchangeConfig(wire_dtype="bf16",
+                                                   lane="auto"))
+        np.testing.assert_array_equal(got[0]["x"], shards[0]["x"])
+        assert any("ZSX2-only" in r.message and "DISABLED" in r.message
+                   for r in caplog.records)
+        # the legacy verdict is memoized: the next fetch neither re-pays
+        # the doomed hello round trip nor logs again
+        n = len(caplog.records)
+        got = fetch_many(("127.0.0.1", ex.port), [0],
+                         config=ExchangeConfig(wire_dtype="bf16",
+                                               lane="auto"))
+        np.testing.assert_array_equal(got[0]["x"], shards[0]["x"])
+        assert len(caplog.records) == n
+    finally:
+        ex.close()
+
+
+def test_forced_shm_lane_fails_loud_against_legacy_peer():
+    shards = {0: {"x": np.zeros(4, np.float32)}}
+    ex = ShardExchange(shards, bind="127.0.0.1", negotiate=False)
+    try:
+        with pytest.raises(ProtocolError, match="ZOO_SHARD_LANE=shm"):
+            fetch_many(("127.0.0.1", ex.port), [0],
+                       config=ExchangeConfig(lane="shm"))
+    finally:
+        ex.close()
+
+
+def test_forced_shm_lane_fails_loud_when_peer_has_no_shm(monkeypatch):
+    """A peer that cannot offer a segment (no usable shm dir) must fail
+    a FORCED shm lane loudly, not silently fall back."""
+    monkeypatch.setenv("ZOO_SHARD_SHM_DIR", "/nonexistent-zoo-shm-dir")
+    shards = {0: {"x": np.zeros(4, np.float32)}}
+    ex = ShardExchange(shards, bind="127.0.0.1")
+    try:
+        with pytest.raises(ProtocolError, match="ZOO_SHARD_LANE=shm"):
+            fetch_many(("127.0.0.1", ex.port), [0],
+                       config=ExchangeConfig(lane="shm"))
+        # auto mode: same failure degrades silently to the TCP lane
+        _pool.clear()
+        got = fetch_many(("127.0.0.1", ex.port), [0],
+                         config=ExchangeConfig(lane="auto"))
+        np.testing.assert_array_equal(got[0]["x"], shards[0]["x"])
+    finally:
+        ex.close()
+
+
+def test_shm_segment_allocation_failure_degrades_to_inline(monkeypatch,
+                                                           caplog):
+    """A full tmpfs (segment allocation OSError) must not tear the
+    stream: the server serves the chunk's payloads inline over the
+    same connection, loudly."""
+    from zoo_tpu.orca.data import plane
+
+    def boom(directory, nbytes):
+        raise OSError(28, "No space left on device")
+
+    monkeypatch.setattr(plane._shm, "SegmentWriter", boom)
+    rs = np.random.RandomState(8)
+    shards = {i: {"x": rs.randn(32, 8).astype(np.float32)}
+              for i in range(4)}
+    ex = ShardExchange(shards, bind="127.0.0.1")
+    try:
+        with caplog.at_level(logging.WARNING, "zoo_tpu.orca.data.plane"):
+            got = fetch_many(("127.0.0.1", ex.port), sorted(shards),
+                             config=ExchangeConfig(lane="shm"))
+        for g, s in shards.items():
+            assert got[g]["x"].tobytes() == s["x"].tobytes()
+        assert any("inline" in r.message for r in caplog.records)
+    finally:
+        ex.close()
+
+
+def test_shm_lane_leaves_no_segments_behind():
+    rs = np.random.RandomState(6)
+    shards = {i: {"x": rs.randn(64, 64).astype(np.float32)}
+              for i in range(8)}
+    got = _roundtrip(shards, ExchangeConfig(lane="shm"))
+    for g, s in shards.items():
+        np.testing.assert_array_equal(np.asarray(got[g]["x"]), s["x"])
+    # every segment was unlinked at map time; nothing with our pid may
+    # survive the exchange
+    d = shm_mod.shm_dir()
+    mine = [n for n in os.listdir(d)
+            if n.startswith(f"{shm_mod.SEGMENT_PREFIX}p{os.getpid()}_")]
+    assert mine == [], f"leaked shm segments: {mine}"
+
+
+def test_exchange_config_parses_env_once(monkeypatch):
+    """The old per-call os.environ reads are gone: a config captures
+    the knobs at construction and later env changes do not leak into a
+    running exchange (the readahead controller owns mutation)."""
+    monkeypatch.setenv("ZOO_SHARD_MULTIGET", "7")
+    monkeypatch.setenv("ZOO_SHARD_FETCH_CONCURRENCY", "3")
+    cfg = ExchangeConfig()
+    assert cfg.multiget == 7 and cfg.concurrency == 3
+    monkeypatch.setenv("ZOO_SHARD_MULTIGET", "999")
+    monkeypatch.setenv("ZOO_SHARD_FETCH_CONCURRENCY", "999")
+    assert cfg.multiget == 7 and cfg.concurrency == 3
+    # constructor args beat env
+    assert ExchangeConfig(multiget=5).multiget == 5
+    # lz4 requested but unavailable degrades (loudly) to zlib, never
+    # to a codec the peer could not decode
+    from zoo_tpu.orca.data.wire_codec import supported_codecs
+    if "lz4" not in supported_codecs():
+        assert ExchangeConfig(
+            wire_compress="lz4").wire_compress == "zlib"
+
+
+# --------------------------------------------------------- chaos cleanup
+
+@pytest.mark.chaos
+@pytest.mark.timeout(120)
+def test_shm_cleanup_on_peer_death():
+    """SIGKILL the serving process mid-use of the shm lane: decoded
+    shards stay valid (the mapping outlives the file AND the server),
+    and the stale sweep reaps anything the dead server orphaned."""
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "check_data_plane.py")
+    child = subprocess.Popen(
+        [sys.executable, script, "--serve"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+    try:
+        line = child.stdout.readline()
+        assert line.startswith("PORT "), line
+        addr = ("127.0.0.1", int(line.split()[1]))
+        got = fetch_many(addr, list(range(8)),
+                         config=ExchangeConfig(lane="shm"))
+        assert sorted(got) == list(range(8))
+        arr_before = np.asarray(got[3]["x"]).copy()
+
+        os.kill(child.pid, signal.SIGKILL)
+        child.wait(timeout=30)
+        # decoded arrays alias the (unlinked) mapping — the server's
+        # death must not invalidate them
+        np.testing.assert_array_equal(np.asarray(got[3]["x"]), arr_before)
+
+        # a fetch against the corpse fails as a transient (retried,
+        # then raised) — never a hang
+        from zoo_tpu.util.resilience import RetryPolicy
+        with pytest.raises((ConnectionError, OSError, RuntimeError)):
+            fetch_many(addr, [0], timeout=5.0,
+                       retry=RetryPolicy(max_attempts=2, base_delay=0.01,
+                                         max_delay=0.05),
+                       config=ExchangeConfig(lane="shm"))
+
+        # nothing owned by the dead pid survives the sweep
+        shm_mod.gc_stale_segments()
+        d = shm_mod.shm_dir()
+        left = [n for n in os.listdir(d)
+                if n.startswith(f"{shm_mod.SEGMENT_PREFIX}p{child.pid}_")]
+        assert left == [], f"dead peer leaked segments: {left}"
+    finally:
+        if child.poll() is None:
+            child.kill()
+        child.wait(timeout=30)

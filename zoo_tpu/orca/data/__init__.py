@@ -1,6 +1,12 @@
 from zoo_tpu.orca.data.shard import XShards, LocalXShards
-from zoo_tpu.orca.data.plane import fetch_many, rebalance_shards
+from zoo_tpu.orca.data.plane import (
+    ExchangeConfig,
+    fetch_many,
+    iter_fetch,
+    rebalance_shards,
+)
 from zoo_tpu.orca.data.ingest import (
+    ReadaheadController,
     async_device_ingest,
     staged_pipeline,
 )
@@ -16,4 +22,5 @@ class SharedValue:
 
 
 __all__ = ["XShards", "LocalXShards", "rebalance_shards", "fetch_many",
+           "iter_fetch", "ExchangeConfig", "ReadaheadController",
            "staged_pipeline", "async_device_ingest", "SharedValue"]

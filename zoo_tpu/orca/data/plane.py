@@ -19,6 +19,13 @@ moves). The TPU-native shape of the same capability:
   many global ids into one **multi-get** request whose responses stream
   back on the same connection, so per-fetch latency amortizes across
   the exchange (``ZOO_SHARD_POOL_SIZE`` idle connections per peer);
+* each fresh connection runs a one-round **ZSXN negotiation**: the
+  fetcher proposes wire dtype narrowing (``ZOO_SHARD_WIRE_DTYPE``),
+  compression (``ZOO_SHARD_WIRE_COMPRESS``) and the same-host
+  shared-memory payload lane (``ZOO_SHARD_LANE``, probe-verified —
+  see :mod:`zoo_tpu.orca.data.shm`); a legacy ZSX2-only peer drops the
+  hello and the client falls back to the plain protocol (loudly when a
+  feature was explicitly requested);
 * peer discovery rides the JAX distributed runtime itself —
   the coordination-service KV store carries each host's (ip, port,
   count) triple, so there is no extra coordinator and no driver-side
@@ -28,13 +35,19 @@ moves). The TPU-native shape of the same capability:
   balanced target allows, and only surplus shards are fetched by deficit
   hosts;
 * :func:`rebalance_shards` runs the whole exchange — fetches run
-  concurrently across peers (``ZOO_SHARD_FETCH_CONCURRENCY`` threads,
-  default 4) and can stream through a staged ingest pipeline
-  (``stage_fn=jax.device_put``: device transfer of shard *k* overlaps
-  the network fetch of shard *k+1* — see
-  :mod:`zoo_tpu.orca.data.ingest`) — and returns this process's
-  balanced, disjoint shard set, ready for the estimator's per-process
-  feed into ``host_local_to_global`` (``parallel/mesh.py:152``).
+  concurrently across peers and can stream through a staged ingest
+  pipeline (``stage_fn=jax.device_put``: device transfer of shard *k*
+  overlaps the network fetch of shard *k+1* — see
+  :mod:`zoo_tpu.orca.data.ingest`), with an adaptive readahead
+  controller growing/shrinking fetch concurrency and multi-get chunk
+  size toward the point where the fetch leg fully hides under
+  decode + device placement — and returns this process's balanced,
+  disjoint shard set, ready for the estimator's per-process feed into
+  ``host_local_to_global`` (``parallel/mesh.py:152``).
+
+All client knobs are parsed from the environment ONCE per
+:class:`ExchangeConfig` (not per call) — the config object is the
+single mutation point the readahead controller adjusts.
 
 Shards must be dicts of numpy arrays (the estimator feed format); use
 ``XShards.partition({"x": ..., "y": ...})``.
@@ -44,13 +57,14 @@ See ``docs/data_plane.md`` for the wire format and tuning knobs.
 
 from __future__ import annotations
 
+import json
 import logging
 import os
+import queue
 import socket
 import struct
 import threading
 import time
-from concurrent.futures import ThreadPoolExecutor, as_completed
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -63,10 +77,23 @@ from zoo_tpu.obs.coordination import (
 )
 from zoo_tpu.obs.metrics import counter, histogram
 from zoo_tpu.obs.tracing import span
+from zoo_tpu.orca.data import shm as _shm
+from zoo_tpu.orca.data.wire_codec import (
+    FLAG_COMPRESSED,
+    FLAG_NARROWED,
+    FLAG_SHM,
+    WirePolicy,
+    decode_payload,
+    encode_array,
+    payload_view as _payload_view,
+    supported_codecs,
+    supported_wire_dtypes,
+)
 from zoo_tpu.util.resilience import RetryPolicy, fault_point
 
-__all__ = ["ShardExchange", "assign_shards", "rebalance_shards",
-           "fetch_many", "ProtocolError"]
+__all__ = ["ShardExchange", "ExchangeConfig", "assign_shards",
+           "rebalance_shards", "fetch_many", "iter_fetch",
+           "ProtocolError"]
 
 logger = logging.getLogger(__name__)
 
@@ -84,6 +111,18 @@ _pool_conns = counter(
     "zoo_shard_pool_connections_total",
     "Peer connections by pool event (opened = fresh TCP dial, reused = "
     "checked out of the per-peer pool)", labels=("event",))
+_lane_shards = counter(
+    "zoo_shard_lane_total",
+    "Shard responses received by transport lane (shm = same-host "
+    "shared-memory payloads, tcp = socket payloads)", labels=("lane",))
+_lane_bytes = counter(
+    "zoo_shard_lane_bytes_total",
+    "On-the-wire payload bytes received by transport lane",
+    labels=("lane",))
+_wire_saved = counter(
+    "zoo_shard_wire_saved_bytes_total",
+    "Logical minus on-wire payload bytes (savings from negotiated "
+    "dtype narrowing / compression)")
 _barrier_wait = histogram(
     "zoo_rebalance_barrier_wait_seconds",
     "Wall time spent in each rebalance KV-store barrier phase",
@@ -91,13 +130,10 @@ _barrier_wait = histogram(
 
 _MAGIC_V1 = b"ZSX1"
 _MAGIC = b"ZSX2"
-def _multiget_chunk() -> int:
-    """Gids per multi-get: bounds the cost of a retried attempt (a
-    mid-stream peer death refetches one chunk, not the whole plan) and
-    keeps responses flowing while later chunks are queued. Read per
-    call like the sibling knobs, so runtime env changes take effect."""
-    return max(1, min(int(os.environ.get("ZOO_SHARD_MULTIGET", "32")),
-                      0xFFFF))
+_MAGIC_HELLO = b"ZSXN"   # negotiation hello/reply (json capability blob)
+_MAGIC_SHM_OK = b"ZSXS"  # client's probe verdict (u8: 1 = same host)
+_MAGIC_SEG = b"ZSXM"     # server's per-chunk segment announce
+_MAGIC_ACK = b"ZSXA"     # client mapped+unlinked the announced segment
 
 
 class ProtocolError(RuntimeError):
@@ -109,11 +145,102 @@ class ProtocolError(RuntimeError):
     shards."""
 
 
+# ------------------------------------------------------------------- config
+
+class ExchangeConfig:
+    """Every client-side data-plane knob, parsed from the environment
+    ONCE at construction (the old per-call ``os.environ`` reads made
+    runtime adaptation impossible — there was no single place to
+    mutate). One config rides a whole exchange; the adaptive readahead
+    controller (:class:`zoo_tpu.orca.data.ingest.ReadaheadController`)
+    mutates ``multiget`` and ``concurrency`` on THIS object between
+    chunks, and :func:`iter_fetch` re-reads them when carving the next
+    chunk.
+
+    Env fallbacks (constructor args win): ``ZOO_SHARD_MULTIGET`` (32),
+    ``ZOO_SHARD_FETCH_CONCURRENCY`` (4), ``ZOO_SHARD_LANE``
+    (auto|tcp|shm, default auto), ``ZOO_SHARD_WIRE_DTYPE``
+    (off|bf16|int8, default off — narrowing is lossy, never implicit),
+    ``ZOO_SHARD_WIRE_COMPRESS`` (off|zlib|lz4, default off),
+    ``ZOO_SHARD_READAHEAD`` (adaptive|static, default adaptive).
+    """
+
+    LANES = ("auto", "tcp", "shm")
+
+    def __init__(self, multiget: Optional[int] = None,
+                 concurrency: Optional[int] = None,
+                 lane: Optional[str] = None,
+                 wire_dtype: Optional[str] = None,
+                 wire_compress: Optional[str] = None,
+                 readahead: Optional[str] = None):
+        env = os.environ
+        self.multiget = max(1, min(int(
+            multiget if multiget is not None
+            else env.get("ZOO_SHARD_MULTIGET", "32")), 0xFFFF))
+        self.concurrency = max(1, int(
+            concurrency if concurrency is not None
+            else env.get("ZOO_SHARD_FETCH_CONCURRENCY", "4")))
+        self.lane = (lane or env.get("ZOO_SHARD_LANE", "auto")).lower()
+        if self.lane not in self.LANES:
+            raise ValueError(
+                f"ZOO_SHARD_LANE={self.lane!r}: pick one of {self.LANES}")
+        self.wire_dtype = (
+            wire_dtype or env.get("ZOO_SHARD_WIRE_DTYPE", "off")).lower()
+        self.wire_compress = (
+            wire_compress or env.get("ZOO_SHARD_WIRE_COMPRESS",
+                                     "off")).lower()
+        if self.wire_compress == "lz4" and "lz4" not in supported_codecs():
+            logger.warning(
+                "ZOO_SHARD_WIRE_COMPRESS=lz4 but the lz4 module is not "
+                "importable here — falling back to zlib")
+            self.wire_compress = "zlib"
+        # validate loudly at parse time, not mid-exchange
+        WirePolicy(self.wire_dtype, self.wire_compress)
+        if self.wire_dtype != "off" \
+                and self.wire_dtype not in supported_wire_dtypes():
+            # a VALID narrowing this build cannot decode (ml_dtypes
+            # missing): fall toward LOSSLESS, never toward a lossier one
+            logger.warning(
+                "ZOO_SHARD_WIRE_DTYPE=%s but this build cannot decode "
+                "it (ml_dtypes missing?) — narrowing disabled",
+                self.wire_dtype)
+            self.wire_dtype = "off"
+        self.readahead = (
+            readahead or env.get("ZOO_SHARD_READAHEAD", "adaptive")).lower()
+        if self.readahead not in ("adaptive", "static"):
+            # a typo here would silently disable the controller
+            raise ValueError(
+                f"ZOO_SHARD_READAHEAD={self.readahead!r}: adaptive or "
+                "static")
+
+    def wants_negotiation(self) -> bool:
+        """Whether a fresh connection should attempt the ZSXN hello:
+        any non-default wire feature, or the (default) auto lane whose
+        same-host probe IS the negotiation."""
+        return (self.lane != "tcp" or self.wire_dtype != "off"
+                or self.wire_compress != "off")
+
+    def clone(self) -> "ExchangeConfig":
+        return ExchangeConfig(
+            multiget=self.multiget, concurrency=self.concurrency,
+            lane=self.lane, wire_dtype=self.wire_dtype,
+            wire_compress=self.wire_compress, readahead=self.readahead)
+
+    def __repr__(self):
+        return (f"ExchangeConfig(multiget={self.multiget}, "
+                f"concurrency={self.concurrency}, lane={self.lane!r}, "
+                f"wire_dtype={self.wire_dtype!r}, "
+                f"wire_compress={self.wire_compress!r}, "
+                f"readahead={self.readahead!r})")
+
+
 # --------------------------------------------------------------------- codec
 # Wire codec v2: raw tensor framing. Per shard: i32 array count; per
 # array: u16-length name, u16-length dtype descriptor, u8 rank, rank x
 # u64 dims, u64 payload bytes, then the raw (C-order) buffer. Decoding
 # is np.frombuffer over the received buffer — zero-copy, non-executable.
+# Negotiated connections append a flags byte (+ narrowing/compression/
+# shm-offset fields) after each header — see _send_arrays/_read_shard.
 
 def _dtype_descr(dt: np.dtype) -> bytes:
     # '<f4'-style descriptors round-trip exactly (endianness included);
@@ -153,20 +280,6 @@ def _dtype_from_descr(descr: str) -> np.dtype:
             f"refusing object dtype {descr!r} from the wire (pickle "
             "vector; the exchange codec is non-executable)")
     return dt
-
-
-def _payload_view(arr: np.ndarray) -> memoryview:
-    """The array's raw bytes WITHOUT a serialize copy (contiguous
-    arrays; a non-contiguous shard pays one compaction copy)."""
-    a = np.ascontiguousarray(arr)
-    if a.nbytes == 0:
-        return memoryview(b"")
-    try:
-        return memoryview(a).cast("B")
-    except (ValueError, TypeError):
-        # extension dtypes (bfloat16) refuse the buffer protocol; a
-        # uint8 view of the same memory does not copy
-        return memoryview(a.reshape(-1).view(np.uint8))
 
 
 def _check_shard(shard) -> None:
@@ -282,18 +395,58 @@ def _recv_exact(sock: socket.socket, n: int) -> bytearray:
 
 # ---------------------------------------------------------------- conn pool
 
+class _Conn:
+    """One client connection + its per-connection negotiated state
+    (framing is stateful: extended headers and the shm lane apply only
+    after a successful ZSXN hello on THIS socket, so the state must
+    travel with the socket through the pool)."""
+
+    __slots__ = ("sock", "negotiated", "policy", "lane", "shm_dir")
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.negotiated = False
+        self.policy: Optional[WirePolicy] = None
+        self.lane = "tcp"
+        self.shm_dir: Optional[str] = None
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def _dial(addr: Tuple[str, int], timeout: float) -> _Conn:
+    """Fresh un-negotiated connection: ONE place for the dial ritual
+    (NODELAY, opened-counter) so the pool, the pool=False baseline and
+    the legacy redial cannot drift apart."""
+    sock = socket.create_connection(addr, timeout=timeout)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    _pool_conns.labels(event="opened").inc()
+    return _Conn(sock)
+
+
 class _ConnPool:
     """Per-peer idle-connection pool. ``acquire`` hands back a pooled
-    socket (metric event ``reused``) or dials a fresh one (``opened``);
-    ``release`` returns it for the next fetch. A connection that errors
-    mid-RPC must be closed and the peer's pool invalidated — the stream
-    is poisoned and every idle sibling probably points at the same dead
-    peer."""
+    connection (metric event ``reused``) or dials a fresh one
+    (``opened``); ``release`` returns it for the next fetch. A
+    connection that errors mid-RPC must be closed and the peer's pool
+    invalidated — the stream is poisoned and every idle sibling
+    probably points at the same dead peer."""
 
     def __init__(self, max_idle_per_peer: Optional[int] = None):
-        self._idle: Dict[Tuple[str, int], List[socket.socket]] = {}
+        self._idle: Dict[Tuple[str, int], List[_Conn]] = {}
         self._lock = threading.Lock()
         self._max_idle = max_idle_per_peer
+        # peers that dropped the ZSXN hello (ZSX2-only builds): skip
+        # the hello on future dials so every reconnect doesn't re-pay
+        # a doomed round trip + a duplicate warning
+        self._legacy_peers: set = set()
+        self._legacy_warned: set = set()
+        # (addr, requested (dtype, compress)) -> granted (dtype,
+        # compress): what the peer actually agreed to for a request
+        self._negotiated: Dict[tuple, tuple] = {}
 
     @property
     def max_idle(self) -> int:
@@ -301,40 +454,83 @@ class _ConnPool:
             return self._max_idle
         return max(1, int(os.environ.get("ZOO_SHARD_POOL_SIZE", "4")))
 
-    def acquire(self, addr: Tuple[str, int],
-                timeout: float) -> socket.socket:
+    def acquire(self, addr: Tuple[str, int], timeout: float) -> _Conn:
         with self._lock:
             lst = self._idle.get(addr)
-            sock = lst.pop() if lst else None
-        if sock is not None:
+            conn = lst.pop() if lst else None
+        if conn is not None:
             _pool_conns.labels(event="reused").inc()
-            sock.settimeout(timeout)
-            return sock
-        sock = socket.create_connection(addr, timeout=timeout)
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        _pool_conns.labels(event="opened").inc()
-        return sock
+            conn.sock.settimeout(timeout)
+            return conn
+        return _dial(addr, timeout)
 
-    def release(self, addr: Tuple[str, int], sock: socket.socket):
+    def release(self, addr: Tuple[str, int], conn: _Conn):
         with self._lock:
             lst = self._idle.setdefault(addr, [])
             if len(lst) < self.max_idle:
-                lst.append(sock)
+                lst.append(conn)
                 return
-        sock.close()
+        conn.close()
 
     def invalidate(self, addr: Tuple[str, int]):
         with self._lock:
             stale = self._idle.pop(addr, [])
-        for s in stale:
-            try:
-                s.close()
-            except OSError:
-                pass
+            # the peer may be restarting with a different build whose
+            # negotiation answers differ — re-learn EVERYTHING on the
+            # next dial, including a legacy verdict (one re-paid hello
+            # round trip beats a sticky downgrade if the verdict came
+            # from a blip or the peer was since upgraded)
+            for k in [k for k in self._negotiated if k[0] == addr]:
+                del self._negotiated[k]
+            self._legacy_peers.discard(addr)
+            self._legacy_warned.discard(addr)
+        for c in stale:
+            c.close()
+
+    def mark_legacy(self, addr) -> bool:
+        """Record a ZSX2-only peer; returns True the FIRST time (the
+        caller logs once, not per reconnect)."""
+        with self._lock:
+            if addr in self._legacy_peers:
+                return False
+            self._legacy_peers.add(addr)
+            return True
+
+    def is_legacy(self, addr) -> bool:
+        with self._lock:
+            return addr in self._legacy_peers
+
+    def warn_features_once(self, addr) -> bool:
+        """First featureful config to hit an already-memoized legacy
+        peer gets one loud line (the memo's first-contact log may have
+        predated the feature request)."""
+        with self._lock:
+            if addr in self._legacy_warned:
+                return False
+            self._legacy_warned.add(addr)
+            return True
+
+    def remember_outcome(self, addr, requested: tuple, granted: tuple):
+        """Memoize what a peer actually granted for a requested wire
+        profile. Negotiation is deterministic per (peer, request), so a
+        pooled connection carrying the GRANTED profile stays reusable
+        for that request even when the peer negotiated a feature DOWN
+        (e.g. no lz4 on the serving side) — without the memo a
+        downgrade mismatches every checkout and permanently defeats
+        the pool, one silent redial + hello per chunk."""
+        with self._lock:
+            self._negotiated[(addr, requested)] = granted
+
+    def granted_for(self, addr, requested: tuple) -> Optional[tuple]:
+        with self._lock:
+            return self._negotiated.get((addr, requested))
 
     def clear(self):
         with self._lock:
             all_addrs = list(self._idle)
+            self._legacy_peers.clear()
+            self._legacy_warned.clear()
+            self._negotiated.clear()
         for a in all_addrs:
             self.invalidate(a)
 
@@ -344,6 +540,47 @@ _pool = _ConnPool()
 
 # ------------------------------------------------------------------- server
 
+class _ServerConnState:
+    """Per-connection negotiated state on the serving side."""
+
+    def __init__(self):
+        self.policy: Optional[WirePolicy] = None
+        self.shm_dir: Optional[str] = None
+        self.probe_path: Optional[str] = None
+        self.shm_pending = False
+        self.shm_on = False
+        self.shm_failed_logged = False
+        # announced segments not yet acked by the client, oldest first
+        self.outstanding: List[Optional[_shm.SegmentWriter]] = []
+
+    def confirm_shm(self, ok: bool):
+        self._drop_probe()
+        self.shm_on = bool(ok) and self.shm_pending
+        self.shm_pending = False
+
+    def pop_ack(self):
+        if self.outstanding:
+            w = self.outstanding.pop(0)
+            if w is not None:
+                w.discard()  # usually ENOENT — the client unlinked first
+
+    def _drop_probe(self):
+        if self.probe_path:
+            try:
+                os.unlink(self.probe_path)
+            except OSError:
+                pass
+            self.probe_path = None
+
+    def cleanup(self):
+        """Connection is gone (ack'd or not): nothing may leak."""
+        self._drop_probe()
+        for w in self.outstanding:
+            if w is not None:
+                w.discard()
+        self.outstanding = []
+
+
 class ShardExchange:
     """Serve this process's shards (by global id) to peer hosts.
 
@@ -352,20 +589,39 @@ class ShardExchange:
     in request order = ``ZSX2`` + u32 gid + i32 array count (-1 = not
     held here) + the raw-tensor frames of the shard. Payloads leave
     through ``memoryview`` of the original arrays — nothing is
-    pre-encoded and nothing on the wire can execute code. A ``ZSX1``
-    (protocol v1) request is rejected loudly and the connection
-    dropped: mixed-version clusters must fail, not corrupt. The port is
-    ephemeral, announced only through the JAX coordination service, and
-    the server thread dies with the process.
+    pre-encoded and nothing on the wire can execute code.
+
+    A client may open with a ``ZSXN`` hello negotiating per-connection
+    wire features: dtype narrowing / compression (applied per array by
+    :mod:`~zoo_tpu.orca.data.wire_codec`) and the same-host
+    shared-memory payload lane (probe-verified; payload bytes then move
+    through per-chunk ``/dev/shm`` segments and only control frames
+    cross the socket — :mod:`~zoo_tpu.orca.data.shm`). Responses on a
+    negotiated connection carry one extra flags byte per array; an
+    un-negotiated connection speaks bit-identical v2.
+
+    A ``ZSX1`` (protocol v1) request is rejected loudly and the
+    connection dropped: mixed-version clusters must fail, not corrupt.
+    The port is ephemeral, announced only through the JAX coordination
+    service, and the server thread dies with the process.
     """
 
+    # class-level default so test fixtures that build instances via
+    # __new__ (port-pinned exchanges) inherit sane behavior
+    _negotiate = True
+
     def __init__(self, shards_by_gid: Dict[int, Dict[str, np.ndarray]],
-                 bind: str = "0.0.0.0"):
+                 bind: str = "0.0.0.0", negotiate: bool = True):
         for s in shards_by_gid.values():
             _check_shard(s)
         # served lazily from the caller's arrays: no blob copies, no
         # doubled resident memory while the exchange is open
         self._shards = dict(shards_by_gid)
+        self._negotiate = negotiate
+        if negotiate:
+            # reap segments orphaned by SIGKILL'd peers (the one leak
+            # window the unlink-after-map protocol cannot cover)
+            _shm.gc_stale_segments()
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((bind, 0))
@@ -392,6 +648,7 @@ class ShardExchange:
                              daemon=True).start()
 
     def _handle(self, conn: socket.socket):
+        st = _ServerConnState()
         try:
             with conn:
                 while True:
@@ -406,19 +663,120 @@ class ShardExchange:
                             "versions in one cluster; upgrade every "
                             "host in lockstep. Dropping the connection.")
                         return
+                    if magic == _MAGIC_HELLO and self._negotiate:
+                        self._handle_hello(conn, st)
+                        continue
+                    if magic == _MAGIC_SHM_OK:
+                        (ok,) = struct.unpack("!B", _recv_exact(conn, 1))
+                        st.confirm_shm(bool(ok))
+                        continue
+                    if magic == _MAGIC_ACK:
+                        st.pop_ack()
+                        continue
                     if magic != _MAGIC:
                         return  # not our protocol: drop the connection
                     (count,) = struct.unpack("!H", _recv_exact(conn, 2))
                     gids = struct.unpack(f"!{count}I",
                                          _recv_exact(conn, 4 * count))
-                    for gid in gids:
-                        fault_point("shard.serve", gid=gid)
-                        self._send_shard(conn, gid)
+                    self._respond(conn, gids, st)
         except OSError:
             pass
         finally:
+            st.cleanup()
             with self._conns_lock:
                 self._conns.discard(conn)
+
+    def _handle_hello(self, conn: socket.socket, st: _ServerConnState):
+        (ln,) = struct.unpack("!H", _recv_exact(conn, 2))
+        try:
+            prop = json.loads(bytes(_recv_exact(conn, ln)).decode("utf-8"))
+        except ValueError:
+            prop = {}
+        dtype = prop.get("dtype", "off")
+        if dtype not in supported_wire_dtypes():
+            # unknown string OR a narrowing this build cannot encode
+            # (bf16 without ml_dtypes): grant no narrowing rather than
+            # ImportError mid-response with frames already on the wire
+            dtype = "off"
+        comp = next((c for c in prop.get("compress", [])
+                     if c in supported_codecs()), "off")
+        st.policy = WirePolicy(dtype, comp)
+        reply = {"v": 2, "dtype": dtype, "compress": comp, "shm": None}
+        if prop.get("shm"):
+            try:
+                d = _shm.shm_dir()
+                name, token, path = _shm.write_probe(d)
+                st.shm_dir, st.probe_path = d, path
+                st.shm_pending = True
+                reply["shm"] = {"dir": d, "name": name, "token": token}
+            except OSError:
+                pass  # no usable shm dir: stay on the TCP payload path
+        blob = json.dumps(reply).encode("utf-8")
+        conn.sendall(_MAGIC_HELLO + struct.pack("!H", len(blob)) + blob)
+
+    def _respond(self, conn: socket.socket, gids, st: _ServerConnState):
+        if st.policy is None:
+            # un-negotiated connection: bit-identical plain v2
+            for gid in gids:
+                fault_point("shard.serve", gid=gid)
+                self._send_shard(conn, gid)
+            return
+        writer = None
+        if st.shm_on:
+            # upper bound = raw logical bytes (narrowing/compression
+            # can only shrink); pages are reserved up front so a full
+            # tmpfs fails HERE, where the chunk can still degrade to
+            # inline TCP payloads (empty announce + no FLAG_SHM)
+            # instead of tearing the stream mid-frame
+            ub = sum(arr.nbytes
+                     for g in gids
+                     for arr in (self._shards.get(g) or {}).values())
+            if ub:
+                try:
+                    writer = _shm.SegmentWriter(st.shm_dir, ub)
+                except OSError as e:
+                    if not st.shm_failed_logged:
+                        st.shm_failed_logged = True
+                        logger.warning(
+                            "shm lane: segment allocation of %d bytes "
+                            "in %s failed (%s) — serving this "
+                            "connection's payloads inline over TCP "
+                            "(is the tmpfs full?)", ub, st.shm_dir, e)
+            # track BEFORE any frame leaves: the chaos path (peer dies
+            # mid-response) must find it in outstanding and discard it
+            st.outstanding.append(writer)
+            nb = (writer.name if writer else "").encode("ascii")
+            conn.sendall(_MAGIC_SEG + struct.pack("!H", len(nb)) + nb +
+                         struct.pack("!Q", writer.size if writer else 0))
+        for gid in gids:
+            fault_point("shard.serve", gid=gid)
+            shard = self._shards.get(gid)
+            if shard is None:
+                conn.sendall(_MAGIC + struct.pack("!Ii", gid, -1))
+                continue
+            conn.sendall(_MAGIC + struct.pack("!Ii", gid, len(shard)))
+            for name, arr in shard.items():
+                self._send_array(conn, name, arr, st, writer)
+
+    def _send_array(self, conn, name, arr, st: _ServerConnState, writer):
+        flags, wdescr, scale, payload = encode_array(arr, st.policy)
+        pv = memoryview(payload)
+        parts = [_array_header(name, arr)]
+        if writer is not None:
+            flags |= FLAG_SHM
+        parts.append(struct.pack("!B", flags))
+        if flags & FLAG_NARROWED:
+            parts.append(struct.pack("!H", len(wdescr)) + wdescr +
+                         struct.pack("!d", scale))
+        if flags & (FLAG_NARROWED | FLAG_COMPRESSED):
+            parts.append(struct.pack("!Q", pv.nbytes))
+        if writer is not None:
+            parts.append(struct.pack("!Q", writer.write(pv)))
+            conn.sendall(b"".join(parts))
+        else:
+            conn.sendall(b"".join(parts))
+            if pv.nbytes:
+                conn.sendall(pv)
 
     def _send_shard(self, conn: socket.socket, gid: int):
         shard = self._shards.get(gid)
@@ -465,7 +823,8 @@ class ShardExchange:
 
     @staticmethod
     def fetch(addr: Tuple[str, int], gid: int, timeout: float = 60.0,
-              retry: Optional[RetryPolicy] = None, pool: bool = True
+              retry: Optional[RetryPolicy] = None, pool: bool = True,
+              config: Optional[ExchangeConfig] = None
               ) -> Dict[str, np.ndarray]:
         """Fetch shard ``gid`` from ``addr`` with bounded retries.
 
@@ -478,13 +837,192 @@ class ShardExchange:
         one connection per call (the pre-v2 behavior; kept as the
         microbench baseline)."""
         return fetch_many(addr, [gid], timeout=timeout, retry=retry,
-                          pool=pool)[gid]
+                          pool=pool, config=config)[gid]
 
 
 # ------------------------------------------------------------------- client
 
-def _read_shard(sock: socket.socket) -> Tuple[int, Optional[Dict], int]:
-    """One response frame → (gid, shard-or-None, bytes received)."""
+def _negotiate_conn(conn: _Conn, addr, cfg: ExchangeConfig,
+                    timeout: float) -> bool:
+    """One-round ZSXN hello on a fresh connection. Returns False when
+    the peer dropped the hello (a ZSX2-only build): the socket is dead
+    and the caller must redial plain. Raises :class:`ProtocolError` on
+    a non-exchange peer or when a hard requirement (forced shm lane)
+    cannot be met."""
+    sock = conn.sock
+    prop = {"v": 2, "dtype": cfg.wire_dtype,
+            "compress": ([] if cfg.wire_compress == "off"
+                         else [cfg.wire_compress]),
+            "shm": cfg.lane in ("auto", "shm")}
+    blob = json.dumps(prop).encode("utf-8")
+    sock.sendall(_MAGIC_HELLO + struct.pack("!H", len(blob)) + blob)
+    try:
+        magic = _recv_exact(sock, 4)
+    except ConnectionError:
+        return False  # legacy peer: hello dropped, connection closed
+    if magic != _MAGIC_HELLO:
+        raise ProtocolError(
+            f"peer {addr} answered the negotiation hello with magic "
+            f"{bytes(magic)!r} — protocol version mismatch (v1 peer in "
+            "a v2 cluster?)")
+    (ln,) = struct.unpack("!H", _recv_exact(sock, 2))
+    reply = json.loads(bytes(_recv_exact(sock, ln)).decode("utf-8"))
+    conn.policy = WirePolicy(reply.get("dtype", "off"),
+                             reply.get("compress", "off"))
+    conn.negotiated = True
+    shm_info = reply.get("shm")
+    ok = bool(shm_info) and _shm.check_probe(
+        shm_info["dir"], shm_info["name"], shm_info["token"])
+    sock.sendall(_MAGIC_SHM_OK + struct.pack("!B", 1 if ok else 0))
+    if ok:
+        conn.lane = "shm"
+        conn.shm_dir = shm_info["dir"]
+    elif cfg.lane == "shm":
+        raise ProtocolError(
+            f"ZOO_SHARD_LANE=shm forced but peer {addr} "
+            + ("did not offer a shared-memory segment"
+               if not shm_info else
+               "failed the same-host probe (different host?)"))
+    return True
+
+
+def _forced_shm_legacy_error(addr) -> ProtocolError:
+    """The one message for ZOO_SHARD_LANE=shm hitting a ZSX2-only peer,
+    whether discovered on this dial or memoized from an earlier one."""
+    return ProtocolError(
+        f"ZOO_SHARD_LANE=shm forced but peer {addr} pre-dates wire "
+        "negotiation (ZSX2-only build) — upgrade it or unset the "
+        "forced lane")
+
+
+def _conn_matches(conn: _Conn, addr, cfg: ExchangeConfig) -> bool:
+    """Whether a NEGOTIATED pooled connection's profile is the one this
+    config would negotiate. The pool is process-global and profiles are
+    per-connection state, so a mismatched checkout must be discarded —
+    reusing it would silently apply another caller's (possibly lossy)
+    wire treatment, or the wrong lane, to this fetch. The comparison is
+    against what this request is KNOWN to get from this peer (the
+    pool's negotiation memo) when a prior hello recorded it — a peer
+    that grants a feature DOWN (no lz4 on its side, say) must not
+    mismatch every checkout forever. (An un-negotiated pooled
+    connection never reaches here: it either serves a plain config
+    as-is or gets the hello on checkout.)"""
+    if not cfg.wants_negotiation():
+        return False  # cfg wants bit-plain v2 framing; conn is extended
+    pol = conn.policy or WirePolicy()
+    requested = (cfg.wire_dtype, cfg.wire_compress)
+    granted = _pool.granted_for(addr, requested)
+    if (pol.dtype, pol.compress) != (granted or requested):
+        return False
+    if cfg.lane == "shm" and conn.lane != "shm":
+        return False
+    if cfg.lane == "tcp" and conn.lane != "tcp":
+        return False
+    return True
+
+
+def _acquire_conn(addr, timeout: float, pool: bool,
+                  cfg: ExchangeConfig) -> _Conn:
+    """Dial or pool-checkout a connection, negotiating wire features on
+    fresh sockets. A pooled connection whose negotiated profile does
+    not match THIS config is discarded and replaced — profiles are
+    per-connection, configs are per-caller, and the two must never mix.
+    A peer that drops the hello (pre-negotiation build) is remembered
+    and redialed plain — loudly when the config asked for a feature the
+    fallback loses, and a hard error when the shm lane is forced (a
+    forced lane never silently degrades, memoized peer or not)."""
+    if _pool.is_legacy(addr) and cfg.lane == "shm":
+        raise _forced_shm_legacy_error(addr)
+    if _pool.is_legacy(addr) \
+            and (cfg.wire_dtype != "off" or cfg.wire_compress != "off") \
+            and _pool.warn_features_once(addr):
+        logger.error(
+            "peer %s:%d is a memoized ZSX2-only build: requested wire "
+            "dtype/compression (%s/%s) DISABLED for this peer",
+            addr[0], addr[1], cfg.wire_dtype, cfg.wire_compress)
+    conn = _pool.acquire(addr, timeout) if pool else _dial(addr, timeout)
+    if conn.negotiated and not _conn_matches(conn, addr, cfg):
+        # another caller's profile: close it and start clean
+        conn.close()
+        conn = _dial(addr, timeout)
+    if conn.negotiated or not cfg.wants_negotiation() \
+            or _pool.is_legacy(addr):
+        return conn
+    # up to two hello attempts, the second on a guaranteed-fresh dial:
+    # a dropped hello on the first may be a stale pooled socket or a
+    # peer mid-restart, and the legacy verdict is sticky — confirm
+    # before memoizing. A transiently-dead peer fails the fresh dial
+    # itself, which propagates as the transient error it is.
+    for attempt in range(2):
+        conn.sock.settimeout(timeout)
+        try:
+            if _negotiate_conn(conn, addr, cfg, timeout):
+                pol = conn.policy or WirePolicy()
+                _pool.remember_outcome(
+                    addr, (cfg.wire_dtype, cfg.wire_compress),
+                    (pol.dtype, pol.compress))
+                return conn
+        except ProtocolError:
+            conn.close()
+            raise
+        except (ConnectionError, OSError):
+            conn.close()
+            raise
+        conn.close()
+        if attempt == 0:
+            conn = _dial(addr, timeout)
+    # hello dropped twice on fresh sockets: ZSX2-only peer. Fall back
+    # to the plain protocol — loud when that loses a requested feature.
+    if cfg.lane == "shm":
+        raise _forced_shm_legacy_error(addr)
+    if _pool.mark_legacy(addr):
+        if cfg.wire_dtype != "off" or cfg.wire_compress != "off":
+            # this discovery log already names the lost features:
+            # consume the memo-path token so the peer is warned once,
+            # not once per dedup mechanism
+            _pool.warn_features_once(addr)
+            logger.error(
+                "peer %s:%d pre-dates wire negotiation (ZSX2-only): "
+                "requested wire dtype/compression (%s/%s) DISABLED for "
+                "this peer — upgrade hosts in lockstep to get it back",
+                addr[0], addr[1], cfg.wire_dtype, cfg.wire_compress)
+        else:
+            logger.warning(
+                "peer %s:%d pre-dates wire negotiation (ZSX2-only); "
+                "staying on the plain TCP lane", addr[0], addr[1])
+    return _dial(addr, timeout)
+
+
+def _read_segment_announce(conn: _Conn) -> Optional[_shm.SegmentReader]:
+    """Read the server's per-chunk segment announce, map + unlink the
+    segment, and ack. Returns None for an all-empty chunk."""
+    magic = _recv_exact(conn.sock, 4)
+    if magic != _MAGIC_SEG:
+        raise ProtocolError(
+            f"expected shm segment announce, got magic {bytes(magic)!r} "
+            "— desynchronized stream")
+    (nlen,) = struct.unpack("!H", _recv_exact(conn.sock, 2))
+    name = bytes(_recv_exact(conn.sock, nlen)).decode("ascii")
+    (size,) = struct.unpack("!Q", _recv_exact(conn.sock, 8))
+    seg = None
+    if name and size:
+        try:
+            seg = _shm.SegmentReader(conn.shm_dir, name, size)
+        except (OSError, ValueError) as e:
+            raise ConnectionError(
+                f"shm segment {name!r} vanished before mapping "
+                f"(peer died?): {e}") from e
+    conn.sock.sendall(_MAGIC_ACK)
+    return seg
+
+
+def _read_shard(conn: _Conn, seg: Optional[_shm.SegmentReader]
+                ) -> Tuple[int, Optional[Dict], int, int]:
+    """One response frame → (gid, shard-or-None, wire bytes, logical
+    bytes). Wire bytes = what actually crossed the transport (narrowed/
+    compressed size; shm offsets count their payload — the bytes moved,
+    just not through the socket). Logical = decoded array bytes."""
+    sock = conn.sock
     head = _recv_exact(sock, 12)
     if head[:4] != _MAGIC:
         raise ProtocolError(
@@ -493,44 +1031,80 @@ def _read_shard(sock: socket.socket) -> Tuple[int, Optional[Dict], int]:
             "cluster?)")
     gid, count = struct.unpack("!Ii", bytes(head[4:]))
     if count < 0:
-        return gid, None, 12
+        return gid, None, 12, 12
     shard: Dict[str, np.ndarray] = {}
-    total = 12
+    wire = logical = 12
     for _ in range(count):
         name, dt, shape, nbytes, header_len = _parse_array_header(
             lambda n: _recv_exact(sock, n))
-        buf = _recv_exact(sock, nbytes) if nbytes else b""
-        # the decoded array WRAPS the recv buffer — no copy
-        shard[name] = np.frombuffer(memoryview(buf),
-                                    dtype=dt).reshape(shape)
-        total += header_len + nbytes
-    return gid, shard, total
+        logical += header_len + nbytes
+        if not conn.negotiated:
+            buf = _recv_exact(sock, nbytes) if nbytes else b""
+            # the decoded array WRAPS the recv buffer — no copy
+            shard[name] = np.frombuffer(memoryview(buf),
+                                        dtype=dt).reshape(shape)
+            wire += header_len + nbytes
+            continue
+        (flags,) = struct.unpack("!B", _recv_exact(sock, 1))
+        wdescr, scale, wn = None, 0.0, nbytes
+        if flags & FLAG_NARROWED:
+            (dlen,) = struct.unpack("!H", _recv_exact(sock, 2))
+            wdescr = bytes(_recv_exact(sock, dlen)).decode("ascii")
+            (scale,) = struct.unpack("!d", _recv_exact(sock, 8))
+            header_len += 10 + dlen
+        if flags & (FLAG_NARROWED | FLAG_COMPRESSED):
+            (wn,) = struct.unpack("!Q", _recv_exact(sock, 8))
+            header_len += 8
+            if wn > nbytes:
+                raise ProtocolError(
+                    f"array {name!r}: wire length {wn} exceeds logical "
+                    f"{nbytes} — narrowing/compression can only shrink; "
+                    "corrupt or desynchronized stream")
+        if flags & FLAG_SHM:
+            (off,) = struct.unpack("!Q", _recv_exact(sock, 8))
+            if seg is None:
+                raise ProtocolError(
+                    f"array {name!r}: shm payload flagged but no "
+                    "segment was announced for this chunk")
+            buf = seg.view(off, wn)
+        else:
+            buf = _recv_exact(sock, wn) if wn else b""
+        try:
+            shard[name] = decode_payload(
+                buf, flags, dt, shape, wdescr, scale,
+                conn.policy.compress if conn.policy else "off")
+        except ProtocolError:
+            raise
+        except Exception as e:  # zlib.error / frombuffer size mismatch
+            raise ProtocolError(
+                f"array {name!r}: wire payload failed to decode "
+                f"({e!r}) — corrupt or desynchronized stream") from e
+        wire += header_len + 1 + wn
+    return gid, shard, wire, logical
 
 
 def _fetch_chunk_once(addr: Tuple[str, int], gids: Sequence[int],
-                      timeout: float, pool: bool) -> Dict[int, Dict]:
+                      timeout: float, pool: bool,
+                      cfg: ExchangeConfig) -> Dict[int, Dict]:
     """One pipelined multi-get attempt: N gids in one write, responses
-    streamed back on the same connection."""
+    streamed back on the same connection (payloads through the shm
+    segment when that lane is negotiated)."""
     for gid in gids:
         fault_point("shard.fetch", addr=addr, gid=gid)
     _fetch_requests.labels(
         mode="multi" if len(gids) > 1 else "single").inc()
     t0 = time.perf_counter()
-    if pool:
-        sock = _pool.acquire(addr, timeout)
-    else:
-        sock = socket.create_connection(addr, timeout=timeout)
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        _pool_conns.labels(event="opened").inc()
+    conn = _acquire_conn(addr, timeout, pool, cfg)
     reusable = False
     try:
-        sock.settimeout(timeout)
-        sock.sendall(_MAGIC + struct.pack(f"!H{len(gids)}I",
-                                          len(gids), *gids))
+        conn.sock.settimeout(timeout)
+        conn.sock.sendall(_MAGIC + struct.pack(f"!H{len(gids)}I",
+                                               len(gids), *gids))
+        seg = _read_segment_announce(conn) if conn.lane == "shm" else None
         out: Dict[int, Dict] = {}
-        total = 0
+        wire_total = logical_total = 0
         for want in gids:
-            gid, shard, nbytes = _read_shard(sock)
+            gid, shard, wire, logical = _read_shard(conn, seg)
             if gid != want:
                 raise ProtocolError(
                     f"peer {addr} answered gid {gid} for request {want} "
@@ -538,10 +1112,15 @@ def _fetch_chunk_once(addr: Tuple[str, int], gids: Sequence[int],
             if shard is None:
                 raise KeyError(f"peer {addr} does not hold shard {gid}")
             out[gid] = shard
-            total += nbytes
+            wire_total += wire
+            logical_total += logical
         reusable = pool
         _fetch_seconds.observe(time.perf_counter() - t0)
-        _fetch_bytes.inc(total)
+        _fetch_bytes.inc(wire_total)
+        _lane_shards.labels(lane=conn.lane).inc(len(gids))
+        _lane_bytes.labels(lane=conn.lane).inc(wire_total)
+        if logical_total > wire_total:
+            _wire_saved.inc(logical_total - wire_total)
         return out
     except (ConnectionError, OSError):
         # poisoned stream AND probably a dead peer: every pooled
@@ -551,90 +1130,207 @@ def _fetch_chunk_once(addr: Tuple[str, int], gids: Sequence[int],
         raise
     finally:
         if reusable:
-            _pool.release(addr, sock)
+            _pool.release(addr, conn)
         else:
             # KeyError leaves unread responses in flight; error paths
             # leave a torn stream — never pool either
-            try:
-                sock.close()
-            except OSError:
-                pass
+            conn.close()
 
 
 def fetch_many(addr: Tuple[str, int], gids: Sequence[int],
                timeout: float = 60.0,
                retry: Optional[RetryPolicy] = None,
-               pool: bool = True) -> Dict[int, Dict[str, np.ndarray]]:
+               pool: bool = True,
+               config: Optional[ExchangeConfig] = None
+               ) -> Dict[int, Dict[str, np.ndarray]]:
     """Fetch many shards from one peer with pipelined multi-gets.
 
-    ``gids`` are split into chunks of ``ZOO_SHARD_MULTIGET`` (default
-    32); each chunk is one wire round trip (one request write, streamed
-    responses) retried independently under ``retry`` — a peer dying
-    mid-stream costs one chunk's refetch on a fresh connection, and
-    ``fault_point("shard.fetch")`` fires per gid per attempt exactly as
-    it did for single fetches."""
+    ``gids`` are split into chunks of ``config.multiget`` (default
+    ``ZOO_SHARD_MULTIGET`` = 32); each chunk is one wire round trip
+    (one request write, streamed responses) retried independently under
+    ``retry`` — a peer dying mid-stream costs one chunk's refetch on a
+    fresh connection, and ``fault_point("shard.fetch")`` fires per gid
+    per attempt exactly as it did for single fetches."""
     gids = [int(g) for g in gids]
+    cfg = config or ExchangeConfig()
     retry = retry or RetryPolicy(max_attempts=3, base_delay=0.1,
                                  max_delay=2.0, deadline=timeout)
     out: Dict[int, Dict[str, np.ndarray]] = {}
-    chunk = _multiget_chunk()
-    for i in range(0, len(gids), chunk):
+    i = 0
+    while i < len(gids):
+        # re-read per chunk: the readahead controller may have resized
+        chunk = max(1, min(int(cfg.multiget), 0xFFFF))
         part = gids[i:i + chunk]
+        i += chunk
         out.update(retry.call(_fetch_chunk_once, addr, part, timeout,
-                              pool))
+                              pool, cfg))
     return out
 
 
 def iter_fetch(sources: Sequence[Tuple[Tuple[str, int], Sequence[int]]],
                timeout=60.0,
                concurrency: Optional[int] = None,
-               retry: Optional[RetryPolicy] = None
+               retry: Optional[RetryPolicy] = None,
+               config: Optional[ExchangeConfig] = None,
+               controller=None
                ) -> Iterable[Tuple[int, Dict[str, np.ndarray]]]:
     """Stream ``(gid, shard)`` pairs from many peers as they arrive.
 
-    ``sources`` = [(addr, gids), ...]. Chunks fan out over a bounded
-    thread pool (``ZOO_SHARD_FETCH_CONCURRENCY``, default 4) and
-    completed chunks yield immediately — the generator is the *fetch
-    stage* of the ingest pipeline, so a consumer wrapping it in
-    :func:`zoo_tpu.orca.data.ingest.staged_pipeline` overlaps device
-    transfer of earlier shards with the network fetch of later ones.
-    Ordering across peers is completion order, not plan order.
+    ``sources`` = [(addr, gids), ...]. Chunks are carved LAZILY (next
+    chunk's size reads ``config.multiget`` at carve time) and fan out
+    over a bounded worker set whose live width is re-read from
+    ``config.concurrency`` — so a :class:`~zoo_tpu.orca.data.ingest.
+    ReadaheadController` passed as ``controller`` can grow/shrink both
+    between chunks. ``controller.on_chunk(ngids, nbytes, seconds)`` is
+    invoked after each completed chunk. Ordering across peers is
+    completion order, not plan order.
 
     ``timeout`` may be a callable re-evaluated when each chunk STARTS
     (not when it was queued) — rebalance passes its ``remaining()``
     budget so queued chunks cannot stack fresh 60s retry deadlines past
     the phase deadline; once the budget is spent the callable raises
     and every pending chunk fails fast."""
-    if concurrency is None:
-        concurrency = max(1, int(os.environ.get(
-            "ZOO_SHARD_FETCH_CONCURRENCY", "4")))
+    if controller is not None:
+        # the controller's shared config IS the contract: chunks are
+        # carved from it and the concurrency kwarg is ignored outright
+        # (applying it would clobber the controller's state). Duck
+        # controllers (on_chunk only, no .config) use the passed config.
+        ctl_cfg = getattr(controller, "config", None)
+        if ctl_cfg is not None and config is not None \
+                and ctl_cfg is not config:
+            raise ValueError(
+                "iter_fetch: controller.config and config are different "
+                "objects — the controller would adapt one while chunks "
+                "are carved from the other; pass the controller's own "
+                "config (or neither)")
+        cfg = ctl_cfg or config or ExchangeConfig()
+    else:
+        cfg = config or ExchangeConfig()
+        if concurrency is not None:
+            if config is not None:
+                # never mutate a caller's config object from a kwarg —
+                # the override lives on a private copy
+                cfg = cfg.clone()
+            cfg.concurrency = max(1, int(concurrency))
     timeout_fn = timeout if callable(timeout) else (lambda: timeout)
-    chunk = _multiget_chunk()
-    tasks = []
-    for addr, gids in sources:
-        gids = list(gids)
-        for i in range(0, len(gids), chunk):
-            tasks.append((addr, gids[i:i + chunk]))
-    if not tasks:
+    pending = [[addr, list(gids)] for addr, gids in sources if len(gids)]
+    total = sum(len(g) for _, g in pending)
+    if not total:
         return
+    lock = threading.Lock()
+    rr = [0]  # round-robin cursor across sources
 
-    def _run(addr, part):
-        return fetch_many(addr, part, timeout=timeout_fn(), retry=retry)
+    def take_chunk():
+        with lock:
+            for _ in range(len(pending)):
+                i = rr[0] % len(pending)
+                rr[0] += 1
+                addr, gids = pending[i]
+                if gids:
+                    n = max(1, min(int(cfg.multiget), 0xFFFF))
+                    pending[i][1] = gids[n:]
+                    return addr, gids[:n]
+        return None
 
-    tp = ThreadPoolExecutor(max_workers=min(concurrency, len(tasks)),
-                            thread_name_prefix="zoo-shard-fetch")
-    futs = [tp.submit(_run, addr, part) for addr, part in tasks]
+    def chunks_left() -> bool:
+        with lock:
+            return any(gids for _, gids in pending)
+
+    out_q: "queue.Queue" = queue.Queue()
+    stop = threading.Event()
+    # live-width accounting (NOT thread objects): retired workers must
+    # free their slot or later controller growth could never re-spawn
+    state = {"live": 0, "spawned": 0}
+
+    def _maybe_retire() -> bool:
+        # shrink: a worker above the CURRENT width retires atomically
+        # (check-and-decrement under the lock so concurrent retirees
+        # cannot undershoot the width) — the consumer re-spawns fresh
+        # ones if the controller grows again; no parked threads, no
+        # polling. The last live worker never retires (width >= 1), so
+        # remaining chunks always have an owner.
+        with lock:
+            if state["live"] > max(1, int(cfg.concurrency)):
+                state["live"] -= 1
+                return True
+            return False
+
+    def run():
+        retired = False
+        try:
+            while not stop.is_set():
+                if _maybe_retire():
+                    retired = True
+                    return
+                task = take_chunk()
+                if task is None:
+                    return
+                addr, part = task
+                t0 = time.perf_counter()
+                res = fetch_many(addr, part, timeout=timeout_fn(),
+                                 retry=retry, config=cfg)
+                if controller is not None:
+                    nb = sum(v.nbytes for s in res.values()
+                             for v in s.values())
+                    controller.on_chunk(len(part), nb,
+                                        time.perf_counter() - t0)
+                out_q.put(("ok", res))
+        except BaseException as e:  # noqa: BLE001 — relayed to consumer
+            out_q.put(("err", e))
+        finally:
+            if not retired:
+                with lock:
+                    state["live"] -= 1
+            out_q.put(("done", None))
+
+    def ensure_workers():
+        """Spawn up to the CURRENT width — called at start and after
+        every completed chunk, so controller growth materializes as new
+        threads exactly when there is evidence (a completion) to react
+        to, and never as a parked-thread pool."""
+        while chunks_left():
+            with lock:
+                if state["live"] >= min(max(1, int(cfg.concurrency)),
+                                        total):
+                    return
+                state["live"] += 1
+                state["spawned"] += 1
+                n = state["spawned"]
+            threading.Thread(target=run, daemon=True,
+                             name=f"zoo-shard-fetch-{n}").start()
+
+    ensure_workers()
+    delivered = finished = 0
     try:
-        for fut in as_completed(futs):
-            yield from fut.result().items()
-        tp.shutdown(wait=True)
-    except BaseException:
+        while delivered < total:
+            kind, val = out_q.get()
+            if kind == "err":
+                raise val
+            if kind == "done":
+                finished += 1
+                if finished == state["spawned"] and delivered < total:
+                    # every worker flushed its results before its done
+                    # token (FIFO per producer) and the LAST live worker
+                    # only exits with no chunks left, so this is a
+                    # genuine shortfall, not a race — unless the width
+                    # simply needs re-spawning after a retire wave
+                    ensure_workers()
+                    if finished == state["spawned"]:
+                        raise RuntimeError(
+                            f"shard fetch workers exited with only "
+                            f"{delivered}/{total} shards delivered")
+                continue
+            for item in val.items():
+                delivered += 1
+                yield item
+            ensure_workers()
+    finally:
         # early exit (consumer broke out / pipeline torn down / a chunk
         # raised): nobody will consume the remaining chunks, so do NOT
-        # sit out their full retry budgets — drop queued work and leave
-        # in-flight chunks to finish on their own threads
-        tp.shutdown(wait=False, cancel_futures=True)
-        raise
+        # sit out their full retry budgets — unstarted chunks are never
+        # carved, and in-flight chunks finish on their own daemon
+        # threads without a join
+        stop.set()
 
 
 def assign_shards(counts: Sequence[int]) -> List[List[int]]:
@@ -699,7 +1395,8 @@ def _kv_allgather(client, gen: int, tag: str, pid: int, nprocs: int,
 
 
 def rebalance_shards(shards, bind_ip: Optional[str] = None,
-                     deadline: float = 120.0, stage_fn=None):
+                     deadline: float = 120.0, stage_fn=None,
+                     config: Optional[ExchangeConfig] = None):
     """Exchange shards so every process holds a balanced, disjoint set.
 
     ``shards``: this process's :class:`LocalXShards` of dict-of-ndarray
@@ -713,7 +1410,14 @@ def rebalance_shards(shards, bind_ip: Optional[str] = None,
     still in flight, so device transfer overlaps the network exchange;
     locally-kept shards are staged inline during final assembly. The
     returned shard ORDER is identical with and without ``stage_fn`` —
-    the deterministic :func:`assign_shards` plan.
+    the deterministic :func:`assign_shards` plan. With ``stage_fn`` the
+    fetch leg also runs under the adaptive readahead controller
+    (``config.readahead == "adaptive"``): concurrency and multi-get
+    chunk size track the measured overlap ratio instead of static env
+    values.
+
+    ``config``: one :class:`ExchangeConfig` for the whole exchange
+    (env knobs parsed once; defaults otherwise).
 
     Failure semantics: every phase is bounded by ``deadline`` seconds,
     and every host *always* reaches the post-fetch status exchange — a
@@ -748,6 +1452,7 @@ def rebalance_shards(shards, bind_ip: Optional[str] = None,
         raise RuntimeError(
             "rebalance_shards needs the JAX coordination service "
             "(jax.distributed.initialize) in multi-process mode")
+    cfg = config or ExchangeConfig()
     ip = bind_ip or _default_ip()
     t0 = time.monotonic()
 
@@ -779,7 +1484,7 @@ def rebalance_shards(shards, bind_ip: Optional[str] = None,
             mine, error = [], None
             try:
                 mine = _fetch_plan(plan[pid], pid, offsets, addrs, parts,
-                                   remaining, stage_fn)
+                                   remaining, stage_fn, cfg)
             except Exception as e:  # noqa: BLE001 — reported to every host
                 error = e
                 logger.error("shard fetch phase failed on host %d: %r",
@@ -810,13 +1515,15 @@ def rebalance_shards(shards, bind_ip: Optional[str] = None,
 
 
 def _fetch_plan(my_plan: Sequence[int], pid: int, offsets, addrs,
-                parts, remaining, stage_fn) -> List:
+                parts, remaining, stage_fn,
+                cfg: Optional[ExchangeConfig] = None) -> List:
     """Materialize this host's planned shard list: local shards by
     reference, remote ones via concurrent pipelined multi-gets (grouped
     per source peer), optionally streamed through the ingest pipeline
     so device placement overlaps the network fetch."""
     import itertools
 
+    cfg = cfg or ExchangeConfig()
     local_gids: List[int] = []
     by_src: Dict[int, List[int]] = {}
     for gid in my_plan:
@@ -832,15 +1539,19 @@ def _fetch_plan(my_plan: Sequence[int], pid: int, offsets, addrs,
     # rebalance deadline (remaining() raises once it is spent, so
     # pending chunks fail fast and every host reaches the status
     # barrier together)
-    stream = iter_fetch(source_list,
-                        timeout=lambda: min(remaining(), 60.0))
+    chunk_timeout = lambda: min(remaining(), 60.0)  # noqa: E731
     if stage_fn is None:
-        for gid, shard in stream:
+        for gid, shard in iter_fetch(source_list, timeout=chunk_timeout,
+                                     config=cfg):
             staged[gid] = shard
         local_set = set(local_gids)
         return [parts[gid - offsets[pid]] if gid in local_set
                 else staged[gid] for gid in my_plan]
-    from zoo_tpu.orca.data.ingest import staged_pipeline
+    from zoo_tpu.orca.data.ingest import (
+        PipelineStats,
+        ReadaheadController,
+        staged_pipeline,
+    )
     # ONE stream for local and remote shards: locals lead (available
     # immediately, so their device placement starts before the first
     # fetch completes — on the locality-first plan most shards are
@@ -848,11 +1559,19 @@ def _fetch_plan(my_plan: Sequence[int], pid: int, offsets, addrs,
     # whole fetch window), then fetched shards as they arrive. The
     # pipeline's producer thread drains the stream while its stage
     # thread runs stage_fn (device_put): transfer of shard k overlaps
-    # the fetch of shard k+1.
+    # the fetch of shard k+1. The readahead controller closes the loop:
+    # it reads the pipeline's overlap stats after each chunk and walks
+    # concurrency/chunk size toward "fetch fully hidden".
+    stats = PipelineStats()
+    controller = (ReadaheadController(cfg, stats)
+                  if cfg.readahead == "adaptive" else None)
+    stream = iter_fetch(source_list, timeout=chunk_timeout, config=cfg,
+                        controller=controller)
     locals_iter = ((gid, parts[gid - offsets[pid]]) for gid in local_gids)
     with staged_pipeline(
             itertools.chain(locals_iter, stream),
-            [("ingest", lambda kv: (kv[0], stage_fn(kv[1])))]) as pipe:
+            [("ingest", lambda kv: (kv[0], stage_fn(kv[1])))],
+            stats=stats) as pipe:
         for gid, shard in pipe:
             staged[gid] = shard
     return [staged[gid] for gid in my_plan]

@@ -1,0 +1,245 @@
+"""Same-host shared-memory payload lane for the shard exchange.
+
+BENCH_r05 measured NCF at 5.07M samples/s on-device but 1.91M with
+transport — and on a multi-process single-host cluster (the dominant
+TPU-VM topology: one JAX process per chip, all on one VM) every one of
+those payload bytes crossed loopback TCP: two kernel copies and a
+syscall per send/recv for data that already lives in the same DRAM.
+This module is the fix: when :mod:`~zoo_tpu.orca.data.plane` detects
+(empirically, see below) that a peer shares its host, payload bytes move
+through a file in ``/dev/shm`` (tmpfs) instead of the socket. The TCP
+connection stays — it carries the ZSX2 control frames (headers, shapes,
+offsets) whose bytes are tiny — but the payload path becomes: server
+writes the array's buffer into the mapped segment, client decodes with
+``np.frombuffer`` **directly over its own mapping** of the same pages.
+Zero copies, no kernel socket path.
+
+Lifecycle — built so a SIGKILL'd peer cannot leak segments:
+
+* one segment per multi-get response chunk, created by the server,
+  named ``zoo_shm_p<pid>_<seq>_<token>`` (the pid is load-bearing: it
+  is how the stale sweep decides ownership);
+* the client **unlinks the file immediately after mapping it** — on
+  Linux the mapping survives the unlink, and numpy's base-chain
+  refcount (array → memoryview → mmap) frees the pages when the last
+  decoded array dies.  From that instant nothing can leak, whichever
+  side is killed;
+* the server holds (fd, name) only until the client's ack frame (or
+  the connection drops), then closes and best-effort unlinks (ENOENT
+  expected — the client usually got there first);
+* :func:`gc_stale_segments` sweeps segments whose creating pid is dead
+  — the only leak window left is a server SIGKILL'd *between* creating
+  a segment and the client mapping it, and every
+  :class:`~zoo_tpu.orca.data.plane.ShardExchange` start runs the sweep.
+
+Retention caveat: the segment is mapped ONCE per response chunk, so
+every array decoded from that chunk shares the one mapping — retaining
+any single array (even a small label column) keeps the whole chunk's
+pages resident until it dies. Consumers that keep a small slice of a
+chunk long-term should ``np.array(...)`` it out; the staged ingest path
+(``device_put`` copies to HBM, host arrays dropped) never hits this.
+
+Same-host detection is a direct experiment, not an IP heuristic: at
+negotiation the server drops a random token into a probe file under the
+shm dir and the client tries to read it back. Readable-and-matching
+*is* "same host" (two hosts cannot share tmpfs); anything else — ENOENT
+on the real other-host case, a permission error, a mismatch — falls
+back to the TCP payload path.
+"""
+
+from __future__ import annotations
+
+import logging
+import mmap
+import os
+import re
+import tempfile
+import threading
+from typing import Optional
+
+__all__ = ["shm_dir", "SegmentWriter", "SegmentReader", "write_probe",
+           "check_probe", "gc_stale_segments", "SEGMENT_PREFIX"]
+
+logger = logging.getLogger(__name__)
+
+SEGMENT_PREFIX = "zoo_shm_"
+_NAME_RE = re.compile(r"^zoo_shm_p(\d+)_")
+
+_seq_lock = threading.Lock()
+_seq = 0
+
+
+def shm_dir() -> str:
+    """Directory backing the lane: ``ZOO_SHARD_SHM_DIR`` > ``/dev/shm``
+    (tmpfs — the real shared-memory path) > the tempdir (still mmap'd
+    and kernel-socket-free, just disk-backed if dirty pages flush)."""
+    d = os.environ.get("ZOO_SHARD_SHM_DIR")
+    if d:
+        return d
+    return "/dev/shm" if os.path.isdir("/dev/shm") else tempfile.gettempdir()
+
+
+def _token() -> str:
+    return os.urandom(8).hex()
+
+
+def _next_name() -> str:
+    global _seq
+    with _seq_lock:
+        _seq += 1
+        n = _seq
+    return f"{SEGMENT_PREFIX}p{os.getpid()}_{n}_{_token()}"
+
+
+class SegmentWriter:
+    """Server side of one response chunk: a preallocated tmpfs file the
+    payloads are appended into. Pages are reserved UP FRONT
+    (``posix_fallocate``) rather than lazily on write: a full tmpfs
+    must fail HERE, at construction — where the caller can still fall
+    back to inline TCP payloads for the whole chunk — not as a
+    mid-frame ``ENOSPC`` that tears the connection after the segment
+    announce is already on the wire. The reservation is transient (the
+    client unlinks at map time) and bounded by the chunk's raw bytes —
+    the same pages an uncompressed chunk writes anyway."""
+
+    def __init__(self, directory: str, nbytes: int):
+        self.name = _next_name()
+        self.path = os.path.join(directory, self.name)
+        self.size = int(nbytes)
+        self._fd = os.open(self.path, os.O_CREAT | os.O_EXCL | os.O_RDWR,
+                           0o600)
+        try:
+            if hasattr(os, "posix_fallocate"):
+                os.posix_fallocate(self._fd, 0, self.size)
+            else:  # pragma: no cover - non-POSIX fallback
+                os.ftruncate(self._fd, self.size)
+        except OSError:
+            self.discard()
+            raise
+        self._off = 0
+
+    def write(self, payload) -> int:
+        """Append one payload; returns its offset within the segment."""
+        view = memoryview(payload)
+        off = self._off
+        if off + view.nbytes > self.size:
+            raise ValueError(
+                f"segment {self.name} overflow: {off}+{view.nbytes} > "
+                f"{self.size} (encoder produced more than the raw upper "
+                "bound — codec bug)")
+        written = 0
+        while written < view.nbytes:
+            written += os.pwrite(self._fd, view[written:], off + written)
+        self._off = off + view.nbytes
+        return off
+
+    def discard(self):
+        """Close and best-effort unlink (the client normally unlinked
+        already — ENOENT is the expected case)."""
+        if self._fd is not None:
+            try:
+                os.close(self._fd)
+            except OSError:
+                pass
+            self._fd = None
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+class SegmentReader:
+    """Client side: map the announced segment, then immediately unlink
+    it — the mapping (and therefore every array decoded from it) stays
+    valid, and from this point no crash on either side can leak the
+    file. Decoded arrays keep the mapping alive through their numpy
+    base chain; nothing here is ever explicitly closed."""
+
+    def __init__(self, directory: str, name: str, size: int):
+        if "/" in name or not name.startswith(SEGMENT_PREFIX):
+            # the name rode the wire: never let it traverse out of the
+            # negotiated shm dir
+            raise ValueError(f"illegal shm segment name {name!r}")
+        path = os.path.join(directory, name)
+        fd = os.open(path, os.O_RDWR)
+        try:
+            self._map = mmap.mmap(fd, size) if size else None
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        finally:
+            os.close(fd)
+        self._view = memoryview(self._map) if self._map is not None else \
+            memoryview(b"")
+        self.size = size
+
+    def view(self, off: int, nbytes: int) -> memoryview:
+        if off + nbytes > self.size:
+            raise ValueError(
+                f"shm payload [{off}:{off + nbytes}] exceeds segment "
+                f"size {self.size} — desynchronized stream")
+        return self._view[off:off + nbytes]
+
+
+def write_probe(directory: str) -> tuple:
+    """Server: drop a token into a probe file; returns (basename,
+    token, path). The client proving it can read the token back IS the
+    same-host test."""
+    token = _token()
+    name = f"{SEGMENT_PREFIX}p{os.getpid()}_probe_{token}"
+    path = os.path.join(directory, name)
+    fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o600)
+    try:
+        os.write(fd, token.encode("ascii"))
+    finally:
+        os.close(fd)
+    return name, token, path
+
+
+def check_probe(directory: str, name: str, token: str) -> bool:
+    """Client: same host iff the server's probe file is readable here
+    and carries the server's token."""
+    if "/" in name or not name.startswith(SEGMENT_PREFIX):
+        return False
+    try:
+        with open(os.path.join(directory, name), "rb") as f:
+            return f.read(64).decode("ascii", "replace") == token
+    except OSError:
+        return False
+
+
+def gc_stale_segments(directory: Optional[str] = None) -> int:
+    """Unlink segments (and probes) whose creating pid no longer runs —
+    the cleanup of record for a server SIGKILL'd between creating a
+    segment and its client mapping it. Run by every ShardExchange
+    start and by the chaos suite. Returns the number removed."""
+    directory = directory or shm_dir()
+    removed = 0
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return 0
+    for name in names:
+        m = _NAME_RE.match(name)
+        if not m:
+            continue
+        pid = int(m.group(1))
+        if pid == os.getpid():
+            continue
+        try:
+            os.kill(pid, 0)  # signal 0: existence test only
+            continue  # owner still alive — not ours to reap
+        except ProcessLookupError:
+            pass
+        except PermissionError:
+            continue  # alive, different uid
+        try:
+            os.unlink(os.path.join(directory, name))
+            removed += 1
+        except OSError:
+            pass
+    if removed:
+        logger.info("shm lane: reaped %d stale segment(s) from dead "
+                    "peers in %s", removed, directory)
+    return removed

@@ -1,0 +1,224 @@
+"""Optional per-array wire narrowing + compression for the shard
+exchange.
+
+The ZSX2 codec ships raw tensor bytes; this module is the negotiated
+layer on top that can make those bytes *fewer*:
+
+* **dtype narrowing** — f32 payloads travel as bf16 (half the bytes,
+  ~2^-8 relative error) or as int8 with a per-array absmax scale
+  (quarter the bytes, absmax/254 absolute error), widened back to f32
+  on the receiving side. Narrowing is LOSSY and therefore **opt-in
+  only**: the default policy ships bit-identical bytes, and the
+  cross-lane smoke (`scripts/check_data_plane.py`) asserts exactly
+  that.
+* **compression** — zlib (always available) or lz4 (when importable)
+  framing for low-entropy arrays, applied per array *after* narrowing
+  and kept only when it actually shrinks the payload (the flag byte
+  says which, so an incompressible array costs nothing but the
+  attempt).
+
+Both features are negotiated per connection (``ZSXN`` hello — see
+``plane.py``): the *fetching* side proposes what it wants on its wire
+(``ZOO_SHARD_WIRE_DTYPE`` / ``ZOO_SHARD_WIRE_COMPRESS``), the serving
+side answers with what it will actually do, and a legacy ZSX2-only
+peer that understands neither simply gets the plain protocol.
+
+Nothing here is executable from the wire: decode is ``zlib.decompress``
+/ ``lz4.frame.decompress`` plus ``np.frombuffer`` with a parsed dtype,
+and every length is validated against the header before allocation.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+try:  # optional — never a hard dependency (container may lack it)
+    import lz4.frame as _lz4
+except ImportError:  # pragma: no cover - environment-dependent
+    _lz4 = None
+
+__all__ = ["WirePolicy", "encode_array", "decode_payload",
+           "supported_codecs", "supported_wire_dtypes",
+           "FLAG_NARROWED", "FLAG_COMPRESSED", "FLAG_SHM",
+           "WIRE_DTYPES"]
+
+FLAG_NARROWED = 0x01
+FLAG_COMPRESSED = 0x02
+FLAG_SHM = 0x04  # payload field is a segment offset, not inline bytes
+
+WIRE_DTYPES = ("off", "bf16", "int8")
+
+# compress only when it pays: tiny arrays cost more in per-call
+# overhead than their bytes, and the attempt itself is not free
+_MIN_COMPRESS_BYTES = 1 << 10
+
+
+def supported_codecs() -> List[str]:
+    return (["lz4"] if _lz4 is not None else []) + ["zlib"]
+
+
+def supported_wire_dtypes() -> List[str]:
+    """Narrowings this process can actually encode/decode — bf16 needs
+    ml_dtypes (jax ships it, but a jax-free serving process may not).
+    Granting a narrowing the codec would ImportError on mid-response
+    kills the stream after frames are on the wire; filtering here makes
+    it negotiate down instead, exactly like compression."""
+    out = ["off", "int8"]
+    try:
+        import ml_dtypes  # noqa: F401
+        out.insert(1, "bf16")
+    except ImportError:  # pragma: no cover - environment-dependent
+        pass
+    return out
+
+
+class WirePolicy:
+    """One connection's negotiated wire treatment."""
+
+    __slots__ = ("dtype", "compress")
+
+    def __init__(self, dtype: str = "off", compress: str = "off"):
+        if dtype not in WIRE_DTYPES:
+            raise ValueError(
+                f"ZOO_SHARD_WIRE_DTYPE={dtype!r}: pick one of "
+                f"{WIRE_DTYPES} (narrowing is lossy and therefore "
+                "never a default)")
+        if compress not in ("off", "zlib", "lz4"):
+            raise ValueError(
+                f"ZOO_SHARD_WIRE_COMPRESS={compress!r}: off, zlib or lz4")
+        self.dtype = dtype
+        self.compress = compress
+
+    @property
+    def active(self) -> bool:
+        return self.dtype != "off" or self.compress != "off"
+
+    def __repr__(self):
+        return f"WirePolicy(dtype={self.dtype!r}, compress={self.compress!r})"
+
+
+def _bf16():
+    import ml_dtypes
+    return np.dtype(ml_dtypes.bfloat16)
+
+
+def payload_view(arr: np.ndarray) -> memoryview:
+    """The array's raw bytes WITHOUT a serialize copy (contiguous
+    arrays; a non-contiguous shard pays one compaction copy)."""
+    a = np.ascontiguousarray(arr)
+    if a.nbytes == 0:
+        return memoryview(b"")
+    try:
+        return memoryview(a).cast("B")
+    except (ValueError, TypeError):
+        # extension dtypes (bfloat16) refuse the buffer protocol; a
+        # uint8 view of the same memory does not copy
+        return memoryview(a.reshape(-1).view(np.uint8))
+
+
+def encode_array(arr: np.ndarray, policy: Optional[WirePolicy]
+                 ) -> Tuple[int, Optional[bytes], float, object]:
+    """Apply the policy to one array.
+
+    Returns ``(flags, wire_dtype_descr, scale, payload)`` where
+    ``payload`` is a buffer (memoryview for the untouched zero-copy
+    case, bytes when narrowed/compressed). Narrowing applies to f32
+    arrays only — everything else passes through un-narrowed, so a
+    mixed shard (int labels + float features) narrows exactly the part
+    that tolerates it.
+    """
+    flags = 0
+    wire_descr: Optional[bytes] = None
+    scale = 0.0
+    payload: object = None
+    if policy is not None and policy.dtype != "off" \
+            and arr.dtype == np.float32 and arr.size:
+        if policy.dtype == "bf16":
+            narrowed = np.ascontiguousarray(arr).astype(_bf16())
+            wire_descr = b"bfloat16"
+        else:  # int8 with per-array absmax scale
+            absmax = float(np.max(np.abs(arr)))
+            scale = (absmax / 127.0) if absmax > 0 else 1.0
+            narrowed = np.clip(np.rint(arr / scale), -127, 127
+                               ).astype(np.int8)
+            wire_descr = b"|i1"
+        flags |= FLAG_NARROWED
+        # reshape(-1).view covers 0-d and extension dtypes alike (a
+        # memoryview cast would refuse both)
+        payload = memoryview(narrowed.reshape(-1).view(np.uint8))
+    else:
+        payload = payload_view(arr)
+    if policy is not None and policy.compress != "off":
+        view = memoryview(payload)
+        if view.nbytes >= _MIN_COMPRESS_BYTES:
+            # compressors take the buffer directly — a bytes() copy
+            # here would double transient memory on the hot send path
+            if policy.compress == "lz4" and _lz4 is not None:
+                packed = _lz4.compress(view)
+            else:
+                packed = zlib.compress(view, 1)
+            if len(packed) < view.nbytes:  # keep only a real win
+                flags |= FLAG_COMPRESSED
+                payload = packed
+    return flags, wire_descr, scale, payload
+
+
+def _inflated_nbytes(flags: int, dtype, shape,
+                     wire_descr: Optional[str]) -> int:
+    """Exact decompressed size the header promises — the allocation
+    bound for the inflate step."""
+    count = 1
+    for s in shape:
+        count *= int(s)
+    if flags & FLAG_NARROWED:
+        return count * (2 if wire_descr == "bfloat16" else 1)
+    return count * np.dtype(dtype).itemsize
+
+
+def decode_payload(buf, flags: int, dtype: np.dtype, shape,
+                   wire_descr: Optional[str], scale: float,
+                   compress: str) -> np.ndarray:
+    """Invert :func:`encode_array`: bytes off the wire (or out of the
+    mapped segment) back to the logical array. The untouched path is
+    ``np.frombuffer`` over ``buf`` — zero copy; narrowing/compression
+    inherently allocate (they must widen/inflate). Inflation is BOUNDED
+    by the size the header promises — a corrupt or hostile stream must
+    not turn a tiny compressed payload into an arbitrary allocation."""
+    if flags & FLAG_COMPRESSED:
+        bound = _inflated_nbytes(flags, dtype, shape, wire_descr)
+        data = bytes(buf)
+        if compress == "lz4":
+            if _lz4 is None:
+                raise RuntimeError(
+                    "peer sent lz4-compressed payload but lz4 is not "
+                    "importable here — negotiation bug")
+            d = _lz4.LZ4FrameDecompressor()
+            out = d.decompress(data, max_length=bound + 1)
+        else:
+            # bound+1, not bound: at exactly max_length the stream
+            # trailer can sit unconsumed, which is indistinguishable
+            # from a real overrun — one spare byte disambiguates
+            out = zlib.decompressobj().decompress(data, bound + 1)
+        if len(out) != bound:
+            raise ValueError(
+                f"compressed payload inflates to "
+                f"{'>' if len(out) > bound else ''}{len(out)} bytes "
+                f"but the header promises {bound} — corrupt or "
+                "desynchronized stream")
+        buf = out
+    if flags & FLAG_NARROWED:
+        # astype/multiply allocate the widened array, so frombuffer can
+        # read straight off the (possibly read-only) wire buffer
+        if wire_descr == "bfloat16":
+            narrow = np.frombuffer(buf, dtype=_bf16())
+            out = narrow.astype(np.float32)
+        elif wire_descr in ("|i1", "int8"):
+            narrow = np.frombuffer(buf, dtype=np.int8)
+            out = narrow.astype(np.float32) * np.float32(scale)
+        else:
+            raise ValueError(f"unknown wire dtype {wire_descr!r}")
+        return out.reshape(shape)
+    return np.frombuffer(buf, dtype=dtype).reshape(shape)

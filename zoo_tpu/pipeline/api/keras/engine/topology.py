@@ -731,6 +731,22 @@ class KerasNet:
         elif self._jit_train is None:
             self._jit_train = self._own_jit_train = \
                 self._build_train_step()
+        # host-fed path: stage superbatch slices into rotating
+        # preallocated buffers (double-buffered device_put — the DMA of
+        # superbatch k reads buffer A while k+1 is sliced into buffer
+        # B). maybe_create allocates the buffers off XLA's zero-copy
+        # alignment and probes each one, falling back to plain
+        # allocation if device_put would alias it; multi-host keeps the
+        # global-assembly path, and a multi-device CPU mesh is excluded
+        # (its per-device placement semantics are not covered by the
+        # probe).
+        staging_pool = None
+        if not device_resident and pc == 1 and (
+                mesh is None or getattr(mesh, "size", 1) == 1
+                or jax.default_backend() != "cpu"):
+            from zoo_tpu.orca.data.ingest import StagingBufferPool
+            staging_pool = StagingBufferPool.maybe_create(
+                arrs, rows=group * local_bs)
         for epoch in range(nb_epoch):
             t0 = time.perf_counter()  # monotonic: NTP-step-proof Throughput
             loss_sum, n_steps = None, 0
@@ -789,9 +805,22 @@ class KerasNet:
                     # stages, each on its own staging thread — the step
                     # on superbatch k overlaps the host→device transfer
                     # of k+1 AND the host slicing of k+2 (the async
-                    # ingest pipeline; see orca/data/ingest.py)
+                    # ingest pipeline; see orca/data/ingest.py).
+                    # reset() reclaims buffers a prior epoch's teardown
+                    # (error, guard rollback) stranded in flight; its
+                    # generation token fences off that epoch's stage
+                    # threads, which may still be running (the pipeline
+                    # close() does not join) and must not touch THIS
+                    # epoch's slots
+                    pool_gen = (staging_pool.reset()
+                                if staging_pool is not None else None)
+
                     def _slice(idx):
-                        sliced = [a[idx] for a in arrs]
+                        if staging_pool is not None:
+                            sliced = staging_pool.take(arrs, idx,
+                                                       gen=pool_gen)
+                        else:
+                            sliced = [a[idx] for a in arrs]
                         if guard is not None:
                             # chaos seam: armed tests corrupt the host
                             # batch in place (poison-batch injection);
@@ -812,9 +841,17 @@ class KerasNet:
                         return sliced
 
                     def _put(sliced):
-                        if use_scan:
-                            return self._put_stacked(sliced)
-                        return self._put_batch(sliced)
+                        out = self._put_stacked(sliced) if use_scan \
+                            else self._put_batch(sliced)
+                        if staging_pool is not None:
+                            # the buffer may be reused only after the
+                            # host→device transfer has READ it; blocking
+                            # here costs nothing — this IS the transfer
+                            # stage's thread, and the step consumes
+                            # `out` downstream anyway
+                            jax.block_until_ready(out)
+                            staging_pool.recycle(gen=pool_gen)
+                        return out
 
                     stages = [("slice", _slice), ("device_put", _put)]
 

@@ -73,5 +73,8 @@ def test_custom_loss_in_compile(orca_ctx):
     m = Sequential()
     m.add(Dense(1, input_shape=(4,)))
     m.compile(optimizer=Adam(lr=0.05), loss=loss)
-    hist = m.fit(x, y, batch_size=32, nb_epoch=5, verbose=0)
+    # MAE under Adam descends ~linearly at ~lr per step (sign-like
+    # gradients), ~0.25 loss/epoch here: 5 epochs lands just above the
+    # halving bar; 10 is well past it (measured 0.39 vs bar 1.23)
+    hist = m.fit(x, y, batch_size=32, nb_epoch=10, verbose=0)
     assert hist["loss"][-1] < hist["loss"][0] * 0.5

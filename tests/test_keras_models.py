@@ -64,8 +64,10 @@ def test_functional_two_tower(orca_ctx):
     model = Model(input=[u_in, i_in], output=out)
     model.compile(optimizer="adam", loss="binary_crossentropy",
                   metrics=["accuracy"])
+    # the parity target needs the embeddings to separate before accuracy
+    # moves: 10 epochs sits at chance (0.59 measured), 25 reaches ~0.9+
     hist = model.fit([user.reshape(-1, 1), item.reshape(-1, 1)], y,
-                     batch_size=32, nb_epoch=10, verbose=0)
+                     batch_size=32, nb_epoch=25, verbose=0)
     assert hist["loss"][-1] < hist["loss"][0]
     res = model.evaluate([user.reshape(-1, 1), item.reshape(-1, 1)], y,
                          batch_size=32)

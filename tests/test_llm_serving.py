@@ -1,0 +1,674 @@
+"""LLM serving engine (docs/llm_serving.md): the paged KV block
+allocator, the iteration-level (continuous) scheduler, the
+prefill/decode split's correctness against the full-context Llama
+reference, and the streaming generate op over the real TCP door with
+HA failover-resume.
+
+The allocator and scheduler tests run against pure-python fakes (no
+jax), so most of this file is tier-1 cheap; the paged-model and wire
+tests share ONE tiny compiled model via a module fixture. The 2-replica
+SIGKILL smoke (scripts/check_llm_serving.py) runs as a subprocess under
+the ``chaos`` marker like its serving-HA sibling.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from zoo_tpu.serving.llm.engine import AdmissionError, LLMEngine
+from zoo_tpu.serving.llm.kv_cache import BlockAllocator
+from zoo_tpu.serving.llm.spec import parse_llm_spec
+from zoo_tpu.util.resilience import Deadline
+
+
+# ------------------------------------------------------- block allocator
+
+def test_allocator_alloc_free_reuse():
+    a = BlockAllocator(num_blocks=8, block_size=4)
+    assert a.free_blocks == 7  # block 0 is the reserved trash block
+    got = a.allocate("s1", 3)
+    assert len(got) == 3 and 0 not in got
+    assert a.used_blocks == 3 and a.free_blocks == 4
+    assert a.blocks_of("s1") == got
+    assert a.free("s1") == 3
+    assert a.used_blocks == 0 and a.free_blocks == 7
+    # LIFO: the just-freed blocks come back first (warm reuse), in the
+    # same order the sequence held them
+    again = a.allocate("s2", 3)
+    assert again == got
+
+
+def test_allocator_never_hands_out_block_zero():
+    a = BlockAllocator(num_blocks=6, block_size=2)
+    got = a.allocate("s", 5)
+    assert sorted(got) == [1, 2, 3, 4, 5]
+    assert a.allocate("s2", 1) is None   # block 0 is never handed out
+
+
+def test_allocator_all_or_nothing():
+    a = BlockAllocator(num_blocks=5, block_size=4)  # 4 usable
+    assert a.allocate("s1", 3) is not None
+    # asking for more than the free list holds changes NOTHING
+    assert a.allocate("s2", 2) is None
+    assert a.used_blocks == 3 and a.free_blocks == 1
+    assert a.blocks_of("s2") == []
+
+
+def test_allocator_block_table_growth():
+    a = BlockAllocator(num_blocks=10, block_size=2)
+    first = a.allocate("s", a.blocks_for_tokens(3))   # 3 tokens -> 2
+    assert len(first) == 2
+    # crossing each block boundary appends to the SAME table, order
+    # preserved (the block table is positional: row i covers tokens
+    # [i*bs, (i+1)*bs) )
+    for _ in range(3):
+        assert a.allocate("s", 1) is not None
+    table = a.blocks_of("s")
+    assert len(table) == 5 and table[:2] == first
+
+
+def test_allocator_admission_refusal_when_empty():
+    a = BlockAllocator(num_blocks=4, block_size=4)  # 3 usable
+    assert a.can_admit(prompt_len=7)   # 2 blocks for 7+1 tokens
+    assert a.allocate("hog", 3) is not None
+    assert not a.can_admit(prompt_len=1)
+    assert a.allocate("late", 1) is None
+    a.free("hog")
+    assert a.can_admit(prompt_len=7)
+
+
+def test_allocator_free_is_idempotent():
+    a = BlockAllocator(num_blocks=6, block_size=2)
+    a.allocate("s", 2)
+    assert a.free("s") == 2
+    assert a.free("s") == 0          # abort paths may race: no double free
+    assert a.free("never-seen") == 0
+    assert a.free_blocks == 5
+
+
+def test_allocator_blocks_for_tokens_math():
+    a = BlockAllocator(num_blocks=4, block_size=8)
+    assert a.blocks_for_tokens(1) == 1
+    assert a.blocks_for_tokens(8) == 1
+    assert a.blocks_for_tokens(9) == 2
+    assert a.blocks_for_tokens(0) == 1  # a sequence always owns a block
+
+
+def test_allocator_publishes_gauges():
+    from zoo_tpu.obs.metrics import gauge
+    used = gauge("zoo_llm_kv_blocks_used")
+    free = gauge("zoo_llm_kv_blocks_free")
+    a = BlockAllocator(num_blocks=9, block_size=4)
+    assert free.value == 8.0 and used.value == 0.0
+    a.allocate("s", 5)
+    assert used.value == 5.0 and free.value == 3.0
+    a.free("s")
+    assert used.value == 0.0 and free.value == 8.0
+
+
+# ------------------------------------------------ scheduler (fake model)
+
+class _FakeModel:
+    """Deterministic greedy 'llm' with the PagedLlamaModel surface but
+    no jax: the next token is a pure function of (last token, position)
+    — ``(2*tok + pos) % 97`` — which makes preemption's
+    re-prefill-from-prompt+generated provably seamless, exactly the
+    property the real model gets from greedy decode."""
+
+    def __init__(self, num_slots=2, block_size=4, num_blocks=8,
+                 max_blocks_per_seq=4, max_prompt_len=12,
+                 decode_delay=0.0, eos_id=None):
+        self.num_slots = num_slots
+        self.block_size = block_size
+        self.num_blocks = num_blocks
+        self.max_blocks_per_seq = max_blocks_per_seq
+        self.max_prompt_len = max_prompt_len
+        self.decode_delay = decode_delay
+        self.eos_id = eos_id
+        self.prefills = []
+
+    @staticmethod
+    def _next(tok, pos):
+        return (2 * int(tok) + int(pos)) % 97
+
+    def prefill(self, prompt, block_table_row):
+        self.prefills.append(len(prompt))
+        return self._next(prompt[-1], len(prompt))
+
+    def decode(self, tokens, block_tables, positions):
+        if self.decode_delay:
+            time.sleep(self.decode_delay)
+        # ``positions[i]`` is the cache index the incoming token is
+        # WRITTEN at, so the sequence is ``position + 1`` tokens long
+        # once it lands — the same length prefill sees for the same
+        # sequence, which is what makes preemption's re-prefill seamless
+        return np.array([self._next(t, p + 1)
+                         for t, p in zip(tokens, positions)], np.int32)
+
+
+def _reference(prompt, n):
+    """What any correct schedule must emit for ``prompt``."""
+    seq = list(prompt)
+    out = []
+    for _ in range(n):
+        out.append(_FakeModel._next(seq[-1], len(seq)))
+        seq.append(out[-1])
+    return out
+
+
+def _drain(handles, budget=20.0):
+    deadline = time.monotonic() + budget
+    while not all(h.done for h in handles):
+        if time.monotonic() > deadline:
+            raise AssertionError(
+                f"streams stuck: {[h.outcome for h in handles]}")
+        time.sleep(0.005)
+
+
+def test_engine_continuous_more_streams_than_slots():
+    eng = LLMEngine(_FakeModel(num_slots=2, num_blocks=32,
+                               max_blocks_per_seq=8)).start()
+    try:
+        prompts = [[3, 5], [7], [1, 2, 3], [9, 9], [4], [8, 1]]
+        hs = [eng.submit(p, 5) for p in prompts]
+        _drain(hs)
+        for p, h in zip(prompts, hs):
+            assert h.outcome == "ok"
+            assert h.tokens == _reference(p, 5)
+        assert eng.allocator.used_blocks == 0
+        assert eng.allocator.live_sequences() == 0
+    finally:
+        eng.stop()
+
+
+def test_engine_continuous_admits_into_freed_slots_midflight():
+    """The Orca property itself: with 1 slot and bimodal lengths, a
+    short stream admitted behind a long one starts as soon as ANY slot
+    frees — i.e. the long stream is still running when the short one
+    finishes (request-level batching would serialize whole waves)."""
+    eng = LLMEngine(_FakeModel(num_slots=2, num_blocks=64,
+                               max_blocks_per_seq=8,
+                               decode_delay=0.002)).start()
+    try:
+        long_h = eng.submit([1], 25)
+        short = [eng.submit([2 + i], 2) for i in range(3)]
+        _drain(short)
+        assert not long_h.done, \
+            "short streams should finish while the long one decodes"
+        _drain([long_h])
+        assert long_h.tokens == _reference([1], 25)
+    finally:
+        eng.stop()
+
+
+def test_engine_oneshot_waits_for_batch_to_drain():
+    """The request-level baseline the bench compares against: a wave is
+    admitted only on an EMPTY batch, so a late request waits for every
+    member of the running wave."""
+    eng = LLMEngine(_FakeModel(num_slots=2, num_blocks=64,
+                               max_blocks_per_seq=8), mode="oneshot")
+    # white-box: tick the scheduler by hand for determinism
+    h1 = eng.submit([1], 4)
+    h2 = eng.submit([2], 4)
+    h3 = eng.submit([3], 2)   # wave 2
+    for _ in range(3):
+        eng._sweep(); eng._admit(); eng._grow_or_preempt()
+        eng._decode_tick()
+    assert h1.done and h2.done
+    assert not h3.tokens, "oneshot admitted into a non-empty batch"
+    for _ in range(2):
+        eng._sweep(); eng._admit(); eng._grow_or_preempt()
+        eng._decode_tick()
+    assert h3.done and h3.tokens == _reference([3], 2)
+    eng.stop()
+
+
+def test_engine_deadline_dead_in_queue():
+    eng = LLMEngine(_FakeModel()).start()
+    try:
+        h = eng.submit([1, 2], 4, deadline=Deadline.from_ms(0.0))
+        _drain([h])
+        assert h.outcome == "expired" and h.tokens == []
+        assert eng.allocator.used_blocks == 0
+    finally:
+        eng.stop()
+
+
+def test_engine_deadline_expires_midstream_and_frees_blocks():
+    eng = LLMEngine(_FakeModel(num_blocks=32, max_blocks_per_seq=8,
+                               decode_delay=0.01)).start()
+    try:
+        h = eng.submit([5], 10_000, deadline=Deadline.from_ms(120.0))
+        _drain([h], budget=10.0)
+        assert h.outcome == "expired"
+        assert 0 < len(h.tokens) < 10_000
+        assert h.tokens == _reference([5], len(h.tokens))
+        deadline = time.monotonic() + 5
+        while eng.allocator.used_blocks and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert eng.allocator.used_blocks == 0, "expiry leaked KV blocks"
+    finally:
+        eng.stop()
+
+
+def test_engine_cancel_frees_blocks():
+    eng = LLMEngine(_FakeModel(num_blocks=32, max_blocks_per_seq=8,
+                               decode_delay=0.01)).start()
+    try:
+        h = eng.submit([5, 6], 10_000)
+        while not h.tokens:
+            time.sleep(0.005)
+        assert eng.cancel(h.id)
+        _drain([h])
+        assert h.outcome == "cancelled"
+        deadline = time.monotonic() + 5
+        while eng.allocator.used_blocks and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert eng.allocator.used_blocks == 0, "abort leaked KV blocks"
+        assert not eng.cancel(h.id)   # already finished: no-op
+    finally:
+        eng.stop()
+
+
+def test_engine_admission_sheds_when_waiting_queue_full():
+    eng = LLMEngine(_FakeModel(num_slots=1, decode_delay=0.01),
+                    max_waiting=2).start()
+    try:
+        running = eng.submit([1], 1000)
+        while not running.tokens:
+            time.sleep(0.005)
+        eng.submit([2], 4)
+        eng.submit([3], 4)
+        with pytest.raises(AdmissionError) as ei:
+            eng.submit([4], 4)
+        assert ei.value.retry_after_ms > 0
+    finally:
+        eng.stop()
+
+
+def test_engine_duplicate_rid_joins_stream():
+    eng = LLMEngine(_FakeModel()).start()
+    try:
+        h1 = eng.submit([3, 4], 4, rid="r-1")
+        h2 = eng.submit([9, 9, 9], 999, rid="r-1")  # args ignored: join
+        assert h2 is h1
+        _drain([h1])
+        assert h1.tokens == _reference([3, 4], 4)
+    finally:
+        eng.stop()
+
+
+def test_engine_prompt_too_long_and_empty_rejected():
+    eng = LLMEngine(_FakeModel(max_prompt_len=8))
+    with pytest.raises(ValueError):
+        eng.submit(list(range(9)), 4)
+    with pytest.raises(ValueError):
+        eng.submit([], 4)
+    with pytest.raises(ValueError):
+        eng.submit([1], 0)
+    eng.stop()
+
+
+def test_engine_preempts_youngest_and_resumes_exactly():
+    """KV pressure: two long streams on a pool that cannot hold both to
+    completion. The youngest-admitted one is evicted (blocks freed,
+    re-queued) and later RE-PREFILLED from prompt+generated; because
+    decode is deterministic its final token stream is byte-identical to
+    an uncontended run."""
+    # 6 usable blocks, bs=2: each stream needs 1 block per 2 tokens;
+    # two 12-token streams want 2x6 > 6 -> somebody must be preempted.
+    # White-box manual ticks (engine not started): both streams are
+    # admitted in the SAME tick, so concurrent growth — and therefore
+    # the preemption — is deterministic, not a thread-timing accident.
+    model = _FakeModel(num_slots=2, block_size=2, num_blocks=7,
+                       max_blocks_per_seq=6, max_prompt_len=8)
+    eng = LLMEngine(model)
+    from zoo_tpu.obs.metrics import counter
+    preempts0 = counter("zoo_llm_preempt_total").value
+    a = eng.submit([1, 2], 9)
+    b = eng.submit([3, 4], 9)
+    for _ in range(60):
+        eng._sweep(); eng._admit(); eng._grow_or_preempt()
+        eng._decode_tick()
+        if a.done and b.done:
+            break
+    assert a.outcome == "ok" and b.outcome == "ok"
+    assert a.tokens == _reference([1, 2], 9)
+    assert b.tokens == _reference([3, 4], 9)
+    assert counter("zoo_llm_preempt_total").value > preempts0
+    # the victim was re-prefilled with its context so far
+    assert max(model.prefills) > 4
+    assert eng.allocator.used_blocks == 0
+    eng.stop()
+
+
+def test_engine_rejects_prompt_larger_than_whole_pool():
+    """A prompt whose blocks can NEVER be satisfied (bigger than the
+    entire pool) must be rejected at submit — not parked at the head of
+    the waiting queue forever, wedging everything behind it."""
+    model = _FakeModel(num_slots=1, block_size=2, num_blocks=4,
+                       max_blocks_per_seq=16, max_prompt_len=64)
+    eng = LLMEngine(model).start()
+    try:
+        with pytest.raises(ValueError, match="whole pool"):
+            eng.submit(list(range(20)), 4)   # 11 blocks > 3 usable
+        # feasible traffic still flows
+        h = eng.submit([1, 2], 2)
+        _drain([h])
+        assert h.outcome == "ok"
+    finally:
+        eng.stop()
+
+
+def test_engine_sole_stream_out_of_pool_errors():
+    """A stream that cannot grow and has no preemption victim must end
+    loudly (error outcome), not wedge the scheduler."""
+    model = _FakeModel(num_slots=1, block_size=2, num_blocks=3,
+                       max_blocks_per_seq=16, max_prompt_len=3)
+    eng = LLMEngine(model).start()
+    try:
+        h = eng.submit([1], 50)   # needs 25 blocks, pool holds 2
+        _drain([h])
+        assert h.outcome == "error"
+        assert "kv cache exhausted" in h.error
+        assert eng.allocator.used_blocks == 0
+    finally:
+        eng.stop()
+
+
+def test_engine_context_ceiling_truncates_ok():
+    model = _FakeModel(num_slots=1, block_size=2, num_blocks=32,
+                       max_blocks_per_seq=3, max_prompt_len=4)
+    eng = LLMEngine(model).start()
+    try:
+        h = eng.submit([1, 2], 50)   # table caps context at 6 tokens
+        _drain([h])
+        assert h.outcome == "ok" and h.truncated
+        assert len(h.tokens) < 50
+        assert h.tokens == _reference([1, 2], len(h.tokens))
+    finally:
+        eng.stop()
+
+
+def test_engine_eos_stops_stream():
+    ref = _reference([6], 10)
+    eos = ref[3]
+    eng = LLMEngine(_FakeModel(eos_id=eos)).start()
+    try:
+        h = eng.submit([6], 10)
+        _drain([h])
+        assert h.outcome == "ok"
+        assert h.tokens == ref[:4]   # eos token is emitted, then stop
+    finally:
+        eng.stop()
+
+
+def test_engine_stop_frees_everything():
+    eng = LLMEngine(_FakeModel(num_blocks=32, max_blocks_per_seq=8,
+                               decode_delay=0.01)).start()
+    h = eng.submit([1], 10_000)
+    while not h.tokens:
+        time.sleep(0.005)
+    eng.stop()
+    assert h.outcome == "cancelled"
+    assert eng.allocator.used_blocks == 0
+
+
+# ------------------------------------------------------------ spec parse
+
+def test_parse_llm_spec_forms():
+    cfg, eng = parse_llm_spec("llama:tiny")
+    assert cfg["hidden"] == 64 and eng == {}
+    cfg, eng = parse_llm_spec(
+        "llama:tiny:seed=3,slots=4,block=8,blocks=64,buckets=16/64")
+    assert eng == {"seed": 3, "num_slots": 4, "block_size": 8,
+                   "num_blocks": 64, "prefill_buckets": (16, 64)}
+    cfg, _ = parse_llm_spec(
+        "llama:vocab=256,hidden=32,n_block=1,n_head=4,n_kv_head=2,"
+        "intermediate=64")
+    assert cfg["vocab"] == 256 and cfg["n_kv_head"] == 2
+    with pytest.raises(ValueError):
+        parse_llm_spec("llama:gguf")
+    with pytest.raises(ValueError):
+        parse_llm_spec("llama:tiny:slots")
+    with pytest.raises(ValueError):
+        parse_llm_spec("llama:tiny:warp=9")
+
+
+# --------------------------------------------- paged model (jax, shared)
+
+@pytest.fixture(scope="module")
+def paged():
+    """ONE tiny compiled model + its config, shared by every jax test
+    in this file (each test runs its own engine; freed blocks are fully
+    rewritten by the next owner, so sharing the cache is safe)."""
+    from zoo_tpu.models.llm.llama import LlamaConfig
+    from zoo_tpu.serving.llm.model import PagedLlamaModel
+    cfg = LlamaConfig(vocab=64, hidden=32, n_block=2, n_head=4,
+                      n_kv_head=2, intermediate=64, rope_theta=10000.0)
+    model = PagedLlamaModel(cfg, seed=0, num_slots=2, block_size=4,
+                            num_blocks=24, max_blocks_per_seq=6,
+                            prefill_buckets=(8, 16))
+    return cfg, model
+
+
+def test_gqa_cache_layout(paged):
+    """K/V are stored at num_kv_heads (2), NOT num_heads (4) — the GQA
+    memory saving is real, not re-expanded into the cache."""
+    cfg, model = paged
+    import jax.numpy as jnp
+    assert cfg.n_kv_head < cfg.n_head
+    expect = (cfg.n_block, model.num_blocks, model.block_size,
+              cfg.n_kv_head, cfg.head_dim)
+    assert model._kc.shape == expect
+    assert model._vc.shape == expect
+    assert model._kc.dtype == jnp.float32
+
+
+def test_paged_decode_matches_full_context_reference(paged):
+    """The correctness anchor: greedy generation through the paged
+    prefill + block-gathered decode must match token-for-token a greedy
+    loop over the ORIGINAL full-context Llama forward (same params) —
+    across a block boundary and a preemption-free multi-stream mix."""
+    cfg, model = paged
+    import jax.numpy as jnp
+    from zoo_tpu.models.llm.llama import Llama
+
+    layer = Llama(cfg, lm_head=True)
+
+    def ref_generate(prompt, n):
+        seq = list(int(t) for t in prompt)
+        out = []
+        for _ in range(n):
+            logits = layer.call(model.params,
+                                jnp.asarray([seq], jnp.int32))
+            out.append(int(jnp.argmax(logits[0, -1])))
+            seq.append(out[-1])
+        return out
+
+    eng = LLMEngine(model).start()
+    try:
+        rs = np.random.RandomState(7)
+        prompts = [rs.randint(0, cfg.vocab, (n,)) for n in (3, 9, 14)]
+        n_new = 9   # crosses the 4-token block boundary repeatedly
+        hs = [eng.submit(p, n_new) for p in prompts]
+        _drain(hs, budget=300.0)
+        for p, h in zip(prompts, hs):
+            assert h.outcome == "ok"
+            assert h.tokens == ref_generate(p, n_new), \
+                f"paged decode diverged for prompt len {len(p)}"
+        assert eng.allocator.used_blocks == 0
+    finally:
+        eng.stop()
+
+
+def test_decode_compiles_exactly_one_executable(paged):
+    """The fixed-shape contract: after streams of every shape mix, the
+    decode jit cache holds ONE executable and prefill at most one per
+    bucket — request churn must never recompile."""
+    cfg, model = paged
+    eng = LLMEngine(model).start()
+    try:
+        rs = np.random.RandomState(3)
+        hs = [eng.submit(rs.randint(0, cfg.vocab, (n,)), 3)
+              for n in (2, 7, 8, 13)]   # both buckets, varied fill
+        _drain(hs, budget=300.0)
+    finally:
+        eng.stop()
+    counts = model.compile_counts()
+    if counts["decode"] < 0:
+        pytest.skip("jit cache size API unavailable on this jax")
+    assert counts["decode"] == 1, counts
+    assert 0 < counts["prefill"] <= len(model.prefill_buckets), counts
+
+
+# ------------------------------------------------- streaming over the wire
+
+@pytest.fixture(scope="module")
+def llm_server(paged):
+    """The shared model behind a REAL ServingServer TCP door (llm-only
+    replica: no predict model mounted)."""
+    from zoo_tpu.serving.server import ServingServer
+    _, model = paged
+    eng = LLMEngine(model)
+    server = ServingServer(None, llm_engine=eng.start(), port=0,
+                           batch_size=2, max_wait_ms=1.0).start()
+    yield server, eng
+    server.stop()
+
+
+def _stream_tokens(host, port, prompt, n, rid=None, resume_from=0,
+                   deadline=None):
+    from zoo_tpu.serving.tcp_client import _Connection
+    conn = _Connection(host, port)
+    frames, toks = [], []
+    try:
+        for f in conn.stream({"op": "generate", "id": rid,
+                              "prompt": np.asarray(prompt, np.int32),
+                              "max_new_tokens": n,
+                              "resume_from": resume_from},
+                             deadline=deadline):
+            frames.append(f)
+            toks.extend(f.get("tokens") or ())
+    finally:
+        conn.close()
+    return toks, frames
+
+
+def test_generate_streams_over_wire(paged, llm_server):
+    cfg, model = paged
+    server, eng = llm_server
+    prompt = np.arange(1, 6) % cfg.vocab
+    toks, frames = _stream_tokens(server.host, server.port, prompt, 6)
+    assert len(toks) == 6
+    assert frames[-1]["done"] and frames[-1]["outcome"] == "ok"
+    assert frames[-1]["n_tokens"] == 6
+    # a direct engine replay of the same rid would dedup; a fresh id
+    # reproduces the same tokens (deterministic greedy decode)
+    again, _ = _stream_tokens(server.host, server.port, prompt, 6)
+    assert again == toks
+
+
+def test_generate_resume_from_skips_prefix(paged, llm_server):
+    cfg, _ = paged
+    server, _ = llm_server
+    prompt = np.arange(2, 8) % cfg.vocab
+    full, _ = _stream_tokens(server.host, server.port, prompt, 6)
+    suffix, frames = _stream_tokens(server.host, server.port, prompt, 6,
+                                    resume_from=4)
+    assert suffix == full[4:]
+    assert frames[-1]["n_tokens"] == 6   # server-side count is total
+
+
+def test_generate_dead_on_arrival_deadline(paged, llm_server):
+    server, _ = llm_server
+    from zoo_tpu.serving.tcp_client import _Connection
+    conn = _Connection(server.host, server.port)
+    try:
+        frames = list(conn.stream({"op": "generate", "prompt": [1, 2],
+                                   "max_new_tokens": 4,
+                                   "deadline_ms": 0.0}))
+    finally:
+        conn.close()
+    assert frames[-1].get("expired") and frames[-1]["outcome"] == "expired"
+
+
+def test_generate_client_disconnect_frees_blocks(paged, llm_server):
+    """The last subscriber dropping mid-stream cancels the stream and
+    returns its KV blocks — an abandoned client must not pin the pool
+    until max_new_tokens."""
+    from zoo_tpu.serving.tcp_client import _Connection
+    server, eng = llm_server
+    before = eng.allocator.used_blocks
+    conn = _Connection(server.host, server.port)
+    it = conn.stream({"op": "generate", "prompt": [3, 1],
+                      "max_new_tokens": 100_000})
+    first = next(it)
+    assert first.get("tokens") or first.get("done") is False
+    conn.close()   # walk away mid-stream
+    deadline = time.monotonic() + 10
+    while eng.allocator.used_blocks > before and \
+            time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert eng.allocator.used_blocks == before, "disconnect leaked blocks"
+
+
+def test_ha_client_generate_failover_resumes_midstream(paged):
+    """Mid-stream replica loss under HAServingClient.generate: the
+    second replica (bit-identical weights, greedy decode) resumes from
+    ``resume_from`` and the caller sees one gapless, duplicate-free
+    token stream."""
+    from zoo_tpu.serving.ha_client import HAServingClient
+    from zoo_tpu.serving.server import ServingServer
+    cfg, model = paged
+    # two engines over the SAME model object = bit-identical weights
+    # (they serialize on the model lock, like two processes on one chip)
+    eng1, eng2 = LLMEngine(model).start(), LLMEngine(model).start()
+    s1 = ServingServer(None, llm_engine=eng1, port=0, batch_size=2,
+                       max_wait_ms=1.0).start()
+    s2 = ServingServer(None, llm_engine=eng2, port=0, batch_size=2,
+                       max_wait_ms=1.0).start()
+    try:
+        prompt = (np.arange(5) * 3 + 1) % cfg.vocab
+        ref, _ = _stream_tokens(s2.host, s2.port, prompt, 8)
+        cli = HAServingClient([(s1.host, s1.port), (s2.host, s2.port)],
+                              hedge=False, deadline_ms=120_000)
+        got = []
+        for tok in cli.generate(prompt, 8):
+            got.append(tok)
+            if len(got) == 3:
+                s1.stop()   # primary dies mid-stream
+        assert got == ref, f"failover stream diverged: {got} vs {ref}"
+        cli.close()
+    finally:
+        for srv, eng in ((s1, eng1), (s2, eng2)):
+            try:
+                srv.stop()
+            except Exception:  # noqa: BLE001 — s1 already stopped
+                pass
+        assert eng1.allocator.used_blocks == 0
+        assert eng2.allocator.used_blocks == 0
+
+
+# ------------------------------------------------------------ chaos smoke
+
+@pytest.mark.chaos
+def test_check_llm_serving_script_runs():
+    """The 2-replica SIGKILL smoke (scripts/check_llm_serving.py): a
+    real supervised llama:tiny replica group streams concurrent
+    mixed-length generations, loses one replica mid-stream, and the HA
+    client contract holds — zero client-visible failures, token streams
+    byte-identical to the reference, zero leaked KV blocks."""
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join("scripts", "check_llm_serving.py")],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True, text=True, timeout=420,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "LLM SERVING OK" in proc.stdout

@@ -68,7 +68,7 @@ def test_validate_batch_size():
 def test_psum_over_mesh_collective():
     """Real allreduce over the virtual mesh via shard_map — the rebuild's
     equivalent of the reference's DistriEstimatorSpec on local[4]."""
-    from jax import shard_map
+    from zoo_tpu.parallel.compat import shard_map
 
     mesh = build_mesh()
     x = jnp.arange(8.0)

@@ -432,6 +432,13 @@ def test_metrics_end_to_end_serving_fit_checkpoint(orca_ctx, tmp_path):
         slow_srv.stop()
         fast_srv.stop()
 
+    # 4c. the LLM engine's paged-KV gauges (docs/llm_serving.md): a
+    # jax-free allocator round-trip leaves zoo_llm_kv_blocks_{used,free}
+    # populated with the pool's live accounting
+    from zoo_tpu.serving.llm.kv_cache import BlockAllocator
+    alloc = BlockAllocator(num_blocks=17, block_size=8)
+    alloc.allocate("scrape-seq", 4)
+
     # 5. one scrape sees all of it
     ex = MetricsExporter().start()  # process-global registry
     try:
@@ -455,6 +462,8 @@ def test_metrics_end_to_end_serving_fit_checkpoint(orca_ctx, tmp_path):
             "zoo_serve_failover_total",
             'zoo_serve_hedge_total{event="fired"}',
             'zoo_serve_hedge_total{event="won"}',
+            "zoo_llm_kv_blocks_used 4",
+            "zoo_llm_kv_blocks_free 12",
     ):
         assert needle in text, f"/metrics is missing {needle}"
     # the fit really recorded step phases (count > 0, not just a family)

@@ -72,6 +72,31 @@ def llama_param_count(cfg: LlamaConfig) -> int:
     return total
 
 
+def resolve_attention_impl(impl: str, seq_len: int) -> str:
+    """Concrete kernel for an ``attention_impl`` request at ``seq_len``.
+
+    ``"auto"`` picks the Pallas flash kernel from
+    ``ZOO_LLAMA_FLASH_MIN_SEQ`` tokens up (default 512 — the measured
+    v5e crossover vs the fused dense path) when running on TPU
+    hardware, else the dense path. BENCH_r05 showed the s4096 MFU
+    falloff (0.44 → 0.35) exactly because the old auto check keyed on
+    the backend *name* and the bench platform registered as ``axon``;
+    resolving here (by sequence length, against ``pallas.on_tpu()``'s
+    device_kind probe) makes the choice explicit and lets the bench
+    record it per row. ``"dense"``/``"flash"``/``"ring"`` pass through
+    untouched; ``ZOO_LLAMA_ATTN_IMPL`` force-overrides auto for A/B
+    runs without a code change."""
+    import os
+    if impl != "auto":
+        return impl
+    forced = os.environ.get("ZOO_LLAMA_ATTN_IMPL", "")
+    if forced:
+        return forced
+    from zoo_tpu.ops.pallas import on_tpu
+    min_seq = int(os.environ.get("ZOO_LLAMA_FLASH_MIN_SEQ", "512"))
+    return "flash" if seq_len >= min_seq and on_tpu() else "dense"
+
+
 def _rms_norm(x, gain, eps):
     # f32 island for the moment/rsqrt only; the normalized tensor drops
     # to the compute dtype BEFORE the gain multiply, so autodiff saves a
@@ -225,7 +250,11 @@ class Llama(Layer):
         q = apply_rope(q.transpose(0, 2, 1, 3), cos, sin)
         k = apply_rope(k.transpose(0, 2, 1, 3), cos, sin)
         v = v.transpose(0, 2, 1, 3)
-        if self.attention_impl == "ring":
+        impl = resolve_attention_impl(self.attention_impl, T)
+        # trace-time record (T is static): bench rows and tests read the
+        # concrete kernel the auto mode landed on for this shape
+        self.last_attention_impl = impl
+        if impl == "ring":
             # GQA-aware kernel: the ring carries the unrepeated kv heads
             from zoo_tpu.parallel.ring_attention import ring_attention
             a = ring_attention(self._seq_mesh(), q, k, v, causal=True)
@@ -233,8 +262,7 @@ class Llama(Layer):
             # GQA passes the unrepeated kv heads straight through: the
             # flash kernel maps query heads onto their group's kv head
             # in its index maps, the dense path broadcasts internally
-            a = dot_product_attention(q, k, v, causal=True,
-                                      impl=self.attention_impl)
+            a = dot_product_attention(q, k, v, causal=True, impl=impl)
         a = a.transpose(0, 2, 1, 3).reshape(B, T, c.hidden)
         return h + a @ p["wo"]
 

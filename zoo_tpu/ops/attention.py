@@ -41,14 +41,19 @@ def dot_product_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     the dense path broadcasts the groups here.
     """
     flash_ok = mask is None and dropout_p == 0.0
-    # auto: flash from S>=512 up — with 512x512 blocks the kernel beats
-    # the dense path there (measured v5e, B=64 H=12 D=64: fwd 3.3 vs
-    # 4.9 ms) and it avoids materializing the f32 T^2 scores that
-    # dominate the dense path's HBM traffic; at shorter seq the fused
-    # dense path is faster (BERT-base S=128 dense 1.4x flash on v5e)
-    if impl == "flash" or (impl == "auto" and flash_ok
-                           and q.shape[-2] >= 512
-                           and jax.default_backend() == "tpu"):
+    if impl == "auto":
+        # ONE owner for the flash-vs-dense policy (threshold, TPU
+        # probe, env overrides): resolve_attention_impl. flash from
+        # S>=512 up — with 512x512 blocks the kernel beats the dense
+        # path there (measured v5e, B=64 H=12 D=64: fwd 3.3 vs 4.9 ms)
+        # and it avoids materializing the f32 T^2 scores that dominate
+        # the dense path's HBM traffic; at shorter seq the fused dense
+        # path is faster (BERT-base S=128 dense 1.4x flash on v5e).
+        # Lazy import: llama.py imports this module at load time.
+        from zoo_tpu.models.llm.llama import resolve_attention_impl
+        impl = resolve_attention_impl("auto", q.shape[-2]) \
+            if flash_ok else "dense"
+    if impl == "flash":
         if not flash_ok:
             raise ValueError("flash attention supports causal masking only "
                              "(no arbitrary mask / dropout); use the dense "

@@ -29,7 +29,25 @@ SUBLANES = 8      # f32 sublane count
 
 
 def on_tpu() -> bool:
-    return jax.default_backend() == "tpu"
+    """True when the default device is TPU hardware.
+
+    Checks the device_kind, not just the backend name: experimental TPU
+    platform registrations (BENCH_r05 ran on a backend named ``axon``
+    whose devices report ``device_kind="TPU v5 lite"``) would otherwise
+    silently demote every Pallas kernel to the interpreter — and the
+    attention ``auto`` mode to the dense path, the measured s4096 MFU
+    falloff. ``ZOO_PALLAS_FORCE_INTERPRET=1`` is the kill switch if a
+    TPU-kind platform cannot take Mosaic kernels."""
+    import os
+    if os.environ.get("ZOO_PALLAS_FORCE_INTERPRET", "") in ("1", "true"):
+        return False
+    if jax.default_backend() == "tpu":
+        return True
+    try:
+        kind = getattr(jax.devices()[0], "device_kind", "")
+    except Exception:  # noqa: BLE001 — no backend at all
+        return False
+    return "tpu" in str(kind).lower()
 
 
 def resolve_interpret(interpret) -> bool:

@@ -1,0 +1,33 @@
+"""jax API compatibility shims for the parallel subsystem.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to a
+top-level ``jax.shard_map`` (with ``check_rep`` renamed ``check_vma``)
+across the jax versions this repo meets in the wild; the baked-in
+toolchain here ships 0.4.x where only the experimental spelling exists.
+One shim keeps every call site on the new-style signature.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["shard_map"]
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check: bool = False):
+    """New-style ``jax.shard_map`` when available, else the experimental
+    one. ``check`` maps to ``check_vma`` (new) / ``check_rep`` (old);
+    default False — the replication checker predates several collective
+    patterns used here (ring ppermute, pipeline stages) and rejects
+    valid programs on old jax."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        try:
+            return sm(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_vma=check)
+        except TypeError:  # pre-rename top-level export
+            return sm(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check)
+    from jax.experimental.shard_map import shard_map as legacy
+    return legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check)

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import contextlib
 import pickle
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -56,6 +57,14 @@ def _merge_state(trainable: Dict, state: Dict) -> Dict:
 # Event-file-backed summaries (own writer + disk read-back) live in
 # zoo_tpu.tensorboard; re-exported here for the keras facade.
 from zoo_tpu.tensorboard import TrainSummary  # noqa: E402
+
+# serializes lazy jit-cache builds: concurrent first predicts (the
+# multi-replica ServingServer batcher threads) could otherwise each
+# build a PRIVATE jit object for the same step fn — two full XLA
+# compiles of the same executable, a multi-second p99 spike per extra
+# thread on TPU. Module-level (not an instance attr) so models stay
+# cloudpickle-serializable.
+_JIT_BUILD_LOCK = threading.Lock()
 
 
 def _scan_steps(step, params, opt_state, rng, stacked):
@@ -1019,7 +1028,9 @@ class KerasNet:
         process feeds its local rows of the global batch and gets its local
         predictions back (``batch_size`` is global, like fit)."""
         if self._jit_pred is None:
-            self._jit_pred = self._build_pred_step()
+            with _JIT_BUILD_LOCK:
+                if self._jit_pred is None:
+                    self._jit_pred = self._build_pred_step()
         params = self._place(self.params)
         n = data_utils.num_samples(xs)
         pc = jax.process_count()
@@ -1075,7 +1086,9 @@ class KerasNet:
             return {"loss": float(self.loss_fn(
                 yt, tuple(jnp.asarray(p) for p in preds)))}
         if self._jit_pred is None:
-            self._jit_pred = self._build_pred_step()
+            with _JIT_BUILD_LOCK:
+                if self._jit_pred is None:
+                    self._jit_pred = self._build_pred_step()
         params = self._place(self.params)
         ys = np.asarray(ys) if not hasattr(ys, "devices") else ys
         n = data_utils.num_samples(xs)

@@ -78,7 +78,17 @@ class SyntheticModel:
 def load_serving_model(spec: str, batch_size: int = 8):
     """A model from a replica spec: ``synthetic:*`` (jax-free),
     a TF SavedModel directory, or a serialized ``.zoo`` file (the same
-    resolution order as ``zoo_tpu.serving.run``)."""
+    resolution order as ``zoo_tpu.serving.run``). ``llama:*`` specs are
+    NOT predict models — they mount the autoregressive engine
+    (``zoo_tpu.serving.llm``) and are resolved by the replica process
+    itself."""
+    from zoo_tpu.serving.llm.spec import is_llm_spec
+    if is_llm_spec(spec):
+        raise ValueError(
+            f"{spec!r} is an llm spec (streaming generate, not "
+            "predict); build it with "
+            "zoo_tpu.serving.llm.build_llm_engine, or pass it as a "
+            "ReplicaGroup model to serve it")
     if spec.startswith(SYNTHETIC_PREFIX):
         return SyntheticModel.parse(spec)
     from zoo_tpu.pipeline.inference.inference_model import InferenceModel

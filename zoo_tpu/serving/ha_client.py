@@ -201,6 +201,237 @@ class HAServingClient:
             raise RuntimeError(resp["error"])
         return resp["result"]
 
+    def generate(self, prompt, max_new_tokens: int,
+                 deadline_ms: Optional[float] = None,
+                 hedge: Optional[bool] = None):
+        """Stream one greedy generation over the replica group: yields
+        tokens (ints) as frames arrive.
+
+        The PR 5 contracts, applied per stream:
+
+        * **deadline** — one budget covers the whole stream; the engine
+          expires it mid-decode and this raises
+          :class:`DeadlineExceeded`.
+        * **failover with resume** — a transport failure or retryable
+          shed mid-stream moves to the next replica with
+          ``resume_from = tokens_already_received``. Replicas hold
+          bit-identical weights and decode greedily, so the fresh
+          replica regenerates the same stream and sends only the
+          unseen suffix: the caller observes a pause, never a gap,
+          duplicate, or error.
+        * **first-token hedge** — when no frame has arrived within the
+          p95-tracked hedge delay, ONE duplicate stream starts on the
+          next replica (same id, so a same-replica landing joins the
+          live stream via the engine's dedup instead of decoding
+          twice); whichever produces the first content frame becomes
+          the stream, the loser's connection closes (its server drops
+          the last subscriber and frees the KV blocks).
+        """
+        import numpy as _np
+        rid = uuid.uuid4().hex
+        dl = Deadline.from_ms(
+            deadline_ms if deadline_ms is not None else self.deadline_ms)
+        use_hedge = self.hedge if hedge is None else bool(hedge)
+        prompt = _np.asarray(prompt)
+        received = 0
+        results: "_queue.Queue" = _queue.Queue()
+        attempts: List[Dict] = []
+        order = self._plan()
+        # every endpoint may be tried twice (once pre-, once post-
+        # failure) before the stream gives up
+        budget = 2 * len(order)
+        candidates = list(order) + list(order)
+        chosen: Optional[Dict] = None
+        last_err: Optional[BaseException] = None
+
+        def fire(ep: _Endpoint, is_hedge: bool = False):
+            att = {"ep": ep, "stop": threading.Event(), "conn": None,
+                   "hedge": is_hedge, "dead": False}
+            attempts.append(att)
+
+            def run():
+                # exactly ONE terminal event per attempt ("err"/"end"),
+                # stopped or not — the arbiter's in_flight counter
+                # depends on it
+                try:
+                    conn = ep.acquire()
+                except OSError as e:
+                    ep.breaker.record_failure()
+                    results.put(("err", att, e))
+                    return
+                att["conn"] = conn
+                msg = {"op": "generate", "id": rid,
+                       "prompt": prompt,
+                       "max_new_tokens": int(max_new_tokens),
+                       "resume_from": received}
+                try:
+                    for frame in conn.stream(dict(msg), deadline=dl):
+                        results.put(("frame", att, frame))
+                        if att["stop"].is_set():
+                            break
+                except Exception as e:  # noqa: BLE001 — the arbiter
+                    # owns the verdict; a leaked exception would strand
+                    # in_flight and hang the stream
+                    if not (att["stop"].is_set()
+                            or isinstance(e, DeadlineExceeded)):
+                        ep.breaker.record_failure()
+                    ep.release(conn, healthy=False)
+                    results.put(("err", att, e))
+                    return
+                ep.release(conn, healthy=not att["stop"].is_set())
+                results.put(("end", att, None))
+
+            threading.Thread(target=run, daemon=True,
+                             name="zoo-ha-stream").start()
+            return att
+
+        def kill(att):
+            att["stop"].set()
+            conn = att.get("conn")
+            if conn is not None:
+                conn.close()  # the server sees the drop; when this was
+                #               the last subscriber it cancels the
+                #               stream and frees its KV blocks
+
+        def others_racing(att):
+            return any(a is not att and not a["dead"]
+                       and not a["stop"].is_set() for a in attempts)
+
+        def can_fire():
+            return bool(candidates) and budget > 0 and (
+                dl is None or not dl.expired())
+
+        in_flight = 1
+        budget -= 1
+        fire(candidates.pop(0))
+        hedged = False
+        try:
+            while in_flight:
+                can_hedge = (use_hedge and not hedged and chosen is None
+                             and can_fire())
+                timeout = self._hedge_delay() if can_hedge else None
+                if dl is not None:
+                    rem = max(0.0, dl.remaining()) + 0.5
+                    timeout = rem if timeout is None else min(timeout,
+                                                              rem)
+                try:
+                    kind, att, payload = results.get(timeout=timeout)
+                except _queue.Empty:
+                    if can_hedge:
+                        hedged = True
+                        _hedge.labels(event="fired").inc()
+                        budget -= 1
+                        in_flight += 1
+                        fire(candidates.pop(0), is_hedge=True)
+                        continue
+                    raise DeadlineExceeded(
+                        "stream deadline expired waiting for frames"
+                    ) from last_err
+                if kind in ("err", "end"):
+                    in_flight -= 1
+                    att["dead"] = True
+                    if att["stop"].is_set():
+                        continue
+                    if kind == "end":
+                        continue
+                    last_err = payload
+                    if isinstance(payload, DeadlineExceeded):
+                        raise payload
+                    if att is chosen:
+                        chosen = None
+                    # failover-with-resume: only when nobody else is
+                    # still racing for (or producing) frames
+                    if chosen is None and not others_racing(att) \
+                            and can_fire():
+                        _failover.inc()
+                        budget -= 1
+                        in_flight += 1
+                        fire(candidates.pop(0))
+                    continue
+                if att["stop"].is_set() or (chosen is not None
+                                            and att is not chosen):
+                    continue
+                frame = payload
+                if frame.get("shed") and frame.get("retryable"):
+                    kill(att)
+                    last_err = NoReplicaAvailable(
+                        frame.get("error", "shed"), None)
+                    if att is chosen:
+                        chosen = None
+                    if not others_racing(att) and can_fire():
+                        _failover.inc()
+                        budget -= 1
+                        in_flight += 1
+                        fire(candidates.pop(0))
+                    continue
+                if frame.get("done") and \
+                        frame.get("outcome") == "cancelled":
+                    # the replica gave up the stream (engine stopped /
+                    # graceful shutdown) — not a client cancel, we are
+                    # still here reading. Tokens in the terminal frame
+                    # are a valid prefix (greedy decode); keep them and
+                    # resume the remainder on another replica, same as
+                    # a transport loss. Any still-racing attempt (an
+                    # unresolved hedge) was fired with an OLDER
+                    # resume_from — kill it BEFORE advancing the
+                    # cursor, or its stream could later be adopted and
+                    # re-deliver these tokens
+                    for other in attempts:
+                        if other is not att and not other["dead"] \
+                                and not other["stop"].is_set():
+                            kill(other)
+                    for tok in frame.get("tokens") or ():
+                        received += 1
+                        yield int(tok)
+                    kill(att)
+                    last_err = NoReplicaAvailable(
+                        frame.get("error", "stream cancelled by "
+                                           "replica"), None)
+                    if att is chosen:
+                        chosen = None
+                    if not others_racing(att) and can_fire():
+                        _failover.inc()
+                        budget -= 1
+                        in_flight += 1
+                        fire(candidates.pop(0))
+                    continue
+                if chosen is None and (frame.get("tokens")
+                                       or frame.get("done")):
+                    chosen = att
+                    att["ep"].breaker.record_success()
+                    if att["hedge"]:
+                        _hedge.labels(event="won").inc()
+                    for other in attempts:
+                        if other is not att and not other["dead"] \
+                                and not other["stop"].is_set():
+                            kill(other)
+                if att is not chosen:
+                    continue
+                if frame.get("expired") or \
+                        frame.get("outcome") == "expired":
+                    raise DeadlineExceeded(
+                        frame.get("error",
+                                  "server expired the stream"))
+                for tok in frame.get("tokens") or ():
+                    received += 1
+                    yield int(tok)
+                if frame.get("done"):
+                    if frame.get("outcome") not in ("ok", None):
+                        raise RuntimeError(
+                            frame.get("error",
+                                      f"stream {frame.get('outcome')}"))
+                    return
+            if dl is not None and dl.expired():
+                raise DeadlineExceeded(
+                    "stream deadline expired during failover"
+                ) from last_err
+            raise NoReplicaAvailable(
+                f"all {len(self._eps)} replica(s) failed the stream: "
+                f"{last_err!r}", last_err)
+        finally:
+            for att in attempts:
+                kill(att)
+
     def stats(self) -> List[Optional[Dict]]:
         """Per-replica stage-timer stats (None for a down replica)."""
         out = []

@@ -1,0 +1,35 @@
+"""Autoregressive LLM serving: continuous batching over a paged KV cache.
+
+The serving stack through PR 5 does fixed-shape one-shot batching — the
+right shape for a classifier, a dead end for autoregressive decode where
+requests finish at different times and a request-level batch idles every
+finished seat until the slowest member drains (Orca, OSDI '22, measured
+that gap at an order of magnitude). This package is the inference-engine
+rebuild of that result for the Llama family:
+
+* :mod:`kv_cache` — fixed-size token-block KV allocator with
+  per-sequence block tables (the PagedAttention memory model, SOSP '23):
+  KV memory is admitted block-by-block off a free list instead of
+  per-request max-length preallocation, so achievable batch depth is
+  bounded by *actual* tokens resident, not by worst-case length.
+* :mod:`model` — prefill/decode split over one set of Llama weights:
+  bucketed prompt prefill executables plus exactly ONE fixed-shape
+  (slots x 1 token) decode executable whose attention gathers K/V
+  through the block tables.
+* :mod:`engine` — the iteration-level scheduler: every decode step,
+  finished slots are freed and waiting requests are admitted into them
+  (continuous batching), with PR 5's deadline/admission semantics and
+  per-token streaming out of each slot.
+* :mod:`spec` — ``llama:...`` model specs so a :class:`ReplicaGroup`
+  replica (``zoo_tpu.serving.replica``) can mount the engine behind the
+  HA layer.
+
+See docs/llm_serving.md for the architecture and the ZOO_LLM_* knobs.
+"""
+
+from zoo_tpu.serving.llm.engine import GenHandle, LLMEngine
+from zoo_tpu.serving.llm.kv_cache import BlockAllocator
+from zoo_tpu.serving.llm.spec import build_llm_engine, is_llm_spec
+
+__all__ = ["LLMEngine", "GenHandle", "BlockAllocator",
+           "build_llm_engine", "is_llm_spec"]

@@ -1,0 +1,544 @@
+"""Iteration-level (continuous) batching engine for autoregressive
+decode.
+
+The scheduler the tentpole is named after: instead of forming a batch of
+requests and draining it to completion (request-level batching — every
+finished sequence idles its seat until the slowest member ends), the
+engine re-schedules **every decode iteration**: finished/expired/aborted
+streams free their slot and KV blocks, waiting requests are admitted
+into free slots the same tick, and the ONE fixed-shape decode executable
+runs over whatever mix of old and new sequences the slots hold (Orca's
+in-flight batching, OSDI '22).
+
+PR 5's serving semantics apply per stream: a propagated
+:class:`Deadline` is checked at submission (dead-on-arrival), at
+admission, and every decode iteration (mid-stream expiry frees the slot
+immediately); the waiting queue is bounded (overload sheds at the door
+with ``retryable``); a duplicate request id joins the live stream
+instead of decoding twice. Admission is additionally gated on the KV
+free list — a request only enters a slot when its prompt's blocks plus
+one decode block exist (:meth:`BlockAllocator.can_admit`).
+
+When a RUNNING sequence needs its next block and the pool is dry, the
+youngest-admitted victim is **preempted**: blocks freed, stream pushed
+back to the head of the waiting queue, and (because decode is greedy
+and deterministic) re-prefilled later from prompt+generated with no
+client-visible artifact beyond latency.
+
+The model behind the engine is any adapter with the
+:class:`~zoo_tpu.serving.llm.model.PagedLlamaModel` surface (``prefill``
+/ ``decode`` / shape attrs), so scheduler tests run against a pure-
+python fake without importing jax.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Deque, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from zoo_tpu.obs.metrics import counter, gauge, histogram
+from zoo_tpu.serving.llm.kv_cache import BlockAllocator
+from zoo_tpu.util.resilience import Deadline, env_int
+
+_tokens = counter(
+    "zoo_llm_tokens_total", "Tokens processed by the LLM engine "
+    "(prefill = prompt tokens, decode = generated tokens)",
+    labels=("kind",))
+_steps = counter(
+    "zoo_llm_decode_steps_total",
+    "Fixed-shape decode iterations executed")
+_ttft = histogram(
+    "zoo_llm_ttft_seconds",
+    "Time from stream submission to its first generated token")
+_occupancy = gauge(
+    "zoo_llm_slot_occupancy",
+    "Decode slots holding a live sequence right now")
+_waiting = gauge(
+    "zoo_llm_waiting_streams", "Streams queued behind admission "
+    "(no free slot or no free KV blocks)")
+_preempts = counter(
+    "zoo_llm_preempt_total",
+    "Running streams evicted to free KV blocks (re-queued, resumed by "
+    "re-prefill)")
+_streams = counter(
+    "zoo_llm_streams_total", "Finished streams by outcome "
+    "(ok / expired / cancelled / error)", labels=("outcome",))
+_dedup = counter(
+    "zoo_llm_stream_dedup_total",
+    "Duplicate stream ids joined to an existing stream instead of "
+    "decoding twice")
+
+
+class AdmissionError(RuntimeError):
+    """Retryable door rejection (waiting queue full); mirrors the
+    predict path's shed contract."""
+
+    def __init__(self, msg: str, retry_after_ms: int = 100):
+        super().__init__(msg)
+        self.retry_after_ms = retry_after_ms
+
+
+class GenHandle:
+    """One stream: the scheduler appends tokens, any number of
+    subscribers read them by cursor (a duplicate request id or a
+    resumed failover attempt replays from its own cursor — frames are
+    never consumed destructively)."""
+
+    def __init__(self, rid: str, prompt: np.ndarray, max_new: int,
+                 deadline: Optional[Deadline]):
+        self.id = rid
+        self.prompt = np.asarray(prompt, np.int32)
+        self.max_new = int(max_new)
+        self.deadline = deadline
+        self.tokens: List[int] = []
+        self.outcome: Optional[str] = None   # None=live
+        self.error: Optional[str] = None
+        self.truncated = False
+        self.created = time.perf_counter()
+        self.first_token_at: Optional[float] = None
+        self.cancelled = threading.Event()
+        self._cond = threading.Condition()
+        self._subs = 0  # live server-side stream loops on this handle
+        # scheduler-side state (owned by the engine thread)
+        self.gen_count = 0        # tokens generated across preemptions
+        self.admit_seq = -1       # admission order; preemption victims
+        #                           are picked youngest-first
+        self.effective_prompt: Optional[np.ndarray] = None  # after
+        #                           preemption: prompt + generated
+
+    @property
+    def done(self) -> bool:
+        return self.outcome is not None
+
+    def push(self, tok: int):
+        with self._cond:
+            self.tokens.append(int(tok))
+            if self.first_token_at is None:
+                self.first_token_at = time.perf_counter()
+                _ttft.observe(self.first_token_at - self.created)
+            self._cond.notify_all()
+
+    def finish(self, outcome: str, error: Optional[str] = None):
+        with self._cond:
+            if self.outcome is not None:
+                return
+            self.outcome = outcome
+            self.error = error
+            self._cond.notify_all()
+        _streams.labels(outcome=outcome).inc()
+
+    def cancel(self):
+        """Client-side abort (connection dropped, caller gone): the
+        scheduler frees the slot and KV blocks at its next sweep."""
+        self.cancelled.set()
+        with self._cond:
+            self._cond.notify_all()
+
+    def wait_new(self, cursor: int, timeout: Optional[float]
+                 ) -> tuple:
+        """Block until tokens beyond ``cursor`` exist or the stream
+        ends. Returns ``(new_tokens, done)``; on timeout both are
+        empty/False so the caller can re-check its own deadline."""
+        end = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while True:
+                if len(self.tokens) > cursor:
+                    return self.tokens[cursor:], self.outcome is not None
+                if self.outcome is not None:
+                    return [], True
+                rem = None if end is None else end - time.monotonic()
+                if rem is not None and rem <= 0:
+                    return [], False
+                self._cond.wait(rem if rem is None or rem < 0.5
+                                else 0.5)
+
+    def subscribe(self) -> int:
+        """Register a streaming reader (a server handler, a joined
+        duplicate, a hedge). The stream is only auto-cancelled when the
+        LAST reader drops — a hedge loser's disconnect must not kill
+        the winner's stream."""
+        with self._cond:
+            self._subs += 1
+            return self._subs
+
+    def unsubscribe(self) -> int:
+        with self._cond:
+            self._subs -= 1
+            return self._subs
+
+    def ttft(self) -> Optional[float]:
+        return None if self.first_token_at is None else \
+            self.first_token_at - self.created
+
+
+class _Slot:
+    __slots__ = ("handle", "last_token", "position")
+
+    def __init__(self):
+        self.handle: Optional[GenHandle] = None
+        self.last_token = 0
+        self.position = 0
+
+
+class LLMEngine:
+    """``LLMEngine(model).start()`` → ``submit()`` streams until
+    ``stop()``.
+
+    ``mode="continuous"`` (default) admits into free slots every
+    iteration; ``mode="oneshot"`` is the request-level baseline the
+    bench compares against — a wave is admitted only when every slot is
+    empty and drains completely before the next wave."""
+
+    def __init__(self, model, mode: str = "continuous",
+                 max_waiting: Optional[int] = None):
+        if mode not in ("continuous", "oneshot"):
+            raise ValueError(f"unknown scheduling mode {mode!r}")
+        self.model = model
+        self.mode = mode
+        self.max_waiting = max_waiting if max_waiting is not None else \
+            env_int("ZOO_LLM_MAX_WAITING", 256)
+        self.allocator = BlockAllocator(model.num_blocks,
+                                        model.block_size)
+        self._slots = [_Slot() for _ in range(model.num_slots)]
+        self._wait: Deque[GenHandle] = collections.deque()
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._admit_counter = 0
+        # id → handle for every live stream plus an LRU of finished
+        # ones: a duplicate id (retry / same-replica hedge) REPLAYS the
+        # stream instead of re-decoding it
+        self._by_id: "collections.OrderedDict[str, GenHandle]" = \
+            collections.OrderedDict()
+        self._finished_cap = env_int("ZOO_LLM_FINISHED_CACHE", 256)
+        self._decode_steps = 0
+        self._generated = 0
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "LLMEngine":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="zoo-llm-scheduler")
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0):
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        # everything still live is cancelled and its blocks freed — the
+        # pool must account to zero on shutdown
+        with self._lock:
+            live = [s.handle for s in self._slots if s.handle] + \
+                list(self._wait)
+            self._wait.clear()
+            for s in self._slots:
+                s.handle = None
+        for h in live:
+            self.allocator.free(h.id)
+            h.finish("cancelled", "engine stopped")
+        self._publish()
+
+    # -- submission --------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int,
+               rid: Optional[str] = None,
+               deadline: Optional[Deadline] = None) -> GenHandle:
+        """Queue one generation. Raises :class:`AdmissionError` when the
+        waiting queue is full (retryable shed), ``ValueError`` for a
+        prompt no prefill bucket can hold."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        if prompt.size > self.model.max_prompt_len:
+            raise ValueError(
+                f"prompt of {prompt.size} tokens exceeds the largest "
+                f"prefill bucket ({self.model.max_prompt_len})")
+        usable = self.allocator.num_blocks - 1
+        if self.allocator.blocks_for_tokens(prompt.size + 1) > usable:
+            # can_admit() could NEVER pass: without this check the
+            # request would park at the head of the waiting queue
+            # forever, wedging everything behind it
+            raise ValueError(
+                f"prompt of {prompt.size} tokens needs more KV blocks "
+                f"than the whole pool holds ({usable} usable x "
+                f"{self.allocator.block_size} tokens)")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if rid is None:
+            import uuid
+            rid = uuid.uuid4().hex
+        with self._lock:
+            prior = self._by_id.get(rid)
+            if prior is not None:
+                _dedup.inc()
+                return prior
+            if len(self._wait) >= self.max_waiting:
+                raise AdmissionError(
+                    f"llm waiting queue full ({len(self._wait)} "
+                    f"streams, bound {self.max_waiting}); retry "
+                    "another replica",
+                    retry_after_ms=200)
+            h = GenHandle(rid, prompt, max_new_tokens, deadline)
+            self._by_id[rid] = h
+            self._trim_finished()
+            self._wait.append(h)
+            _waiting.set(len(self._wait))
+        self._wake.set()
+        return h
+
+    def get(self, rid: str) -> Optional[GenHandle]:
+        with self._lock:
+            return self._by_id.get(rid)
+
+    def cancel(self, rid: str) -> bool:
+        h = self.get(rid)
+        if h is None or h.done:
+            return False
+        h.cancel()
+        self._wake.set()
+        return True
+
+    def _trim_finished(self):
+        # under self._lock. Finished handles age out of the dedup map
+        # oldest-first; live handles are never evicted.
+        while len(self._by_id) > self._finished_cap:
+            for k, h in self._by_id.items():
+                if h.done:
+                    del self._by_id[k]
+                    break
+            else:
+                return
+
+    # -- scheduler ---------------------------------------------------------
+    def _publish(self):
+        _occupancy.set(sum(1 for s in self._slots if s.handle))
+        with self._lock:
+            _waiting.set(len(self._wait))
+
+    def _finish_slot(self, slot: _Slot, outcome: str,
+                     error: Optional[str] = None):
+        h = slot.handle
+        slot.handle = None
+        self.allocator.free(h.id)
+        h.finish(outcome, error)
+
+    def _expired(self, h: GenHandle) -> bool:
+        return h.deadline is not None and h.deadline.expired()
+
+    def _sweep(self):
+        """Free slots whose stream is done for out-of-band reasons
+        (client cancel, deadline expiry, max tokens already reached)."""
+        for slot in self._slots:
+            h = slot.handle
+            if h is None:
+                continue
+            if h.cancelled.is_set():
+                self._finish_slot(slot, "cancelled", "stream aborted")
+            elif self._expired(h):
+                self._finish_slot(
+                    slot, "expired",
+                    "deadline expired mid-stream (generation stopped, "
+                    f"{h.gen_count} tokens emitted)")
+
+    def _admit_ready(self) -> bool:
+        if self.mode == "oneshot":
+            # request-level baseline: a new wave only starts on an
+            # EMPTY batch (what serving did before this engine)
+            return all(s.handle is None for s in self._slots)
+        return True
+
+    def _admit(self):
+        if not self._admit_ready():
+            return
+        for slot in self._slots:
+            if slot.handle is not None:
+                continue
+            with self._lock:
+                h = self._wait.popleft() if self._wait else None
+            if h is None:
+                break
+            if h.cancelled.is_set():
+                h.finish("cancelled", "aborted while queued")
+                continue
+            if self._expired(h):
+                h.finish("expired", "deadline expired in the waiting "
+                                    "queue (never admitted)")
+                continue
+            prompt = h.effective_prompt if h.effective_prompt \
+                is not None else h.prompt
+            if self.allocator.blocks_for_tokens(len(prompt) + 1) > \
+                    self.allocator.num_blocks - 1:
+                # a preempted stream whose prompt+generated context
+                # outgrew the whole pool: no future free list satisfies
+                # it, so end it loudly instead of parking it forever
+                h.finish("error",
+                         f"resumed context of {len(prompt)} tokens "
+                         "exceeds the whole KV pool")
+                continue
+            if not self.allocator.can_admit(len(prompt)):
+                # KV pressure: requeue at the head and stop admitting
+                # this tick — FIFO order is preserved and the gauge
+                # shows the door is block-gated, not slot-gated
+                with self._lock:
+                    self._wait.appendleft(h)
+                break
+            n_blocks = self.allocator.blocks_for_tokens(len(prompt))
+            got = self.allocator.allocate(h.id, n_blocks)
+            if got is None:   # raced another allocator client
+                with self._lock:
+                    self._wait.appendleft(h)
+                break
+            first = self.model.prefill(
+                prompt, self._table_row(self.allocator.blocks_of(h.id)))
+            _tokens.labels(kind="prefill").inc(len(prompt))
+            slot.handle = h
+            slot.last_token = first
+            slot.position = len(prompt)
+            self._admit_counter += 1
+            h.admit_seq = self._admit_counter
+            h.push(first)
+            h.gen_count += 1
+            self._generated += 1
+            _tokens.labels(kind="decode").inc()
+            eos = getattr(self.model, "eos_id", None)
+            if h.gen_count >= h.max_new or \
+                    (eos is not None and first == eos):
+                self._finish_slot(slot, "ok")
+        self._publish()
+
+    def _table_row(self, blocks: Sequence[int]) -> np.ndarray:
+        row = np.zeros((self.model.max_blocks_per_seq,), np.int32)
+        row[:len(blocks)] = blocks
+        return row
+
+    def _grow_or_preempt(self) -> None:
+        """Every active slot must own the block its next write lands in
+        (position // block_size). When the free list is dry, evict the
+        youngest-admitted stream and retry; a stream that cannot even
+        self-fund (alone and out of pool) errors out."""
+        bs = self.model.block_size
+        for slot in self._slots:
+            h = slot.handle
+            if h is None:
+                continue
+            needed = slot.position // bs + 1
+            while True:
+                have = len(self.allocator.blocks_of(h.id))
+                if have >= needed:
+                    break
+                if needed > self.model.max_blocks_per_seq:
+                    # block table is full: the sequence hit the context
+                    # ceiling — a truncated-but-successful stream
+                    h.truncated = True
+                    self._finish_slot(slot, "ok")
+                    break
+                if self.allocator.allocate(h.id, 1) is not None:
+                    continue
+                victim = self._pick_victim(exclude=h)
+                if victim is None:
+                    self._finish_slot(
+                        slot, "error",
+                        "kv cache exhausted: sequence cannot grow and "
+                        "no other stream is preemptible")
+                    break
+                self._preempt(victim)
+
+    def _pick_victim(self, exclude: GenHandle) -> Optional[_Slot]:
+        best = None
+        for slot in self._slots:
+            if slot.handle is None or slot.handle is exclude:
+                continue
+            if best is None or slot.handle.admit_seq > \
+                    best.handle.admit_seq:
+                best = slot
+        return best
+
+    def _preempt(self, slot: _Slot):
+        """Evict a running stream: free its blocks and requeue it with
+        prompt := original prompt + everything generated so far.
+        Greedy decode is deterministic, so the re-prefilled
+        continuation matches what the stream would have produced —
+        subscribers just see a pause."""
+        h = slot.handle
+        resumed = np.concatenate(
+            [h.prompt, np.asarray(h.tokens, np.int32)])
+        if len(resumed) > self.model.max_prompt_len:
+            # cannot re-prefill a context longer than the biggest
+            # bucket; end it as truncated-ok rather than wedge the pool
+            h.truncated = True
+            self._finish_slot(slot, "ok")
+            return
+        h.effective_prompt = resumed
+        slot.handle = None
+        self.allocator.free(h.id)
+        _preempts.inc()
+        with self._lock:
+            self._wait.appendleft(h)
+
+    def _decode_tick(self):
+        S = self.model.num_slots
+        tokens = np.zeros((S,), np.int32)
+        tables = np.zeros((S, self.model.max_blocks_per_seq), np.int32)
+        positions = np.zeros((S,), np.int32)
+        active = []
+        for i, slot in enumerate(self._slots):
+            if slot.handle is None:
+                continue
+            active.append(i)
+            tokens[i] = slot.last_token
+            tables[i] = self._table_row(
+                self.allocator.blocks_of(slot.handle.id))
+            positions[i] = slot.position
+        if not active:
+            return False
+        nxt = self.model.decode(tokens, tables, positions)
+        self._decode_steps += 1
+        _steps.inc()
+        for i in active:
+            slot = self._slots[i]
+            h = slot.handle
+            slot.position += 1
+            tok = int(nxt[i])
+            slot.last_token = tok
+            h.push(tok)
+            h.gen_count += 1
+            self._generated += 1
+            _tokens.labels(kind="decode").inc()
+            eos = getattr(self.model, "eos_id", None)
+            if h.gen_count >= h.max_new or \
+                    (eos is not None and tok == eos):
+                self._finish_slot(slot, "ok")
+        self._publish()
+        return True
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self._sweep()
+            self._admit()
+            self._grow_or_preempt()
+            progressed = self._decode_tick()
+            if not progressed:
+                # also parks the loop when the waiting queue is only
+                # KV-gated (head cannot be admitted yet): without the
+                # sleep that state busy-spins a core. submit() sets
+                # _wake, so a fresh request still admits immediately.
+                self._wake.wait(0.005)
+                self._wake.clear()
+
+    # -- introspection -----------------------------------------------------
+    def stats(self) -> Dict:
+        out = {"mode": self.mode,
+               "slots": self.model.num_slots,
+               "active": sum(1 for s in self._slots if s.handle),
+               "waiting": len(self._wait),
+               "decode_steps": self._decode_steps,
+               "generated_tokens": self._generated}
+        out.update(self.allocator.stats())
+        if hasattr(self.model, "compile_counts"):
+            out["compiles"] = self.model.compile_counts()
+        return out

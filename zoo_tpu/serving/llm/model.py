@@ -1,0 +1,285 @@
+"""Prefill/decode split over one set of Llama weights, paged KV.
+
+The compilation contract that makes autoregressive serving viable on an
+XLA device:
+
+* **Prefill** — a full causal forward over the (padded) prompt, one
+  compiled executable per *prompt-length bucket* (a handful of shapes,
+  e.g. 32/128/512), reusing the training attention stack — the Pallas
+  flash kernel at long buckets on TPU, the fused dense path otherwise
+  (``resolve_attention_impl``). The prompt's K/V are scattered into the
+  paged cache through the sequence's block table as part of the same
+  executable.
+* **Decode** — exactly ONE fixed-shape executable: ``num_slots``
+  sequences x 1 token. Every iteration it writes the incoming token's
+  K/V through the block tables, then runs **paged-gather attention**:
+  K/V are gathered ``cache[block_table]`` per slot, masked to each
+  sequence's true length, never materialized contiguous per sequence.
+  Slot count, table width and block count are fixed at construction, so
+  the decode loop NEVER recompiles — request churn only changes the
+  *contents* of the token/table/position operands (the Orca
+  iteration-level scheduling precondition).
+
+Inactive slots point their block table at the reserved trash block 0
+and are masked by position, so the executable has no liveness branch.
+
+The cache lives here as two device arrays
+``(n_layer, num_blocks, block_size, n_kv_head, head_dim)``, donated
+through every prefill/decode call so XLA updates them in place.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from zoo_tpu.models.llm.llama import (
+    Llama,
+    LlamaConfig,
+    _rms_norm,
+    apply_rope,
+    resolve_attention_impl,
+    rope_frequencies,
+)
+from zoo_tpu.ops.attention import dot_product_attention
+
+DEFAULT_PREFILL_BUCKETS = (32, 128, 512)
+
+
+def _pick_bucket(buckets: Sequence[int], n: int) -> Optional[int]:
+    for b in buckets:
+        if n <= b:
+            return b
+    return None
+
+
+class PagedLlamaModel:
+    """Llama weights + paged KV cache + the two serving executables.
+
+    ``params=None`` builds deterministic weights from ``seed`` — every
+    replica of a ``llama:...`` spec holds bit-identical params, so
+    greedy decode is reproducible across the group (the property the
+    HA client's failover-resume leans on).
+    """
+
+    def __init__(self, config: LlamaConfig, *,
+                 params=None, seed: int = 0,
+                 num_slots: int = 8,
+                 block_size: int = 16,
+                 num_blocks: int = 128,
+                 max_blocks_per_seq: int = 32,
+                 prefill_buckets: Sequence[int] = DEFAULT_PREFILL_BUCKETS,
+                 eos_id: Optional[int] = None):
+        self.cfg = config
+        self.num_slots = int(num_slots)
+        self.block_size = int(block_size)
+        self.num_blocks = int(num_blocks)
+        self.max_blocks_per_seq = int(max_blocks_per_seq)
+        self.prefill_buckets = tuple(sorted(int(b) for b in
+                                            prefill_buckets))
+        self.eos_id = eos_id
+        if self.num_slots < 1 or self.num_blocks < 2:
+            raise ValueError("need >= 1 slot and >= 2 KV blocks")
+        self.max_context = self.max_blocks_per_seq * self.block_size
+        if self.prefill_buckets[-1] > self.max_context:
+            raise ValueError(
+                f"largest prefill bucket {self.prefill_buckets[-1]} "
+                f"exceeds the block-table context capacity "
+                f"{self.max_context}")
+        self.max_prompt_len = self.prefill_buckets[-1]
+
+        layer = Llama(config, lm_head=True)
+        self.params = params if params is not None else layer.build(
+            jax.random.PRNGKey(seed), (None, self.prefill_buckets[-1]))
+        c = config
+        # rope tables over the whole pageable context, closed over by
+        # both executables (f32, tiny: max_context x head_dim/2)
+        self._cos, self._sin = rope_frequencies(
+            c.head_dim, self.max_context, c.rope_theta)
+        shape = (c.n_block, self.num_blocks, self.block_size,
+                 c.n_kv_head, c.head_dim)
+        self._kc = jnp.zeros(shape, jnp.float32)
+        self._vc = jnp.zeros(shape, jnp.float32)
+        # one call at a time: prefill/decode donate + replace the cache
+        # arrays, so interleaved calls would race the handoff
+        self._lock = threading.Lock()
+        # caches are args 1,2 → donated: XLA aliases them in place
+        self._decode = jax.jit(self._decode_fn, donate_argnums=(1, 2))
+        self._prefill = jax.jit(self._prefill_fn, donate_argnums=(1, 2))
+
+    # -- compiled bodies ---------------------------------------------------
+    def _attn_proj(self, p, x):
+        """Shared q/k/v projection + head split for both executables."""
+        c = self.cfg
+        q = (x @ p["wq"]).reshape(*x.shape[:-1], c.n_head, c.head_dim)
+        k = (x @ p["wk"]).reshape(*x.shape[:-1], c.n_kv_head, c.head_dim)
+        v = (x @ p["wv"]).reshape(*x.shape[:-1], c.n_kv_head, c.head_dim)
+        return q, k, v
+
+    def _mlp(self, p, h):
+        c = self.cfg
+        x = _rms_norm(h, p["mlp_norm"], c.rms_eps)
+        return h + (jax.nn.silu(x @ p["w_gate"])
+                    * (x @ p["w_up"])) @ p["w_down"]
+
+    def _lm_head(self, params, h):
+        c = self.cfg
+        h = _rms_norm(h, params["final_norm"], c.rms_eps)
+        head = (params["embed"].T if c.tie_embeddings
+                else params["head"])
+        return h @ head.astype(h.dtype)
+
+    def _decode_fn(self, params, kc, vc, tokens, block_tables, positions):
+        """One token for every slot. ``tokens`` (S,) int32 — the last
+        emitted token per slot; ``positions`` (S,) — tokens already
+        resident in the cache for that sequence (the incoming token's
+        K/V are written at exactly this index). Returns greedy next
+        tokens and the updated caches."""
+        c = self.cfg
+        S = self.num_slots
+        h = jnp.take(params["embed"], tokens, axis=0)        # (S, hidden)
+        cos = jnp.take(self._cos, positions, axis=0)          # (S, D/2)
+        sin = jnp.take(self._sin, positions, axis=0)
+        blk = jnp.take_along_axis(
+            block_tables, (positions // self.block_size)[:, None],
+            axis=1)[:, 0]                                     # (S,)
+        off = positions % self.block_size
+        scale = 1.0 / float(c.head_dim) ** 0.5
+        group = c.n_head // c.n_kv_head
+        ctx = self.max_blocks_per_seq * self.block_size
+        t_idx = jnp.arange(ctx)[None, :]                      # (1, ctx)
+        live = t_idx <= positions[:, None]                    # (S, ctx)
+
+        def layer(h, xs):
+            p, kcl, vcl = xs
+            x = _rms_norm(h, p["attn_norm"], c.rms_eps)
+            q, k, v = self._attn_proj(p, x)
+            # rope at each slot's own position (per-slot angle rows)
+            q = _rope_rows(q, cos, sin)
+            k = _rope_rows(k, cos, sin)
+            # write this token's k/v through the block table, THEN
+            # gather — the token attends to itself like any other
+            kcl = kcl.at[blk, off].set(k)
+            vcl = vcl.at[blk, off].set(v)
+            keys = kcl[block_tables].reshape(
+                S, ctx, c.n_kv_head, c.head_dim)
+            vals = vcl[block_tables].reshape(
+                S, ctx, c.n_kv_head, c.head_dim)
+            qg = q.reshape(S, c.n_kv_head, group, c.head_dim)
+            s = jnp.einsum("skgd,stkd->skgt", qg, keys).astype(
+                jnp.float32) * scale
+            s = jnp.where(live[:, None, None, :], s,
+                          jnp.finfo(jnp.float32).min)
+            probs = jax.nn.softmax(s, axis=-1).astype(vals.dtype)
+            o = jnp.einsum("skgt,stkd->skgd", probs, vals).reshape(
+                S, c.n_head * c.head_dim)
+            h = h + o @ p["wo"]
+            return self._mlp(p, h), (kcl, vcl)
+
+        h, (kc, vc) = jax.lax.scan(layer, h, (params["blocks"], kc, vc))
+        logits = self._lm_head(params, h)                     # (S, vocab)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), kc, vc
+
+    def _prefill_fn(self, params, kc, vc, ids, length, block_table):
+        """Causal forward over one padded prompt (1, L_bucket): scatter
+        the prompt's K/V into the paged cache and return the greedy
+        first generated token. ``length`` is the true prompt length
+        (dynamic); pad positions write to the trash block and are never
+        attended by real tokens (they sit in the causal future)."""
+        c = self.cfg
+        L = ids.shape[1]
+        pos = jnp.arange(L)
+        cos, sin = self._cos[:L], self._sin[:L]
+        # pad positions → trash block 0 (their k/v must not land in the
+        # sequence's real blocks: block ``pos // bs`` may be unallocated
+        # past the prompt's last block)
+        blk = jnp.where(pos < length,
+                        block_table[pos // self.block_size], 0)
+        off = pos % self.block_size
+        impl = resolve_attention_impl("auto", L)
+
+        def layer(h, xs):
+            p, kcl, vcl = xs
+            x = _rms_norm(h, p["attn_norm"], c.rms_eps)
+            q, k, v = self._attn_proj(p, x)                   # (1,L,H,D)
+            q = apply_rope(q.transpose(0, 2, 1, 3), cos, sin)
+            k = apply_rope(k.transpose(0, 2, 1, 3), cos, sin)
+            v = v.transpose(0, 2, 1, 3)
+            a = dot_product_attention(q, k, v, causal=True, impl=impl)
+            a = a.transpose(0, 2, 1, 3).reshape(1, L,
+                                                c.n_head * c.head_dim)
+            h = h + a @ p["wo"]
+            kcl = kcl.at[blk, off].set(k.transpose(0, 2, 1, 3)[0])
+            vcl = vcl.at[blk, off].set(v.transpose(0, 2, 1, 3)[0])
+            return self._mlp(p, h), (kcl, vcl)
+
+        h = jnp.take(params["embed"], ids.astype(jnp.int32), axis=0)
+        h, (kc, vc) = jax.lax.scan(layer, h, (params["blocks"], kc, vc))
+        logits = self._lm_head(params, h)                  # (1, L, vocab)
+        last = jnp.take(logits[0], length - 1, axis=0)     # (vocab,)
+        return jnp.argmax(last).astype(jnp.int32), kc, vc
+
+    # -- host-facing API (what the engine calls) ---------------------------
+    def prefill(self, prompt: np.ndarray,
+                block_table_row: np.ndarray) -> int:
+        """Run one prompt through its bucket executable; the prompt's
+        K/V land in the blocks listed in ``block_table_row``. Returns
+        the first generated token."""
+        n = int(prompt.shape[0])
+        bucket = _pick_bucket(self.prefill_buckets, n)
+        if bucket is None:
+            raise ValueError(
+                f"prompt of {n} tokens exceeds the largest prefill "
+                f"bucket ({self.prefill_buckets[-1]})")
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :n] = prompt
+        bt = np.asarray(block_table_row, np.int32)
+        if bt.shape != (self.max_blocks_per_seq,):
+            raise ValueError("block_table_row has the wrong width")
+        with self._lock:
+            tok, self._kc, self._vc = self._prefill(
+                self.params, self._kc, self._vc, jnp.asarray(ids),
+                jnp.int32(n), jnp.asarray(bt))
+            return int(tok)
+
+    def decode(self, tokens: np.ndarray, block_tables: np.ndarray,
+               positions: np.ndarray) -> np.ndarray:
+        """One continuous-batching iteration over every slot (the ONE
+        fixed-shape call). All three operands are (S,...)-shaped
+        regardless of how many slots are live."""
+        with self._lock:
+            out, self._kc, self._vc = self._decode(
+                self.params, self._kc, self._vc,
+                jnp.asarray(tokens, jnp.int32),
+                jnp.asarray(block_tables, jnp.int32),
+                jnp.asarray(positions, jnp.int32))
+            return np.asarray(out)
+
+    def compile_counts(self) -> dict:
+        """Executable counts per compiled function — the no-recompile
+        guarantee is asserted against these (decode must stay at 1
+        after warmup; prefill at <= len(buckets))."""
+        def size(fn):
+            try:
+                return int(fn._cache_size())
+            except Exception:  # noqa: BLE001 — private API moved
+                return -1
+        return {"decode": size(self._decode),
+                "prefill": size(self._prefill)}
+
+
+def _rope_rows(x: jnp.ndarray, cos: jnp.ndarray,
+               sin: jnp.ndarray) -> jnp.ndarray:
+    """Rotate (S, H, D) by per-ROW angles (S, D/2) — the decode-step
+    variant of :func:`apply_rope`, where every slot sits at its own
+    position instead of sharing a 0..T ramp."""
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2], x[..., d2:]
+    c = cos[:, None, :].astype(x.dtype)
+    s = sin[:, None, :].astype(x.dtype)
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)

@@ -1,0 +1,128 @@
+"""``llama:...`` model specs: mount the LLM engine behind a replica.
+
+The HA layer launches replicas from a model STRING (``ReplicaGroup``
+passes ``--model`` through to ``zoo_tpu.serving.replica``); this module
+is the llm half of that resolution:
+
+* ``llama:tiny`` — the test-topology config (``tiny_llama_config``),
+  deterministic weights from seed 0: every replica of a group builds
+  bit-identical params, which is what makes greedy decode reproducible
+  across the group and the HA client's mid-stream failover-with-resume
+  seamless.
+* ``llama:tiny:seed=3,slots=4,block=8,blocks=64,buckets=16/64`` —
+  key=value overrides after the preset.
+* ``llama:vocab=256,hidden=64,n_block=2,n_head=4,n_kv_head=2,``
+  ``intermediate=128`` — explicit architecture, no preset.
+
+Engine knobs resolve env (``ZOO_LLM_*``) < spec < explicit kwargs —
+the env is the deployment-wide default, an explicit spec component
+overrides it; the env names are documented in docs/llm_serving.md.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Tuple
+
+LLM_PREFIX = "llama:"
+
+_ARCH_KEYS = ("vocab", "hidden", "n_block", "n_head", "n_kv_head",
+              "intermediate")
+_ENGINE_KEYS = {"slots": "num_slots", "block": "block_size",
+                "blocks": "num_blocks", "tables": "max_blocks_per_seq",
+                "seed": "seed", "eos": "eos_id"}
+
+
+def is_llm_spec(spec) -> bool:
+    return isinstance(spec, str) and spec.startswith(LLM_PREFIX)
+
+
+def _parse_kv(parts) -> Dict[str, str]:
+    out = {}
+    for part in parts:
+        for kv in part.split(","):
+            if not kv:
+                continue
+            if "=" not in kv:
+                raise ValueError(
+                    f"malformed llama spec component {kv!r} "
+                    "(expected key=value)")
+            k, v = kv.split("=", 1)
+            out[k.strip()] = v.strip()
+    return out
+
+
+def parse_llm_spec(spec: str) -> Tuple[Dict, Dict]:
+    """``(config_kwargs, engine_kwargs)`` from a ``llama:...`` spec."""
+    if not is_llm_spec(spec):
+        raise ValueError(f"not an llm spec: {spec!r}")
+    body = spec[len(LLM_PREFIX):]
+    parts = body.split(":") if body else [""]
+    preset = parts[0] if parts[0] and "=" not in parts[0] else None
+    kvs = _parse_kv(parts[1:] if preset else parts)
+
+    cfg_kwargs: Dict = {}
+    if preset == "tiny" or preset is None and not any(
+            k in kvs for k in _ARCH_KEYS):
+        from zoo_tpu.models.llm.llama import tiny_llama_config
+        cfg_kwargs = dict(tiny_llama_config().__dict__)
+        cfg_kwargs.pop("tie_embeddings", None)
+    elif preset is not None and preset != "tiny":
+        raise ValueError(f"unknown llama preset {preset!r} "
+                         "(supported: tiny, or explicit key=value dims)")
+    for k in _ARCH_KEYS:
+        if k in kvs:
+            cfg_kwargs[k] = int(kvs.pop(k))
+
+    eng: Dict = {}
+    for short, name in _ENGINE_KEYS.items():
+        if short in kvs:
+            eng[name] = int(kvs.pop(short))
+    if "buckets" in kvs:
+        eng["prefill_buckets"] = tuple(
+            int(b) for b in kvs.pop("buckets").split("/"))
+    if kvs:
+        raise ValueError(f"unknown llama spec keys {sorted(kvs)}")
+    return cfg_kwargs, eng
+
+
+def _env_engine_defaults() -> Dict:
+    """ZOO_LLM_* env knobs (the per-replica deployment surface — a
+    ReplicaGroup passes env to every replica it spawns)."""
+    out: Dict = {}
+    pairs = (("ZOO_LLM_SLOTS", "num_slots"),
+             ("ZOO_LLM_BLOCK_SIZE", "block_size"),
+             ("ZOO_LLM_KV_BLOCKS", "num_blocks"),
+             ("ZOO_LLM_MAX_BLOCKS_PER_SEQ", "max_blocks_per_seq"),
+             ("ZOO_LLM_SEED", "seed"),
+             ("ZOO_LLM_EOS", "eos_id"))
+    for env, name in pairs:
+        v = os.environ.get(env)
+        if v:
+            out[name] = int(v)
+    v = os.environ.get("ZOO_LLM_PREFILL_BUCKETS")
+    if v:
+        out["prefill_buckets"] = tuple(int(b) for b in v.split("/"))
+    return out
+
+
+def build_llm_engine(spec: str, mode: Optional[str] = None,
+                     start: bool = True, **overrides):
+    """An :class:`LLMEngine` (started unless ``start=False``) from a
+    ``llama:...`` spec. ``overrides`` are engine/model kwargs that win
+    over both the spec and the env."""
+    from zoo_tpu.models.llm.llama import LlamaConfig
+    from zoo_tpu.serving.llm.engine import LLMEngine
+    from zoo_tpu.serving.llm.model import PagedLlamaModel
+
+    cfg_kwargs, eng_kwargs = parse_llm_spec(spec)
+    merged = dict(_env_engine_defaults())
+    merged.update(eng_kwargs)
+    merged.update({k: v for k, v in overrides.items()
+                   if k not in ("mode", "max_waiting")})
+    cfg = LlamaConfig(**cfg_kwargs)
+    model = PagedLlamaModel(cfg, **merged)
+    mode = mode or os.environ.get("ZOO_LLM_MODE", "continuous")
+    engine = LLMEngine(model, mode=mode,
+                       max_waiting=overrides.get("max_waiting"))
+    return engine.start() if start else engine

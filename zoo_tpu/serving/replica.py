@@ -27,10 +27,19 @@ def serve_replica(ns) -> int:
     )
 
     start_heartbeat_thread()  # no-op unless the supervisor set the env
-    model = load_serving_model(ns.model, batch_size=ns.batch_size)
+    from zoo_tpu.serving.llm.spec import is_llm_spec
+    model = engine = None
+    if is_llm_spec(ns.model):
+        # llm replica: the paged-KV continuous-batching engine behind
+        # the same TCP door (docs/llm_serving.md); the predict path is
+        # not mounted — generate is the only inference op
+        from zoo_tpu.serving.llm.spec import build_llm_engine
+        engine = build_llm_engine(ns.model)
+    else:
+        model = load_serving_model(ns.model, batch_size=ns.batch_size)
     server = ServingServer(
         model, host=ns.host, port=ns.port, batch_size=ns.batch_size,
-        max_wait_ms=ns.max_wait_ms,
+        max_wait_ms=ns.max_wait_ms, llm_engine=engine,
         breaker=CircuitBreaker(failure_threshold=5,
                                recovery_timeout=5.0)).start()
     exporter = None

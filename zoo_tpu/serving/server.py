@@ -176,7 +176,8 @@ class ServingServer:
                  max_queue: Optional[int] = None,
                  request_timeout: Optional[float] = None,
                  handshake_timeout: Optional[float] = None,
-                 dedup_cache: Optional[int] = None):
+                 dedup_cache: Optional[int] = None,
+                 llm_engine=None):
         """``certfile``/``keyfile``: serve over TLS — the trusted-
         serving door of the reference's PPML trusted-realtime-ml story
         (``ppml/trusted-realtime-ml/``: encrypted transport in front of
@@ -199,8 +200,18 @@ class ServingServer:
         when the client propagated NO deadline (requests that carry
         ``deadline_ms`` use the deadline itself). ``handshake_timeout``
         bounds the TLS handshake. ``dedup_cache`` sizes the request-id
-        LRU that makes client retries/hedges idempotent (0 = off)."""
+        LRU that makes client retries/hedges idempotent (0 = off).
+
+        ``llm_engine``: an :class:`zoo_tpu.serving.llm.LLMEngine`
+        mounted on this door — adds the streaming ``generate`` op
+        (docs/llm_serving.md) next to ``predict``. ``model`` may be
+        ``None`` for an llm-only replica (the batcher threads are then
+        not started and ``predict`` answers with a routing error)."""
         self.model = model
+        self.llm_engine = llm_engine
+        if model is None and llm_engine is None:
+            raise ValueError("ServingServer needs a model, an "
+                             "llm_engine, or both")
         self.breaker = breaker
         self.max_queue = max_queue if max_queue is not None else \
             env_int("ZOO_SERVE_MAX_QUEUE", 1024)
@@ -211,8 +222,9 @@ class ServingServer:
         cap = dedup_cache if dedup_cache is not None else \
             env_int("ZOO_SERVE_DEDUP_CACHE", 1024)
         self._dedup_cache = _DedupCache(cap) if cap > 0 else None
-        self._replicas = list(models) if models else \
+        self._replicas = list(models) if models else (
             [model] * max(1, int(num_replicas))
+            if model is not None else [])
         self.batch_size = batch_size
         self.max_wait_ms = max_wait_ms
         self._ssl_ctx = None
@@ -330,6 +342,13 @@ class ServingServer:
 
             def _handle_predict(self, msg):
                 rid = msg.get("id")
+                if outer.model is None:
+                    _requests.labels(outcome="error").inc()
+                    self._reply(msg, {
+                        "error": "this replica serves the llm "
+                                 "generate op only (no predict "
+                                 "model mounted)"})
+                    return
                 deadline = Deadline.from_ms(msg.get("deadline_ms"))
                 # 1. idempotency: a duplicate id (client retry after a
                 # mid-RPC reset, or a hedge landing on the same replica)
@@ -410,6 +429,134 @@ class ServingServer:
                 self._await_and_reply(msg, req, deadline)
                 outer.timers["total"].record(time.perf_counter() - t0)
 
+            def _handle_generate(self, msg):
+                """Streaming autoregressive generation
+                (docs/llm_serving.md wire format): the reply is a
+                SEQUENCE of frames on this connection — ``{id, seq,
+                tokens: [...]}`` chunks as the engine emits them, then
+                one terminal ``{id, done: true, outcome, n_tokens}``.
+                ``resume_from`` skips the first N generated tokens
+                (the HA client's failover-resume: decode is greedy and
+                deterministic, so a fresh replica regenerates the same
+                stream and only the unseen suffix goes on the wire)."""
+                eng = outer.llm_engine
+                rid = msg.get("id")
+                deadline = Deadline.from_ms(msg.get("deadline_ms"))
+                if eng is None:
+                    self._reply(msg, {
+                        "done": True, "outcome": "error",
+                        "error": "no llm engine mounted on this "
+                                 "replica (generate needs a "
+                                 "llama:* model spec)"})
+                    return
+                if outer.breaker is not None and \
+                        not outer.breaker.allow():
+                    _requests.labels(outcome="shed").inc()
+                    _shed.labels(reason="breaker_open").inc()
+                    self._reply(msg, {
+                        "shed": True, "retryable": True,
+                        "error": "server shedding load (circuit open)"})
+                    return
+                if outer._draining.is_set():
+                    _requests.labels(outcome="shed").inc()
+                    _shed.labels(reason="draining").inc()
+                    self._reply(msg, {
+                        "shed": True, "draining": True,
+                        "retryable": True,
+                        "error": "server draining; retry another "
+                                 "replica"})
+                    return
+                if deadline is not None and deadline.expired():
+                    _requests.labels(outcome="expired").inc()
+                    _deadline_expired.labels(stage="admission").inc()
+                    self._reply(msg, {
+                        "done": True, "outcome": "expired",
+                        "expired": True,
+                        "error": "deadline expired before admission"})
+                    return
+                from zoo_tpu.serving.llm.engine import AdmissionError
+                try:
+                    h = eng.submit(
+                        np.asarray(msg["prompt"]),
+                        int(msg.get("max_new_tokens", 16)),
+                        rid=rid, deadline=deadline)
+                except AdmissionError as e:
+                    _requests.labels(outcome="shed").inc()
+                    _shed.labels(reason="queue_full").inc()
+                    self._reply(msg, {
+                        "shed": True, "retryable": True,
+                        "retry_after_ms": e.retry_after_ms,
+                        "error": str(e)})
+                    return
+                except (ValueError, KeyError) as e:
+                    _requests.labels(outcome="error").inc()
+                    self._reply(msg, {"done": True, "outcome": "error",
+                                      "error": repr(e)})
+                    return
+                cursor = max(0, int(msg.get("resume_from") or 0))
+                seq = 0
+                h.subscribe()
+                completed = False
+                try:
+                    last_progress = time.monotonic()
+                    while True:
+                        toks, done = h.wait_new(cursor, 0.25)
+                        if toks:
+                            cursor += len(toks)
+                            last_progress = time.monotonic()
+                            if not done:
+                                self._reply(msg, {"seq": seq,
+                                                  "tokens": toks,
+                                                  "done": False})
+                                seq += 1
+                                continue
+                        if done:
+                            out = {"seq": seq, "done": True,
+                                   "outcome": h.outcome,
+                                   "tokens": toks,
+                                   "n_tokens": len(h.tokens)}
+                            if h.truncated:
+                                out["truncated"] = True
+                            if h.outcome == "expired":
+                                out["expired"] = True
+                                _requests.labels(
+                                    outcome="expired").inc()
+                                _deadline_expired.labels(
+                                    stage="stream").inc()
+                            elif h.outcome == "ok":
+                                _requests.labels(outcome="ok").inc()
+                            else:
+                                _requests.labels(outcome="error").inc()
+                            if h.error:
+                                out["error"] = h.error
+                            self._reply(msg, out)
+                            completed = True
+                            return
+                        # no progress: enforce the no-deadline reply
+                        # bound (a deadline-carrying stream is expired
+                        # by the engine itself)
+                        if deadline is None and time.monotonic() - \
+                                last_progress > outer.request_timeout:
+                            _requests.labels(outcome="error").inc()
+                            self._reply(msg, {
+                                "seq": seq, "done": True,
+                                "outcome": "error",
+                                "error": "no tokens within "
+                                         "$ZOO_SERVE_REQUEST_TIMEOUT "
+                                         f"={outer.request_timeout:g}s"})
+                            return
+                except OSError:
+                    # client went away mid-stream; fall through to the
+                    # unsubscribe cleanup and stop pushing frames
+                    pass
+                finally:
+                    if h.unsubscribe() <= 0 and not h.done \
+                            and not completed:
+                        # last reader gone with the stream still
+                        # decoding: cancel so its KV blocks free NOW,
+                        # not at max_new_tokens
+                        eng.cancel(h.id)
+
             def handle(self):
                 while True:
                     msg = _recv_msg(self.request)
@@ -417,6 +564,13 @@ class ServingServer:
                         return
                     if msg.get("op") == "predict":
                         self._handle_predict(msg)
+                    elif msg.get("op") == "generate":
+                        self._handle_generate(msg)
+                    elif msg.get("op") == "llm_stats":
+                        eng = outer.llm_engine
+                        self._reply(msg, {"stats": eng.stats()}
+                                    if eng is not None else
+                                    {"error": "no llm engine"})
                     elif msg.get("op") == "stats":
                         self._reply(msg, {k: t.stats()
                                           for k, t in outer.timers.items()})
@@ -627,5 +781,9 @@ class ServingServer:
 
     def stop(self):
         self._stop.set()
+        if self.llm_engine is not None:
+            # cancels live streams and returns every KV block to the
+            # free list before the door closes
+            self.llm_engine.stop()
         self._server.shutdown()
         self._server.server_close()

@@ -119,6 +119,51 @@ class _Connection:
                 self._drop()  # poisoned stream: next attempt re-dials
                 raise
 
+    def stream(self, msg: Dict,
+               deadline: Optional[Deadline] = None,
+               idle_timeout: float = 120.0):
+        """One request, MANY response frames (the llm ``generate`` op):
+        yields each frame until a terminal one (``done`` / ``shed`` /
+        bare ``error``). No transparent retry — a broken stream raises
+        and the HA layer resumes on another replica with
+        ``resume_from``. ``idle_timeout`` bounds the gap BETWEEN frames
+        when no deadline was propagated."""
+        fault_point("serving.request", op=msg.get("op"))
+        with self._lock:
+            if deadline is not None and deadline.expired():
+                raise DeadlineExceeded(
+                    "stream deadline expired before send")
+            if self._sock is None:
+                self._open()
+            try:
+                if deadline is not None:
+                    msg["deadline_ms"] = deadline.remaining_ms()
+                    self._sock.settimeout(deadline.remaining() + 0.25)
+                else:
+                    self._sock.settimeout(idle_timeout)
+                _send_msg(self._sock, msg)
+                fault_point("serving.client.recv", id=msg.get("id"))
+                while True:
+                    if deadline is not None:
+                        self._sock.settimeout(
+                            max(0.0, deadline.remaining()) + 0.25)
+                    resp = _recv_msg(self._sock)
+                    if resp is None:
+                        self._drop()
+                        raise ConnectionError(
+                            "serving connection closed mid-stream")
+                    rid = msg.get("id")
+                    if rid is not None and \
+                            resp.get("id") not in (None, rid):
+                        continue  # stale frame from a prior request
+                    yield resp
+                    if resp.get("done") or resp.get("shed") or (
+                            "error" in resp and "seq" not in resp):
+                        return
+            except OSError:
+                self._drop()
+                raise
+
     def rpc(self, msg: Dict,
             deadline: Optional[Deadline] = None) -> Dict:
         # own copy: the auto-stamped id (and per-attempt deadline_ms)

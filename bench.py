@@ -635,6 +635,58 @@ def bench_guard(extra, n=16384, feat=64, batch_size=512, epochs=3, reps=3):
     extra["guard_overhead_pct"] = round((u50 / g50 - 1.0) * 100, 2)
 
 
+def bench_fused_optim(extra, n=16384, feat=64, batch_size=512, epochs=3,
+                      reps=3):
+    """Fused-optimizer A/B (ROADMAP item 4 foothold, behind
+    ``ZOO_FUSED_OPTIM`` in production): the same MLP fit with AdamW on
+    the optax path versus the direct-apply fused path
+    (``ops/pallas/fused_optim.py`` — one VMEM-resident elementwise pass
+    per shard on TPU; Pallas-interpret / the partitionable elementwise
+    reference off-TPU and on a >1-device mesh, so the fallback is clean
+    everywhere and this row measures whatever path a deployment would
+    actually take). ``fused_optim_speedup`` > 1 is the win condition on
+    real hardware; on the CPU rig the row exists to catch regressions
+    and to prove the A/B runs."""
+    from zoo_tpu.pipeline.api.keras import Sequential
+    from zoo_tpu.pipeline.api.keras.layers import Dense
+    from zoo_tpu.pipeline.api.keras.optimizers import AdamWeightDecay
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(n, feat).astype(np.float32)
+    y = (x @ rs.randn(feat, 1)).astype(np.float32)
+
+    def build(fused):
+        m = Sequential()
+        m.add(Dense(256, input_shape=(feat,), activation="relu"))
+        m.add(Dense(256, activation="relu"))
+        m.add(Dense(1))
+        m.compile(optimizer=AdamWeightDecay(lr=1e-3, fused=fused),
+                  loss="mse")
+        m.fit(x, y, batch_size=batch_size, nb_epoch=1, shuffle=False,
+              verbose=0)  # warm the jit cache
+        return m
+
+    mo, mf = build(False), build(True)
+    optax_r, fused_r = [], []
+    for _ in range(reps):  # interleaved A/B: same chip window
+        t0 = time.perf_counter()
+        mo.fit(x, y, batch_size=batch_size, nb_epoch=epochs,
+               shuffle=False, verbose=0)
+        optax_r.append(n * epochs / (time.perf_counter() - t0))
+        t0 = time.perf_counter()
+        mf.fit(x, y, batch_size=batch_size, nb_epoch=epochs,
+               shuffle=False, verbose=0)
+        fused_r.append(n * epochs / (time.perf_counter() - t0))
+    (o50, osp), (f50, fsp) = _stats(optax_r), _stats(fused_r)
+    from zoo_tpu.ops.pallas import on_tpu
+    extra["fused_optim_optax_samples_per_sec"] = round(o50, 1)
+    extra["fused_optim_optax_spread"] = round(osp, 3)
+    extra["fused_optim_samples_per_sec"] = round(f50, 1)
+    extra["fused_optim_spread"] = round(fsp, 3)
+    extra["fused_optim_speedup"] = round(f50 / o50, 3)
+    extra["fused_optim_path"] = "pallas" if on_tpu() else "interpret"
+
+
 def bench_serving(extra, n_requests=200, clients=8, feat=64):
     """Hermetic serving numbers (VERDICT r4 #7): an MLP behind the TCP
     micro-batcher on loopback, ``clients`` concurrent connections; p50 /
@@ -997,6 +1049,10 @@ def main():
             bench_guard(extra)
         except Exception as e:  # noqa: BLE001
             extra["guard_error"] = repr(e)
+        try:
+            bench_fused_optim(extra)
+        except Exception as e:  # noqa: BLE001
+            extra["fused_optim_error"] = repr(e)
         try:
             (f_p50, f_sp), (q_p50, q_sp) = bench_resnet50_int8_infer()
             extra["resnet50_infer_samples_per_sec"] = round(f_p50, 1)

@@ -672,3 +672,58 @@ def test_check_llm_serving_script_runs():
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "LLM SERVING OK" in proc.stdout
+
+
+# -------------------------------------------------- tensor-parallel (mesh)
+
+class TestTensorParallel:
+    """mesh= support on PagedLlamaModel (docs/multichip.md): one set of
+    weights + one paged KV cache span the mesh's model axis. The full
+    token-identity acceptance check runs in scripts/check_multichip.py
+    (multichip marker); these are the cheap unit guarantees."""
+
+    def test_spec_parses_tp_knob(self):
+        _, eng = parse_llm_spec("llama:tiny:tp=2,slots=4")
+        assert eng["tp"] == 2 and eng["num_slots"] == 4
+
+    def test_env_tp_knob(self, monkeypatch):
+        from zoo_tpu.serving.llm.spec import _env_engine_defaults
+        monkeypatch.setenv("ZOO_LLM_TP", "2")
+        assert _env_engine_defaults()["tp"] == 2
+
+    def test_kv_head_divisibility_enforced(self):
+        """tiny config has n_kv_head=2: tp=3 cannot shard the KV cache
+        on the heads axis and must refuse loudly at construction (not
+        at first decode)."""
+        import jax
+
+        from zoo_tpu.models.llm.llama import tiny_llama_config
+        from zoo_tpu.parallel import build_mesh
+        from zoo_tpu.serving.llm.model import PagedLlamaModel
+
+        if len(jax.devices()) < 3:
+            pytest.skip("needs >= 3 devices")
+        mesh = build_mesh(jax.devices()[:3], axis_sizes={"model": 3})
+        with pytest.raises(ValueError, match="n_kv_head"):
+            PagedLlamaModel(tiny_llama_config(), mesh=mesh)
+
+    def test_tp_spec_needs_enough_devices(self, monkeypatch):
+        import jax
+
+        from zoo_tpu.serving.llm.spec import build_llm_engine
+        n = len(jax.devices())
+        with pytest.raises(ValueError, match="only"):
+            build_llm_engine(f"llama:tiny:tp={n * 2}", start=False)
+
+    def test_single_device_mesh_is_ignored(self):
+        """mesh over one device (or size-1 model axis) degrades to the
+        plain single-device layout — tp reported as 1."""
+        import jax
+
+        from zoo_tpu.models.llm.llama import tiny_llama_config
+        from zoo_tpu.parallel import build_mesh
+        from zoo_tpu.serving.llm.model import PagedLlamaModel
+
+        mesh = build_mesh(jax.devices()[:1], axis_sizes={"data": 1})
+        m = PagedLlamaModel(tiny_llama_config(), num_blocks=8, mesh=mesh)
+        assert m.mesh is None and m.tp == 1

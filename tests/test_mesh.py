@@ -65,6 +65,68 @@ def test_validate_batch_size():
         validate_batch_size(12, mesh)
 
 
+def test_validate_batch_size_error_text():
+    """The error must say WHAT must divide by WHAT — it is the first
+    thing a user hits moving a single-chip script to a mesh."""
+    mesh = build_mesh(axis_sizes={"data": 2, "fsdp": 4})
+    with pytest.raises(ValueError,
+                       match=r"batch_size \(12\) must be divisible by "
+                             r"the number of data-parallel shards \(8\)"):
+        validate_batch_size(12, mesh)
+
+
+def test_factor_shape_edge_cases():
+    """Mesh factoring at the world sizes the elastic path actually
+    visits (8 → 6 → 1): wildcard absorption, full coverage checks, and
+    the error modes."""
+    from zoo_tpu.parallel.mesh import _factor_shape
+
+    axes = ("data", "fsdp", "model")
+    # 1 device: everything collapses to 1s
+    assert _factor_shape(1, {"data": -1}, axes) == (1, 1, 1)
+    assert _factor_shape(1, {}, axes) == (1, 1, 1)
+    # 6 devices (a scale-down world size): wildcard absorbs the rest
+    assert _factor_shape(6, {"data": -1, "model": 2}, axes) == (3, 1, 2)
+    assert _factor_shape(6, {"data": 6}, axes) == (6, 1, 1)
+    # 8 devices, fully explicit
+    assert _factor_shape(8, {"data": 2, "fsdp": 2, "model": 2},
+                         axes) == (2, 2, 2)
+    with pytest.raises(ValueError, match="not divisible"):
+        _factor_shape(6, {"data": 4}, axes)
+    with pytest.raises(ValueError, match="cover 3 devices but 6"):
+        _factor_shape(6, {"data": 3}, ("data",))
+    with pytest.raises(ValueError, match="only one mesh axis may be -1"):
+        _factor_shape(8, {"data": -1, "fsdp": -1}, axes)
+    with pytest.raises(ValueError, match="positive size"):
+        _factor_shape(8, {"data": 0}, axes)
+
+
+def test_pick_divisible_dim_fallback_to_replication():
+    """Nothing divides → None → the plan replicates instead of erroring
+    (odd embedding vocab on an even mesh is a real case)."""
+    from zoo_tpu.parallel.mesh import pick_divisible_dim
+
+    assert pick_divisible_dim((7, 5), 4) is None
+    assert pick_divisible_dim((12, 8), 4) == 0       # largest divisible
+    assert pick_divisible_dim((12, 8), 4, taken=(0,)) == 1
+    assert pick_divisible_dim((12, 7), 4, taken=(0,)) is None
+    assert pick_divisible_dim((), 4) is None
+    s = fsdp_param_sharding(build_mesh(axis_sizes={"fsdp": 8}), (7, 5))
+    assert s.spec == P()
+
+
+def test_mesh_axes_from_env(monkeypatch):
+    from zoo_tpu.parallel.mesh import mesh_axes_from_env
+
+    monkeypatch.delenv("ZOO_MESH_DATA", raising=False)
+    assert mesh_axes_from_env() is None
+    monkeypatch.setenv("ZOO_MESH_FSDP", "4")
+    monkeypatch.setenv("ZOO_MESH_DATA", "-1")
+    assert mesh_axes_from_env() == {"data": -1, "fsdp": 4}
+    mesh = build_mesh(axis_sizes=mesh_axes_from_env())
+    assert mesh.shape["fsdp"] == 4 and mesh.shape["data"] == 2
+
+
 def test_psum_over_mesh_collective():
     """Real allreduce over the virtual mesh via shard_map — the rebuild's
     equivalent of the reference's DistriEstimatorSpec on local[4]."""

@@ -464,6 +464,12 @@ def test_metrics_end_to_end_serving_fit_checkpoint(orca_ctx, tmp_path):
             'zoo_serve_hedge_total{event="won"}',
             "zoo_llm_kv_blocks_used 4",
             "zoo_llm_kv_blocks_free 12",
+            # the GSPMD layer (docs/multichip.md): the fixture's 8-device
+            # mesh publishes its axis sizes, and the fit above ran DP
+            # over it, so the plan's estimated grad all-reduce bytes
+            # accumulated per executed step
+            'zoo_mesh_axis_size{axis="data"}',
+            'zoo_mesh_collective_bytes_total{op="all_reduce"}',
     ):
         assert needle in text, f"/metrics is missing {needle}"
     # the fit really recorded step phases (count > 0, not just a family)
@@ -473,3 +479,10 @@ def test_metrics_end_to_end_serving_fit_checkpoint(orca_ctx, tmp_path):
             break
     else:
         raise AssertionError("no step-phase count sample")
+    # the mesh gauges/counters carry real values, not just families
+    for line in text.splitlines():
+        if line.startswith('zoo_mesh_axis_size{axis="data"}'):
+            assert float(line.rsplit(" ", 1)[1]) == 8.0
+        if line.startswith('zoo_mesh_collective_bytes_total'
+                           '{op="all_reduce"}'):
+            assert float(line.rsplit(" ", 1)[1]) > 0

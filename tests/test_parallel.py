@@ -154,3 +154,87 @@ def test_ring_attention_gradients_match_dense():
     for a, b in zip(g_ring, g_dense):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-4)
+
+
+def test_plan_registry_transformer_pairing():
+    """The name-aware megatron pairing (plans.py registry): column into
+    the heads, row back out — for both llama and BERT/GPT leaf names —
+    with fsdp layered on a remaining dim."""
+    from zoo_tpu.parallel.plans import named_leaf_sharding
+
+    mesh = build_mesh(axis_sizes={"data": 2, "fsdp": 2, "model": 2})
+    col = {"wq", "wk", "wv", "w_gate", "w_up", "qkv_w", "fc1_w"}
+    row = {"wo", "w_down", "proj_w", "fc2_w"}
+    for name in col:
+        s = named_leaf_sharding(mesh, f"blocks/{name}", (4, 16, 16))
+        assert s.spec[-1] == "model", (name, s.spec)
+    for name in row:
+        s = named_leaf_sharding(mesh, f"blocks/{name}", (4, 16, 16))
+        assert s.spec[-2] == "model", (name, s.spec)
+        assert "fsdp" in str(s.spec)  # fsdp still shards a free dim
+    # unknown names keep the shape-based default exactly
+    from zoo_tpu.parallel.plans import leaf_sharding
+    assert named_leaf_sharding(mesh, "embed", (64, 16)).spec == \
+        leaf_sharding(mesh, (64, 16)).spec
+    # non-divisible TP dim: the rule declines, default takes over
+    s = named_leaf_sharding(mesh, "blocks/wo", (4, 7, 16))
+    assert s.spec == leaf_sharding(mesh, (4, 7, 16)).spec
+
+
+def test_plan_registry_explicit_and_unknown():
+    from zoo_tpu.parallel.plans import (
+        get_plan,
+        named_leaf_sharding,
+        register_plan,
+    )
+
+    mesh = build_mesh(axis_sizes={"data": -1, "model": 2})
+    with pytest.raises(KeyError, match="unknown sharding plan"):
+        get_plan("nope")
+
+    @register_plan("test-replicate-all")
+    def _rule(mesh, name, shape):
+        from zoo_tpu.parallel.mesh import replicated_sharding
+        return replicated_sharding(mesh)
+
+    s = named_leaf_sharding(mesh, "blocks/wq", (16, 16),
+                            plan="test-replicate-all")
+    assert s.spec == P()
+
+
+def test_sharding_tree_matches_placement(orca_ctx):
+    """sharding_tree (the jit in/out_shardings input) must agree leaf
+    for leaf with what place_params actually does."""
+    from zoo_tpu.parallel.plans import sharding_tree
+
+    mesh = build_mesh(axis_sizes={"fsdp": 4, "model": 2})
+    params = {"blocks": {"wq": jnp.ones((2, 16, 16)),
+                         "attn_norm": jnp.ones((2, 16))},
+              "embed": jnp.ones((64, 16))}
+    placed = place_params(params, mesh)
+    tree = sharding_tree(params, mesh)
+    flat_p = jax.tree_util.tree_leaves(placed)
+    flat_s = jax.tree_util.tree_leaves(
+        tree, is_leaf=lambda x: hasattr(x, "spec"))
+    for arr, sh in zip(flat_p, flat_s):
+        assert arr.sharding.is_equivalent_to(sh, arr.ndim), (
+            arr.sharding, sh)
+
+
+def test_estimate_collective_bytes():
+    from zoo_tpu.parallel.plans import estimate_collective_bytes
+
+    params = {"w": np.zeros((16, 16), np.float32),   # fsdp-sharded
+              "odd": np.zeros((7, 5), np.float32)}   # replicated
+    mesh = build_mesh(axis_sizes={"data": 2, "fsdp": 4})
+    est = estimate_collective_bytes(params, mesh)
+    wb = 16 * 16 * 4
+    ob = 7 * 5 * 4
+    assert est["all_gather"] == int(2 * wb * 3 / 4)
+    assert est["reduce_scatter"] == int(wb * 3 / 4)
+    assert est["all_reduce"] == int(2 * ob * 1 / 2)
+    # pure DP: no gathers, everything all-reduces
+    dp = estimate_collective_bytes(params, build_mesh(
+        axis_sizes={"data": 8}))
+    assert dp["all_gather"] == 0 and dp["reduce_scatter"] == 0
+    assert dp["all_reduce"] > 0

@@ -106,13 +106,57 @@ def _adam_kernel(lr_ref, b1_ref, b2_ref, eps_ref, wd_ref, bc1_ref, bc2_ref,
     v_out[...] = v
 
 
+def reference_apply_adam(param: jnp.ndarray, grad: jnp.ndarray,
+                         m: jnp.ndarray, v: jnp.ndarray, step,
+                         lr, beta1: float = 0.9, beta2: float = 0.999,
+                         eps: float = 1e-8, weight_decay: float = 0.0
+                         ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """The same AdamW math as :func:`fused_apply_adam`, in plain jnp —
+    the GSPMD-friendly form. A ``pallas_call`` has no SPMD partitioning
+    rule, so inside an FSDP/TP-sharded train step the kernel would force
+    XLA to gather every shard it touches; this elementwise chain
+    partitions trivially (each device updates only its slice) and XLA
+    fuses it into one VMEM pass anyway. ``fused_apply_adam`` dispatches
+    here whenever the active mesh spans more than one device."""
+    step = jnp.asarray(step, jnp.float32)
+    b1, b2 = jnp.float32(beta1), jnp.float32(beta2)
+    p32, g = param.astype(jnp.float32), grad.astype(jnp.float32)
+    m = b1 * m.astype(jnp.float32) + (1.0 - b1) * g
+    v = b2 * v.astype(jnp.float32) + (1.0 - b2) * g * g
+    m_hat = m * (1.0 / (1.0 - b1 ** step))
+    v_hat = v * (1.0 / (1.0 - b2 ** step))
+    update = m_hat / (jnp.sqrt(v_hat) + jnp.float32(eps)) \
+        + jnp.float32(weight_decay) * p32
+    new_p = (p32 - jnp.asarray(lr, jnp.float32) * update).astype(
+        param.dtype)
+    return new_p, m, v
+
+
+def _mesh_active() -> bool:
+    """True when the runtime context's mesh spans >1 device — the
+    sharded-step case where the elementwise reference path must be used
+    (see :func:`reference_apply_adam`)."""
+    from zoo_tpu.common.context import get_runtime_context
+    ctx = get_runtime_context(required=False)
+    return ctx is not None and getattr(ctx.mesh, "size", 1) > 1
+
+
 def fused_apply_adam(param: jnp.ndarray, grad: jnp.ndarray,
                      m: jnp.ndarray, v: jnp.ndarray, step,
                      lr, beta1: float = 0.9, beta2: float = 0.999,
                      eps: float = 1e-8, weight_decay: float = 0.0,
                      interpret: Optional[bool] = None
                      ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """One fused Adam(W) step; returns (param, m, v). ``step`` is 1-based."""
+    """One fused Adam(W) step; returns (param, m, v). ``step`` is 1-based.
+
+    Under a >1-device mesh the update runs as the partitionable
+    elementwise reference chain instead of the Pallas kernel (same math;
+    each device updates its own parameter shard — the reference's
+    "apply optimizer to the aggregated slice in-task" done by GSPMD)."""
+    if _mesh_active():
+        return reference_apply_adam(param, grad, m, v, step, lr,
+                                    beta1=beta1, beta2=beta2, eps=eps,
+                                    weight_decay=weight_decay)
     interpret = _resolve_interpret(interpret)
     step = jnp.asarray(step, jnp.float32)
     bc1 = 1.0 / (1.0 - jnp.float32(beta1) ** step)

@@ -174,10 +174,35 @@ def init_orca_context(cluster_mode: str = "local",
     start_heartbeat_thread()
 
     import jax
-    from zoo_tpu.parallel.mesh import build_mesh
+    from zoo_tpu.parallel.mesh import (
+        build_mesh,
+        mesh_axes_from_env,
+        publish_mesh_metrics,
+    )
 
     devs = list(devices if devices is not None else jax.devices())
+    if mesh_axes is None:
+        # deployment-wide layout knobs (docs/multichip.md): ZOO_MESH_DATA /
+        # ZOO_MESH_FSDP / ZOO_MESH_MODEL / ... choose the parallelism
+        # layout without touching launcher code; an explicit mesh_axes=
+        # argument always wins, and env axes that do not fit this
+        # context's device list (a single-device reference fit, a bench
+        # pinning one chip) fall back to pure DP with a warning instead
+        # of crashing the caller
+        env_axes = mesh_axes_from_env()
+        if env_axes:
+            from zoo_tpu.parallel.mesh import DEFAULT_AXES, _factor_shape
+            try:
+                _factor_shape(len(devs), dict(env_axes),
+                              tuple(axis_names or DEFAULT_AXES))
+                mesh_axes = dict(env_axes)
+            except ValueError as e:
+                logger.warning(
+                    "ZOO_MESH_* axes %s do not fit the %d device(s) of "
+                    "this context (%s); using the data-parallel default",
+                    env_axes, len(devs), e)
     mesh = build_mesh(devs, axis_sizes=mesh_axes, axis_names=axis_names)
+    publish_mesh_metrics(mesh)
 
     nproc = jax.process_count()
     if cluster_mode != "local" and num_nodes > 1 and nproc not in (1, num_nodes):

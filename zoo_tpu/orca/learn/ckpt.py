@@ -114,6 +114,38 @@ def _walk_files(root: str) -> List[str]:
     return sorted(out)
 
 
+def _apply_sharding(tree: Any, sharding: Any) -> Any:
+    """Re-place a restored host pytree onto device(s) per ``sharding``:
+
+    - a ``jax.sharding.Mesh`` — every array leaf is placed by the
+      parameter plan (``zoo_tpu.parallel.plans``), scalars/metadata left
+      alone. THE resharding-on-restore form: a checkpoint saved at world
+      size N restores onto an M-device mesh bit-exactly (host bytes are
+      layout-free; placement just scatters them differently);
+    - a callable ``leaf -> Sharding`` — per-leaf control;
+    - a pytree of Shardings matching ``tree`` — explicit placement.
+    """
+    if sharding is None:
+        return tree
+    from jax.sharding import Mesh, Sharding
+
+    if isinstance(sharding, Mesh):
+        from zoo_tpu.parallel.plans import named_leaf_sharding, _leaf_name
+
+        def place(path, leaf):
+            if not (hasattr(leaf, "ndim") and hasattr(leaf, "dtype")):
+                return leaf  # epoch counters etc.: not array state
+            return jax.device_put(leaf, named_leaf_sharding(
+                sharding, _leaf_name(path), np.shape(leaf)))
+
+        return jax.tree_util.tree_map_with_path(place, tree)
+    if callable(sharding) and not isinstance(sharding, Sharding):
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, sharding(a)), tree)
+    return jax.tree_util.tree_map(
+        lambda a, s: jax.device_put(a, s), tree, sharding)
+
+
 class CheckpointManager:
     """Crash-safe orbax wrapper with a pickle fallback for exotic pytrees."""
 
@@ -301,14 +333,23 @@ class CheckpointManager:
             logger.warning("could not quarantine step %d: %s", step, e)
         return False
 
-    def restore(self, step: Optional[int] = None, target: Any = None) -> Any:
+    def restore(self, step: Optional[int] = None, target: Any = None,
+                sharding: Any = None) -> Any:
         """Load checkpoint ``step``. ``step=None`` picks the newest
         VERIFIED step — corrupt or torn steps (a saver killed mid-write)
         are quarantined to ``<step>.corrupt`` and skipped. An explicit
         ``step`` that fails verification raises
-        :class:`CheckpointCorruptError` after quarantining it."""
+        :class:`CheckpointCorruptError` after quarantining it.
+
+        ``sharding`` re-places the restored host pytree onto devices:
+        pass the CURRENT mesh (placement per the parameter plan), a
+        ``leaf -> Sharding`` callable, or a matching pytree of
+        Shardings. Checkpoints are world-size-free host bytes, so a
+        snapshot saved at world size N restores bit-exactly at world
+        size M — the half of elastic resume (``run_elastic`` re-mesh)
+        the save side cannot provide."""
         with span("ckpt.restore", step=step), _restore_seconds.time():
-            return self._restore(step, target)
+            return _apply_sharding(self._restore(step, target), sharding)
 
     def _restore(self, step: Optional[int] = None, target: Any = None) -> Any:
         if step is not None:
@@ -342,25 +383,34 @@ class CheckpointManager:
         return self._ckptr.restore(src)
 
     def restore_with_aux(self, step: Optional[int] = None,
-                         target: Any = None):
+                         target: Any = None, sharding: Any = None,
+                         aux_sharding: Any = None):
         """``(step, state, aux)`` from one verified snapshot — the
         resume/rollback primitive: params and optimizer state are
         guaranteed to come from the SAME step (``restore`` followed by a
         separate ``restore_aux(None)`` could straddle a concurrent save).
         ``step=None`` picks the newest verified step; raises
-        ``FileNotFoundError`` when none exists."""
+        ``FileNotFoundError`` when none exists.
+
+        ``sharding`` places the state (see :meth:`restore`);
+        ``aux_sharding`` places the aux pytree — when it is a Mesh the
+        same plan applies, which matches how fit initializes optimizer
+        moments (zeros_like of the placed params)."""
         if step is None:
             step = self.latest_verified_step()
             if step is None:
                 raise FileNotFoundError(
                     f"no verified checkpoints under {self.directory}")
-        return step, self.restore(step, target), self.restore_aux(step)
+        return (step, self.restore(step, target, sharding),
+                self.restore_aux(step, aux_sharding))
 
-    def restore_aux(self, step: Optional[int] = None) -> Any:
+    def restore_aux(self, step: Optional[int] = None,
+                    sharding: Any = None) -> Any:
         """Load the side pytree written with ``save(..., aux=...)``;
         None if the step has none. ``step=None`` follows the same
         newest-VERIFIED-step rule as :meth:`restore`, so params and
-        optimizer state always come from the same snapshot."""
+        optimizer state always come from the same snapshot.
+        ``sharding`` as in :meth:`restore`."""
         if step is None:
             step = self.latest_verified_step()
         if step is None:
@@ -369,7 +419,7 @@ class CheckpointManager:
         if not os.path.exists(path):
             return None
         with open(path, "rb") as f:
-            return pickle.load(f)
+            return _apply_sharding(pickle.load(f), sharding)
 
     # -- housekeeping ------------------------------------------------------
     def _gc(self):

@@ -217,12 +217,25 @@ class KerasEstimator:
         state = {"params": self.model.params, "epoch": self._epoch}
         self._ckpt.save(self._epoch, state, aux=self.model._opt_state)
 
+    @staticmethod
+    def _restore_mesh():
+        """The live mesh when one is active with >1 device — the
+        resharding target for restores, so a checkpoint saved at any
+        world size lands pre-placed for THIS run's layout (the
+        run_elastic re-mesh path; docs/multichip.md)."""
+        from zoo_tpu.common.context import get_runtime_context
+        ctx = get_runtime_context(required=False)
+        mesh = ctx.mesh if ctx is not None else None
+        return mesh if mesh is not None and mesh.size > 1 else None
+
     def _restore_latest(self):
         """Reload the newest snapshot: params, optimizer state, epoch
         counter — the reference's retry loop reloads ``model.N`` +
         ``optimMethod-*.N`` the same way. ``restore_with_aux`` pins both
         pytrees to ONE verified step."""
-        _, state, aux = self._ckpt.restore_with_aux(None)
+        mesh = self._restore_mesh()
+        _, state, aux = self._ckpt.restore_with_aux(
+            None, sharding=mesh, aux_sharding=mesh)
         self.model.params = state["params"]
         self.model._opt_state = aux
         self._epoch = int(state.get("epoch", 0))
@@ -236,11 +249,14 @@ class KerasEstimator:
                 os.path.join(path, "ckpts")) else path)
         if mgr is None:
             raise ValueError("no model_dir configured and no path given")
-        state = mgr.restore(version)
+        mesh = self._restore_mesh()
+        state = mgr.restore(version, sharding=mesh)
         self.model.params = state["params"]
         # optimizer state (Adam moments etc.) resumes too — the reference
-        # reloads optimMethod-<name>.N alongside model.N
-        self.model._opt_state = mgr.restore_aux(version)
+        # reloads optimMethod-<name>.N alongside model.N; both pytrees
+        # land resharded for the CURRENT mesh, so a world-size change
+        # between save and resume (elastic scale-down) is transparent
+        self.model._opt_state = mgr.restore_aux(version, sharding=mesh)
         self._epoch = int(state.get("epoch", 0))
         return self
 

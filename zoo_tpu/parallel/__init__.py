@@ -4,6 +4,8 @@ from zoo_tpu.parallel.mesh import (
     replicated_sharding,
     fsdp_param_sharding,
     host_local_to_global,
+    mesh_axes_from_env,
+    publish_mesh_metrics,
     DEFAULT_AXES,
 )
 from zoo_tpu.parallel.pipeline import pipeline_apply, stack_stages
@@ -14,6 +16,8 @@ __all__ = [
     "replicated_sharding",
     "fsdp_param_sharding",
     "host_local_to_global",
+    "mesh_axes_from_env",
+    "publish_mesh_metrics",
     "DEFAULT_AXES",
     "pipeline_apply",
     "stack_stages",

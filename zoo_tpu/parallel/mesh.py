@@ -24,6 +24,7 @@ Axis-name convention (used by every sharding plan in zoo_tpu):
 
 from __future__ import annotations
 
+import os
 from typing import Dict, Optional, Sequence, Tuple
 
 import jax
@@ -31,6 +32,31 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DEFAULT_AXES = ("data", "fsdp", "model", "seq", "expert", "pipe")
+
+
+def mesh_axes_from_env() -> Optional[Dict[str, int]]:
+    """Mesh layout from the ``ZOO_MESH_<AXIS>`` env knobs (e.g.
+    ``ZOO_MESH_FSDP=8``, ``ZOO_MESH_DATA=-1``) — the deployment-wide
+    default ``init_orca_context`` applies when the caller passes no
+    ``mesh_axes``. None when no knob is set (pure-DP default)."""
+    axes: Dict[str, int] = {}
+    for name in DEFAULT_AXES:
+        v = os.environ.get(f"ZOO_MESH_{name.upper()}")
+        if v:
+            axes[name] = int(v)
+    return axes or None
+
+
+def publish_mesh_metrics(mesh: Mesh) -> None:
+    """Export ``zoo_mesh_axis_size{axis=...}`` gauges for the live mesh
+    (every axis, including size-1 ones — a scrape can tell "axis unused"
+    from "axis missing")."""
+    from zoo_tpu.obs.metrics import gauge
+    g = gauge("zoo_mesh_axis_size",
+              "Device-mesh axis sizes of the active runtime context",
+              labels=("axis",))
+    for name in mesh.axis_names:
+        g.labels(axis=name).set(float(mesh.shape.get(name, 1)))
 
 
 def _factor_shape(n_devices: int, axis_sizes: Dict[str, int],

@@ -1,7 +1,7 @@
 """Parameter-placement plans: DP / ZeRO(fsdp) / TP over the global mesh.
 
 Net-new vs the reference (SURVEY §2.10: the reference is data-parallel
-only). The plan maps every parameter leaf to a NamedSharding:
+only). A *plan* maps every parameter leaf to a NamedSharding:
 
 - ``data`` axis: batch only — params replicated across it (classic DP; the
   reference's AllReduceParameter semantics).
@@ -12,11 +12,21 @@ only). The plan maps every parameter leaf to a NamedSharding:
 - ``model`` axis: tensor parallel for 2-D matmul weights — output-dim
   sharding (megatron "column") by default, falling back to input-dim
   ("row") when only that divides; XLA inserts the psum.
+
+Shape-only placement cannot tell a q-projection from an o-projection, so
+this module also keeps a small **plan registry**: named rules keyed on
+the *leaf name* that encode the megatron pairing for known model
+families (llama / BERT-style transformer blocks: column-parallel into
+the heads, row-parallel back out, so activations stay head-sharded
+between the two matmuls with ONE psum per block half). ``plan="auto"``
+(the default everywhere) applies the name rules where a leaf name
+matches and falls back to :func:`leaf_sharding` elsewhere — models the
+registry has never heard of keep today's behavior exactly.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Callable, Dict, Optional, Tuple
 
 import jax
 import numpy as np
@@ -25,11 +35,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from zoo_tpu.parallel.mesh import pick_divisible_dim, replicated_sharding
 
 
+def _axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape.get(axis, 1) if axis in mesh.axis_names else 1
+
+
 def leaf_sharding(mesh: Mesh, shape) -> NamedSharding:
     """Choose a sharding for one parameter tensor under the mesh's fsdp and
     model axes (both may be active at once for 2-D weights)."""
-    fsdp = mesh.shape.get("fsdp", 1) if "fsdp" in mesh.axis_names else 1
-    model = mesh.shape.get("model", 1) if "model" in mesh.axis_names else 1
+    fsdp = _axis_size(mesh, "fsdp")
+    model = _axis_size(mesh, "model")
     spec = [None] * len(shape)
 
     if model > 1 and len(shape) >= 2:
@@ -49,10 +63,196 @@ def leaf_sharding(mesh: Mesh, shape) -> NamedSharding:
     return NamedSharding(mesh, P(*spec))
 
 
-def place_params(params, mesh: Optional[Mesh]):
+# -- plan registry ----------------------------------------------------------
+# rule(mesh, name, shape) -> Optional[NamedSharding]; None = "not mine",
+# fall through to the next rule / the shape-based default
+_PLAN_REGISTRY: Dict[str, Callable] = {}
+
+#: megatron pairing for transformer blocks: which matmul dim the
+#: ``model`` axis splits, keyed by the leaf name conventions of
+#: zoo_tpu's llama (wq/wk/wv/wo, w_gate/w_up/w_down) and the BERT/GPT
+#: TransformerLayer (qkv_w/proj_w, fc1_w/fc2_w). -1 = column (output
+#: dim, into the heads), -2 = row (input dim, out of the heads — XLA
+#: psums the partial sums back), so activations stay head-sharded
+#: between the pair with one psum per half-block.
+_TP_COLUMN = ("wq", "wk", "wv", "w_gate", "w_up", "qkv_w", "fc1_w")
+_TP_ROW = ("wo", "w_down", "proj_w", "fc2_w")
+
+
+def register_plan(name: str):
+    """Decorator: register a named sharding rule. The rule sees
+    ``(mesh, leaf_name, shape)`` and returns a NamedSharding or None to
+    decline the leaf."""
+    def deco(fn):
+        _PLAN_REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+def get_plan(name: str) -> Callable:
+    if name not in _PLAN_REGISTRY:
+        raise KeyError(
+            f"unknown sharding plan {name!r}; registered: "
+            f"{sorted(_PLAN_REGISTRY)}")
+    return _PLAN_REGISTRY[name]
+
+
+def _fill_fsdp(mesh: Mesh, shape, spec) -> NamedSharding:
+    """Add the fsdp axis to whatever the TP rule chose, on the largest
+    still-free divisible dim (never the leading stacked-blocks dim of a
+    scanned stack when another dim divides — the scan unstacks it)."""
+    fsdp = _axis_size(mesh, "fsdp")
+    if fsdp > 1:
+        taken = tuple(i for i, s in enumerate(spec) if s is not None)
+        best = pick_divisible_dim(shape, fsdp, taken)
+        if best is not None:
+            spec[best] = "fsdp"
+    if all(s is None for s in spec):
+        return replicated_sharding(mesh)
+    return NamedSharding(mesh, P(*spec))
+
+
+@register_plan("transformer")
+def _transformer_rule(mesh: Mesh, name: str,
+                      shape) -> Optional[NamedSharding]:
+    """Tensor-parallel rule for llama/BERT attention+MLP blocks: column
+    into the head/ffn dim, row back out, norms/embeddings replicated
+    across ``model`` (fsdp still shards them)."""
+    model = _axis_size(mesh, "model")
+    if model <= 1 or len(shape) < 2:
+        return None
+    leaf = name.rsplit("/", 1)[-1].rsplit(".", 1)[-1]
+    spec = [None] * len(shape)
+    if leaf in _TP_COLUMN and shape[-1] % model == 0:
+        spec[-1] = "model"
+    elif leaf in _TP_ROW and shape[-2] % model == 0:
+        spec[-2] = "model"
+    else:
+        return None
+    return _fill_fsdp(mesh, list(shape), spec)
+
+
+@register_plan("default")
+def _default_rule(mesh: Mesh, name: str, shape) -> NamedSharding:
+    return leaf_sharding(mesh, shape)
+
+
+def named_leaf_sharding(mesh: Mesh, name: str, shape,
+                        plan: str = "auto") -> NamedSharding:
+    """Sharding for one named parameter leaf under ``plan``.
+
+    ``"auto"`` tries the transformer name rule first (it declines
+    unknown names), then the shape-based default — the resolution every
+    fit/serving path uses unless a caller pins an explicit plan."""
+    shape = tuple(shape)
+    if plan == "auto":
+        s = _transformer_rule(mesh, name, shape)
+        return s if s is not None else leaf_sharding(mesh, shape)
+    s = get_plan(plan)(mesh, name, shape)
+    return s if s is not None else leaf_sharding(mesh, shape)
+
+
+def _leaf_name(path) -> str:
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                    for k in path)
+
+
+def sharding_tree(params, mesh: Mesh, plan: str = "auto"):
+    """The NamedSharding pytree the plan assigns to ``params`` — the
+    explicit ``in_shardings``/``out_shardings`` input for a jitted step
+    (no device_put happens here)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, x: named_leaf_sharding(
+            mesh, _leaf_name(path), np.shape(x), plan), params)
+
+
+def place_params(params, mesh: Optional[Mesh], plan: str = "auto"):
     """Device-put a whole params pytree according to the plan."""
     if mesh is None:
         return params
-    return jax.tree_util.tree_map(
-        lambda x: jax.device_put(
-            x, leaf_sharding(mesh, np.shape(x))), params)
+    return jax.tree_util.tree_map_with_path(
+        lambda path, x: jax.device_put(
+            x, named_leaf_sharding(mesh, _leaf_name(path),
+                                   np.shape(x), plan)), params)
+
+
+def shardings_of(tree, mesh: Mesh):
+    """The concrete shardings carried by an already-placed pytree,
+    normalized for use as explicit jit shardings: leaves that are not
+    mesh-placed jax Arrays (host numpy, scalars, single-device arrays)
+    map to the replicated sharding."""
+    rep = replicated_sharding(mesh)
+
+    def of(x):
+        s = getattr(x, "sharding", None)
+        if isinstance(s, NamedSharding) and s.mesh == mesh:
+            return s
+        return rep
+
+    return jax.tree_util.tree_map(of, tree)
+
+
+def ensure_placed(tree, mesh: Mesh):
+    """Commit every leaf that is not already mesh-placed to the
+    replicated sharding, so the tree's shardings and
+    :func:`shardings_of` agree exactly (explicit in_shardings + donation
+    want zero surprise reshards)."""
+    rep = replicated_sharding(mesh)
+
+    def fix(x):
+        s = getattr(x, "sharding", None)
+        if isinstance(s, NamedSharding) and s.mesh == mesh:
+            return x
+        return jax.device_put(x, rep)
+
+    return jax.tree_util.tree_map(fix, tree)
+
+
+def fsdp_lint_shapes(params, mesh: Mesh, plan: str = "auto"):
+    """``(sharded, replicated, local)`` global/per-device shape lists
+    for :func:`zoo_tpu.parallel.hlo_check.assert_fsdp_sharded`:
+    ``sharded``/``replicated`` are the plan's global shapes, ``local``
+    the per-device shard shapes the partitioned module legitimately
+    carries (the lint skips collisions against both)."""
+    sharded, replicated, local = [], [], []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        shape = tuple(np.shape(leaf))
+        sh = named_leaf_sharding(mesh, _leaf_name(path), shape, plan)
+        if any(s is not None for s in sh.spec):
+            sharded.append(shape)
+            local.append(tuple(sh.shard_shape(shape)))
+        else:
+            replicated.append(shape)
+    return sharded, replicated, local
+
+
+def estimate_collective_bytes(params, mesh: Mesh,
+                              plan: str = "auto") -> Dict[str, int]:
+    """Per-STEP collective traffic the plan implies, in bytes (the
+    static estimate behind ``zoo_mesh_collective_bytes_total``; actual
+    traffic is XLA's business, but the plan's lower bound is what
+    capacity planning needs):
+
+    - fsdp: every sharded param is all-gathered into its consuming op in
+      forward AND backward (2x full bytes x (n-1)/n) and its grad
+      reduce-scattered once (1x);
+    - data: every replicated-trainable grad is all-reduced — ring cost
+      2 x bytes x (n-1)/n.
+    """
+    fsdp = _axis_size(mesh, "fsdp")
+    data = _axis_size(mesh, "data")
+    out = {"all_gather": 0, "reduce_scatter": 0, "all_reduce": 0}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        nbytes = int(np.prod(np.shape(leaf), dtype=np.int64)) * \
+            np.dtype(getattr(leaf, "dtype", np.float32)).itemsize
+        spec = named_leaf_sharding(mesh, _leaf_name(path),
+                                   np.shape(leaf), plan).spec
+        axes = [a for s in spec if s is not None
+                for a in ((s,) if isinstance(s, str) else s)]
+        if "fsdp" in axes and fsdp > 1:
+            frac = (fsdp - 1) / fsdp
+            out["all_gather"] += int(2 * nbytes * frac)
+            out["reduce_scatter"] += int(nbytes * frac)
+        elif data > 1:
+            out["all_reduce"] += int(2 * nbytes * (data - 1) / data)
+    return out

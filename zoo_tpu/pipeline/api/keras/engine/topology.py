@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from zoo_tpu.common.context import get_runtime_context
+from zoo_tpu.obs.metrics import counter as _obs_counter
 from zoo_tpu.pipeline.api.keras.engine.base import KTensor, Layer
 from zoo_tpu.pipeline.api.keras.engine import data_utils
 from zoo_tpu.pipeline.api.keras.metrics import Metric, get_metric
@@ -57,6 +58,14 @@ def _merge_state(trainable: Dict, state: Dict) -> Dict:
 # Event-file-backed summaries (own writer + disk read-back) live in
 # zoo_tpu.tensorboard; re-exported here for the keras facade.
 from zoo_tpu.tensorboard import TrainSummary  # noqa: E402
+
+_collective_bytes = _obs_counter(
+    "zoo_mesh_collective_bytes_total",
+    "Estimated per-step collective traffic the active sharding plan "
+    "implies, accumulated over executed train steps (static plan "
+    "estimate — fsdp weight gathers + grad reductions; see "
+    "zoo_tpu.parallel.plans.estimate_collective_bytes)",
+    labels=("op",))
 
 # serializes lazy jit-cache builds: concurrent first predicts (the
 # multi-replica ServingServer batcher threads) could otherwise each
@@ -434,10 +443,53 @@ class KerasNet:
 
         return step
 
-    def _build_train_step(self):
-        return jax.jit(self._make_step_fn(), donate_argnums=(0, 1, 2))
+    # -- explicit GSPMD shardings (docs/multichip.md) ---------------------
+    # On a >1-device mesh the train step is jitted with explicit
+    # NamedSharding in/out shardings instead of relying on committed-input
+    # inference: params/opt-state follow the placement plan
+    # (zoo_tpu.parallel.plans — replicated over `data`, ZeRO-sharded over
+    # `fsdp`, tensor-parallel over `model`), batches ride the data axes,
+    # rng/loss and the guard's device counters are replicated. Explicit
+    # out_shardings pin the updated params to the SAME layout, so a plan
+    # regression cannot silently come back replicated (the hlo_check
+    # FSDP lint asserts the same thing from the compiled text).
+    def _state_shardings(self, params, opt_state):
+        """(params_shardings, opt_state_shardings, replicated) for the
+        current mesh, from the live placed arrays; None off-mesh."""
+        mesh = self._mesh()
+        if mesh is None or mesh.size <= 1:
+            return None
+        from zoo_tpu.parallel.mesh import replicated_sharding
+        from zoo_tpu.parallel.plans import shardings_of
+        return (shardings_of(params, mesh), shardings_of(opt_state, mesh),
+                replicated_sharding(mesh))
 
-    def _build_multi_train_step(self):
+    def _step_shardings(self, shard, batch_ndims, stacked: bool):
+        """jit (in_shardings, out_shardings) for the train-step seam."""
+        if shard is None:
+            return None
+        mesh = self._mesh()
+        from zoo_tpu.parallel.mesh import (
+            batch_sharding,
+            stacked_batch_sharding,
+        )
+        p_sh, o_sh, rep = shard
+        bfn = stacked_batch_sharding if stacked else batch_sharding
+        ins = (p_sh, o_sh, rep) + tuple(
+            bfn(mesh, nd + (1 if stacked else 0)) for nd in batch_ndims)
+        return ins, (p_sh, o_sh, rep, rep)
+
+    def _jit_step(self, fn, shardings):
+        if shardings is None:
+            return jax.jit(fn, donate_argnums=(0, 1, 2))
+        ins, outs = shardings
+        return jax.jit(fn, donate_argnums=(0, 1, 2),
+                       in_shardings=ins, out_shardings=outs)
+
+    def _build_train_step(self, shardings=None):
+        return self._jit_step(self._make_step_fn(), shardings)
+
+    def _build_multi_train_step(self, shardings=None):
         """K training steps per dispatch: ``lax.scan`` of the step over
         batches stacked as (k, batch, ...). One XLA execution covers k
         steps, amortizing per-call dispatch latency — the difference is
@@ -451,9 +503,10 @@ class KerasNet:
         def multi(params, opt_state, rng, *stacked):
             return _scan_steps(step, params, opt_state, rng, stacked)
 
-        return jax.jit(multi, donate_argnums=(0, 1, 2))
+        return self._jit_step(multi, shardings)
 
-    def _build_epoch_train_step(self, k: int, bs: int, gather: bool):
+    def _build_epoch_train_step(self, k: int, bs: int, gather: bool,
+                                shard=None):
         """A FULL epoch in one dispatch: permutation-gather of the (small,
         device-resident) dataset + ``lax.scan`` of the step over all ``k``
         batches, inside a single jit call. On high-latency PJRT transports
@@ -485,6 +538,13 @@ class KerasNet:
                     for a in stacked]
             return _scan_steps(step, params, opt_state, rng, stacked)
 
+        if shard is not None:
+            # dataset operands keep their resident placement (the gather
+            # re-pins batches via the constraint above); the carried
+            # params/opt-state come back pinned to the plan's layout
+            p_sh, o_sh, rep = shard
+            return jax.jit(epoch_fn, donate_argnums=(0, 1, 2),
+                           out_shardings=(p_sh, o_sh, rep, rep))
         return jax.jit(epoch_fn, donate_argnums=(0, 1, 2))
 
     def _build_pred_step(self):
@@ -530,15 +590,30 @@ class KerasNet:
             # the guarded step carries the guard counters in opt_state
             opt_state = (opt_state, self._active_guard().device_init())
         rng = jax.random.PRNGKey(seed + 1)
+        mesh = self._mesh()
+        _shard = None
+        if mesh is not None and mesh.size > 1:
+            from zoo_tpu.parallel.plans import ensure_placed
+            opt_state = ensure_placed(opt_state, mesh)
+            _shard = self._state_shardings(params, opt_state)
+            rng = jax.device_put(rng, _shard[2])
         local_bs = max(batch_size // jax.process_count(), 1)
-        batch = self._put_batch([np.asarray(a[:local_bs])
-                                 for a in xs + ys_list])
+        host_batch = [np.asarray(a[:local_bs]) for a in xs + ys_list]
+        batch = self._put_batch(host_batch)
         # use OUR jitted step, never an interposed _jit_train (the
         # elastic-retry fault-injection contract replaces it with plain
         # callables that have no .lower); don't clobber the interposer
         jt = getattr(self, "_own_jit_train", None)
+        interposed = self._jit_train is not None \
+            and self._jit_train is not jt
+        if not interposed and getattr(self, "_jit_mesh", None) != mesh:
+            self._drop_train_caches()  # stale-mesh shardings baked in
+            jt = None
+            self._jit_mesh = mesh
         if jt is None:
-            jt = self._own_jit_train = self._build_train_step()
+            jt = self._own_jit_train = self._build_train_step(
+                self._step_shardings(_shard,
+                                     [a.ndim for a in host_batch], False))
         if self._jit_train is None:
             self._jit_train = jt
         return jt.lower(params, opt_state, rng,
@@ -610,6 +685,20 @@ class KerasNet:
             # the guard's device-side (bad, streak) counters ride the
             # optimizer-state carry; the guarded step unwraps them
             opt_state = (opt_state, guard.device_init())
+        # >1-device mesh: commit every state leaf to its plan sharding and
+        # capture the explicit in/out shardings the jitted steps are built
+        # with (params/opt-state per the plan, guard counters replicated)
+        _shard = None
+        _coll_est = None
+        if mesh is not None and mesh.size > 1:
+            from zoo_tpu.parallel.plans import (
+                ensure_placed,
+                estimate_collective_bytes,
+            )
+            opt_state = ensure_placed(opt_state, mesh)
+            _shard = self._state_shardings(params, opt_state)
+            _coll_est = {k: v for k, v in estimate_collective_bytes(
+                trainable, mesh).items() if v}
         # boundary bookkeeping: per-epoch cumulative baselines so each
         # superbatch boundary sees window deltas (reset at epoch start)
         gb = {"loss": 0.0, "steps": 0, "bad": 0, "bad0": 0, "idx": None,
@@ -643,6 +732,14 @@ class KerasNet:
                     self.optimizer.init_fused(tr)
                     if getattr(self.optimizer, "fused", False)
                     else tx.init(tr))
+                if _shard is not None and aux is not None:
+                    # reshard-on-restore: the checkpointed opt state is
+                    # host numpy; pin every moment back onto the SAME
+                    # mesh layout the step was compiled for, so rollback
+                    # under FSDP/TP keeps PR 4 semantics bit-unchanged
+                    inner = jax.tree_util.tree_map(
+                        lambda s, a: jax.device_put(a, s),
+                        _shard[1][0], inner)
                 hp = getattr(inner, "hyperparams", None)
                 if lr_scale != 1.0 and hp is not None \
                         and "learning_rate" in hp:
@@ -650,6 +747,9 @@ class KerasNet:
                         float(np.asarray(hp["learning_rate"])) * lr_scale,
                         jnp.float32)
                 opt_state = (inner, guard.device_init())
+                if _shard is not None:
+                    from zoo_tpu.parallel.plans import ensure_placed
+                    opt_state = ensure_placed(opt_state, mesh)
                 gb["bad"] = gb["bad0"] = 0
                 if not final:
                     # the diverged pre-rollback losses must not leak
@@ -669,6 +769,8 @@ class KerasNet:
                 guard.preempt_checkpoint(step=self._step)
 
         rng = jax.random.PRNGKey(seed + 1)
+        if _shard is not None:
+            rng = jax.device_put(rng, _shard[2])
         nprng = np.random.RandomState(seed)
         val_arrays = None
         if validation_data is not None:
@@ -718,6 +820,12 @@ class KerasNet:
         # cached build (e.g. from a profiled fit) must not disable scan
         interposed = self._jit_train is not None \
             and self._jit_train is not getattr(self, "_own_jit_train", None)
+        if not interposed and getattr(self, "_jit_mesh", None) != mesh:
+            # cached steps bake their explicit shardings in; a context
+            # switch to a different mesh (AutoML sub-meshes, re-init)
+            # must rebuild them, never feed a stale-mesh executable
+            self._drop_train_caches()
+            self._jit_mesh = mesh
         # whole-epoch dispatch: small device-resident dataset on one chip
         # -> permutation-gather + full-epoch scan in ONE jit call per
         # epoch (see _build_epoch_train_step). The 256MB cap bounds the
@@ -729,6 +837,7 @@ class KerasNet:
                      and sum(a.nbytes for a in arrs) <= (256 << 20))
         use_scan = scan_group > 1 and prof is None and pc == 1 \
             and not interposed and not use_epoch
+        batch_ndims = [a.ndim for a in arrs]
         if use_epoch:
             if getattr(self, "_jit_epoch_cache", None) is None:
                 self._jit_epoch_cache = {}
@@ -736,10 +845,12 @@ class KerasNet:
             group = scan_group
             # getattr: instances unpickled from blobs predating _jit_multi
             if getattr(self, "_jit_multi", None) is None:
-                self._jit_multi = self._build_multi_train_step()
+                self._jit_multi = self._build_multi_train_step(
+                    self._step_shardings(_shard, batch_ndims, True))
         elif self._jit_train is None:
             self._jit_train = self._own_jit_train = \
-                self._build_train_step()
+                self._build_train_step(
+                    self._step_shardings(_shard, batch_ndims, False))
         # host-fed path: stage superbatch slices into rotating
         # preallocated buffers (double-buffered device_put — the DMA of
         # superbatch k reads buffer A while k+1 is sliced into buffer
@@ -773,7 +884,8 @@ class KerasNet:
                 if je is None:
                     je = self._jit_epoch_cache[key] = \
                         self._build_epoch_train_step(kk, local_bs,
-                                                     bool(shuffle))
+                                                     bool(shuffle),
+                                                     shard=_shard)
                 extra_args = []
                 if shuffle:
                     perm = nprng.permutation(n).astype(np.int32)
@@ -961,6 +1073,12 @@ class KerasNet:
                     "mode (ZooContext.debug_nans) treats this as fatal; "
                     "jax_debug_nans should have pinpointed the producing "
                     "op above")
+            if _coll_est:
+                # static plan estimate x steps actually executed: the
+                # obs-side answer to "what did this epoch move over ICI"
+                for op_, nbytes_ in _coll_est.items():
+                    _collective_bytes.labels(op=op_).inc(
+                        float(nbytes_) * n_steps)
             history["loss"].append(epoch_loss)
             self.train_summary.add_scalar("Loss", epoch_loss, self._step)
             self.train_summary.add_scalar(
@@ -999,8 +1117,12 @@ class KerasNet:
                     # the jitted step picks the new value up as an argument
                     _inner_opt = opt_state[0] if guard is not None \
                         else opt_state
-                    _inner_opt.hyperparams["learning_rate"] = jnp.asarray(
-                        new_lr, dtype=jnp.float32)
+                    new_lr = jnp.asarray(new_lr, dtype=jnp.float32)
+                    if _shard is not None:
+                        # keep the explicit in_shardings contract: every
+                        # opt-state leaf stays mesh-placed (replicated)
+                        new_lr = jax.device_put(new_lr, _shard[2])
+                    _inner_opt.hyperparams["learning_rate"] = new_lr
             if verbose:
                 extra = {k: v[-1] for k, v in history.items() if k != "loss"}
                 print(f"Epoch {epoch + 1}/{nb_epoch} - loss: "
@@ -1157,6 +1279,7 @@ class KerasNet:
         jm = getattr(self, "_jit_multi", None)
         jo = getattr(self, "_own_jit_train", None)
         jc = getattr(self, "_jit_epoch_cache", None)
+        jmesh = getattr(self, "_jit_mesh", None)
         ts, vs, opt = self.train_summary, self.validation_summary, \
             self._opt_state
         prof = getattr(self, "_profiler", None)
@@ -1168,6 +1291,7 @@ class KerasNet:
             self._own_jit_train = None
             self._jit_stage = None
             self._jit_epoch_cache = None
+            self._jit_mesh = None  # Mesh holds live Device handles
             self._opt_state = None
             self._profiler = None
             self._guard = None  # holds locks/events; owners re-attach
@@ -1181,6 +1305,7 @@ class KerasNet:
             self._jit_multi = jm
             self._own_jit_train = jo
             self._jit_epoch_cache = jc
+            self._jit_mesh = jmesh
             self.train_summary, self.validation_summary = ts, vs
             self._opt_state = opt
             self._profiler = prof

@@ -12,6 +12,7 @@ reduce-scatter + apply + all-gather automatically when params are sharded.
 
 from __future__ import annotations
 
+import os
 from typing import Callable, Optional, Union
 
 import optax
@@ -95,13 +96,20 @@ class AdamWeightDecay(Optimizer):
     aggregated slice in-task" leg of the reference's PS allreduce,
     ``wp-bigdl.md:146-160``) through the direct-apply path of the train
     step, skipping the optax updates/apply round trip. Constant lr only
-    (schedules stay on the optax path)."""
+    (schedules stay on the optax path). ``fused=None`` (default) reads
+    the ``ZOO_FUSED_OPTIM`` env knob — "1" turns the direct-apply path
+    on deployment-wide for schedule-free configs (a scheduled config
+    silently keeps the optax path rather than erroring, so one env var
+    can cover a whole job). Inside a >1-device mesh the update runs as
+    the partitionable elementwise form; off-TPU the kernel interprets —
+    either way the fallback is clean (``bench_fused_optim`` measures the
+    A/B)."""
 
     def __init__(self, lr: float = 0.001, beta_1: float = 0.9,
                  beta_2: float = 0.999, epsilon: float = 1e-6,
                  weight_decay: float = 0.01, total_steps: int = 0,
                  warmup_ratio: float = 0.1, learningrate_schedule=None,
-                 fused: bool = False):
+                 fused: Optional[bool] = None):
         if learningrate_schedule is None and total_steps:
             warmup = max(1, int(total_steps * warmup_ratio))
             learningrate_schedule = optax.warmup_cosine_decay_schedule(
@@ -112,6 +120,10 @@ class AdamWeightDecay(Optimizer):
         super().__init__(tx, "adamw", plateau)
         if fused and learningrate_schedule is not None:
             raise ValueError("fused=True supports a constant lr only")
+        if fused is None:
+            fused = (os.environ.get("ZOO_FUSED_OPTIM", "").lower()
+                     in ("1", "true")
+                     and learningrate_schedule is None)
         if fused:
             self.fused = True
             self._fused_args = (float(lr), float(beta_1), float(beta_2),

@@ -534,6 +534,9 @@ class LLMEngine:
     def stats(self) -> Dict:
         out = {"mode": self.mode,
                "slots": self.model.num_slots,
+               # tensor-parallel ways the model spans (1 = replicated
+               # single-device weights — the pre-mesh layout)
+               "tp": getattr(self.model, "tp", 1),
                "active": sum(1 for s in self._slots if s.handle),
                "waiting": len(self._wait),
                "decode_steps": self._decode_steps,

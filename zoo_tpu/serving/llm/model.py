@@ -26,6 +26,17 @@ and are masked by position, so the executable has no liveness branch.
 The cache lives here as two device arrays
 ``(n_layer, num_blocks, block_size, n_kv_head, head_dim)``, donated
 through every prefill/decode call so XLA updates them in place.
+
+**Tensor-parallel serving** (``mesh=``): ONE set of weights and ONE
+paged KV cache span every device of the mesh's ``model`` axis instead
+of the model being cloned per replica — attention/MLP weights follow
+the megatron plan (``zoo_tpu.parallel.plans``), the KV cache is sharded
+on its ``n_kv_head`` axis (each device owns its heads' K/V for every
+block), and both executables are jitted with explicit NamedSharding
+in/out shardings. The donation aliasing keeps the in-place cache
+update, so the single-decode-executable and zero-recompile invariants
+hold unchanged on the mesh; per-device weight+cache memory drops to
+~1/tp of the replicated model.
 """
 
 from __future__ import annotations
@@ -74,7 +85,8 @@ class PagedLlamaModel:
                  num_blocks: int = 128,
                  max_blocks_per_seq: int = 32,
                  prefill_buckets: Sequence[int] = DEFAULT_PREFILL_BUCKETS,
-                 eos_id: Optional[int] = None):
+                 eos_id: Optional[int] = None,
+                 mesh=None):
         self.cfg = config
         self.num_slots = int(num_slots)
         self.block_size = int(block_size)
@@ -93,10 +105,21 @@ class PagedLlamaModel:
                 f"{self.max_context}")
         self.max_prompt_len = self.prefill_buckets[-1]
 
+        self.mesh = mesh if mesh is not None \
+            and getattr(mesh, "size", 1) > 1 else None
+        self.tp = self.mesh.shape.get("model", 1) if self.mesh is not None \
+            else 1
+        c = config
+        if self.tp > 1:
+            if c.n_kv_head % self.tp or c.n_head % self.tp:
+                raise ValueError(
+                    f"tensor-parallel serving shards the KV cache on the "
+                    f"kv-head axis: n_kv_head ({c.n_kv_head}) and n_head "
+                    f"({c.n_head}) must divide by the model-axis size "
+                    f"({self.tp})")
         layer = Llama(config, lm_head=True)
         self.params = params if params is not None else layer.build(
             jax.random.PRNGKey(seed), (None, self.prefill_buckets[-1]))
-        c = config
         # rope tables over the whole pageable context, closed over by
         # both executables (f32, tiny: max_context x head_dim/2)
         self._cos, self._sin = rope_frequencies(
@@ -108,9 +131,38 @@ class PagedLlamaModel:
         # one call at a time: prefill/decode donate + replace the cache
         # arrays, so interleaved calls would race the handoff
         self._lock = threading.Lock()
-        # caches are args 1,2 → donated: XLA aliases them in place
-        self._decode = jax.jit(self._decode_fn, donate_argnums=(1, 2))
-        self._prefill = jax.jit(self._prefill_fn, donate_argnums=(1, 2))
+        if self.mesh is None:
+            # caches are args 1,2 → donated: XLA aliases them in place
+            self._decode = jax.jit(self._decode_fn, donate_argnums=(1, 2))
+            self._prefill = jax.jit(self._prefill_fn,
+                                    donate_argnums=(1, 2))
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from zoo_tpu.parallel.mesh import (
+                publish_mesh_metrics,
+                replicated_sharding,
+            )
+            from zoo_tpu.parallel.plans import place_params, shardings_of
+
+            publish_mesh_metrics(self.mesh)
+            self.params = place_params(self.params, self.mesh)
+            rep = replicated_sharding(self.mesh)
+            kv_sh = NamedSharding(
+                self.mesh, P(None, None, None, "model", None))
+            self._kc = jax.device_put(self._kc, kv_sh)
+            self._vc = jax.device_put(self._vc, kv_sh)
+            p_sh = shardings_of(self.params, self.mesh)
+            # identical donated in/out cache shardings keep the in-place
+            # alias on the mesh; token/table/position operands and the
+            # emitted tokens are replicated (host round trip unchanged)
+            self._decode = jax.jit(
+                self._decode_fn, donate_argnums=(1, 2),
+                in_shardings=(p_sh, kv_sh, kv_sh, rep, rep, rep),
+                out_shardings=(rep, kv_sh, kv_sh))
+            self._prefill = jax.jit(
+                self._prefill_fn, donate_argnums=(1, 2),
+                in_shardings=(p_sh, kv_sh, kv_sh, rep, rep, rep),
+                out_shardings=(rep, kv_sh, kv_sh))
 
     # -- compiled bodies ---------------------------------------------------
     def _attn_proj(self, p, x):

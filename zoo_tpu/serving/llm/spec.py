@@ -30,7 +30,7 @@ _ARCH_KEYS = ("vocab", "hidden", "n_block", "n_head", "n_kv_head",
               "intermediate")
 _ENGINE_KEYS = {"slots": "num_slots", "block": "block_size",
                 "blocks": "num_blocks", "tables": "max_blocks_per_seq",
-                "seed": "seed", "eos": "eos_id"}
+                "seed": "seed", "eos": "eos_id", "tp": "tp"}
 
 
 def is_llm_spec(spec) -> bool:
@@ -95,7 +95,8 @@ def _env_engine_defaults() -> Dict:
              ("ZOO_LLM_KV_BLOCKS", "num_blocks"),
              ("ZOO_LLM_MAX_BLOCKS_PER_SEQ", "max_blocks_per_seq"),
              ("ZOO_LLM_SEED", "seed"),
-             ("ZOO_LLM_EOS", "eos_id"))
+             ("ZOO_LLM_EOS", "eos_id"),
+             ("ZOO_LLM_TP", "tp"))
     for env, name in pairs:
         v = os.environ.get(env)
         if v:
@@ -121,6 +122,20 @@ def build_llm_engine(spec: str, mode: Optional[str] = None,
     merged.update({k: v for k, v in overrides.items()
                    if k not in ("mode", "max_waiting")})
     cfg = LlamaConfig(**cfg_kwargs)
+    # tensor-parallel serving: `tp=N` (spec) / ZOO_LLM_TP (env) / a
+    # `mesh=` override span ONE model over N local devices instead of
+    # replicating it (docs/multichip.md)
+    tp = int(merged.pop("tp", 0) or 0)
+    if tp > 1 and "mesh" not in merged:
+        import jax
+
+        from zoo_tpu.parallel import build_mesh
+        devs = jax.devices()
+        if len(devs) < tp:
+            raise ValueError(
+                f"llama spec asks for tp={tp} but only {len(devs)} "
+                "local device(s) are visible")
+        merged["mesh"] = build_mesh(devs[:tp], axis_sizes={"model": tp})
     model = PagedLlamaModel(cfg, **merged)
     mode = mode or os.environ.get("ZOO_LLM_MODE", "continuous")
     engine = LLMEngine(model, mode=mode,

@@ -43,6 +43,7 @@ README's ResNet analysis cites.
 """
 
 import json
+import os
 import statistics
 import time
 
@@ -989,6 +990,100 @@ def bench_serving_ha(extra, n_requests=240, clients=6, feat=16):
         counter_value("zoo_serve_failover_total") - fo0)
 
 
+def bench_lifecycle(extra, clients=6, feat=16):
+    """Model-lifecycle numbers (docs/model_lifecycle.md): whole-group
+    rolling hot-swap duration and the p99 paid DURING the swap vs a
+    pre-swap baseline, for a 3-replica registry-backed group under
+    sustained verified load with one replica SIGKILLed mid-update.
+    The acceptance bar rides along: zero client-visible failures and
+    zero mixed-version replicas after the swap."""
+    import tempfile
+    import threading
+
+    from zoo_tpu.serving.ha import ReplicaGroup
+    from zoo_tpu.serving.ha_client import HAServingClient
+    from zoo_tpu.serving.registry import ModelRegistry
+
+    reg = ModelRegistry(os.path.join(
+        tempfile.mkdtemp(prefix="zoo-bench-lifecycle-"), "registry"))
+    reg.publish(spec="synthetic:double:2", alias="prod")
+    group = ReplicaGroup(f"registry:{reg.root}:prod", num_replicas=3,
+                         batch_size=8, max_wait_ms=2.0, max_restarts=3)
+    group.start(timeout=60)
+    client = HAServingClient(group.endpoints(), deadline_ms=10000)
+
+    phase = ["warmup"]
+    lats = {"baseline": [], "swap": []}
+    failures = []
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def one_client(k):
+        rs_c = np.random.RandomState(k)
+        while not stop.is_set():
+            x = rs_c.randn(1, feat).astype(np.float32)
+            t0 = time.perf_counter()
+            try:
+                out = np.asarray(client.predict(x))
+                if not np.allclose(out, x * 2.0, atol=1e-6):
+                    raise AssertionError("response mismatch")
+                dt = time.perf_counter() - t0
+                with lock:
+                    if phase[0] in lats:
+                        lats[phase[0]].append(dt)
+            except Exception as e:  # noqa: BLE001 — tally, keep going
+                with lock:
+                    failures.append(repr(e))
+            time.sleep(0.001)
+
+    threads = [threading.Thread(target=one_client, args=(k,))
+               for k in range(clients)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.5)       # warm every replica's jit/warm shapes
+        phase[0] = "baseline"
+        time.sleep(1.0)
+        v2 = reg.publish(spec="synthetic:double:2", alias="prod")
+        killer = threading.Timer(0.15, group.kill_replica, args=(1,))
+        phase[0] = "swap"
+        killer.start()
+        t0 = time.perf_counter()
+        group.rolling_update(v2, settle=0.3)
+        swap_seconds = time.perf_counter() - t0
+        killer.join()
+        phase[0] = "after"
+        versions = [d and d.get("version")
+                    for d in group.version_info(timeout=30)]
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        group.stop()
+
+    def pctl(xs, p):
+        return float(np.percentile(np.asarray(xs) * 1e3, p)) \
+            if xs else float("nan")
+
+    extra["lifecycle_baseline_p50_ms"] = round(pctl(lats["baseline"],
+                                                    50), 2)
+    extra["lifecycle_baseline_p99_ms"] = round(pctl(lats["baseline"],
+                                                    99), 2)
+    extra["lifecycle_swap_p50_ms"] = round(pctl(lats["swap"], 50), 2)
+    extra["lifecycle_swap_p99_ms"] = round(pctl(lats["swap"], 99), 2)
+    if lats["baseline"] and lats["swap"]:
+        extra["lifecycle_swap_p99_ratio"] = round(
+            pctl(lats["swap"], 99) / max(pctl(lats["baseline"], 99),
+                                         1e-9), 3)
+    extra["lifecycle_swap_seconds"] = round(swap_seconds, 3)
+    extra["lifecycle_failed"] = len(failures)
+    extra["lifecycle_restarts"] = group.restarts()
+    extra["lifecycle_mixed_version"] = int(
+        any(v != versions[0] for v in versions))
+    assert not failures, failures[:5]
+    assert versions.count(versions[0]) == len(versions), versions
+
+
 def main():
     import jax
 
@@ -1037,6 +1132,10 @@ def main():
             bench_serving_ha(extra)
         except Exception as e:  # noqa: BLE001
             extra["serving_ha_error"] = repr(e)
+        try:
+            bench_lifecycle(extra)
+        except Exception as e:  # noqa: BLE001
+            extra["lifecycle_error"] = repr(e)
         try:
             bench_llm_serving(extra)
         except Exception as e:  # noqa: BLE001

@@ -1,0 +1,636 @@
+"""Zero-downtime model lifecycle (docs/model_lifecycle.md): hot-swap
+reload semantics, version pinning + A/B routing, rolling updates with
+auto-rollback, the shadow-eval promotion gate, and the chaos matrix the
+ISSUE names (SIGKILL mid-reload, corrupt publish, injected canary
+error-rate, dedup across a version flip).
+
+In-process tests run against stand-in models (jax-free, tier-1 fast);
+the subprocess chaos pieces carry the ``chaos``/``lifecycle`` markers
+like their serving-HA siblings.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from zoo_tpu.serving.ha import SyntheticModel, resolve_model_spec
+from zoo_tpu.serving.registry import ModelRegistry
+from zoo_tpu.serving.server import ServingServer
+from zoo_tpu.serving.tcp_client import TCPInputQueue, _Connection
+from zoo_tpu.util.resilience import clear_faults, inject
+
+
+def _x(v, n=1, feat=4):
+    return np.full((n, feat), float(v), np.float32)
+
+
+class _MarkerModel:
+    """y = factor * x, recording the marker (column 0) of every row it
+    actually computed — the witness that deduped requests never reached
+    inference, across version flips included."""
+
+    def __init__(self, factor=2.0, delay=0.0):
+        self.factor = factor
+        self.delay = delay
+        self.rows = []
+
+    def predict(self, x, batch_size=None):
+        if self.delay:
+            time.sleep(self.delay)
+        self.rows.extend(np.asarray(x)[:, 0].tolist())
+        return np.asarray(x) * self.factor
+
+    def seen(self, marker):
+        return sum(1 for r in self.rows if r == float(marker))
+
+
+def _registry_with(tmp_path, *specs, alias="prod"):
+    reg = ModelRegistry(str(tmp_path / "registry"))
+    versions = [reg.publish(spec=s) for s in specs]
+    if alias and versions:
+        reg.set_alias(alias, versions[0])
+    return reg, versions
+
+
+# ---------------------------------------------------------- hot-swap
+
+def test_reload_flips_version_and_keeps_serving(tmp_path):
+    reg, (v1, v2) = _registry_with(tmp_path, "synthetic:double:0",
+                                   "synthetic:double:0")
+    model, version = resolve_model_spec(f"registry:{reg.root}:prod")
+    assert version == v1
+    server = ServingServer(model, batch_size=4, version=version,
+                           model_spec=f"registry:{reg.root}:prod").start()
+    try:
+        q = TCPInputQueue(server.host, server.port)
+        np.testing.assert_allclose(q.predict(_x(1.0)), _x(1.0) * 2)
+        assert q.version()["version"] == v1
+        conn = _Connection(server.host, server.port)
+        resp = conn.rpc({"op": "reload",
+                         "spec": f"registry:{reg.root}:{v2}"})
+        assert resp.get("ok"), resp
+        assert resp["version"] == v2 and resp["previous"] == v1
+        # the warm pass primed the input signature live traffic used
+        assert resp["warmed"] == 1
+        assert q.version()["version"] == v2
+        np.testing.assert_allclose(q.predict(_x(2.0)), _x(2.0) * 2)
+        # every reply now advertises v2 (the A/B client learns from it)
+        assert conn.rpc({"op": "ping"})["version"] == v2
+        conn.close()
+        q.close()
+    finally:
+        server.stop()
+
+
+def test_failed_reload_never_flips(tmp_path):
+    """A candidate that fails load OR warm leaves the incumbent
+    serving: corrupt registry version (load fails) and broken model
+    (warm fails) both reject without a flip."""
+    reg, (v1, v2, v3) = _registry_with(
+        tmp_path, "synthetic:double:0", "synthetic:broken",
+        "synthetic:double:0")
+    # corrupt v3 on disk
+    path = reg.resolve(v3)[1]
+    with open(os.path.join(path, "MODEL"), "ab") as f:
+        f.write(b"rot")
+    reg._verified_ok.discard(3)
+    model, version = resolve_model_spec(f"registry:{reg.root}:prod")
+    server = ServingServer(model, batch_size=4, version=version).start()
+    try:
+        q = TCPInputQueue(server.host, server.port)
+        q.predict(_x(1.0))  # teach the warm shape
+        conn = _Connection(server.host, server.port)
+        # broken model: loads, then the warm inference raises
+        resp = conn.rpc({"op": "reload",
+                         "spec": f"registry:{reg.root}:{v2}"})
+        assert resp.get("reload_failed") and "broken" in resp["error"]
+        assert q.version()["version"] == v1
+        # corrupt version: the registry quarantines at load
+        resp = conn.rpc({"op": "reload",
+                         "spec": f"registry:{reg.root}:{v3}"})
+        assert resp.get("reload_failed")
+        assert "Corrupt" in resp["error"] or "corrupt" in resp["error"]
+        assert q.version()["version"] == v1
+        np.testing.assert_allclose(q.predict(_x(5.0)), _x(5.0) * 2)
+        conn.close()
+        q.close()
+    finally:
+        server.stop()
+
+
+def test_swap_is_atomic_under_concurrent_load(tmp_path):
+    """Clients hammering predict across a flip never see an error or a
+    wrong answer — both versions compute 2x, so ANY response is
+    verifiable while the flip lands between batches."""
+    reg, (v1, v2) = _registry_with(tmp_path, "synthetic:double:1",
+                                   "synthetic:double:1")
+    model, version = resolve_model_spec(f"registry:{reg.root}:prod")
+    server = ServingServer(model, batch_size=4, max_wait_ms=1.0,
+                           version=version).start()
+    errors = []
+    stop = threading.Event()
+
+    def hammer():
+        q = TCPInputQueue(server.host, server.port)
+        i = 0
+        while not stop.is_set():
+            i += 1
+            try:
+                out = np.asarray(q.predict(_x(i)))
+                if not np.allclose(out, _x(i) * 2):
+                    raise AssertionError(f"bad answer for {i}")
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+        q.close()
+
+    threads = [threading.Thread(target=hammer) for _ in range(3)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.2)
+        assert server.reload_model(
+            f"registry:{reg.root}:{v2}")["version"] == v2
+        time.sleep(0.2)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        server.stop()
+    assert not errors, errors[:5]
+
+
+def test_dedup_preserved_across_version_flip(tmp_path):
+    """Chaos satellite: a mid-RPC reset retry whose re-send lands AFTER
+    a hot-swap still joins the original execution — the request id is
+    the identity, not the model version, so the model (either version)
+    runs the marker exactly once."""
+    m1, m2 = _MarkerModel(delay=0.2), _MarkerModel()
+    server = ServingServer(m1, batch_size=2, max_wait_ms=1.0,
+                           version="v1",
+                           model_loader=lambda s: (m2, "v2")).start()
+    try:
+        clear_faults()
+        flipped = threading.Event()
+
+        def flip_mid_retry():
+            # land the flip while the first attempt's batch (0.2s
+            # inference) is still in flight and the client is about to
+            # retry after its injected reset
+            time.sleep(0.05)
+            server.reload_model("whatever", version="v2")
+            flipped.set()
+
+        threading.Thread(target=flip_mid_retry, daemon=True).start()
+        with inject("serving.client.recv",
+                    exc=ConnectionResetError("mid-RPC reset"),
+                    times=1) as armed:
+            q = TCPInputQueue(server.host, server.port)
+            out = np.asarray(q.predict(_x(13.0)))
+            assert armed.fired == 1
+        flipped.wait(timeout=5)
+        np.testing.assert_allclose(out, _x(13.0) * 2.0)
+        assert m1.seen(13.0) + m2.seen(13.0) == 1, \
+            "retry across the version flip double-executed the request"
+        q.close()
+    finally:
+        clear_faults()
+        server.stop()
+
+
+# ------------------------------------------------- version pinning / A/B
+
+def _two_version_servers():
+    """Two in-process servers standing in for a mid-rollout group:
+    one on v1, one on v2 (both y=2x, so answers verify either way)."""
+    s1 = ServingServer(SyntheticModel(), batch_size=4, max_wait_ms=1.0,
+                       version="v1").start()
+    s2 = ServingServer(SyntheticModel(), batch_size=4, max_wait_ms=1.0,
+                       version="v2").start()
+    return s1, s2
+
+
+def test_version_mismatch_bounced_and_routed():
+    from zoo_tpu.serving.ha_client import HAServingClient
+
+    s1, s2 = _two_version_servers()
+    try:
+        # single-endpoint client: the bounce surfaces as a shed
+        conn = _Connection(s1.host, s1.port)
+        resp = conn.rpc({"op": "predict", "uri": "u", "data": _x(1.0),
+                         "model_version": "v2"})
+        assert resp.get("shed") and resp.get("version_mismatch")
+        assert resp["version"] == "v1"  # teaches the client the truth
+        conn.close()
+        # HA client: failover lands the pinned request on the right seat
+        cli = HAServingClient([(s1.host, s1.port), (s2.host, s2.port)],
+                              deadline_ms=8000, hedge=False)
+        for _ in range(4):
+            out = cli.predict(_x(3.0), model_version="v2")
+            np.testing.assert_allclose(out, _x(3.0) * 2)
+        # the learned seat versions now steer the plan directly
+        assert sorted(ep.seen_version for ep in cli._eps
+                      if ep.seen_version) == ["v1", "v2"]
+        cli.close()
+    finally:
+        s1.stop()
+        s2.stop()
+
+
+def test_ab_split_routes_fraction():
+    from zoo_tpu.serving.ha_client import HAServingClient
+
+    s1, s2 = _two_version_servers()
+    try:
+        cli = HAServingClient([(s1.host, s1.port), (s2.host, s2.port)],
+                              deadline_ms=8000, hedge=False,
+                              ab_split={"v2": 0.5})
+        cli._ab_rng.seed(42)
+        for i in range(40):
+            np.testing.assert_allclose(cli.predict(_x(i)), _x(i) * 2)
+        # a 50% split at n=40 lands well inside (5, 35) w.h.p.
+        drawn = sum(cli._draw_version() == "v2" for _ in range(200))
+        assert 60 <= drawn <= 140
+        # pin_version(None) clears
+        cli.pin_version(None)
+        assert cli._draw_version() is None
+        cli.pin_version("v2", 1.0)
+        assert cli._draw_version() == "v2"
+        with pytest.raises(ValueError):
+            cli.set_ab_split({"v2": 0.8, "v3": 0.5})  # sums past 1
+        with pytest.raises(ValueError):
+            cli.set_ab_split({"v2": -0.1})
+        cli.close()
+    finally:
+        s1.stop()
+        s2.stop()
+
+
+def test_ab_split_env_parsing(monkeypatch):
+    from zoo_tpu.serving import ha_client as hc
+
+    assert hc._parse_ab_split("v2=0.1, v3=0.05") == {"v2": 0.1,
+                                                     "v3": 0.05}
+    assert hc._parse_ab_split("") == {}
+    s1 = ServingServer(SyntheticModel(), batch_size=2,
+                       version="v1").start()
+    try:
+        monkeypatch.setenv("ZOO_SERVE_AB_SPLIT", "v1=1.0")
+        cli = hc.HAServingClient([(s1.host, s1.port)], hedge=False)
+        assert cli._draw_version() == "v1"
+        np.testing.assert_allclose(cli.predict(_x(1.0)), _x(1.0) * 2)
+        cli.close()
+    finally:
+        s1.stop()
+
+
+def test_refresh_endpoints_keeps_surviving_state():
+    from zoo_tpu.serving.ha_client import HAServingClient
+
+    s1, s2 = _two_version_servers()
+    s3 = ServingServer(SyntheticModel(), batch_size=4,
+                       version="v2").start()
+    try:
+        cli = HAServingClient([(s1.host, s1.port), (s2.host, s2.port)],
+                              deadline_ms=8000, hedge=False)
+        cli.predict(_x(1.0))
+        cli.predict(_x(2.0))
+        survivor = next(ep for ep in cli._eps
+                        if (ep.host, ep.port) == (s1.host, s1.port))
+        survivor.breaker.record_failure()  # distinctive state
+        seen = survivor.seen_version
+        # rolling resize: s2 leaves, s3 joins, s1 survives
+        cli.refresh_endpoints([(s1.host, s1.port), (s3.host, s3.port)])
+        kept = next(ep for ep in cli._eps
+                    if (ep.host, ep.port) == (s1.host, s1.port))
+        assert kept is survivor, "surviving endpoint was rebuilt"
+        assert kept.seen_version == seen
+        assert kept.breaker._failures == 1, \
+            "surviving endpoint's breaker state was reset"
+        assert {(ep.host, ep.port) for ep in cli._eps} == {
+            (s1.host, s1.port), (s3.host, s3.port)}
+        np.testing.assert_allclose(cli.predict(_x(9.0)), _x(9.0) * 2)
+        with pytest.raises(ValueError):
+            cli.refresh_endpoints([])
+        cli.close()
+    finally:
+        s1.stop()
+        s2.stop()
+        s3.stop()
+
+
+# ------------------------------------------------------ drain satellite
+
+def test_drain_honors_env_timeout_and_metric(monkeypatch):
+    from zoo_tpu.obs.metrics import get_registry
+
+    monkeypatch.setenv("ZOO_SERVE_DRAIN_TIMEOUT_S", "0.05")
+    model = _MarkerModel(delay=0.5)
+    server = ServingServer(model, batch_size=2, max_wait_ms=1.0).start()
+    done = []
+
+    def slow_req():
+        q = TCPInputQueue(server.host, server.port)
+        try:
+            done.append(np.asarray(q.predict(_x(1.0))))
+        except Exception:  # noqa: BLE001 — the drain may cut it off
+            pass
+
+    t = threading.Thread(target=slow_req, daemon=True)
+    t.start()
+    time.sleep(0.1)  # request is mid-inference (0.5s)
+    t0 = time.perf_counter()
+    drained = server.drain()  # timeout=None -> env 0.05s
+    dt = time.perf_counter() - t0
+    assert drained is False, "0.05s budget cannot cover 0.5s inference"
+    # well under the 30s default (the tail past 0.05s is socketserver's
+    # shutdown poll interval, not the drain wait)
+    assert dt < 2.0, f"env drain timeout not honored ({dt:.2f}s)"
+    snap = get_registry().snapshot()
+    fam = [h for h in snap["histograms"]
+           if h["name"] == "zoo_serve_drain_seconds"]
+    assert fam and sum(h["count"] for h in fam) >= 1, \
+        "zoo_serve_drain_seconds not observed"
+
+
+# -------------------------------------------------- promotion gate
+
+def test_promotion_gate_rejects_injected_canary_errors(tmp_path):
+    """Chaos satellite: fault_point("serving.canary") injects a
+    regressed canary error rate; the gate must reject, leave prod on
+    the incumbent, and drop the canary alias."""
+    from zoo_tpu.orca.learn.continuous import PromotionGate
+
+    reg, (v1, v2) = _registry_with(tmp_path, "synthetic:double:0",
+                                   "synthetic:double:0")
+    reg.set_alias("canary", v2)
+    good = lambda x: np.asarray(x) * 2.0  # noqa: E731
+
+    def traffic(n=100):
+        rs = np.random.RandomState(3)
+        for _ in range(n):
+            x = rs.randn(1, 4).astype(np.float32)
+            yield x, x * 2.0
+
+    clear_faults()
+    try:
+        with inject("serving.canary", exc=RuntimeError("canary 500"),
+                    p=0.3) as armed:
+            gate = PromotionGate(good, good, candidate=v2, registry=reg,
+                                 sample=1.0, window=30,
+                                 rng=np.random.RandomState(0))
+            verdict = gate.run(traffic())
+            assert armed.fired >= 1
+        assert not verdict.promoted
+        assert "error rate" in verdict.reason
+        assert reg.alias_version("prod") == v1
+        assert reg.alias_version("canary") is None  # demoted
+    finally:
+        clear_faults()
+
+
+def test_promotion_gate_rejects_latency_and_loss_regression(tmp_path):
+    from zoo_tpu.orca.learn.continuous import PromotionGate
+
+    reg, (v1, v2) = _registry_with(tmp_path, "synthetic:double:0",
+                                   "synthetic:double:0")
+    fast = lambda x: np.asarray(x) * 2.0  # noqa: E731
+
+    def slow(x):
+        time.sleep(0.01)
+        return np.asarray(x) * 2.0
+
+    def wrong(x):
+        return np.asarray(x) * 2.5  # regressed loss vs y_true = 2x
+
+    def traffic(n=60):
+        rs = np.random.RandomState(5)
+        for _ in range(n):
+            x = rs.randn(1, 4).astype(np.float32) + 1.0
+            yield x, x * 2.0
+
+    gate = PromotionGate(fast, slow, candidate=v2, registry=reg,
+                         sample=1.0, window=16, max_latency_ratio=2.0,
+                         rng=np.random.RandomState(0))
+    verdict = gate.run(traffic())
+    assert not verdict.promoted and "p50" in verdict.reason
+    gate = PromotionGate(fast, wrong, candidate=v2, registry=reg,
+                         sample=1.0, window=16, max_loss_ratio=1.1,
+                         rng=np.random.RandomState(0))
+    verdict = gate.run(traffic())
+    assert not verdict.promoted and "loss" in verdict.reason
+    assert reg.alias_version("prod") == v1
+
+
+def test_continuous_loop_demotes_diverged_candidate(tmp_path):
+    from zoo_tpu.orca.learn.continuous import ContinuousTrainingLoop
+    from zoo_tpu.orca.learn.guard import TrainingDiverged
+
+    reg, (v1,) = _registry_with(tmp_path, "synthetic:double:0")
+
+    def bad_train(window):
+        raise TrainingDiverged("loss spiked 10x over rolling median")
+
+    loop = ContinuousTrainingLoop(bad_train, reg)
+    out = loop.step(window=None)
+    assert out["outcome"] == "demoted"
+    assert reg.versions() == [1], "a diverged candidate was published"
+    assert reg.alias_version("prod") == v1
+
+
+def test_continuous_chronos_loop_end_to_end(tmp_path):
+    """The paper's Chronos + Serving pillars composed: a REAL Chronos
+    forecaster retrains on a streaming window, the ``.zoo`` artifact is
+    published as an immutable registry version, shadow-evaled against
+    the serving incumbent on live-shaped traffic, and promoted — twice,
+    so the second crank exercises a real incumbent-vs-candidate gate
+    over models loaded back from the registry."""
+    from zoo_tpu.chronos.forecaster.lstm_forecaster import LSTMForecaster
+    from zoo_tpu.orca.learn.continuous import (
+        ContinuousTrainingLoop,
+        PromotionGate,
+        chronos_train_fn,
+    )
+
+    past, feat = 8, 2
+    rs = np.random.RandomState(0)
+
+    def stream_window(n=96):
+        # y = mean of the last row's features: learnable in one epoch
+        x = rs.randn(n, past, feat).astype(np.float32)
+        y = x[:, -1:, :1] * 0.5 + x[:, -1:, 1:] * 0.5
+        return x, y
+
+    reg = ModelRegistry(str(tmp_path / "registry"))
+    train_fn = chronos_train_fn(
+        lambda: LSTMForecaster(past_seq_len=past, input_feature_num=feat,
+                               output_feature_num=1, hidden_dim=8),
+        epochs=2, batch_size=32, out_dir=str(tmp_path / "artifacts"))
+
+    # crank 1: empty registry, no incumbent -> direct promotion
+    loop = ContinuousTrainingLoop(train_fn, reg)
+    out1 = loop.step(stream_window())
+    assert out1["outcome"] == "promoted" and out1["version"] == "v1"
+    assert reg.alias_version("prod") == "v1"
+    _, artifact = reg.model_spec("prod")
+    assert artifact.endswith("model.zoo")
+
+    # crank 2: gate the new candidate against the serving incumbent,
+    # both loaded back from the registry (the replica load path)
+    def gate_factory(candidate):
+        inc = resolve_model_spec(f"registry:{reg.root}:prod")[0]
+        can = resolve_model_spec(f"registry:{reg.root}:{candidate}")[0]
+        return PromotionGate(
+            lambda x: inc.predict(x), lambda x: can.predict(x),
+            candidate=candidate, registry=reg, sample=1.0, window=12,
+            max_latency_ratio=50.0,  # CPU timing noise is not the point
+            rng=np.random.RandomState(1))
+
+    loop = ContinuousTrainingLoop(train_fn, reg,
+                                  gate_factory=gate_factory)
+    xs, ys = stream_window(32)
+    traffic = [(xs[i:i + 1], ys[i:i + 1].reshape(1, -1))
+               for i in range(len(xs))]
+    out2 = loop.step(stream_window(), traffic)
+    assert out2["outcome"] == "promoted", out2
+    assert out2["version"] == "v2"
+    assert reg.alias_version("prod") == "v2"
+    assert out2["gate"]["mirrored"] >= 12
+    # both versions remain immutable history in the registry
+    assert reg.versions() == [1, 2]
+
+
+# ------------------------------------------------------- chaos (group)
+
+@pytest.mark.chaos
+def test_sigkill_mid_reload_respawns_on_aliased_version(tmp_path):
+    """Chaos satellite: a replica SIGKILLed while reload is warming the
+    incoming model must never serve a half-loaded model — the
+    supervisor respawn re-resolves the alias and boots on the NEW
+    version (the alias moved before the swap), not the stale one."""
+    from zoo_tpu.serving.ha import ReplicaGroup
+    from zoo_tpu.util.resilience import RetryError, RetryPolicy
+
+    reg = ModelRegistry(str(tmp_path / "registry"))
+    reg.publish(spec="synthetic:double:1", alias="prod")
+    # v2's 300ms per-predict delay makes the warm pass a wide window
+    v2 = reg.publish(spec="synthetic:double:300")
+    group = ReplicaGroup(f"registry:{reg.root}:prod", num_replicas=1,
+                         max_restarts=2, batch_size=4, max_wait_ms=1.0,
+                         log_dir=str(tmp_path / "logs"))
+    group.start(timeout=60)
+    try:
+        conn = _Connection(group.host, group.ports[0],
+                           retry=RetryPolicy(max_attempts=1))
+        np.testing.assert_allclose(
+            np.asarray(conn.rpc({"op": "predict", "uri": "u",
+                                 "data": _x(1.0)})["result"]),
+            _x(1.0) * 2)  # teach the warm shape
+        reg.set_alias("prod", v2)  # alias moves BEFORE the swap
+
+        def kill_mid_warm():
+            time.sleep(0.1)  # inside the 300ms warm inference
+            group.kill_replica(0)
+
+        threading.Thread(target=kill_mid_warm, daemon=True).start()
+        with pytest.raises((OSError, RetryError)):
+            conn.rpc({"op": "reload",
+                      "spec": f"registry:{reg.root}:{v2}"})
+        conn.close()
+        # the respawn resolves prod -> v2 at boot
+        deadline = time.monotonic() + 60
+        version = None
+        while time.monotonic() < deadline:
+            try:
+                c = _Connection(group.host, group.ports[0],
+                                retry=RetryPolicy(max_attempts=1))
+                version = c.rpc({"op": "version"}).get("version")
+                c.close()
+                break
+            except (OSError, RetryError):
+                time.sleep(0.1)
+        assert version == v2, \
+            f"respawn came up on {version}, not the aliased {v2}"
+        assert group.restarts() >= 1
+    finally:
+        group.stop()
+
+
+@pytest.mark.chaos
+def test_rolling_update_rejects_corrupt_target_before_touching(tmp_path):
+    """A corrupt published version fails rolling_update at resolution —
+    BEFORE any replica is contacted — and is quarantined."""
+    from zoo_tpu.serving.ha import ReplicaGroup
+    from zoo_tpu.serving.registry import RegistryCorruptError
+
+    reg = ModelRegistry(str(tmp_path / "registry"))
+    v1 = reg.publish(spec="synthetic:double:1", alias="prod")
+    v2 = reg.publish(spec="synthetic:double:1")
+    path = reg.resolve(v2)[1]
+    with open(os.path.join(path, "MODEL"), "ab") as f:
+        f.write(b"rot")
+    reg._verified_ok.discard(2)
+    group = ReplicaGroup(f"registry:{reg.root}:prod", num_replicas=1,
+                         max_restarts=1, batch_size=4)
+    group.start(timeout=60)
+    try:
+        with pytest.raises(RegistryCorruptError):
+            group.rolling_update(v2)
+        assert [d and d.get("version")
+                for d in group.version_info()] == [v1]
+        assert any(".corrupt" in n for n in os.listdir(reg.versions_dir))
+    finally:
+        group.stop()
+
+
+@pytest.mark.lifecycle
+@pytest.mark.slow
+def test_registry_published_llm_spec_boots_llm_replica(tmp_path):
+    """A registry version may hold an llm MODEL pointer (llama:*): the
+    replica resolves the alias at boot and mounts the generate engine
+    — streaming works through the registry indirection, and the
+    version travels on the wire identity."""
+    from zoo_tpu.serving.ha import ReplicaGroup
+    from zoo_tpu.serving.ha_client import HAServingClient
+
+    reg = ModelRegistry(str(tmp_path / "registry"))
+    v1 = reg.publish(
+        spec="llama:tiny:slots=4,block=8,blocks=96,tables=8,"
+             "buckets=16/32", alias="prod")
+    group = ReplicaGroup(f"registry:{reg.root}:prod", num_replicas=1,
+                         max_restarts=1,
+                         log_dir=str(tmp_path / "logs"))
+    group.start(timeout=300)  # one jax import + tiny-llama build
+    try:
+        assert group.version_info()[0].get("version") == v1
+        cli = HAServingClient(group.endpoints(), deadline_ms=60000)
+        toks = list(cli.generate(np.arange(1, 7), max_new_tokens=4))
+        assert len(toks) == 4
+        cli.close()
+    finally:
+        group.stop()
+
+
+# ------------------------------------------------------ lifecycle smoke
+
+@pytest.mark.lifecycle
+@pytest.mark.chaos
+def test_check_lifecycle_script_runs():
+    """The end-to-end lifecycle chaos smoke
+    (scripts/check_lifecycle.py): 3-replica group under sustained
+    verified load — publish v2 → shadow-eval → promote → rolling swap
+    with one SIGKILL injected → broken-candidate auto-rollback; zero
+    client-visible failures, zero mixed-version replicas, all replicas
+    reporting v2 on /metrics. Run as a subprocess, the operator
+    invocation."""
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join("scripts", "check_lifecycle.py")],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "LIFECYCLE OK" in proc.stdout

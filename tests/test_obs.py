@@ -397,7 +397,7 @@ def test_metrics_end_to_end_serving_fit_checkpoint(orca_ctx, tmp_path):
     slow_srv = ServingServer(_Stall(3.0, 0.5), port=0, batch_size=1,
                              max_wait_ms=0.0).start()
     fast_srv = ServingServer(_Stall(2.0, 0.0), port=0, batch_size=2,
-                             max_wait_ms=1.0).start()
+                             max_wait_ms=1.0, version="v9").start()
     try:
         conn = _Connection(shed_srv.host, shed_srv.port)
         resp = conn.rpc({"op": "predict", "uri": "u",
@@ -427,6 +427,20 @@ def test_metrics_end_to_end_serving_fit_checkpoint(orca_ctx, tmp_path):
         hedged = np.asarray(cli2.predict(np.ones((1, 2), np.float32)))
         np.testing.assert_allclose(hedged, 2.0)  # the fast replica won
         cli2.close()
+        # the model-lifecycle families (docs/model_lifecycle.md): a
+        # version-pinned mismatch bounce and a pinned A/B request
+        conn = _Connection(fast_srv.host, fast_srv.port)
+        resp = conn.rpc({"op": "predict", "uri": "u",
+                         "data": np.zeros((1, 2), np.float32),
+                         "model_version": "v8"})
+        assert resp.get("version_mismatch") and resp["version"] == "v9"
+        conn.close()
+        cli3 = HAServingClient([(fast_srv.host, fast_srv.port)],
+                               hedge=False, deadline_ms=8000)
+        np.testing.assert_allclose(
+            np.asarray(cli3.predict(np.ones((1, 2), np.float32),
+                                    model_version="v9")), 2.0)
+        cli3.close()
     finally:
         shed_srv.stop()
         slow_srv.stop()
@@ -462,6 +476,9 @@ def test_metrics_end_to_end_serving_fit_checkpoint(orca_ctx, tmp_path):
             "zoo_serve_failover_total",
             'zoo_serve_hedge_total{event="fired"}',
             'zoo_serve_hedge_total{event="won"}',
+            'zoo_serve_shed_total{reason="version_mismatch"}',
+            'zoo_registry_version_info{version="v9"} 1',
+            'zoo_serve_ab_requests_total{version="v9",outcome="ok"}',
             "zoo_llm_kv_blocks_used 4",
             "zoo_llm_kv_blocks_free 12",
             # the GSPMD layer (docs/multichip.md): the fixture's 8-device

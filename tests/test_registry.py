@@ -1,0 +1,298 @@
+"""Versioned model registry (docs/model_lifecycle.md): atomic publish,
+verify-or-quarantine resolution, atomic alias moves, pin/alias-aware
+retention GC — plus the checkpoint-side retention satellite
+(``CheckpointManager(keep=N)`` bounding steps AND quarantine dirs while
+the newest-verified fallback chain survives).
+
+Everything here is jax-free except the checkpoint tests (CheckpointManager
+imports jax at module level), and nothing spawns processes — tier-1 fast.
+"""
+
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from zoo_tpu.serving.registry import (
+    ModelRegistry,
+    RegistryCorruptError,
+    is_registry_spec,
+    parse_registry_spec,
+)
+
+
+def _mk(tmp_path, keep=8) -> ModelRegistry:
+    return ModelRegistry(str(tmp_path / "registry"), keep=keep)
+
+
+# ---------------------------------------------------------------- specs
+
+def test_registry_spec_parsing():
+    assert is_registry_spec("registry:/r:prod")
+    assert not is_registry_spec("synthetic:double")
+    assert parse_registry_spec("registry:/a/b:prod") == ("/a/b", "prod")
+    assert parse_registry_spec("registry:/a/b:v7") == ("/a/b", "v7")
+    # no ref → the prod alias
+    assert parse_registry_spec("registry:/a/b") == ("/a/b", "prod")
+    with pytest.raises(ValueError):
+        parse_registry_spec("registry:")
+
+
+# -------------------------------------------------------------- publish
+
+def test_publish_resolve_roundtrip_spec_and_file(tmp_path):
+    reg = _mk(tmp_path)
+    v1 = reg.publish(spec="synthetic:double:2")
+    assert v1 == "v1"
+    version, inner = reg.model_spec("v1")
+    assert (version, inner) == ("v1", "synthetic:double:2")
+    # file payload: copied in, resolved back as the file path
+    src = tmp_path / "model.zoo"
+    src.write_bytes(b"weights-bytes")
+    v2 = reg.publish(str(src))
+    version, inner = reg.model_spec(v2)
+    assert version == "v2" and inner.endswith("model.zoo")
+    with open(inner, "rb") as f:
+        assert f.read() == b"weights-bytes"
+    # dir payload: resolved as the version dir (SavedModel layout)
+    d = tmp_path / "saved"
+    d.mkdir()
+    (d / "graph.pb").write_bytes(b"g")
+    (d / "weights.bin").write_bytes(b"w")
+    v3 = reg.publish(str(d))
+    version, inner = reg.model_spec(v3)
+    assert version == "v3" and os.path.isdir(inner)
+    assert sorted(os.listdir(inner)) == ["graph.pb", "manifest.json",
+                                         "weights.bin"]
+
+
+def test_publish_requires_exactly_one_source(tmp_path):
+    reg = _mk(tmp_path)
+    with pytest.raises(ValueError):
+        reg.publish()
+    with pytest.raises(ValueError):
+        reg.publish("/nope", spec="synthetic:double")
+    with pytest.raises(FileNotFoundError):
+        reg.publish(str(tmp_path / "missing.zoo"))
+
+
+def test_resolve_refs(tmp_path):
+    reg = _mk(tmp_path)
+    reg.publish(spec="a", alias="prod")
+    reg.publish(spec="b")
+    assert reg.resolve("v1")[0] == "v1"
+    assert reg.resolve(1)[0] == "v1"
+    assert reg.resolve("latest")[0] == "v2"
+    assert reg.resolve("prod")[0] == "v1"
+    with pytest.raises(KeyError):
+        reg.resolve("staging")  # unknown alias
+    with pytest.raises(FileNotFoundError):
+        reg.resolve("v99")
+
+
+# -------------------------------------------- corruption -> quarantine
+
+def _corrupt_one_file(path):
+    for name in os.listdir(path):
+        if name != "manifest.json":
+            with open(os.path.join(path, name), "ab") as f:
+                f.write(b"\x00bitrot")
+            return name
+    raise AssertionError("no payload file to corrupt")
+
+
+def test_corrupt_version_quarantined_never_served(tmp_path):
+    reg = _mk(tmp_path)
+    reg.publish(spec="good", alias="prod")
+    v2 = reg.publish(spec="will-rot", alias="canary")
+    _corrupt_one_file(reg.resolve(v2)[1])
+    reg._verified_ok.discard(2)  # fresh process would re-verify
+    with pytest.raises(RegistryCorruptError):
+        reg.resolve("canary")
+    # quarantined, gone from the committed set, prod unaffected
+    assert reg.versions() == [1]
+    assert any(".corrupt" in n for n in os.listdir(reg.versions_dir))
+    assert reg.resolve("prod")[0] == "v1"
+    # the number is burned: the next publish never reuses v2
+    assert reg.publish(spec="fresh") == "v3"
+
+
+def test_missing_manifest_is_corrupt_not_legacy(tmp_path):
+    """Unlike pre-manifest checkpoints, a registry version with no
+    manifest is corrupt, full stop — it never verified at publish."""
+    reg = _mk(tmp_path)
+    v1 = reg.publish(spec="x")
+    os.unlink(os.path.join(reg.resolve(v1)[1], "manifest.json"))
+    reg._verified_ok.discard(1)
+    with pytest.raises(RegistryCorruptError):
+        reg.resolve("v1")
+
+
+def test_set_alias_refuses_corrupt_target(tmp_path):
+    reg = _mk(tmp_path)
+    reg.publish(spec="good", alias="prod")
+    v2 = reg.publish(spec="rot")
+    _corrupt_one_file(reg.resolve(v2)[1])
+    reg._verified_ok.discard(2)
+    with pytest.raises(RegistryCorruptError):
+        reg.set_alias("prod", v2)
+    assert reg.alias_version("prod") == "v1"
+
+
+def test_alias_move_is_atomic_pointer(tmp_path):
+    reg = _mk(tmp_path)
+    reg.publish(spec="a", alias="prod")
+    v2 = reg.publish(spec="b")
+    reg.set_alias("prod", v2)
+    assert reg.alias_version("prod") == "v2"
+    # no torn tmp files left behind
+    assert os.listdir(reg.aliases_dir) == ["prod"]
+    reg.drop_alias("prod")
+    assert reg.alias_version("prod") is None
+    reg.drop_alias("prod")  # idempotent
+    # version-literal alias names could never be reached by resolve()
+    for bad in ("v2", "7", "latest"):
+        with pytest.raises(ValueError):
+            reg.set_alias(bad, 1)
+
+
+# ------------------------------------------------------------ retention
+
+def test_gc_bounds_versions_but_never_aliased_or_pinned(tmp_path):
+    reg = _mk(tmp_path, keep=3)
+    reg.publish(spec="s0", alias="prod")  # v1, protected by alias
+    for i in range(1, 8):
+        reg.publish(spec=f"s{i}")
+    vs = reg.versions()
+    assert len(vs) == 3 and 1 in vs, vs  # bounded, alias survives
+    assert vs[-1] == 8
+    # pin protects an about-to-be-collected version through a publish
+    with reg.pin("latest") as pinned:
+        assert pinned == "v8"
+        for i in range(8, 12):
+            reg.publish(spec=f"s{i}")
+        assert 8 in reg.versions()
+    # pin released → the next publish's GC can collect v8
+    reg.publish(spec="s12")
+    assert 8 not in reg.versions()
+    assert 1 in reg.versions()  # alias still survives
+
+
+def test_gc_ages_corrupt_dirs_and_stale_staging(tmp_path):
+    reg = _mk(tmp_path, keep=2)
+    for i in range(6):
+        v = reg.publish(spec=f"s{i}")
+        _corrupt_one_file(reg.resolve(v)[1])
+        reg._verified_ok.discard(int(v[1:]))
+        with pytest.raises(RegistryCorruptError):
+            reg.resolve(v)
+    reg.gc()  # retention applies at gc time (publish runs it too)
+    corrupt = [n for n in os.listdir(reg.versions_dir)
+               if ".corrupt" in n]
+    assert len(corrupt) <= 2, corrupt
+    # stale staging dir from a "killed publisher" (dead pid) is reaped
+    stale = os.path.join(reg.root, ".tmp-v99-999999999")
+    os.makedirs(stale)
+    reg.gc()
+    assert not os.path.exists(stale)
+
+
+def test_publish_survives_sigkill_midway(tmp_path):
+    """A publisher SIGKILLed mid-stage leaves only a staging dir (no
+    committed version, nothing resolvable), and the next registry user
+    GCs it: the atomic-rename commit protocol, end to end."""
+    root = str(tmp_path / "registry")
+    code = f"""
+import os, sys, time
+sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+from zoo_tpu.serving import registry as R
+reg = R.ModelRegistry({root!r})
+orig = R.write_manifest
+def slow(*a, **k):
+    print("STAGED", flush=True)
+    time.sleep(30)  # killed here: after payload staging, before commit
+    return orig(*a, **k)
+R.write_manifest = slow
+reg.publish(spec="never-commits")
+"""
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().strip() == "STAGED"
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    reg = ModelRegistry(root)
+    assert reg.versions() == []
+    with pytest.raises(FileNotFoundError):
+        reg.resolve("latest")
+    staging = [n for n in os.listdir(reg.root) if n.startswith(".tmp-")]
+    assert staging, "expected the killed publisher's staging dir"
+    reg.gc()
+    assert not [n for n in os.listdir(reg.root)
+                if n.startswith(".tmp-")]
+
+
+# ----------------------------------- checkpoint retention (satellite)
+
+def _rot_step(mgr, step):
+    """Append garbage to a manifest-listed payload file of ``step``
+    (works for both the orbax and pickle codecs)."""
+    import json
+    d = os.path.join(mgr.directory, str(step))
+    with open(os.path.join(d, "manifest.json")) as f:
+        rel = sorted(json.load(f)["files"])[0]
+    with open(os.path.join(d, rel), "ab") as f:
+        f.write(b"rot")
+    mgr._verified_ok.discard(step)
+
+
+def test_ckpt_keep_bounds_steps_and_quarantine(tmp_path):
+    """CheckpointManager(keep=N): a long save loop keeps the step AND
+    .corrupt dir counts bounded instead of growing one dir per save."""
+    from zoo_tpu.orca.learn.ckpt import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), keep=4)
+    state = {"w": np.arange(8.0)}
+    for step in range(1, 21):
+        mgr.save(step, state)
+        # every 4th step rots on disk and gets quarantined on read
+        if step % 4 == 0:
+            _rot_step(mgr, step)
+            assert mgr.latest_verified_step() != step
+        names = os.listdir(mgr.directory)
+        steps = [n for n in names if n.isdigit()]
+        corrupt = [n for n in names if ".corrupt" in n]
+        assert len(steps) <= 4 + 1, steps  # +1: protected newest-verified
+        # read-time quarantines land between saves, so the corrupt
+        # count may overshoot by the one quarantined since the last GC
+        assert len(corrupt) <= 4 + 1, corrupt
+    mgr.gc()
+    corrupt = [n for n in os.listdir(mgr.directory) if ".corrupt" in n]
+    assert len(corrupt) <= 4, corrupt
+    # the fallback chain still restores a verified step
+    restored = mgr.restore()
+    np.testing.assert_array_equal(restored["w"], state["w"])
+
+
+def test_ckpt_gc_protects_newest_verified_fallback(tmp_path):
+    """When every step NEWER than the last verified one is corrupt, GC
+    must not evict the verified anchor — restore(None) still works."""
+    from zoo_tpu.orca.learn.ckpt import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), keep=2)
+    mgr.save(1, {"w": np.ones(4)})
+    assert mgr.latest_verified_step() == 1  # mark step 1 verified
+    for step in range(2, 7):
+        mgr.save(step, {"w": np.full(4, float(step))})
+        _rot_step(mgr, step)  # rot immediately (never verified)
+    # step 1 survived five GCs despite keep=2
+    assert 1 in mgr.all_steps()
+    np.testing.assert_array_equal(mgr.restore()["w"], np.ones(4))

@@ -24,22 +24,32 @@ a worker may be ``kill -9``'d at ANY instant during :meth:`save` and
 
 from __future__ import annotations
 
-import hashlib
-import json
 import logging
 import os
 import pickle
 import re
 import shutil
-import time
-from typing import Any, Dict, List, Optional
+from typing import Any, List, Optional
 
 import jax
 import numpy as np
 
 from zoo_tpu.obs.metrics import counter, histogram
 from zoo_tpu.obs.tracing import span
-from zoo_tpu.util.resilience import fault_point
+from zoo_tpu.util.manifest import (
+    MANIFEST,
+    fsync_dir as _fsync_dir,
+    prune_corrupt,
+    prune_dirs,
+    quarantine_dir,
+    reap_stale_staging,
+    sha256_file as _sha256,
+    verify_manifest,
+    walk_files as _walk_files,
+    write_durable as _write_durable,
+    write_manifest,
+)
+from zoo_tpu.util.resilience import env_int, fault_point
 
 logger = logging.getLogger(__name__)
 
@@ -57,7 +67,6 @@ _quarantined = counter(
 _STEP_RE = re.compile(r"^(\d+)$")
 _TMP_RE = re.compile(r"^\.tmp-(\d+)-(\d+)$")  # .tmp-<step>-<pid>
 _STALE_RE = re.compile(r"^(\d+)\.stale-(\d+)$")  # <step>.stale-<pid>
-MANIFEST = "manifest.json"
 
 
 class CheckpointCorruptError(RuntimeError):
@@ -81,37 +90,6 @@ def _ensure_host(tree):
         return np.asarray(a)
 
     return jax.tree_util.tree_map(to_host, tree)
-
-
-def _fsync_dir(path: str):
-    fd = os.open(path, os.O_RDONLY)
-    try:
-        os.fsync(fd)
-    finally:
-        os.close(fd)
-
-
-def _write_durable(path: str, data: bytes):
-    with open(path, "wb") as f:
-        f.write(data)
-        f.flush()
-        os.fsync(f.fileno())
-
-
-def _sha256(path: str) -> str:
-    h = hashlib.sha256()
-    with open(path, "rb") as f:
-        for chunk in iter(lambda: f.read(1 << 20), b""):
-            h.update(chunk)
-    return h.hexdigest()
-
-
-def _walk_files(root: str) -> List[str]:
-    out = []
-    for dirpath, _, names in os.walk(root):
-        for name in names:
-            out.append(os.path.relpath(os.path.join(dirpath, name), root))
-    return sorted(out)
 
 
 def _apply_sharding(tree: Any, sharding: Any) -> Any:
@@ -149,10 +127,22 @@ def _apply_sharding(tree: Any, sharding: Any) -> Any:
 class CheckpointManager:
     """Crash-safe orbax wrapper with a pickle fallback for exotic pytrees."""
 
-    def __init__(self, directory: str, max_to_keep: int = 5):
+    def __init__(self, directory: str, max_to_keep: Optional[int] = None,
+                 keep: Optional[int] = None):
+        """``keep`` (alias ``max_to_keep``; default ``$ZOO_CKPT_KEEP`` or
+        5) is the retention bound: :meth:`gc` keeps the newest ``keep``
+        committed steps AND at most ``keep`` quarantined
+        ``<step>.corrupt`` dirs — without it both grow one directory per
+        save/quarantine forever on a long-running trainer. The newest
+        hash-VERIFIED step is never a GC victim, so the
+        newest-verified fallback chain (docs/fault_tolerance.md)
+        survives even when every younger step is corrupt."""
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
-        self.max_to_keep = max_to_keep
+        if keep is None:
+            keep = max_to_keep if max_to_keep is not None else \
+                env_int("ZOO_CKPT_KEEP", 5)
+        self.max_to_keep = int(keep)
         # steps this process already hash-verified: restore(None) followed
         # by restore_aux(None) — the elastic resume path — must not read
         # and sha256 a multi-GB snapshot twice
@@ -213,19 +203,9 @@ class CheckpointManager:
                 pickle.dumps(_ensure_host(aux),
                              protocol=pickle.HIGHEST_PROTOCOL))
         fault_point("ckpt.pre_manifest", step=step, dir=tmp)
-        manifest = {"step": int(step), "files": {}}
-        for rel in _walk_files(tmp):
-            full = os.path.join(tmp, rel)
-            # orbax already fsyncs its own payload? not guaranteed — fsync
-            # everything we are about to vouch for in the manifest
-            with open(full, "rb+") as f:
-                os.fsync(f.fileno())
-            manifest["files"][rel] = {
-                "size": os.path.getsize(full), "sha256": _sha256(full)}
-        _write_durable(os.path.join(tmp, MANIFEST),
-                       json.dumps(manifest, indent=1).encode())
-        for dirpath, _, _ in os.walk(tmp):
-            _fsync_dir(dirpath)
+        # orbax already fsyncs its own payload? not guaranteed —
+        # write_manifest fsyncs everything it vouches for
+        write_manifest(tmp, extra={"step": int(step)})
         fault_point("ckpt.pre_rename", step=step, dir=tmp)
         stale = None
         if os.path.isdir(final):
@@ -280,34 +260,12 @@ class CheckpointManager:
             return self._verify(step)
 
     def _verify(self, step: int) -> bool:
-        path = os.path.join(self.directory, str(step))
-        if not os.path.isdir(path):
-            return False
-        mpath = os.path.join(path, MANIFEST)
-        if not os.path.exists(mpath):
-            return os.path.exists(os.path.join(path, "state.pkl")) or \
-                bool(os.listdir(path))
-        try:
-            with open(mpath) as f:
-                manifest = json.load(f)
-            files: Dict[str, Dict] = manifest["files"]
-        except (OSError, ValueError, KeyError) as e:
-            logger.warning("step %d: unreadable manifest (%s)", step, e)
-            return False
-        present = set(_walk_files(path)) - {MANIFEST}
-        if set(files) - present:
-            logger.warning("step %d: missing files %s", step,
-                           sorted(set(files) - present))
-            return False
-        for rel, meta in files.items():
-            full = os.path.join(path, rel)
-            if os.path.getsize(full) != meta["size"]:
-                logger.warning("step %d: %s size mismatch", step, rel)
-                return False
-            if _sha256(full) != meta["sha256"]:
-                logger.warning("step %d: %s checksum mismatch", step, rel)
-                return False
-        return True
+        # steps written before the manifest era predate the atomic-
+        # rename protocol, so their mere presence implies a completed
+        # legacy save (legacy_ok)
+        return verify_manifest(os.path.join(self.directory, str(step)),
+                               what=f"checkpoint step {step}",
+                               legacy_ok=True)
 
     def _verify_or_quarantine(self, step: int) -> bool:
         if step in self._verified_ok and \
@@ -317,20 +275,9 @@ class CheckpointManager:
             self._verified_ok.add(step)
             return True
         self._verified_ok.discard(step)
-        path = os.path.join(self.directory, str(step))
-        dest = path + ".corrupt"
-        n = 0
-        while os.path.exists(dest):
-            n += 1
-            dest = f"{path}.corrupt.{n}"
-        try:
-            os.rename(path, dest)
+        if quarantine_dir(os.path.join(self.directory, str(step)),
+                          what=f"checkpoint step {step}") is not None:
             _quarantined.inc()
-            logger.warning(
-                "quarantined corrupt/incomplete checkpoint step %d -> %s",
-                step, os.path.basename(dest))
-        except OSError as e:  # raced with another quarantiner: fine
-            logger.warning("could not quarantine step %d: %s", step, e)
         return False
 
     def restore(self, step: Optional[int] = None, target: Any = None,
@@ -422,34 +369,28 @@ class CheckpointManager:
             return _apply_sharding(pickle.load(f), sharding)
 
     # -- housekeeping ------------------------------------------------------
+    @property
+    def keep(self) -> int:
+        """Retention bound (``keep=`` / ``max_to_keep=`` ctor alias)."""
+        return self.max_to_keep
+
+    def gc(self):
+        """Bounded disk hygiene (also runs after every :meth:`save`):
+        keep the newest ``keep`` committed steps — but NEVER the newest
+        step this process has hash-verified, so the restore fallback
+        chain survives a run whose youngest steps are all torn — age out
+        ``<step>.corrupt`` quarantine dirs past the same bound, and
+        reap staging/stale dirs whose owning pid is gone."""
+        self._gc()
+
     def _gc(self):
         steps = self.all_steps()
-        while len(steps) > self.max_to_keep:
-            victim = steps.pop(0)
-            shutil.rmtree(os.path.join(self.directory, str(victim)),
-                          ignore_errors=True)
-        # prune quarantined dirs oldest-STEP-first (numeric, not
-        # lexicographic — "10.corrupt" is newer forensics than "2.corrupt")
-        corrupt = sorted(
-            (n for n in os.listdir(self.directory) if ".corrupt" in n),
-            key=lambda n: int(re.match(r"\d+", n).group()
-                              if re.match(r"\d+", n) else 0))
-        while len(corrupt) > self.max_to_keep:
-            shutil.rmtree(os.path.join(self.directory, corrupt.pop(0)),
-                          ignore_errors=True)
-        for name in os.listdir(self.directory):
-            m = _TMP_RE.match(name) or _STALE_RE.match(name)
-            if not m:
-                continue
-            pid = int(m.group(2))
-            if pid == os.getpid():
-                continue
-            try:
-                os.kill(pid, 0)  # saver still alive: leave its staging dir
-            except ProcessLookupError:
-                shutil.rmtree(os.path.join(self.directory, name),
-                              ignore_errors=True)
-                logger.info("removed stale checkpoint staging dir %s "
-                            "(saver pid %d is gone)", name, pid)
-            except PermissionError:
-                pass  # pid exists under another uid: leave it
+        # the newest step KNOWN verified is the restore fallback anchor:
+        # deleting it while every younger step is corrupt would leave
+        # restore(None) with nothing — protect it from retention
+        verified = [s for s in steps if s in self._verified_ok]
+        protect = {str(verified[-1])} if verified else set()
+        prune_dirs(self.directory, [str(s) for s in steps],
+                   self.max_to_keep, protect=protect)
+        prune_corrupt(self.directory, self.max_to_keep)
+        reap_stale_staging(self.directory, _TMP_RE, _STALE_RE)

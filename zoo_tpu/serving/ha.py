@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import sys
 import time
 import urllib.request
@@ -35,8 +36,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from zoo_tpu.obs.metrics import gauge
-from zoo_tpu.util.resilience import RetryPolicy
+from zoo_tpu.obs.metrics import counter, gauge, histogram
+from zoo_tpu.util.resilience import Deadline, RetryPolicy
 
 _replicas_healthy = gauge(
     "zoo_serve_replicas_healthy",
@@ -44,6 +45,17 @@ _replicas_healthy = gauge(
 _replica_restarts = gauge(
     "zoo_serve_replica_restarts",
     "Total replica respawns performed by this ReplicaGroup's supervisor")
+_rolling_updates = counter(
+    "zoo_serve_rolling_update_total",
+    "Rolling updates driven by this ReplicaGroup, by outcome "
+    "(ok / rolled_back — rolled_back = a replica failed "
+    "load/verify/warm or regressed its post-swap probe and the WHOLE "
+    "group was returned to the incumbent version)",
+    labels=("outcome",))
+_rolling_update_seconds = histogram(
+    "zoo_serve_rolling_update_seconds",
+    "Wall time of one whole-group rolling update (drain + swap + probe "
+    "across every replica)")
 
 SYNTHETIC_PREFIX = "synthetic:"
 
@@ -53,35 +65,70 @@ class SyntheticModel:
 
     ``synthetic:double[:delay_ms]`` → y = 2x after an optional per-batch
     delay. Deterministic, so a client can verify every response
-    (``out == 2 * in``) while replicas are being SIGKILLed under it."""
+    (``out == 2 * in``) while replicas are being SIGKILLed under it.
+    ``synthetic:broken[:delay_ms]`` loads fine but raises on every
+    predict — the stand-in for a published model whose weights are
+    garbage, used to exercise warm-failure rollback in rolling
+    updates."""
 
-    def __init__(self, factor: float = 2.0, delay_ms: float = 0.0):
+    def __init__(self, factor: float = 2.0, delay_ms: float = 0.0,
+                 broken: bool = False):
         self.factor = factor
         self.delay = delay_ms / 1000.0
+        self.broken = broken
 
     @classmethod
     def parse(cls, spec: str) -> "SyntheticModel":
         parts = spec[len(SYNTHETIC_PREFIX):].split(":")
         kind = parts[0] or "double"
-        if kind != "double":
-            raise ValueError(f"unknown synthetic model {spec!r} "
-                             "(supported: synthetic:double[:delay_ms])")
+        if kind not in ("double", "broken"):
+            raise ValueError(
+                f"unknown synthetic model {spec!r} (supported: "
+                "synthetic:double[:delay_ms], "
+                "synthetic:broken[:delay_ms])")
         delay_ms = float(parts[1]) if len(parts) > 1 else 0.0
-        return cls(2.0, delay_ms)
+        return cls(2.0, delay_ms, broken=(kind == "broken"))
 
     def predict(self, x, batch_size=None):
         if self.delay:
             time.sleep(self.delay)
+        if self.broken:
+            raise RuntimeError(
+                "synthetic:broken model: every inference fails (bad "
+                "candidate stand-in)")
         return np.asarray(x) * self.factor
 
 
 def load_serving_model(spec: str, batch_size: int = 8):
     """A model from a replica spec: ``synthetic:*`` (jax-free),
-    a TF SavedModel directory, or a serialized ``.zoo`` file (the same
-    resolution order as ``zoo_tpu.serving.run``). ``llama:*`` specs are
-    NOT predict models — they mount the autoregressive engine
-    (``zoo_tpu.serving.llm``) and are resolved by the replica process
-    itself."""
+    ``registry:<root>:<ref>`` (the versioned model registry,
+    docs/model_lifecycle.md), a TF SavedModel directory, or a
+    serialized ``.zoo`` file (the same resolution order as
+    ``zoo_tpu.serving.run``). ``llama:*`` specs are NOT predict models
+    — they mount the autoregressive engine (``zoo_tpu.serving.llm``)
+    and are resolved by the replica process itself."""
+    return resolve_model_spec(spec, batch_size=batch_size)[0]
+
+
+def resolve_model_spec(spec: str, batch_size: int = 8
+                       ) -> Tuple[object, Optional[str]]:
+    """``(model, version)`` — ``version`` is the resolved ``"vN"`` for
+    ``registry:*`` specs (the alias is re-read NOW, so a respawned
+    replica boots on the currently aliased version) and ``None``
+    otherwise. The version stays pinned against registry GC for the
+    duration of the load."""
+    from zoo_tpu.serving.registry import (
+        ModelRegistry,
+        is_registry_spec,
+        parse_registry_spec,
+    )
+    if is_registry_spec(spec):
+        root, ref = parse_registry_spec(spec)
+        reg = ModelRegistry(root)
+        with reg.pin(ref) as version:
+            _, inner = reg.model_spec(version)
+            return load_serving_model(inner,
+                                      batch_size=batch_size), version
     from zoo_tpu.serving.llm.spec import is_llm_spec
     if is_llm_spec(spec):
         raise ValueError(
@@ -90,14 +137,14 @@ def load_serving_model(spec: str, batch_size: int = 8):
             "zoo_tpu.serving.llm.build_llm_engine, or pass it as a "
             "ReplicaGroup model to serve it")
     if spec.startswith(SYNTHETIC_PREFIX):
-        return SyntheticModel.parse(spec)
+        return SyntheticModel.parse(spec), None
     from zoo_tpu.pipeline.inference.inference_model import InferenceModel
     im = InferenceModel(supported_concurrent_num=2)
     if os.path.isdir(spec):
         im.load_tf(spec, batch_size=batch_size)
     else:
         im.load(spec, batch_size=batch_size)
-    return im
+    return im, None
 
 
 def _free_ports(n: int) -> List[int]:
@@ -111,6 +158,11 @@ def _free_ports(n: int) -> List[int]:
     finally:
         for s in socks:
             s.close()
+
+
+class RollingUpdateError(RuntimeError):
+    """A rolling update failed; the group has been rolled back to (or
+    never left) the incumbent version — it is not mixed-version."""
 
 
 class ReplicaGroup:
@@ -142,6 +194,19 @@ class ReplicaGroup:
             raise ValueError("num_replicas must be >= 1")
         self.model = model
         self.host = host
+        # registry-backed groups know their root + alias, which is what
+        # rolling_update / auto-rollback steer (docs/model_lifecycle.md)
+        self.registry_root: Optional[str] = None
+        self.alias: Optional[str] = None
+        from zoo_tpu.serving.registry import (
+            ModelRegistry,
+            is_registry_spec,
+            parse_registry_spec,
+        )
+        if is_registry_spec(model):
+            self.registry_root, ref = parse_registry_spec(model)
+            if ModelRegistry._as_version(ref) is None and ref != "latest":
+                self.alias = ref
         self.num_replicas = int(num_replicas)
         if ports is not None and len(ports) != self.num_replicas:
             raise ValueError(
@@ -263,6 +328,247 @@ class ReplicaGroup:
         w = self._monitor.workers[i]
         if w.proc is not None and w.proc.poll() is None:
             os.kill(w.proc.pid, sig or _signal.SIGKILL)
+
+    # -- model lifecycle (docs/model_lifecycle.md) -------------------------
+    def registry(self):
+        """The :class:`ModelRegistry` this group serves from; raises
+        for non-registry model specs."""
+        from zoo_tpu.serving.registry import ModelRegistry
+        if self.registry_root is None:
+            raise RuntimeError(
+                "this group does not serve from a model registry "
+                f"(model spec {self.model!r}); boot it from a "
+                "registry:<root>:<alias> spec to use the lifecycle API")
+        return ModelRegistry(self.registry_root)
+
+    def _rpc(self, i: int, msg: Dict, timeout: float) -> Dict:
+        from zoo_tpu.serving.tcp_client import _Connection
+        conn = _Connection(self.host, self.ports[i],
+                           retry=RetryPolicy(max_attempts=1))
+        try:
+            return conn.rpc(dict(msg), deadline=Deadline(timeout))
+        finally:
+            conn.close()
+
+    def version_info(self, timeout: float = 5.0) -> List[Optional[Dict]]:
+        """Per-replica ``{"version": "vN", "model_spec": ...}`` (None
+        for a replica that did not answer) — the ground truth a
+        rolling update verifies against."""
+        out: List[Optional[Dict]] = []
+        for i in range(self.num_replicas):
+            try:
+                out.append(self._rpc(i, {"op": "version"}, timeout))
+            except Exception:  # noqa: BLE001 — a down replica is data
+                out.append(None)
+        return out
+
+    def _metrics_counter(self, i: int, name: str,
+                         timeout: float = 2.0) -> Dict[str, float]:
+        """``{label-signature: value}`` for one counter family scraped
+        off replica ``i``'s /metrics door (empty when unreachable)."""
+        out: Dict[str, float] = {}
+        try:
+            with urllib.request.urlopen(
+                    f"http://{self.host}:{self.metrics_ports[i]}/metrics",
+                    timeout=timeout) as resp:
+                text = resp.read().decode()
+        except Exception:  # noqa: BLE001
+            return out
+        for m in re.finditer(
+                rf"^{re.escape(name)}(\{{[^}}]*\}})? ([0-9.eE+-]+)$",
+                text, re.M):
+            out[m.group(1) or ""] = float(m.group(2))
+        return out
+
+    def _probe_replica(self, i: int, version: Optional[str],
+                       settle: float, max_error_rate: float,
+                       timeout: float):
+        """Post-swap health gate: the replica must (1) answer its
+        ``/healthz`` door ok and report the target version, then
+        (2) survive a ``settle``-second live-traffic window without its
+        served error rate regressing past ``max_error_rate`` — the
+        check that catches a model that loads and warms but then fails
+        (or garbage-errors) on real requests."""
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                with urllib.request.urlopen(
+                        f"http://{self.host}:{self.metrics_ports[i]}"
+                        "/healthz", timeout=2.0) as resp:
+                    hz = json.loads(resp.read().decode())
+                if hz.get("ok"):
+                    info = self._rpc(i, {"op": "version"}, 2.0)
+                    if version is None or info.get("version") == version:
+                        break
+            except Exception:  # noqa: BLE001 — keep probing
+                pass
+            if time.monotonic() > deadline:
+                raise RollingUpdateError(
+                    f"replica {i} did not probe healthy on {version} "
+                    f"within {timeout:.0f}s after the swap")
+            time.sleep(0.1)
+        before = self._metrics_counter(i, "zoo_serving_requests_total")
+        time.sleep(max(0.0, settle))
+        after = self._metrics_counter(i, "zoo_serving_requests_total")
+        delta = {k: after.get(k, 0.0) - before.get(k, 0.0)
+                 for k in after}
+        errors = sum(v for k, v in delta.items() if "error" in k)
+        # EXECUTED requests only: sheds (breaker-open included) must
+        # not dilute the rate, or a fully broken model whose breaker
+        # opened mid-window would pass the probe on shed volume
+        total = errors + sum(v for k, v in delta.items()
+                             if '"ok"' in k and v > 0)
+        if total >= 2 and errors / total > max_error_rate:
+            raise RollingUpdateError(
+                f"replica {i} error rate regressed after swapping to "
+                f"{version}: {errors:.0f}/{total:.0f} requests errored "
+                f"in the {settle:.1f}s probe window "
+                f"(bound {max_error_rate:.0%})")
+
+    def _swap_one(self, i: int, spec: str, version: Optional[str],
+                  timeout: float):
+        """Hot-swap ONE replica to ``spec`` and return only when it
+        serves ``version``. A transport loss mid-reload (the replica
+        was SIGKILLed under us) is NOT a failure: the supervisor
+        respawns the seat, and a registry-spec replica re-resolves its
+        alias at boot — we wait for it and verify the version, retrying
+        the reload when the respawn came up on something older."""
+        deadline = time.monotonic() + timeout
+        attempt_reload = True
+        while True:
+            if attempt_reload:
+                try:
+                    resp = self._rpc(i, {"op": "reload", "spec": spec,
+                                         "version": version},
+                                     max(1.0, deadline - time.monotonic()))
+                    if resp.get("ok"):
+                        return
+                    raise RollingUpdateError(
+                        f"replica {i} rejected the swap to {version}: "
+                        f"{resp.get('error')}")
+                except RollingUpdateError:
+                    raise
+                except Exception:  # noqa: BLE001 — transport loss:
+                    # killed/respawning mid-reload; fall through to the
+                    # respawn-verify path
+                    attempt_reload = False
+            try:
+                info = self._rpc(i, {"op": "version"}, 2.0)
+                if version is None or info.get("version") == version:
+                    return
+                # seat is back but on an older version (respawned
+                # before the alias moved, or boot raced the kill):
+                # drive the reload again
+                attempt_reload = True
+            except Exception:  # noqa: BLE001 — still respawning
+                pass
+            if time.monotonic() > deadline:
+                raise RollingUpdateError(
+                    f"replica {i} never came up on {version} within "
+                    f"{timeout:.0f}s (killed mid-reload and respawn "
+                    "didn't land?)")
+            time.sleep(0.1)
+
+    def rolling_update(self, version=None, *,
+                       drain_timeout: Optional[float] = None,
+                       settle: float = 0.5,
+                       max_error_rate: float = 0.5,
+                       reload_timeout: float = 120.0) -> Dict:
+        """Zero-downtime group-wide hot-swap to registry ``version``
+        (default: whatever the group's alias currently resolves to —
+        the normal call order is *move the alias, then roll*).
+
+        One replica at a time: reload (load + verify + warm beside the
+        old model, atomic flip), then a ``/healthz`` + error-rate probe
+        — the HA client's failover/hedging makes each per-replica swap
+        invisible to callers. ANY failure (load/verify/warm rejection,
+        a replica that never comes back, a probe regression) triggers
+        **automatic rollback**: the alias is returned to the incumbent
+        version, every already-swapped replica is reloaded back, and
+        :class:`RollingUpdateError` is raised — the group is never left
+        mixed-version after completion, in either direction.
+
+        ``drain_timeout`` (default ``$ZOO_SERVE_DRAIN_TIMEOUT_S``) is
+        the per-replica budget for in-flight work around the swap — the
+        same knob :meth:`ServingServer.drain` honors, so slow LLM
+        streams get the same protection in both paths."""
+        from zoo_tpu.serving.server import drain_timeout as _dt
+        reg = self.registry()
+        if drain_timeout is None:
+            drain_timeout = _dt()
+        if version is None:
+            if self.alias is None:
+                raise RollingUpdateError(
+                    "rolling_update needs an explicit version for a "
+                    "non-aliased registry spec")
+            version = reg.alias_version(self.alias)
+            if version is None:
+                raise RollingUpdateError(
+                    f"alias {self.alias!r} does not exist in "
+                    f"{self.registry_root}")
+        version, _path = reg.resolve(version)  # verify BEFORE touching
+        target_spec = f"registry:{self.registry_root}:{version}"
+        info = self.version_info()
+        incumbents = [d.get("version") for d in info
+                      if d is not None and d.get("version") not in
+                      (None, version)]
+        incumbent = incumbents[0] if incumbents else None
+        swapped: List[int] = []
+        t0 = time.perf_counter()
+        failure: Optional[Exception] = None
+        try:
+            for i in range(self.num_replicas):
+                cur = info[i].get("version") if info[i] else None
+                if cur == version:
+                    continue  # already serving the target
+                self._swap_one(i, target_spec, version,
+                               reload_timeout + drain_timeout)
+                swapped.append(i)
+                self._probe_replica(i, version, settle, max_error_rate,
+                                    timeout=drain_timeout + 30.0)
+        except Exception as e:  # noqa: BLE001 — every failure rolls back
+            failure = e
+        if failure is None:
+            _rolling_updates.labels(outcome="ok").inc()
+            _rolling_update_seconds.observe(time.perf_counter() - t0)
+            return {"version": version, "swapped": len(swapped),
+                    "seconds": round(time.perf_counter() - t0, 3)}
+        # -- auto-rollback: leave the group 100% on the incumbent ----------
+        if incumbent is None:
+            _rolling_updates.labels(outcome="rolled_back").inc()
+            raise RollingUpdateError(
+                f"rolling update to {version} failed with no known "
+                "incumbent version to roll back to") from failure
+        # alias first, so any supervisor respawn during the rollback
+        # boots on the incumbent, not the bad candidate
+        if self.alias is not None and \
+                reg.alias_version(self.alias) == version:
+            reg.set_alias(self.alias, incumbent)
+        # roll back every replica ACTUALLY on the target, not just the
+        # ones _swap_one returned for: a reload whose reply was lost
+        # (deadline expired mid-load, connection dropped) may have
+        # flipped server-side after _swap_one gave up on it
+        on_target = {i for i, d in enumerate(self.version_info())
+                     if d is not None and d.get("version") == version}
+        rb_spec = f"registry:{self.registry_root}:{incumbent}"
+        for i in sorted(set(swapped) | on_target):
+            try:
+                self._swap_one(i, rb_spec, incumbent, reload_timeout)
+            except Exception:  # noqa: BLE001 — last resort: respawn
+                # picks the (restored) alias up from the registry
+                self.kill_replica(i)
+                try:
+                    self._swap_one(i, rb_spec, incumbent, reload_timeout)
+                except Exception:  # noqa: BLE001
+                    pass
+        final = [d.get("version") if d else None
+                 for d in self.version_info()]
+        _rolling_updates.labels(outcome="rolled_back").inc()
+        _rolling_update_seconds.observe(time.perf_counter() - t0)
+        raise RollingUpdateError(
+            f"rolling update to {version} failed and was rolled back "
+            f"to {incumbent} (replica versions now {final}): {failure}"
+        ) from failure
 
 
 # The single-replica process entry lives in zoo_tpu.serving.replica (a

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import os
 import queue as _queue
+import random
 import threading
 import time
 import uuid
@@ -63,6 +64,45 @@ _attempt_seconds = histogram(
     "zoo_serve_client_attempt_seconds",
     "Per-attempt client-observed RPC latency (successful attempts; "
     "feeds the hedge-delay p95)")
+# A/B routing families (docs/model_lifecycle.md): per-pinned-version
+# outcome and end-to-end latency — what the promotion gate compares the
+# canary against the incumbent on
+_ab_requests = counter(
+    "zoo_serve_ab_requests_total",
+    "Logical client requests by pinned model version and outcome "
+    "(version=unpinned for traffic the A/B split left on the "
+    "incumbent)", labels=("version", "outcome"))
+_ab_latency = histogram(
+    "zoo_serve_ab_latency_seconds",
+    "End-to-end client-observed request latency by pinned model "
+    "version (includes failover/hedging)", labels=("version",))
+
+
+def _parse_ab_split(text: str) -> Dict[str, float]:
+    """``"v2=0.1,v3=0.05"`` → ``{"v2": 0.1, "v3": 0.05}``."""
+    out: Dict[str, float] = {}
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        version, sep, frac = part.partition("=")
+        try:
+            if not sep:
+                raise ValueError("missing '='")
+            out[version.strip()] = float(frac)
+        except ValueError as e:
+            raise ValueError(
+                f"malformed ZOO_SERVE_AB_SPLIT entry {part!r} "
+                f"(expected e.g. \"v2=0.1,v3=0.05\"): {e}") from None
+    return out
+
+
+def _validate_ab_split(split: Dict[str, float]):
+    for v, f in split.items():
+        if not (0.0 <= f <= 1.0):
+            raise ValueError(f"A/B fraction for {v!r} out of [0,1]: {f}")
+    if sum(split.values()) > 1.0 + 1e-9:
+        raise ValueError(f"A/B fractions sum past 1.0: {split}")
 
 
 class NoReplicaAvailable(ConnectionError):
@@ -115,6 +155,10 @@ class _Endpoint:
         self.host, self.port = host, int(port)
         self._tls, self._cafile, self._verify = tls, cafile, verify
         self.breaker = breaker
+        # the registry version this seat last echoed ("vN"); None until
+        # a reply teaches us — steers version-pinned routing without
+        # probe round-trips, and is only a HINT (the server enforces)
+        self.seen_version: Optional[str] = None
         self._idle: List[_Connection] = []
         self._lock = threading.Lock()
 
@@ -168,7 +212,8 @@ class HAServingClient:
                  tls: bool = False, cafile: Optional[str] = None,
                  verify: bool = True,
                  breaker_failures: int = 2,
-                 breaker_recovery: Optional[float] = None):
+                 breaker_recovery: Optional[float] = None,
+                 ab_split: Optional[Dict[str, float]] = None):
         if not endpoints:
             raise ValueError("HAServingClient needs at least one endpoint")
         if deadline_ms is None:
@@ -181,22 +226,93 @@ class HAServingClient:
         if hedge_delay_ms is None:
             hedge_delay_ms = env_float("ZOO_SERVE_HEDGE_DELAY_MS", 0.0)
         self._hedge_delay_ms = hedge_delay_ms  # 0 = p95-tracked
-        recovery = breaker_recovery if breaker_recovery is not None \
+        self._breaker_failures = breaker_failures
+        self._breaker_recovery = breaker_recovery \
+            if breaker_recovery is not None \
             else env_float("ZOO_SERVE_BREAKER_RECOVERY", 1.0)
-        self._eps = [
-            _Endpoint(h, p, tls, cafile, verify,
-                      CircuitBreaker(failure_threshold=breaker_failures,
-                                     recovery_timeout=recovery))
-            for h, p in endpoints]
+        self._tls, self._cafile, self._verify = tls, cafile, verify
+        self._eps = [self._make_endpoint(h, p) for h, p in endpoints]
         self._rr = 0
         self._rr_lock = threading.Lock()
         self._lat = _LatencyTracker()
+        # A/B version pinning (docs/model_lifecycle.md): fractions of
+        # traffic stamped with X-Zoo-Model-Version (the wire field
+        # ``model_version``); the remainder rides unpinned on whatever
+        # the replicas serve. ZOO_SERVE_AB_SPLIT="v2=0.1,v3=0.05".
+        if ab_split is None:
+            ab_split = _parse_ab_split(
+                os.environ.get("ZOO_SERVE_AB_SPLIT", ""))
+        self._ab_lock = threading.Lock()
+        self._ab_split = dict(ab_split or {})
+        _validate_ab_split(self._ab_split)
+        self._ab_rng = random.Random()
+
+    def _make_endpoint(self, host: str, port: int) -> _Endpoint:
+        return _Endpoint(
+            host, port, self._tls, self._cafile, self._verify,
+            CircuitBreaker(failure_threshold=self._breaker_failures,
+                           recovery_timeout=self._breaker_recovery))
+
+    # -- topology / routing state -----------------------------------------
+    def refresh_endpoints(self, endpoints: Sequence[Tuple[str, int]]):
+        """Retarget the client onto a new endpoint list WITHOUT losing
+        per-endpoint state for seats that survive: a surviving
+        ``(host, port)`` keeps its breaker (health memory), idle
+        connections, and last-seen version; only genuinely new seats
+        start cold, and removed seats have their connections closed.
+        This is what a rolling update / future group resize calls
+        instead of rebuilding the client."""
+        if not endpoints:
+            raise ValueError("refresh_endpoints needs at least one "
+                             "endpoint")
+        with self._rr_lock:
+            old = {(ep.host, ep.port): ep for ep in self._eps}
+            self._eps = [
+                old.pop((h, int(p)), None) or self._make_endpoint(h, p)
+                for h, p in endpoints]
+            self._rr %= len(self._eps)
+        for ep in old.values():  # seats no longer in the group
+            ep.close()
+
+    def set_ab_split(self, split: Optional[Dict[str, float]]):
+        """Replace the A/B split (``{"v2": 0.1}`` = pin 10% of traffic
+        to v2); None/{} returns all traffic to unpinned."""
+        split = dict(split or {})
+        _validate_ab_split(split)
+        with self._ab_lock:
+            self._ab_split = split
+
+    def pin_version(self, version: Optional[str], fraction: float = 1.0):
+        """Shorthand: route ``fraction`` of traffic to ``version``
+        (1.0 = everything; ``None`` clears the split)."""
+        self.set_ab_split(
+            {version: float(fraction)} if version is not None else {})
+
+    def _draw_version(self) -> Optional[str]:
+        with self._ab_lock:
+            if not self._ab_split:
+                return None
+            split = list(self._ab_split.items())
+        r = self._ab_rng.random()
+        acc = 0.0
+        for version, frac in split:
+            acc += frac
+            if r < acc:
+                return version
+        return None
 
     # -- public API --------------------------------------------------------
     def predict(self, x, deadline_ms: Optional[float] = None,
-                uri: str = "_sync_") -> np.ndarray:
-        resp = self.rpc({"op": "predict", "uri": uri,
-                         "data": np.asarray(x)}, deadline_ms=deadline_ms)
+                uri: str = "_sync_",
+                model_version: Optional[str] = None) -> np.ndarray:
+        """``model_version`` pins this request to one registry version
+        (bypassing the A/B split); unset, the split decides. A pinned
+        request is bounced retryable by replicas serving a different
+        version, so failover lands it on one that matches."""
+        msg = {"op": "predict", "uri": uri, "data": np.asarray(x)}
+        if model_version is not None:
+            msg["model_version"] = model_version
+        resp = self.rpc(msg, deadline_ms=deadline_ms)
         if "error" in resp:
             raise RuntimeError(resp["error"])
         return resp["result"]
@@ -456,20 +572,33 @@ class HAServingClient:
             ep.close()
 
     # -- the hedged failover core -----------------------------------------
-    def _plan(self) -> List[_Endpoint]:
+    def _plan(self, version: Optional[str] = None) -> List[_Endpoint]:
         """Rotation for one request: every endpoint exactly once,
         healthy (breaker-admitted) seats first, starting at the
         round-robin cursor. Open-breaker seats stay at the tail as a
         last resort so a fully-dark group still probes rather than
-        refusing outright."""
+        refusing outright. A pinned ``version`` additionally floats
+        seats KNOWN to serve it (or not yet known) ahead of seats last
+        seen on a different version — a hint only; mismatched seats
+        stay in the plan because a hot-swap may have moved them since."""
         with self._rr_lock:
+            eps = list(self._eps)
             start = self._rr
-            self._rr = (self._rr + 1) % len(self._eps)
-        order = [self._eps[(start + i) % len(self._eps)]
-                 for i in range(len(self._eps))]
+            self._rr = (self._rr + 1) % len(eps)
+        order = [eps[(start + i) % len(eps)] for i in range(len(eps))]
         healthy = [ep for ep in order if ep.breaker.allow()]
         dark = [ep for ep in order if ep not in healthy]
-        return healthy + dark
+        if version is None:
+            return healthy + dark
+        # version preference WITHIN each health tier: a dead seat last
+        # seen on the pinned version must never outrank a healthy seat
+        # that merely bounced us once (it may have been swapped since)
+        out = []
+        for tier in (healthy, dark):
+            match = [ep for ep in tier
+                     if ep.seen_version in (None, version)]
+            out += match + [ep for ep in tier if ep not in match]
+        return out
 
     def _hedge_delay(self) -> float:
         if self._hedge_delay_ms > 0:
@@ -484,9 +613,42 @@ class HAServingClient:
         # dedup replay)
         msg = dict(msg)
         msg.setdefault("id", uuid.uuid4().hex)
+        # A/B: an explicitly pinned request keeps its pin; otherwise
+        # the split draws one. The pin (or its absence) holds across
+        # every attempt of this logical request.
+        is_predict = msg.get("op") == "predict"
+        if is_predict and "model_version" not in msg:
+            drawn = self._draw_version()
+            if drawn is not None:
+                msg["model_version"] = drawn
+        want = msg.get("model_version")
+        if not is_predict:
+            # stats/llm_stats/version probes must not pollute the
+            # per-version series the promotion gate compares against
+            return self._rpc_attempts(msg, deadline_ms, want)
+        ab_label = want if want is not None else "unpinned"
+        t_req = time.perf_counter()
+        try:
+            resp = self._rpc_attempts(msg, deadline_ms, want)
+        except DeadlineExceeded:
+            _ab_requests.labels(version=ab_label,
+                                outcome="expired").inc()
+            raise
+        except Exception:
+            _ab_requests.labels(version=ab_label, outcome="failed").inc()
+            raise
+        _ab_requests.labels(
+            version=ab_label,
+            outcome="error" if "error" in resp else "ok").inc()
+        _ab_latency.labels(version=ab_label).observe(
+            time.perf_counter() - t_req)
+        return resp
+
+    def _rpc_attempts(self, msg: Dict, deadline_ms: Optional[float],
+                      want: Optional[str]) -> Dict:
         dl = Deadline.from_ms(
             deadline_ms if deadline_ms is not None else self.deadline_ms)
-        candidates = self._plan()
+        candidates = self._plan(version=want)
         results: "_queue.Queue" = _queue.Queue()
         in_flight = 0
         last_err: Optional[BaseException] = None
@@ -556,6 +718,11 @@ class HAServingClient:
             in_flight -= 1
             if item[0] == "ok":
                 _kind, ep, resp, dt = item
+                if resp.get("version") is not None:
+                    # every frame teaches us what this seat serves —
+                    # version-mismatch bounces included, so the NEXT
+                    # pinned request plans around it
+                    ep.seen_version = resp["version"]
                 if resp.get("shed") and resp.get("retryable"):
                     # overload shed: the replica is alive but full —
                     # fail over without charging its breaker

@@ -19,7 +19,6 @@ import time
 
 def serve_replica(ns) -> int:
     from zoo_tpu.obs.exporters import MetricsExporter
-    from zoo_tpu.serving.ha import load_serving_model
     from zoo_tpu.serving.server import ServingServer
     from zoo_tpu.util.resilience import (
         CircuitBreaker,
@@ -28,18 +27,44 @@ def serve_replica(ns) -> int:
 
     start_heartbeat_thread()  # no-op unless the supervisor set the env
     from zoo_tpu.serving.llm.spec import is_llm_spec
-    model = engine = None
-    if is_llm_spec(ns.model):
-        # llm replica: the paged-KV continuous-batching engine behind
-        # the same TCP door (docs/llm_serving.md); the predict path is
-        # not mounted — generate is the only inference op
-        from zoo_tpu.serving.llm.spec import build_llm_engine
-        engine = build_llm_engine(ns.model)
+    from zoo_tpu.serving.registry import (
+        ModelRegistry,
+        is_registry_spec,
+        parse_registry_spec,
+    )
+    model = engine = version = None
+
+    def _mount(inner: str):
+        """Load the (possibly registry-nested) spec: an llm spec mounts
+        the paged-KV continuous-batching engine behind the same TCP
+        door (docs/llm_serving.md; generate is then the only inference
+        op — hot-swap reload applies to predict models, an llm version
+        change goes through replica restart, which the alias
+        resolution covers), anything else the predict path."""
+        nonlocal model, engine
+        if is_llm_spec(inner):
+            from zoo_tpu.serving.llm.spec import build_llm_engine
+            engine = build_llm_engine(inner)
+        else:
+            from zoo_tpu.serving.ha import load_serving_model
+            model = load_serving_model(inner, batch_size=ns.batch_size)
+
+    if is_registry_spec(ns.model):
+        # the alias is re-resolved HERE, at boot — a replica respawned
+        # mid-rolling-update therefore comes up on the currently
+        # ALIASED version, never a stale one; the pin keeps registry GC
+        # off the version for the duration of the load
+        root, ref = parse_registry_spec(ns.model)
+        reg = ModelRegistry(root)
+        with reg.pin(ref) as pinned:
+            version, inner = reg.model_spec(pinned)
+            _mount(inner)
     else:
-        model = load_serving_model(ns.model, batch_size=ns.batch_size)
+        _mount(ns.model)
     server = ServingServer(
         model, host=ns.host, port=ns.port, batch_size=ns.batch_size,
         max_wait_ms=ns.max_wait_ms, llm_engine=engine,
+        version=version, model_spec=ns.model,
         breaker=CircuitBreaker(failure_threshold=5,
                                recovery_timeout=5.0)).start()
     exporter = None

@@ -60,6 +60,15 @@ def main(argv=None) -> int:
                          "printed at startup)")
     ap.add_argument("--tcp-max-restarts", type=int, default=3,
                     help="per-replica respawn budget in HA mode")
+    ap.add_argument("--registry", default=None,
+                    help="serve from a versioned model registry root "
+                         "(docs/model_lifecycle.md): replicas resolve "
+                         "--alias at boot and hot-swap via "
+                         "ReplicaGroup.rolling_update; shorthand for "
+                         "--model registry:<root>:<alias>")
+    ap.add_argument("--alias", default="prod",
+                    help="registry alias to serve (with --registry; "
+                         "default prod)")
     ap.add_argument("--encrypted", action="store_true",
                     help="the model file is encrypted at rest (reference "
                          "trusted serving); key material comes from "
@@ -79,8 +88,18 @@ def main(argv=None) -> int:
         ns.redis_host = cfg.get("redisHost", ns.redis_host)
         ns.redis_port = int(cfg.get("redisPort", ns.redis_port))
         ns.batch_size = int(cfg.get("batchSize", ns.batch_size))
+    if ns.registry:
+        if ns.model:
+            ap.error("--registry and --model are mutually exclusive "
+                     "(--registry IS the model source)")
+        ns.model = f"registry:{ns.registry}:{ns.alias}"
+        if ns.tcp_replicas <= 0:
+            ap.error("--registry needs the HA TCP mode "
+                     "(--tcp-replicas N): hot-swap reload lives on "
+                     "the replica wire")
     if not ns.model:
-        ap.error("--model (or a config with modelPath) is required")
+        ap.error("--model (or a config with modelPath, or --registry) "
+                 "is required")
 
     if ns.tcp_replicas > 0:
         # HA mode: the replicas load the model themselves (one process

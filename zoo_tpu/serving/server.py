@@ -28,7 +28,7 @@ import socketserver
 import struct
 import threading
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -75,6 +75,30 @@ _dedup = counter(
     "zoo_serve_dedup_total", "Duplicate request ids absorbed without "
     "re-executing (inflight = joined a pending request, replay = served "
     "from the completed-request cache)", labels=("kind",))
+# model-lifecycle families (docs/model_lifecycle.md): which registry
+# version this replica is serving (1 = current, 0 = a version it served
+# before a hot-swap), hot-swap outcomes, and the measured drain time the
+# rolling updater budgets with ZOO_SERVE_DRAIN_TIMEOUT_S
+_version_info = gauge(
+    "zoo_registry_version_info",
+    "Registry model version served by this replica (1 = current; a "
+    "version flips to 0 when a reload swaps it out)", labels=("version",))
+_reloads = counter(
+    "zoo_serve_reload_total", "Hot-swap model reloads, by outcome "
+    "(ok / failed — failed never flips, the old model keeps serving)",
+    labels=("outcome",))
+_drain_seconds = histogram(
+    "zoo_serve_drain_seconds",
+    "Graceful-drain wall time (raise the ZOO_SERVE_DRAIN_TIMEOUT_S "
+    "budget when this nears it)")
+
+
+def drain_timeout() -> float:
+    """The graceful-drain budget (``ZOO_SERVE_DRAIN_TIMEOUT_S``, default
+    30 s) — shared by :meth:`ServingServer.drain` and
+    :meth:`zoo_tpu.serving.ha.ReplicaGroup.rolling_update` so a budget
+    raised for slow LLM streams protects a rolling swap too."""
+    return env_float("ZOO_SERVE_DRAIN_TIMEOUT_S", 30.0)
 
 
 def _send_msg(sock: socket.socket, obj):
@@ -177,7 +201,10 @@ class ServingServer:
                  request_timeout: Optional[float] = None,
                  handshake_timeout: Optional[float] = None,
                  dedup_cache: Optional[int] = None,
-                 llm_engine=None):
+                 llm_engine=None,
+                 version: Optional[str] = None,
+                 model_spec: Optional[str] = None,
+                 model_loader=None):
         """``certfile``/``keyfile``: serve over TLS — the trusted-
         serving door of the reference's PPML trusted-realtime-ml story
         (``ppml/trusted-realtime-ml/``: encrypted transport in front of
@@ -206,9 +233,32 @@ class ServingServer:
         mounted on this door — adds the streaming ``generate`` op
         (docs/llm_serving.md) next to ``predict``. ``model`` may be
         ``None`` for an llm-only replica (the batcher threads are then
-        not started and ``predict`` answers with a routing error)."""
+        not started and ``predict`` answers with a routing error).
+
+        Lifecycle identity (docs/model_lifecycle.md): ``version`` is
+        the registry version this model came from (``"v3"``; echoed on
+        every reply and published as the ``zoo_registry_version_info``
+        gauge), ``model_spec`` the spec it was loaded from, and
+        ``model_loader`` a ``spec -> (model, version)`` callable the
+        wire ``reload`` op uses to hot-swap a new version beside the
+        old one (defaults to
+        :func:`zoo_tpu.serving.ha.resolve_model_spec`)."""
         self.model = model
         self.llm_engine = llm_engine
+        self.version = version
+        self.model_spec = model_spec
+        self.model_loader = model_loader
+        # hot-swap: the batcher reads the live model under this lock and
+        # reload_model flips it under the same lock — atomic, no drain
+        self._swap_lock = threading.Lock()
+        # input signatures seen by the batcher ((row_shape, dtype) ->
+        # None, insertion-ordered): reload warms the incoming model with
+        # one padded-batch inference per signature so the flip never
+        # pays a live request's first XLA compile
+        self._warm_shapes: "collections.OrderedDict" = \
+            collections.OrderedDict()
+        if version is not None:
+            _version_info.labels(version=version).set(1)
         if model is None and llm_engine is None:
             raise ValueError("ServingServer needs a model, an "
                              "llm_engine, or both")
@@ -293,6 +343,11 @@ class ServingServer:
                     out["uri"] = msg.get("uri")
                 if msg.get("id") is not None:
                     out["id"] = msg["id"]
+                if outer.version is not None:
+                    # lifecycle identity on every frame: the HA client
+                    # learns which version each endpoint serves (A/B
+                    # routing) without extra probe round-trips
+                    out["version"] = outer.version
                 out.update(extra)
                 _send_msg(self.request, out)
 
@@ -361,7 +416,25 @@ class ServingServer:
                             else "inflight").inc()
                         self._await_and_reply(msg, prior, deadline)
                         return
-                # 2. breaker load shedding: fail fast at the door while
+                # 2. A/B version pinning: a request pinned to a version
+                # this replica does not serve is bounced retryable so
+                # the client's failover finds a replica that does (the
+                # echoed version teaches it which). AFTER dedup — a
+                # retry/hedge of an already-executed request must join
+                # it even when a hot-swap flipped the version between
+                # the attempts (idempotency survives the flip).
+                want = msg.get("model_version")
+                if want is not None and outer.version is not None \
+                        and want != outer.version:
+                    _requests.labels(outcome="shed").inc()
+                    _shed.labels(reason="version_mismatch").inc()
+                    self._reply(msg, {
+                        "shed": True, "retryable": True,
+                        "version_mismatch": True,
+                        "error": f"this replica serves {outer.version}, "
+                                 f"not {want}; retry another replica"})
+                    return
+                # 3. breaker load shedding: fail fast at the door while
                 # the model is known-broken, instead of parking the
                 # caller behind a dead batcher
                 if outer.breaker is not None and \
@@ -376,7 +449,7 @@ class ServingServer:
                                  "open after repeated inference "
                                  "failures; retry later)"})
                     return
-                # 3. dead-on-arrival: the budget was spent in transit or
+                # 4. dead-on-arrival: the budget was spent in transit or
                 # upstream queues — reject instead of computing a result
                 # nobody is waiting for
                 if deadline is not None and deadline.expired():
@@ -387,7 +460,7 @@ class ServingServer:
                         "error": "deadline expired before admission "
                                  "(budget exhausted upstream)"})
                     return
-                # 4. admission control: early rejection at the bounded
+                # 5. admission control: early rejection at the bounded
                 # queue, with a retry-after hint sized to the backlog —
                 # overload sheds at the door, not after a timeout
                 depth = outer._queue.qsize()
@@ -557,6 +630,27 @@ class ServingServer:
                         # not at max_new_tokens
                         eng.cancel(h.id)
 
+            def _handle_reload(self, msg):
+                """Wire half of :meth:`ServingServer.reload_model`.
+                The reply is sent only AFTER the swap (or its failure):
+                an ``ok`` means the new version is live on this replica,
+                an error means the old model never stopped serving."""
+                spec = msg.get("spec")
+                if not spec:
+                    self._reply(msg, {"error": "reload needs a spec"})
+                    return
+                try:
+                    info = outer.reload_model(
+                        spec, version=msg.get("version"),
+                        warm=bool(msg.get("warm", True)))
+                except Exception as e:  # noqa: BLE001 — the caller
+                    # (rolling updater) turns this into a rollback; the
+                    # incumbent model is still serving
+                    self._reply(msg, {"error": repr(e),
+                                      "reload_failed": True})
+                    return
+                self._reply(msg, {"ok": True, **info})
+
             def handle(self):
                 while True:
                     msg = _recv_msg(self.request)
@@ -566,6 +660,13 @@ class ServingServer:
                         self._handle_predict(msg)
                     elif msg.get("op") == "generate":
                         self._handle_generate(msg)
+                    elif msg.get("op") == "reload":
+                        self._handle_reload(msg)
+                    elif msg.get("op") == "version":
+                        self._reply(msg, {
+                            "ok": True,
+                            "model_spec": outer.model_spec,
+                            "version": outer.version})
                     elif msg.get("op") == "llm_stats":
                         eng = outer.llm_engine
                         self._reply(msg, {"stats": eng.stats()}
@@ -597,6 +698,83 @@ class ServingServer:
         self._server = Server((host, port), Handler)
         self.host, self.port = self._server.server_address
 
+    # -- model lifecycle ---------------------------------------------------
+    def _note_warm_shape(self, row_shape, dtype):
+        key = (tuple(int(d) for d in row_shape), np.dtype(dtype).str)
+        # under the swap lock: reload_model snapshots this dict while
+        # batcher threads keep recording — an unlocked insert/pop could
+        # blow up its iteration and fail a perfectly good reload
+        with self._swap_lock:
+            if key not in self._warm_shapes:
+                self._warm_shapes[key] = None
+                while len(self._warm_shapes) > 8:
+                    self._warm_shapes.popitem(last=False)
+
+    def reload_model(self, spec: str, version: Optional[str] = None,
+                     warm: bool = True) -> Dict:
+        """Hot-swap to the model at ``spec`` with ZERO downtime: load +
+        verify the new model BESIDE the old one (the old model keeps
+        serving the whole time), prime it with one padded-batch
+        inference at every input signature this server has compiled
+        (so the first post-swap request never pays an XLA compile),
+        then flip atomically under the batcher's swap lock. Any
+        load/verify/warm failure raises WITHOUT flipping — a bad
+        candidate can never replace a healthy incumbent.
+
+        This is the wire ``reload`` op's engine and what
+        :meth:`zoo_tpu.serving.ha.ReplicaGroup.rolling_update` drives
+        one replica at a time."""
+        if self.model is None:
+            raise RuntimeError("this replica serves the llm generate op "
+                               "only; hot-swap reload applies to the "
+                               "predict model path")
+        if len({id(m) for m in self._replicas}) > 1:
+            # models=[...] gave every batcher its OWN copy (models not
+            # safe for concurrent predict, or pinned to distinct
+            # devices); a single loaded instance cannot honor that —
+            # refuse rather than silently regress thread safety
+            raise RuntimeError(
+                "hot-swap reload is not supported on a server built "
+                "with distinct per-replica model copies (models=[...]); "
+                "restart the replica process instead")
+        t0 = time.perf_counter()
+        try:
+            loader = self.model_loader
+            if loader is None:
+                from zoo_tpu.serving.ha import resolve_model_spec
+                loader = lambda s: resolve_model_spec(  # noqa: E731
+                    s, batch_size=self.batch_size)
+            fault_point("serving.reload", spec=spec)
+            new_model, loaded_version = loader(spec)
+            version = version or loaded_version
+            warmed = 0
+            if warm:
+                with self._swap_lock:
+                    shapes = list(self._warm_shapes)
+                for row_shape, dtype in shapes:
+                    x = np.zeros((self.batch_size,) + row_shape,
+                                 np.dtype(dtype))
+                    np.asarray(new_model.predict(
+                        x, batch_size=self.batch_size))
+                    warmed += 1
+        except Exception:
+            _reloads.labels(outcome="failed").inc()
+            raise
+        with self._swap_lock:
+            previous = self.version
+            self.model = new_model
+            self._replicas = [new_model] * max(1, len(self._replicas))
+            self.version = version
+            self.model_spec = spec
+        if previous is not None:
+            _version_info.labels(version=previous).set(0)
+        if version is not None:
+            _version_info.labels(version=version).set(1)
+        _reloads.labels(outcome="ok").inc()
+        return {"version": version, "previous": previous,
+                "warmed": warmed,
+                "reload_seconds": round(time.perf_counter() - t0, 4)}
+
     # -- batcher -----------------------------------------------------------
     def _drop_expired(self, req: _Request):
         """Answer an expired request WITHOUT computing it: the budget is
@@ -611,8 +789,7 @@ class ServingServer:
         with self._inflight_lock:
             self._completed += 1
 
-    def _batch_loop(self, model=None):
-        model = model if model is not None else self.model
+    def _batch_loop(self, idx: int = 0):
         while not self._stop.is_set():
             try:
                 first = self._queue.get(timeout=0.1)
@@ -686,6 +863,14 @@ class ServingServer:
                         np.zeros((padded - real,) + arrays[0].shape[1:],
                                  arrays[0].dtype)]
                     stacked = np.concatenate(to_stack, axis=0)
+                    # the LIVE model, read under the swap lock so a
+                    # concurrent reload flips atomically between
+                    # batches — a batch runs wholly on the old or
+                    # wholly on the new version, never a mix
+                    with self._swap_lock:
+                        model = self._replicas[idx]
+                    self._note_warm_shape(stacked.shape[1:],
+                                          stacked.dtype)
                     preds = model.predict(stacked,
                                           batch_size=self.batch_size)
                     preds = np.asarray(preds)[:real]
@@ -713,20 +898,24 @@ class ServingServer:
             threading.Thread(target=self._server.serve_forever,
                              daemon=True)]
         self._threads += [
-            threading.Thread(target=self._batch_loop, args=(m,),
+            threading.Thread(target=self._batch_loop, args=(i,),
                              daemon=True, name=f"zoo-serving-replica-{i}")
-            for i, m in enumerate(self._replicas)]
+            for i in range(len(self._replicas))]
         for t in self._threads:
             t.start()
         return self
 
-    def drain(self, timeout: float = 30.0,
+    def drain(self, timeout: Optional[float] = None,
               snapshot_path: str = None) -> bool:
         """Graceful shutdown (the SIGTERM path): stop taking new work,
         finish everything already accepted, flush the metrics snapshot,
         then close. Returns True when every queued/in-flight request was
-        answered inside ``timeout`` (False = timed out and force-closed;
-        the stragglers get their normal timeout error).
+        answered inside ``timeout`` (``None`` → the
+        ``ZOO_SERVE_DRAIN_TIMEOUT_S`` env, default 30 — rolling updates
+        budget replica swaps with the SAME knob, so raising it for slow
+        LLM streams protects both paths; False = timed out and
+        force-closed; the stragglers get their normal timeout error).
+        The measured drain time lands on ``zoo_serve_drain_seconds``.
 
         Order matters: (1) ``_draining`` is raised under the accept
         lock, so no handler can slip a request past the closing door —
@@ -736,10 +925,13 @@ class ServingServer:
         outstanding); (3) write the metrics snapshot (``snapshot_path``
         or ``$ZOO_OBS_SNAPSHOT``) so the final request tallies survive
         the process; (4) ``stop()``."""
+        if timeout is None:
+            timeout = drain_timeout()
+        t0 = time.monotonic()
         with self._accept_lock:
             self._draining.set()
             outstanding_at_close = self._accepted
-        deadline = time.monotonic() + timeout
+        deadline = t0 + timeout
         drained = False
         while time.monotonic() < deadline:
             with self._inflight_lock:
@@ -749,6 +941,7 @@ class ServingServer:
                 drained = True
                 break
             time.sleep(0.01)
+        _drain_seconds.observe(time.monotonic() - t0)
         path = snapshot_path or os.environ.get("ZOO_OBS_SNAPSHOT")
         if path:
             try:
@@ -761,7 +954,8 @@ class ServingServer:
         self.stop()
         return drained
 
-    def install_drain_handler(self, signals=None, timeout: float = 30.0,
+    def install_drain_handler(self, signals=None,
+                              timeout: Optional[float] = None,
                               snapshot_path: str = None):
         """Route SIGTERM (default) to :meth:`drain` on a helper thread —
         the orchestrator's stop signal finishes in-flight work instead

@@ -209,18 +209,29 @@ class TCPInputQueue:
         return True  # single-record enqueue always adds the batch dim
 
     def predict(self, x: np.ndarray,
-                deadline_ms: Optional[float] = None) -> np.ndarray:
+                deadline_ms: Optional[float] = None,
+                model_version: Optional[str] = None) -> np.ndarray:
         """Synchronous batch predict (reference: ``InputQueue.predict``).
 
         ``deadline_ms``: optional end-to-end budget propagated to the
         server, which enforces it at admission, batch formation and
-        reply (docs/serving_ha.md); an exhausted budget raises."""
-        resp = self._conn.rpc({"op": "predict", "uri": "_sync_",
-                               "data": np.asarray(x)},
-                              deadline=Deadline.from_ms(deadline_ms))
+        reply (docs/serving_ha.md); an exhausted budget raises.
+        ``model_version`` pins the request to one registry version —
+        a replica serving a different version bounces it retryable
+        (docs/model_lifecycle.md; single-endpoint clients surface that
+        as an error, the HA client fails over instead)."""
+        msg = {"op": "predict", "uri": "_sync_", "data": np.asarray(x)}
+        if model_version is not None:
+            msg["model_version"] = model_version
+        resp = self._conn.rpc(msg, deadline=Deadline.from_ms(deadline_ms))
         if "error" in resp:
             raise RuntimeError(resp["error"])
         return resp["result"]
+
+    def version(self) -> Dict:
+        """The replica's lifecycle identity:
+        ``{"version": "vN" | None, "model_spec": ...}``."""
+        return self._conn.rpc({"op": "version"})
 
     def pop_result(self, uri: str) -> Optional[np.ndarray]:
         return self._results.pop(uri, None)

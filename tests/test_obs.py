@@ -446,9 +446,43 @@ def test_metrics_end_to_end_serving_fit_checkpoint(orca_ctx, tmp_path):
         slow_srv.stop()
         fast_srv.stop()
 
-    # 4c. the LLM engine's paged-KV gauges (docs/llm_serving.md): a
-    # jax-free allocator round-trip leaves zoo_llm_kv_blocks_{used,free}
-    # populated with the pool's live accounting
+    # 4c. the overlapped tick pipeline's phase histograms + overlap
+    # gauge (docs/llm_serving.md): one short jax-free engine run over a
+    # deterministic fake model populates zoo_llm_tick_seconds{phase}
+    # and zoo_llm_tick_overlap_ratio. Runs BEFORE the allocator probe
+    # below — the engine's own allocator republishes the process-global
+    # zoo_llm_kv_blocks_* gauges on every mutation, and the scrape
+    # asserts the probe's values.
+    from zoo_tpu.serving.llm.engine import LLMEngine
+
+    class _TickModel:
+        num_slots, block_size, num_blocks = 2, 4, 16
+        max_blocks_per_seq, max_prompt_len = 4, 12
+        max_context, prefill_chunk_size, eos_id = 16, 0, None
+
+        def prefill(self, prompt, row, sampling=None):
+            return 1
+
+        def decode_step(self, prev, host, use, tables, pos, lanes):
+            import time as _t
+            _t.sleep(0.001)
+            return np.where(np.asarray(use), host, 0) + 1
+
+        def read_tokens(self, batch):
+            return np.asarray(batch)
+
+    llm_eng = LLMEngine(_TickModel(), overlap=True).start()
+    try:
+        h = llm_eng.submit([1, 2], 6)
+        deadline = time.monotonic() + 30
+        while not h.done and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert h.done
+    finally:
+        llm_eng.stop()
+
+    # 4d. the paged-KV gauges: a jax-free allocator round-trip leaves
+    # zoo_llm_kv_blocks_{used,free} at the pool's live accounting
     from zoo_tpu.serving.llm.kv_cache import BlockAllocator
     alloc = BlockAllocator(num_blocks=17, block_size=8)
     alloc.allocate("scrape-seq", 4)
@@ -481,6 +515,12 @@ def test_metrics_end_to_end_serving_fit_checkpoint(orca_ctx, tmp_path):
             'zoo_serve_ab_requests_total{version="v9",outcome="ok"}',
             "zoo_llm_kv_blocks_used 4",
             "zoo_llm_kv_blocks_free 12",
+            # the tick pipeline (PR 10): per-phase engine tick
+            # histograms + the device-busy/wall overlap gauge
+            'zoo_llm_tick_seconds_bucket{phase="schedule"',
+            'zoo_llm_tick_seconds_bucket{phase="decode"',
+            'zoo_llm_tick_seconds_bucket{phase="readback"',
+            "zoo_llm_tick_overlap_ratio",
             # the GSPMD layer (docs/multichip.md): the fixture's 8-device
             # mesh publishes its axis sizes, and the fit above ran DP
             # over it, so the plan's estimated grad all-reduce bytes

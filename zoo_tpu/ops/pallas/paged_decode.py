@@ -1,0 +1,231 @@
+"""Paged flash-decode: single-query attention through a block table.
+
+The decode hot path is memory-bound — each generated token must stream
+every live K/V byte of its sequence out of HBM exactly once, so the
+roofline that matters is HBM bytes/token, not FLOPs. PR 7's decode
+executable paid that bill twice: ``cache[block_table]`` materializes a
+gathered ``(slots, ctx, heads, d)`` copy of every sequence's K/V in HBM
+*before* the attention math reads it back. This kernel is the
+PagedAttention/flash-decoding rebuild (Kwon et al., SOSP '23; Dao et
+al., 2023):
+
+* **paged** — K/V blocks are read directly where they live, routed by a
+  scalar-prefetched block table in the ``BlockSpec`` index maps, so the
+  per-sequence gather copy never exists;
+* **flash** — online-softmax accumulation in VMEM scratch, never a
+  ``(ctx,)`` score row in HBM;
+* **split-KV** — the sequence axis is cut into ``num_splits`` grid
+  programs that each produce a partial ``(acc, m, l)``; a tiny jnp
+  epilogue merges them with the standard log-sum-exp correction. At
+  decode there is ONE query per sequence, so without the split the
+  kernel exposes only ``slots x kv_heads`` programs of parallelism —
+  splitting the KV length is what keeps the cores busy at low
+  occupancy (the flash-decoding observation);
+* **GQA-aware** — the ``group = n_head / n_kv_head`` query heads that
+  share a KV head are batched into one ``(group, d) @ (d, block)``
+  matmul, so each K/V block is streamed once per KV head, not once per
+  query head.
+
+Blocks past a sequence's live length are skipped via ``pl.when`` (no
+MXU work, no DMA consumed), and a fully-dead split contributes
+``m=-inf, l=0`` which the epilogue drops — inactive slots (position 0,
+table full of trash-block zeros) produce garbage that the engine never
+reads, exactly like the dense path.
+
+Off-TPU the kernel runs under the Pallas interpreter (exact, slow), so
+the CPU test rig asserts token identity against the dense-gather
+reference on the same code path TPU hardware compiles.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from zoo_tpu.ops.pallas import LANES as _LANES
+from zoo_tpu.ops.pallas import resolve_interpret as _resolve_interpret
+
+
+def _kernel(bt_ref, pos_ref, q_ref, k_ref, v_ref,
+            acc_ref, m_ref, l_ref, m_scr, l_scr, a_scr, *,
+            n_kv, block_size, bps, scale):
+    """One (slot, kv-head, split) program; the innermost grid axis walks
+    the split's ``bps`` table entries with the online-softmax carry in
+    VMEM scratch."""
+    sh = pl.program_id(0)
+    split = pl.program_id(1)
+    j = pl.program_id(2)
+    s = sh // n_kv
+    pos = pos_ref[s]
+    start = (split * bps + j) * block_size
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        a_scr[...] = jnp.zeros_like(a_scr)
+
+    # whole block past the live length: skip — no matmul, and (because
+    # the index map clamps dead entries to block 0) no fresh DMA either
+    @pl.when(start <= pos)
+    def _step():
+        q = q_ref[0, 0]                       # (group, D)
+        k = k_ref[0, :, 0, :]                 # (block, D)
+        v = v_ref[0, :, 0, :]
+        s_ = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (group, block)
+        col = start + jax.lax.broadcasted_iota(jnp.int32, s_.shape, 1)
+        mask = col <= pos
+        s_ = jnp.where(mask, s_, -jnp.inf)
+        m_prev = m_scr[:, :1]                 # (group, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s_, axis=-1, keepdims=True))
+        safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(jnp.where(mask, s_ - safe, -jnp.inf))
+        corr = jnp.where(jnp.isfinite(m_prev),
+                         jnp.exp(m_prev - safe), 0.0)
+        l_scr[:, :1] = corr * l_scr[:, :1] + \
+            jnp.sum(p, axis=-1, keepdims=True)
+        a_scr[...] = a_scr[...] * corr + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:, :1] = m_new
+
+    @pl.when(j == bps - 1)
+    def _finish():
+        acc_ref[0, 0, 0] = a_scr[...].astype(acc_ref.dtype)
+        m_ref[0, 0, 0] = jnp.broadcast_to(m_scr[:, :1],
+                                          m_ref.shape[3:])
+        l_ref[0, 0, 0] = jnp.broadcast_to(l_scr[:, :1],
+                                          l_ref.shape[3:])
+
+
+def resolve_num_splits(table_width: int,
+                       requested: Optional[int] = None) -> int:
+    """Largest divisor of ``table_width`` not exceeding the request
+    (``ZOO_LLM_DECODE_SPLITS``, default 4): splits must tile the table
+    exactly so every grid program walks the same number of entries."""
+    if requested is None:
+        requested = int(os.environ.get("ZOO_LLM_DECODE_SPLITS", "4"))
+    requested = max(1, min(int(requested), table_width))
+    for d in range(requested, 0, -1):
+        if table_width % d == 0:
+            return d
+    return 1
+
+
+def paged_flash_decode(q: jnp.ndarray, k_cache: jnp.ndarray,
+                       v_cache: jnp.ndarray, block_tables: jnp.ndarray,
+                       positions: jnp.ndarray, *,
+                       scale: Optional[float] = None,
+                       num_splits: Optional[int] = None,
+                       interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Single-query paged attention for one decode tick.
+
+    ``q``: (S, H, D) — one query per slot; ``k_cache``/``v_cache``:
+    (num_blocks, block_size, H_kv, D); ``block_tables``: (S, W) int32;
+    ``positions``: (S,) int32 — the cache index the slot's incoming
+    token was written at (tokens ``0..position`` are attended).
+    Returns (S, H, D) in ``q``'s dtype.
+    """
+    S, H, D = q.shape
+    n_blocks, block_size, n_kv, _ = k_cache.shape
+    if H % n_kv:
+        raise ValueError(f"q heads ({H}) must be a multiple of kv heads "
+                         f"({n_kv})")
+    group = H // n_kv
+    W = block_tables.shape[1]
+    if scale is None:
+        scale = 1.0 / float(D) ** 0.5
+    interpret = _resolve_interpret(interpret)
+    splits = resolve_num_splits(W, num_splits)
+    bps = W // splits
+
+    q4 = q.reshape(S, n_kv, group, D)
+    bt = block_tables.astype(jnp.int32)
+    pos = positions.astype(jnp.int32)
+
+    def _entry(sh, sp, j, bt_ref, pos_ref):
+        # dead entries (whole block past the live length) are clamped to
+        # block 0 so the pipeline re-fetches the already-resident trash
+        # block instead of streaming a block the kernel will skip
+        idx = sp * bps + j
+        s = sh // n_kv
+        live = idx * block_size <= pos_ref[s]
+        return jnp.where(live, bt_ref[s, idx], 0)
+
+    kernel = functools.partial(
+        _kernel, n_kv=n_kv, block_size=block_size, bps=bps, scale=scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S * n_kv, splits, bps),
+        in_specs=[
+            pl.BlockSpec((1, 1, group, D),
+                         lambda sh, sp, j, bt_ref, pos_ref:
+                         (sh // n_kv, sh % n_kv, 0, 0)),
+            pl.BlockSpec((1, block_size, 1, D),
+                         lambda sh, sp, j, bt_ref, pos_ref:
+                         (_entry(sh, sp, j, bt_ref, pos_ref), 0,
+                          sh % n_kv, 0)),
+            pl.BlockSpec((1, block_size, 1, D),
+                         lambda sh, sp, j, bt_ref, pos_ref:
+                         (_entry(sh, sp, j, bt_ref, pos_ref), 0,
+                          sh % n_kv, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, 1, group, D),
+                         lambda sh, sp, j, bt_ref, pos_ref:
+                         (sh // n_kv, sh % n_kv, sp, 0, 0)),
+            pl.BlockSpec((1, 1, 1, group, _LANES),
+                         lambda sh, sp, j, bt_ref, pos_ref:
+                         (sh // n_kv, sh % n_kv, sp, 0, 0)),
+            pl.BlockSpec((1, 1, 1, group, _LANES),
+                         lambda sh, sp, j, bt_ref, pos_ref:
+                         (sh // n_kv, sh % n_kv, sp, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((group, _LANES), jnp.float32),
+            pltpu.VMEM((group, _LANES), jnp.float32),
+            pltpu.VMEM((group, D), jnp.float32),
+        ],
+    )
+    # (slot*kv_head, split) programs are independent — mark them
+    # parallel so Mosaic can spread them over cores (megacore); only
+    # the innermost block walk carries the VMEM softmax state and must
+    # stay sequential. Without this the whole grid serializes and the
+    # split-KV axis adds epilogue cost without its parallelism.
+    params_cls = getattr(pltpu, "CompilerParams", None) or \
+        pltpu.TPUCompilerParams
+    acc, m, l = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        compiler_params=params_cls(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        out_shape=[
+            jax.ShapeDtypeStruct((S, n_kv, splits, group, D),
+                                 jnp.float32),
+            jax.ShapeDtypeStruct((S, n_kv, splits, group, _LANES),
+                                 jnp.float32),
+            jax.ShapeDtypeStruct((S, n_kv, splits, group, _LANES),
+                                 jnp.float32),
+        ],
+        interpret=interpret,
+    )(bt, pos, q4, k_cache, v_cache)
+
+    # split-KV epilogue: merge the per-split partial softmaxes with the
+    # log-sum-exp correction (dead splits carry m=-inf/l=0 and drop out)
+    m0 = m[..., 0]                                  # (S, n_kv, splits, G)
+    l0 = l[..., 0]
+    m_max = jnp.max(m0, axis=2, keepdims=True)
+    m_safe = jnp.where(jnp.isfinite(m_max), m_max, 0.0)
+    alpha = jnp.where(jnp.isfinite(m0), jnp.exp(m0 - m_safe), 0.0)
+    l_tot = jnp.sum(alpha * l0, axis=2)             # (S, n_kv, G)
+    o = jnp.sum(alpha[..., None] * acc, axis=2) / \
+        jnp.where(l_tot == 0.0, 1.0, l_tot)[..., None]
+    return o.astype(q.dtype).reshape(S, H, D)

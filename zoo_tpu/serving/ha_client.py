@@ -319,9 +319,18 @@ class HAServingClient:
 
     def generate(self, prompt, max_new_tokens: int,
                  deadline_ms: Optional[float] = None,
-                 hedge: Optional[bool] = None):
-        """Stream one greedy generation over the replica group: yields
-        tokens (ints) as frames arrive.
+                 hedge: Optional[bool] = None,
+                 temperature: Optional[float] = None,
+                 top_k: Optional[int] = None,
+                 top_p: Optional[float] = None,
+                 seed: Optional[int] = None):
+        """Stream one generation over the replica group: yields tokens
+        (ints) as frames arrive. ``temperature``/``top_k``/``top_p``/
+        ``seed`` select on-device sampling (unset = greedy, or the
+        server's ``ZOO_LLM_SAMPLING`` default); the seed defaults to a
+        stable hash of the request id on the server, so every attempt
+        of this stream — retries, hedges, failover resumes — draws the
+        same tokens on any replica.
 
         The PR 5 contracts, applied per stream:
 
@@ -380,6 +389,11 @@ class HAServingClient:
                        "prompt": prompt,
                        "max_new_tokens": int(max_new_tokens),
                        "resume_from": received}
+                for key, val in (("temperature", temperature),
+                                 ("top_k", top_k), ("top_p", top_p),
+                                 ("seed", seed)):
+                    if val is not None:
+                        msg[key] = val
                 try:
                     for frame in conn.stream(dict(msg), deadline=dl):
                         results.put(("frame", att, frame))

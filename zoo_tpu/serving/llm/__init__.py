@@ -13,13 +13,17 @@ rebuild of that result for the Llama family:
   per-request max-length preallocation, so achievable batch depth is
   bounded by *actual* tokens resident, not by worst-case length.
 * :mod:`model` — prefill/decode split over one set of Llama weights:
-  bucketed prompt prefill executables plus exactly ONE fixed-shape
-  (slots x 1 token) decode executable whose attention gathers K/V
-  through the block tables.
+  bucketed (or chunked) prompt prefill executables plus exactly ONE
+  fixed-shape (slots x 1 token) decode executable that reads K/V
+  through the block tables (the paged flash-decode Pallas kernel on
+  TPU, the dense gather off it) and samples the next token ON DEVICE —
+  only slots x 1 ids ever cross to the host.
 * :mod:`engine` — the iteration-level scheduler: every decode step,
   finished slots are freed and waiting requests are admitted into them
   (continuous batching), with PR 5's deadline/admission semantics and
-  per-token streaming out of each slot.
+  per-token streaming out of each slot; the tick itself is
+  double-buffered against the device (overlap pipeline) and long
+  prompts prefill in chunks interleaved with decode.
 * :mod:`spec` — ``llama:...`` model specs so a :class:`ReplicaGroup`
   replica (``zoo_tpu.serving.replica``) can mount the engine behind the
   HA layer.

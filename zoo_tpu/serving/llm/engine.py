@@ -1,18 +1,46 @@
 """Iteration-level (continuous) batching engine for autoregressive
 decode.
 
-The scheduler the tentpole is named after: instead of forming a batch of
-requests and draining it to completion (request-level batching — every
-finished sequence idles its seat until the slowest member ends), the
-engine re-schedules **every decode iteration**: finished/expired/aborted
-streams free their slot and KV blocks, waiting requests are admitted
-into free slots the same tick, and the ONE fixed-shape decode executable
-runs over whatever mix of old and new sequences the slots hold (Orca's
-in-flight batching, OSDI '22).
+The scheduler the PR 7 tentpole was named after: instead of forming a
+batch of requests and draining it to completion (request-level batching
+— every finished sequence idles its seat until the slowest member
+ends), the engine re-schedules **every decode iteration**:
+finished/expired/aborted streams free their slot and KV blocks, waiting
+requests are admitted into free slots the same tick, and the ONE
+fixed-shape decode executable runs over whatever mix of old and new
+sequences the slots hold (Orca's in-flight batching, OSDI '22).
+
+This revision rebuilds the tick itself around the device:
+
+* **Overlapped tick pipeline** (``ZOO_LLM_OVERLAP``, default on) — the
+  loop no longer blocks on each tick's result. Tick N+1's input tokens
+  are tick N's ON-DEVICE output batch (``model.decode_step`` chains
+  them without a host round trip; freshly admitted slots override
+  their lane with the prefill token via a host mask), so the scheduler
+  runs sweep/admit/grow-or-preempt for the next tick while the device
+  executes the current one, and a dedicated readback thread streams
+  each finished batch out to subscribers. At most two ticks are in
+  flight; every dispatched lane carries a ``(slot, handle, epoch)``
+  snapshot, and a lane whose slot was re-assigned (finish, expiry,
+  preemption) between dispatch and readback is discarded on arrival —
+  sampling is a pure function of (seed, token index), so any token a
+  discard loses is re-drawn bit-identically after the resume. Deadline
+  enforcement (every scheduler pass) and youngest-first preemption are
+  unchanged, and the decode executable census stays at exactly 1.
+* **Chunked prefill** (``ZOO_LLM_PREFILL_CHUNK``) — prompts are fed in
+  fixed-size chunks, at most one prefill budget per tick, interleaved
+  with decode, so a long prompt no longer freezes every live stream
+  for its whole prefill. A mid-prefill slot simply doesn't decode yet.
+* **Per-stream sampling** — temperature/top-k/top-p/seed ride the
+  stream (env defaults via ``ZOO_LLM_SAMPLING``), are applied on
+  device through per-slot parameter lanes, and the per-sequence PRNG
+  seed is checkpointed in the sequence's block-table entry
+  (:meth:`BlockAllocator.set_aux`) so preempt-resume and failover
+  replay the same draws.
 
 PR 5's serving semantics apply per stream: a propagated
 :class:`Deadline` is checked at submission (dead-on-arrival), at
-admission, and every decode iteration (mid-stream expiry frees the slot
+admission, and every scheduler pass (mid-stream expiry frees the slot
 immediately); the waiting queue is bounded (overload sheds at the door
 with ``retryable``); a duplicate request id joins the live stream
 instead of decoding twice. Admission is additionally gated on the KV
@@ -21,22 +49,25 @@ one decode block exist (:meth:`BlockAllocator.can_admit`).
 
 When a RUNNING sequence needs its next block and the pool is dry, the
 youngest-admitted victim is **preempted**: blocks freed, stream pushed
-back to the head of the waiting queue, and (because decode is greedy
-and deterministic) re-prefilled later from prompt+generated with no
-client-visible artifact beyond latency.
+back to the head of the waiting queue, and (because decode — greedy or
+seeded — is deterministic) re-prefilled later from prompt+generated
+with no client-visible artifact beyond latency.
 
 The model behind the engine is any adapter with the
-:class:`~zoo_tpu.serving.llm.model.PagedLlamaModel` surface (``prefill``
-/ ``decode`` / shape attrs), so scheduler tests run against a pure-
-python fake without importing jax.
+:class:`~zoo_tpu.serving.llm.model.PagedLlamaModel` surface
+(``prefill`` / ``decode_step`` / ``read_tokens`` / shape attrs), so
+scheduler tests run against a pure-python fake without importing jax.
 """
 
 from __future__ import annotations
 
 import collections
+import os
+import queue as _queue
 import threading
 import time
-from typing import Deque, Dict, List, Optional, Sequence
+import zlib
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -71,6 +102,19 @@ _dedup = counter(
     "zoo_llm_stream_dedup_total",
     "Duplicate stream ids joined to an existing stream instead of "
     "decoding twice")
+# tick-pipeline families (docs/llm_serving.md): where each engine tick
+# spends its time, and how much of the wall clock the device is busy —
+# the overlap the async pipeline exists to create
+_tick_seconds = histogram(
+    "zoo_llm_tick_seconds",
+    "Per-phase engine tick latency (schedule = sweep/admit/grow host "
+    "work, prefill = prompt chunk executions, decode = dispatch-to-"
+    "ready device time, readback = applying a ready batch to streams)",
+    labels=("phase",))
+_overlap_ratio = gauge(
+    "zoo_llm_tick_overlap_ratio",
+    "Device-busy time / wall time over the recent decode window (1.0 "
+    "= the scheduler never leaves the device idle)")
 
 
 class AdmissionError(RuntimeError):
@@ -82,6 +126,53 @@ class AdmissionError(RuntimeError):
         self.retry_after_ms = retry_after_ms
 
 
+def stream_seed(rid: str) -> int:
+    """Deterministic per-stream PRNG seed from the request id: stable
+    across processes and replicas, so an HA failover-with-resume
+    (same rid, fresh replica) replays the same sampling draws."""
+    return zlib.crc32(rid.encode("utf-8")) & 0xFFFFFFFF
+
+
+def parse_sampling(spec, rid: str) -> Tuple[float, int, float, int]:
+    """Normalize a sampling request to ``(temperature, top_k, top_p,
+    seed)``. ``spec`` may be None (greedy unless ``ZOO_LLM_SAMPLING``
+    sets deployment defaults), a dict with any of
+    ``temperature``/``top_k``/``top_p``/``seed``, or an env-style
+    string ``"temperature=0.8,top_k=40,top_p=0.95,seed=7"``. A missing
+    seed derives from the request id (:func:`stream_seed`)."""
+    merged: Dict[str, float] = {}
+    env = os.environ.get("ZOO_LLM_SAMPLING", "")
+    for source in (env, spec):
+        if not source:
+            continue
+        if isinstance(source, str):
+            parts = {}
+            for kv in source.split(","):
+                if not kv.strip():
+                    continue
+                if "=" not in kv:
+                    raise ValueError(
+                        f"malformed sampling component {kv!r} "
+                        "(expected key=value)")
+                k, v = kv.split("=", 1)
+                parts[k.strip()] = v.strip()
+            source = parts
+        unknown = set(source) - {"temperature", "top_k", "top_p", "seed"}
+        if unknown:
+            raise ValueError(f"unknown sampling keys {sorted(unknown)}")
+        merged.update(source)
+    temp = float(merged.get("temperature", 0.0))
+    topk = int(merged.get("top_k", 0))
+    topp = float(merged.get("top_p", 1.0))
+    if temp < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temp}")
+    if not (0.0 < topp <= 1.0):
+        raise ValueError(f"top_p must be in (0, 1], got {topp}")
+    seed = int(merged["seed"]) & 0xFFFFFFFF if "seed" in merged \
+        else stream_seed(rid)
+    return temp, topk, topp, seed
+
+
 class GenHandle:
     """One stream: the scheduler appends tokens, any number of
     subscribers read them by cursor (a duplicate request id or a
@@ -89,11 +180,14 @@ class GenHandle:
     never consumed destructively)."""
 
     def __init__(self, rid: str, prompt: np.ndarray, max_new: int,
-                 deadline: Optional[Deadline]):
+                 deadline: Optional[Deadline],
+                 sampling: Tuple[float, int, float, int] = None):
         self.id = rid
         self.prompt = np.asarray(prompt, np.int32)
         self.max_new = int(max_new)
         self.deadline = deadline
+        self.sampling = sampling if sampling is not None else \
+            (0.0, 0, 1.0, stream_seed(rid))
         self.tokens: List[int] = []
         self.outcome: Optional[str] = None   # None=live
         self.error: Optional[str] = None
@@ -103,8 +197,11 @@ class GenHandle:
         self.cancelled = threading.Event()
         self._cond = threading.Condition()
         self._subs = 0  # live server-side stream loops on this handle
-        # scheduler-side state (owned by the engine thread)
-        self.gen_count = 0        # tokens generated across preemptions
+        # scheduler-side state (owned by the engine under its lock)
+        self.gen_count = 0        # tokens APPLIED (pushed) so far
+        self.sched_count = 0      # tokens dispatched to the device so
+        #                           far (>= gen_count under overlap;
+        #                           the gap is in-flight speculation)
         self.admit_seq = -1       # admission order; preemption victims
         #                           are picked youngest-first
         self.effective_prompt: Optional[np.ndarray] = None  # after
@@ -176,12 +273,22 @@ class GenHandle:
 
 
 class _Slot:
-    __slots__ = ("handle", "last_token", "position")
+    __slots__ = ("handle", "last_token", "position", "phase",
+                 "prefill_pos", "epoch", "host_token", "use_host")
 
     def __init__(self):
         self.handle: Optional[GenHandle] = None
         self.last_token = 0
-        self.position = 0
+        self.position = 0        # cache index the NEXT incoming token
+        #                          will be written at
+        self.phase = "decode"    # "prefill" while chunks are pending
+        self.prefill_pos = 0     # prompt tokens already fed (chunked)
+        self.epoch = 0           # bumped whenever the slot is cleared:
+        #                          an in-flight lane snapshot from an
+        #                          older epoch is discarded on readback
+        self.host_token = 0      # prefill token for the first decode
+        self.use_host = False    # next tick feeds host_token, not the
+        #                          on-device chain
 
 
 class LLMEngine:
@@ -191,21 +298,34 @@ class LLMEngine:
     ``mode="continuous"`` (default) admits into free slots every
     iteration; ``mode="oneshot"`` is the request-level baseline the
     bench compares against — a wave is admitted only when every slot is
-    empty and drains completely before the next wave."""
+    empty and drains completely before the next wave. ``overlap=None``
+    reads ``ZOO_LLM_OVERLAP`` (default on): the double-buffered async
+    tick pipeline, continuous mode only, and only for models exposing
+    the ``decode_step``/``read_tokens`` dispatch surface."""
 
     def __init__(self, model, mode: str = "continuous",
-                 max_waiting: Optional[int] = None):
+                 max_waiting: Optional[int] = None,
+                 overlap: Optional[bool] = None):
         if mode not in ("continuous", "oneshot"):
             raise ValueError(f"unknown scheduling mode {mode!r}")
         self.model = model
         self.mode = mode
+        if overlap is None:
+            overlap = os.environ.get("ZOO_LLM_OVERLAP", "1") not in (
+                "0", "false", "off")
+        self.overlap = bool(overlap) and mode == "continuous" and \
+            hasattr(model, "decode_step") and hasattr(model,
+                                                     "read_tokens")
         self.max_waiting = max_waiting if max_waiting is not None else \
             env_int("ZOO_LLM_MAX_WAITING", 256)
         self.allocator = BlockAllocator(model.num_blocks,
                                         model.block_size)
         self._slots = [_Slot() for _ in range(model.num_slots)]
         self._wait: Deque[GenHandle] = collections.deque()
-        self._lock = threading.Lock()
+        # ONE reentrant state lock: the scheduler holds it across each
+        # pass, the readback thread holds it while applying a batch —
+        # slot/queue state is never observed half-mutated by either
+        self._lock = threading.RLock()
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -218,6 +338,21 @@ class LLMEngine:
         self._finished_cap = env_int("ZOO_LLM_FINISHED_CACHE", 256)
         self._decode_steps = 0
         self._generated = 0
+        # chunked prefill: tokens of prompt fed per tick (0 = whole
+        # prompts at admission, the pre-chunking behavior)
+        self._chunk = int(getattr(model, "prefill_chunk_size", 0) or 0)
+        self._prefill_budget = env_int("ZOO_LLM_PREFILL_BUDGET",
+                                       self._chunk) if self._chunk else 0
+        # overlap bookkeeping
+        self._rbq: "_queue.Queue" = _queue.Queue()
+        self._inflight = threading.Semaphore(2)
+        self._rb_thread: Optional[threading.Thread] = None
+        self._busy_win: Deque[Tuple[float, float]] = \
+            collections.deque(maxlen=64)
+        # set (under the lock) when a dispatch or readback failed: the
+        # on-device token chain references a failed computation and
+        # must be re-seeded from host state before the next dispatch
+        self._chain_broken = False
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "LLMEngine":
@@ -239,6 +374,7 @@ class LLMEngine:
             self._wait.clear()
             for s in self._slots:
                 s.handle = None
+                s.epoch += 1
         for h in live:
             self.allocator.free(h.id)
             h.finish("cancelled", "engine stopped")
@@ -247,17 +383,23 @@ class LLMEngine:
     # -- submission --------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int,
                rid: Optional[str] = None,
-               deadline: Optional[Deadline] = None) -> GenHandle:
-        """Queue one generation. Raises :class:`AdmissionError` when the
-        waiting queue is full (retryable shed), ``ValueError`` for a
-        prompt no prefill bucket can hold."""
+               deadline: Optional[Deadline] = None,
+               sampling=None) -> GenHandle:
+        """Queue one generation. ``sampling``: None (greedy, or the
+        ``ZOO_LLM_SAMPLING`` deployment default), or a dict/string with
+        ``temperature``/``top_k``/``top_p``/``seed`` — a missing seed
+        derives deterministically from the request id, so retries and
+        failover resumes replay the same draws. Raises
+        :class:`AdmissionError` when the waiting queue is full
+        (retryable shed), ``ValueError`` for a prompt no prefill path
+        can hold."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("empty prompt")
         if prompt.size > self.model.max_prompt_len:
             raise ValueError(
                 f"prompt of {prompt.size} tokens exceeds the largest "
-                f"prefill bucket ({self.model.max_prompt_len})")
+                f"prefill capacity ({self.model.max_prompt_len})")
         usable = self.allocator.num_blocks - 1
         if self.allocator.blocks_for_tokens(prompt.size + 1) > usable:
             # can_admit() could NEVER pass: without this check the
@@ -272,6 +414,7 @@ class LLMEngine:
         if rid is None:
             import uuid
             rid = uuid.uuid4().hex
+        params = parse_sampling(sampling, rid)
         with self._lock:
             prior = self._by_id.get(rid)
             if prior is not None:
@@ -283,7 +426,8 @@ class LLMEngine:
                     f"streams, bound {self.max_waiting}); retry "
                     "another replica",
                     retry_after_ms=200)
-            h = GenHandle(rid, prompt, max_new_tokens, deadline)
+            h = GenHandle(rid, prompt, max_new_tokens, deadline,
+                          sampling=params)
             self._by_id[rid] = h
             self._trim_finished()
             self._wait.append(h)
@@ -316,14 +460,15 @@ class LLMEngine:
 
     # -- scheduler ---------------------------------------------------------
     def _publish(self):
-        _occupancy.set(sum(1 for s in self._slots if s.handle))
         with self._lock:
+            _occupancy.set(sum(1 for s in self._slots if s.handle))
             _waiting.set(len(self._wait))
 
     def _finish_slot(self, slot: _Slot, outcome: str,
                      error: Optional[str] = None):
         h = slot.handle
         slot.handle = None
+        slot.epoch += 1   # any in-flight lane for this seat is stale now
         self.allocator.free(h.id)
         h.finish(outcome, error)
 
@@ -332,7 +477,7 @@ class LLMEngine:
 
     def _sweep(self):
         """Free slots whose stream is done for out-of-band reasons
-        (client cancel, deadline expiry, max tokens already reached)."""
+        (client cancel, deadline expiry)."""
         for slot in self._slots:
             h = slot.handle
             if h is None:
@@ -393,23 +538,133 @@ class LLMEngine:
                 with self._lock:
                     self._wait.appendleft(h)
                 break
-            first = self.model.prefill(
-                prompt, self._table_row(self.allocator.blocks_of(h.id)))
-            _tokens.labels(kind="prefill").inc(len(prompt))
+            # the per-sequence sampling state rides the block-table
+            # entry: a scheduler that migrates/resumes the sequence
+            # replays the same PRNG draws from (seed, token index)
+            self.allocator.set_aux(h.id, seed=h.sampling[3],
+                                   resumed_at=len(prompt))
             slot.handle = h
-            slot.last_token = first
-            slot.position = len(prompt)
+            slot.epoch += 1
             self._admit_counter += 1
             h.admit_seq = self._admit_counter
-            h.push(first)
-            h.gen_count += 1
-            self._generated += 1
-            _tokens.labels(kind="decode").inc()
-            eos = getattr(self.model, "eos_id", None)
-            if h.gen_count >= h.max_new or \
-                    (eos is not None and first == eos):
-                self._finish_slot(slot, "ok")
+            # admission only BINDS the slot and blocks; the device
+            # prefill itself (whole prompt, or chunks across ticks)
+            # runs in _prefill_tick OUTSIDE the engine lock, so
+            # submit() and the readback thread never stall behind a
+            # long prompt
+            slot.phase = "prefill"
+            slot.prefill_pos = 0
+            slot.position = 0
         self._publish()
+
+    def _enter_decode(self, slot: _Slot, h: GenHandle, first: int,
+                      prompt_len: int):
+        """Prompt fully prefilled: push the first generated token and
+        arm the slot for the decode chain (first tick host-fed)."""
+        slot.phase = "decode"
+        slot.position = prompt_len
+        slot.last_token = first
+        slot.host_token = first
+        slot.use_host = True
+        h.push(first)
+        h.gen_count += 1
+        h.sched_count += 1
+        self._generated += 1
+        _tokens.labels(kind="decode").inc()
+        eos = getattr(self.model, "eos_id", None)
+        if h.gen_count >= h.max_new or \
+                (eos is not None and first == eos):
+            self._finish_slot(slot, "ok")
+
+    def _select_prefill(self) -> List[tuple]:
+        """Under the lock: claim this tick's prefill work — whole
+        prompts (chunking off), or up to one budget of chunks, oldest
+        admission first. Claiming advances ``prefill_pos`` so the next
+        select never double-feeds; the device calls themselves run
+        outside the lock (:meth:`_run_prefill`)."""
+        pending = sorted(
+            (s for s in self._slots
+             if s.handle is not None and s.phase == "prefill"),
+            key=lambda s: s.handle.admit_seq)
+        budget = self._prefill_budget if self._chunk else None
+        work = []
+        for slot in pending:
+            h = slot.handle
+            prompt = h.effective_prompt if h.effective_prompt \
+                is not None else h.prompt
+            n = len(prompt)
+            start = slot.prefill_pos
+            if start >= n:
+                continue   # fed, result still in flight this tick
+            if budget is None:
+                take = n
+            else:
+                if budget <= 0:
+                    break
+                take = min(self._chunk, n - start)
+                budget -= take
+            slot.prefill_pos = start + take
+            work.append((slot, h, slot.epoch, prompt, start, take, n,
+                         self._table_row(self.allocator.blocks_of(
+                             h.id))))
+        return work
+
+    def _run_prefill(self, work) -> List[tuple]:
+        """OUTSIDE the lock: execute the claimed prefill device calls
+        (submit() and the readback thread keep flowing while a long
+        prompt runs). Returns per-item results for _apply_prefill."""
+        results = []
+        for slot, h, epoch, prompt, start, take, n, row in work:
+            t0 = time.perf_counter()
+            try:
+                if self._chunk:
+                    tok = self.model.prefill_chunk(
+                        prompt[start:start + take], start, n, row,
+                        sampling=h.sampling)
+                else:
+                    tok = self.model.prefill(prompt, row,
+                                             sampling=h.sampling)
+            except Exception as e:  # noqa: BLE001 — a prefill failure
+                # must end THIS stream loudly, not kill the scheduler
+                # thread with every stream hanging
+                results.append((slot, h, epoch, start, take, n, None,
+                                e))
+                continue
+            _tick_seconds.labels(phase="prefill").observe(
+                time.perf_counter() - t0)
+            _tokens.labels(kind="prefill").inc(take)
+            results.append((slot, h, epoch, start, take, n, tok, None))
+        return results
+
+    def _apply_prefill(self, results):
+        """Under the lock: land prefill results. A slot that moved on
+        while the device ran (cancel/expiry/preemption bumped the
+        epoch) is skipped — its K/V writes are overwritten before any
+        new owner reads them, same argument as in-flight decode
+        lanes."""
+        for slot, h, epoch, start, take, n, tok, err in results:
+            if slot.handle is not h or slot.epoch != epoch or h.done:
+                continue
+            if err is not None:
+                self._finish_slot(slot, "error",
+                                  f"prefill failed: {err!r}")
+                continue
+            if start + take >= n:
+                self._enter_decode(slot, h, tok, n)
+        self._publish()
+
+    def _prefill_tick(self):
+        """One tick of prompt feeding: long prompts advance a chunk per
+        tick while every live stream keeps decoding — the anti-stall
+        the chunk executable exists for. Lock is held only around the
+        claim and the apply, never across the device."""
+        with self._lock:
+            work = self._select_prefill()
+        if not work:
+            return
+        results = self._run_prefill(work)
+        with self._lock:
+            self._apply_prefill(results)
 
     def _table_row(self, blocks: Sequence[int]) -> np.ndarray:
         row = np.zeros((self.model.max_blocks_per_seq,), np.int32)
@@ -417,25 +672,28 @@ class LLMEngine:
         return row
 
     def _grow_or_preempt(self) -> None:
-        """Every active slot must own the block its next write lands in
-        (position // block_size). When the free list is dry, evict the
-        youngest-admitted stream and retry; a stream that cannot even
-        self-fund (alone and out of pool) errors out."""
+        """Every decoding slot must own the block its next write lands
+        in (position // block_size). When the free list is dry, evict
+        the youngest-admitted stream and retry; a stream that cannot
+        even self-fund (alone and out of pool) errors out."""
         bs = self.model.block_size
         for slot in self._slots:
             h = slot.handle
-            if h is None:
+            if h is None or slot.phase != "decode":
                 continue
             needed = slot.position // bs + 1
             while True:
-                have = len(self.allocator.blocks_of(h.id))
-                if have >= needed:
-                    break
                 if needed > self.model.max_blocks_per_seq:
                     # block table is full: the sequence hit the context
-                    # ceiling — a truncated-but-successful stream
-                    h.truncated = True
-                    self._finish_slot(slot, "ok")
+                    # ceiling — a truncated-but-successful stream. With
+                    # ticks in flight, wait until every dispatched
+                    # token has been applied so none are dropped.
+                    if h.sched_count == h.gen_count:
+                        h.truncated = True
+                        self._finish_slot(slot, "ok")
+                    break
+                have = len(self.allocator.blocks_of(h.id))
+                if have >= needed:
                     break
                 if self.allocator.allocate(h.id, 1) is not None:
                     continue
@@ -461,66 +719,308 @@ class LLMEngine:
     def _preempt(self, slot: _Slot):
         """Evict a running stream: free its blocks and requeue it with
         prompt := original prompt + everything generated so far.
-        Greedy decode is deterministic, so the re-prefilled
-        continuation matches what the stream would have produced —
-        subscribers just see a pause."""
+        Decode (greedy or seeded sampling — the PRNG key is a pure
+        function of seed and token index, and the seed was
+        checkpointed with the block-table entry) is deterministic, so
+        the re-prefilled continuation matches what the stream would
+        have produced — subscribers just see a pause. Tokens dispatched
+        but not yet read back are dropped with the slot epoch and
+        re-drawn identically after the resume."""
         h = slot.handle
         resumed = np.concatenate(
             [h.prompt, np.asarray(h.tokens, np.int32)])
         if len(resumed) > self.model.max_prompt_len:
-            # cannot re-prefill a context longer than the biggest
-            # bucket; end it as truncated-ok rather than wedge the pool
+            # cannot re-prefill a context longer than the prefill path
+            # can hold; end it as truncated-ok rather than wedge the
+            # pool
             h.truncated = True
             self._finish_slot(slot, "ok")
             return
+        # replay alignment: everything past the APPLIED tokens is
+        # regenerated from the checkpointed (seed, token index) state
+        aux = self.allocator.get_aux(h.id)
+        assert aux is None or aux.get("seed") == h.sampling[3]
         h.effective_prompt = resumed
+        h.sched_count = h.gen_count
         slot.handle = None
+        slot.epoch += 1
         self.allocator.free(h.id)
         _preempts.inc()
         with self._lock:
             self._wait.appendleft(h)
 
-    def _decode_tick(self):
+    def _build_tick(self, device_chain: bool):
+        """Assemble the fixed-shape decode operands for every decoding
+        slot (one lane per slot; idle/prefilling lanes write to the
+        trash block and are never read). ``device_chain`` feeds
+        continuing lanes from the previous tick's on-device batch;
+        the sync path host-feeds every lane from ``slot.last_token``.
+        Advances positions/sched counters — the caller WILL dispatch.
+        Returns None when no lane decodes this tick."""
         S = self.model.num_slots
-        tokens = np.zeros((S,), np.int32)
+        host = np.zeros((S,), np.int32)
+        use = np.zeros((S,), bool)
         tables = np.zeros((S, self.model.max_blocks_per_seq), np.int32)
         positions = np.zeros((S,), np.int32)
-        active = []
+        temps = np.zeros((S,), np.float32)
+        topks = np.zeros((S,), np.int32)
+        topps = np.ones((S,), np.float32)
+        seeds = np.zeros((S,), np.uint32)
+        snapshot = []
         for i, slot in enumerate(self._slots):
-            if slot.handle is None:
-                continue
-            active.append(i)
-            tokens[i] = slot.last_token
-            tables[i] = self._table_row(
-                self.allocator.blocks_of(slot.handle.id))
-            positions[i] = slot.position
-        if not active:
-            return False
-        nxt = self.model.decode(tokens, tables, positions)
-        self._decode_steps += 1
-        _steps.inc()
-        for i in active:
-            slot = self._slots[i]
             h = slot.handle
+            if h is None or slot.phase != "decode" or h.done:
+                continue
+            ctx = getattr(self.model, "max_context",
+                          self.model.max_blocks_per_seq *
+                          self.model.block_size)
+            if h.sched_count >= h.max_new or slot.position >= ctx:
+                # everything is dispatched (or the table is full):
+                # this lane idles until readback settles its fate
+                continue
+            snapshot.append((i, h, slot.epoch))
+            tables[i] = self._table_row(
+                self.allocator.blocks_of(h.id))
+            positions[i] = slot.position
+            if device_chain:
+                if slot.use_host:
+                    use[i] = True
+                    host[i] = slot.host_token
+                    slot.use_host = False
+            else:
+                use[i] = True
+                host[i] = slot.last_token
+            t, k, p, s = h.sampling
+            temps[i], topks[i], topps[i], seeds[i] = t, k, p, s
             slot.position += 1
-            tok = int(nxt[i])
+            h.sched_count += 1
+        if not snapshot:
+            return None
+        return (host, use, tables, positions,
+                (temps, topks, topps, seeds), snapshot)
+
+    def _fail_lanes(self, snapshot, err: BaseException):
+        """A dispatched batch's tokens are unrecoverable (dispatch or
+        readback raised): end the affected streams LOUDLY. Skipping
+        silently would leave a one-token hole in each stream and a
+        sched/gen gap that wedges the slot (and its KV blocks) forever.
+        Under self._lock."""
+        for i, h, epoch in snapshot:
+            slot = self._slots[i]
+            if slot.handle is h and slot.epoch == epoch and not h.done:
+                self._finish_slot(
+                    slot, "error",
+                    f"decode tick failed, stream tokens lost: {err!r}")
+        self._chain_broken = True
+        self._publish()
+
+    def _apply_tokens(self, snapshot, arr: np.ndarray):
+        """Apply one readback batch to its streams. A lane whose slot
+        moved on (finish / expiry / preemption bumped the epoch) is
+        discarded — its token is either unwanted or will be re-drawn
+        bit-identically by the resume."""
+        eos = getattr(self.model, "eos_id", None)
+        for i, h, epoch in snapshot:
+            slot = self._slots[i]
+            if slot.handle is not h or slot.epoch != epoch or h.done:
+                continue
+            tok = int(arr[i])
             slot.last_token = tok
             h.push(tok)
             h.gen_count += 1
             self._generated += 1
             _tokens.labels(kind="decode").inc()
-            eos = getattr(self.model, "eos_id", None)
             if h.gen_count >= h.max_new or \
                     (eos is not None and tok == eos):
                 self._finish_slot(slot, "ok")
         self._publish()
+
+    def _decode_tick(self):
+        """The SYNCHRONOUS tick (request-level baseline, overlap-off
+        runs, and white-box tests): host-fed lanes, blocking readback,
+        apply inline."""
+        with self._lock:
+            built = self._build_tick(device_chain=False)
+        if built is None:
+            return False
+        host, use, tables, positions, lanes, snapshot = built
+        t0 = time.perf_counter()
+        try:
+            if hasattr(self.model, "decode_step"):
+                batch = self.model.decode_step(None, host, use, tables,
+                                               positions, lanes)
+                arr = self.model.read_tokens(batch)
+            else:
+                arr = self.model.decode(host, tables, positions, lanes)
+        except Exception as e:  # noqa: BLE001 — same contract as the
+            # overlap pipeline: lost tokens end their streams loudly
+            # instead of leaving a silent hole + wedged slot
+            with self._lock:
+                self._fail_lanes(snapshot, e)
+            return True
+        t1 = time.perf_counter()
+        _tick_seconds.labels(phase="decode").observe(t1 - t0)
+        self._note_busy(t0, t1)
+        self._decode_steps += 1
+        _steps.inc()
+        with self._lock:
+            self._apply_tokens(snapshot, arr)
+        _tick_seconds.labels(phase="readback").observe(
+            time.perf_counter() - t1)
         return True
 
-    def _loop(self):
+    # -- overlap pipeline --------------------------------------------------
+    def _note_busy(self, t_start: float, t_ready: float):
+        """Record one tick's device-busy interval and refresh the
+        overlap gauge over the recent window (busy intervals are
+        clipped to start after the previous ready, so two in-flight
+        ticks never double-count the same wall time)."""
+        last = self._busy_win[-1][0] if self._busy_win else 0.0
+        busy = max(0.0, t_ready - max(t_start, last))
+        self._busy_win.append((t_ready, busy))
+        ratio = self._window_ratio()
+        if ratio is not None:
+            _overlap_ratio.set(ratio)
+
+    def _window_ratio(self) -> Optional[float]:
+        """THIS engine's device-busy / wall over the recent window.
+        ``stats()`` reads this (not the process-global gauge: two
+        engines in one process — a hot-swap pair, in-process HA test
+        rigs — would otherwise report each other's ratio)."""
+        if len(self._busy_win) < 2:
+            return None
+        win = list(self._busy_win)
+        wall = win[-1][0] - win[0][0]
+        if wall <= 0:
+            return None
+        return min(1.0, sum(b for _, b in win[1:]) / wall)
+
+    def _readback_loop(self):
+        while True:
+            item = self._rbq.get()
+            if item is None:
+                return
+            batch, snapshot, t_dispatch = item
+            try:
+                arr = self.model.read_tokens(batch)
+            except Exception as e:  # noqa: BLE001 — these lanes'
+                # tokens are gone (and the donated-cache chain may be
+                # poisoned): end the streams loudly and tell the
+                # dispatcher to re-seed the device token chain
+                with self._lock:
+                    self._fail_lanes(snapshot, e)
+                self._inflight.release()
+                self._wake.set()
+                continue
+            t_ready = time.perf_counter()
+            _tick_seconds.labels(phase="decode").observe(
+                t_ready - t_dispatch)
+            self._note_busy(t_dispatch, t_ready)
+            with self._lock:
+                self._apply_tokens(snapshot, arr)
+            _tick_seconds.labels(phase="readback").observe(
+                time.perf_counter() - t_ready)
+            self._decode_steps += 1
+            _steps.inc()
+            self._inflight.release()
+            self._wake.set()
+
+    def _loop_overlapped(self):
+        """The double-buffered tick pipeline: dispatch tick N, then run
+        the host scheduler for tick N+1 while the device executes and
+        the readback thread streams tick N-1's tokens out. Tick N+1's
+        continuing lanes consume tick N's ON-DEVICE output batch, so
+        the steady-state hot path moves slots x 1 ids to the host and
+        nothing to the device but block tables and positions."""
+        self._rb_thread = threading.Thread(
+            target=self._readback_loop, daemon=True,
+            name="zoo-llm-readback")
+        self._rb_thread.start()
+        prev_batch = None
+        try:
+            while not self._stop.is_set():
+                with self._lock:
+                    broken = self._chain_broken
+                if broken:
+                    # drain the pipeline first — every still-in-flight
+                    # batch chained on the failed computation will fail
+                    # its own readback and error-finish its own lanes —
+                    # then re-seed the SURVIVING decode slots (streams
+                    # never in a failed batch) from their last APPLIED
+                    # token and restart the device chain from host state
+                    grabbed = 0
+                    while grabbed < 2 and not self._stop.is_set():
+                        if self._inflight.acquire(timeout=0.5):
+                            grabbed += 1
+                    with self._lock:
+                        self._chain_broken = False
+                        for slot in self._slots:
+                            if slot.handle is not None and \
+                                    slot.phase == "decode":
+                                slot.use_host = True
+                                slot.host_token = slot.last_token
+                    prev_batch = None
+                    for _ in range(grabbed):
+                        self._inflight.release()
+                    if self._stop.is_set():
+                        return
+                t0 = time.perf_counter()
+                with self._lock:
+                    self._sweep()
+                    self._admit()
+                t1 = time.perf_counter()
+                # device prefill runs UNLOCKED: submissions and token
+                # readback keep flowing while a long prompt feeds
+                self._prefill_tick()
+                t2 = time.perf_counter()
+                with self._lock:
+                    self._grow_or_preempt()
+                    built = self._build_tick(device_chain=True)
+                _tick_seconds.labels(phase="schedule").observe(
+                    (t1 - t0) + (time.perf_counter() - t2))
+                if built is None:
+                    # no decodable lane: break the device token chain
+                    # (every post-idle admission is host-fed anyway)
+                    prev_batch = None
+                    self._wake.wait(0.005)
+                    self._wake.clear()
+                    continue
+                host, use, tables, positions, lanes, snapshot = built
+                # bound the pipeline depth: at most 2 ticks in flight
+                while not self._inflight.acquire(timeout=0.5):
+                    if self._stop.is_set():
+                        return
+                t_d = time.perf_counter()
+                try:
+                    prev_batch = self.model.decode_step(
+                        prev_batch, host, use, tables, positions, lanes)
+                except Exception as e:  # noqa: BLE001 — consuming a
+                    # poisoned prev batch / cache raises here; fail the
+                    # built lanes loudly and re-seed instead of letting
+                    # the scheduler thread die with streams hanging
+                    with self._lock:
+                        self._fail_lanes(snapshot, e)
+                    self._inflight.release()
+                    continue
+                self._rbq.put((prev_batch, snapshot, t_d))
+        finally:
+            self._rbq.put(None)
+            if self._rb_thread is not None:
+                self._rb_thread.join(timeout=10)
+
+    def _loop_sync(self):
         while not self._stop.is_set():
-            self._sweep()
-            self._admit()
-            self._grow_or_preempt()
+            t0 = time.perf_counter()
+            with self._lock:
+                self._sweep()
+                self._admit()
+            t1 = time.perf_counter()
+            self._prefill_tick()
+            t2 = time.perf_counter()
+            with self._lock:
+                self._grow_or_preempt()
+            _tick_seconds.labels(phase="schedule").observe(
+                (t1 - t0) + (time.perf_counter() - t2))
             progressed = self._decode_tick()
             if not progressed:
                 # also parks the loop when the waiting queue is only
@@ -530,16 +1030,27 @@ class LLMEngine:
                 self._wake.wait(0.005)
                 self._wake.clear()
 
+    def _loop(self):
+        if self.overlap:
+            self._loop_overlapped()
+        else:
+            self._loop_sync()
+
     # -- introspection -----------------------------------------------------
     def stats(self) -> Dict:
         out = {"mode": self.mode,
+               "overlap": self.overlap,
                "slots": self.model.num_slots,
                # tensor-parallel ways the model spans (1 = replicated
                # single-device weights — the pre-mesh layout)
                "tp": getattr(self.model, "tp", 1),
+               "prefill_chunk": self._chunk,
+               "decode_attention_impl": getattr(
+                   self.model, "decode_attention_impl", "host"),
                "active": sum(1 for s in self._slots if s.handle),
                "waiting": len(self._wait),
                "decode_steps": self._decode_steps,
+               "overlap_ratio": self._window_ratio() or 0.0,
                "generated_tokens": self._generated}
         out.update(self.allocator.stats())
         if hasattr(self.model, "compile_counts"):

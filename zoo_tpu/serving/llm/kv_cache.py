@@ -60,6 +60,10 @@ class BlockAllocator:
         # LIFO free list: a just-freed block is re-handed warm
         self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
         self._owners: Dict[str, List[int]] = {}
+        # per-sequence aux state riding the block-table entry (e.g. the
+        # sampling PRNG seed): whoever resumes the sequence replays
+        # from exactly what was checkpointed here
+        self._aux: Dict[str, Dict] = {}
         self._publish()
 
     # -- accounting --------------------------------------------------------
@@ -84,6 +88,19 @@ class BlockAllocator:
     def blocks_for_tokens(self, n_tokens: int) -> int:
         """Blocks a sequence of ``n_tokens`` occupies."""
         return max(1, -(-int(n_tokens) // self.block_size))
+
+    def set_aux(self, seq_id: str, **aux):
+        """Checkpoint per-sequence state alongside the block-table
+        entry (the engine stores the sampling PRNG seed here, so a
+        preempted/migrated sequence replays identical draws). Cleared
+        with the blocks by :meth:`free`."""
+        with self._lock:
+            self._aux.setdefault(seq_id, {}).update(aux)
+
+    def get_aux(self, seq_id: str) -> Optional[Dict]:
+        with self._lock:
+            aux = self._aux.get(seq_id)
+            return dict(aux) if aux is not None else None
 
     # -- allocation --------------------------------------------------------
     def can_admit(self, prompt_len: int) -> bool:
@@ -115,6 +132,7 @@ class BlockAllocator:
         can race without double-freeing."""
         with self._lock:
             blocks = self._owners.pop(seq_id, None)
+            self._aux.pop(seq_id, None)
             if not blocks:
                 return 0
             self._free.extend(reversed(blocks))

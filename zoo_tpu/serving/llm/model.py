@@ -9,23 +9,48 @@ XLA device:
   flash kernel at long buckets on TPU, the fused dense path otherwise
   (``resolve_attention_impl``). The prompt's K/V are scattered into the
   paged cache through the sequence's block table as part of the same
-  executable.
+  executable. With ``prefill_chunk=N`` (``ZOO_LLM_PREFILL_CHUNK``) the
+  bucket census collapses to ONE chunk executable: prompts are fed in
+  fixed-size N-token chunks that attend over everything already
+  resident in the cache, so a 4k prompt costs many short ticks the
+  scheduler interleaves with decode instead of one long stall.
 * **Decode** — exactly ONE fixed-shape executable: ``num_slots``
   sequences x 1 token. Every iteration it writes the incoming token's
-  K/V through the block tables, then runs **paged-gather attention**:
-  K/V are gathered ``cache[block_table]`` per slot, masked to each
-  sequence's true length, never materialized contiguous per sequence.
-  Slot count, table width and block count are fixed at construction, so
-  the decode loop NEVER recompiles — request churn only changes the
-  *contents* of the token/table/position operands (the Orca
-  iteration-level scheduling precondition).
+  K/V through the block tables, runs **paged attention** over the
+  cache, and **samples the next token on device** (greedy argmax or
+  temperature/top-k/top-p with per-slot parameter lanes and per-slot
+  PRNG keys), so only ``slots x 1`` int32 ids ever cross to the host —
+  never the ``slots x vocab`` logits. Slot count, table width and block
+  count are fixed at construction, so the decode loop NEVER recompiles
+  — request churn only changes the *contents* of the operands (the
+  Orca iteration-level scheduling precondition).
+
+Decode attention has two implementations behind
+:func:`resolve_decode_impl`:
+
+* ``"flash"`` (TPU default) — the paged flash-decode Pallas kernel
+  (:mod:`zoo_tpu.ops.pallas.paged_decode`): K/V blocks are read
+  directly through the block table with online softmax and split-KV
+  parallelism, never materializing the gathered per-sequence cache;
+* ``"dense"`` (off-TPU default, and the correctness reference) — the
+  PR 7 ``cache[block_table]`` gather + masked softmax.
+
+Token identity between the two is asserted by the test suite; a decode
+tick's sampled ids are also a pure function of (weights, prompt,
+sampling params, seed, token index) — the PRNG key for token *i* is
+``fold_in(seed, i)``, independent of scheduling history — so
+preempt-resume and HA failover-with-resume replay byte-identically.
 
 Inactive slots point their block table at the reserved trash block 0
 and are masked by position, so the executable has no liveness branch.
 
 The cache lives here as two device arrays
 ``(n_layer, num_blocks, block_size, n_kv_head, head_dim)``, donated
-through every prefill/decode call so XLA updates them in place.
+through every prefill/decode call so XLA updates them in place. The
+sampled token batch of a decode tick is likewise returned as a DEVICE
+array that :meth:`decode_step` accepts back as the next tick's input —
+the engine's overlapped pipeline chains ticks without a host round
+trip, and only the async readback thread ever blocks on a transfer.
 
 **Tensor-parallel serving** (``mesh=``): ONE set of weights and ONE
 paged KV cache span every device of the mesh's ``model`` axis instead
@@ -33,16 +58,20 @@ of the model being cloned per replica — attention/MLP weights follow
 the megatron plan (``zoo_tpu.parallel.plans``), the KV cache is sharded
 on its ``n_kv_head`` axis (each device owns its heads' K/V for every
 block), and both executables are jitted with explicit NamedSharding
-in/out shardings. The donation aliasing keeps the in-place cache
-update, so the single-decode-executable and zero-recompile invariants
-hold unchanged on the mesh; per-device weight+cache memory drops to
-~1/tp of the replicated model.
+in/out shardings. The flash kernel runs under ``shard_map`` over the
+``model`` axis (each device decodes its own KV heads; attention is
+head-local so no collective is needed before the output projection).
+The donation aliasing keeps the in-place cache update, so the
+single-decode-executable and zero-recompile invariants hold unchanged
+on the mesh; per-device weight+cache memory drops to ~1/tp of the
+replicated model.
 """
 
 from __future__ import annotations
 
+import os
 import threading
-from typing import List, Optional, Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -57,9 +86,41 @@ from zoo_tpu.models.llm.llama import (
     resolve_attention_impl,
     rope_frequencies,
 )
+from zoo_tpu.obs.metrics import counter
 from zoo_tpu.ops.attention import dot_product_attention
 
 DEFAULT_PREFILL_BUCKETS = (32, 128, 512)
+
+# the host-transfer audit: everything the decode hot path moves across
+# the device boundary per tick (tokens out). The acceptance contract —
+# slots x 1 int32 ids, never slots x vocab logits — is asserted against
+# this counter's per-tick delta.
+_host_transfer = counter(
+    "zoo_llm_host_transfer_bytes_total",
+    "Bytes read back from the device by the LLM serving hot path, by "
+    "payload kind (tokens = the per-tick slots x 1 id batch)",
+    labels=("kind",))
+
+
+def resolve_decode_impl(impl: Optional[str] = "auto") -> str:
+    """Concrete decode-attention kernel for this process.
+
+    ``"auto"`` (default) picks the paged flash-decode Pallas kernel on
+    TPU hardware (``pallas.on_tpu()`` — device_kind probe, so an
+    experimentally-named platform is not silently demoted) and the
+    dense-gather reference off TPU, where the kernel would run under
+    the slow interpreter. ``ZOO_LLM_DECODE_IMPL`` force-overrides for
+    A/B runs and for asserting token identity on CPU
+    (``dense`` / ``flash``)."""
+    if impl in (None, "auto"):
+        impl = os.environ.get("ZOO_LLM_DECODE_IMPL", "") or "auto"
+    if impl != "auto":
+        if impl not in ("dense", "flash"):
+            raise ValueError(f"unknown decode impl {impl!r} "
+                             "(dense / flash / auto)")
+        return impl
+    from zoo_tpu.ops.pallas import on_tpu
+    return "flash" if on_tpu() else "dense"
 
 
 def _pick_bucket(buckets: Sequence[int], n: int) -> Optional[int]:
@@ -69,13 +130,93 @@ def _pick_bucket(buckets: Sequence[int], n: int) -> Optional[int]:
     return None
 
 
+# ------------------------------------------------------ on-device sampling
+
+GREEDY = (0.0, 0, 1.0, 0)  # (temperature, top_k, top_p, seed)
+
+
+def _sample_one(logits: jnp.ndarray, temp, topk, topp, key):
+    """Sample ONE token id from a (vocab,) logit row on device.
+
+    ``temp <= 0`` is greedy argmax (the seed is never consulted, so
+    greedy streams stay reproducible without PRNG bookkeeping).
+    Otherwise: temperature-scale, keep the top-k logits (``topk <= 0``
+    disables), keep the top-p nucleus of the remaining mass
+    (``topp >= 1`` disables), then draw via Gumbel-max with the given
+    key — the draw is a pure function of (logits, params, key), which
+    is what makes preempt/failover replay byte-identical."""
+    v = logits.shape[-1]
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temp, 1e-4)
+    desc = jnp.sort(scaled)[::-1]
+    kth = desc[jnp.clip(topk, 1, v) - 1]
+    masked = jnp.where(jnp.logical_or(topk <= 0, scaled >= kth),
+                       scaled, -jnp.inf)
+    probs = jax.nn.softmax(masked)
+    sp = jnp.sort(probs)[::-1]
+    # nucleus: the smallest prefix of the sorted probs reaching topp;
+    # a token is in it iff the mass STRICTLY BEFORE it is < topp
+    included = (jnp.cumsum(sp) - sp) < topp
+    thresh = jnp.min(jnp.where(included, sp, jnp.inf))
+    masked = jnp.where(probs >= thresh, masked, -jnp.inf)
+    sampled = jnp.argmax(
+        masked + jax.random.gumbel(key, (v,), jnp.float32)
+    ).astype(jnp.int32)
+    return jnp.where(temp <= 0.0, greedy, sampled)
+
+
+def _slot_keys(seeds: jnp.ndarray, token_index: jnp.ndarray):
+    """Per-slot PRNG key for sampling the token at ``token_index``:
+    ``fold_in(PRNGKey(seed), index)``. Stateless by construction — the
+    key depends only on the stream's seed and the token's position in
+    the sequence, never on scheduling history, so a preempted stream
+    re-prefilled on this (or any) replica redraws identical tokens."""
+    base = jnp.stack([jnp.zeros_like(seeds), seeds],
+                     axis=-1).astype(jnp.uint32)          # raw threefry
+    return jax.vmap(jax.random.fold_in)(base, token_index)
+
+
+def _sample_row(logits, temp, topk, topp, seed, token_index):
+    """Single-row sampling for the prefill executables' first generated
+    token: same greedy ``lax.cond`` fast path as the decode batch."""
+    def drawn(_):
+        key = _slot_keys(jnp.asarray([seed], jnp.uint32),
+                         jnp.asarray([token_index]))[0]
+        return _sample_one(logits, temp, topk, topp, key)
+
+    return jax.lax.cond(
+        temp > 0.0, drawn,
+        lambda _: jnp.argmax(logits).astype(jnp.int32), None)
+
+
+def _sample_tokens(logits, temps, topks, topps, seeds, token_index):
+    """(S, vocab) logits -> (S,) int32 ids, all lanes independent.
+
+    Greedy-only batches (the default deployment) take a
+    ``lax.cond`` fast path that skips the whole sampling pipeline —
+    two O(V log V) vocab sorts, a softmax/cumsum, and a Gumbel draw
+    per lane would otherwise run every tick just to be discarded by
+    the temperature select. One executable either way."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def drawn(_):
+        keys = _slot_keys(seeds, token_index)
+        sampled = jax.vmap(_sample_one)(logits, temps, topks, topps,
+                                        keys)
+        return jnp.where(temps <= 0.0, greedy, sampled)
+
+    return jax.lax.cond(jnp.any(temps > 0.0), drawn,
+                        lambda _: greedy, None)
+
+
 class PagedLlamaModel:
-    """Llama weights + paged KV cache + the two serving executables.
+    """Llama weights + paged KV cache + the serving executables.
 
     ``params=None`` builds deterministic weights from ``seed`` — every
     replica of a ``llama:...`` spec holds bit-identical params, so
-    greedy decode is reproducible across the group (the property the
-    HA client's failover-resume leans on).
+    decode (greedy or seeded sampling) is reproducible across the
+    group (the property the HA client's failover-resume leans on).
     """
 
     def __init__(self, config: LlamaConfig, *,
@@ -85,6 +226,8 @@ class PagedLlamaModel:
                  num_blocks: int = 128,
                  max_blocks_per_seq: int = 32,
                  prefill_buckets: Sequence[int] = DEFAULT_PREFILL_BUCKETS,
+                 prefill_chunk: Optional[int] = None,
+                 decode_impl: str = "auto",
                  eos_id: Optional[int] = None,
                  mesh=None):
         self.cfg = config
@@ -94,6 +237,11 @@ class PagedLlamaModel:
         self.max_blocks_per_seq = int(max_blocks_per_seq)
         self.prefill_buckets = tuple(sorted(int(b) for b in
                                             prefill_buckets))
+        if prefill_chunk is None:
+            prefill_chunk = int(os.environ.get("ZOO_LLM_PREFILL_CHUNK",
+                                               "0") or 0)
+        self.prefill_chunk_size = int(prefill_chunk)
+        self.decode_attention_impl = resolve_decode_impl(decode_impl)
         self.eos_id = eos_id
         if self.num_slots < 1 or self.num_blocks < 2:
             raise ValueError("need >= 1 slot and >= 2 KV blocks")
@@ -103,7 +251,10 @@ class PagedLlamaModel:
                 f"largest prefill bucket {self.prefill_buckets[-1]} "
                 f"exceeds the block-table context capacity "
                 f"{self.max_context}")
-        self.max_prompt_len = self.prefill_buckets[-1]
+        self.max_prompt_len = self.prefill_buckets[-1] \
+            if not self.prefill_chunk_size else self.max_context
+        if self.prefill_chunk_size < 0:
+            raise ValueError("prefill_chunk must be >= 0 (0 = off)")
 
         self.mesh = mesh if mesh is not None \
             and getattr(mesh, "size", 1) > 1 else None
@@ -121,7 +272,7 @@ class PagedLlamaModel:
         self.params = params if params is not None else layer.build(
             jax.random.PRNGKey(seed), (None, self.prefill_buckets[-1]))
         # rope tables over the whole pageable context, closed over by
-        # both executables (f32, tiny: max_context x head_dim/2)
+        # every executable (f32, tiny: max_context x head_dim/2)
         self._cos, self._sin = rope_frequencies(
             c.head_dim, self.max_context, c.rope_theta)
         shape = (c.n_block, self.num_blocks, self.block_size,
@@ -129,13 +280,23 @@ class PagedLlamaModel:
         self._kc = jnp.zeros(shape, jnp.float32)
         self._vc = jnp.zeros(shape, jnp.float32)
         # one call at a time: prefill/decode donate + replace the cache
-        # arrays, so interleaved calls would race the handoff
+        # arrays, so interleaved calls would race the handoff. (The
+        # lock covers DISPATCH only — decode_step returns a device
+        # future, and chaining the donated caches sequences the actual
+        # executions on the device stream.)
         self._lock = threading.Lock()
+        # the chain seed for prev_tokens on an idle restart — placed
+        # exactly like a decode output so the executable census stays
+        # at one (a default-device zeros array would be a distinct
+        # sharding layout and compile a second entry under a mesh)
+        self._zero_tokens = jnp.zeros((self.num_slots,), jnp.int32)
         if self.mesh is None:
             # caches are args 1,2 → donated: XLA aliases them in place
             self._decode = jax.jit(self._decode_fn, donate_argnums=(1, 2))
             self._prefill = jax.jit(self._prefill_fn,
                                     donate_argnums=(1, 2))
+            self._prefill_chunked = jax.jit(self._prefill_chunk_fn,
+                                            donate_argnums=(1, 2))
         else:
             from jax.sharding import NamedSharding, PartitionSpec as P
             from zoo_tpu.parallel.mesh import (
@@ -147,26 +308,32 @@ class PagedLlamaModel:
             publish_mesh_metrics(self.mesh)
             self.params = place_params(self.params, self.mesh)
             rep = replicated_sharding(self.mesh)
+            self._zero_tokens = jax.device_put(self._zero_tokens, rep)
             kv_sh = NamedSharding(
                 self.mesh, P(None, None, None, "model", None))
             self._kc = jax.device_put(self._kc, kv_sh)
             self._vc = jax.device_put(self._vc, kv_sh)
             p_sh = shardings_of(self.params, self.mesh)
             # identical donated in/out cache shardings keep the in-place
-            # alias on the mesh; token/table/position operands and the
-            # emitted tokens are replicated (host round trip unchanged)
+            # alias on the mesh; token/table/position/sampling operands
+            # and the emitted token ids are replicated (the host round
+            # trip stays slots x 1)
             self._decode = jax.jit(
                 self._decode_fn, donate_argnums=(1, 2),
-                in_shardings=(p_sh, kv_sh, kv_sh, rep, rep, rep),
+                in_shardings=(p_sh, kv_sh, kv_sh) + (rep,) * 9,
                 out_shardings=(rep, kv_sh, kv_sh))
             self._prefill = jax.jit(
                 self._prefill_fn, donate_argnums=(1, 2),
-                in_shardings=(p_sh, kv_sh, kv_sh, rep, rep, rep),
+                in_shardings=(p_sh, kv_sh, kv_sh) + (rep,) * 7,
+                out_shardings=(rep, kv_sh, kv_sh))
+            self._prefill_chunked = jax.jit(
+                self._prefill_chunk_fn, donate_argnums=(1, 2),
+                in_shardings=(p_sh, kv_sh, kv_sh) + (rep,) * 8,
                 out_shardings=(rep, kv_sh, kv_sh))
 
     # -- compiled bodies ---------------------------------------------------
     def _attn_proj(self, p, x):
-        """Shared q/k/v projection + head split for both executables."""
+        """Shared q/k/v projection + head split for every executable."""
         c = self.cfg
         q = (x @ p["wq"]).reshape(*x.shape[:-1], c.n_head, c.head_dim)
         k = (x @ p["wk"]).reshape(*x.shape[:-1], c.n_kv_head, c.head_dim)
@@ -186,14 +353,81 @@ class PagedLlamaModel:
                 else params["head"])
         return h @ head.astype(h.dtype)
 
-    def _decode_fn(self, params, kc, vc, tokens, block_tables, positions):
-        """One token for every slot. ``tokens`` (S,) int32 — the last
-        emitted token per slot; ``positions`` (S,) — tokens already
-        resident in the cache for that sequence (the incoming token's
-        K/V are written at exactly this index). Returns greedy next
-        tokens and the updated caches."""
+    def _paged_attend(self, q, kcl, vcl, block_tables, positions):
+        """Single-query attention over the paged cache: (S, H, D) q
+        against the (blocks, block, n_kv, D) layer cache, routed by the
+        block tables and masked to each slot's live length. Dispatches
+        to the paged flash-decode Pallas kernel or the dense-gather
+        reference per ``decode_attention_impl``."""
         c = self.cfg
         S = self.num_slots
+        scale = 1.0 / float(c.head_dim) ** 0.5
+        if self.decode_attention_impl == "flash":
+            from zoo_tpu.ops.pallas.paged_decode import paged_flash_decode
+            if self.mesh is None:
+                return paged_flash_decode(
+                    q, kcl, vcl, block_tables, positions,
+                    scale=scale).reshape(S, c.n_head * c.head_dim)
+            # tp: each device runs the kernel over ITS kv heads' cache
+            # shard and the query heads of those groups — attention is
+            # head-local, so the only post-kernel communication is the
+            # row-parallel wo matmul GSPMD already inserts
+            from jax.sharding import PartitionSpec as P
+
+            from zoo_tpu.parallel.compat import shard_map
+            out = shard_map(
+                lambda q_, k_, v_, bt_, pos_: paged_flash_decode(
+                    q_, k_, v_, bt_, pos_, scale=scale),
+                mesh=self.mesh,
+                in_specs=(P(None, "model", None),
+                          P(None, None, "model", None),
+                          P(None, None, "model", None),
+                          P(None, None), P(None)),
+                out_specs=P(None, "model", None),
+            )(q, kcl, vcl, block_tables, positions)
+            return out.reshape(S, c.n_head * c.head_dim)
+        # dense-gather reference: materialize cache[block_table] and
+        # mask — the PR 7 path, kept as the off-TPU fallback and the
+        # token-identity anchor for the kernel
+        ctx = self.max_blocks_per_seq * self.block_size
+        live = jnp.arange(ctx)[None, :] <= positions[:, None]  # (S, ctx)
+        keys = kcl[block_tables].reshape(S, ctx, c.n_kv_head, c.head_dim)
+        vals = vcl[block_tables].reshape(S, ctx, c.n_kv_head, c.head_dim)
+        return self._masked_gather_attention(q, keys, vals, live)
+
+    def _masked_gather_attention(self, q, keys, vals, live):
+        """The shared dense paged-attention math: ``q`` (R, H, D) rows
+        against cache-gathered ``keys``/``vals`` (R, ctx, n_kv, D)
+        under a (R, ctx) liveness mask — GQA grouped, f32 scores.
+        Rows are decode slots or prefill-chunk positions; both callers
+        must stay numerically identical (chunked prefill is asserted
+        byte-identical to the bucket path)."""
+        c = self.cfg
+        R = q.shape[0]
+        group = c.n_head // c.n_kv_head
+        scale = 1.0 / float(c.head_dim) ** 0.5
+        qg = q.reshape(R, c.n_kv_head, group, c.head_dim)
+        s = jnp.einsum("rkgd,rtkd->rkgt", qg, keys).astype(
+            jnp.float32) * scale
+        s = jnp.where(live[:, None, None, :], s,
+                      jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(s, axis=-1).astype(vals.dtype)
+        return jnp.einsum("rkgt,rtkd->rkgd", probs, vals).reshape(
+            R, c.n_head * c.head_dim)
+
+    def _decode_fn(self, params, kc, vc, prev_tokens, host_tokens,
+                   use_host, block_tables, positions,
+                   temps, topks, topps, seeds):
+        """One token for every slot. The incoming token per slot is
+        either ``host_tokens`` (freshly admitted stream: the prefill's
+        first token) or ``prev_tokens`` — the PREVIOUS tick's on-device
+        output, so back-to-back ticks chain without a host round trip.
+        ``positions`` (S,) is the cache index the incoming token's K/V
+        are written at. Returns the SAMPLED next tokens (device) and
+        the updated caches."""
+        c = self.cfg
+        S = self.num_slots
+        tokens = jnp.where(use_host, host_tokens, prev_tokens)
         h = jnp.take(params["embed"], tokens, axis=0)        # (S, hidden)
         cos = jnp.take(self._cos, positions, axis=0)          # (S, D/2)
         sin = jnp.take(self._sin, positions, axis=0)
@@ -201,11 +435,6 @@ class PagedLlamaModel:
             block_tables, (positions // self.block_size)[:, None],
             axis=1)[:, 0]                                     # (S,)
         off = positions % self.block_size
-        scale = 1.0 / float(c.head_dim) ** 0.5
-        group = c.n_head // c.n_kv_head
-        ctx = self.max_blocks_per_seq * self.block_size
-        t_idx = jnp.arange(ctx)[None, :]                      # (1, ctx)
-        live = t_idx <= positions[:, None]                    # (S, ctx)
 
         def layer(h, xs):
             p, kcl, vcl = xs
@@ -215,31 +444,24 @@ class PagedLlamaModel:
             q = _rope_rows(q, cos, sin)
             k = _rope_rows(k, cos, sin)
             # write this token's k/v through the block table, THEN
-            # gather — the token attends to itself like any other
+            # attend — the token attends to itself like any other
             kcl = kcl.at[blk, off].set(k)
             vcl = vcl.at[blk, off].set(v)
-            keys = kcl[block_tables].reshape(
-                S, ctx, c.n_kv_head, c.head_dim)
-            vals = vcl[block_tables].reshape(
-                S, ctx, c.n_kv_head, c.head_dim)
-            qg = q.reshape(S, c.n_kv_head, group, c.head_dim)
-            s = jnp.einsum("skgd,stkd->skgt", qg, keys).astype(
-                jnp.float32) * scale
-            s = jnp.where(live[:, None, None, :], s,
-                          jnp.finfo(jnp.float32).min)
-            probs = jax.nn.softmax(s, axis=-1).astype(vals.dtype)
-            o = jnp.einsum("skgt,stkd->skgd", probs, vals).reshape(
-                S, c.n_head * c.head_dim)
+            o = self._paged_attend(q, kcl, vcl, block_tables, positions)
             h = h + o @ p["wo"]
             return self._mlp(p, h), (kcl, vcl)
 
         h, (kc, vc) = jax.lax.scan(layer, h, (params["blocks"], kc, vc))
         logits = self._lm_head(params, h)                     # (S, vocab)
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32), kc, vc
+        # the token being drawn sits at sequence index position+1
+        nxt = _sample_tokens(logits, temps, topks, topps, seeds,
+                             positions + 1)
+        return nxt, kc, vc
 
-    def _prefill_fn(self, params, kc, vc, ids, length, block_table):
+    def _prefill_fn(self, params, kc, vc, ids, length, block_table,
+                    temp, topk, topp, seed):
         """Causal forward over one padded prompt (1, L_bucket): scatter
-        the prompt's K/V into the paged cache and return the greedy
+        the prompt's K/V into the paged cache and return the sampled
         first generated token. ``length`` is the true prompt length
         (dynamic); pad positions write to the trash block and are never
         attended by real tokens (they sit in the causal future)."""
@@ -274,14 +496,76 @@ class PagedLlamaModel:
         h, (kc, vc) = jax.lax.scan(layer, h, (params["blocks"], kc, vc))
         logits = self._lm_head(params, h)                  # (1, L, vocab)
         last = jnp.take(logits[0], length - 1, axis=0)     # (vocab,)
-        return jnp.argmax(last).astype(jnp.int32), kc, vc
+        # first generated token = sequence index ``length``
+        tok = _sample_row(last, temp, topk, topp, seed, length)
+        return tok, kc, vc
+
+    def _prefill_chunk_fn(self, params, kc, vc, ids, start, length,
+                          block_table, temp, topk, topp, seed):
+        """One fixed-size CHUNK of a prompt: write the chunk's K/V
+        through the block table at positions ``start..start+C-1`` and
+        attend each chunk token causally over everything already
+        resident (earlier chunks included) — the same math as the
+        bucket prefill, just fed through the cache in N-token slices.
+        Returns the sampled first generated token, meaningful only on
+        the chunk that contains the prompt's last real token (earlier
+        chunks sample from a mid-prompt row the engine discards)."""
+        c = self.cfg
+        C = ids.shape[1]
+        pos = start + jnp.arange(C)                       # (C,)
+        real = pos < length
+        cos = jnp.take(self._cos, pos, axis=0)            # (C, D/2)
+        sin = jnp.take(self._sin, pos, axis=0)
+        blk = jnp.where(real, block_table[pos // self.block_size], 0)
+        off = pos % self.block_size
+        ctx = self.max_blocks_per_seq * self.block_size
+        # causal over the CACHE index space: chunk row i attends every
+        # resident position <= start+i (all of which are real writes —
+        # earlier chunks plus this chunk's own prefix)
+        live = jnp.arange(ctx)[None, :] <= pos[:, None]   # (C, ctx)
+
+        def layer(h, xs):
+            p, kcl, vcl = xs
+            x = _rms_norm(h, p["attn_norm"], c.rms_eps)
+            q, k, v = self._attn_proj(p, x)               # (1, C, H, D)
+            q = _rope_rows(q[0], cos, sin)[None]
+            k = _rope_rows(k[0], cos, sin)[None]
+            kcl = kcl.at[blk, off].set(k[0])
+            vcl = vcl.at[blk, off].set(v[0])
+            # one table serves every chunk row: broadcast the gathered
+            # cache over rows and reuse the one shared attention body
+            kv_shape = (C, ctx, c.n_kv_head, c.head_dim)
+            keys = jnp.broadcast_to(
+                kcl[block_table].reshape(kv_shape[1:])[None], kv_shape)
+            vals = jnp.broadcast_to(
+                vcl[block_table].reshape(kv_shape[1:])[None], kv_shape)
+            a = self._masked_gather_attention(q[0], keys, vals,
+                                              live)[None]
+            h = h + a @ p["wo"]
+            return self._mlp(p, h), (kcl, vcl)
+
+        h = jnp.take(params["embed"], ids.astype(jnp.int32), axis=0)
+        h, (kc, vc) = jax.lax.scan(layer, h, (params["blocks"], kc, vc))
+        logits = self._lm_head(params, h)                 # (1, C, vocab)
+        last = jnp.take(logits[0],
+                        jnp.clip(length - 1 - start, 0, C - 1), axis=0)
+        tok = _sample_row(last, temp, topk, topp, seed, length)
+        return tok, kc, vc
 
     # -- host-facing API (what the engine calls) ---------------------------
-    def prefill(self, prompt: np.ndarray,
-                block_table_row: np.ndarray) -> int:
+    @staticmethod
+    def _sampling_tuple(sampling) -> Tuple[float, int, float, int]:
+        if sampling is None:
+            return GREEDY
+        t, k, p, s = sampling
+        return float(t), int(k), float(p), int(s) & 0xFFFFFFFF
+
+    def prefill(self, prompt: np.ndarray, block_table_row: np.ndarray,
+                sampling=None) -> int:
         """Run one prompt through its bucket executable; the prompt's
         K/V land in the blocks listed in ``block_table_row``. Returns
-        the first generated token."""
+        the first generated token (sampled per ``sampling`` =
+        ``(temperature, top_k, top_p, seed)``; None = greedy)."""
         n = int(prompt.shape[0])
         bucket = _pick_bucket(self.prefill_buckets, n)
         if bucket is None:
@@ -293,42 +577,118 @@ class PagedLlamaModel:
         bt = np.asarray(block_table_row, np.int32)
         if bt.shape != (self.max_blocks_per_seq,):
             raise ValueError("block_table_row has the wrong width")
+        t, k, p, s = self._sampling_tuple(sampling)
         with self._lock:
             tok, self._kc, self._vc = self._prefill(
                 self.params, self._kc, self._vc, jnp.asarray(ids),
-                jnp.int32(n), jnp.asarray(bt))
-            return int(tok)
+                jnp.int32(n), jnp.asarray(bt), jnp.float32(t),
+                jnp.int32(k), jnp.float32(p), jnp.uint32(s))
+            out = int(tok)
+        _host_transfer.labels(kind="prefill").inc(4)
+        return out
 
-    def decode(self, tokens: np.ndarray, block_tables: np.ndarray,
-               positions: np.ndarray) -> np.ndarray:
-        """One continuous-batching iteration over every slot (the ONE
-        fixed-shape call). All three operands are (S,...)-shaped
-        regardless of how many slots are live."""
+    def prefill_chunk(self, chunk: np.ndarray, start: int,
+                      total_len: int, block_table_row: np.ndarray,
+                      sampling=None) -> int:
+        """Feed ONE fixed-size chunk of a prompt (`start` = offset of
+        ``chunk[0]`` in the sequence). Every chunk call runs the same
+        single executable regardless of prompt length. Returns the
+        sampled first generated token — meaningful only when this
+        chunk contains the prompt's last real token."""
+        if not self.prefill_chunk_size:
+            raise RuntimeError("prefill_chunk called with chunking off "
+                               "(prefill_chunk=0)")
+        C = self.prefill_chunk_size
+        n = int(chunk.shape[0])
+        if n < 1 or n > C:
+            raise ValueError(f"chunk of {n} tokens (chunk size {C})")
+        ids = np.zeros((1, C), np.int32)
+        ids[0, :n] = chunk
+        bt = np.asarray(block_table_row, np.int32)
+        if bt.shape != (self.max_blocks_per_seq,):
+            raise ValueError("block_table_row has the wrong width")
+        t, k, p, s = self._sampling_tuple(sampling)
         with self._lock:
+            tok, self._kc, self._vc = self._prefill_chunked(
+                self.params, self._kc, self._vc, jnp.asarray(ids),
+                jnp.int32(start), jnp.int32(total_len), jnp.asarray(bt),
+                jnp.float32(t), jnp.int32(k), jnp.float32(p),
+                jnp.uint32(s))
+            out = int(tok)
+        _host_transfer.labels(kind="prefill").inc(4)
+        return out
+
+    def decode_step(self, prev_batch, host_tokens: np.ndarray,
+                    use_host: np.ndarray, block_tables: np.ndarray,
+                    positions: np.ndarray, sampling_lanes):
+        """Dispatch ONE continuous-batching iteration WITHOUT a host
+        sync: returns the on-device (S,) token batch, which the next
+        tick accepts back as ``prev_batch`` (slots whose ``use_host``
+        lane is set take ``host_tokens`` instead — fresh admissions).
+        ``sampling_lanes`` = (temps, topks, topps, seeds) arrays, one
+        lane per slot. The donated-cache chain sequences back-to-back
+        dispatches on the device stream; only :meth:`read_tokens`
+        blocks."""
+        temps, topks, topps, seeds = sampling_lanes
+        with self._lock:
+            if prev_batch is None:
+                prev_batch = self._zero_tokens
             out, self._kc, self._vc = self._decode(
                 self.params, self._kc, self._vc,
-                jnp.asarray(tokens, jnp.int32),
+                jnp.asarray(prev_batch, jnp.int32),
+                jnp.asarray(host_tokens, jnp.int32),
+                jnp.asarray(use_host, bool),
                 jnp.asarray(block_tables, jnp.int32),
-                jnp.asarray(positions, jnp.int32))
-            return np.asarray(out)
+                jnp.asarray(positions, jnp.int32),
+                jnp.asarray(temps, jnp.float32),
+                jnp.asarray(topks, jnp.int32),
+                jnp.asarray(topps, jnp.float32),
+                jnp.asarray(seeds, jnp.uint32))
+            return out
+
+    def read_tokens(self, batch) -> np.ndarray:
+        """Block until a dispatched tick's token batch is on the host.
+        This is the ONLY device->host transfer of the decode hot path:
+        slots x 1 int32 ids (the logits never leave the device)."""
+        arr = np.asarray(batch)
+        _host_transfer.labels(kind="tokens").inc(int(arr.nbytes))
+        return arr
+
+    def decode(self, tokens: np.ndarray, block_tables: np.ndarray,
+               positions: np.ndarray, sampling_lanes=None) -> np.ndarray:
+        """Synchronous decode tick (the pre-overlap contract, kept for
+        the request-level baseline and white-box tests): every slot's
+        incoming token comes from the host, the sampled batch is read
+        straight back."""
+        S = self.num_slots
+        if sampling_lanes is None:
+            sampling_lanes = (np.zeros(S, np.float32),
+                              np.zeros(S, np.int32),
+                              np.ones(S, np.float32),
+                              np.zeros(S, np.uint32))
+        batch = self.decode_step(None, tokens, np.ones(S, bool),
+                                 block_tables, positions, sampling_lanes)
+        return self.read_tokens(batch)
 
     def compile_counts(self) -> dict:
         """Executable counts per compiled function — the no-recompile
         guarantee is asserted against these (decode must stay at 1
-        after warmup; prefill at <= len(buckets))."""
+        after warmup; prefill at <= len(buckets); the chunked prefill
+        at <= 1)."""
         def size(fn):
             try:
                 return int(fn._cache_size())
             except Exception:  # noqa: BLE001 — private API moved
                 return -1
         return {"decode": size(self._decode),
-                "prefill": size(self._prefill)}
+                "prefill": size(self._prefill),
+                "prefill_chunk": size(self._prefill_chunked)}
 
 
 def _rope_rows(x: jnp.ndarray, cos: jnp.ndarray,
                sin: jnp.ndarray) -> jnp.ndarray:
     """Rotate (S, H, D) by per-ROW angles (S, D/2) — the decode-step
-    variant of :func:`apply_rope`, where every slot sits at its own
+    variant of :func:`apply_rope`, where every row sits at its own
     position instead of sharing a 0..T ramp."""
     d2 = x.shape[-1] // 2
     x1, x2 = x[..., :d2], x[..., d2:]

@@ -10,7 +10,8 @@ is the llm half of that resolution:
   across the group and the HA client's mid-stream failover-with-resume
   seamless.
 * ``llama:tiny:seed=3,slots=4,block=8,blocks=64,buckets=16/64`` —
-  key=value overrides after the preset.
+  key=value overrides after the preset (also ``chunk=N`` for chunked
+  prefill and ``overlap=0/1`` for the async tick pipeline).
 * ``llama:vocab=256,hidden=64,n_block=2,n_head=4,n_kv_head=2,``
   ``intermediate=128`` — explicit architecture, no preset.
 
@@ -30,7 +31,8 @@ _ARCH_KEYS = ("vocab", "hidden", "n_block", "n_head", "n_kv_head",
               "intermediate")
 _ENGINE_KEYS = {"slots": "num_slots", "block": "block_size",
                 "blocks": "num_blocks", "tables": "max_blocks_per_seq",
-                "seed": "seed", "eos": "eos_id", "tp": "tp"}
+                "seed": "seed", "eos": "eos_id", "tp": "tp",
+                "chunk": "prefill_chunk", "overlap": "overlap"}
 
 
 def is_llm_spec(spec) -> bool:
@@ -96,7 +98,8 @@ def _env_engine_defaults() -> Dict:
              ("ZOO_LLM_MAX_BLOCKS_PER_SEQ", "max_blocks_per_seq"),
              ("ZOO_LLM_SEED", "seed"),
              ("ZOO_LLM_EOS", "eos_id"),
-             ("ZOO_LLM_TP", "tp"))
+             ("ZOO_LLM_TP", "tp"),
+             ("ZOO_LLM_PREFILL_CHUNK", "prefill_chunk"))
     for env, name in pairs:
         v = os.environ.get(env)
         if v:
@@ -121,6 +124,12 @@ def build_llm_engine(spec: str, mode: Optional[str] = None,
     merged.update(eng_kwargs)
     merged.update({k: v for k, v in overrides.items()
                    if k not in ("mode", "max_waiting")})
+    # overlap is an ENGINE knob (the async tick pipeline), not a model
+    # shape: spec `overlap=0/1` < ZOO_LLM_OVERLAP resolution in the
+    # engine itself
+    overlap = merged.pop("overlap", None)
+    if overlap is not None:
+        overlap = bool(int(overlap))
     cfg = LlamaConfig(**cfg_kwargs)
     # tensor-parallel serving: `tp=N` (spec) / ZOO_LLM_TP (env) / a
     # `mesh=` override span ONE model over N local devices instead of
@@ -139,5 +148,6 @@ def build_llm_engine(spec: str, mode: Optional[str] = None,
     model = PagedLlamaModel(cfg, **merged)
     mode = mode or os.environ.get("ZOO_LLM_MODE", "continuous")
     engine = LLMEngine(model, mode=mode,
-                       max_waiting=overrides.get("max_waiting"))
+                       max_waiting=overrides.get("max_waiting"),
+                       overlap=overlap)
     return engine.start() if start else engine

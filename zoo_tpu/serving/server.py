@@ -548,11 +548,19 @@ class ServingServer:
                         "error": "deadline expired before admission"})
                     return
                 from zoo_tpu.serving.llm.engine import AdmissionError
+                # per-stream sampling params ride the wire; a missing
+                # seed derives from the request id server-side, so a
+                # failover resume (same rid, another replica) replays
+                # the same draws (docs/llm_serving.md)
+                sampling = {k: msg[k] for k in
+                            ("temperature", "top_k", "top_p", "seed")
+                            if msg.get(k) is not None}
                 try:
                     h = eng.submit(
                         np.asarray(msg["prompt"]),
                         int(msg.get("max_new_tokens", 16)),
-                        rid=rid, deadline=deadline)
+                        rid=rid, deadline=deadline,
+                        sampling=sampling or None)
                 except AdmissionError as e:
                     _requests.labels(outcome="shed").inc()
                     _shed.labels(reason="queue_full").inc()

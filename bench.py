@@ -927,14 +927,19 @@ def bench_llm_serving(extra, n_requests=24, long_tokens=96,
 
     # achieved HBM GB/s per the decode bytes/token model: every token
     # streams its sequence's live KV (read) + writes one position +
-    # reads the weights once per TICK (amortized over S live slots)
+    # reads the weights once per TICK (amortized over S live slots).
+    # The per-token cache cost comes from the model's OWN byte
+    # accounting (kv_bytes_per_token: K+V rows across layers at the
+    # active cache dtype, plus int8 scale rows), so the same formula
+    # prices every ZOO_LLM_KV_DTYPE.
     from zoo_tpu.models.llm.llama import llama_param_count
     avg_live = 4 + 64 / 2  # prompt + half the generated length
-    kv_bytes_per_tok = (2 * cfg.n_block * cfg.n_kv_head * cfg.head_dim
-                        * 4 * avg_live)          # K+V read, f32
-    kv_write = 2 * cfg.n_block * cfg.n_kv_head * cfg.head_dim * 4
     weight_bytes = llama_param_count(cfg) * 4 / S
-    bytes_per_tok = kv_bytes_per_tok + kv_write + weight_bytes
+
+    def roofline_bytes(m):
+        return m.kv_bytes_per_token * (avg_live + 1) + weight_bytes
+
+    bytes_per_tok = roofline_bytes(model)
     extra["llm_decode_bytes_per_token"] = int(bytes_per_tok)
     extra["llm_decode_hbm_gbs"] = round(
         full_overlap * bytes_per_tok / 1e9, 3)
@@ -1011,6 +1016,97 @@ def bench_llm_serving(extra, n_requests=24, long_tokens=96,
     extra["llm_ttft_mixed_p50_ms_chunked"] = round(p50c, 1)
     extra["llm_ttft_mixed_p99_ms_chunked"] = round(p99c, 1)
     extra["llm_intertoken_p99_ms_chunked"] = round(gap99c, 2)
+
+    # ---- prefix caching: shared-system-prompt workload ----
+    # the "millions of users" fleet shape: every request = one 400-token
+    # shared system prompt + a short novel suffix. cold = the first
+    # arrival on a replica (registers the prefix blocks); cached = the
+    # steady state, where admission binds the cached blocks and prefill
+    # starts at the first uncached token.
+    def shared_prefix(prefix_cache):
+        m = PagedLlamaModel(cfg, seed=0, num_slots=4, block_size=16,
+                            num_blocks=256, max_blocks_per_seq=40,
+                            prefill_buckets=(16, 512),
+                            prefill_chunk=64)
+        eng = LLMEngine(m, prefix_cache=prefix_cache).start()
+        try:
+            sysp = rs.randint(0, cfg.vocab, (400,)).astype(np.int32)
+            # compile the executables off the clock (tiny stream)
+            drain([eng.submit(sysp[:6], 2)], budget=120.0)
+            cold = eng.submit(np.concatenate([sysp, sysp[:1]]), 2)
+            drain([cold], budget=300.0)
+            hs = [eng.submit(np.concatenate(
+                [sysp, rs.randint(0, cfg.vocab, (6,))]), 4)
+                for _ in range(8)]
+            drain(hs, budget=300.0)
+            ttfts = np.asarray([h.ttft() for h in hs]) * 1e3
+            st = eng.stats()
+            assert st["blocks_used"] == 0, st
+            return (cold.ttft() * 1e3,
+                    float(np.percentile(ttfts, 50)), st)
+        finally:
+            eng.stop()
+
+    cold_ms, cached_p50, st_on = shared_prefix(True)
+    extra["llm_prefix_ttft_cold_ms"] = round(cold_ms, 1)
+    extra["llm_prefix_ttft_cached_p50_ms"] = round(cached_p50, 1)
+    hit_rate = st_on["prefix_hit_tokens"] / max(
+        1, st_on["prefix_hit_tokens"] + st_on["prefix_miss_tokens"])
+    extra["llm_prefix_hit_rate"] = round(hit_rate, 3)
+    _, nocache_p50, _ = shared_prefix(False)
+    extra["llm_prefix_ttft_nocache_p50_ms"] = round(nocache_p50, 1)
+    assert hit_rate >= 0.5, (
+        f"shared-prefix hit rate {hit_rate:.2f} — the prefix cache is "
+        "not being shared")
+    assert cached_p50 < cold_ms, (
+        f"cached ttft p50 {cached_p50:.1f}ms not below the cold "
+        f"{cold_ms:.1f}ms — prefill is not skipping the cached prefix")
+
+    # ---- quantized KV cache: bytes/token + achieved GB/s by dtype ----
+    # int8 halves the bf16 cache bytes (modulo the absmax scale rows)
+    # and the roofline GB/s is re-priced per dtype with the same byte
+    # model the f32 row above uses; `auto`'s platform pick is recorded
+    # so a silent fallback is visible in the bench line, not just in a
+    # slow run.
+    from zoo_tpu.serving.llm.model import resolve_kv_dtype
+    extra["llm_kv_dtype_auto_selects"] = resolve_kv_dtype("auto")
+
+    def decode_tps(m, n_new=64, reps=3):
+        best = 0.0
+        for _ in range(reps):   # rep 1 absorbs the compile
+            eng = LLMEngine(m).start()
+            try:
+                t0 = time.perf_counter()
+                hs = [eng.submit(rs.randint(0, cfg.vocab, (4,)), n_new)
+                      for _ in range(m.num_slots)]
+                drain(hs, budget=120.0)
+                best = max(best, sum(len(h.tokens) for h in hs) /
+                           (time.perf_counter() - t0))
+            finally:
+                eng.stop()
+        return best
+
+    extra["llm_kv_bytes_per_token_f32"] = model.kv_bytes_per_token
+    for kv in ("bf16", "int8"):
+        mq = PagedLlamaModel(cfg, seed=0, num_slots=8, block_size=8,
+                             num_blocks=160, max_blocks_per_seq=16,
+                             prefill_buckets=(16,), kv_dtype=kv)
+        extra[f"llm_kv_bytes_per_token_{kv}"] = mq.kv_bytes_per_token
+        tps = decode_tps(mq)
+        extra[f"llm_decode_tok_per_sec_{kv}"] = round(tps, 1)
+        extra[f"llm_decode_hbm_gbs_{kv}"] = round(
+            tps * roofline_bytes(mq) / 1e9, 3)
+        if isinstance(ceiling, (int, float)) and ceiling == ceiling \
+                and ceiling > 0:
+            extra[f"llm_decode_hbm_frac_{kv}"] = round(
+                extra[f"llm_decode_hbm_gbs_{kv}"] / ceiling, 4)
+        assert mq.compile_counts()["decode"] == 1
+    ratio = extra["llm_kv_bytes_per_token_int8"] / \
+        extra["llm_kv_bytes_per_token_bf16"]
+    extra["llm_kv_int8_vs_bf16_bytes"] = round(ratio, 3)
+    assert 0.5 <= ratio < 0.75, (
+        f"int8 cache bytes {ratio:.2f}x bf16 — the ~half-byte "
+        "contract is broken")
 
 
 def bench_serving_ha(extra, n_requests=240, clients=6, feat=16):

@@ -1157,6 +1157,141 @@ def test_ha_client_sampled_generate_failover_resumes_midstream(paged):
         assert eng2.allocator.used_blocks == 0
 
 
+# ------------------- prefix caching + quantized KV cache (real model)
+
+def _engine_tokens(model, prompts, max_new=6, prefix_cache=False,
+                   sampling=None):
+    """Run ``prompts`` sequentially (each waits for the previous, so
+    registration is deterministic) and return their token streams plus
+    the engine stats."""
+    eng = LLMEngine(model, prefix_cache=prefix_cache).start()
+    try:
+        outs = []
+        for i, p in enumerate(prompts):
+            h = eng.submit(np.asarray(p, np.int32), max_new,
+                           rid=f"px-{i}", sampling=sampling)
+            _drain([h], budget=120.0)
+            assert h.outcome == "ok", (h.outcome, h.error)
+            outs.append(list(h.tokens))
+        return outs, eng.stats()
+    finally:
+        eng.stop()
+
+
+def _px_model(kv_dtype="f32", chunk=0, impl="dense"):
+    from zoo_tpu.models.llm.llama import tiny_llama_config
+    from zoo_tpu.serving.llm.model import PagedLlamaModel
+    return PagedLlamaModel(tiny_llama_config(), seed=0, num_slots=2,
+                           block_size=4, num_blocks=48,
+                           max_blocks_per_seq=8, prefill_buckets=(8, 32),
+                           kv_dtype=kv_dtype, prefill_chunk=chunk,
+                           decode_impl=impl)
+
+
+_PX_SHARED = list(range(1, 17))     # 16 tokens = 4 full blocks, aligned
+_PX_PROMPTS = [_PX_SHARED, _PX_SHARED + [99, 98, 97],
+               _PX_SHARED + [50], _PX_SHARED]
+
+
+@pytest.mark.parametrize("chunk", [0, 4])
+def test_prefix_cache_byte_identical_real_model(chunk):
+    """Acceptance: greedy streams byte-identical with prefix caching on
+    vs off — bucketed (chunk=0: novel suffix fed through the ONE chunk
+    executable) AND chunked prefill — with real hits, a real CoW fork
+    on the aligned repeat, and the executable census intact."""
+    m_off = _px_model(chunk=chunk)
+    off, _ = _engine_tokens(m_off, _PX_PROMPTS)
+    m_on = _px_model(chunk=chunk)
+    on, st = _engine_tokens(m_on, _PX_PROMPTS, prefix_cache=True)
+    assert on == off
+    assert st["prefix_hit_tokens"] > 0
+    assert st["blocks_used"] == 0          # zero leaks
+    counts = m_on.compile_counts()
+    assert counts["decode"] == 1
+    assert counts["prefill_chunk"] <= 1    # suffix feed is ONE exec
+    if chunk:
+        assert counts["prefill"] == 0      # bucket path never compiled
+
+
+def test_prefix_cache_sampled_streams_identical_real_model():
+    sampling = dict(temperature=0.8, top_k=12, top_p=0.9, seed=77)
+    off, _ = _engine_tokens(_px_model(), _PX_PROMPTS, sampling=sampling)
+    on, st = _engine_tokens(_px_model(), _PX_PROMPTS, sampling=sampling,
+                            prefix_cache=True)
+    assert on == off and st["prefix_hit_tokens"] > 0
+
+
+def test_int8_cache_flash_dense_token_identity():
+    """Acceptance: with the int8 KV cache, the paged flash kernel
+    (interpreter = the exact kernel TPU compiles) and the dense-gather
+    fallback agree token-for-token — and at test scale the quantized
+    streams match the f32 reference ids outright."""
+    ref, _ = _engine_tokens(_px_model("f32"), _PX_PROMPTS, max_new=8)
+    dense, st = _engine_tokens(_px_model("int8", impl="dense"),
+                               _PX_PROMPTS, max_new=8)
+    flash, _ = _engine_tokens(_px_model("int8", impl="flash"),
+                              _PX_PROMPTS, max_new=8)
+    assert dense == flash                  # the hard contract
+    assert dense == ref                    # tiny-scale quality parity
+    assert st["kv_cache_dtype"] == "int8"
+
+
+def test_int8_cache_with_prefix_cache_and_census():
+    """Both features on at once: byte-identity to int8-without-cache,
+    decode-compiles==1, chunk census unchanged, zero leaked blocks."""
+    off, _ = _engine_tokens(_px_model("int8", chunk=4), _PX_PROMPTS)
+    m = _px_model("int8", chunk=4)
+    on, st = _engine_tokens(m, _PX_PROMPTS, prefix_cache=True)
+    assert on == off
+    counts = m.compile_counts()
+    assert counts["decode"] == 1 and counts["prefill_chunk"] == 1
+    assert st["blocks_used"] == 0
+    assert st["kv_bytes_per_token"] < _px_model("bf16")\
+        .kv_bytes_per_token
+
+
+def test_kv_dtype_resolution_and_bytes_model():
+    """auto records its selection (CPU -> f32, never silent), bad
+    values are loud, and the bytes-per-token model halves bf16 -> int8
+    modulo the scale rows."""
+    from zoo_tpu.serving.llm.model import resolve_kv_dtype
+    assert resolve_kv_dtype("int8") == "int8"
+    assert resolve_kv_dtype("bf16") == "bf16"
+    assert resolve_kv_dtype("auto") in ("int8", "f32")  # TPU vs CPU
+    with pytest.raises(ValueError):
+        resolve_kv_dtype("fp4")
+    f32 = _px_model("f32")
+    bf16 = _px_model("bf16")
+    i8 = _px_model("int8")
+    assert bf16.kv_bytes_per_token * 2 == f32.kv_bytes_per_token
+    # int8 payload is half of bf16; the absmax scale rows ride on top
+    c = f32.cfg
+    scale_bytes = 2 * c.n_block * c.n_kv_head * 4
+    assert i8.kv_bytes_per_token == \
+        bf16.kv_bytes_per_token // 2 + scale_bytes
+    assert i8.kv_cache_dtype_requested == "int8"
+    import jax.numpy as jnp
+    assert i8._kc.dtype == jnp.int8
+    assert bf16._kc.dtype == jnp.bfloat16
+    assert i8._cache["ks"].shape == (c.n_block, i8.num_blocks,
+                                     i8.block_size, c.n_kv_head)
+
+
+def test_spec_parses_kv_and_prefix_cache():
+    from zoo_tpu.serving.llm.spec import build_llm_engine
+    eng = build_llm_engine(
+        "llama:tiny:slots=2,block=4,blocks=16,tables=4,buckets=8,"
+        "kv=int8,prefix_cache=1", start=False)
+    try:
+        assert eng.prefix_cache is True
+        assert eng.allocator.prefix_cache is True
+        assert eng.model.kv_cache_dtype == "int8"
+    finally:
+        eng.stop()
+    with pytest.raises(ValueError):
+        build_llm_engine("llama:tiny:kv=fp4", start=False)
+
+
 # ------------------------------------------------------------ chaos smoke
 
 @pytest.mark.perf
@@ -1175,6 +1310,23 @@ def test_check_llm_decode_script_runs():
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "LLM DECODE OK" in proc.stdout
+
+
+@pytest.mark.perf
+def test_check_prefix_cache_script_runs():
+    """The prefix-cache chaos smoke (scripts/check_prefix_cache.py): a
+    2-replica group with prefix caching on, concurrent streams sharing
+    a 400-token prefix — byte-identical to the no-cache reference
+    across a mid-storm SIGKILL, hit-rate above the floor, zero leaked
+    blocks, and the respawned replica re-warms."""
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join("scripts", "check_prefix_cache.py")],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True, text=True, timeout=420,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PREFIX CACHE OK" in proc.stdout
 
 
 @pytest.mark.chaos

@@ -459,8 +459,14 @@ def test_metrics_end_to_end_serving_fit_checkpoint(orca_ctx, tmp_path):
         num_slots, block_size, num_blocks = 2, 4, 16
         max_blocks_per_seq, max_prompt_len = 4, 12
         max_context, prefill_chunk_size, eos_id = 16, 0, None
+        suffix_chunk_size = 4
+        kv_bytes_per_token = 160          # -> zoo_llm_kv_bytes_per_token
 
         def prefill(self, prompt, row, sampling=None):
+            return 1
+
+        def prefill_chunk(self, chunk, start, total, row,
+                          sampling=None):
             return 1
 
         def decode_step(self, prev, host, use, tables, pos, lanes):
@@ -471,13 +477,19 @@ def test_metrics_end_to_end_serving_fit_checkpoint(orca_ctx, tmp_path):
         def read_tokens(self, batch):
             return np.asarray(batch)
 
-    llm_eng = LLMEngine(_TickModel(), overlap=True).start()
+    # prefix caching ON: the second identical prompt hits the first's
+    # registered blocks, populating zoo_llm_prefix_cache_{hit,miss}_*
+    # and the shared/cached block gauges — all jax-free
+    llm_eng = LLMEngine(_TickModel(), overlap=True,
+                        prefix_cache=True).start()
     try:
-        h = llm_eng.submit([1, 2], 6)
-        deadline = time.monotonic() + 30
-        while not h.done and time.monotonic() < deadline:
-            time.sleep(0.01)
-        assert h.done
+        for rid in ("scrape-a", "scrape-b"):
+            h = llm_eng.submit([1, 2, 3, 4, 5, 6], 6, rid=rid)
+            deadline = time.monotonic() + 30
+            while not h.done and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert h.done
+        assert llm_eng.stats()["prefix_hit_tokens"] > 0
     finally:
         llm_eng.stop()
 
@@ -521,6 +533,13 @@ def test_metrics_end_to_end_serving_fit_checkpoint(orca_ctx, tmp_path):
             'zoo_llm_tick_seconds_bucket{phase="decode"',
             'zoo_llm_tick_seconds_bucket{phase="readback"',
             "zoo_llm_tick_overlap_ratio",
+            # prefix caching + quantized KV (this PR): token hit/miss
+            # counters, the shared-blocks gauge, and the per-token HBM
+            # byte cost under the active cache dtype
+            "zoo_llm_prefix_cache_hit_tokens_total",
+            "zoo_llm_prefix_cache_miss_tokens_total",
+            "zoo_llm_kv_blocks_shared",
+            "zoo_llm_kv_bytes_per_token 160",
             # the GSPMD layer (docs/multichip.md): the fixture's 8-device
             # mesh publishes its axis sizes, and the fit above ran DP
             # over it, so the plan's estimated grad all-reduce bytes

@@ -92,6 +92,49 @@ def test_kernel_under_jit_with_donated_style_caches():
                                np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+def test_kernel_int8_dequant_matches_dense_widen():
+    """The quantized-cache contract: the kernel fed int8 K/V plus
+    per-(block, row, kv-head) absmax scales must equal the dense path's
+    gather-then-widen on the SAME bytes — across splits and the
+    position edges."""
+    from zoo_tpu.util.quantize import absmax_scale, narrow_int8, \
+        widen_int8
+
+    rs = np.random.RandomState(21)
+    S, H, n_kv, D, nb, bs, W = 3, 4, 2, 16, 12, 4, 4
+    q = jnp.asarray(rs.randn(S, H, D).astype(np.float32))
+    kc = rs.randn(nb, bs, n_kv, D).astype(np.float32)
+    vc = rs.randn(nb, bs, n_kv, D).astype(np.float32)
+    ks = np.asarray(absmax_scale(kc, axis=-1))       # (nb, bs, n_kv)
+    vs = np.asarray(absmax_scale(vc, axis=-1))
+    kq = narrow_int8(kc, ks[..., None])
+    vq = narrow_int8(vc, vs[..., None])
+    bt = jnp.asarray(rs.randint(1, nb, (S, W)).astype(np.int32))
+    for splits, positions in ((1, None), (2, [0, 7, 15]),
+                              (4, [3, 8, 12])):
+        pos = jnp.asarray(np.asarray(
+            positions if positions is not None
+            else rs.randint(0, W * bs, (S,)), np.int32))
+        ref = _dense_ref(q, jnp.asarray(widen_int8(kq, ks[..., None])),
+                         jnp.asarray(widen_int8(vq, vs[..., None])),
+                         bt, pos)
+        out = paged_flash_decode(
+            q, jnp.asarray(kq), jnp.asarray(vq), bt, pos,
+            k_scale=jnp.asarray(ks), v_scale=jnp.asarray(vs),
+            num_splits=splits, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5,
+                                   err_msg=f"splits={splits}")
+
+
+def test_kernel_scales_must_travel_together():
+    q, kc, vc, bt, pos = _case()
+    with pytest.raises(ValueError):
+        paged_flash_decode(q, kc, vc, bt, pos,
+                           k_scale=jnp.zeros((12, 4, 2)),
+                           interpret=True)
+
+
 def test_resolve_num_splits_divides_table():
     assert resolve_num_splits(16, 4) == 4
     assert resolve_num_splits(6, 4) == 3    # largest divisor <= 4

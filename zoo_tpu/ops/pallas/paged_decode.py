@@ -52,12 +52,18 @@ from zoo_tpu.ops.pallas import LANES as _LANES
 from zoo_tpu.ops.pallas import resolve_interpret as _resolve_interpret
 
 
-def _kernel(bt_ref, pos_ref, q_ref, k_ref, v_ref,
-            acc_ref, m_ref, l_ref, m_scr, l_scr, a_scr, *,
-            n_kv, block_size, bps, scale):
+def _kernel(bt_ref, pos_ref, q_ref, k_ref, v_ref, *rest,
+            n_kv, block_size, bps, scale, quantized):
     """One (slot, kv-head, split) program; the innermost grid axis walks
     the split's ``bps`` table entries with the online-softmax carry in
-    VMEM scratch."""
+    VMEM scratch. ``quantized`` adds two per-(block, row) scale refs
+    after ``v_ref`` and the int8 K/V stream is widened IN REGISTER —
+    HBM moves half the bytes, the math runs in f32 exactly like the
+    dense fallback's gather-then-widen."""
+    if quantized:
+        ks_ref, vs_ref = rest[0], rest[1]
+        rest = rest[2:]
+    acc_ref, m_ref, l_ref, m_scr, l_scr, a_scr = rest
     sh = pl.program_id(0)
     split = pl.program_id(1)
     j = pl.program_id(2)
@@ -78,6 +84,9 @@ def _kernel(bt_ref, pos_ref, q_ref, k_ref, v_ref,
         q = q_ref[0, 0]                       # (group, D)
         k = k_ref[0, :, 0, :]                 # (block, D)
         v = v_ref[0, :, 0, :]
+        if quantized:
+            k = k.astype(jnp.float32) * ks_ref[0, :, 0][:, None]
+            v = v.astype(jnp.float32) * vs_ref[0, :, 0][:, None]
         s_ = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # (group, block)
@@ -123,6 +132,8 @@ def resolve_num_splits(table_width: int,
 def paged_flash_decode(q: jnp.ndarray, k_cache: jnp.ndarray,
                        v_cache: jnp.ndarray, block_tables: jnp.ndarray,
                        positions: jnp.ndarray, *,
+                       k_scale: Optional[jnp.ndarray] = None,
+                       v_scale: Optional[jnp.ndarray] = None,
                        scale: Optional[float] = None,
                        num_splits: Optional[int] = None,
                        interpret: Optional[bool] = None) -> jnp.ndarray:
@@ -133,9 +144,19 @@ def paged_flash_decode(q: jnp.ndarray, k_cache: jnp.ndarray,
     ``positions``: (S,) int32 — the cache index the slot's incoming
     token was written at (tokens ``0..position`` are attended).
     Returns (S, H, D) in ``q``'s dtype.
+
+    An int8 cache passes ``k_scale``/``v_scale`` — per-(block, row,
+    kv-head) absmax scales, shape (num_blocks, block_size, H_kv) — and
+    each block stream is dequantized in VMEM right after the DMA, so
+    the HBM roofline sees int8 bytes while the softmax math stays f32
+    (a bf16 cache needs no scales; the matmuls widen it natively).
     """
     S, H, D = q.shape
     n_blocks, block_size, n_kv, _ = k_cache.shape
+    quantized = k_scale is not None
+    if quantized and v_scale is None or not quantized \
+            and v_scale is not None:
+        raise ValueError("k_scale and v_scale travel together")
     if H % n_kv:
         raise ValueError(f"q heads ({H}) must be a multiple of kv heads "
                          f"({n_kv})")
@@ -161,23 +182,39 @@ def paged_flash_decode(q: jnp.ndarray, k_cache: jnp.ndarray,
         return jnp.where(live, bt_ref[s, idx], 0)
 
     kernel = functools.partial(
-        _kernel, n_kv=n_kv, block_size=block_size, bps=bps, scale=scale)
+        _kernel, n_kv=n_kv, block_size=block_size, bps=bps, scale=scale,
+        quantized=quantized)
+    in_specs = [
+        pl.BlockSpec((1, 1, group, D),
+                     lambda sh, sp, j, bt_ref, pos_ref:
+                     (sh // n_kv, sh % n_kv, 0, 0)),
+        pl.BlockSpec((1, block_size, 1, D),
+                     lambda sh, sp, j, bt_ref, pos_ref:
+                     (_entry(sh, sp, j, bt_ref, pos_ref), 0,
+                      sh % n_kv, 0)),
+        pl.BlockSpec((1, block_size, 1, D),
+                     lambda sh, sp, j, bt_ref, pos_ref:
+                     (_entry(sh, sp, j, bt_ref, pos_ref), 0,
+                      sh % n_kv, 0)),
+    ]
+    operands = [q4, k_cache, v_cache]
+    if quantized:
+        # the scale rows ride the exact same block-table routing as
+        # their K/V block (dead entries clamp to the trash block too)
+        for s_arr in (k_scale, v_scale):
+            if s_arr.shape != (n_blocks, block_size, n_kv):
+                raise ValueError(
+                    f"scale shape {s_arr.shape} != "
+                    f"{(n_blocks, block_size, n_kv)}")
+            in_specs.append(pl.BlockSpec(
+                (1, block_size, 1),
+                lambda sh, sp, j, bt_ref, pos_ref:
+                (_entry(sh, sp, j, bt_ref, pos_ref), 0, sh % n_kv)))
+            operands.append(s_arr.astype(jnp.float32))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(S * n_kv, splits, bps),
-        in_specs=[
-            pl.BlockSpec((1, 1, group, D),
-                         lambda sh, sp, j, bt_ref, pos_ref:
-                         (sh // n_kv, sh % n_kv, 0, 0)),
-            pl.BlockSpec((1, block_size, 1, D),
-                         lambda sh, sp, j, bt_ref, pos_ref:
-                         (_entry(sh, sp, j, bt_ref, pos_ref), 0,
-                          sh % n_kv, 0)),
-            pl.BlockSpec((1, block_size, 1, D),
-                         lambda sh, sp, j, bt_ref, pos_ref:
-                         (_entry(sh, sp, j, bt_ref, pos_ref), 0,
-                          sh % n_kv, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, 1, group, D),
                          lambda sh, sp, j, bt_ref, pos_ref:
@@ -216,7 +253,7 @@ def paged_flash_decode(q: jnp.ndarray, k_cache: jnp.ndarray,
                                  jnp.float32),
         ],
         interpret=interpret,
-    )(bt, pos, q4, k_cache, v_cache)
+    )(bt, pos, *operands)
 
     # split-KV epilogue: merge the per-split partial softmaxes with the
     # log-sum-exp correction (dead splits carry m=-inf/l=0 and drop out)

@@ -35,6 +35,11 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+# ONE absmax int8 implementation serves the wire and the paged KV
+# cache (zoo_tpu/serving/llm/model.py quantizes cache rows with the
+# same helpers under jnp); re-exported here for existing importers
+from zoo_tpu.util.quantize import absmax_scale, narrow_int8, widen_int8
+
 try:  # optional — never a hard dependency (container may lack it)
     import lz4.frame as _lz4
 except ImportError:  # pragma: no cover - environment-dependent
@@ -43,7 +48,7 @@ except ImportError:  # pragma: no cover - environment-dependent
 __all__ = ["WirePolicy", "encode_array", "decode_payload",
            "supported_codecs", "supported_wire_dtypes",
            "FLAG_NARROWED", "FLAG_COMPRESSED", "FLAG_SHM",
-           "WIRE_DTYPES"]
+           "WIRE_DTYPES", "absmax_scale", "narrow_int8", "widen_int8"]
 
 FLAG_NARROWED = 0x01
 FLAG_COMPRESSED = 0x02
@@ -139,11 +144,9 @@ def encode_array(arr: np.ndarray, policy: Optional[WirePolicy]
         if policy.dtype == "bf16":
             narrowed = np.ascontiguousarray(arr).astype(_bf16())
             wire_descr = b"bfloat16"
-        else:  # int8 with per-array absmax scale
-            absmax = float(np.max(np.abs(arr)))
-            scale = (absmax / 127.0) if absmax > 0 else 1.0
-            narrowed = np.clip(np.rint(arr / scale), -127, 127
-                               ).astype(np.int8)
+        else:  # int8 with per-array absmax scale (shared helpers)
+            scale = float(absmax_scale(arr))
+            narrowed = narrow_int8(arr, scale)
             wire_descr = b"|i1"
         flags |= FLAG_NARROWED
         # reshape(-1).view covers 0-d and extension dtypes alike (a
@@ -217,7 +220,7 @@ def decode_payload(buf, flags: int, dtype: np.dtype, shape,
             out = narrow.astype(np.float32)
         elif wire_descr in ("|i1", "int8"):
             narrow = np.frombuffer(buf, dtype=np.int8)
-            out = narrow.astype(np.float32) * np.float32(scale)
+            out = widen_int8(narrow, np.float32(scale))
         else:
             raise ValueError(f"unknown wire dtype {wire_descr!r}")
         return out.reshape(shape)

@@ -72,7 +72,10 @@ from typing import Deque, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from zoo_tpu.obs.metrics import counter, gauge, histogram
-from zoo_tpu.serving.llm.kv_cache import BlockAllocator
+from zoo_tpu.serving.llm.kv_cache import (
+    BlockAllocator,
+    prefix_block_hashes,
+)
 from zoo_tpu.util.resilience import Deadline, env_int
 
 _tokens = counter(
@@ -115,6 +118,20 @@ _overlap_ratio = gauge(
     "zoo_llm_tick_overlap_ratio",
     "Device-busy time / wall time over the recent decode window (1.0 "
     "= the scheduler never leaves the device idle)")
+# prefix-cache families (docs/llm_serving.md): prompt tokens whose KV
+# was reused from a cached prefix vs computed fresh, and the HBM cost
+# of one cached token under the active cache dtype
+_prefix_hits = counter(
+    "zoo_llm_prefix_cache_hit_tokens_total",
+    "Prompt tokens admitted onto CACHED prefix blocks (prefill skipped "
+    "straight past them)")
+_prefix_misses = counter(
+    "zoo_llm_prefix_cache_miss_tokens_total",
+    "Prompt tokens prefilled fresh while prefix caching was enabled")
+_kv_bytes_per_token = gauge(
+    "zoo_llm_kv_bytes_per_token",
+    "HBM bytes one cached token costs (K+V rows across layers, plus "
+    "int8 scale rows) under the engine model's KV cache dtype")
 
 
 class AdmissionError(RuntimeError):
@@ -206,6 +223,12 @@ class GenHandle:
         #                           are picked youngest-first
         self.effective_prompt: Optional[np.ndarray] = None  # after
         #                           preemption: prompt + generated
+        # prefix-cache state, set at each admission (a resumed stream
+        # re-hashes its GROWN effective prompt and re-matches on
+        # whatever replica admits it; hashed_len is the cache key)
+        self.block_hashes: list = []
+        self.hashed_len = -1
+        self.cache_hit_tokens = 0
 
     @property
     def done(self) -> bool:
@@ -274,7 +297,8 @@ class GenHandle:
 
 class _Slot:
     __slots__ = ("handle", "last_token", "position", "phase",
-                 "prefill_pos", "epoch", "host_token", "use_host")
+                 "prefill_pos", "epoch", "host_token", "use_host",
+                 "pending_copy")
 
     def __init__(self):
         self.handle: Optional[GenHandle] = None
@@ -282,7 +306,11 @@ class _Slot:
         self.position = 0        # cache index the NEXT incoming token
         #                          will be written at
         self.phase = "decode"    # "prefill" while chunks are pending
-        self.prefill_pos = 0     # prompt tokens already fed (chunked)
+        self.prefill_pos = 0     # prompt tokens already fed (starts at
+        #                          the first UNCACHED token on a
+        #                          prefix-cache hit)
+        self.pending_copy = None  # (src, dst) CoW device copy owed
+        #                          before this slot's next prefill write
         self.epoch = 0           # bumped whenever the slot is cleared:
         #                          an in-flight lane snapshot from an
         #                          older epoch is discarded on readback
@@ -305,7 +333,8 @@ class LLMEngine:
 
     def __init__(self, model, mode: str = "continuous",
                  max_waiting: Optional[int] = None,
-                 overlap: Optional[bool] = None):
+                 overlap: Optional[bool] = None,
+                 prefix_cache: Optional[bool] = None):
         if mode not in ("continuous", "oneshot"):
             raise ValueError(f"unknown scheduling mode {mode!r}")
         self.model = model
@@ -316,10 +345,22 @@ class LLMEngine:
         self.overlap = bool(overlap) and mode == "continuous" and \
             hasattr(model, "decode_step") and hasattr(model,
                                                      "read_tokens")
+        if prefix_cache is None:
+            prefix_cache = os.environ.get(
+                "ZOO_LLM_PREFIX_CACHE", "0") in ("1", "true", "on")
+        self.prefix_cache = bool(prefix_cache)
         self.max_waiting = max_waiting if max_waiting is not None else \
             env_int("ZOO_LLM_MAX_WAITING", 256)
         self.allocator = BlockAllocator(model.num_blocks,
-                                        model.block_size)
+                                        model.block_size,
+                                        prefix_cache=self.prefix_cache)
+        # engine-local hit/miss tallies (stats()); the process-global
+        # counters feed /metrics
+        self._hit_tokens = 0
+        self._miss_tokens = 0
+        self._kv_bpt = getattr(model, "kv_bytes_per_token", None)
+        if self._kv_bpt:
+            _kv_bytes_per_token.set(float(self._kv_bpt))
         self._slots = [_Slot() for _ in range(model.num_slots)]
         self._wait: Deque[GenHandle] = collections.deque()
         # ONE reentrant state lock: the scheduler holds it across each
@@ -463,6 +504,12 @@ class LLMEngine:
         with self._lock:
             _occupancy.set(sum(1 for s in self._slots if s.handle))
             _waiting.set(len(self._wait))
+        # republished on every scheduler mutation so the ACTIVELY
+        # serving engine owns the process-global gauge — a second
+        # engine constructed in the same process (bench A/B rigs,
+        # hot-swap pairs) only displaces it until the next tick
+        if self._kv_bpt:
+            _kv_bytes_per_token.set(float(self._kv_bpt))
 
     def _finish_slot(self, slot: _Slot, outcome: str,
                      error: Optional[str] = None):
@@ -525,22 +572,46 @@ class LLMEngine:
                          f"resumed context of {len(prompt)} tokens "
                          "exceeds the whole KV pool")
                 continue
-            if not self.allocator.can_admit(len(prompt)):
+            # prefix cache: hash the prompt's full blocks and probe for
+            # the longest cached run. At least the LAST prompt token is
+            # always recomputed (its forward pass produces the first
+            # generated token), so an aligned full-prompt hit recomputes
+            # one token into a copy-on-write fork of its final block.
+            # Hashes are cached on the handle so a block-gated head
+            # re-attempted every tick doesn't re-hash a long prompt
+            # each pass (the effective prompt only ever changes by
+            # GROWING on a preempt-resume, so length is the identity).
+            hashes = []
+            if self.prefix_cache:
+                if h.block_hashes and h.hashed_len == len(prompt):
+                    hashes = h.block_hashes
+                else:
+                    hashes = prefix_block_hashes(
+                        prompt, self.allocator.block_size)
+                    h.block_hashes = hashes
+                    h.hashed_len = len(prompt)
+            matched = self.allocator.match_prefix(hashes)
+            start = min(matched * self.allocator.block_size,
+                        len(prompt) - 1)
+            if not self.allocator.can_admit(
+                    len(prompt), cached_blocks=matched,
+                    needs_cow=matched * self.allocator.block_size
+                    > start):
                 # KV pressure: requeue at the head and stop admitting
                 # this tick — FIFO order is preserved and the gauge
                 # shows the door is block-gated, not slot-gated
                 with self._lock:
                     self._wait.appendleft(h)
                 break
-            n_blocks = self.allocator.blocks_for_tokens(len(prompt))
-            got = self.allocator.allocate(h.id, n_blocks)
-            if got is None:   # raced another allocator client
-                with self._lock:
+            if not self._bind_blocks(slot, h, prompt, hashes):
+                with self._lock:   # raced another allocator client
                     self._wait.appendleft(h)
                 break
             # the per-sequence sampling state rides the block-table
             # entry: a scheduler that migrates/resumes the sequence
-            # replays the same PRNG draws from (seed, token index)
+            # replays the same PRNG draws from (seed, token index).
+            # Aux is PER-SEQUENCE, never per-block — prefix sharing
+            # must not alias one stream's replay state into another's.
             self.allocator.set_aux(h.id, seed=h.sampling[3],
                                    resumed_at=len(prompt))
             slot.handle = h
@@ -548,19 +619,59 @@ class LLMEngine:
             self._admit_counter += 1
             h.admit_seq = self._admit_counter
             # admission only BINDS the slot and blocks; the device
-            # prefill itself (whole prompt, or chunks across ticks)
-            # runs in _prefill_tick OUTSIDE the engine lock, so
-            # submit() and the readback thread never stall behind a
-            # long prompt
+            # prefill itself (whole prompt, suffix past the cached
+            # prefix, or chunks across ticks) runs in _prefill_tick
+            # OUTSIDE the engine lock, so submit() and the readback
+            # thread never stall behind a long prompt
             slot.phase = "prefill"
-            slot.prefill_pos = 0
+            slot.prefill_pos = h.cache_hit_tokens
             slot.position = 0
         self._publish()
+
+    def _bind_blocks(self, slot: _Slot, h: GenHandle,
+                     prompt: np.ndarray, hashes: list) -> bool:
+        """Bind ``h``'s KV blocks: acquire the longest cached prefix
+        (refcount bumps — a shared block is counted ONCE in the pool),
+        allocate the private remainder, and fork the final matched
+        block when the recompute write would land inside it
+        (copy-on-write; the device copy is owed via
+        ``slot.pending_copy`` and dispatched before the first prefill
+        write). Returns False with everything released on an
+        allocation race."""
+        bs = self.allocator.block_size
+        got = self.allocator.acquire_prefix(h.id, hashes)
+        start = min(len(got) * bs, len(prompt) - 1)
+        need = self.allocator.blocks_for_tokens(len(prompt)) - len(got)
+        if need > 0 and self.allocator.allocate(h.id, need) is None:
+            self.allocator.free(h.id)
+            return False
+        slot.pending_copy = None
+        if len(got) * bs > start:
+            # aligned full-prompt hit: the recomputed last token writes
+            # into the final MATCHED block — fork it first
+            try:
+                slot.pending_copy = self.allocator.make_writable(
+                    h.id, len(got) - 1)
+            except MemoryError:
+                self.allocator.free(h.id)
+                return False
+        h.cache_hit_tokens = start
+        if self.prefix_cache:
+            self._hit_tokens += start
+            self._miss_tokens += len(prompt) - start
+            _prefix_hits.inc(start)
+            _prefix_misses.inc(len(prompt) - start)
+        return True
 
     def _enter_decode(self, slot: _Slot, h: GenHandle, first: int,
                       prompt_len: int):
         """Prompt fully prefilled: push the first generated token and
         arm the slot for the decode chain (first tick host-fed)."""
+        # publish the prompt's full blocks under their content hashes —
+        # every later stream carrying the same prefix binds them
+        # instead of re-prefilling (first writer wins, so a CoW fork
+        # never shadows the shared original)
+        self.allocator.register_blocks(h.id, h.block_hashes)
         slot.phase = "decode"
         slot.position = prompt_len
         slot.last_token = first
@@ -597,16 +708,19 @@ class LLMEngine:
             if start >= n:
                 continue   # fed, result still in flight this tick
             if budget is None:
-                take = n
+                take = n - start   # whole prompt, or the whole novel
+                #                    suffix past a cached prefix
             else:
                 if budget <= 0:
                     break
                 take = min(self._chunk, n - start)
                 budget -= take
             slot.prefill_pos = start + take
+            copy = slot.pending_copy
+            slot.pending_copy = None
             work.append((slot, h, slot.epoch, prompt, start, take, n,
                          self._table_row(self.allocator.blocks_of(
-                             h.id))))
+                             h.id)), copy))
         return work
 
     def _run_prefill(self, work) -> List[tuple]:
@@ -614,16 +728,45 @@ class LLMEngine:
         (submit() and the readback thread keep flowing while a long
         prompt runs). Returns per-item results for _apply_prefill."""
         results = []
-        for slot, h, epoch, prompt, start, take, n, row in work:
+        for slot, h, epoch, prompt, start, take, n, row, copy in work:
             t0 = time.perf_counter()
             try:
+                if copy is not None:
+                    # the copy-on-write device copy owed from
+                    # admission: duplicate the shared block's bytes
+                    # BEFORE this sequence's first write lands in the
+                    # fork. Dispatch order on the one device stream
+                    # also orders it before any later re-use of the
+                    # source block. A model without copy_block cannot
+                    # serve a forked block — fail THIS stream loudly
+                    # (the except below error-finishes it) rather than
+                    # silently decode over a zeroed prefix.
+                    fn = getattr(self.model, "copy_block", None)
+                    if fn is None:
+                        raise RuntimeError(
+                            "prefix-cache CoW fork needs "
+                            "model.copy_block and this model has none")
+                    fn(*copy)
                 if self._chunk:
                     tok = self.model.prefill_chunk(
                         prompt[start:start + take], start, n, row,
                         sampling=h.sampling)
-                else:
+                elif start == 0:
                     tok = self.model.prefill(prompt, row,
                                              sampling=h.sampling)
+                else:
+                    # cache-hit prompt in a bucketed config: feed the
+                    # novel suffix through the ONE chunk executable
+                    # (the bucket executable can only start at 0; the
+                    # chunk path attends over the resident cached
+                    # prefix by construction)
+                    C = int(getattr(self.model, "suffix_chunk_size", 0)
+                            or self.model.block_size)
+                    tok = None
+                    for s0 in range(start, start + take, C):
+                        tok = self.model.prefill_chunk(
+                            prompt[s0:min(s0 + C, n)], s0, n, row,
+                            sampling=h.sampling)
             except Exception as e:  # noqa: BLE001 — a prefill failure
                 # must end THIS stream loudly, not kill the scheduler
                 # thread with every stream hanging
@@ -1047,6 +1190,18 @@ class LLMEngine:
                "prefill_chunk": self._chunk,
                "decode_attention_impl": getattr(
                    self.model, "decode_attention_impl", "host"),
+               # bytes-per-token multipliers (this PR): what the cache
+               # stores tokens as (auto's pick is recorded, never
+               # silent) and how the prefix cache is doing
+               "kv_cache_dtype": getattr(
+                   self.model, "kv_cache_dtype", "f32"),
+               "kv_cache_dtype_requested": getattr(
+                   self.model, "kv_cache_dtype_requested", "f32"),
+               "kv_bytes_per_token": getattr(
+                   self.model, "kv_bytes_per_token", None),
+               "prefix_cache": self.prefix_cache,
+               "prefix_hit_tokens": self._hit_tokens,
+               "prefix_miss_tokens": self._miss_tokens,
                "active": sum(1 for s in self._slots if s.handle),
                "waiting": len(self._wait),
                "decode_steps": self._decode_steps,

@@ -1,4 +1,5 @@
-"""Paged KV-cache block allocator (the PagedAttention memory model).
+"""Paged KV-cache block allocator (the PagedAttention memory model)
+with content-hash prefix sharing and copy-on-write.
 
 Contiguous per-request KV preallocation sizes every sequence at the
 maximum context length, so a 32-slot server at 4k context holds 128k
@@ -17,6 +18,35 @@ fixed-size token blocks handed out from a free list:
   when its prompt's blocks (plus one decode block) are actually
   available, so overload queues at the door instead of OOMing the pool.
 
+**Prefix caching** (``prefix_cache=True`` / ``ZOO_LLM_PREFIX_CACHE``)
+adds block-level sharing on top, so a fleet-wide shared system prompt
+costs its KV blocks ONCE across every stream that carries it:
+
+* every FULL block of a prompt is keyed by a **rolling content hash**
+  of (hash of the prefix so far, the block's token ids) —
+  :func:`prefix_block_hashes` — so a hash hit implies the whole prefix
+  up to and including that block is byte-identical, which (K/V being a
+  pure function of token ids and absolute positions for fixed weights)
+  makes its cached K/V bytes exactly what a fresh prefill would write;
+* blocks carry a **refcount**: admission matches the longest cached
+  prefix and bumps refs (:meth:`acquire_prefix`); ``free`` decrements,
+  and a block reaching refcount 0 with a registered hash parks on a
+  **cached-free LRU** instead of the raw free list — still matchable,
+  reclaimed lazily;
+* **eviction is LRU over refcount==0 blocks only**: ``allocate``
+  refills the free list from the cached-free LRU (deregistering the
+  hash) and NEVER touches a block some live sequence still references;
+* **copy-on-write**: a sequence about to write into a block it shares
+  (the aligned-full-hit recompute, in practice) calls
+  :meth:`make_writable` first — ref>1 forks a private copy (the caller
+  copies the device bytes), ref==1 writes in place.
+
+Partial blocks are never shared (full-block hash granularity), decode
+writes always land past the shared region or in a forked copy, and the
+per-sequence aux dict (sampling seed checkpoint) is keyed by sequence
+id — never by block — so sharing cannot leak one stream's replay state
+into another.
+
 Block 0 is reserved as the trash block: inactive decode slots point
 their table at it, so the fixed-shape decode step always has a legal
 write target and never branches on slot liveness.
@@ -28,8 +58,12 @@ bookkeeping); the device-side arrays it indexes live in
 
 from __future__ import annotations
 
+import hashlib
 import threading
-from typing import Dict, List, Optional
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
 
 from zoo_tpu.obs.metrics import gauge
 
@@ -39,6 +73,40 @@ _blocks_used = gauge(
 _blocks_free = gauge(
     "zoo_llm_kv_blocks_free",
     "KV-cache blocks on the allocator free list")
+_blocks_shared = gauge(
+    "zoo_llm_kv_blocks_shared",
+    "KV-cache blocks referenced by MORE than one live sequence "
+    "(prefix-cache hits sharing a prompt's blocks)")
+_blocks_cached = gauge(
+    "zoo_llm_kv_blocks_cached",
+    "Refcount-0 blocks parked on the prefix-cache LRU (matchable, "
+    "reclaimed lazily)")
+
+
+def prefix_block_hashes(tokens: Sequence[int],
+                        block_size: int) -> List[bytes]:
+    """Rolling content hash per FULL block of ``tokens``: block ``i``'s
+    key digests (key of block ``i-1``, the block's token ids), so equal
+    keys imply the ENTIRE prefix through block ``i`` is identical —
+    the property that makes a hash hit safe to alias. Partial trailing
+    tokens produce no hash (partial blocks are never shared)."""
+    out: List[bytes] = []
+    prev = b"zoo-kv-prefix-v1"
+    n_full = len(tokens) // block_size
+    if not n_full:
+        return out
+    # one C-level tobytes over the whole prompt, one digest update per
+    # block — this runs on the admission hot path
+    raw = np.ascontiguousarray(
+        np.asarray(tokens[:n_full * block_size], dtype="<i4"))
+    stride = block_size * 4
+    buf = raw.tobytes()
+    for i in range(n_full):
+        h = hashlib.blake2b(prev, digest_size=16)
+        h.update(buf[i * stride:(i + 1) * stride])
+        prev = h.digest()
+        out.append(prev)
+    return out
 
 
 class BlockAllocator:
@@ -46,30 +114,47 @@ class BlockAllocator:
 
     ``owners`` maps a sequence id to its ordered block list (the block
     table rows); every mutation republishes the
-    ``zoo_llm_kv_blocks_{used,free}`` gauges so a /metrics scrape sees
-    pool pressure live."""
+    ``zoo_llm_kv_blocks_{used,free,shared,cached}`` gauges so a
+    /metrics scrape sees pool pressure live. ``prefix_cache=True``
+    turns on content-hash block reuse (see module docstring); off, the
+    allocator behaves exactly as before sharing existed (every block
+    private, free returns blocks straight to the free list)."""
 
-    def __init__(self, num_blocks: int, block_size: int):
+    def __init__(self, num_blocks: int, block_size: int,
+                 prefix_cache: bool = False):
         if num_blocks < 2:
             raise ValueError("need >= 2 blocks (block 0 is reserved)")
         if block_size < 1:
             raise ValueError("block_size must be >= 1")
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
+        self.prefix_cache = bool(prefix_cache)
         self._lock = threading.Lock()
         # LIFO free list: a just-freed block is re-handed warm
         self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
         self._owners: Dict[str, List[int]] = {}
+        # sharing state: per-block refcount (absent = 0), hash registry
+        # both ways, and the refcount-0-but-still-cached LRU (oldest
+        # first — eviction pops from the front, a fresh acquire/park
+        # moves to the back)
+        self._ref: Dict[int, int] = {}
+        self._hash_of: Dict[int, bytes] = {}
+        self._by_hash: Dict[bytes, int] = {}
+        self._cached: "OrderedDict[int, None]" = OrderedDict()
         # per-sequence aux state riding the block-table entry (e.g. the
         # sampling PRNG seed): whoever resumes the sequence replays
-        # from exactly what was checkpointed here
+        # from exactly what was checkpointed here. KEYED BY SEQUENCE,
+        # never by block — shared blocks must not share replay state.
         self._aux: Dict[str, Dict] = {}
         self._publish()
 
     # -- accounting --------------------------------------------------------
     def _publish(self):
         _blocks_free.set(len(self._free))
-        _blocks_used.set(self.num_blocks - 1 - len(self._free))
+        _blocks_used.set(self.num_blocks - 1 - len(self._free)
+                         - len(self._cached))
+        _blocks_shared.set(sum(1 for r in self._ref.values() if r > 1))
+        _blocks_cached.set(len(self._cached))
 
     @property
     def free_blocks(self) -> int:
@@ -77,9 +162,22 @@ class BlockAllocator:
             return len(self._free)
 
     @property
-    def used_blocks(self) -> int:
+    def cached_blocks(self) -> int:
         with self._lock:
-            return self.num_blocks - 1 - len(self._free)
+            return len(self._cached)
+
+    @property
+    def used_blocks(self) -> int:
+        """Blocks referenced by at least one live sequence (a shared
+        block counts ONCE — pool pressure is physical blocks)."""
+        with self._lock:
+            return self.num_blocks - 1 - len(self._free) \
+                - len(self._cached)
+
+    @property
+    def shared_blocks(self) -> int:
+        with self._lock:
+            return sum(1 for r in self._ref.values() if r > 1)
 
     def blocks_of(self, seq_id: str) -> List[int]:
         with self._lock:
@@ -93,7 +191,8 @@ class BlockAllocator:
         """Checkpoint per-sequence state alongside the block-table
         entry (the engine stores the sampling PRNG seed here, so a
         preempted/migrated sequence replays identical draws). Cleared
-        with the blocks by :meth:`free`."""
+        with the blocks by :meth:`free`; per-SEQUENCE by construction,
+        so refcounted sharing never aliases it."""
         with self._lock:
             self._aux.setdefault(seq_id, {}).update(aux)
 
@@ -103,41 +202,195 @@ class BlockAllocator:
             return dict(aux) if aux is not None else None
 
     # -- allocation --------------------------------------------------------
-    def can_admit(self, prompt_len: int) -> bool:
-        """Enough free blocks for a prompt PLUS its first decode block
-        (the admission gate: a prompt that prefills but cannot take one
-        decode step would stall a slot while holding its blocks)."""
+    def can_admit(self, prompt_len: int, cached_blocks: int = 0,
+                  needs_cow: bool = False) -> bool:
+        """Enough blocks for a prompt PLUS its first decode block (the
+        admission gate: a prompt that prefills but cannot take one
+        decode step would stall a slot while holding its blocks).
+
+        ``cached_blocks`` is the caller's expected prefix hit (blocks
+        it will acquire instead of allocating); the accounting is
+        CONSERVATIVE — every matched block is assumed to sit on the
+        cached-free LRU (so it is subtracted from the evictable
+        supply, not just from the demand), and ``needs_cow`` budgets
+        one extra block for the copy-on-write fork."""
         need = self.blocks_for_tokens(prompt_len + 1)
+        need -= min(int(cached_blocks), need)
+        if needs_cow:
+            need += 1
         with self._lock:
-            return len(self._free) >= need
+            evictable = max(0, len(self._cached) - int(cached_blocks))
+            return len(self._free) + evictable >= need
+
+    def _evict_one(self):
+        """Under the lock: reclaim the LRU cached-free block onto the
+        raw free list, deregistering its hash. Only ever sees
+        refcount-0 blocks (the LRU holds nothing else)."""
+        blk, _ = self._cached.popitem(last=False)   # LRU end
+        h = self._hash_of.pop(blk, None)
+        if h is not None:
+            self._by_hash.pop(h, None)
+        self._free.append(blk)
+
+    def _take_free(self, n: int) -> Optional[List[int]]:
+        """Under the lock: pop ``n`` blocks, evicting LRU cached-free
+        blocks when the raw free list runs short. Refcounted blocks
+        are NEVER evicted."""
+        while len(self._free) < n and self._cached:
+            self._evict_one()
+        if len(self._free) < n:
+            return None
+        return [self._free.pop() for _ in range(n)]
 
     def allocate(self, seq_id: str, n_blocks: int) -> Optional[List[int]]:
-        """Grow ``seq_id`` by ``n_blocks``; all-or-nothing. Returns the
-        new block ids, or None when the free list cannot cover the ask
-        (caller preempts or queues — never a partial grant)."""
+        """Grow ``seq_id`` by ``n_blocks`` PRIVATE blocks;
+        all-or-nothing. Returns the new block ids, or None when free +
+        evictable-cached cannot cover the ask (caller preempts or
+        queues — never a partial grant)."""
         if n_blocks <= 0:
             raise ValueError("n_blocks must be positive")
         with self._lock:
-            if len(self._free) < n_blocks:
+            got = self._take_free(n_blocks)
+            if got is None:
                 return None
-            got = [self._free.pop() for _ in range(n_blocks)]
+            for b in got:
+                self._ref[b] = 1
             self._owners.setdefault(seq_id, []).extend(got)
             self._publish()
             return got
 
+    # -- prefix cache ------------------------------------------------------
+    def match_prefix(self, hashes: Sequence[bytes]) -> int:
+        """How many LEADING hashes are currently matchable (read-only
+        probe — no refs move). The answer can shrink before
+        :meth:`acquire_prefix` if eviction intervenes; acquire re-walks
+        under the lock, so callers treat this as a hint."""
+        if not self.prefix_cache:
+            return 0
+        with self._lock:
+            n = 0
+            for h in hashes:
+                if h not in self._by_hash:
+                    break
+                n += 1
+            return n
+
+    def acquire_prefix(self, seq_id: str,
+                       hashes: Sequence[bytes]) -> List[int]:
+        """Bind the longest cached prefix to ``seq_id``: walk
+        ``hashes`` in order, stop at the first miss, bump each matched
+        block's refcount (pulling it off the cached-free LRU if it was
+        parked there) and append it to the sequence's block table.
+        Returns the matched block ids (possibly empty)."""
+        if not self.prefix_cache:
+            return []
+        with self._lock:
+            if self._owners.get(seq_id):
+                raise ValueError(
+                    f"acquire_prefix must run before {seq_id!r} owns "
+                    "blocks (the prefix is table rows 0..n)")
+            got: List[int] = []
+            for h in hashes:
+                blk = self._by_hash.get(h)
+                if blk is None:
+                    break
+                self._ref[blk] = self._ref.get(blk, 0) + 1
+                self._cached.pop(blk, None)
+                got.append(blk)
+            if got:
+                self._owners.setdefault(seq_id, []).extend(got)
+                self._publish()
+            return got
+
+    def register_blocks(self, seq_id: str, hashes: Sequence[bytes]):
+        """Publish ``seq_id``'s leading blocks under their content
+        hashes (called once the prompt's K/V writes are dispatched).
+        First writer wins: a hash already registered — including to the
+        block the sequence itself acquired — is skipped, so a CoW fork
+        never shadows the shared original."""
+        if not self.prefix_cache:
+            return
+        with self._lock:
+            blocks = self._owners.get(seq_id, ())
+            for i, h in enumerate(hashes):
+                if i >= len(blocks):
+                    break
+                if h in self._by_hash:
+                    continue
+                blk = blocks[i]
+                if blk in self._hash_of:   # already published (other h)
+                    continue
+                self._hash_of[blk] = h
+                self._by_hash[h] = blk
+
+    def make_writable(self, seq_id: str,
+                      index: int) -> Optional[Tuple[int, int]]:
+        """Copy-on-write gate: the caller is about to write into table
+        row ``index``. A block shared with another sequence (ref > 1)
+        is forked — a fresh private block replaces it in THIS
+        sequence's table, and ``(src, dst)`` is returned so the caller
+        copies the device bytes before writing. A private block
+        (ref == 1) returns None: write in place. Raises MemoryError
+        when the fork cannot be funded (admission budgets for it via
+        ``can_admit(..., needs_cow=True)``, so this is a race, not a
+        plan)."""
+        with self._lock:
+            blocks = self._owners.get(seq_id)
+            if not blocks or index >= len(blocks):
+                raise KeyError(f"{seq_id!r} has no block at row {index}")
+            src = blocks[index]
+            if self._ref.get(src, 1) <= 1:
+                return None
+            got = self._take_free(1)
+            if got is None:
+                raise MemoryError(
+                    "copy-on-write fork needs a free block and the "
+                    "pool is exhausted")
+            dst = got[0]
+            self._ref[dst] = 1
+            self._ref[src] -= 1
+            blocks[index] = dst
+            self._publish()
+            return src, dst
+
     def free(self, seq_id: str) -> int:
-        """Return every block of ``seq_id`` to the free list (stream
-        finished / aborted / deadline-expired / preempted). Idempotent —
-        the abort paths (client gone, handler crashed, scheduler sweep)
-        can race without double-freeing."""
+        """Release every block of ``seq_id`` (stream finished / aborted
+        / deadline-expired / preempted): refcounts drop by one; a block
+        reaching 0 returns to the free list — or parks on the
+        cached-free LRU when its content hash is registered, where it
+        stays matchable until evicted. Idempotent — the abort paths
+        (client gone, handler crashed, scheduler sweep) can race
+        without double-freeing."""
         with self._lock:
             blocks = self._owners.pop(seq_id, None)
             self._aux.pop(seq_id, None)
             if not blocks:
                 return 0
-            self._free.extend(reversed(blocks))
+            for b in reversed(blocks):
+                r = self._ref.get(b, 1) - 1
+                if r > 0:
+                    self._ref[b] = r
+                    continue
+                self._ref.pop(b, None)
+                if b in self._hash_of:
+                    self._cached[b] = None
+                    self._cached.move_to_end(b)   # MRU end
+                else:
+                    self._free.append(b)
             self._publish()
             return len(blocks)
+
+    def drop_cached(self) -> int:
+        """Flush the cached-free LRU back to the raw free list
+        (deregistering every parked hash). Live shared blocks are
+        untouched. Returns the number of blocks reclaimed."""
+        with self._lock:
+            n = len(self._cached)
+            while self._cached:
+                self._evict_one()
+            if n:
+                self._publish()
+            return n
 
     def live_sequences(self) -> int:
         with self._lock:
@@ -145,9 +398,14 @@ class BlockAllocator:
 
     def stats(self) -> Dict[str, int]:
         with self._lock:
-            used = self.num_blocks - 1 - len(self._free)
+            cached = len(self._cached)
+            used = self.num_blocks - 1 - len(self._free) - cached
             return {"num_blocks": self.num_blocks,
                     "block_size": self.block_size,
                     "blocks_used": used,
                     "blocks_free": len(self._free),
+                    "blocks_cached": cached,
+                    "blocks_shared": sum(1 for r in self._ref.values()
+                                         if r > 1),
+                    "prefix_cache": self.prefix_cache,
                     "live_sequences": len(self._owners)}

@@ -88,8 +88,15 @@ from zoo_tpu.models.llm.llama import (
 )
 from zoo_tpu.obs.metrics import counter
 from zoo_tpu.ops.attention import dot_product_attention
+from zoo_tpu.util.quantize import absmax_scale, narrow_int8
 
 DEFAULT_PREFILL_BUCKETS = (32, 128, 512)
+
+# chunk-executable width used to feed the novel SUFFIX of a
+# prefix-cache hit when chunked prefill is off (a cache-hit prompt must
+# start prefill at its first uncached token, and the bucket executable
+# can only start at 0); any fixed width works — it compiles once
+SUFFIX_CHUNK_DEFAULT = 64
 
 # the host-transfer audit: everything the decode hot path moves across
 # the device boundary per tick (tokens out). The acceptance contract —
@@ -121,6 +128,31 @@ def resolve_decode_impl(impl: Optional[str] = "auto") -> str:
         return impl
     from zoo_tpu.ops.pallas import on_tpu
     return "flash" if on_tpu() else "dense"
+
+
+KV_DTYPES = ("f32", "bf16", "int8")
+
+
+def resolve_kv_dtype(dtype: Optional[str] = None) -> str:
+    """Concrete KV-cache storage dtype for this process.
+
+    ``None``/empty reads ``ZOO_LLM_KV_DTYPE`` (default ``f32``, the
+    pre-quantization layout). ``auto`` picks ``int8`` on TPU hardware —
+    decode is HBM-bound there and int8 halves the bytes the roofline
+    charges per token — and ``f32`` off TPU where bandwidth is not the
+    wall and the reference numerics are worth keeping. The selection is
+    recorded (model attr, engine stats, bench line), never silent."""
+    if dtype in (None, ""):
+        dtype = os.environ.get("ZOO_LLM_KV_DTYPE", "") or "f32"
+    dtype = {"fp32": "f32", "float32": "f32",
+             "bfloat16": "bf16"}.get(dtype, dtype)
+    if dtype == "auto":
+        from zoo_tpu.ops.pallas import on_tpu
+        return "int8" if on_tpu() else "f32"
+    if dtype not in KV_DTYPES:
+        raise ValueError(f"unknown KV cache dtype {dtype!r} "
+                         f"({'/'.join(KV_DTYPES)}/auto)")
+    return dtype
 
 
 def _pick_bucket(buckets: Sequence[int], n: int) -> Optional[int]:
@@ -228,6 +260,7 @@ class PagedLlamaModel:
                  prefill_buckets: Sequence[int] = DEFAULT_PREFILL_BUCKETS,
                  prefill_chunk: Optional[int] = None,
                  decode_impl: str = "auto",
+                 kv_dtype: Optional[str] = None,
                  eos_id: Optional[int] = None,
                  mesh=None):
         self.cfg = config
@@ -242,6 +275,14 @@ class PagedLlamaModel:
                                                "0") or 0)
         self.prefill_chunk_size = int(prefill_chunk)
         self.decode_attention_impl = resolve_decode_impl(decode_impl)
+        # KV storage dtype (docs/llm_serving.md): f32 (reference), bf16
+        # (half the bytes), int8 + per-(block,row,kv-head) absmax
+        # scales (half again). Both the requested and resolved values
+        # are recorded so an `auto` pick is visible in stats/bench.
+        self.kv_cache_dtype_requested = kv_dtype if kv_dtype not in (
+            None, "") else (os.environ.get("ZOO_LLM_KV_DTYPE", "")
+                            or "f32")
+        self.kv_cache_dtype = resolve_kv_dtype(kv_dtype)
         self.eos_id = eos_id
         if self.num_slots < 1 or self.num_blocks < 2:
             raise ValueError("need >= 1 slot and >= 2 KV blocks")
@@ -277,8 +318,31 @@ class PagedLlamaModel:
             c.head_dim, self.max_context, c.rope_theta)
         shape = (c.n_block, self.num_blocks, self.block_size,
                  c.n_kv_head, c.head_dim)
-        self._kc = jnp.zeros(shape, jnp.float32)
-        self._vc = jnp.zeros(shape, jnp.float32)
+        cache_np = {"f32": jnp.float32, "bf16": jnp.bfloat16,
+                    "int8": jnp.int8}[self.kv_cache_dtype]
+        self._cache = {"k": jnp.zeros(shape, cache_np),
+                       "v": jnp.zeros(shape, cache_np)}
+        if self.kv_cache_dtype == "int8":
+            # absmax scale per written cache ROW, stored block-indexed
+            # right beside the K/V blocks (the block table routes both)
+            sshape = (c.n_block, self.num_blocks, self.block_size,
+                      c.n_kv_head)
+            self._cache["ks"] = jnp.zeros(sshape, jnp.float32)
+            self._cache["vs"] = jnp.zeros(sshape, jnp.float32)
+        # HBM bytes ONE cached token costs (K+V rows over every layer,
+        # plus the scale rows for int8) — the engine republishes this
+        # as the zoo_llm_kv_bytes_per_token gauge and the bench's byte
+        # model reads it instead of hardcoding f32
+        item = {"f32": 4, "bf16": 2, "int8": 1}[self.kv_cache_dtype]
+        self.kv_bytes_per_token = (
+            2 * c.n_block * c.n_kv_head * c.head_dim * item
+            + (2 * c.n_block * c.n_kv_head * 4
+               if self.kv_cache_dtype == "int8" else 0))
+        # chunk-executable width: the scheduling chunk when chunked
+        # prefill is on, else the fixed suffix-feed width prefix-cache
+        # hits use (compiles at most ONE chunk executable either way)
+        self.suffix_chunk_size = self.prefill_chunk_size or min(
+            SUFFIX_CHUNK_DEFAULT, self.prefill_buckets[-1])
         # one call at a time: prefill/decode donate + replace the cache
         # arrays, so interleaved calls would race the handoff. (The
         # lock covers DISPATCH only — decode_step returns a device
@@ -291,12 +355,15 @@ class PagedLlamaModel:
         # sharding layout and compile a second entry under a mesh)
         self._zero_tokens = jnp.zeros((self.num_slots,), jnp.int32)
         if self.mesh is None:
-            # caches are args 1,2 → donated: XLA aliases them in place
-            self._decode = jax.jit(self._decode_fn, donate_argnums=(1, 2))
+            # the cache pytree is arg 1 → donated: XLA aliases it in
+            # place (K/V blocks and, under int8, their scale rows)
+            self._decode = jax.jit(self._decode_fn, donate_argnums=(1,))
             self._prefill = jax.jit(self._prefill_fn,
-                                    donate_argnums=(1, 2))
+                                    donate_argnums=(1,))
             self._prefill_chunked = jax.jit(self._prefill_chunk_fn,
-                                            donate_argnums=(1, 2))
+                                            donate_argnums=(1,))
+            self._copy = jax.jit(self._copy_block_fn,
+                                 donate_argnums=(0,))
         else:
             from jax.sharding import NamedSharding, PartitionSpec as P
             from zoo_tpu.parallel.mesh import (
@@ -309,27 +376,108 @@ class PagedLlamaModel:
             self.params = place_params(self.params, self.mesh)
             rep = replicated_sharding(self.mesh)
             self._zero_tokens = jax.device_put(self._zero_tokens, rep)
+            # K/V blocks shard on the kv-head axis; int8 scale rows
+            # carry the same head axis and shard with their blocks
+            # (docs/multichip.md: the tp=N layout quantization keeps)
             kv_sh = NamedSharding(
                 self.mesh, P(None, None, None, "model", None))
-            self._kc = jax.device_put(self._kc, kv_sh)
-            self._vc = jax.device_put(self._vc, kv_sh)
+            scale_sh = NamedSharding(
+                self.mesh, P(None, None, None, "model"))
+            cache_sh = {"k": kv_sh, "v": kv_sh}
+            if self.kv_cache_dtype == "int8":
+                cache_sh["ks"] = cache_sh["vs"] = scale_sh
+            self._cache = {name: jax.device_put(arr, cache_sh[name])
+                           for name, arr in self._cache.items()}
             p_sh = shardings_of(self.params, self.mesh)
             # identical donated in/out cache shardings keep the in-place
             # alias on the mesh; token/table/position/sampling operands
             # and the emitted token ids are replicated (the host round
             # trip stays slots x 1)
             self._decode = jax.jit(
-                self._decode_fn, donate_argnums=(1, 2),
-                in_shardings=(p_sh, kv_sh, kv_sh) + (rep,) * 9,
-                out_shardings=(rep, kv_sh, kv_sh))
+                self._decode_fn, donate_argnums=(1,),
+                in_shardings=(p_sh, cache_sh) + (rep,) * 9,
+                out_shardings=(rep, cache_sh))
             self._prefill = jax.jit(
-                self._prefill_fn, donate_argnums=(1, 2),
-                in_shardings=(p_sh, kv_sh, kv_sh) + (rep,) * 7,
-                out_shardings=(rep, kv_sh, kv_sh))
+                self._prefill_fn, donate_argnums=(1,),
+                in_shardings=(p_sh, cache_sh) + (rep,) * 7,
+                out_shardings=(rep, cache_sh))
             self._prefill_chunked = jax.jit(
-                self._prefill_chunk_fn, donate_argnums=(1, 2),
-                in_shardings=(p_sh, kv_sh, kv_sh) + (rep,) * 8,
-                out_shardings=(rep, kv_sh, kv_sh))
+                self._prefill_chunk_fn, donate_argnums=(1,),
+                in_shardings=(p_sh, cache_sh) + (rep,) * 8,
+                out_shardings=(rep, cache_sh))
+            self._copy = jax.jit(
+                self._copy_block_fn, donate_argnums=(0,),
+                in_shardings=(cache_sh, rep, rep),
+                out_shardings=cache_sh)
+
+    # test/debug views of the cache arrays (the canonical home is the
+    # donated ``self._cache`` pytree)
+    @property
+    def _kc(self):
+        return self._cache["k"]
+
+    @property
+    def _vc(self):
+        return self._cache["v"]
+
+    # -- cache quantization helpers (traced inside the executables) --------
+    def _layer_xs(self, params, cache):
+        """The per-layer scan operands: weights + this layer's cache
+        slices (+ scale slices under int8)."""
+        xs = (params["blocks"], cache["k"], cache["v"])
+        if self.kv_cache_dtype == "int8":
+            xs += (cache["ks"], cache["vs"])
+        return xs
+
+    def _unpack_xs(self, xs):
+        """(p, kcl, vcl, ksl, vsl) with None scales off-int8."""
+        if self.kv_cache_dtype == "int8":
+            return xs
+        p, kcl, vcl = xs
+        return p, kcl, vcl, None, None
+
+    def _repack_cache(self, ys):
+        cache = {"k": ys[0], "v": ys[1]}
+        if self.kv_cache_dtype == "int8":
+            cache["ks"], cache["vs"] = ys[2], ys[3]
+        return cache
+
+    def _append_rows(self, cachel, scalel, blk, off, x):
+        """Write f32 K or V rows ``x`` (..., n_kv, D) through the block
+        table at (blk, off), quantizing per the cache dtype: int8 rows
+        store ``clip(rint(x/scale))`` with their own absmax scale (a
+        row is written once and never requantized, so bucketed, chunked
+        and decode-appended writes of the same token are bit-identical
+        cache bytes); bf16 narrows; f32 passes through."""
+        if self.kv_cache_dtype == "int8":
+            s = absmax_scale(x, axis=-1, keepdims=True, xp=jnp)
+            cachel = cachel.at[blk, off].set(
+                narrow_int8(x, s, xp=jnp))
+            scalel = scalel.at[blk, off].set(s[..., 0])
+            return cachel, scalel
+        return cachel.at[blk, off].set(x.astype(cachel.dtype)), scalel
+
+    def _layer_ys(self, kcl, vcl, ksl, vsl):
+        ys = (kcl, vcl)
+        if self.kv_cache_dtype == "int8":
+            ys += (ksl, vsl)
+        return ys
+
+    def _widen_gather(self, cachel, scalel, idx):
+        """Gather cache blocks by table ``idx`` and widen to f32 (int8
+        rows times their scales; bf16/f32 a plain cast) — the dense
+        reference for exactly what the flash kernel does in VMEM."""
+        g = cachel[idx].astype(jnp.float32)
+        if scalel is not None:
+            g = g * scalel[idx][..., None]
+        return g
+
+    def _copy_block_fn(self, cache, src, dst):
+        """Block ``src`` -> ``dst`` across every layer (K, V and scale
+        rows alike): the device half of copy-on-write — the allocator
+        forks the table entry, this moves the bytes."""
+        return {name: arr.at[:, dst].set(arr[:, src])
+                for name, arr in cache.items()}
 
     # -- compiled bodies ---------------------------------------------------
     def _attn_proj(self, p, x):
@@ -353,12 +501,16 @@ class PagedLlamaModel:
                 else params["head"])
         return h @ head.astype(h.dtype)
 
-    def _paged_attend(self, q, kcl, vcl, block_tables, positions):
+    def _paged_attend(self, q, kcl, vcl, ksl, vsl, block_tables,
+                      positions):
         """Single-query attention over the paged cache: (S, H, D) q
         against the (blocks, block, n_kv, D) layer cache, routed by the
         block tables and masked to each slot's live length. Dispatches
         to the paged flash-decode Pallas kernel or the dense-gather
-        reference per ``decode_attention_impl``."""
+        reference per ``decode_attention_impl``; an int8 cache hands
+        the kernel its scale rows (in-register dequant) and the dense
+        path widens the gathered blocks the same way, so token parity
+        between the two stays testable off-TPU."""
         c = self.cfg
         S = self.num_slots
         scale = 1.0 / float(c.head_dim) ** 0.5
@@ -367,32 +519,52 @@ class PagedLlamaModel:
             if self.mesh is None:
                 return paged_flash_decode(
                     q, kcl, vcl, block_tables, positions,
+                    k_scale=ksl, v_scale=vsl,
                     scale=scale).reshape(S, c.n_head * c.head_dim)
             # tp: each device runs the kernel over ITS kv heads' cache
             # shard and the query heads of those groups — attention is
             # head-local, so the only post-kernel communication is the
-            # row-parallel wo matmul GSPMD already inserts
+            # row-parallel wo matmul GSPMD already inserts. Scale rows
+            # shard on the same kv-head axis as their blocks.
             from jax.sharding import PartitionSpec as P
 
             from zoo_tpu.parallel.compat import shard_map
-            out = shard_map(
-                lambda q_, k_, v_, bt_, pos_: paged_flash_decode(
-                    q_, k_, v_, bt_, pos_, scale=scale),
-                mesh=self.mesh,
-                in_specs=(P(None, "model", None),
-                          P(None, None, "model", None),
-                          P(None, None, "model", None),
-                          P(None, None), P(None)),
-                out_specs=P(None, "model", None),
-            )(q, kcl, vcl, block_tables, positions)
+            if ksl is None:
+                out = shard_map(
+                    lambda q_, k_, v_, bt_, pos_: paged_flash_decode(
+                        q_, k_, v_, bt_, pos_, scale=scale),
+                    mesh=self.mesh,
+                    in_specs=(P(None, "model", None),
+                              P(None, None, "model", None),
+                              P(None, None, "model", None),
+                              P(None, None), P(None)),
+                    out_specs=P(None, "model", None),
+                )(q, kcl, vcl, block_tables, positions)
+            else:
+                out = shard_map(
+                    lambda q_, k_, v_, ks_, vs_, bt_, pos_:
+                    paged_flash_decode(
+                        q_, k_, v_, bt_, pos_, k_scale=ks_,
+                        v_scale=vs_, scale=scale),
+                    mesh=self.mesh,
+                    in_specs=(P(None, "model", None),
+                              P(None, None, "model", None),
+                              P(None, None, "model", None),
+                              P(None, None, "model"),
+                              P(None, None, "model"),
+                              P(None, None), P(None)),
+                    out_specs=P(None, "model", None),
+                )(q, kcl, vcl, ksl, vsl, block_tables, positions)
             return out.reshape(S, c.n_head * c.head_dim)
-        # dense-gather reference: materialize cache[block_table] and
-        # mask — the PR 7 path, kept as the off-TPU fallback and the
-        # token-identity anchor for the kernel
+        # dense-gather reference: materialize cache[block_table], widen
+        # and mask — the PR 7 path, kept as the off-TPU fallback and
+        # the token-identity anchor for the kernel
         ctx = self.max_blocks_per_seq * self.block_size
         live = jnp.arange(ctx)[None, :] <= positions[:, None]  # (S, ctx)
-        keys = kcl[block_tables].reshape(S, ctx, c.n_kv_head, c.head_dim)
-        vals = vcl[block_tables].reshape(S, ctx, c.n_kv_head, c.head_dim)
+        keys = self._widen_gather(kcl, ksl, block_tables).reshape(
+            S, ctx, c.n_kv_head, c.head_dim)
+        vals = self._widen_gather(vcl, vsl, block_tables).reshape(
+            S, ctx, c.n_kv_head, c.head_dim)
         return self._masked_gather_attention(q, keys, vals, live)
 
     def _masked_gather_attention(self, q, keys, vals, live):
@@ -415,7 +587,7 @@ class PagedLlamaModel:
         return jnp.einsum("rkgt,rtkd->rkgd", probs, vals).reshape(
             R, c.n_head * c.head_dim)
 
-    def _decode_fn(self, params, kc, vc, prev_tokens, host_tokens,
+    def _decode_fn(self, params, cache, prev_tokens, host_tokens,
                    use_host, block_tables, positions,
                    temps, topks, topps, seeds):
         """One token for every slot. The incoming token per slot is
@@ -424,7 +596,7 @@ class PagedLlamaModel:
         output, so back-to-back ticks chain without a host round trip.
         ``positions`` (S,) is the cache index the incoming token's K/V
         are written at. Returns the SAMPLED next tokens (device) and
-        the updated caches."""
+        the updated cache pytree."""
         c = self.cfg
         S = self.num_slots
         tokens = jnp.where(use_host, host_tokens, prev_tokens)
@@ -437,28 +609,31 @@ class PagedLlamaModel:
         off = positions % self.block_size
 
         def layer(h, xs):
-            p, kcl, vcl = xs
+            p, kcl, vcl, ksl, vsl = self._unpack_xs(xs)
             x = _rms_norm(h, p["attn_norm"], c.rms_eps)
             q, k, v = self._attn_proj(p, x)
             # rope at each slot's own position (per-slot angle rows)
             q = _rope_rows(q, cos, sin)
             k = _rope_rows(k, cos, sin)
-            # write this token's k/v through the block table, THEN
-            # attend — the token attends to itself like any other
-            kcl = kcl.at[blk, off].set(k)
-            vcl = vcl.at[blk, off].set(v)
-            o = self._paged_attend(q, kcl, vcl, block_tables, positions)
+            # write this token's k/v through the block table (narrowed
+            # per the cache dtype), THEN attend — the token attends to
+            # itself like any other
+            kcl, ksl = self._append_rows(kcl, ksl, blk, off, k)
+            vcl, vsl = self._append_rows(vcl, vsl, blk, off, v)
+            o = self._paged_attend(q, kcl, vcl, ksl, vsl,
+                                   block_tables, positions)
             h = h + o @ p["wo"]
-            return self._mlp(p, h), (kcl, vcl)
+            return self._mlp(p, h), self._layer_ys(kcl, vcl, ksl, vsl)
 
-        h, (kc, vc) = jax.lax.scan(layer, h, (params["blocks"], kc, vc))
+        h, ys = jax.lax.scan(layer, h, self._layer_xs(params, cache))
+        cache = self._repack_cache(ys)
         logits = self._lm_head(params, h)                     # (S, vocab)
         # the token being drawn sits at sequence index position+1
         nxt = _sample_tokens(logits, temps, topks, topps, seeds,
                              positions + 1)
-        return nxt, kc, vc
+        return nxt, cache
 
-    def _prefill_fn(self, params, kc, vc, ids, length, block_table,
+    def _prefill_fn(self, params, cache, ids, length, block_table,
                     temp, topk, topp, seed):
         """Causal forward over one padded prompt (1, L_bucket): scatter
         the prompt's K/V into the paged cache and return the sampled
@@ -478,7 +653,7 @@ class PagedLlamaModel:
         impl = resolve_attention_impl("auto", L)
 
         def layer(h, xs):
-            p, kcl, vcl = xs
+            p, kcl, vcl, ksl, vsl = self._unpack_xs(xs)
             x = _rms_norm(h, p["attn_norm"], c.rms_eps)
             q, k, v = self._attn_proj(p, x)                   # (1,L,H,D)
             q = apply_rope(q.transpose(0, 2, 1, 3), cos, sin)
@@ -488,19 +663,22 @@ class PagedLlamaModel:
             a = a.transpose(0, 2, 1, 3).reshape(1, L,
                                                 c.n_head * c.head_dim)
             h = h + a @ p["wo"]
-            kcl = kcl.at[blk, off].set(k.transpose(0, 2, 1, 3)[0])
-            vcl = vcl.at[blk, off].set(v.transpose(0, 2, 1, 3)[0])
-            return self._mlp(p, h), (kcl, vcl)
+            kcl, ksl = self._append_rows(kcl, ksl, blk, off,
+                                         k.transpose(0, 2, 1, 3)[0])
+            vcl, vsl = self._append_rows(vcl, vsl, blk, off,
+                                         v.transpose(0, 2, 1, 3)[0])
+            return self._mlp(p, h), self._layer_ys(kcl, vcl, ksl, vsl)
 
         h = jnp.take(params["embed"], ids.astype(jnp.int32), axis=0)
-        h, (kc, vc) = jax.lax.scan(layer, h, (params["blocks"], kc, vc))
+        h, ys = jax.lax.scan(layer, h, self._layer_xs(params, cache))
+        cache = self._repack_cache(ys)
         logits = self._lm_head(params, h)                  # (1, L, vocab)
         last = jnp.take(logits[0], length - 1, axis=0)     # (vocab,)
         # first generated token = sequence index ``length``
         tok = _sample_row(last, temp, topk, topp, seed, length)
-        return tok, kc, vc
+        return tok, cache
 
-    def _prefill_chunk_fn(self, params, kc, vc, ids, start, length,
+    def _prefill_chunk_fn(self, params, cache, ids, start, length,
                           block_table, temp, topk, topp, seed):
         """One fixed-size CHUNK of a prompt: write the chunk's K/V
         through the block table at positions ``start..start+C-1`` and
@@ -512,45 +690,55 @@ class PagedLlamaModel:
         chunks sample from a mid-prompt row the engine discards)."""
         c = self.cfg
         C = ids.shape[1]
+        ctx = self.max_blocks_per_seq * self.block_size
         pos = start + jnp.arange(C)                       # (C,)
         real = pos < length
+        # pad rows past the pageable context must still take FINITE
+        # rope rows: jnp.take fills out-of-bounds with NaN, and a NaN
+        # K/V written to the trash block poisons every later layer
+        # through 0 * NaN in the masked attention. Real rows always
+        # sit below max_context, so the clamp never moves them.
+        pos = jnp.minimum(pos, ctx - 1)
         cos = jnp.take(self._cos, pos, axis=0)            # (C, D/2)
         sin = jnp.take(self._sin, pos, axis=0)
         blk = jnp.where(real, block_table[pos // self.block_size], 0)
         off = pos % self.block_size
-        ctx = self.max_blocks_per_seq * self.block_size
         # causal over the CACHE index space: chunk row i attends every
         # resident position <= start+i (all of which are real writes —
         # earlier chunks plus this chunk's own prefix)
         live = jnp.arange(ctx)[None, :] <= pos[:, None]   # (C, ctx)
 
         def layer(h, xs):
-            p, kcl, vcl = xs
+            p, kcl, vcl, ksl, vsl = self._unpack_xs(xs)
             x = _rms_norm(h, p["attn_norm"], c.rms_eps)
             q, k, v = self._attn_proj(p, x)               # (1, C, H, D)
             q = _rope_rows(q[0], cos, sin)[None]
             k = _rope_rows(k[0], cos, sin)[None]
-            kcl = kcl.at[blk, off].set(k[0])
-            vcl = vcl.at[blk, off].set(v[0])
+            kcl, ksl = self._append_rows(kcl, ksl, blk, off, k[0])
+            vcl, vsl = self._append_rows(vcl, vsl, blk, off, v[0])
             # one table serves every chunk row: broadcast the gathered
-            # cache over rows and reuse the one shared attention body
+            # (widened) cache over rows and reuse the one shared
+            # attention body
             kv_shape = (C, ctx, c.n_kv_head, c.head_dim)
             keys = jnp.broadcast_to(
-                kcl[block_table].reshape(kv_shape[1:])[None], kv_shape)
+                self._widen_gather(kcl, ksl, block_table).reshape(
+                    kv_shape[1:])[None], kv_shape)
             vals = jnp.broadcast_to(
-                vcl[block_table].reshape(kv_shape[1:])[None], kv_shape)
+                self._widen_gather(vcl, vsl, block_table).reshape(
+                    kv_shape[1:])[None], kv_shape)
             a = self._masked_gather_attention(q[0], keys, vals,
                                               live)[None]
             h = h + a @ p["wo"]
-            return self._mlp(p, h), (kcl, vcl)
+            return self._mlp(p, h), self._layer_ys(kcl, vcl, ksl, vsl)
 
         h = jnp.take(params["embed"], ids.astype(jnp.int32), axis=0)
-        h, (kc, vc) = jax.lax.scan(layer, h, (params["blocks"], kc, vc))
+        h, ys = jax.lax.scan(layer, h, self._layer_xs(params, cache))
+        cache = self._repack_cache(ys)
         logits = self._lm_head(params, h)                 # (1, C, vocab)
         last = jnp.take(logits[0],
                         jnp.clip(length - 1 - start, 0, C - 1), axis=0)
         tok = _sample_row(last, temp, topk, topp, seed, length)
-        return tok, kc, vc
+        return tok, cache
 
     # -- host-facing API (what the engine calls) ---------------------------
     @staticmethod
@@ -579,8 +767,8 @@ class PagedLlamaModel:
             raise ValueError("block_table_row has the wrong width")
         t, k, p, s = self._sampling_tuple(sampling)
         with self._lock:
-            tok, self._kc, self._vc = self._prefill(
-                self.params, self._kc, self._vc, jnp.asarray(ids),
+            tok, self._cache = self._prefill(
+                self.params, self._cache, jnp.asarray(ids),
                 jnp.int32(n), jnp.asarray(bt), jnp.float32(t),
                 jnp.int32(k), jnp.float32(p), jnp.uint32(s))
             out = int(tok)
@@ -592,13 +780,13 @@ class PagedLlamaModel:
                       sampling=None) -> int:
         """Feed ONE fixed-size chunk of a prompt (`start` = offset of
         ``chunk[0]`` in the sequence). Every chunk call runs the same
-        single executable regardless of prompt length. Returns the
-        sampled first generated token — meaningful only when this
-        chunk contains the prompt's last real token."""
-        if not self.prefill_chunk_size:
-            raise RuntimeError("prefill_chunk called with chunking off "
-                               "(prefill_chunk=0)")
-        C = self.prefill_chunk_size
+        single executable regardless of prompt length (width =
+        ``suffix_chunk_size``: the scheduling chunk when chunked
+        prefill is on, the fixed suffix-feed width the prefix cache
+        uses otherwise). Returns the sampled first generated token —
+        meaningful only when this chunk contains the prompt's last
+        real token."""
+        C = self.suffix_chunk_size
         n = int(chunk.shape[0])
         if n < 1 or n > C:
             raise ValueError(f"chunk of {n} tokens (chunk size {C})")
@@ -609,14 +797,23 @@ class PagedLlamaModel:
             raise ValueError("block_table_row has the wrong width")
         t, k, p, s = self._sampling_tuple(sampling)
         with self._lock:
-            tok, self._kc, self._vc = self._prefill_chunked(
-                self.params, self._kc, self._vc, jnp.asarray(ids),
+            tok, self._cache = self._prefill_chunked(
+                self.params, self._cache, jnp.asarray(ids),
                 jnp.int32(start), jnp.int32(total_len), jnp.asarray(bt),
                 jnp.float32(t), jnp.int32(k), jnp.float32(p),
                 jnp.uint32(s))
             out = int(tok)
         _host_transfer.labels(kind="prefill").inc(4)
         return out
+
+    def copy_block(self, src: int, dst: int):
+        """Device half of copy-on-write: duplicate block ``src`` into
+        ``dst`` (K, V and int8 scale rows, every layer) before a
+        sequence writes into its forked copy. One tiny fixed-shape
+        executable, compiled once."""
+        with self._lock:
+            self._cache = self._copy(self._cache, jnp.int32(src),
+                                     jnp.int32(dst))
 
     def decode_step(self, prev_batch, host_tokens: np.ndarray,
                     use_host: np.ndarray, block_tables: np.ndarray,
@@ -633,8 +830,8 @@ class PagedLlamaModel:
         with self._lock:
             if prev_batch is None:
                 prev_batch = self._zero_tokens
-            out, self._kc, self._vc = self._decode(
-                self.params, self._kc, self._vc,
+            out, self._cache = self._decode(
+                self.params, self._cache,
                 jnp.asarray(prev_batch, jnp.int32),
                 jnp.asarray(host_tokens, jnp.int32),
                 jnp.asarray(use_host, bool),
@@ -682,7 +879,8 @@ class PagedLlamaModel:
                 return -1
         return {"decode": size(self._decode),
                 "prefill": size(self._prefill),
-                "prefill_chunk": size(self._prefill_chunked)}
+                "prefill_chunk": size(self._prefill_chunked),
+                "copy_block": size(self._copy)}
 
 
 def _rope_rows(x: jnp.ndarray, cos: jnp.ndarray,

@@ -32,7 +32,10 @@ _ARCH_KEYS = ("vocab", "hidden", "n_block", "n_head", "n_kv_head",
 _ENGINE_KEYS = {"slots": "num_slots", "block": "block_size",
                 "blocks": "num_blocks", "tables": "max_blocks_per_seq",
                 "seed": "seed", "eos": "eos_id", "tp": "tp",
-                "chunk": "prefill_chunk", "overlap": "overlap"}
+                "chunk": "prefill_chunk", "overlap": "overlap",
+                "prefix_cache": "prefix_cache"}
+# string-valued engine/model keys (everything in _ENGINE_KEYS is int)
+_STR_KEYS = {"kv": "kv_dtype"}
 
 
 def is_llm_spec(spec) -> bool:
@@ -80,6 +83,9 @@ def parse_llm_spec(spec: str) -> Tuple[Dict, Dict]:
     for short, name in _ENGINE_KEYS.items():
         if short in kvs:
             eng[name] = int(kvs.pop(short))
+    for short, name in _STR_KEYS.items():
+        if short in kvs:
+            eng[name] = kvs.pop(short)
     if "buckets" in kvs:
         eng["prefill_buckets"] = tuple(
             int(b) for b in kvs.pop("buckets").split("/"))
@@ -124,12 +130,16 @@ def build_llm_engine(spec: str, mode: Optional[str] = None,
     merged.update(eng_kwargs)
     merged.update({k: v for k, v in overrides.items()
                    if k not in ("mode", "max_waiting")})
-    # overlap is an ENGINE knob (the async tick pipeline), not a model
-    # shape: spec `overlap=0/1` < ZOO_LLM_OVERLAP resolution in the
-    # engine itself
+    # overlap and prefix_cache are ENGINE knobs (the async tick
+    # pipeline / content-hash block reuse), not model shapes: spec
+    # `overlap=0/1` / `prefix_cache=0/1` < their ZOO_LLM_* env
+    # resolution in the engine itself
     overlap = merged.pop("overlap", None)
     if overlap is not None:
         overlap = bool(int(overlap))
+    prefix_cache = merged.pop("prefix_cache", None)
+    if prefix_cache is not None:
+        prefix_cache = bool(int(prefix_cache))
     cfg = LlamaConfig(**cfg_kwargs)
     # tensor-parallel serving: `tp=N` (spec) / ZOO_LLM_TP (env) / a
     # `mesh=` override span ONE model over N local devices instead of
@@ -149,5 +159,5 @@ def build_llm_engine(spec: str, mode: Optional[str] = None,
     mode = mode or os.environ.get("ZOO_LLM_MODE", "continuous")
     engine = LLMEngine(model, mode=mode,
                        max_waiting=overrides.get("max_waiting"),
-                       overlap=overlap)
+                       overlap=overlap, prefix_cache=prefix_cache)
     return engine.start() if start else engine

@@ -1,0 +1,53 @@
+"""Absmax int8 narrowing, shared by the wire codec and the KV cache.
+
+One implementation of the scale/narrow/widen triple that PR 6's wire
+codec introduced (f32 -> int8 with a recorded absmax scale, widened on
+the other side) and the int8 paged KV cache now needs on-device: the
+helpers are array-namespace agnostic (``xp=np`` for the host wire path,
+``xp=jnp`` inside a jitted executable), so both call sites share the
+exact rounding/clipping/zero-guard semantics and a parity test on one
+covers the other.
+
+Conventions (identical to the original wire-codec behavior):
+
+* ``scale = absmax / 127`` with an all-zero input mapping to scale 1.0
+  (so the narrow path never divides by zero and a zero array round
+  trips to exactly zero);
+* narrowing is ``clip(rint(x / scale), -127, 127)`` — symmetric, -128
+  never produced;
+* widening is ``q.astype(f32) * scale``.
+
+``axis=None`` gives the wire codec's per-array scale; the KV quantizer
+passes ``axis=-1, keepdims=True`` for a scale per cache row.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["absmax_scale", "narrow_int8", "widen_int8"]
+
+
+def absmax_scale(arr, axis=None, keepdims: bool = False, xp=np):
+    """The int8 quantization scale(s) of ``arr``: ``absmax / 127``
+    along ``axis`` (None = whole array), with exact-zero slices mapped
+    to 1.0. Returns an ``xp`` array (0-d for ``axis=None`` under np —
+    callers wanting a python float wrap it in ``float()``)."""
+    a = xp.asarray(arr)
+    if a.dtype != xp.float32:
+        a = a.astype(xp.float32)
+    absmax = xp.max(xp.abs(a), axis=axis, keepdims=keepdims)
+    return xp.where(absmax > 0, absmax / 127.0,
+                    xp.ones_like(absmax))
+
+
+def narrow_int8(arr, scale, xp=np):
+    """``arr`` (f32) -> int8 under ``scale`` (broadcastable): symmetric
+    round-to-nearest, clipped to [-127, 127]."""
+    a = xp.asarray(arr)
+    return xp.clip(xp.rint(a / scale), -127, 127).astype(xp.int8)
+
+
+def widen_int8(q, scale, xp=np):
+    """Invert :func:`narrow_int8`: int8 payload times its scale, f32."""
+    return xp.asarray(q).astype(xp.float32) * scale

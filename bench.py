@@ -823,7 +823,13 @@ def bench_llm_serving(extra, n_requests=24, long_tokens=96,
 
     ``llm_decode_attention_impl`` records which decode kernel auto
     landed on (paged flash vs dense gather) — a silent fallback shows
-    up in the bench line, not just in a slow run."""
+    up in the bench line, not just in a slow run.
+
+    (4) This PR's amortization rows — speculative decoding A/B on a
+    repetitive-workload mix (``llm_spec_speedup`` asserted > 1.0 with
+    the accept rate recorded, never silently skipped) and the
+    chunk-prefill kernel roofline (``llm_prefill_hbm_gbs`` vs
+    ``cal_hbm_gbs``, landed impl recorded)."""
     import threading
 
     from zoo_tpu.models.llm.llama import LlamaConfig
@@ -1108,6 +1114,115 @@ def bench_llm_serving(extra, n_requests=24, long_tokens=96,
         f"int8 cache bytes {ratio:.2f}x bf16 — the ~half-byte "
         "contract is broken")
 
+    # ---- speculative decoding: spec-on vs spec-off A/B ----
+    # the repetitive-workload mix where prompt-lookup actually hits
+    # (motif-tiled prompts — the code-completion / copy-span shape):
+    # same model, same streams, engine spec_k toggled. The greedy
+    # streams are byte-identical either way (asserted), so the A/B is
+    # purely decode passes vs verify passes. Best-of-3 per side —
+    # tokens/s at this scale is scheduling-noise-bound.
+    def spec_ab():
+        ms = PagedLlamaModel(cfg, seed=0, num_slots=4, block_size=8,
+                             num_blocks=256, max_blocks_per_seq=16,
+                             prefill_buckets=(16, 64), spec_k=4)
+        motifs = [rs.randint(0, cfg.vocab,
+                             (int(rs.randint(4, 9)),))
+                  for _ in range(16)]
+        sprompts = [np.tile(mo, 8)[:60].astype(np.int32)
+                    for mo in motifs]
+
+        def one(spec, tag):
+            eng = LLMEngine(ms, spec_k=spec).start()
+            try:
+                t0 = time.perf_counter()
+                hs = [eng.submit(p, 64, rid=f"spec-{tag}-{i}")
+                      for i, p in enumerate(sprompts)]
+                drain(hs, budget=300.0)
+                wall = time.perf_counter() - t0
+                return (sum(len(h.tokens) for h in hs) / wall,
+                        eng.stats(), [list(h.tokens) for h in hs])
+            finally:
+                eng.stop()
+
+        one(0, "warm0")
+        one(4, "warmk")
+        off = max(one(0, f"off{r}")[0] for r in range(3))
+        on, st, toks_on = 0.0, None, None
+        for r in range(3):
+            t, s, tk = one(4, f"on{r}")
+            if t > on:
+                on, st, toks_on = t, s, tk
+        _, _, toks_off = one(0, "ident")
+        assert toks_on == toks_off, (
+            "speculative streams diverged from plain decode — the "
+            "byte-identity contract is broken")
+        return off, on, st
+
+    off_tps, on_tps, spec_stats = spec_ab()
+    extra["llm_spec_tok_per_sec_off"] = round(off_tps, 1)
+    extra["llm_spec_tok_per_sec_on"] = round(on_tps, 1)
+    extra["llm_spec_speedup"] = round(on_tps / max(off_tps, 1e-9), 3)
+    extra["llm_spec_accept_rate"] = round(
+        spec_stats["spec_accept_rate"], 3)
+    extra["llm_spec_draft_hit_rate"] = round(
+        spec_stats["spec_draft_hit_rate"], 3)
+    extra["llm_spec_k"] = spec_stats["spec_k"]
+    assert spec_stats["compiles"]["verify"] == 1, (
+        f"verify must be ONE executable: {spec_stats['compiles']}")
+    assert spec_stats["blocks_used"] == 0, spec_stats
+    # the acceptance floor: on the repetitive mix the verify pass must
+    # amortize its cost even on CPU (measured 1.6-1.85x; the hardware
+    # target is far higher — decode there is HBM-bound and a verify
+    # pass streams the same bytes as ONE decode tick)
+    assert extra["llm_spec_speedup"] > 1.0, (
+        f"speculative decoding {extra['llm_spec_speedup']}x plain "
+        f"decode (accept rate {extra['llm_spec_accept_rate']}) — the "
+        "verify pass is not amortizing the roofline")
+
+    # ---- paged flash-prefill kernel: chunk-prefill roofline ----
+    # chunked prefill of long prompts through the ONE chunk
+    # executable; bytes/prompt per the same cache byte model the
+    # decode roofline uses — each chunk at start s re-reads the s
+    # resident rows, writes its own C, and streams the weights once —
+    # with the landed impl recorded (flash on TPU, dense-gather
+    # anchor off); a silent fallback shows in the result line.
+    def prefill_roofline(n_prompts=6, plen=448, chunk=64):
+        mp = PagedLlamaModel(cfg, seed=0, num_slots=4, block_size=16,
+                             num_blocks=256, max_blocks_per_seq=40,
+                             prefill_buckets=(16, 512),
+                             prefill_chunk=chunk)
+        eng = LLMEngine(mp).start()
+        try:
+            drain([eng.submit(rs.randint(0, cfg.vocab, (plen,)), 1,
+                              rid="pf-warm")], budget=300.0)
+            t0 = time.perf_counter()
+            hs = [eng.submit(rs.randint(0, cfg.vocab, (plen,)), 1,
+                             rid=f"pf-{i}") for i in range(n_prompts)]
+            drain(hs, budget=300.0)
+            wall = time.perf_counter() - t0
+            assert eng.stats()["compiles"]["prefill_chunk"] == 1
+        finally:
+            eng.stop()
+        n_chunks = -(-plen // chunk)
+        resident = sum(min(plen, (i + 1) * chunk)
+                       for i in range(n_chunks))
+        per_prompt = (mp.kv_bytes_per_token * (resident + plen)
+                      + llama_param_count(cfg) * 4 * n_chunks)
+        return (n_prompts * plen / wall,
+                n_prompts * per_prompt / wall / 1e9,
+                mp.prefill_attention_impl)
+
+    from zoo_tpu.serving.llm.model import resolve_prefill_impl
+    pf_tps, pf_gbs, pf_impl = prefill_roofline()
+    extra["llm_prefill_tok_per_sec"] = round(pf_tps, 1)
+    extra["llm_prefill_hbm_gbs"] = round(pf_gbs, 3)
+    extra["llm_prefill_impl"] = pf_impl
+    assert pf_impl == resolve_prefill_impl("auto"), (
+        "bench model not on the auto-selected prefill kernel")
+    if isinstance(ceiling, (int, float)) and ceiling == ceiling \
+            and ceiling > 0:
+        extra["llm_prefill_hbm_frac"] = round(pf_gbs / ceiling, 4)
+
 
 def bench_serving_ha(extra, n_requests=240, clients=6, feat=16):
     """Serving-HA numbers (docs/serving_ha.md): p50/p99 and
@@ -1305,7 +1420,7 @@ def bench_lifecycle(extra, clients=6, feat=16):
     assert versions.count(versions[0]) == len(versions), versions
 
 
-_BENCH_PR = 10  # bump alongside CHANGES.md when bench semantics move
+_BENCH_PR = 12  # bump alongside CHANGES.md when bench semantics move
 
 
 def _bench_meta():

@@ -461,35 +461,48 @@ def test_metrics_end_to_end_serving_fit_checkpoint(orca_ctx, tmp_path):
         max_context, prefill_chunk_size, eos_id = 16, 0, None
         suffix_chunk_size = 4
         kv_bytes_per_token = 160          # -> zoo_llm_kv_bytes_per_token
+        spec_k = 2                        # -> the verify path + the
+        #                                   zoo_llm_spec_* families
 
         def prefill(self, prompt, row, sampling=None):
-            return 1
+            return (int(prompt[-1]) + 1) % 4
 
         def prefill_chunk(self, chunk, start, total, row,
                           sampling=None):
-            return 1
+            return (int(chunk[-1]) + 1) % 4
 
         def decode_step(self, prev, host, use, tables, pos, lanes):
             import time as _t
             _t.sleep(0.001)
-            return np.where(np.asarray(use), host, 0) + 1
+            return (np.where(np.asarray(use), host,
+                             prev if prev is not None else 0) + 1) % 4
+
+        def verify_step(self, tokens, tables, pos, lanes):
+            import time as _t
+            _t.sleep(0.001)
+            return (np.asarray(tokens) + 1) % 4
 
         def read_tokens(self, batch):
             return np.asarray(batch)
 
-    # prefix caching ON: the second identical prompt hits the first's
-    # registered blocks, populating zoo_llm_prefix_cache_{hit,miss}_*
-    # and the shared/cached block gauges — all jax-free
+    # prefix caching ON + speculative decoding ON: the second identical
+    # prompt hits the first's registered blocks (populating
+    # zoo_llm_prefix_cache_{hit,miss}_* and the shared/cached gauges),
+    # and the cyclic prompt makes the prompt-lookup drafter propose
+    # tokens the (x+1)%4 fake accepts — all jax-free
     llm_eng = LLMEngine(_TickModel(), overlap=True,
                         prefix_cache=True).start()
     try:
         for rid in ("scrape-a", "scrape-b"):
-            h = llm_eng.submit([1, 2, 3, 4, 5, 6], 6, rid=rid)
+            h = llm_eng.submit([1, 2, 3, 1, 2, 3], 6, rid=rid)
             deadline = time.monotonic() + 30
             while not h.done and time.monotonic() < deadline:
                 time.sleep(0.01)
             assert h.done
-        assert llm_eng.stats()["prefix_hit_tokens"] > 0
+        llm_stats = llm_eng.stats()
+        assert llm_stats["prefix_hit_tokens"] > 0
+        assert llm_stats["spec_proposed_tokens"] > 0
+        assert llm_stats["spec_accepted_tokens"] > 0
     finally:
         llm_eng.stop()
 
@@ -540,6 +553,13 @@ def test_metrics_end_to_end_serving_fit_checkpoint(orca_ctx, tmp_path):
             "zoo_llm_prefix_cache_miss_tokens_total",
             "zoo_llm_kv_blocks_shared",
             "zoo_llm_kv_bytes_per_token 160",
+            # speculative decoding (this PR): proposed/accepted draft
+            # tokens, the per-pass accept-length histogram, and the
+            # drafter hit-rate gauge — republished from engine.stats()
+            "zoo_llm_spec_proposed_tokens_total",
+            "zoo_llm_spec_accepted_tokens_total",
+            "zoo_llm_spec_accept_len_bucket",
+            "zoo_llm_spec_draft_hit_rate",
             # the GSPMD layer (docs/multichip.md): the fixture's 8-device
             # mesh publishes its axis sizes, and the fit above ran DP
             # over it, so the plan's estimated grad all-reduce bytes

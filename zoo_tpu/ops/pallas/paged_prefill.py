@@ -1,0 +1,231 @@
+"""Paged flash-prefill: a chunk of queries through a block table.
+
+The PR 10 chunk executable bounded the prefill stall, but its attention
+still ran the dense reference: every chunk call gathers the FULL table
+width of cache (``cache[block_table]`` → a ``(ctx, heads, d)`` copy per
+layer, broadcast over the chunk rows) before the masked softmax reads it
+back — the exact double-billing the paged flash-decode kernel removed
+from the decode path. This kernel is the prefill/verify counterpart:
+
+* **paged** — K/V blocks are streamed IN PLACE through a
+  scalar-prefetched block table (dead entries clamp to the resident
+  trash block 0, so no DMA is wasted on blocks past the live length);
+* **flash** — online-softmax accumulation in VMEM scratch per chunk
+  row, never a ``(ctx,)`` score row in HBM;
+* **chunk-causal** — each query row carries its own cache position and
+  attends every resident column ``<= position``: causal within the
+  chunk AND over everything earlier ticks wrote, because the chunk's
+  own K/V are appended to the cache *before* the kernel runs (same
+  ordering as the dense chunk path);
+* **batched** — the leading axis is sequences: the chunked-prefill
+  executable calls it with one sequence, the speculative-decode VERIFY
+  executable with every slot's ``k + 1`` candidate rows at once; both
+  shapes compile exactly once;
+* **GQA-aware + int8** — the ``n_head / n_kv_head`` query heads of a
+  KV head are batched per block stream, and an int8 cache hands the
+  kernel its per-row absmax scales for in-register dequant after the
+  DMA (HBM moves int8 bytes; the math stays f32, exactly like the
+  dense path's gather-then-widen).
+
+There is no split-KV axis: unlike decode (one query per sequence), a
+chunk exposes ``rows x kv_heads`` programs of parallelism already, and
+prefill is compute-bound — the sequential walk over table entries keeps
+the online-softmax carry in VMEM with zero merge epilogue.
+
+Off-TPU the kernel runs under the Pallas interpreter (exact, slow); the
+CPU suite asserts token identity against the dense-gather reference on
+the same code path TPU hardware compiles.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from zoo_tpu.ops.pallas import LANES as _LANES
+from zoo_tpu.ops.pallas import resolve_interpret as _resolve_interpret
+
+
+def _kernel(bt_ref, pos_sref, q_ref, pos_ref, k_ref, v_ref, *rest,
+            n_kv, block_size, group, width, scale, quantized):
+    """One (sequence*kv-head, table-entry) program; the innermost grid
+    axis walks the table with the online-softmax carry in VMEM scratch.
+    Rows = chunk positions x the kv head's query group."""
+    if quantized:
+        ks_ref, vs_ref = rest[0], rest[1]
+        rest = rest[2:]
+    out_ref, m_scr, l_scr, a_scr = rest
+    j = pl.program_id(1)
+    C = pos_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        a_scr[...] = jnp.zeros_like(a_scr)
+
+    # rows attend columns <= their own position; positions are
+    # nondecreasing per chunk, so a block wholly past the LAST row's
+    # position is dead for every row — skip (the index map already
+    # clamped its DMA to the resident trash block)
+    pos_row = pos_ref[0, :]                                   # (C,)
+    # (C*group, 1) per-row positions: row r covers chunk index r//group
+    prow = jnp.broadcast_to(pos_row[:, None],
+                            (C, group)).reshape(C * group, 1)
+    start = j * block_size
+
+    @pl.when(start <= pos_row[C - 1])
+    def _step():
+        q = q_ref[0, 0].reshape(C * group, q_ref.shape[-1])
+        k = k_ref[0, :, 0, :]                                 # (block, D)
+        v = v_ref[0, :, 0, :]
+        if quantized:
+            k = k.astype(jnp.float32) * ks_ref[0, :, 0][:, None]
+            v = v.astype(jnp.float32) * vs_ref[0, :, 0][:, None]
+        s_ = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (rows, block)
+        col = start + jax.lax.broadcasted_iota(jnp.int32, s_.shape, 1)
+        mask = col <= prow
+        s_ = jnp.where(mask, s_, -jnp.inf)
+        m_prev = m_scr[:, :1]                            # (rows, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s_, axis=-1, keepdims=True))
+        safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(jnp.where(mask, s_ - safe, -jnp.inf))
+        corr = jnp.where(jnp.isfinite(m_prev),
+                         jnp.exp(m_prev - safe), 0.0)
+        l_scr[:, :1] = corr * l_scr[:, :1] + \
+            jnp.sum(p, axis=-1, keepdims=True)
+        a_scr[...] = a_scr[...] * corr + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:, :1] = m_new
+
+    @pl.when(j == width - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        out = a_scr[...] / jnp.where(l == 0.0, 1.0, l)
+        out_ref[0, 0] = out.reshape(out_ref.shape[2:]).astype(
+            out_ref.dtype)
+
+
+def paged_flash_prefill(q: jnp.ndarray, k_cache: jnp.ndarray,
+                        v_cache: jnp.ndarray,
+                        block_tables: jnp.ndarray,
+                        positions: jnp.ndarray, *,
+                        k_scale: Optional[jnp.ndarray] = None,
+                        v_scale: Optional[jnp.ndarray] = None,
+                        scale: Optional[float] = None,
+                        interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Chunk-of-queries paged attention over a resident cache.
+
+    ``q``: (S, C, H, D) — C query rows per sequence (a prefill chunk,
+    or a verify pass's k+1 candidate rows); ``k_cache``/``v_cache``:
+    (num_blocks, block_size, H_kv, D); ``block_tables``: (S, W) int32;
+    ``positions``: (S, C) int32 — the cache index each row's token was
+    written at, NONDECREASING per sequence (row r attends every column
+    ``<= positions[s, r]``, which covers causal-within-chunk plus the
+    resident prefix). Returns (S, C, H, D) in ``q``'s dtype.
+
+    An int8 cache passes ``k_scale``/``v_scale`` (per-(block, row,
+    kv-head) absmax, shape (num_blocks, block_size, H_kv)); each block
+    stream is widened in VMEM right after the DMA."""
+    S, C, H, D = q.shape
+    n_blocks, block_size, n_kv, _ = k_cache.shape
+    quantized = k_scale is not None
+    if quantized and v_scale is None or not quantized \
+            and v_scale is not None:
+        raise ValueError("k_scale and v_scale travel together")
+    if H % n_kv:
+        raise ValueError(f"q heads ({H}) must be a multiple of kv "
+                         f"heads ({n_kv})")
+    if positions.shape != (S, C):
+        raise ValueError(f"positions shape {positions.shape} != "
+                         f"{(S, C)}")
+    group = H // n_kv
+    W = block_tables.shape[1]
+    if scale is None:
+        scale = 1.0 / float(D) ** 0.5
+    interpret = _resolve_interpret(interpret)
+
+    # (S, n_kv, C, group, D): one program streams a kv head's blocks
+    # against its C*group query rows
+    q5 = q.reshape(S, C, n_kv, group, D).transpose(0, 2, 1, 3, 4)
+    bt = block_tables.astype(jnp.int32)
+    pos = positions.astype(jnp.int32)
+
+    def _entry(sk, j, bt_ref, pos_ref):
+        # dead entries (whole block past the last row's position) clamp
+        # to block 0 so the pipeline re-fetches the resident trash
+        # block instead of streaming a block the kernel will skip
+        s = sk // n_kv
+        live = j * block_size <= pos_ref[s, C - 1]
+        return jnp.where(live, bt_ref[s, j], 0)
+
+    kernel = functools.partial(
+        _kernel, n_kv=n_kv, block_size=block_size, group=group,
+        width=W, scale=scale, quantized=quantized)
+    in_specs = [
+        pl.BlockSpec((1, 1, C, group, D),
+                     lambda sk, j, bt_ref, pos_ref:
+                     (sk // n_kv, sk % n_kv, 0, 0, 0)),
+        # the positions again as a VMEM operand: the kernel needs the
+        # (C,) row vector for masking, and SMEM scalar-prefetch reads
+        # are scalar-only
+        pl.BlockSpec((1, C),
+                     lambda sk, j, bt_ref, pos_ref: (sk // n_kv, 0)),
+        pl.BlockSpec((1, block_size, 1, D),
+                     lambda sk, j, bt_ref, pos_ref:
+                     (_entry(sk, j, bt_ref, pos_ref), 0, sk % n_kv, 0)),
+        pl.BlockSpec((1, block_size, 1, D),
+                     lambda sk, j, bt_ref, pos_ref:
+                     (_entry(sk, j, bt_ref, pos_ref), 0, sk % n_kv, 0)),
+    ]
+    operands = [q5, pos, k_cache, v_cache]
+    if quantized:
+        for s_arr in (k_scale, v_scale):
+            if s_arr.shape != (n_blocks, block_size, n_kv):
+                raise ValueError(
+                    f"scale shape {s_arr.shape} != "
+                    f"{(n_blocks, block_size, n_kv)}")
+            in_specs.append(pl.BlockSpec(
+                (1, block_size, 1),
+                lambda sk, j, bt_ref, pos_ref:
+                (_entry(sk, j, bt_ref, pos_ref), 0, sk % n_kv)))
+            operands.append(s_arr.astype(jnp.float32))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S * n_kv, W),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, C, group, D),
+                         lambda sk, j, bt_ref, pos_ref:
+                         (sk // n_kv, sk % n_kv, 0, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((C * group, _LANES), jnp.float32),
+            pltpu.VMEM((C * group, _LANES), jnp.float32),
+            pltpu.VMEM((C * group, D), jnp.float32),
+        ],
+    )
+    # (sequence*kv_head) programs are independent — parallel over
+    # cores; the table walk carries the VMEM softmax state and must
+    # stay sequential
+    params_cls = getattr(pltpu, "CompilerParams", None) or \
+        pltpu.TPUCompilerParams
+    (out,) = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        compiler_params=params_cls(
+            dimension_semantics=("parallel", "arbitrary")),
+        out_shape=[
+            jax.ShapeDtypeStruct((S, n_kv, C, group, D), q.dtype),
+        ],
+        interpret=interpret,
+    )(bt, pos, *operands)
+    return out.transpose(0, 2, 1, 3, 4).reshape(S, C, H, D)

@@ -323,14 +323,20 @@ class HAServingClient:
                  temperature: Optional[float] = None,
                  top_k: Optional[int] = None,
                  top_p: Optional[float] = None,
-                 seed: Optional[int] = None):
+                 seed: Optional[int] = None,
+                 spec_k: Optional[int] = None):
         """Stream one generation over the replica group: yields tokens
         (ints) as frames arrive. ``temperature``/``top_k``/``top_p``/
         ``seed`` select on-device sampling (unset = greedy, or the
         server's ``ZOO_LLM_SAMPLING`` default); the seed defaults to a
         stable hash of the request id on the server, so every attempt
         of this stream — retries, hedges, failover resumes — draws the
-        same tokens on any replica.
+        same tokens on any replica. ``spec_k`` caps the stream's
+        speculative-decoding draft budget on the replica (None = the
+        replica's ``ZOO_LLM_SPEC_K`` deployment default, 0 = no
+        drafting for this stream); speculative or not, the token
+        stream is byte-identical, so failover may freely land a
+        resumed stream on a replica with a different budget.
 
         The PR 5 contracts, applied per stream:
 
@@ -391,7 +397,7 @@ class HAServingClient:
                        "resume_from": received}
                 for key, val in (("temperature", temperature),
                                  ("top_k", top_k), ("top_p", top_p),
-                                 ("seed", seed)):
+                                 ("seed", seed), ("spec_k", spec_k)):
                     if val is not None:
                         msg[key] = val
                 try:

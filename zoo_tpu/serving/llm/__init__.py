@@ -24,6 +24,10 @@ rebuild of that result for the Llama family:
   per-token streaming out of each slot; the tick itself is
   double-buffered against the device (overlap pipeline) and long
   prompts prefill in chunks interleaved with decode.
+* :mod:`speculative` — the model-free n-gram prompt-lookup drafter
+  behind speculative decoding: k drafted tokens scored by ONE
+  multi-token paged verify pass, emitted streams byte-identical to
+  plain decode by construction.
 * :mod:`spec` — ``llama:...`` model specs so a :class:`ReplicaGroup`
   replica (``zoo_tpu.serving.replica``) can mount the engine behind the
   HA layer.
@@ -34,6 +38,8 @@ See docs/llm_serving.md for the architecture and the ZOO_LLM_* knobs.
 from zoo_tpu.serving.llm.engine import GenHandle, LLMEngine
 from zoo_tpu.serving.llm.kv_cache import BlockAllocator
 from zoo_tpu.serving.llm.spec import build_llm_engine, is_llm_spec
+from zoo_tpu.serving.llm.speculative import PromptLookup, propose_tokens
 
 __all__ = ["LLMEngine", "GenHandle", "BlockAllocator",
-           "build_llm_engine", "is_llm_spec"]
+           "build_llm_engine", "is_llm_spec", "PromptLookup",
+           "propose_tokens"]

@@ -37,6 +37,21 @@ This revision rebuilds the tick itself around the device:
   seed is checkpointed in the sequence's block-table entry
   (:meth:`BlockAllocator.set_aux`) so preempt-resume and failover
   replay the same draws.
+* **Speculative decoding** (``ZOO_LLM_SPEC_K`` / model ``spec_k``) —
+  the n-gram prompt-lookup drafter proposes up to k continuation
+  tokens per stream and ONE fixed-shape ``slots x (k+1)`` VERIFY
+  executable scores them all in a single device pass; the engine
+  emits the longest accepted prefix plus the model's own next token.
+  Every emitted token is the canonical per-position sample (same
+  stateless PRNG key plain decode would use), so speculative streams
+  are byte-identical to non-speculative ones — greedy and seeded —
+  and rejection is a pure length reset (rejected rows' cache writes
+  are position-masked garbage the next append overwrites). Draft
+  spans are funded from the free list only (never by preempting
+  another stream); deadlines, preemption, prefix caching, int8 KV,
+  and the overlap pipeline compose unchanged (verify batches are
+  host-fed and gate per seat — the accept length decides the next
+  base position).
 
 PR 5's serving semantics apply per stream: a propagated
 :class:`Deadline` is checked at submission (dead-on-arrival), at
@@ -76,6 +91,7 @@ from zoo_tpu.serving.llm.kv_cache import (
     BlockAllocator,
     prefix_block_hashes,
 )
+from zoo_tpu.serving.llm.speculative import PromptLookup, accept_length
 from zoo_tpu.util.resilience import Deadline, env_int
 
 _tokens = counter(
@@ -132,6 +148,28 @@ _kv_bytes_per_token = gauge(
     "zoo_llm_kv_bytes_per_token",
     "HBM bytes one cached token costs (K+V rows across layers, plus "
     "int8 scale rows) under the engine model's KV cache dtype")
+# speculative-decoding families (docs/llm_serving.md): how many tokens
+# the drafter proposed, how many the verify pass accepted (the
+# amortization the feature exists for), the per-pass accept-length
+# distribution, and how often the drafter had anything to propose
+_spec_proposed = counter(
+    "zoo_llm_spec_proposed_tokens_total",
+    "Draft tokens proposed by the n-gram prompt-lookup drafter and "
+    "scored by a verify pass")
+_spec_accepted = counter(
+    "zoo_llm_spec_accepted_tokens_total",
+    "Draft tokens accepted by the verify pass (each one is a decoded "
+    "token that cost no extra HBM pass)")
+_spec_accept_len = histogram(
+    "zoo_llm_spec_accept_len",
+    "Accepted-prefix length per verify pass with a non-empty draft "
+    "(0 = the first draft token already mismatched)",
+    buckets=(0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0))
+_spec_hit_rate = gauge(
+    "zoo_llm_spec_draft_hit_rate",
+    "Fraction of decode lanes the prompt-lookup drafter produced at "
+    "least one proposal for (cumulative, republished from "
+    "engine.stats())")
 
 
 class AdmissionError(RuntimeError):
@@ -198,11 +236,22 @@ class GenHandle:
 
     def __init__(self, rid: str, prompt: np.ndarray, max_new: int,
                  deadline: Optional[Deadline],
-                 sampling: Tuple[float, int, float, int] = None):
+                 sampling: Tuple[float, int, float, int] = None,
+                 spec_k: Optional[int] = None):
         self.id = rid
         self.prompt = np.asarray(prompt, np.int32)
         self.max_new = int(max_new)
         self.deadline = deadline
+        # per-stream speculative budget: None = the engine default,
+        # 0 = no drafting for this stream (it still rides the verify
+        # batch with an empty draft — plain decode), 1..k = a cap
+        self.spec_k = spec_k
+        # lazily-built incremental prompt-lookup index (the drafter
+        # runs every decode tick — rescanning the context each pass
+        # would put O(context) work on the scheduler hot path). Owned
+        # by the engine, mutated only under its lock.
+        self.lookup: Optional[PromptLookup] = None
+        self.lookup_len = 0   # generated tokens already indexed
         self.sampling = sampling if sampling is not None else \
             (0.0, 0, 1.0, stream_seed(rid))
         self.tokens: List[int] = []
@@ -248,6 +297,9 @@ class GenHandle:
                 return
             self.outcome = outcome
             self.error = error
+            # the drafter index is decode-time state; finished handles
+            # live on in the dedup LRU and must not pin it
+            self.lookup = None
             self._cond.notify_all()
         _streams.labels(outcome=outcome).inc()
 
@@ -298,11 +350,14 @@ class GenHandle:
 class _Slot:
     __slots__ = ("handle", "last_token", "position", "phase",
                  "prefill_pos", "epoch", "host_token", "use_host",
-                 "pending_copy")
+                 "pending_copy", "spec_inflight")
 
     def __init__(self):
         self.handle: Optional[GenHandle] = None
         self.last_token = 0
+        self.spec_inflight = False  # a verify batch for this seat is
+        #                          dispatched but not yet applied: the
+        #                          next pass must not re-dispatch it
         self.position = 0        # cache index the NEXT incoming token
         #                          will be written at
         self.phase = "decode"    # "prefill" while chunks are pending
@@ -334,11 +389,35 @@ class LLMEngine:
     def __init__(self, model, mode: str = "continuous",
                  max_waiting: Optional[int] = None,
                  overlap: Optional[bool] = None,
-                 prefix_cache: Optional[bool] = None):
+                 prefix_cache: Optional[bool] = None,
+                 spec_k: Optional[int] = None,
+                 spec_ngram: Optional[int] = None):
         if mode not in ("continuous", "oneshot"):
             raise ValueError(f"unknown scheduling mode {mode!r}")
         self.model = model
         self.mode = mode
+        # speculative decoding: the engine drafts with the n-gram
+        # prompt-lookup drafter and scores through the model's VERIFY
+        # executable; the budget can never exceed the model's fixed
+        # verify width (spec_k at model construction), and an engine
+        # built with spec_k=0 on a spec-capable model runs plain
+        # decode (the bench A/B rig)
+        model_k = int(getattr(model, "spec_k", 0) or 0)
+        if spec_k is None:
+            spec_k = model_k
+        self.spec_k = max(0, min(int(spec_k), model_k))
+        self._spec = self.spec_k > 0 and \
+            hasattr(model, "verify_step") and \
+            hasattr(model, "read_tokens")
+        if spec_ngram is None:
+            spec_ngram = env_int("ZOO_LLM_SPEC_NGRAM", 3)
+        self.spec_ngram = max(1, int(spec_ngram))
+        # drafter/accept accounting (stats(); the process-global
+        # counters feed /metrics)
+        self._spec_lanes = 0           # verify lanes dispatched
+        self._spec_drafted_lanes = 0   # ... with a non-empty draft
+        self._spec_proposed_n = 0
+        self._spec_accepted_n = 0
         if overlap is None:
             overlap = os.environ.get("ZOO_LLM_OVERLAP", "1") not in (
                 "0", "false", "off")
@@ -425,15 +504,20 @@ class LLMEngine:
     def submit(self, prompt, max_new_tokens: int,
                rid: Optional[str] = None,
                deadline: Optional[Deadline] = None,
-               sampling=None) -> GenHandle:
+               sampling=None, spec_k: Optional[int] = None
+               ) -> GenHandle:
         """Queue one generation. ``sampling``: None (greedy, or the
         ``ZOO_LLM_SAMPLING`` deployment default), or a dict/string with
         ``temperature``/``top_k``/``top_p``/``seed`` — a missing seed
         derives deterministically from the request id, so retries and
-        failover resumes replay the same draws. Raises
-        :class:`AdmissionError` when the waiting queue is full
-        (retryable shed), ``ValueError`` for a prompt no prefill path
-        can hold."""
+        failover resumes replay the same draws. ``spec_k`` caps this
+        stream's speculative draft budget (None = the engine default,
+        0 = no drafting for this stream; it cannot raise the engine's
+        verify width). Raises :class:`AdmissionError` when the waiting
+        queue is full (retryable shed), ``ValueError`` for a prompt no
+        prefill path can hold."""
+        if spec_k is not None and int(spec_k) < 0:
+            raise ValueError("spec_k must be >= 0")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("empty prompt")
@@ -468,7 +552,9 @@ class LLMEngine:
                     "another replica",
                     retry_after_ms=200)
             h = GenHandle(rid, prompt, max_new_tokens, deadline,
-                          sampling=params)
+                          sampling=params,
+                          spec_k=None if spec_k is None else
+                          int(spec_k))
             self._by_id[rid] = h
             self._trim_finished()
             self._wait.append(h)
@@ -616,6 +702,8 @@ class LLMEngine:
                                    resumed_at=len(prompt))
             slot.handle = h
             slot.epoch += 1
+            slot.spec_inflight = False  # any stale verify batch for
+            #                          this seat died with the epoch
             self._admit_counter += 1
             h.admit_seq = self._admit_counter
             # admission only BINDS the slot and blocks; the device
@@ -978,6 +1066,157 @@ class LLMEngine:
                 self._finish_slot(slot, "ok")
         self._publish()
 
+    # -- speculative decoding ----------------------------------------------
+    def _draft_for(self, h: GenHandle) -> np.ndarray:
+        """Up to the stream's spec budget of drafted continuation
+        tokens from the n-gram prompt-lookup drafter, matched against
+        prompt + everything generated (which always ends with the last
+        emitted token — the verify pass's row 0). The per-stream index
+        is built once and extended incrementally as tokens land, so
+        drafting stays O(k) per tick. Under self._lock (push() only
+        ever appends to ``h.tokens`` from under the same lock)."""
+        k = self.spec_k if h.spec_k is None else min(h.spec_k,
+                                                     self.spec_k)
+        if k <= 0:
+            return np.zeros((0,), np.int32)
+        if h.lookup is None:
+            h.lookup = PromptLookup(h.prompt, self.spec_ngram)
+        if h.lookup_len < len(h.tokens):
+            h.lookup.extend(h.tokens[h.lookup_len:])
+            h.lookup_len = len(h.tokens)
+        return h.lookup.propose(k)
+
+    def _build_spec_tick(self):
+        """Under the lock: assemble ONE fixed-shape verify batch —
+        (slots, spec_k + 1) candidate rows, row 0 the incoming token,
+        rows 1.. the drafter's proposals, zero-padded. The draft span
+        is funded from the FREE list only (``grow_to`` — speculation
+        never preempts another stream) and clamped to owned blocks,
+        the context ceiling, and the stream's remaining budget, so
+        every token the accept step can emit has a REAL cache row.
+        A seat with a verify batch still in flight idles until the
+        readback applies it (accept length decides the next base
+        position, so spec lanes cannot chain on-device)."""
+        S = self.model.num_slots
+        T = self.spec_k + 1
+        ctx = getattr(self.model, "max_context",
+                      self.model.max_blocks_per_seq *
+                      self.model.block_size)
+        tokens = np.zeros((S, T), np.int32)
+        tables = np.zeros((S, self.model.max_blocks_per_seq), np.int32)
+        positions = np.zeros((S,), np.int32)
+        temps = np.zeros((S,), np.float32)
+        topks = np.zeros((S,), np.int32)
+        topps = np.ones((S,), np.float32)
+        seeds = np.zeros((S,), np.uint32)
+        snapshot = []
+        for i, slot in enumerate(self._slots):
+            h = slot.handle
+            if h is None or slot.phase != "decode" or h.done:
+                continue
+            if slot.spec_inflight:
+                continue
+            if h.sched_count >= h.max_new or slot.position >= ctx:
+                continue
+            draft = self._draft_for(h)
+            if len(draft):
+                cap_tokens = self.allocator.grow_to(
+                    h.id, min(slot.position + len(draft) + 1, ctx))
+                cap = min(cap_tokens - 1 - slot.position,
+                          ctx - 1 - slot.position,
+                          h.max_new - h.gen_count - 1)
+                draft = draft[:max(0, cap)]
+            tokens[i, 0] = slot.last_token
+            if len(draft):
+                tokens[i, 1:1 + len(draft)] = draft
+            tables[i] = self._table_row(self.allocator.blocks_of(h.id))
+            positions[i] = slot.position
+            t, k, p, s = h.sampling
+            temps[i], topks[i], topps[i], seeds[i] = t, k, p, s
+            slot.spec_inflight = True
+            snapshot.append((i, h, slot.epoch,
+                             [int(x) for x in draft]))
+        if not snapshot:
+            return None
+        return (tokens, tables, positions,
+                (temps, topks, topps, seeds), snapshot)
+
+    def _apply_spec(self, snapshot, arr: np.ndarray):
+        """Apply one verify readback: emit the longest accepted prefix
+        plus the model's own next token. ``arr[i, j]`` is the CANONICAL
+        token after the context extended by the first ``j`` draft
+        tokens (sampled with the same stateless key non-speculative
+        decode would use), so the emitted stream is byte-identical to
+        plain decode by construction; rejected rows' cache writes are
+        dead weight the position mask hides until the next pass
+        overwrites them (rollback = length reset). A lane whose slot
+        moved on (epoch bumped) is discarded, exactly like a decode
+        lane."""
+        eos = getattr(self.model, "eos_id", None)
+        for i, h, epoch, draft in snapshot:
+            slot = self._slots[i]
+            if slot.handle is not h or slot.epoch != epoch or h.done:
+                continue
+            slot.spec_inflight = False
+            out = arr[i]
+            n_draft = len(draft)
+            accept = accept_length(draft, out)
+            self._spec_lanes += 1
+            if n_draft:
+                self._spec_drafted_lanes += 1
+                self._spec_proposed_n += n_draft
+                self._spec_accepted_n += accept
+                _spec_proposed.inc(n_draft)
+                _spec_accepted.inc(accept)
+                _spec_accept_len.observe(accept)
+            for tok in (int(t) for t in out[:accept + 1]):
+                slot.position += 1
+                slot.last_token = tok
+                h.push(tok)
+                h.gen_count += 1
+                h.sched_count = h.gen_count
+                self._generated += 1
+                _tokens.labels(kind="decode").inc()
+                if h.gen_count >= h.max_new or \
+                        (eos is not None and tok == eos):
+                    self._finish_slot(slot, "ok")
+                    break
+        if self._spec_lanes:
+            _spec_hit_rate.set(self._spec_drafted_lanes /
+                               self._spec_lanes)
+        self._publish()
+
+    def _spec_tick(self) -> bool:
+        """The SYNCHRONOUS verify tick (overlap-off runs, oneshot
+        baseline, white-box tests): build, dispatch, block on
+        readback, apply inline."""
+        with self._lock:
+            built = self._build_spec_tick()
+        if built is None:
+            return False
+        tokens, tables, positions, lanes, snapshot = built
+        t0 = time.perf_counter()
+        try:
+            batch = self.model.verify_step(tokens, tables, positions,
+                                           lanes)
+            arr = self.model.read_tokens(batch)
+        except Exception as e:  # noqa: BLE001 — lost verify lanes end
+            # their streams loudly, same contract as a decode tick
+            with self._lock:
+                self._fail_lanes([(i, h, ep) for i, h, ep, _
+                                  in snapshot], e)
+            return True
+        t1 = time.perf_counter()
+        _tick_seconds.labels(phase="decode").observe(t1 - t0)
+        self._note_busy(t0, t1)
+        self._decode_steps += 1
+        _steps.inc()
+        with self._lock:
+            self._apply_spec(snapshot, np.asarray(arr))
+        _tick_seconds.labels(phase="readback").observe(
+            time.perf_counter() - t1)
+        return True
+
     def _decode_tick(self):
         """The SYNCHRONOUS tick (request-level baseline, overlap-off
         runs, and white-box tests): host-fed lanes, blocking readback,
@@ -1043,7 +1282,7 @@ class LLMEngine:
             item = self._rbq.get()
             if item is None:
                 return
-            batch, snapshot, t_dispatch = item
+            kind, batch, snapshot, t_dispatch = item
             try:
                 arr = self.model.read_tokens(batch)
             except Exception as e:  # noqa: BLE001 — these lanes'
@@ -1051,7 +1290,9 @@ class LLMEngine:
                 # poisoned): end the streams loudly and tell the
                 # dispatcher to re-seed the device token chain
                 with self._lock:
-                    self._fail_lanes(snapshot, e)
+                    self._fail_lanes(
+                        snapshot if kind == "decode" else
+                        [(i, h, ep) for i, h, ep, _ in snapshot], e)
                 self._inflight.release()
                 self._wake.set()
                 continue
@@ -1060,7 +1301,10 @@ class LLMEngine:
                 t_ready - t_dispatch)
             self._note_busy(t_dispatch, t_ready)
             with self._lock:
-                self._apply_tokens(snapshot, arr)
+                if kind == "spec":
+                    self._apply_spec(snapshot, np.asarray(arr))
+                else:
+                    self._apply_tokens(snapshot, arr)
             _tick_seconds.labels(phase="readback").observe(
                 time.perf_counter() - t_ready)
             self._decode_steps += 1
@@ -1118,7 +1362,8 @@ class LLMEngine:
                 t2 = time.perf_counter()
                 with self._lock:
                     self._grow_or_preempt()
-                    built = self._build_tick(device_chain=True)
+                    built = self._build_spec_tick() if self._spec \
+                        else self._build_tick(device_chain=True)
                 _tick_seconds.labels(phase="schedule").observe(
                     (t1 - t0) + (time.perf_counter() - t2))
                 if built is None:
@@ -1128,12 +1373,38 @@ class LLMEngine:
                     self._wake.wait(0.005)
                     self._wake.clear()
                     continue
-                host, use, tables, positions, lanes, snapshot = built
                 # bound the pipeline depth: at most 2 ticks in flight
                 while not self._inflight.acquire(timeout=0.5):
                     if self._stop.is_set():
                         return
                 t_d = time.perf_counter()
+                if self._spec:
+                    # verify batches are host-fed (the accept length
+                    # decides each seat's next base position, so spec
+                    # lanes cannot chain on-device). In steady state
+                    # every ready seat rides ONE batch and the next
+                    # build waits for its apply — pipeline depth 1,
+                    # NOT the decode path's double-buffering: a verify
+                    # pass streams the weights once for ALL seats, so
+                    # splitting seats across alternating batches would
+                    # double the HBM bill per token. Speculation must
+                    # win on accept amortization (which is why it is
+                    # opt-in, not default); only seats entering decode
+                    # mid-pass form a second in-flight batch.
+                    tokens, tables, positions, lanes, snapshot = built
+                    try:
+                        batch = self.model.verify_step(
+                            tokens, tables, positions, lanes)
+                    except Exception as e:  # noqa: BLE001
+                        with self._lock:
+                            self._fail_lanes([(i, h, ep) for i, h, ep,
+                                              _ in snapshot], e)
+                        self._inflight.release()
+                        continue
+                    self._rbq.put(("spec", batch, snapshot, t_d))
+                    prev_batch = None
+                    continue
+                host, use, tables, positions, lanes, snapshot = built
                 try:
                     prev_batch = self.model.decode_step(
                         prev_batch, host, use, tables, positions, lanes)
@@ -1145,7 +1416,7 @@ class LLMEngine:
                         self._fail_lanes(snapshot, e)
                     self._inflight.release()
                     continue
-                self._rbq.put((prev_batch, snapshot, t_d))
+                self._rbq.put(("decode", prev_batch, snapshot, t_d))
         finally:
             self._rbq.put(None)
             if self._rb_thread is not None:
@@ -1164,7 +1435,8 @@ class LLMEngine:
                 self._grow_or_preempt()
             _tick_seconds.labels(phase="schedule").observe(
                 (t1 - t0) + (time.perf_counter() - t2))
-            progressed = self._decode_tick()
+            progressed = self._spec_tick() if self._spec \
+                else self._decode_tick()
             if not progressed:
                 # also parks the loop when the waiting queue is only
                 # KV-gated (head cannot be admitted yet): without the
@@ -1190,6 +1462,8 @@ class LLMEngine:
                "prefill_chunk": self._chunk,
                "decode_attention_impl": getattr(
                    self.model, "decode_attention_impl", "host"),
+               "prefill_attention_impl": getattr(
+                   self.model, "prefill_attention_impl", "host"),
                # bytes-per-token multipliers (this PR): what the cache
                # stores tokens as (auto's pick is recorded, never
                # silent) and how the prefix cache is doing
@@ -1202,6 +1476,19 @@ class LLMEngine:
                "prefix_cache": self.prefix_cache,
                "prefix_hit_tokens": self._hit_tokens,
                "prefix_miss_tokens": self._miss_tokens,
+               # speculative decoding (this PR): the active draft
+               # budget (0 = off), drafter coverage, and the
+               # amortization actually won — accepted / proposed
+               "spec_k": self.spec_k if self._spec else 0,
+               "spec_ngram": self.spec_ngram,
+               "spec_proposed_tokens": self._spec_proposed_n,
+               "spec_accepted_tokens": self._spec_accepted_n,
+               "spec_accept_rate": (
+                   self._spec_accepted_n / self._spec_proposed_n
+                   if self._spec_proposed_n else 0.0),
+               "spec_draft_hit_rate": (
+                   self._spec_drafted_lanes / self._spec_lanes
+                   if self._spec_lanes else 0.0),
                "active": sum(1 for s in self._slots if s.handle),
                "waiting": len(self._wait),
                "decode_steps": self._decode_steps,

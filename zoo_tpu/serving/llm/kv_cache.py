@@ -259,6 +259,33 @@ class BlockAllocator:
             self._publish()
             return got
 
+    def grow_to(self, seq_id: str, n_tokens: int) -> int:
+        """Best-effort growth WITHOUT preemption: allocate free (or
+        evictable cached-free) blocks one at a time until ``seq_id``
+        can hold ``n_tokens``, stopping quietly when the pool runs dry.
+        Returns the resulting token capacity (owned blocks x
+        block_size) — 0 for an unknown sequence.
+
+        The speculative-decode scheduler funds its draft span through
+        this: a verify pass may write up to ``spec_k`` rows past the
+        current position, and accepted rows must land in REAL blocks —
+        but speculation is opportunistic, so it must never evict
+        another stream's KV the way :meth:`allocate`-then-preempt
+        would. Under-funded drafts are simply clamped by the caller."""
+        with self._lock:
+            blocks = self._owners.get(seq_id)
+            if blocks is None:
+                return 0
+            want = self.blocks_for_tokens(n_tokens)
+            while len(blocks) < want:
+                got = self._take_free(1)
+                if got is None:
+                    break
+                self._ref[got[0]] = 1
+                blocks.extend(got)
+            self._publish()
+            return len(blocks) * self.block_size
+
     # -- prefix cache ------------------------------------------------------
     def match_prefix(self, hashes: Sequence[bytes]) -> int:
         """How many LEADING hashes are currently matchable (read-only

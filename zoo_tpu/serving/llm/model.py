@@ -130,6 +130,30 @@ def resolve_decode_impl(impl: Optional[str] = "auto") -> str:
     return "flash" if on_tpu() else "dense"
 
 
+def resolve_prefill_impl(impl: Optional[str] = "auto") -> str:
+    """Concrete chunk-prefill/verify attention kernel for this process.
+
+    ``"auto"`` (default) picks the paged flash-prefill Pallas kernel
+    (:mod:`zoo_tpu.ops.pallas.paged_prefill`) on TPU hardware and the
+    dense ``cache[block_table]`` gather off TPU — the gather is the
+    correctness anchor the kernel is asserted token-identical against.
+    ``ZOO_LLM_PREFILL_IMPL`` force-overrides (``dense`` / ``flash``)
+    for A/B runs and for asserting identity on CPU via the
+    interpreter. Applies to the CHUNK executable (chunked prefill,
+    prefix-cache suffix feeds) and the speculative-decode VERIFY
+    executable; the bucketed whole-prompt prefill keeps the training
+    attention stack (:func:`resolve_attention_impl`)."""
+    if impl in (None, "auto"):
+        impl = os.environ.get("ZOO_LLM_PREFILL_IMPL", "") or "auto"
+    if impl != "auto":
+        if impl not in ("dense", "flash"):
+            raise ValueError(f"unknown prefill impl {impl!r} "
+                             "(dense / flash / auto)")
+        return impl
+    from zoo_tpu.ops.pallas import on_tpu
+    return "flash" if on_tpu() else "dense"
+
+
 KV_DTYPES = ("f32", "bf16", "int8")
 
 
@@ -260,7 +284,9 @@ class PagedLlamaModel:
                  prefill_buckets: Sequence[int] = DEFAULT_PREFILL_BUCKETS,
                  prefill_chunk: Optional[int] = None,
                  decode_impl: str = "auto",
+                 prefill_impl: str = "auto",
                  kv_dtype: Optional[str] = None,
+                 spec_k: Optional[int] = None,
                  eos_id: Optional[int] = None,
                  mesh=None):
         self.cfg = config
@@ -275,6 +301,16 @@ class PagedLlamaModel:
                                                "0") or 0)
         self.prefill_chunk_size = int(prefill_chunk)
         self.decode_attention_impl = resolve_decode_impl(decode_impl)
+        self.prefill_attention_impl = resolve_prefill_impl(prefill_impl)
+        # speculative decoding: the VERIFY executable's fixed candidate
+        # width is spec_k + 1 (the incoming token plus up to spec_k
+        # drafted continuations); 0 = no verify path, the engine runs
+        # plain 1-token decode
+        if spec_k is None:
+            spec_k = int(os.environ.get("ZOO_LLM_SPEC_K", "0") or 0)
+        self.spec_k = int(spec_k)
+        if self.spec_k < 0:
+            raise ValueError("spec_k must be >= 0 (0 = off)")
         # KV storage dtype (docs/llm_serving.md): f32 (reference), bf16
         # (half the bytes), int8 + per-(block,row,kv-head) absmax
         # scales (half again). Both the requested and resolved values
@@ -362,6 +398,7 @@ class PagedLlamaModel:
                                     donate_argnums=(1,))
             self._prefill_chunked = jax.jit(self._prefill_chunk_fn,
                                             donate_argnums=(1,))
+            self._verify = jax.jit(self._verify_fn, donate_argnums=(1,))
             self._copy = jax.jit(self._copy_block_fn,
                                  donate_argnums=(0,))
         else:
@@ -404,6 +441,10 @@ class PagedLlamaModel:
             self._prefill_chunked = jax.jit(
                 self._prefill_chunk_fn, donate_argnums=(1,),
                 in_shardings=(p_sh, cache_sh) + (rep,) * 8,
+                out_shardings=(rep, cache_sh))
+            self._verify = jax.jit(
+                self._verify_fn, donate_argnums=(1,),
+                in_shardings=(p_sh, cache_sh) + (rep,) * 7,
                 out_shardings=(rep, cache_sh))
             self._copy = jax.jit(
                 self._copy_block_fn, donate_argnums=(0,),
@@ -567,6 +608,80 @@ class PagedLlamaModel:
             S, ctx, c.n_kv_head, c.head_dim)
         return self._masked_gather_attention(q, keys, vals, live)
 
+    def _prefill_attend(self, q, kcl, vcl, ksl, vsl, block_tables,
+                        positions):
+        """Chunk-of-rows attention over the resident paged cache:
+        ``q`` (B, R, H, D) rows at cache ``positions`` (B, R), routed by
+        per-sequence ``block_tables`` (B, W) — each row attends every
+        resident column ``<= its position`` (causal within the chunk
+        plus everything earlier ticks wrote; the chunk's own K/V land
+        in the cache before this runs). B is 1 for a prefill chunk and
+        ``num_slots`` for a verify pass. Dispatches to the paged
+        flash-prefill Pallas kernel or the dense gather per
+        ``prefill_attention_impl``; both widen an int8 cache the same
+        way, so token parity stays testable off-TPU. Returns
+        (B, R, n_head * head_dim)."""
+        c = self.cfg
+        B, R = q.shape[0], q.shape[1]
+        scale = 1.0 / float(c.head_dim) ** 0.5
+        if self.prefill_attention_impl == "flash":
+            from zoo_tpu.ops.pallas.paged_prefill import (
+                paged_flash_prefill,
+            )
+            if self.mesh is None:
+                out = paged_flash_prefill(
+                    q, kcl, vcl, block_tables, positions,
+                    k_scale=ksl, v_scale=vsl, scale=scale)
+                return out.reshape(B, R, c.n_head * c.head_dim)
+            # tp: each device streams ITS kv heads' cache shard against
+            # the query heads of those groups — attention is
+            # head-local, same layout argument as the decode kernel
+            from jax.sharding import PartitionSpec as P
+
+            from zoo_tpu.parallel.compat import shard_map
+            if ksl is None:
+                out = shard_map(
+                    lambda q_, k_, v_, bt_, pos_: paged_flash_prefill(
+                        q_, k_, v_, bt_, pos_, scale=scale),
+                    mesh=self.mesh,
+                    in_specs=(P(None, None, "model", None),
+                              P(None, None, "model", None),
+                              P(None, None, "model", None),
+                              P(None, None), P(None, None)),
+                    out_specs=P(None, None, "model", None),
+                )(q, kcl, vcl, block_tables, positions)
+            else:
+                out = shard_map(
+                    lambda q_, k_, v_, ks_, vs_, bt_, pos_:
+                    paged_flash_prefill(
+                        q_, k_, v_, bt_, pos_, k_scale=ks_,
+                        v_scale=vs_, scale=scale),
+                    mesh=self.mesh,
+                    in_specs=(P(None, None, "model", None),
+                              P(None, None, "model", None),
+                              P(None, None, "model", None),
+                              P(None, None, "model"),
+                              P(None, None, "model"),
+                              P(None, None), P(None, None)),
+                    out_specs=P(None, None, "model", None),
+                )(q, kcl, vcl, ksl, vsl, block_tables, positions)
+            return out.reshape(B, R, c.n_head * c.head_dim)
+        # dense anchor: materialize cache[block_table] per sequence,
+        # widen, broadcast over the rows, and run the shared masked
+        # attention body — exactly what the kernel streams in VMEM
+        ctx = self.max_blocks_per_seq * self.block_size
+        kv = (B, ctx, c.n_kv_head, c.head_dim)
+        keys = self._widen_gather(kcl, ksl, block_tables).reshape(kv)
+        vals = self._widen_gather(vcl, vsl, block_tables).reshape(kv)
+        keys = jnp.broadcast_to(keys[:, None], (B, R) + kv[1:]).reshape(
+            (B * R,) + kv[1:])
+        vals = jnp.broadcast_to(vals[:, None], (B, R) + kv[1:]).reshape(
+            (B * R,) + kv[1:])
+        live = jnp.arange(ctx)[None, :] <= positions.reshape(-1)[:, None]
+        return self._masked_gather_attention(
+            q.reshape(B * R, c.n_head, c.head_dim), keys, vals,
+            live).reshape(B, R, c.n_head * c.head_dim)
+
     def _masked_gather_attention(self, q, keys, vals, live):
         """The shared dense paged-attention math: ``q`` (R, H, D) rows
         against cache-gathered ``keys``/``vals`` (R, ctx, n_kv, D)
@@ -703,11 +818,6 @@ class PagedLlamaModel:
         sin = jnp.take(self._sin, pos, axis=0)
         blk = jnp.where(real, block_table[pos // self.block_size], 0)
         off = pos % self.block_size
-        # causal over the CACHE index space: chunk row i attends every
-        # resident position <= start+i (all of which are real writes —
-        # earlier chunks plus this chunk's own prefix)
-        live = jnp.arange(ctx)[None, :] <= pos[:, None]   # (C, ctx)
-
         def layer(h, xs):
             p, kcl, vcl, ksl, vsl = self._unpack_xs(xs)
             x = _rms_norm(h, p["attn_norm"], c.rms_eps)
@@ -716,18 +826,12 @@ class PagedLlamaModel:
             k = _rope_rows(k[0], cos, sin)[None]
             kcl, ksl = self._append_rows(kcl, ksl, blk, off, k[0])
             vcl, vsl = self._append_rows(vcl, vsl, blk, off, v[0])
-            # one table serves every chunk row: broadcast the gathered
-            # (widened) cache over rows and reuse the one shared
-            # attention body
-            kv_shape = (C, ctx, c.n_kv_head, c.head_dim)
-            keys = jnp.broadcast_to(
-                self._widen_gather(kcl, ksl, block_table).reshape(
-                    kv_shape[1:])[None], kv_shape)
-            vals = jnp.broadcast_to(
-                self._widen_gather(vcl, vsl, block_table).reshape(
-                    kv_shape[1:])[None], kv_shape)
-            a = self._masked_gather_attention(q[0], keys, vals,
-                                              live)[None]
+            # causal over the CACHE index space: chunk row i attends
+            # every resident position <= start+i (all real writes —
+            # earlier chunks plus this chunk's own prefix); flash
+            # streams the table, dense gathers it
+            a = self._prefill_attend(q, kcl, vcl, ksl, vsl,
+                                     block_table[None], pos[None])
             h = h + a @ p["wo"]
             return self._mlp(p, h), self._layer_ys(kcl, vcl, ksl, vsl)
 
@@ -739,6 +843,64 @@ class PagedLlamaModel:
                         jnp.clip(length - 1 - start, 0, C - 1), axis=0)
         tok = _sample_row(last, temp, topk, topp, seed, length)
         return tok, cache
+
+    def _verify_fn(self, params, cache, tokens, block_tables,
+                   positions, temps, topks, topps, seeds):
+        """Speculative-decode VERIFY: score ``spec_k + 1`` candidate
+        tokens per slot in ONE device call. Row 0 of ``tokens`` (S, T)
+        is the slot's incoming token (the last emitted one), rows 1..
+        are the drafter's proposals; row ``j`` is written through the
+        block table at cache index ``positions[s] + j`` and attends
+        everything ``<= its position`` — so its logits are exactly what
+        sequential decode would compute after accepting rows ``< j``.
+        Each row then samples with the SAME stateless per-position key
+        non-speculative decode would use (``fold_in(seed, pos + j +
+        1)``), which is what makes the host's longest-accepted-prefix
+        emission byte-identical to plain decode, greedy and seeded
+        alike. Rejected rows' K/V stay in place as garbage the
+        position mask hides until the next append overwrites them —
+        rollback is a pure length reset. Rows past the pageable
+        context write to the trash block (their outputs are never
+        accepted; the engine caps draft length to owned blocks)."""
+        c = self.cfg
+        S, T = tokens.shape
+        ctx = self.max_blocks_per_seq * self.block_size
+        raw = positions[:, None] + jnp.arange(T)[None, :]     # (S, T)
+        real = raw < ctx
+        # same finite-rope clamp as the chunk executable (a NaN K/V in
+        # the trash block would poison later layers through 0 * NaN)
+        pos = jnp.minimum(raw, ctx - 1)
+        cos = jnp.take(self._cos, pos, axis=0)            # (S, T, D/2)
+        sin = jnp.take(self._sin, pos, axis=0)
+        blk = jnp.where(
+            real,
+            jnp.take_along_axis(block_tables, pos // self.block_size,
+                                axis=1), 0)                   # (S, T)
+        off = pos % self.block_size
+
+        def layer(h, xs):
+            p, kcl, vcl, ksl, vsl = self._unpack_xs(xs)
+            x = _rms_norm(h, p["attn_norm"], c.rms_eps)
+            q, k, v = self._attn_proj(p, x)               # (S, T, H, D)
+            q = _rope_rows(q, cos, sin)
+            k = _rope_rows(k, cos, sin)
+            kcl, ksl = self._append_rows(kcl, ksl, blk, off, k)
+            vcl, vsl = self._append_rows(vcl, vsl, blk, off, v)
+            a = self._prefill_attend(q, kcl, vcl, ksl, vsl,
+                                     block_tables, pos)
+            h = h + a @ p["wo"]
+            return self._mlp(p, h), self._layer_ys(kcl, vcl, ksl, vsl)
+
+        h = jnp.take(params["embed"], tokens, axis=0)   # (S, T, hidden)
+        h, ys = jax.lax.scan(layer, h, self._layer_xs(params, cache))
+        cache = self._repack_cache(ys)
+        logits = self._lm_head(params, h)               # (S, T, vocab)
+        nxt = _sample_tokens(
+            logits.reshape(S * T, -1),
+            jnp.repeat(temps, T), jnp.repeat(topks, T),
+            jnp.repeat(topps, T), jnp.repeat(seeds, T),
+            (raw + 1).reshape(S * T)).reshape(S, T)
+        return nxt, cache
 
     # -- host-facing API (what the engine calls) ---------------------------
     @staticmethod
@@ -843,6 +1005,37 @@ class PagedLlamaModel:
                 jnp.asarray(seeds, jnp.uint32))
             return out
 
+    def verify_step(self, tokens: np.ndarray,
+                    block_tables: np.ndarray, positions: np.ndarray,
+                    sampling_lanes):
+        """Dispatch ONE speculative verify pass WITHOUT a host sync:
+        ``tokens`` (num_slots, spec_k + 1) candidate rows per slot
+        (row 0 = the incoming token, rows 1.. = drafted continuations,
+        zero-padded), written through the block tables starting at each
+        slot's ``positions`` entry. Returns the on-device
+        (num_slots, spec_k + 1) batch of per-position canonical tokens
+        — :meth:`read_tokens` blocks on it and the engine emits the
+        longest accepted prefix. ONE fixed shape, compiled once."""
+        tokens = np.asarray(tokens, np.int32)
+        if self.spec_k < 1:
+            raise RuntimeError("verify_step needs spec_k >= 1 at "
+                               "model construction")
+        if tokens.shape != (self.num_slots, self.spec_k + 1):
+            raise ValueError(
+                f"verify batch {tokens.shape} != the fixed "
+                f"{(self.num_slots, self.spec_k + 1)} census shape")
+        temps, topks, topps, seeds = sampling_lanes
+        with self._lock:
+            out, self._cache = self._verify(
+                self.params, self._cache, jnp.asarray(tokens),
+                jnp.asarray(block_tables, jnp.int32),
+                jnp.asarray(positions, jnp.int32),
+                jnp.asarray(temps, jnp.float32),
+                jnp.asarray(topks, jnp.int32),
+                jnp.asarray(topps, jnp.float32),
+                jnp.asarray(seeds, jnp.uint32))
+            return out
+
     def read_tokens(self, batch) -> np.ndarray:
         """Block until a dispatched tick's token batch is on the host.
         This is the ONLY device->host transfer of the decode hot path:
@@ -880,16 +1073,18 @@ class PagedLlamaModel:
         return {"decode": size(self._decode),
                 "prefill": size(self._prefill),
                 "prefill_chunk": size(self._prefill_chunked),
+                "verify": size(self._verify),
                 "copy_block": size(self._copy)}
 
 
 def _rope_rows(x: jnp.ndarray, cos: jnp.ndarray,
                sin: jnp.ndarray) -> jnp.ndarray:
-    """Rotate (S, H, D) by per-ROW angles (S, D/2) — the decode-step
-    variant of :func:`apply_rope`, where every row sits at its own
-    position instead of sharing a 0..T ramp."""
+    """Rotate (..., H, D) by per-ROW angles (..., D/2) — the
+    decode-step variant of :func:`apply_rope`, where every row sits at
+    its own position instead of sharing a 0..T ramp (the verify
+    executable feeds (S, T, H, D) rows with (S, T, D/2) angles)."""
     d2 = x.shape[-1] // 2
     x1, x2 = x[..., :d2], x[..., d2:]
-    c = cos[:, None, :].astype(x.dtype)
-    s = sin[:, None, :].astype(x.dtype)
+    c = cos[..., None, :].astype(x.dtype)
+    s = sin[..., None, :].astype(x.dtype)
     return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)

@@ -11,7 +11,9 @@ is the llm half of that resolution:
   seamless.
 * ``llama:tiny:seed=3,slots=4,block=8,blocks=64,buckets=16/64`` —
   key=value overrides after the preset (also ``chunk=N`` for chunked
-  prefill and ``overlap=0/1`` for the async tick pipeline).
+  prefill, ``overlap=0/1`` for the async tick pipeline, ``spec_k=N`` /
+  ``spec_ngram=N`` for speculative decoding, and ``prefill_impl=`` for
+  the chunk/verify attention kernel).
 * ``llama:vocab=256,hidden=64,n_block=2,n_head=4,n_kv_head=2,``
   ``intermediate=128`` — explicit architecture, no preset.
 
@@ -33,9 +35,10 @@ _ENGINE_KEYS = {"slots": "num_slots", "block": "block_size",
                 "blocks": "num_blocks", "tables": "max_blocks_per_seq",
                 "seed": "seed", "eos": "eos_id", "tp": "tp",
                 "chunk": "prefill_chunk", "overlap": "overlap",
-                "prefix_cache": "prefix_cache"}
+                "prefix_cache": "prefix_cache",
+                "spec_k": "spec_k", "spec_ngram": "spec_ngram"}
 # string-valued engine/model keys (everything in _ENGINE_KEYS is int)
-_STR_KEYS = {"kv": "kv_dtype"}
+_STR_KEYS = {"kv": "kv_dtype", "prefill_impl": "prefill_impl"}
 
 
 def is_llm_spec(spec) -> bool:
@@ -140,6 +143,11 @@ def build_llm_engine(spec: str, mode: Optional[str] = None,
     prefix_cache = merged.pop("prefix_cache", None)
     if prefix_cache is not None:
         prefix_cache = bool(int(prefix_cache))
+    # spec_k is a MODEL shape (the fixed verify-executable width) and
+    # stays in `merged`; spec_ngram is pure scheduler policy
+    spec_ngram = merged.pop("spec_ngram", None)
+    if spec_ngram is not None:
+        spec_ngram = int(spec_ngram)
     cfg = LlamaConfig(**cfg_kwargs)
     # tensor-parallel serving: `tp=N` (spec) / ZOO_LLM_TP (env) / a
     # `mesh=` override span ONE model over N local devices instead of
@@ -159,5 +167,6 @@ def build_llm_engine(spec: str, mode: Optional[str] = None,
     mode = mode or os.environ.get("ZOO_LLM_MODE", "continuous")
     engine = LLMEngine(model, mode=mode,
                        max_waiting=overrides.get("max_waiting"),
-                       overlap=overlap, prefix_cache=prefix_cache)
+                       overlap=overlap, prefix_cache=prefix_cache,
+                       spec_ngram=spec_ngram)
     return engine.start() if start else engine

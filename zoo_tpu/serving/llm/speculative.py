@@ -1,0 +1,167 @@
+"""Model-free n-gram (prompt-lookup) drafter for speculative decoding.
+
+Speculative decoding amortizes the decode roofline: instead of one HBM
+pass per generated token, a cheap DRAFTER proposes ``k`` continuation
+tokens and ONE multi-token verify executable scores all of them
+(:meth:`~zoo_tpu.serving.llm.model.PagedLlamaModel.verify_step`),
+emitting the longest accepted prefix plus the model's own next token —
+up to ``k + 1`` tokens for a single pass over the weights and KV cache.
+
+The drafter here is the *prompt-lookup* observation (Saxena 2023;
+"assisted generation" without an assistant model): real serving traffic
+is massively self-repetitive — code completion echoes identifiers,
+summarization copies source spans, chat repeats the user's phrasing,
+and greedy decode itself falls into loops — so the best free guess for
+"what comes next" is "what followed the last time these tokens
+appeared". No second model, no extra weights, no device work:
+
+* take the last ``n`` generated/prompt tokens (``n`` from
+  ``ngram_max`` down to 1 — longer matches are more reliable, so they
+  win);
+* find the MOST RECENT earlier occurrence of that n-gram in the
+  prompt + generated history;
+* propose the ``k`` tokens that followed it.
+
+A wrong guess costs nothing but the verify lane it rode in (the engine
+emits the model's canonical token for the first mismatched position
+anyway), so the drafter optimizes for proposal coverage, not precision
+— the ACCEPT step is what guarantees output streams stay byte-identical
+to non-speculative decode.
+
+Pure numpy, importable without jax (the engine drafts on the scheduler
+thread; only verification touches the device).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_EMPTY = np.zeros((0,), np.int32)
+
+
+def propose_tokens(context, k: int, ngram_max: int = 3) -> np.ndarray:
+    """Up to ``k`` draft tokens continuing ``context`` (1-D int array:
+    prompt + everything generated, ending with the last emitted token).
+
+    Tries suffix n-grams from ``ngram_max`` down to 1; for the longest
+    one that re-occurs earlier in the context, returns the tokens that
+    followed its most recent occurrence (possibly overlapping the
+    suffix itself — self-referential repetition is a valid draft).
+    When the continuation runs off the end of the context, the match
+    implies a period of ``(L - n) - start`` and the draft keeps
+    extrapolating it — a looping stream (the single most draftable
+    shape there is) yields full-``k`` proposals instead of stalling at
+    the context edge. Returns an empty array when the context never
+    repeats (the engine then verifies a single token, which
+    degenerates to plain decode for that lane)."""
+    if k <= 0:
+        return _EMPTY
+    ctx = np.ascontiguousarray(np.asarray(context, np.int32).reshape(-1))
+    L = int(ctx.size)
+    if L < 2:
+        return _EMPTY
+    # windows over ctx[:-1]: the suffix occurrence itself (ending at
+    # the last token) is excluded by construction, every earlier —
+    # including overlapping — occurrence is a candidate
+    for n in range(min(int(ngram_max), L - 1), 0, -1):
+        pat = ctx[L - n:]
+        hay = ctx[:L - 1]
+        if hay.size < n:
+            continue
+        win = np.lib.stride_tricks.sliding_window_view(hay, n)
+        hits = np.nonzero((win == pat).all(axis=1))[0]
+        if hits.size == 0:
+            continue
+        start = int(hits[-1])
+        period = (L - n) - start
+        idx = start + n + np.arange(int(k))
+        over = idx >= L
+        if over.any():
+            # fold the out-of-range tail back by whole periods: the
+            # draft continues the cycle the match discovered
+            idx[over] = L - period + (idx[over] - L) % period
+        return ctx[idx].astype(np.int32, copy=False)
+    return _EMPTY
+
+
+class PromptLookup:
+    """Incremental prompt-lookup index for ONE stream.
+
+    :func:`propose_tokens` re-scans the whole context every verify
+    pass — fine for a test, measurable on the scheduler hot path (the
+    drafter runs for every decode lane every tick). This class keeps a
+    per-stream n-gram index instead: O(ngram_max) dict updates per
+    emitted token, O(k) per proposal, no rescans.
+
+    For every n in 1..ngram_max the index maps an n-gram (ending at
+    some position) to its two most recent start offsets — two, because
+    the most recent occurrence of the context's own suffix is the
+    suffix itself, and the drafter needs the one before it. Proposals
+    extrapolate the discovered period past the context edge exactly
+    like :func:`propose_tokens`; the two stay behaviorally identical
+    (property-tested against each other)."""
+
+    def __init__(self, tokens, ngram_max: int = 3):
+        self.n = max(1, int(ngram_max))
+        self.toks: list = []
+        # per n: {ngram tuple: (last_start, prev_start|None)}
+        self._idx = [dict() for _ in range(self.n + 1)]
+        self.extend(tokens)
+
+    def extend(self, tokens):
+        """Append emitted tokens, updating every n-gram ending at each
+        new position."""
+        toks = self.toks
+        for t in np.asarray(tokens, np.int32).reshape(-1):
+            toks.append(int(t))
+            end = len(toks)
+            for n in range(1, min(self.n, end) + 1):
+                key = tuple(toks[end - n:end])
+                idx = self._idx[n]
+                prev = idx.get(key)
+                start = end - n
+                idx[key] = (start,
+                            prev[0] if prev is not None else None)
+
+    def propose(self, k: int) -> np.ndarray:
+        """Draft up to ``k`` tokens continuing the indexed context —
+        same semantics as :func:`propose_tokens` on the same tokens."""
+        toks = self.toks
+        L = len(toks)
+        if k <= 0 or L < 2:
+            return _EMPTY
+        for n in range(min(self.n, L - 1), 0, -1):
+            hit = self._idx[n].get(tuple(toks[L - n:]))
+            if hit is None:
+                continue
+            last, prev = hit
+            # the most recent registration is the suffix itself;
+            # the drafter wants the occurrence before it
+            start = prev if last == L - n else last
+            if start is None:
+                continue
+            period = (L - n) - start
+            idx = start + n + np.arange(int(k))
+            over = idx >= L
+            if over.any():
+                idx[over] = L - period + (idx[over] - L) % period
+            return np.asarray([toks[i] for i in idx], np.int32)
+        return _EMPTY
+
+
+def accept_length(draft, verified) -> int:
+    """Longest accepted prefix of ``draft`` against the verify pass's
+    per-position canonical tokens.
+
+    ``verified[j]`` is the token the model itself emits after the
+    context extended by ``draft[:j]`` — sampled (or argmax'd) with the
+    same stateless per-position PRNG key non-speculative decode would
+    use. A draft token is accepted iff it EQUALS that canonical token,
+    so the emitted stream (``verified[:accept_length + 1]``) is
+    byte-identical to non-speculative decode by construction — the
+    classic spec-decode guarantee, greedy and seeded-sampling alike."""
+    n = min(len(draft), len(verified))
+    a = 0
+    while a < n and int(draft[a]) == int(verified[a]):
+        a += 1
+    return a

@@ -555,12 +555,16 @@ class ServingServer:
                 sampling = {k: msg[k] for k in
                             ("temperature", "top_k", "top_p", "seed")
                             if msg.get(k) is not None}
+                # per-stream speculative budget: caps (never raises)
+                # the replica's verify width; 0 = plain decode lanes
+                spec_k = msg.get("spec_k")
                 try:
                     h = eng.submit(
                         np.asarray(msg["prompt"]),
                         int(msg.get("max_new_tokens", 16)),
                         rid=rid, deadline=deadline,
-                        sampling=sampling or None)
+                        sampling=sampling or None,
+                        spec_k=None if spec_k is None else int(spec_k))
                 except AdmissionError as e:
                     _requests.labels(outcome="shed").inc()
                     _shed.labels(reason="queue_full").inc()

@@ -1326,6 +1326,81 @@ def bench_serving_ha(extra, n_requests=240, clients=6, feat=16):
         counter_value("zoo_serve_failover_total") - fo0)
 
 
+def bench_obs_trace(extra, n_requests=300, feat=16):
+    """Tracing-overhead A/B (docs/observability.md): serving throughput
+    through the full HA-client → ServingServer path with request-scoped
+    tracing OFF vs ON (every request minting a trace id, every hop
+    writing spans to the per-process JSONL), plus the disabled-path
+    floor: with no sink, span() must stay a no-op context manager —
+    asserted here with the same bound the obs test tier enforces, so a
+    trace-off deployment never pays for the feature."""
+    import tempfile
+
+    import zoo_tpu.obs as obs
+    from zoo_tpu.obs.tracing import span as _span
+    from zoo_tpu.serving.ha_client import HAServingClient
+    from zoo_tpu.serving.server import ServingServer
+
+    class _Double:
+        def predict(self, x, batch_size=None):
+            return np.asarray(x) * 2.0
+
+    def run():
+        srv = ServingServer(_Double(), port=0, batch_size=8,
+                            max_wait_ms=1.0).start()
+        cli = HAServingClient([(srv.host, srv.port)], hedge=False,
+                              deadline_ms=10000)
+        x = np.ones((1, feat), np.float32)
+        try:
+            for _ in range(20):  # warm the path off the clock
+                cli.predict(x)
+            t0 = time.perf_counter()
+            for _ in range(n_requests):
+                cli.predict(x)
+            dt = time.perf_counter() - t0
+        finally:
+            cli.close()
+            srv.stop()
+        return n_requests / dt
+
+    # an operator tracing the whole bench run ($ZOO_TRACE_DIR) gets
+    # their sink back afterwards — the A/B only borrows the toggle
+    from zoo_tpu.obs.tracing import trace_file_path
+    prior = trace_file_path()
+    obs.stop_tracing()
+    off = run()
+    trace_dir = tempfile.mkdtemp(prefix="zoo-bench-trace-")
+    obs.trace_to(trace_dir)
+    try:
+        on = run()
+    finally:
+        obs.stop_tracing()
+        if prior:
+            obs.trace_to(os.path.dirname(prior))
+    extra["obs_trace_off_req_per_sec"] = round(off, 1)
+    extra["obs_trace_on_req_per_sec"] = round(on, 1)
+    extra["obs_trace_overhead_pct"] = round(100.0 * (off / on - 1.0), 2)
+
+    # disabled-path floor: no sink -> span() is one global check + a
+    # no-op context manager. The tight bound lives in the obs test
+    # tier (tests/test_obs.py::test_span_disabled_is_cheap_noop, 20 µs
+    # on a quiet box); the bench asserts a looser sanity ceiling
+    # because it runs beside whatever else the session is doing.
+    n = 50_000
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with _span("bench.hot"):
+                pass
+        best = min(best, time.perf_counter() - t0)
+    per_op = best / n
+    extra["obs_trace_disabled_span_ns"] = round(per_op * 1e9, 1)
+    assert per_op < 100e-6, (
+        f"disabled span cost {per_op * 1e9:.0f} ns/op breaches the "
+        "hot-path floor")
+
+
 def bench_lifecycle(extra, clients=6, feat=16):
     """Model-lifecycle numbers (docs/model_lifecycle.md): whole-group
     rolling hot-swap duration and the p99 paid DURING the swap vs a
@@ -1420,7 +1495,7 @@ def bench_lifecycle(extra, clients=6, feat=16):
     assert versions.count(versions[0]) == len(versions), versions
 
 
-_BENCH_PR = 12  # bump alongside CHANGES.md when bench semantics move
+_BENCH_PR = 13  # bump alongside CHANGES.md when bench semantics move
 
 
 def _bench_meta():
@@ -1492,6 +1567,10 @@ def main():
             bench_serving_ha(extra)
         except Exception as e:  # noqa: BLE001
             extra["serving_ha_error"] = repr(e)
+        try:
+            bench_obs_trace(extra)
+        except Exception as e:  # noqa: BLE001
+            extra["obs_trace_error"] = repr(e)
         try:
             bench_lifecycle(extra)
         except Exception as e:  # noqa: BLE001

@@ -512,6 +512,20 @@ def test_metrics_end_to_end_serving_fit_checkpoint(orca_ctx, tmp_path):
     alloc = BlockAllocator(num_blocks=17, block_size=8)
     alloc.allocate("scrape-seq", 4)
 
+    # 4e. the SLO watchdog (docs/observability.md): two evaluation
+    # passes over the process-global registry publish the zoo_slo_*
+    # burn-rate/breach gauges the fleet alerts on
+    from zoo_tpu.obs.metrics import counter as _counter
+    from zoo_tpu.obs.slo import SLORule, SLOWatchdog, _error_rate
+    watchdog = SLOWatchdog(
+        rules=[SLORule("error_rate", _error_rate, 0.99)],
+        window_s=60.0, interval_s=60.0)
+    watchdog.evaluate()
+    # traffic must flow INSIDE the window for a burn-rate verdict
+    _counter("zoo_serving_requests_total", labels=("outcome",)) \
+        .labels(outcome="ok").inc()
+    watchdog.evaluate()
+
     # 5. one scrape sees all of it
     ex = MetricsExporter().start()  # process-global registry
     try:
@@ -560,6 +574,17 @@ def test_metrics_end_to_end_serving_fit_checkpoint(orca_ctx, tmp_path):
             "zoo_llm_spec_accepted_tokens_total",
             "zoo_llm_spec_accept_len_bucket",
             "zoo_llm_spec_draft_hit_rate",
+            # per-stream token cadence (PR 13): the request-level
+            # latency families the SLO watchdog burns against — the
+            # engine runs above pushed multi-token streams, so both
+            # carry real observations
+            "zoo_llm_inter_token_seconds_bucket",
+            'zoo_llm_stream_ttft_seconds_bucket{outcome="ok"',
+            # the SLO watchdog's published verdict (4e above) and the
+            # flight recorder's event tally
+            'zoo_slo_burn_rate{slo="error_rate"}',
+            'zoo_slo_breach{slo="error_rate"}',
+            "zoo_flight_events_total",
             # the GSPMD layer (docs/multichip.md): the fixture's 8-device
             # mesh publishes its axis sizes, and the fit above ran DP
             # over it, so the plan's estimated grad all-reduce bytes

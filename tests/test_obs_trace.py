@@ -1,0 +1,491 @@
+"""The fleet observability layer (PR 13): request-scoped trace
+propagation (client → wire → server → engine), the per-request timeline
+merger, torn-line tolerance, the crash flight recorder + postmortem
+harvest, the SLO watchdog, shed-reply trace echo, and the 3-replica
+SIGKILL trace-reconstruction chaos smoke."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import zoo_tpu.obs as obs
+from zoo_tpu.obs import flight as flight_mod
+from zoo_tpu.obs.slo import SLORule, SLOWatchdog
+from zoo_tpu.obs.timeline import (
+    build_timeline,
+    group_traces,
+    load_events,
+    render_text,
+    to_chrome_trace,
+)
+from zoo_tpu.obs.tracing import (
+    ambient_trace_id,
+    emit_event,
+    emit_span,
+    iter_jsonl,
+    trace_context,
+)
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture()
+def fresh_flight(tmp_path, monkeypatch):
+    """A flight recorder spilling into tmp (and restored afterwards)."""
+    monkeypatch.setenv("ZOO_OBS_POSTMORTEM_DIR", str(tmp_path / "pm"))
+    monkeypatch.setenv("ZOO_OBS_FLIGHT_CAP", "8")
+    flight_mod.reset_for_tests()
+    yield flight_mod.flight_recorder()
+    flight_mod.reset_for_tests()
+
+
+# ----------------------------------------------------- trace contexts
+
+def test_trace_context_adoption_and_parenting(tmp_path):
+    d = str(tmp_path / "t")
+    obs.trace_to(d)
+    try:
+        assert ambient_trace_id() is None
+        with trace_context("req1" * 8, "par1" * 4):
+            assert ambient_trace_id() == "req1" * 8
+            with obs.span("inner"):
+                pass
+        assert ambient_trace_id() is None
+        with obs.span("outer"):  # back on the process trace
+            pass
+    finally:
+        obs.stop_tracing()
+    evs = obs.read_trace(d)
+    inner_b = next(e for e in evs if e["name"] == "inner"
+                   and e["ev"] == "B")
+    assert inner_b["trace"] == "req1" * 8
+    assert inner_b["parent"] == "par1" * 4
+    outer_b = next(e for e in evs if e["name"] == "outer"
+                   and e["ev"] == "B")
+    assert outer_b["trace"] != "req1" * 8
+    assert outer_b["parent"] is None
+
+
+def test_emit_span_and_event_identity(tmp_path):
+    d = str(tmp_path / "t")
+    obs.trace_to(d)
+    try:
+        sid = emit_span("work", 100.0, 0.25, trace="tt" * 16,
+                        parent="pp" * 8, ok=False, rid="r1")
+        emit_event("mark", trace="tt" * 16, parent=sid, note="x")
+    finally:
+        obs.stop_tracing()
+    evs = obs.read_trace(d)
+    x = next(e for e in evs if e["ev"] == "X")
+    assert (x["trace"], x["parent"], x["dur_s"], x["ok"]) == \
+        ("tt" * 16, "pp" * 8, 0.25, False)
+    assert x["attrs"] == {"rid": "r1"}
+    i = next(e for e in evs if e["ev"] == "I")
+    assert i["parent"] == x["span"] == sid
+
+
+def test_emit_disabled_is_noop():
+    obs.stop_tracing()
+    assert emit_span("x", 0.0, 0.0) is None
+    assert emit_event("x") is None
+
+
+# ------------------------------------------------- torn-line tolerance
+
+def test_read_trace_skips_truncated_live_file(tmp_path):
+    """A replica SIGKILLed mid-write tears its last line; the readers
+    must keep the intact prefix instead of raising."""
+    d = str(tmp_path / "t")
+    obs.trace_to(d)
+    try:
+        for i in range(3):
+            with obs.span(f"s{i}"):
+                pass
+    finally:
+        obs.stop_tracing()
+    (fname,) = [f for f in os.listdir(d) if f.startswith("trace-")]
+    path = os.path.join(d, fname)
+    # truncate the LIVE file mid-line (the SIGKILL shape) ...
+    raw = open(path, "rb").read()
+    assert raw.endswith(b"\n")
+    with open(path, "wb") as f:
+        f.write(raw[:-9])  # tears the final record
+    evs = obs.read_trace(d)
+    names = [e["name"] for e in evs]
+    assert "s0" in names and "s1" in names
+    assert len(evs) == 5  # 6 B/E records minus the torn one
+    # ... and with appended garbage (torn + invalid utf-8 + partial)
+    with open(path, "ab") as f:
+        f.write(b'{"ev":"B","name":"torn\xff\xfe\n{"half')
+    assert len(obs.read_trace(d)) == 5
+    # the timeline loader shares the tolerance
+    assert len(load_events(d)) == 5
+    assert list(iter_jsonl(os.path.join(d, "missing.jsonl"))) == []
+
+
+# ------------------------------------------------------------ timeline
+
+def test_timeline_merger_open_spans_and_chrome():
+    tid = "ab" * 16
+    events = [
+        # client root (X), one attempt that completed (B+E), one the
+        # kill tore open (B only), an instant, and a foreign trace
+        {"ev": "X", "name": "client.generate", "trace": tid,
+         "span": "root", "ts": 1.0, "dur_s": 5.0, "ok": True,
+         "file": "trace-h-1.jsonl"},
+        {"ev": "B", "name": "server.generate", "trace": tid,
+         "span": "a1", "parent": "root", "pid": 2, "ts": 1.5,
+         "file": "trace-h-2.jsonl"},
+        {"ev": "E", "name": "server.generate", "trace": tid,
+         "span": "a1", "ts": 2.0, "dur_s": 0.5, "ok": True},
+        {"ev": "B", "name": "llm.decode", "trace": tid, "span": "a2",
+         "pid": 3, "ts": 2.5, "file": "trace-h-3.jsonl"},
+        {"ev": "I", "name": "llm.admit", "trace": tid, "span": "i1",
+         "ts": 1.6, "pid": 2, "file": "trace-h-2.jsonl"},
+        {"ev": "B", "name": "other", "trace": "zz" * 16, "span": "zz",
+         "ts": 0.5},
+    ]
+    traces = group_traces(events)
+    assert set(traces) == {tid, "zz" * 16}
+    tl = build_timeline(traces[tid])
+    assert [e["name"] for e in tl] == [
+        "client.generate", "server.generate", "llm.admit",
+        "llm.decode"]
+    by = {e["name"]: e for e in tl}
+    assert by["server.generate"]["open"] is False
+    assert by["server.generate"]["dur_s"] == 0.5
+    assert by["llm.decode"]["open"] is True  # the killed replica
+    assert by["llm.decode"]["dur_s"] is None
+    chrome = to_chrome_trace(tl, trace_id=tid)
+    xs = [e for e in chrome["traceEvents"] if e.get("ph") == "X"]
+    assert any("[open]" in e["name"] for e in xs)
+    # one pid row per source process + the metadata naming them
+    assert len({e["pid"] for e in xs}) == 3
+    assert chrome["otherData"]["trace_id"] == tid
+    text = render_text(tl)
+    assert "OPEN" in text and "client.generate" in text
+
+
+def test_trace_timeline_cli(tmp_path):
+    d = str(tmp_path / "t")
+    obs.trace_to(d)
+    tid = "cd" * 16
+    try:
+        with trace_context(tid):
+            with obs.span("cli.work"):
+                pass
+    finally:
+        obs.stop_tracing()
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(root, "scripts", "trace_timeline.py")
+    out = subprocess.run([sys.executable, script, d, "--list"],
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0 and tid in out.stdout
+    chrome_path = str(tmp_path / "chrome.json")
+    out = subprocess.run(
+        [sys.executable, script, d, "--trace", tid, "--chrome",
+         chrome_path], capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stdout + out.stderr
+    data = json.load(open(chrome_path))
+    assert any(e.get("name", "").startswith("cli.work")
+               for e in data["traceEvents"])
+
+
+# ------------------------------------------------------ flight recorder
+
+def test_flight_ring_bounds_spill_and_bundle(fresh_flight, tmp_path):
+    rec = fresh_flight
+    for i in range(20):
+        rec.record("tick", i=i)
+    ring = rec.events()
+    assert len(ring) == 8  # capacity-bounded
+    assert ring[-1]["i"] == 19 and ring[0]["i"] == 12
+    # the spill kept EVERYTHING (it is the SIGKILL postmortem)
+    spilled = flight_mod.read_spill(rec.spill_path)
+    assert [e["i"] for e in spilled] == list(range(20))
+    # torn spill tail parses to the intact prefix
+    with open(rec.spill_path, "ab") as f:
+        f.write(b'{"ts": 1, "kind": "to')
+    assert len(flight_mod.read_spill(rec.spill_path)) == 20
+    # the bundle: ring + metrics + config + a reason
+    path = rec.dump("unit-test")
+    assert path is not None and os.path.exists(path)
+    bundle = json.load(open(path))
+    assert bundle["reason"] == "unit-test"
+    assert [e["i"] for e in bundle["ring"]] == list(range(12, 20))
+    assert "counters" in bundle["metrics"]
+    assert any(k.startswith("ZOO_") for k in bundle["config"])
+
+
+def test_flight_disabled_costs_nothing(monkeypatch):
+    monkeypatch.setenv("ZOO_OBS_FLIGHT_CAP", "0")
+    flight_mod.reset_for_tests()
+    try:
+        rec = flight_mod.flight_recorder()
+        rec.record("x")
+        assert rec.events() == []
+        assert rec.dump("x") is None or True  # no spill dir armed
+    finally:
+        monkeypatch.delenv("ZOO_OBS_FLIGHT_CAP")
+        flight_mod.reset_for_tests()
+
+
+def test_breaker_and_retry_feed_flight_ring(fresh_flight):
+    from zoo_tpu.util.resilience import (
+        CircuitBreaker,
+        RetryError,
+        RetryPolicy,
+    )
+    br = CircuitBreaker(failure_threshold=1, recovery_timeout=60)
+    br.record_failure()
+    pol = RetryPolicy(max_attempts=2, sleep=lambda s: None)
+
+    def dead():
+        raise ConnectionError("down")
+
+    with pytest.raises(RetryError):
+        pol.call(dead)
+    kinds = [e["kind"] for e in fresh_flight.events()]
+    assert "breaker_open" in kinds and "retry_giveup" in kinds
+
+
+def test_replica_group_harvests_dead_spill(tmp_path):
+    """A spill file whose pid is not the live replica (the SIGKILL
+    leftovers) is packaged into a group-dir bundle, torn tail and
+    all."""
+    from zoo_tpu.serving.ha import ReplicaGroup
+    log_dir = str(tmp_path / "group")
+    group = ReplicaGroup("synthetic:double", num_replicas=1,
+                         log_dir=log_dir)  # never started: no processes
+    fdir = os.path.join(log_dir, "flight", "replica-0")
+    os.makedirs(fdir)
+    with open(os.path.join(fdir, "flight-99999.jsonl"), "w") as f:
+        f.write(json.dumps({"ts": 1.0, "kind": "shed",
+                            "reason": "queue_full"}) + "\n")
+        f.write(json.dumps({"ts": 2.0, "kind": "engine_tick"}) + "\n")
+        f.write('{"ts": 3.0, "kind": "to')  # torn by the kill
+    harvested = group.harvest_postmortems()
+    assert len(harvested) == 1
+    bundle = json.load(open(harvested[0]))
+    assert bundle["reason"] == "harvested" and bundle["pid"] == 99999
+    assert [e["kind"] for e in bundle["ring"]] == ["shed",
+                                                   "engine_tick"]
+    assert not os.path.exists(os.path.join(fdir,
+                                           "flight-99999.jsonl"))
+    assert group.harvest_postmortems() == []  # idempotent
+
+
+def test_crash_handler_dumps_on_excepthook(tmp_path, monkeypatch):
+    """The unhandled-exception path, end to end in a subprocess."""
+    pm = str(tmp_path / "pm")
+    code = (
+        "import os\n"
+        "os.environ['ZOO_OBS_POSTMORTEM_DIR'] = r'%s'\n"
+        "from zoo_tpu.obs.flight import install_crash_handlers, "
+        "record_event\n"
+        "install_crash_handlers()\n"
+        "record_event('about_to_die', step=7)\n"
+        "raise RuntimeError('boom')\n" % pm)
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode != 0 and "boom" in proc.stderr
+    bundles = [f for f in os.listdir(pm)
+               if f.startswith("postmortem-")]
+    assert bundles, os.listdir(pm)
+    bundle = json.load(open(os.path.join(pm, bundles[0])))
+    assert bundle["reason"] == "unhandled_exception"
+    kinds = [e["kind"] for e in bundle["ring"]]
+    assert "about_to_die" in kinds and "unhandled_exception" in kinds
+
+
+# --------------------------------------------------------- SLO watchdog
+
+def _mk_registry():
+    r = obs.MetricsRegistry()
+    req = r.counter("zoo_serving_requests_total", "x",
+                    labels=("outcome",))
+    return r, req
+
+
+def test_slo_watchdog_breach_and_clear(fresh_flight):
+    from zoo_tpu.obs.slo import _error_rate, last_status
+    r, req = _mk_registry()
+    w = SLOWatchdog(
+        rules=[SLORule("error_rate", _error_rate, 0.1)],
+        registry=r, window_s=0.0, interval_s=60.0)
+    req.labels(outcome="ok").inc(10)
+    s0 = w.evaluate()  # first pass: delta vs itself, no verdict
+    assert s0["ok"] and "measured" not in s0["rules"]["error_rate"]
+    req.labels(outcome="ok").inc(5)
+    req.labels(outcome="error").inc(5)
+    s1 = w.evaluate()
+    rule = s1["rules"]["error_rate"]
+    assert not s1["ok"] and s1["breaches"] == ["error_rate"]
+    assert abs(rule["measured"] - 0.5) < 1e-9
+    assert abs(rule["burn_rate"] - 5.0) < 1e-9
+    assert last_status() is s1
+    # quiet window: the breach clears, and both edges hit the ring
+    w.evaluate()
+    s2 = w.evaluate()
+    assert s2["ok"]
+    kinds = [e["kind"] for e in fresh_flight.events()]
+    assert "slo_breach" in kinds and "slo_clear" in kinds
+
+
+def test_slo_quantile_and_floor_rules():
+    from zoo_tpu.obs.slo import quantile_from_counts
+    assert quantile_from_counts([0.1, 1.0], [0, 0, 0], 0.99) is None
+    assert quantile_from_counts([0.1, 1.0], [98, 1, 1], 0.5) == 0.1
+    assert quantile_from_counts([0.1, 1.0], [0, 0, 5], 0.99) == 1.0
+    # floor rule: accept-rate below the floor burns
+    rule = SLORule("accept", lambda d, l: 0.2, 0.4, floor=True)
+    measured, burn = rule.evaluate({}, {})
+    assert measured == 0.2 and abs(burn - 2.0) < 1e-9
+
+
+def test_slo_env_rules_and_healthz(monkeypatch):
+    monkeypatch.setenv("ZOO_SLO_ERROR_RATE", "0.25")
+    monkeypatch.setenv("ZOO_SLO_TTFT_P99_S", "0.5")
+    from zoo_tpu.obs.slo import _set_status, default_rules
+    rules = default_rules()
+    assert sorted(r.name for r in rules) == ["error_rate", "ttft_p99"]
+    # /healthz attaches the last verdict; 200 by default on a breach,
+    # 503 only under the explicit opt-in
+    import urllib.error
+    import urllib.request
+    monkeypatch.delenv("ZOO_HEARTBEAT_FILE", raising=False)
+    _set_status({"ok": False, "breaches": ["error_rate"], "rules": {}})
+    ex = obs.MetricsExporter(registry=obs.MetricsRegistry()).start()
+    try:
+        with urllib.request.urlopen(ex.url + "/healthz",
+                                    timeout=10) as resp:
+            body = json.loads(resp.read().decode())
+        assert body["ok"] is True
+        assert body["slo"]["breaches"] == ["error_rate"]
+        monkeypatch.setenv("ZOO_SLO_FAIL_HEALTHZ", "1")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(ex.url + "/healthz", timeout=10)
+        assert ei.value.code == 503
+    finally:
+        ex.stop()
+        _set_status(None)
+
+
+def test_promotion_gate_slo_veto():
+    from zoo_tpu.obs.slo import _set_status
+    from zoo_tpu.orca.learn.continuous import PromotionGate
+    rng = np.random.RandomState(0)
+    gate = PromotionGate(lambda x: x, lambda x: x, candidate="v2",
+                         sample=1.0, window=1, rng=rng,
+                         max_latency_ratio=1e9)  # not under test:
+    # single-sample p50 ratios are scheduler noise
+    gate.offer(np.ones(2))
+    assert gate.ready()
+    _set_status({"ok": False, "breaches": ["ttft_p99"], "rules": {}})
+    try:
+        d = gate.decision()
+        assert not d.promoted and "SLO" in d.reason
+    finally:
+        _set_status(None)
+    assert gate.decision().promoted
+
+
+# ------------------------------------------- shed replies echo the trace
+
+def test_shed_reply_echoes_trace_id(fresh_flight):
+    """Regression (the old bug): a queue-full shed short-circuits
+    before request bookkeeping, but its reply must still carry the
+    request's trace id — rejected requests are traceable too."""
+    from zoo_tpu.serving.server import ServingServer
+    from zoo_tpu.serving.tcp_client import _Connection
+
+    release = threading.Event()
+
+    class _Block:
+        def predict(self, x, batch_size=None):
+            release.wait(timeout=15)
+            return np.asarray(x) * 2.0
+
+    srv = ServingServer(_Block(), port=0, batch_size=1,
+                        max_wait_ms=0.0, max_queue=1).start()
+    tid = "fe" * 16
+    x = np.zeros((1, 2), np.float32)
+
+    def fire_and_forget():
+        conn = _Connection(srv.host, srv.port)
+        try:
+            conn.rpc({"op": "predict", "uri": "u", "data": x})
+        finally:
+            conn.close()
+
+    try:
+        # request 1 occupies the (single) batcher, request 2 fills the
+        # bounded queue, request 3 must shed at the door
+        t1 = threading.Thread(target=fire_and_forget)
+        t1.start()
+        time.sleep(0.5)
+        t2 = threading.Thread(target=fire_and_forget)
+        t2.start()
+        time.sleep(0.3)
+        conn = _Connection(srv.host, srv.port)
+        resp = conn.rpc({"op": "predict", "uri": "u", "data": x,
+                         "trace": tid, "pspan": "ps" * 8})
+        conn.close()
+        assert resp.get("shed") and resp.get("retryable"), resp
+        assert resp.get("trace") == tid, resp
+        release.set()
+        t1.join(timeout=20)
+        t2.join(timeout=20)
+    finally:
+        release.set()
+        srv.stop()
+    # the shed also landed in the flight ring with its reason
+    sheds = [e for e in fresh_flight.events() if e["kind"] == "shed"]
+    assert any(e.get("reason") == "queue_full" for e in sheds)
+
+
+def test_debug_dump_wire_op(fresh_flight):
+    from zoo_tpu.serving.server import ServingServer
+    from zoo_tpu.serving.tcp_client import _Connection
+
+    class _M:
+        def predict(self, x, batch_size=None):
+            return np.asarray(x)
+
+    flight_mod.record_event("marker", n=1)
+    srv = ServingServer(_M(), port=0, batch_size=2,
+                        max_wait_ms=1.0).start()
+    try:
+        conn = _Connection(srv.host, srv.port)
+        resp = conn.rpc({"op": "debug_dump"})
+        conn.close()
+    finally:
+        srv.stop()
+    assert resp.get("ok")
+    bundle = resp["bundle"]
+    assert bundle["reason"] == "debug_dump"
+    assert any(e["kind"] == "marker" for e in bundle["ring"])
+    assert "counters" in bundle["metrics"]
+
+
+# ------------------------------------------------------ the chaos smoke
+
+def test_check_trace_e2e_script_runs():
+    """The 3-replica hedged-generate SIGKILL smoke
+    (scripts/check_trace_e2e.py): one trace id reconstructs the whole
+    request across the kill, the dead replica's postmortem is
+    harvested, zero client-visible failures — as a subprocess, the
+    operator invocation."""
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join("scripts", "check_trace_e2e.py")],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-4000:]
+    assert "TRACE E2E OK" in proc.stdout

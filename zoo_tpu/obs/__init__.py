@@ -16,6 +16,16 @@ pieces:
 * :mod:`zoo_tpu.obs.aggregate`  — workers publish snapshots into the KV
   store; the merge sums counters, max/mins gauges, bucket-merges
   histograms into one cluster view.
+* :mod:`zoo_tpu.obs.timeline`   — joins the fleet's per-process trace
+  files by request trace id into one per-request timeline
+  (Chrome-trace / text rendering; ``scripts/trace_timeline.py``).
+* :mod:`zoo_tpu.obs.flight`     — crash flight recorder: bounded ring
+  of recent structured events, continuously spilled to disk, dumped as
+  a postmortem bundle on crash/preemption (and served live over the
+  serving wire as ``op=debug_dump``).
+* :mod:`zoo_tpu.obs.slo`        — SLO watchdog: rolling-window
+  burn-rate evaluation over the registry (``zoo_slo_*`` gauges,
+  breach events into the flight ring, ``/healthz`` attachment).
 
 Every layer of the stack records here: retries/breakers/fault trips
 (``util.resilience``), checkpoint save/restore/verify
@@ -43,12 +53,18 @@ from zoo_tpu.obs.metrics import (  # noqa: F401
 )
 from zoo_tpu.obs.tracing import (  # noqa: F401
     TRACE_DIR_ENV,
+    ambient_trace_id,
+    current_span_id,
     current_trace_id,
+    emit_event,
+    emit_span,
+    new_trace_id,
     read_trace,
     set_trace_id,
     share_trace_id,
     span,
     stop_tracing,
+    trace_context,
     trace_to,
     tracing_enabled,
 )
@@ -63,13 +79,33 @@ from zoo_tpu.obs.aggregate import (  # noqa: F401
     last_cluster_view,
     merge_snapshots,
 )
+from zoo_tpu.obs.flight import (  # noqa: F401
+    FlightRecorder,
+    dump_bundle,
+    flight_recorder,
+    install_crash_handlers,
+    record_event,
+)
+from zoo_tpu.obs.slo import SLORule, SLOWatchdog  # noqa: F401
+from zoo_tpu.obs.slo import last_status as slo_last_status  # noqa: F401
+from zoo_tpu.obs.timeline import (  # noqa: F401
+    build_timeline,
+    merge_timeline,
+    to_chrome_trace,
+)
 
 __all__ = [
     "DEFAULT_BUCKETS", "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "StatTimer", "counter", "gauge", "get_registry", "histogram",
     "TRACE_DIR_ENV", "current_trace_id", "read_trace", "set_trace_id",
     "share_trace_id", "span", "stop_tracing", "trace_to", "tracing_enabled",
+    "trace_context", "ambient_trace_id", "current_span_id",
+    "new_trace_id", "emit_span", "emit_event",
     "MetricsExporter", "start_snapshot_thread", "validate_prometheus_text",
     "write_snapshot",
     "aggregate_cluster", "last_cluster_view", "merge_snapshots",
+    "FlightRecorder", "flight_recorder", "record_event", "dump_bundle",
+    "install_crash_handlers",
+    "SLORule", "SLOWatchdog", "slo_last_status",
+    "build_timeline", "merge_timeline", "to_chrome_trace",
 ]

@@ -93,6 +93,22 @@ class MetricsExporter:
                         health = _heartbeat_health(outer._stale_after)
                     except Exception as e:  # noqa: BLE001 — probe, not crash
                         health = {"ok": False, "error": repr(e)}
+                    # attach the SLO watchdog's last verdict (when one
+                    # runs in this process) so group health probes see
+                    # burn-rate breaches; a breach only turns the
+                    # probe 503 under the explicit ZOO_SLO_FAIL_HEALTHZ
+                    # opt-in — an SLO burn is an alert, not a death
+                    try:
+                        from zoo_tpu.obs.slo import last_status
+                        slo = last_status()
+                        if slo is not None:
+                            health["slo"] = slo
+                            if not slo.get("ok", True) and \
+                                    os.environ.get(
+                                        "ZOO_SLO_FAIL_HEALTHZ") == "1":
+                                health["ok"] = False
+                    except Exception:  # noqa: BLE001 — probe, not crash
+                        pass
                     self._reply(200 if health.get("ok") else 503,
                                 json.dumps(health).encode(),
                                 "application/json")

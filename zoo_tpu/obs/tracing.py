@@ -18,6 +18,17 @@ shared id.
 Tracing is off until a sink exists: call :func:`trace_to` or set
 ``$ZOO_TRACE_DIR``. A disabled :func:`span` costs one global check and a
 no-op context manager — safe to leave in hot paths.
+
+Request-scoped tracing (docs/observability.md): a serving client mints
+one trace id per logical request and it rides the wire; the server
+adopts it with :func:`trace_context`, so every span recorded while
+handling that request — on any process of the fleet — carries the
+REQUEST's trace id instead of the process-wide one, and
+``zoo_tpu.obs.timeline`` joins the per-process JSONL files back into
+one per-request timeline. :func:`emit_span` / :func:`emit_event` write
+complete ("X") and instant ("I") events with an EXPLICIT trace id for
+code that works on behalf of many requests at once (the LLM engine's
+scheduler thread, the batcher) where thread-local nesting cannot apply.
 """
 
 from __future__ import annotations
@@ -38,6 +49,9 @@ __all__ = [
     "span", "trace_to", "stop_tracing", "tracing_enabled",
     "current_trace_id", "set_trace_id", "share_trace_id",
     "read_trace", "TRACE_DIR_ENV",
+    "trace_context", "ambient_trace_id", "current_span_id",
+    "new_trace_id", "emit_span", "emit_event", "active_spans",
+    "iter_jsonl", "trace_file_path",
 ]
 
 logger = logging.getLogger(__name__)
@@ -49,6 +63,13 @@ _sink = None            # type: Optional[_TraceLog]
 _env_checked = False
 _trace_id: Optional[str] = None
 _tls = threading.local()  # .stack: span-id stack per thread
+#                           .trace: request trace-id override per thread
+# spans begun but not yet ended, across every thread — what a crash
+# flight-recorder bundle captures as "where was this process when it
+# died". Only mutated while a sink exists (span() returns early when
+# tracing is off), so the disabled hot path never touches it.
+_live_spans: dict = {}
+_live_lock = threading.Lock()
 
 
 class _TraceLog:
@@ -125,15 +146,71 @@ def tracing_enabled() -> bool:
     return _active_sink() is not None
 
 
+def trace_file_path() -> Optional[str]:
+    """This process's trace file path (None while tracing is off)."""
+    sink = _active_sink()
+    return sink.path if sink is not None else None
+
+
 # ------------------------------------------------------------- trace ids
 
+def new_trace_id() -> str:
+    """A fresh request-scoped trace id (what a serving client mints per
+    logical request before putting it on the wire)."""
+    return uuid.uuid4().hex
+
+
 def current_trace_id() -> str:
-    """This process's trace id (minted on first use)."""
+    """The ACTIVE trace id: the thread's adopted request trace inside a
+    :func:`trace_context`, else this process's own id (minted on first
+    use)."""
+    tid = getattr(_tls, "trace", None)
+    if tid is not None:
+        return tid
     global _trace_id
     with _lock:
         if _trace_id is None:
             _trace_id = uuid.uuid4().hex
         return _trace_id
+
+
+def ambient_trace_id() -> Optional[str]:
+    """The thread's adopted REQUEST trace id, or None outside any
+    :func:`trace_context` (never mints; the wire stamps only explicit
+    request traces, not the ambient process id)."""
+    return getattr(_tls, "trace", None)
+
+
+def current_span_id() -> Optional[str]:
+    """The innermost open span id on this thread (for parenting a
+    remote child over the wire), or None."""
+    st = getattr(_tls, "stack", None)
+    return st[-1] if st else None
+
+
+@contextlib.contextmanager
+def trace_context(trace_id: Optional[str],
+                  parent_span: Optional[str] = None) -> Iterator[None]:
+    """Adopt ``trace_id`` for this thread: every :func:`span` inside
+    carries the request's trace id (and parents under ``parent_span``,
+    the caller's span id from the wire) instead of the process-wide
+    trace. ``trace_id=None`` is a no-op passthrough, so wire handlers
+    can wrap unconditionally."""
+    if trace_id is None:
+        yield
+        return
+    prev = getattr(_tls, "trace", None)
+    _tls.trace = str(trace_id)
+    st = _stack()
+    pushed = parent_span is not None
+    if pushed:
+        st.append(str(parent_span))
+    try:
+        yield
+    finally:
+        if pushed:
+            st.pop()
+        _tls.trace = prev
 
 
 def set_trace_id(trace_id: str):
@@ -201,6 +278,8 @@ def span(name: str, **attrs) -> Iterator[Optional[str]]:
     if attrs:
         ev["attrs"] = attrs
     sink.write(ev)
+    with _live_lock:
+        _live_spans[sid] = ev
     st.append(sid)
     t0 = time.perf_counter()
     ok = True
@@ -211,29 +290,106 @@ def span(name: str, **attrs) -> Iterator[Optional[str]]:
         raise
     finally:
         st.pop()
+        with _live_lock:
+            _live_spans.pop(sid, None)
         sink.write({"ev": "E", "name": name,
                     "trace": ev["trace"], "span": sid,
                     "ts": time.time(),
                     "dur_s": time.perf_counter() - t0, "ok": ok})
 
 
+def active_spans() -> List[dict]:
+    """Begin events of every span currently OPEN in this process (any
+    thread) — the "where was it" a flight-recorder bundle captures."""
+    with _live_lock:
+        return list(_live_spans.values())
+
+
+def emit_span(name: str, ts: float, dur_s: float,
+              trace: Optional[str] = None,
+              parent: Optional[str] = None, ok: bool = True,
+              span_id: Optional[str] = None,
+              **attrs) -> Optional[str]:
+    """Write one COMPLETE ("X") span event: started at wall ``ts``,
+    lasted ``dur_s``. For recorders that time a region themselves on
+    behalf of a specific request (the engine's scheduler working a
+    stream, a client attempt thread) where a nested :func:`span` cannot
+    carry the right identity. ``trace=None`` falls back to the active
+    trace id. Returns the span id (None while tracing is off)."""
+    sink = _active_sink()
+    if sink is None:
+        return None
+    sid = span_id if span_id is not None else uuid.uuid4().hex[:16]
+    ev = {"ev": "X", "name": name,
+          "trace": trace if trace is not None else current_trace_id(),
+          "span": sid, "parent": parent, "pid": os.getpid(),
+          "ts": ts, "dur_s": float(dur_s), "ok": bool(ok)}
+    if attrs:
+        ev["attrs"] = attrs
+    sink.write(ev)
+    return sid
+
+
+def emit_event(name: str, trace: Optional[str] = None,
+               parent: Optional[str] = None, **attrs) -> Optional[str]:
+    """Write one INSTANT ("I") event (admission, preemption, a shed —
+    things with a moment but no duration). Same identity rules as
+    :func:`emit_span`."""
+    sink = _active_sink()
+    if sink is None:
+        return None
+    sid = uuid.uuid4().hex[:16]
+    ev = {"ev": "I", "name": name,
+          "trace": trace if trace is not None else current_trace_id(),
+          "span": sid, "parent": parent, "pid": os.getpid(),
+          "ts": time.time()}
+    if attrs:
+        ev["attrs"] = attrs
+    sink.write(ev)
+    return sid
+
+
+def iter_jsonl(path: str) -> Iterator[dict]:
+    """Yield every parseable JSON object from a JSONL file, skipping
+    torn or truncated lines. A crash mid-write is an EXPECTED event for
+    trace files and flight-recorder spills (a SIGKILL can land between
+    any two bytes), so a half-written tail, an interleaved torn line,
+    or invalid UTF-8 from a partial flush must never take the readable
+    prefix down with it. A missing/unreadable file yields nothing."""
+    try:
+        f = open(path, encoding="utf-8", errors="replace")
+    except OSError:
+        return
+    with f:
+        while True:
+            try:
+                line = f.readline()
+            except (OSError, ValueError):
+                return  # unreadable remainder: keep what we have
+            if not line:
+                return
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue  # torn write: skip, keep the rest
+            if isinstance(obj, dict):
+                yield obj
+
+
 def read_trace(dir_path: str) -> List[dict]:
     """Load every span event under ``dir_path`` (all hosts' files),
-    sorted by wall timestamp — the offline-analysis read-back."""
+    sorted by wall timestamp — the offline-analysis read-back. Torn or
+    truncated lines (a replica killed mid-write) are skipped, never
+    raised."""
     events: List[dict] = []
     if not os.path.isdir(dir_path):
         return events
     for fname in sorted(os.listdir(dir_path)):
         if not (fname.startswith("trace-") and fname.endswith(".jsonl")):
             continue
-        with open(os.path.join(dir_path, fname), encoding="utf-8") as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    events.append(json.loads(line))
-                except ValueError:
-                    continue  # torn tail write: skip, keep the rest
+        events.extend(iter_jsonl(os.path.join(dir_path, fname)))
     events.sort(key=lambda e: e.get("ts", 0.0))
     return events

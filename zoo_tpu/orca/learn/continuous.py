@@ -107,10 +107,17 @@ class PromotionGate:
                  max_latency_ratio: Optional[float] = None,
                  max_loss_ratio: Optional[float] = None,
                  loss_fn: Optional[Callable] = None,
-                 rng: Optional[np.random.RandomState] = None):
+                 rng: Optional[np.random.RandomState] = None,
+                 slo_veto: bool = True):
         self._incumbent = incumbent
         self._canary = canary
         self.candidate = candidate
+        # SLO veto (docs/observability.md): when the in-process SLO
+        # watchdog reports an active burn-rate breach at decision
+        # time, the gate refuses to promote — never move the prod
+        # alias while the serving fleet is already missing its
+        # objectives. No watchdog running = no veto.
+        self.slo_veto = bool(slo_veto)
         self.registry = registry
         self.alias = alias
         self.canary_alias = canary_alias
@@ -198,6 +205,18 @@ class PromotionGate:
         if s["mirrored"] < self.window:
             return GateDecision(False, "window not filled "
                                 f"({s['mirrored']}/{self.window})", s)
+        if self.slo_veto:
+            try:
+                from zoo_tpu.obs.slo import last_status
+                slo = last_status()
+            except Exception:  # noqa: BLE001 — no watchdog, no veto
+                slo = None
+            if slo is not None and not slo.get("ok", True):
+                s["slo"] = slo
+                return GateDecision(
+                    False, "SLO watchdog reports an active breach "
+                    f"({', '.join(slo.get('breaches', []))}); "
+                    "refusing to promote into a burning fleet", s)
         if s["canary_error_rate"] > self.max_error_rate:
             return GateDecision(
                 False, f"canary error rate {s['canary_error_rate']:.1%} "

@@ -588,6 +588,17 @@ class TrainingGuard:
             _preempt_ckpts.inc()
         self._journal({"event": "preempt_checkpoint", "step": int(step),
                        "saved": saved, "rank": pid})
+        # flight-recorder postmortem on the way out: the rc-75 exit is
+        # deliberate, but the bundle (recent events + metrics + config)
+        # is what explains the preemption window afterwards. Best
+        # effort — a dump failure must never block the exit protocol.
+        try:
+            from zoo_tpu.obs.flight import dump_bundle, record_event
+            record_event("preempt_exit", step=int(step), saved=saved,
+                         rank=pid)
+            dump_bundle("preempt-rc75")
+        except Exception:  # noqa: BLE001
+            pass
         logger.warning(
             "%s: preemption checkpoint at step %d complete; exiting "
             "with code %d (resume-don't-retry)", self.name, step,

@@ -25,6 +25,7 @@ from zoo_tpu.serving.client import (
     decode_input_b64,
     encode_ndarray_b64,
 )
+from zoo_tpu.obs.tracing import emit_span, trace_context
 from zoo_tpu.serving.resp import RedisClient, RedisError
 from zoo_tpu.serving.server import StageTimer, _deadline_expired
 from zoo_tpu.util.resilience import Deadline
@@ -143,6 +144,7 @@ class FrontEnd:
                 pass
 
             def do_GET(self):
+                self._trace = None  # never echo a prior POST's trace
                 if self.path.rstrip("/") in ("", "/"):
                     self._reply(200, {"status": "ok"})
                 elif self.path.startswith("/metrics"):
@@ -154,6 +156,23 @@ class FrontEnd:
                 if not self.path.startswith("/predict"):
                     self._reply(404, {"error": "not found"})
                     return
+                # trace propagation over HTTP (docs/observability.md):
+                # X-Zoo-Trace adopts the caller's request trace for
+                # everything this handler does (the queue predict below
+                # stamps it on its own wire frames via the ambient
+                # context) and is echoed on EVERY reply — the expired
+                # 504 included, so rejected requests stay traceable
+                self._trace = self.headers.get("X-Zoo-Trace")
+                pspan = self.headers.get("X-Zoo-Parent-Span")
+                with trace_context(self._trace, pspan):
+                    t0 = time.time()
+                    self._do_predict()
+                    if self._trace is not None:
+                        emit_span("http.predict", t0,
+                                  time.time() - t0, trace=self._trace,
+                                  parent=pspan)
+
+            def _do_predict(self):
                 # deadline propagation over HTTP (docs/serving_ha.md):
                 # the remaining budget arrives as a header and is
                 # enforced before any instance is computed — expired
@@ -200,6 +219,9 @@ class FrontEnd:
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(payload)))
+                trace = getattr(self, "_trace", None)
+                if trace is not None:
+                    self.send_header("X-Zoo-Trace", trace)
                 self.end_headers()
                 self.wfile.write(payload)
 

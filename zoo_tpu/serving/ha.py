@@ -231,6 +231,15 @@ class ReplicaGroup:
                 wenv.get("PYTHONPATH", "")
             hb = os.path.join(log_dir, f"replica-{i}.hb") if log_dir \
                 else None
+            if log_dir:
+                # per-replica flight-recorder dir: the replica spills
+                # its event ring there continuously (a SIGKILL cannot
+                # be caught — the spill IS its postmortem) and dumps
+                # full bundles there on catchable deaths;
+                # harvest_postmortems() packages both into the group
+                # dir (docs/observability.md)
+                wenv["ZOO_OBS_POSTMORTEM_DIR"] = os.path.join(
+                    log_dir, "flight", f"replica-{i}")
             workers.append(WorkerProcess(
                 cmd=[sys.executable, "-m", "zoo_tpu.serving.replica",
                      "--model", model, "--host", host,
@@ -299,7 +308,17 @@ class ReplicaGroup:
     def healthz(self, timeout: float = 2.0) -> List[Optional[Dict]]:
         """Probe every replica's obs ``/healthz`` door; ``None`` for a
         replica that did not answer. Publishes the
-        ``zoo_serve_replicas_healthy`` gauge and the restart tally."""
+        ``zoo_serve_replicas_healthy`` gauge and the restart tally.
+        The body carries each replica's last SLO-watchdog verdict when
+        one is running (``"slo"`` key, docs/observability.md), so the
+        supervisor's probe sees burn-rate breaches, not just liveness.
+        Also sweeps dead replicas' flight-recorder remains into the
+        group's postmortem dir (best-effort, same cadence as the
+        probes)."""
+        try:
+            self.harvest_postmortems()
+        except Exception:  # noqa: BLE001 — probing must never fail on
+            pass           # a harvest hiccup
         out: List[Optional[Dict]] = []
         for mport in self.metrics_ports:
             try:
@@ -316,6 +335,84 @@ class ReplicaGroup:
 
     def restarts(self) -> int:
         return sum(w.restarts for w in self._monitor.workers)
+
+    # -- postmortem harvest (docs/observability.md) ------------------------
+    def _flight_dir(self, i: int) -> Optional[str]:
+        if not self.log_dir:
+            return None
+        return os.path.join(self.log_dir, "flight", f"replica-{i}")
+
+    def postmortem_dir(self) -> Optional[str]:
+        """Where harvested bundles land: ``<log_dir>/postmortems``."""
+        if not self.log_dir:
+            return None
+        return os.path.join(self.log_dir, "postmortems")
+
+    def harvest_postmortems(self) -> List[str]:
+        """Collect dead replicas' flight-recorder output into the group
+        dir. Two kinds of remains: full postmortem bundles (dumped on
+        catchable deaths — unhandled exception, SIGTERM, rc-75
+        preemption) are moved as-is; orphan spill files (``flight-
+        <pid>.jsonl`` whose pid is not the live replica — the SIGKILL
+        case, where no handler could run) are packaged into a bundle
+        with whatever events were flushed before death, torn tail
+        skipped. Idempotent; returns the new bundle paths. Requires a
+        ``log_dir`` (no dir = recorder was never armed)."""
+        out_dir = self.postmortem_dir()
+        if out_dir is None:
+            return []
+        from zoo_tpu.obs.flight import read_spill
+        harvested: List[str] = []
+        for i in range(self.num_replicas):
+            fdir = self._flight_dir(i)
+            if not fdir or not os.path.isdir(fdir):
+                continue
+            w = self._monitor.workers[i]
+            live_pid = w.proc.pid if w.proc is not None and \
+                w.proc.poll() is None else None
+            for fname in sorted(os.listdir(fdir)):
+                src = os.path.join(fdir, fname)
+                if fname.startswith("postmortem-") and \
+                        fname.endswith(".json"):
+                    os.makedirs(out_dir, exist_ok=True)
+                    dst = os.path.join(out_dir,
+                                       f"replica-{i}-{fname}")
+                    try:
+                        os.replace(src, dst)
+                        harvested.append(dst)
+                    except OSError:
+                        pass
+                    continue
+                if not (fname.startswith("flight-") and
+                        fname.endswith(".jsonl")):
+                    continue
+                try:
+                    pid = int(fname[len("flight-"):-len(".jsonl")])
+                except ValueError:
+                    continue
+                if pid == live_pid:
+                    continue  # the live replica's own spill
+                ring = read_spill(src)
+                bundle = {"reason": "harvested", "pid": pid,
+                          "replica": i, "ts": time.time(),
+                          "note": "process died without dumping (e.g. "
+                                  "SIGKILL); ring reconstructed from "
+                                  "the continuous spill, torn tail "
+                                  "skipped",
+                          "ring": ring}
+                os.makedirs(out_dir, exist_ok=True)
+                dst = os.path.join(
+                    out_dir, f"replica-{i}-postmortem-pid{pid}.json")
+                try:
+                    tmp = dst + ".tmp"
+                    with open(tmp, "w", encoding="utf-8") as f:
+                        json.dump(bundle, f, default=str)
+                    os.replace(tmp, dst)
+                    os.remove(src)
+                    harvested.append(dst)
+                except OSError:
+                    pass
+        return harvested
 
     def alive(self) -> List[str]:
         return self._monitor.alive()

@@ -25,6 +25,15 @@ Every request carries one id and one :class:`Deadline` end to end; the
 client re-stamps the *remaining* budget into each attempt, and raises
 :class:`DeadlineExceeded` the moment the budget is gone rather than
 letting attempts pile past it.
+
+Every logical request also carries ONE trace id end to end
+(docs/observability.md): the client mints it (or adopts
+``trace_id=``), stamps it on every attempt's wire frame — hedged
+duplicates and failover resumes included — and records each attempt as
+a sibling span under one per-request root span, so the timeline merger
+reconstructs the whole request (client attempts + every replica's
+server/engine spans) from the fleet's per-process trace files, across
+a mid-stream replica kill.
 """
 
 from __future__ import annotations
@@ -32,6 +41,7 @@ from __future__ import annotations
 import os
 import queue as _queue
 import random
+import sys
 import threading
 import time
 import uuid
@@ -40,6 +50,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from zoo_tpu.obs.metrics import counter, histogram
+from zoo_tpu.obs.tracing import emit_span, new_trace_id
 from zoo_tpu.serving.tcp_client import _Connection
 from zoo_tpu.util.resilience import (
     CircuitBreaker,
@@ -324,7 +335,8 @@ class HAServingClient:
                  top_k: Optional[int] = None,
                  top_p: Optional[float] = None,
                  seed: Optional[int] = None,
-                 spec_k: Optional[int] = None):
+                 spec_k: Optional[int] = None,
+                 trace_id: Optional[str] = None):
         """Stream one generation over the replica group: yields tokens
         (ints) as frames arrive. ``temperature``/``top_k``/``top_p``/
         ``seed`` select on-device sampling (unset = greedy, or the
@@ -337,6 +349,9 @@ class HAServingClient:
         drafting for this stream); speculative or not, the token
         stream is byte-identical, so failover may freely land a
         resumed stream on a replica with a different budget.
+        ``trace_id`` adopts a caller-minted trace id for the stream
+        (default: mint one); it rides every attempt's wire frame and
+        the replicas' spans join under it (docs/observability.md).
 
         The PR 5 contracts, applied per stream:
 
@@ -360,6 +375,13 @@ class HAServingClient:
         """
         import numpy as _np
         rid = uuid.uuid4().hex
+        # one trace id for the whole logical stream (every attempt —
+        # retries, hedges, failover resumes — is a sibling span under
+        # this request's root; ``trace_id=`` adopts a caller's)
+        tid = trace_id if trace_id is not None else new_trace_id()
+        root_sid = uuid.uuid4().hex[:16]
+        t_req = time.perf_counter()
+        t_req_wall = time.time()
         dl = Deadline.from_ms(
             deadline_ms if deadline_ms is not None else self.deadline_ms)
         use_hedge = self.hedge if hedge is None else bool(hedge)
@@ -377,24 +399,40 @@ class HAServingClient:
 
         def fire(ep: _Endpoint, is_hedge: bool = False):
             att = {"ep": ep, "stop": threading.Event(), "conn": None,
-                   "hedge": is_hedge, "dead": False}
+                   "hedge": is_hedge, "dead": False,
+                   "resume_from": received}
             attempts.append(att)
 
             def run():
                 # exactly ONE terminal event per attempt ("err"/"end"),
                 # stopped or not — the arbiter's in_flight counter
-                # depends on it
+                # depends on it. Each attempt records ONE sibling span
+                # under the request's root: the timeline then shows the
+                # original, the hedge, and every failover resume side
+                # by side with the replicas they landed on.
+                t0, t0w = time.perf_counter(), time.time()
+
+                def att_span(outcome: str, ok: bool):
+                    emit_span("client.attempt", t0w,
+                              time.perf_counter() - t0, trace=tid,
+                              parent=root_sid, ok=ok, outcome=outcome,
+                              endpoint=f"{ep.host}:{ep.port}",
+                              hedge=is_hedge,
+                              resume_from=att["resume_from"])
+
                 try:
                     conn = ep.acquire()
                 except OSError as e:
                     ep.breaker.record_failure()
+                    att_span("connect_error", False)
                     results.put(("err", att, e))
                     return
                 att["conn"] = conn
                 msg = {"op": "generate", "id": rid,
                        "prompt": prompt,
                        "max_new_tokens": int(max_new_tokens),
-                       "resume_from": received}
+                       "resume_from": received,
+                       "trace": tid, "pspan": root_sid}
                 for key, val in (("temperature", temperature),
                                  ("top_k", top_k), ("top_p", top_p),
                                  ("seed", seed), ("spec_k", spec_k)):
@@ -412,9 +450,12 @@ class HAServingClient:
                             or isinstance(e, DeadlineExceeded)):
                         ep.breaker.record_failure()
                     ep.release(conn, healthy=False)
+                    att_span("transport_error", False)
                     results.put(("err", att, e))
                     return
                 ep.release(conn, healthy=not att["stop"].is_set())
+                att_span("stopped" if att["stop"].is_set() else "ok",
+                         True)
                 results.put(("end", att, None))
 
             threading.Thread(target=run, daemon=True,
@@ -567,6 +608,16 @@ class HAServingClient:
         finally:
             for att in attempts:
                 kill(att)
+            # the request's root span: one per logical stream, with
+            # the attempt count / hedge flag the tail-latency analysis
+            # wants (ok=False covers raised errors AND a caller that
+            # abandoned the generator mid-stream)
+            exc = sys.exc_info()[1]
+            emit_span("client.generate", t_req_wall,
+                      time.perf_counter() - t_req, trace=tid,
+                      span_id=root_sid, ok=exc is None, rid=rid,
+                      tokens=received, attempts=len(attempts),
+                      hedged=hedged)
 
     def stats(self) -> List[Optional[Dict]]:
         """Per-replica stage-timer stats (None for a down replica)."""
@@ -646,22 +697,41 @@ class HAServingClient:
             # stats/llm_stats/version probes must not pollute the
             # per-version series the promotion gate compares against
             return self._rpc_attempts(msg, deadline_ms, want)
+        # trace identity for the logical request: minted here (or
+        # adopted from the caller's explicit ``trace`` field), ridden
+        # by EVERY attempt, parented under one root span
+        tid = msg.get("trace") or new_trace_id()
+        root_sid = uuid.uuid4().hex[:16]
+        msg["trace"] = tid
+        msg["pspan"] = root_sid
         ab_label = want if want is not None else "unpinned"
         t_req = time.perf_counter()
+        t_req_wall = time.time()
+
+        def root_span(outcome: str, ok: bool):
+            emit_span("client.rpc", t_req_wall,
+                      time.perf_counter() - t_req, trace=tid,
+                      span_id=root_sid, ok=ok, op="predict",
+                      outcome=outcome, rid=msg.get("id"))
+
         try:
             resp = self._rpc_attempts(msg, deadline_ms, want)
         except DeadlineExceeded:
             _ab_requests.labels(version=ab_label,
                                 outcome="expired").inc()
+            root_span("expired", False)
             raise
         except Exception:
             _ab_requests.labels(version=ab_label, outcome="failed").inc()
+            root_span("failed", False)
             raise
         _ab_requests.labels(
             version=ab_label,
             outcome="error" if "error" in resp else "ok").inc()
         _ab_latency.labels(version=ab_label).observe(
             time.perf_counter() - t_req)
+        root_span("error" if "error" in resp else "ok",
+                  "error" not in resp)
         return resp
 
     def _rpc_attempts(self, msg: Dict, deadline_ms: Optional[float],
@@ -680,10 +750,25 @@ class HAServingClient:
 
             def run():
                 t0 = time.perf_counter()
+                t0w = time.time()
+
+                def att_span(outcome: str, ok: bool):
+                    # sibling attempt spans under the request root (a
+                    # traced predict stamped trace/pspan in rpc();
+                    # untraced ops — stats probes — skip entirely)
+                    if msg.get("trace") is not None:
+                        emit_span("client.attempt", t0w,
+                                  time.perf_counter() - t0,
+                                  trace=msg["trace"],
+                                  parent=msg.get("pspan"), ok=ok,
+                                  outcome=outcome,
+                                  endpoint=f"{ep.host}:{ep.port}")
+
                 try:
                     conn = ep.acquire()
                 except OSError as e:
                     ep.breaker.record_failure()
+                    att_span("connect_error", False)
                     results.put(("err", ep, e))
                     return
                 try:
@@ -698,9 +783,11 @@ class HAServingClient:
                         # RetryError wraps the underlying transport
                         # failure; either way the seat just failed
                         ep.breaker.record_failure()
+                    att_span("transport_error", False)
                     results.put(("err", ep, e))
                     return
                 ep.release(conn, healthy=True)
+                att_span("shed" if resp.get("shed") else "ok", True)
                 results.put(("ok", ep, resp, time.perf_counter() - t0))
 
             threading.Thread(target=run, daemon=True,

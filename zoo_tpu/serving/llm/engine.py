@@ -86,7 +86,9 @@ from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from zoo_tpu.obs.flight import record_event
 from zoo_tpu.obs.metrics import counter, gauge, histogram
+from zoo_tpu.obs.tracing import emit_event, emit_span
 from zoo_tpu.serving.llm.kv_cache import (
     BlockAllocator,
     prefix_block_hashes,
@@ -104,6 +106,19 @@ _steps = counter(
 _ttft = histogram(
     "zoo_llm_ttft_seconds",
     "Time from stream submission to its first generated token")
+# per-stream token-cadence families (docs/observability.md): tick-phase
+# timing says how busy the ENGINE is; these say what each REQUEST
+# experienced — the p99s the SLO watchdog burns against
+_inter_token = histogram(
+    "zoo_llm_inter_token_seconds",
+    "Gap between consecutive generated tokens of one stream, as "
+    "observed at the engine's readback (what a streaming client feels "
+    "between frames)")
+_stream_ttft = histogram(
+    "zoo_llm_stream_ttft_seconds",
+    "Per-stream time-to-first-token by final outcome (streams that "
+    "never produced a token observe their full lifetime under their "
+    "terminal outcome)", labels=("outcome",))
 _occupancy = gauge(
     "zoo_llm_slot_occupancy",
     "Decode slots holding a live sequence right now")
@@ -237,11 +252,19 @@ class GenHandle:
     def __init__(self, rid: str, prompt: np.ndarray, max_new: int,
                  deadline: Optional[Deadline],
                  sampling: Tuple[float, int, float, int] = None,
-                 spec_k: Optional[int] = None):
+                 spec_k: Optional[int] = None,
+                 trace_id: Optional[str] = None,
+                 parent_span: Optional[str] = None):
         self.id = rid
         self.prompt = np.asarray(prompt, np.int32)
         self.max_new = int(max_new)
         self.deadline = deadline
+        # request-scoped trace identity (rides the wire from the HA
+        # client): every engine lifecycle event for this stream is
+        # stamped with it, so the timeline merger can join this
+        # replica's work into the request's fleet-wide trace
+        self.trace_id = trace_id
+        self.parent_span = parent_span
         # per-stream speculative budget: None = the engine default,
         # 0 = no drafting for this stream (it still rides the verify
         # batch with an empty draft — plain decode), 1..k = a cap
@@ -259,7 +282,11 @@ class GenHandle:
         self.error: Optional[str] = None
         self.truncated = False
         self.created = time.perf_counter()
+        self.created_wall = time.time()
         self.first_token_at: Optional[float] = None
+        self.last_token_at: Optional[float] = None
+        self.admitted_at: Optional[float] = None
+        self.preempts = 0
         self.cancelled = threading.Event()
         self._cond = threading.Condition()
         self._subs = 0  # live server-side stream loops on this handle
@@ -286,9 +313,17 @@ class GenHandle:
     def push(self, tok: int):
         with self._cond:
             self.tokens.append(int(tok))
+            now = time.perf_counter()
             if self.first_token_at is None:
-                self.first_token_at = time.perf_counter()
-                _ttft.observe(self.first_token_at - self.created)
+                self.first_token_at = now
+                _ttft.observe(now - self.created)
+            else:
+                # per-stream cadence: the gap a streaming client felt
+                # between this frame and the previous one (readback
+                # path — preemption pauses and failover stalls land
+                # here, which is exactly the point)
+                _inter_token.observe(now - self.last_token_at)
+            self.last_token_at = now
             self._cond.notify_all()
 
     def finish(self, outcome: str, error: Optional[str] = None):
@@ -302,6 +337,18 @@ class GenHandle:
             self.lookup = None
             self._cond.notify_all()
         _streams.labels(outcome=outcome).inc()
+        now = time.perf_counter()
+        # ttft by outcome: a stream that died waiting observes its whole
+        # lifetime (the latency its caller actually paid for nothing)
+        _stream_ttft.labels(outcome=outcome).observe(
+            (self.first_token_at or now) - self.created)
+        record_event("llm_stream_end", rid=self.id, outcome=outcome,
+                     tokens=len(self.tokens), preempts=self.preempts,
+                     error=error)
+        emit_span("llm.stream", self.created_wall, now - self.created,
+                  trace=self.trace_id, parent=self.parent_span,
+                  ok=outcome == "ok", rid=self.id, outcome=outcome,
+                  tokens=len(self.tokens), preempts=self.preempts)
 
     def cancel(self):
         """Client-side abort (connection dropped, caller gone): the
@@ -504,8 +551,9 @@ class LLMEngine:
     def submit(self, prompt, max_new_tokens: int,
                rid: Optional[str] = None,
                deadline: Optional[Deadline] = None,
-               sampling=None, spec_k: Optional[int] = None
-               ) -> GenHandle:
+               sampling=None, spec_k: Optional[int] = None,
+               trace_id: Optional[str] = None,
+               parent_span: Optional[str] = None) -> GenHandle:
         """Queue one generation. ``sampling``: None (greedy, or the
         ``ZOO_LLM_SAMPLING`` deployment default), or a dict/string with
         ``temperature``/``top_k``/``top_p``/``seed`` — a missing seed
@@ -513,9 +561,11 @@ class LLMEngine:
         failover resumes replay the same draws. ``spec_k`` caps this
         stream's speculative draft budget (None = the engine default,
         0 = no drafting for this stream; it cannot raise the engine's
-        verify width). Raises :class:`AdmissionError` when the waiting
-        queue is full (retryable shed), ``ValueError`` for a prompt no
-        prefill path can hold."""
+        verify width). ``trace_id``/``parent_span`` stamp every engine
+        lifecycle event for this stream with the request's wire trace
+        (docs/observability.md). Raises :class:`AdmissionError` when
+        the waiting queue is full (retryable shed), ``ValueError`` for
+        a prompt no prefill path can hold."""
         if spec_k is not None and int(spec_k) < 0:
             raise ValueError("spec_k must be >= 0")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -554,7 +604,8 @@ class LLMEngine:
             h = GenHandle(rid, prompt, max_new_tokens, deadline,
                           sampling=params,
                           spec_k=None if spec_k is None else
-                          int(spec_k))
+                          int(spec_k),
+                          trace_id=trace_id, parent_span=parent_span)
             self._by_id[rid] = h
             self._trim_finished()
             self._wait.append(h)
@@ -586,6 +637,18 @@ class LLMEngine:
                 return
 
     # -- scheduler ---------------------------------------------------------
+    def _tick_flight(self):
+        """Every 128th decode step drops a tick summary into the crash
+        flight ring — a postmortem bundle then shows what the engine
+        was running (occupancy, backlog, token count) in its last
+        seconds, at a cost that never lands on every tick."""
+        if self._decode_steps % 128:
+            return
+        record_event("engine_tick", steps=self._decode_steps,
+                     occupancy=sum(1 for s in self._slots if s.handle),
+                     waiting=len(self._wait),
+                     generated=self._generated)
+
     def _publish(self):
         with self._lock:
             _occupancy.set(sum(1 for s in self._slots if s.handle))
@@ -706,6 +769,14 @@ class LLMEngine:
             #                          this seat died with the epoch
             self._admit_counter += 1
             h.admit_seq = self._admit_counter
+            h.admitted_at = time.perf_counter()
+            emit_event("llm.admit", trace=h.trace_id,
+                       parent=h.parent_span, rid=h.id,
+                       queue_wait_s=round(h.admitted_at - h.created, 6),
+                       prompt_tokens=int(len(prompt)),
+                       cache_hit_tokens=int(h.cache_hit_tokens),
+                       cow_fork=slot.pending_copy is not None,
+                       resumed=h.effective_prompt is not None)
             # admission only BINDS the slot and blocks; the device
             # prefill itself (whole prompt, suffix past the cached
             # prefix, or chunks across ticks) runs in _prefill_tick
@@ -765,6 +836,8 @@ class LLMEngine:
         slot.last_token = first
         slot.host_token = first
         slot.use_host = True
+        emit_event("llm.first_token", trace=h.trace_id,
+                   parent=h.parent_span, rid=h.id)
         h.push(first)
         h.gen_count += 1
         h.sched_count += 1
@@ -818,6 +891,7 @@ class LLMEngine:
         results = []
         for slot, h, epoch, prompt, start, take, n, row, copy in work:
             t0 = time.perf_counter()
+            t0_wall = time.time()
             try:
                 if copy is not None:
                     # the copy-on-write device copy owed from
@@ -861,9 +935,12 @@ class LLMEngine:
                 results.append((slot, h, epoch, start, take, n, None,
                                 e))
                 continue
-            _tick_seconds.labels(phase="prefill").observe(
-                time.perf_counter() - t0)
+            dur = time.perf_counter() - t0
+            _tick_seconds.labels(phase="prefill").observe(dur)
             _tokens.labels(kind="prefill").inc(take)
+            emit_span("llm.prefill", t0_wall, dur, trace=h.trace_id,
+                      parent=h.parent_span, rid=h.id, start=int(start),
+                      tokens=int(take), total=int(n))
             results.append((slot, h, epoch, start, take, n, tok, None))
         return results
 
@@ -973,10 +1050,16 @@ class LLMEngine:
         assert aux is None or aux.get("seed") == h.sampling[3]
         h.effective_prompt = resumed
         h.sched_count = h.gen_count
+        h.preempts += 1
         slot.handle = None
         slot.epoch += 1
         self.allocator.free(h.id)
         _preempts.inc()
+        emit_event("llm.preempt", trace=h.trace_id,
+                   parent=h.parent_span, rid=h.id,
+                   generated=int(h.gen_count))
+        record_event("llm_preempt", rid=h.id,
+                     generated=int(h.gen_count))
         with self._lock:
             self._wait.appendleft(h)
 
@@ -1211,6 +1294,7 @@ class LLMEngine:
         self._note_busy(t0, t1)
         self._decode_steps += 1
         _steps.inc()
+        self._tick_flight()
         with self._lock:
             self._apply_spec(snapshot, np.asarray(arr))
         _tick_seconds.labels(phase="readback").observe(
@@ -1245,6 +1329,7 @@ class LLMEngine:
         self._note_busy(t0, t1)
         self._decode_steps += 1
         _steps.inc()
+        self._tick_flight()
         with self._lock:
             self._apply_tokens(snapshot, arr)
         _tick_seconds.labels(phase="readback").observe(
@@ -1309,6 +1394,7 @@ class LLMEngine:
                 time.perf_counter() - t_ready)
             self._decode_steps += 1
             _steps.inc()
+            self._tick_flight()
             self._inflight.release()
             self._wake.set()
 

@@ -19,6 +19,8 @@ import time
 
 def serve_replica(ns) -> int:
     from zoo_tpu.obs.exporters import MetricsExporter
+    from zoo_tpu.obs.flight import flight_recorder, record_event
+    from zoo_tpu.obs.slo import SLOWatchdog
     from zoo_tpu.serving.server import ServingServer
     from zoo_tpu.util.resilience import (
         CircuitBreaker,
@@ -26,6 +28,17 @@ def serve_replica(ns) -> int:
     )
 
     start_heartbeat_thread()  # no-op unless the supervisor set the env
+    # black box first: the recorder opens its spill file (when the
+    # supervisor armed $ZOO_OBS_POSTMORTEM_DIR) before the model load —
+    # a boot crash leaves remains too. The SIGTERM crash handler is
+    # installed AFTER the drain handler below so it chains it: dump the
+    # bundle, then drain.
+    flight_recorder()
+    record_event("replica_boot", model=ns.model, port=ns.port)
+    # SLO watchdog: a no-op unless ZOO_SLO_* objectives are armed in
+    # the replica env; its verdict rides /healthz (exporters) and its
+    # breach flips land in the flight ring
+    watchdog = SLOWatchdog().start()
     from zoo_tpu.serving.llm.spec import is_llm_spec
     from zoo_tpu.serving.registry import (
         ModelRegistry,
@@ -72,6 +85,10 @@ def serve_replica(ns) -> int:
         exporter = MetricsExporter(host=ns.host,
                                    port=ns.metrics_port).start()
     server.install_drain_handler()
+    # after the drain handler, so SIGTERM dumps the postmortem bundle
+    # and THEN chains into the drain; unhandled exceptions dump too
+    from zoo_tpu.obs.flight import install_crash_handlers
+    install_crash_handlers()
     print(f"REPLICA READY {server.host}:{server.port}"
           + (f" metrics={exporter.port}" if exporter else ""),
           flush=True)
@@ -80,6 +97,7 @@ def serve_replica(ns) -> int:
             time.sleep(0.2)
     except KeyboardInterrupt:
         server.drain(timeout=10.0)
+    watchdog.stop()
     if exporter is not None:
         exporter.stop()
     return 0

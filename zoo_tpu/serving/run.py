@@ -105,6 +105,7 @@ def main(argv=None) -> int:
         # HA mode: the replicas load the model themselves (one process
         # each, supervised + respawned on a fixed port); this process is
         # only the group supervisor — no Redis, no HTTP frontend
+        from zoo_tpu.obs.flight import install_crash_handlers
         from zoo_tpu.serving.ha import ReplicaGroup
         ports = [ns.tcp_port + i for i in range(ns.tcp_replicas)] \
             if ns.tcp_port else None
@@ -118,10 +119,17 @@ def main(argv=None) -> int:
         stop = threading.Event()
         for sig in (signal.SIGINT, signal.SIGTERM):
             signal.signal(sig, lambda *_: stop.set())
+        # postmortem bundle on a supervisor crash too (chains the stop
+        # handlers just installed; no-op without $ZOO_OBS_FLIGHT_CAP)
+        install_crash_handlers()
         stop.wait()
         # replicas drain on their own SIGTERM (ProcessMonitor.stop
         # group-kills with SIGTERM first, SIGKILL after a grace)
         group.stop()
+        # AFTER the stop: the shutdown SIGTERM is what makes each
+        # replica dump its final postmortem bundle — harvesting first
+        # would strand those in the flight dirs (docs/observability.md)
+        group.harvest_postmortems()
         return 0
 
     from zoo_tpu.pipeline.inference.inference_model import InferenceModel

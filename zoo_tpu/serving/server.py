@@ -32,8 +32,9 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from zoo_tpu.obs.flight import flight_recorder, record_event
 from zoo_tpu.obs.metrics import StatTimer, counter, gauge, histogram
-from zoo_tpu.obs.tracing import span
+from zoo_tpu.obs.tracing import emit_event, emit_span, span
 from zoo_tpu.util.resilience import (
     CircuitBreaker,
     Deadline,
@@ -136,10 +137,13 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytearray]:
 
 class _Request:
     __slots__ = ("uri", "data", "event", "result", "error", "id",
-                 "deadline", "expired")
+                 "deadline", "expired", "trace", "pspan", "t_enqueue",
+                 "t_dequeue")
 
     def __init__(self, uri: str, data, rid: Optional[str] = None,
-                 deadline: Optional[Deadline] = None):
+                 deadline: Optional[Deadline] = None,
+                 trace: Optional[str] = None,
+                 pspan: Optional[str] = None):
         self.uri = uri
         self.data = data
         self.event = threading.Event()
@@ -148,6 +152,13 @@ class _Request:
         self.id = rid
         self.deadline = deadline
         self.expired = False
+        # request-scoped trace identity off the wire + queue timing,
+        # so the reply path can emit a per-request span with its
+        # measured queue wait (docs/observability.md)
+        self.trace = trace
+        self.pspan = pspan
+        self.t_enqueue: Optional[float] = None
+        self.t_dequeue: Optional[float] = None
 
 
 class _DedupCache:
@@ -335,14 +346,19 @@ class ServingServer:
                         pass
 
             def _reply(self, msg, extra):
-                """One response frame; the request id (when the client
-                sent one) is ALWAYS echoed so the client can discard a
-                stale attempt's frame instead of mismatching it."""
+                """One response frame; the request id AND trace id
+                (when the client sent them) are ALWAYS echoed — the id
+                so the client can discard a stale attempt's frame, the
+                trace so EVERY reply is joinable to its request's
+                timeline, sheds and errors included (a rejected request
+                that vanished from the trace was the old bug)."""
                 out = {}
                 if "uri" in msg:
                     out["uri"] = msg.get("uri")
                 if msg.get("id") is not None:
                     out["id"] = msg["id"]
+                if msg.get("trace") is not None:
+                    out["trace"] = msg["trace"]
                 if outer.version is not None:
                     # lifecycle identity on every frame: the HA client
                     # learns which version each endpoint serves (A/B
@@ -351,12 +367,29 @@ class ServingServer:
                 out.update(extra)
                 _send_msg(self.request, out)
 
+            def _note_reject(self, msg, reason):
+                """Door-rejection bookkeeping beyond the counters: the
+                flight ring gets the shed (with its reason — the first
+                thing a postmortem wants), and the request's trace gets
+                an instant event so rejected requests reconstruct in
+                the timeline too."""
+                record_event("shed", op=msg.get("op", "predict"),
+                             reason=reason)
+                if msg.get("trace") is not None:
+                    emit_event("server.shed", trace=msg["trace"],
+                               parent=msg.get("pspan"), reason=reason,
+                               rid=msg.get("id"))
+
             def _await_and_reply(self, msg, req, deadline):
                 """Reply stage: wait for the batcher to resolve ``req``
                 under a deadline-derived bound (the propagated deadline
                 when present, else ZOO_SERVE_REQUEST_TIMEOUT) and send
                 the outcome. Used by fresh requests and by duplicates
-                joining an in-flight/completed request."""
+                joining an in-flight/completed request. Returns the
+                outcome string ACTUALLY sent to this caller — a reply-
+                stage timeout is this connection's verdict only (a
+                joined duplicate must not mutate the shared request's
+                state), so the per-request span reads it from here."""
                 if deadline is not None:
                     done = req.event.wait(
                         timeout=max(0.0, deadline.remaining()))
@@ -375,25 +408,27 @@ class ServingServer:
                             "expired": True,
                             "error": "deadline expired before the batch "
                                      "resolved (request dropped)"})
-                    else:
-                        _requests.labels(outcome="error").inc()
-                        self._reply(msg, {
-                            "error": "timeout waiting for batch inference "
-                                     "(first request may be paying XLA "
-                                     "compile; bound is "
-                                     "$ZOO_SERVE_REQUEST_TIMEOUT "
-                                     f"= {outer.request_timeout:g}s)"})
-                elif req.error is not None:
+                        return "expired"
+                    _requests.labels(outcome="error").inc()
+                    self._reply(msg, {
+                        "error": "timeout waiting for batch inference "
+                                 "(first request may be paying XLA "
+                                 "compile; bound is "
+                                 "$ZOO_SERVE_REQUEST_TIMEOUT "
+                                 f"= {outer.request_timeout:g}s)"})
+                    return "error"
+                if req.error is not None:
                     if req.expired:
                         _requests.labels(outcome="expired").inc()
                         self._reply(msg, {"expired": True,
                                           "error": req.error})
-                    else:
-                        _requests.labels(outcome="error").inc()
-                        self._reply(msg, {"error": req.error})
-                else:
-                    _requests.labels(outcome="ok").inc()
-                    self._reply(msg, {"result": req.result})
+                        return "expired"
+                    _requests.labels(outcome="error").inc()
+                    self._reply(msg, {"error": req.error})
+                    return "error"
+                _requests.labels(outcome="ok").inc()
+                self._reply(msg, {"result": req.result})
+                return "ok"
 
             def _handle_predict(self, msg):
                 rid = msg.get("id")
@@ -428,6 +463,7 @@ class ServingServer:
                         and want != outer.version:
                     _requests.labels(outcome="shed").inc()
                     _shed.labels(reason="version_mismatch").inc()
+                    self._note_reject(msg, "version_mismatch")
                     self._reply(msg, {
                         "shed": True, "retryable": True,
                         "version_mismatch": True,
@@ -441,6 +477,7 @@ class ServingServer:
                         not outer.breaker.allow():
                     _requests.labels(outcome="shed").inc()
                     _shed.labels(reason="breaker_open").inc()
+                    self._note_reject(msg, "breaker_open")
                     self._reply(msg, {
                         "shed": True, "retryable": True,
                         "retry_after_ms": int(
@@ -455,6 +492,7 @@ class ServingServer:
                 if deadline is not None and deadline.expired():
                     _requests.labels(outcome="expired").inc()
                     _deadline_expired.labels(stage="admission").inc()
+                    self._note_reject(msg, "expired_admission")
                     self._reply(msg, {
                         "expired": True,
                         "error": "deadline expired before admission "
@@ -467,6 +505,7 @@ class ServingServer:
                 if outer.max_queue and depth >= outer.max_queue:
                     _requests.labels(outcome="shed").inc()
                     _shed.labels(reason="queue_full").inc()
+                    self._note_reject(msg, "queue_full")
                     hint = int(outer.max_wait_ms * max(
                         1, depth // max(1, outer.batch_size)))
                     self._reply(msg, {
@@ -486,6 +525,7 @@ class ServingServer:
                     # in-flight still completes and responds
                     _requests.labels(outcome="shed").inc()
                     _shed.labels(reason="draining").inc()
+                    self._note_reject(msg, "draining")
                     self._reply(msg, {
                         "shed": True, "draining": True,
                         "retryable": True,
@@ -493,14 +533,33 @@ class ServingServer:
                                  "down); retry another replica"})
                     return
                 req = _Request(msg["uri"], msg["data"], rid=rid,
-                               deadline=deadline)
+                               deadline=deadline,
+                               trace=msg.get("trace"),
+                               pspan=msg.get("pspan"))
                 if rid is not None and outer._dedup_cache is not None:
                     outer._dedup_cache.put(rid, req)
                 t0 = time.perf_counter()
+                t0_wall = time.time()
+                req.t_enqueue = t0
                 outer._queue.put(req)
                 _queue_depth.set(outer._queue.qsize())
-                self._await_and_reply(msg, req, deadline)
-                outer.timers["total"].record(time.perf_counter() - t0)
+                outcome = self._await_and_reply(msg, req, deadline)
+                dur = time.perf_counter() - t0
+                outer.timers["total"].record(dur)
+                # the request's server-side span: queue wait + batch +
+                # inference + reply under ITS trace id, so the timeline
+                # merger shows where this replica spent the budget.
+                # ``outcome`` is what THIS caller was told (a reply-
+                # stage timeout included — the slowest requests must
+                # not read as successes in the timeline).
+                if req.trace is not None:
+                    attrs = {"rid": rid, "outcome": outcome}
+                    if req.t_dequeue is not None:
+                        attrs["queue_wait_s"] = round(
+                            req.t_dequeue - t0, 6)
+                    emit_span("server.predict", t0_wall, dur,
+                              trace=req.trace, parent=req.pspan,
+                              ok=outcome == "ok", **attrs)
 
             def _handle_generate(self, msg):
                 """Streaming autoregressive generation
@@ -526,6 +585,7 @@ class ServingServer:
                         not outer.breaker.allow():
                     _requests.labels(outcome="shed").inc()
                     _shed.labels(reason="breaker_open").inc()
+                    self._note_reject(msg, "breaker_open")
                     self._reply(msg, {
                         "shed": True, "retryable": True,
                         "error": "server shedding load (circuit open)"})
@@ -533,6 +593,7 @@ class ServingServer:
                 if outer._draining.is_set():
                     _requests.labels(outcome="shed").inc()
                     _shed.labels(reason="draining").inc()
+                    self._note_reject(msg, "draining")
                     self._reply(msg, {
                         "shed": True, "draining": True,
                         "retryable": True,
@@ -542,6 +603,7 @@ class ServingServer:
                 if deadline is not None and deadline.expired():
                     _requests.labels(outcome="expired").inc()
                     _deadline_expired.labels(stage="admission").inc()
+                    self._note_reject(msg, "expired_admission")
                     self._reply(msg, {
                         "done": True, "outcome": "expired",
                         "expired": True,
@@ -558,16 +620,20 @@ class ServingServer:
                 # per-stream speculative budget: caps (never raises)
                 # the replica's verify width; 0 = plain decode lanes
                 spec_k = msg.get("spec_k")
+                trace_id = msg.get("trace")
                 try:
                     h = eng.submit(
                         np.asarray(msg["prompt"]),
                         int(msg.get("max_new_tokens", 16)),
                         rid=rid, deadline=deadline,
                         sampling=sampling or None,
-                        spec_k=None if spec_k is None else int(spec_k))
+                        spec_k=None if spec_k is None else int(spec_k),
+                        trace_id=trace_id,
+                        parent_span=msg.get("pspan"))
                 except AdmissionError as e:
                     _requests.labels(outcome="shed").inc()
                     _shed.labels(reason="queue_full").inc()
+                    self._note_reject(msg, "queue_full")
                     self._reply(msg, {
                         "shed": True, "retryable": True,
                         "retry_after_ms": e.retry_after_ms,
@@ -579,7 +645,10 @@ class ServingServer:
                                       "error": repr(e)})
                     return
                 cursor = max(0, int(msg.get("resume_from") or 0))
+                resume_from = cursor
                 seq = 0
+                t_stream = time.perf_counter()
+                t_stream_wall = time.time()
                 h.subscribe()
                 completed = False
                 try:
@@ -641,6 +710,20 @@ class ServingServer:
                         # decoding: cancel so its KV blocks free NOW,
                         # not at max_new_tokens
                         eng.cancel(h.id)
+                    if trace_id is not None:
+                        # this HOP's serving span (one per attempt —
+                        # original, hedge, failover resume — each with
+                        # its resume cursor): the engine's llm.* spans
+                        # nest under the same trace
+                        emit_span("server.generate", t_stream_wall,
+                                  time.perf_counter() - t_stream,
+                                  trace=trace_id,
+                                  parent=msg.get("pspan"),
+                                  ok=completed, rid=rid,
+                                  resume_from=resume_from,
+                                  sent_tokens=cursor - resume_from,
+                                  outcome=h.outcome if completed
+                                  else "disconnected")
 
             def _handle_reload(self, msg):
                 """Wire half of :meth:`ServingServer.reload_model`.
@@ -687,6 +770,14 @@ class ServingServer:
                     elif msg.get("op") == "stats":
                         self._reply(msg, {k: t.stats()
                                           for k, t in outer.timers.items()})
+                    elif msg.get("op") == "debug_dump":
+                        # the flight recorder's bundle, pulled LIVE
+                        # (docs/observability.md): ring + metrics +
+                        # config + open spans, no process death needed
+                        self._reply(msg, {
+                            "ok": True,
+                            "bundle": flight_recorder().snapshot_bundle(
+                                "debug_dump")})
                     elif msg.get("op") == "ping":
                         self._reply(msg, {"ok": True})
 
@@ -807,6 +898,7 @@ class ServingServer:
                 first = self._queue.get(timeout=0.1)
             except queue.Empty:
                 continue
+            first.t_dequeue = time.perf_counter()
             if first.deadline is not None and first.deadline.expired():
                 self._drop_expired(first)
                 continue
@@ -828,6 +920,7 @@ class ServingServer:
                     nxt = self._queue.get(timeout=remaining)
                 except queue.Empty:
                     break
+                nxt.t_dequeue = time.perf_counter()
                 if nxt.deadline is not None and nxt.deadline.expired():
                     self._drop_expired(nxt)
                     continue
@@ -954,6 +1047,8 @@ class ServingServer:
                 break
             time.sleep(0.01)
         _drain_seconds.observe(time.monotonic() - t0)
+        record_event("drain", drained=drained,
+                     seconds=round(time.monotonic() - t0, 3))
         path = snapshot_path or os.environ.get("ZOO_OBS_SNAPSHOT")
         if path:
             try:

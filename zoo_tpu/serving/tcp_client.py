@@ -15,6 +15,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from zoo_tpu.obs.tracing import ambient_trace_id, current_span_id
 from zoo_tpu.serving.server import _recv_msg, _send_msg
 from zoo_tpu.util.resilience import (
     Deadline,
@@ -22,6 +23,23 @@ from zoo_tpu.util.resilience import (
     RetryPolicy,
     fault_point,
 )
+
+
+def _stamp_trace(msg: Dict) -> Dict:
+    """Propagate the thread's adopted request trace onto the frame
+    (docs/observability.md): a caller already inside a
+    ``trace_context`` — the HTTP front end, a user's traced section —
+    gets wire propagation for free; explicit ``trace`` fields (the HA
+    client's) win. No ambient context = no stamp: the wire never
+    carries the process-wide trace id."""
+    if "trace" not in msg:
+        tid = ambient_trace_id()
+        if tid is not None:
+            msg["trace"] = tid
+            ps = current_span_id()
+            if ps is not None:
+                msg["pspan"] = ps
+    return msg
 
 
 class _Connection:
@@ -128,6 +146,7 @@ class _Connection:
         and the HA layer resumes on another replica with
         ``resume_from``. ``idle_timeout`` bounds the gap BETWEEN frames
         when no deadline was propagated."""
+        msg = _stamp_trace(dict(msg))
         fault_point("serving.request", op=msg.get("op"))
         with self._lock:
             if deadline is not None and deadline.expired():
@@ -170,7 +189,7 @@ class _Connection:
         # must never leak into the caller's dict — a reused dict would
         # carry a stale id into its NEXT request and silently replay the
         # previous answer from the server's dedup cache
-        msg = dict(msg)
+        msg = _stamp_trace(dict(msg))
         if msg.get("op") == "predict" and "id" not in msg:
             msg["id"] = uuid.uuid4().hex
         return self._retry.call(self._rpc_once, msg, deadline)

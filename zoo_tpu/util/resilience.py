@@ -57,6 +57,18 @@ _fault_trips = counter(
     "zoo_fault_injections_total", "Armed fault-site firings",
     labels=("site",))
 
+def _flight(kind: str, **fields):
+    """Record into the crash flight-recorder ring (lazy import: the
+    flight module lives above us in the obs package and this module is
+    imported by nearly everything — the ring must never be a reason
+    resilience fails to load)."""
+    try:
+        from zoo_tpu.obs.flight import record_event
+        record_event(kind, **fields)
+    except Exception:  # noqa: BLE001 — telemetry never fails the op
+        pass
+
+
 __all__ = [
     "RetryPolicy", "RetryError",
     "Deadline", "DeadlineExceeded",
@@ -160,6 +172,8 @@ class RetryPolicy:
                              self.max_attempts, delay, e)
                 self._sleep(delay)
         _retry_giveups.inc()
+        _flight("retry_giveup", attempts=self.max_attempts,
+                error=repr(last))
         raise RetryError(
             f"gave up after {self.max_attempts} attempt(s): {last!r}",
             self.max_attempts) from last
@@ -291,6 +305,7 @@ class CircuitBreaker:
             if self._state != self.CLOSED:
                 logger.info("circuit breaker closing after probe success")
                 _breaker_transitions.labels(state=self.CLOSED).inc()
+                _flight("breaker_closed")
                 _breakers_open.dec()
             self._state = self.CLOSED
 
@@ -305,6 +320,9 @@ class CircuitBreaker:
                         "failure(s); shedding load for %.1fs",
                         self._failures, self.recovery_timeout)
                     _breaker_transitions.labels(state=self.OPEN).inc()
+                    _flight("breaker_open",
+                            failures=self._failures,
+                            recovery_s=self.recovery_timeout)
                     if self._state == self.CLOSED:
                         # CLOSED->OPEN only: a reopening HALF_OPEN
                         # breaker is already counted in the gauge

@@ -1326,6 +1326,137 @@ def bench_serving_ha(extra, n_requests=240, clients=6, feat=16):
         counter_value("zoo_serve_failover_total") - fo0)
 
 
+def bench_chaos_ejection(extra, n_requests=360, clients=4, feat=16,
+                         slow_ms=40.0):
+    """Gray-failure ejection A/B (docs/fault_tolerance.md): a
+    3-replica group with replica 1 turned 20x slow over the wire
+    ``chaos`` op (healthz keeps passing — crash detection never
+    fires), measured with ejection OFF vs ON under the same load,
+    hedging disabled so the membership layer is the only mitigation.
+    Reports detect-to-eject latency and asserts the ejection-on p99 is
+    STRICTLY better — the floor that makes a regression loud."""
+    import threading
+
+    from zoo_tpu.serving.ejection import EjectionConfig
+    from zoo_tpu.serving.ha import ReplicaGroup
+    from zoo_tpu.serving.ha_client import HAServingClient
+
+    group = ReplicaGroup("synthetic:double:2", num_replicas=3,
+                         batch_size=8, max_wait_ms=2.0, max_restarts=3,
+                         env={"ZOO_CHAOS_ALLOW": "1"})
+    group.start(timeout=60)
+
+    def run(eject_on):
+        cfg = EjectionConfig(
+            enabled=eject_on, min_ms=20.0, min_samples=4,
+            probation_s=0.4, probe_interval_s=0.3, readmit_base_s=0.5)
+        client = HAServingClient(group.endpoints(), deadline_ms=10000,
+                                 hedge=False, ejection_config=cfg)
+        x_warm = np.ones((1, feat), np.float32)
+        for _ in range(12):
+            client.predict(x_warm)
+        group.chaos_rpc(1, "serving.infer", delay_ms=slow_ms)
+        t_slow = time.monotonic()
+        lats, lock = [], threading.Lock()
+
+        def one_client(k):
+            rs_c = np.random.RandomState(k)
+            for _ in range(n_requests // clients):
+                x = rs_c.randn(1, feat).astype(np.float32)
+                t0 = time.perf_counter()
+                out = np.asarray(client.predict(x))
+                assert np.allclose(out, x * 2.0, atol=1e-6)
+                t1 = time.perf_counter()
+                with lock:
+                    lats.append((t1, t1 - t0))
+
+        threads = [threading.Thread(target=one_client, args=(k,))
+                   for k in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        group.chaos_rpc(1, "serving.infer", clear=True)
+        detect = None
+        for ts, kind, _seat in client.ejection_events():
+            if kind == "ejected":
+                detect = ts - t_slow
+                break
+        client.close()
+        # steady state only: the first third is the detection window
+        # on the ejection-on side (slow requests BEFORE the eject are
+        # the detection cost, reported separately as detect_ms)
+        lats.sort(key=lambda x: x[0])
+        lats_ms = np.asarray(
+            [dt for _, dt in lats[len(lats) // 3:]]) * 1e3
+        return (float(np.percentile(lats_ms, 99)),
+                float(np.percentile(lats_ms, 50)), detect)
+
+    try:
+        off_p99, off_p50, _ = run(eject_on=False)
+        on_p99, on_p50, detect = run(eject_on=True)
+    finally:
+        group.stop()
+    extra["chaos_ejection_off_p99_ms"] = round(off_p99, 2)
+    extra["chaos_ejection_on_p99_ms"] = round(on_p99, 2)
+    extra["chaos_ejection_off_p50_ms"] = round(off_p50, 2)
+    extra["chaos_ejection_on_p50_ms"] = round(on_p50, 2)
+    extra["chaos_ejection_detect_ms"] = (
+        round(detect * 1e3, 1) if detect is not None else None)
+    extra["chaos_ejection_p99_speedup"] = round(off_p99 / on_p99, 3)
+    assert detect is not None, "slow replica was never ejected"
+    assert on_p99 < off_p99, (
+        f"ejection-on p99 {on_p99:.1f}ms not better than "
+        f"ejection-off {off_p99:.1f}ms")
+
+
+def bench_wire_crc(extra, n_requests=400, feat=256):
+    """Frame-integrity overhead (docs/fault_tolerance.md): serving
+    round-trip p50 with the CRC trailer negotiated ON vs OFF, same
+    in-process server + model (an 8x256 f32 request ≈ 8 KB per frame
+    each way). The trailer is one zlib.crc32 over the payload per
+    frame — this row keeps the cost honest in the trajectory."""
+    from zoo_tpu.serving.ha import SyntheticModel
+    from zoo_tpu.serving.server import ServingServer
+    from zoo_tpu.serving.tcp_client import TCPInputQueue
+
+    def run(crc_on):
+        prev = os.environ.get("ZOO_WIRE_CRC")
+        os.environ["ZOO_WIRE_CRC"] = "1" if crc_on else "0"
+        try:
+            srv = ServingServer(SyntheticModel(), port=0, batch_size=8,
+                                max_wait_ms=1.0).start()
+            q = TCPInputQueue(srv.host, srv.port)
+            x = np.random.RandomState(0).randn(8, feat).astype(
+                np.float32)
+            for _ in range(20):
+                q.predict(x)
+            assert q._conn._crc_on == crc_on
+            lats = []
+            for _ in range(n_requests):
+                t0 = time.perf_counter()
+                q.predict(x)
+                lats.append(time.perf_counter() - t0)
+            q.close()
+            srv.stop()
+            return float(np.percentile(np.asarray(lats) * 1e3, 50))
+        finally:
+            if prev is None:
+                os.environ.pop("ZOO_WIRE_CRC", None)
+            else:
+                os.environ["ZOO_WIRE_CRC"] = prev
+
+    # interleaved off/on/off/on: ambient drift lands on both sides
+    p50_off = run(False)
+    p50_on = run(True)
+    p50_off = min(p50_off, run(False))
+    p50_on = min(p50_on, run(True))
+    extra["wire_crc_off_p50_ms"] = round(p50_off, 3)
+    extra["wire_crc_on_p50_ms"] = round(p50_on, 3)
+    extra["wire_crc_overhead_pct"] = round(
+        100.0 * (p50_on - p50_off) / p50_off, 2)
+
+
 def bench_obs_trace(extra, n_requests=300, feat=16):
     """Tracing-overhead A/B (docs/observability.md): serving throughput
     through the full HA-client → ServingServer path with request-scoped
@@ -1495,7 +1626,7 @@ def bench_lifecycle(extra, clients=6, feat=16):
     assert versions.count(versions[0]) == len(versions), versions
 
 
-_BENCH_PR = 13  # bump alongside CHANGES.md when bench semantics move
+_BENCH_PR = 14  # bump alongside CHANGES.md when bench semantics move
 
 
 def _bench_meta():
@@ -1567,6 +1698,14 @@ def main():
             bench_serving_ha(extra)
         except Exception as e:  # noqa: BLE001
             extra["serving_ha_error"] = repr(e)
+        try:
+            bench_chaos_ejection(extra)
+        except Exception as e:  # noqa: BLE001
+            extra["chaos_ejection_error"] = repr(e)
+        try:
+            bench_wire_crc(extra)
+        except Exception as e:  # noqa: BLE001
+            extra["wire_crc_error"] = repr(e)
         try:
             bench_obs_trace(extra)
         except Exception as e:  # noqa: BLE001

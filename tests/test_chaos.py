@@ -1,0 +1,239 @@
+"""Deterministic fleet chaos: ChaosSchedule, the wire ``chaos`` op,
+replica quarantine, and the full mixed-op chaos storm smoke.
+
+The schedule/state-machine tests are pure-python and fast; the
+process-level pieces (quarantine probes, the storm) carry the ``chaos``
+marker like their siblings.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from zoo_tpu.util.resilience import ChaosSchedule
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------- ChaosSchedule
+
+def test_chaos_schedule_parses_instants_windows_and_params():
+    s = ChaosSchedule(
+        "kill@2.0:replica=1;slow@0.5-3.0:replica=0,delay_ms=80;"
+        "corrupt@1.0-2.0:p=0.25", seed=7, replicas=3)
+    kinds = [e["kind"] for e in s.resolved()]
+    assert kinds == ["slow", "corrupt", "kill"]  # sorted by t0
+    slow = s.resolved()[0]
+    assert slow["t0"] == 0.5 and slow["t1"] == 3.0
+    assert slow["params"] == {"replica": 0, "delay_ms": 80}
+    assert s.horizon == 3.0
+
+
+def test_chaos_schedule_same_seed_same_sequence():
+    spec = "kill@1.0~4.0:replica=?;slow@0.2~0.8-5.0:replica=?,delay_ms=50"
+    a = ChaosSchedule(spec, seed=42, replicas=5)
+    b = ChaosSchedule(spec, seed=42, replicas=5)
+    assert a.resolved() == b.resolved()
+    c = ChaosSchedule(spec, seed=43, replicas=5)
+    assert a.resolved() != c.resolved()
+    # draws landed inside their ranges
+    kill = next(e for e in a.resolved() if e["kind"] == "kill")
+    assert 1.0 <= kill["t0"] <= 4.0
+    assert kill["params"]["replica"] in range(5)
+
+
+def test_chaos_schedule_env_defaults(monkeypatch):
+    monkeypatch.setenv("ZOO_CHAOS_SPEC", "kill@1.5:replica=0")
+    monkeypatch.setenv("ZOO_CHAOS_SEED", "99")
+    s = ChaosSchedule()
+    assert s.seed == 99
+    assert s.resolved() == [{"kind": "kill", "t0": 1.5, "t1": None,
+                             "params": {"replica": 0}}]
+
+
+def test_chaos_schedule_rejects_malformed():
+    with pytest.raises(ValueError):
+        ChaosSchedule("kill:replica=0", seed=0)  # no @time
+    with pytest.raises(ValueError):
+        ChaosSchedule("slow@3.0-1.0", seed=0)  # window closes early
+    with pytest.raises(ValueError):
+        ChaosSchedule("kill@1.0:replica=?", seed=0)  # ? needs replicas=
+    with pytest.raises(ValueError):
+        ChaosSchedule("kill@1.0:replica", seed=0)  # param missing '='
+
+
+def test_chaos_schedule_run_dispatches_start_and_end():
+    calls = []
+    s = ChaosSchedule("a@0.01-0.05:x=1;b@0.02", seed=0)
+    s.run({"a": lambda ev, ph: calls.append(("a", ph)),
+           "b": lambda ev, ph: calls.append(("b", ph))})
+    assert s.join(timeout=5.0)
+    assert calls == [("a", "start"), ("b", "start"), ("a", "end")]
+
+
+def test_chaos_schedule_action_errors_never_kill_the_run():
+    calls = []
+
+    def boom(ev, ph):
+        raise RuntimeError("chaos action bug")
+
+    s = ChaosSchedule("a@0.0;b@0.02", seed=0)
+    s.run({"a": boom, "b": lambda ev, ph: calls.append("b")})
+    assert s.join(timeout=5.0)
+    assert calls == ["b"]
+
+
+def test_chaos_schedule_reseeds_injector_for_replayable_pdraws():
+    from zoo_tpu.util.resilience import FaultInjector
+    seqs = []
+    for _ in range(2):
+        inj = FaultInjector()
+        s = ChaosSchedule("noop@0.0", seed=123)
+        s.run({"noop": lambda ev, ph: None}, injector=inj)
+        assert s.join(timeout=5.0)
+        inj.inject("x", exc=None, action=lambda **k: None, p=0.5)
+        fired = []
+        for _ in range(32):
+            before = inj.fired("x")
+            inj.fire("x")
+            fired.append(inj.fired("x") > before)
+        seqs.append(fired)
+    assert seqs[0] == seqs[1], "p-draws did not replay under one seed"
+
+
+# ------------------------------------------------- the wire chaos op
+
+def test_chaos_op_refused_without_allow_env():
+    import numpy as np
+
+    from zoo_tpu.serving.ha import SyntheticModel
+    from zoo_tpu.serving.server import ServingServer
+    from zoo_tpu.serving.tcp_client import _Connection
+
+    os.environ.pop("ZOO_CHAOS_ALLOW", None)
+    srv = ServingServer(SyntheticModel(), port=0, batch_size=2,
+                        max_wait_ms=1.0).start()
+    try:
+        conn = _Connection(srv.host, srv.port)
+        resp = conn.rpc({"op": "chaos", "site": "serving.infer",
+                         "delay_ms": 50})
+        assert "error" in resp and "ZOO_CHAOS_ALLOW" in resp["error"]
+        conn.close()
+        # the door still serves
+        conn = _Connection(srv.host, srv.port)
+        out = conn.rpc({"op": "predict", "uri": "u",
+                        "data": np.ones((1, 2), np.float32)})
+        np.testing.assert_allclose(out["result"], 2.0)
+        conn.close()
+    finally:
+        srv.stop()
+
+
+def test_chaos_op_arms_and_clears_local_injector(monkeypatch):
+    import numpy as np
+
+    from zoo_tpu.serving.ha import SyntheticModel
+    from zoo_tpu.serving.server import ServingServer
+    from zoo_tpu.serving.tcp_client import _Connection
+    from zoo_tpu.util.resilience import clear_faults, default_injector
+
+    monkeypatch.setenv("ZOO_CHAOS_ALLOW", "1")
+    srv = ServingServer(SyntheticModel(), port=0, batch_size=2,
+                        max_wait_ms=1.0).start()
+    try:
+        conn = _Connection(srv.host, srv.port)
+        assert conn.rpc({"op": "chaos", "site": "serving.infer",
+                         "delay_ms": 120})["ok"]
+        t0 = time.perf_counter()
+        conn.rpc({"op": "predict", "uri": "u",
+                  "data": np.ones((1, 2), np.float32)})
+        assert time.perf_counter() - t0 >= 0.1, \
+            "armed delay did not slow the op"
+        assert default_injector.fired("serving.infer") >= 1
+        assert conn.rpc({"op": "chaos", "site": "serving.infer",
+                         "clear": 1})["ok"]
+        t0 = time.perf_counter()
+        conn.rpc({"op": "predict", "uri": "u",
+                  "data": np.ones((1, 2), np.float32)})
+        assert time.perf_counter() - t0 < 0.1, "clear did not disarm"
+        conn.close()
+    finally:
+        clear_faults()
+        srv.stop()
+
+
+# ------------------------------------------------- quarantine (chaos)
+
+@pytest.mark.chaos
+def test_exhausted_restart_budget_quarantines_not_group_teardown(
+        monkeypatch):
+    """A crash-looping seat past max_restarts is QUARANTINED (gauge +
+    healthz verdict + siblings keep serving), then probed back on the
+    backoff timer and re-admitted once a probe survives the heal
+    window — never again the silent permanent death."""
+    monkeypatch.setenv("ZOO_QUARANTINE_PROBE_S", "1.0")
+    monkeypatch.setenv("ZOO_QUARANTINE_HEAL_S", "2.0")
+    import numpy as np
+
+    from zoo_tpu.serving.ha import ReplicaGroup
+    from zoo_tpu.serving.ha_client import HAServingClient
+
+    group = ReplicaGroup("synthetic:double", num_replicas=2,
+                         max_restarts=1).start(timeout=60)
+    cli = HAServingClient(group.endpoints(), deadline_ms=8000,
+                          hedge=False)
+    try:
+        # exhaust replica 0's budget: kill, wait for respawn, kill again
+        for k in (1, 2):
+            group.kill_replica(0)
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                w = group._monitor.workers[0]
+                if w.quarantined or (w.restarts == k
+                                     and w.returncode is None):
+                    break
+                time.sleep(0.05)
+            if group._monitor.workers[0].quarantined:
+                break
+            time.sleep(0.3)  # let the respawn finish booting
+        assert group.quarantined() == ["serving-replica-0"]
+        # the sibling keeps serving the whole time
+        out = np.asarray(cli.predict(np.full((1, 2), 3.0, np.float32)))
+        np.testing.assert_allclose(out, 6.0)
+        # healthz accounts for the parked seat explicitly
+        hz = group.healthz()
+        assert hz[0] is not None and hz[0].get("quarantined")
+        from zoo_tpu.obs.metrics import get_registry
+        gauges = {g["name"]: g["value"]
+                  for g in get_registry().snapshot()["gauges"]}
+        assert gauges.get("zoo_serve_replicas_quarantined") == 1.0
+        # probe respawn + heal window => re-admitted with fresh budget
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and group.quarantined():
+            time.sleep(0.2)
+        assert not group.quarantined(), "quarantine probe never healed"
+        assert group._monitor.workers[0].restarts == 0
+        out = np.asarray(cli.predict(np.full((1, 2), 4.0, np.float32)))
+        np.testing.assert_allclose(out, 8.0)
+    finally:
+        cli.close()
+        group.stop()
+
+
+# ------------------------------------------------------ the storm
+
+@pytest.mark.chaos
+def test_check_chaos_storm_script_runs():
+    """The seeded mixed-op chaos storm (scripts/check_chaos_storm.py):
+    slow-replica ejection + frame corruption + SIGKILL + drops under
+    sustained predict/generate load — byte-exact streams, zero garbage
+    decodes, zero leaked KV blocks, replayable fault sequence."""
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join("scripts", "check_chaos_storm.py")],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "CHAOS STORM OK" in proc.stdout

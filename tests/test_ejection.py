@@ -1,0 +1,223 @@
+"""Gray-failure ejection: scorer EWMA math, the probation/ejection/
+re-admission state machine on a fake clock, and the HA client's
+routing integration against live replicas (chaos marker)."""
+
+import time
+
+import pytest
+
+from zoo_tpu.serving.ejection import (
+    ACTIVE,
+    EJECTED,
+    PROBATION,
+    EjectionConfig,
+    EjectionController,
+)
+
+
+def _cfg(**kw):
+    base = dict(enabled=True, factor=3.0, min_ms=10.0, min_samples=3,
+                alpha=0.5, probation_s=1.0, probe_interval_s=0.5,
+                readmit_base_s=2.0, readmit_max_s=16.0, error_rate=0.6)
+    base.update(kw)
+    return EjectionConfig(**base)
+
+
+def _controller(**kw):
+    now = [0.0]
+    ctl = EjectionController(_cfg(**kw), clock=lambda: now[0])
+    return ctl, now
+
+
+def _feed(score, ms, n, alpha=0.5):
+    for _ in range(n):
+        score.record(ms / 1000.0, alpha)
+
+
+def test_scorer_ewma_and_error_decay():
+    ctl, _ = _controller()
+    s = ctl.new_score("a")
+    s.record(0.010, 0.5)
+    assert abs(s.ewma_ms - 10.0) < 1e-9
+    s.record(0.030, 0.5)
+    assert abs(s.ewma_ms - 20.0) < 1e-9
+    s.record_error(0.5)
+    assert abs(s.err - 0.5) < 1e-9
+    s.record(0.020, 0.5)   # success decays the error EWMA
+    assert abs(s.err - 0.25) < 1e-9
+    assert s.n == 4
+
+
+def test_outlier_walks_probation_then_ejected_then_readmitted():
+    ctl, now = _controller()
+    fast = [ctl.new_score(f"f{i}") for i in range(2)]
+    slow = ctl.new_score("slow")
+    scores = fast + [slow]
+    for s in fast:
+        _feed(s, 4.0, 6)
+    _feed(slow, 200.0, 6)
+
+    ctl.evaluate(scores)
+    assert slow.state == PROBATION and all(
+        s.state == ACTIVE for s in fast)
+    # sustained degradation past probation_s => ejected, backoff armed
+    now[0] = 1.5
+    _feed(slow, 200.0, 2)
+    ctl.evaluate(scores)
+    assert slow.state == EJECTED
+    assert slow.readmit_at == pytest.approx(1.5 + 2.0)
+    # before the backoff expires nothing changes
+    now[0] = 3.0
+    ctl.evaluate(scores)
+    assert slow.state == EJECTED
+    # backoff expiry => probation PROBE with the score reset (fresh
+    # evidence only — the stale slow EWMA must not re-eject it)
+    now[0] = 3.6
+    ctl.evaluate(scores)
+    assert slow.state == PROBATION
+    assert slow.ewma_ms is None and slow.n == 0
+    # fast canary samples re-admit it
+    _feed(slow, 4.0, 4)
+    ctl.evaluate(scores)
+    assert slow.state == ACTIVE
+    assert slow.eject_count == 0  # recovery clears the backoff ladder
+    events = [e[1] for e in ctl.events]
+    assert events == ["probation", "ejected", "probe", "readmitted"]
+
+
+def test_reeject_backoff_doubles_per_consecutive_ejection():
+    ctl, now = _controller()
+    fast = [ctl.new_score(f"f{i}") for i in range(2)]
+    slow = ctl.new_score("slow")
+    scores = fast + [slow]
+    for s in fast:
+        _feed(s, 4.0, 6)
+    expect_backoff = [2.0, 4.0, 8.0]
+    for k, backoff in enumerate(expect_backoff):
+        _feed(slow, 200.0, 6)
+        ctl.evaluate(scores)          # -> probation
+        now[0] += 1.5
+        _feed(slow, 200.0, 1)
+        ctl.evaluate(scores)          # -> ejected
+        assert slow.state == EJECTED
+        assert slow.readmit_at == pytest.approx(now[0] + backoff)
+        now[0] = slow.readmit_at + 0.1
+        ctl.evaluate(scores)          # -> probe window
+        assert slow.state == PROBATION
+
+
+def test_error_rate_alone_triggers_probation():
+    ctl, _ = _controller()
+    a = ctl.new_score("a")
+    b = ctl.new_score("b")
+    _feed(a, 4.0, 6)
+    for _ in range(6):
+        b.record_error(0.5)
+    ctl.evaluate([a, b])
+    assert b.state == PROBATION and a.state == ACTIVE
+
+
+def test_never_probation_last_active_seat_on_latency():
+    """With no healthy peer to compare against, latency alone must not
+    eject — the median would be the seat itself."""
+    ctl, _ = _controller()
+    only = ctl.new_score("only")
+    other = ctl.new_score("other")
+    other.state = EJECTED
+    _feed(only, 500.0, 10)
+    ctl.evaluate([only, other])
+    assert only.state == ACTIVE
+
+
+def test_absolute_floor_shields_fast_outliers():
+    """3x the median is NOT an outlier while everything is under the
+    min_ms floor — sub-floor jitter never ejects."""
+    ctl, _ = _controller(min_ms=50.0)
+    fast = [ctl.new_score(f"f{i}") for i in range(2)]
+    mild = ctl.new_score("mild")
+    for s in fast:
+        _feed(s, 3.0, 6)
+    _feed(mild, 30.0, 6)   # 10x the median but under the 50ms floor
+    ctl.evaluate(fast + [mild])
+    assert mild.state == ACTIVE
+
+
+def test_canary_cadence_at_most_one_per_interval():
+    ctl, now = _controller()
+    s = ctl.new_score("p")
+    s.state = PROBATION
+    now[0] = 10.0
+    assert ctl.take_canary(s)
+    assert not ctl.take_canary(s)
+    now[0] = 10.6
+    assert ctl.take_canary(s)
+
+
+def test_disabled_controller_never_transitions():
+    ctl, _ = _controller(enabled=False)
+    fast = ctl.new_score("f")
+    slow = ctl.new_score("s")
+    _feed(fast, 4.0, 6)
+    _feed(slow, 500.0, 6)
+    ctl.evaluate([fast, slow])
+    assert slow.state == ACTIVE
+    assert ctl.state_of(slow) == ACTIVE
+    assert not ctl.take_canary(slow)
+
+
+# ---------------------------------------------- live integration (chaos)
+
+@pytest.mark.chaos
+def test_slow_replica_ejected_and_readmitted_live():
+    """End to end against real replica processes: a gray-slow replica
+    (healthz fine, 40x slower via the wire chaos op) is ejected from
+    the client rotation, traffic avoids it, and once the fault clears
+    the canary probes re-admit it."""
+    import numpy as np
+
+    from zoo_tpu.serving.ha import ReplicaGroup
+    from zoo_tpu.serving.ha_client import HAServingClient
+
+    group = ReplicaGroup("synthetic:double:2", num_replicas=3,
+                         max_restarts=2, batch_size=8, max_wait_ms=1.0,
+                         env={"ZOO_CHAOS_ALLOW": "1"})
+    group.start(timeout=60)
+    cli = HAServingClient(
+        group.endpoints(), deadline_ms=8000, hedge=False,
+        ejection_config=_cfg(min_ms=20.0, probation_s=0.4,
+                             probe_interval_s=0.25, readmit_base_s=0.4))
+    x = np.ones((1, 4), np.float32)
+    try:
+        for _ in range(12):
+            cli.predict(x)
+        group.chaos_rpc(1, "serving.infer", delay_ms=80)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            cli.predict(x)
+            if any(s["state"] == EJECTED
+                   for s in cli.ejection_states().values()):
+                break
+        states = cli.ejection_states()
+        assert any(s["state"] == EJECTED for s in states.values()), states
+        # healthz still says 3/3 ok — gray, not dead: exactly the
+        # failure crash detection cannot see
+        hz = group.healthz()
+        assert sum(1 for h in hz if h and h.get("ok")) == 3
+        # fault clears -> canaries re-admit
+        group.chaos_rpc(1, "serving.infer", clear=True)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            cli.predict(x)
+            if all(s["state"] == ACTIVE
+                   for s in cli.ejection_states().values()):
+                break
+            time.sleep(0.02)
+        assert all(s["state"] == ACTIVE
+                   for s in cli.ejection_states().values()), \
+            cli.ejection_states()
+        kinds = [e[1] for e in cli.ejection_events()]
+        assert "ejected" in kinds and (
+            "readmitted" in kinds), kinds
+    finally:
+        cli.close()
+        group.stop()

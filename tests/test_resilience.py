@@ -266,3 +266,72 @@ def test_pick_coordinator_port_gives_up_with_clear_error(monkeypatch):
             bootstrap._pick_coordinator_port(retries=3)
     finally:
         blocker.close()
+
+
+# ------------------------------------------- breaker half-open hardening
+
+def test_half_open_probe_quota_under_thread_race():
+    """Property: many threads racing allow()/record_* never admit more
+    than half_open_max probes per probe window, and the breaker never
+    wedges — after every storm of racing callers there is eventually a
+    window that admits a probe again."""
+    import threading as _threading
+
+    from zoo_tpu.util.resilience import CircuitBreaker
+
+    now = [0.0]
+    lock = _threading.Lock()
+    breaker = CircuitBreaker(failure_threshold=1, recovery_timeout=10.0,
+                             half_open_max=2, clock=lambda: now[0])
+    breaker.record_failure()          # OPEN at t=0
+    now[0] = 10.0                     # recovery due: next allow probes
+
+    admitted = []
+    barrier = _threading.Barrier(16)
+
+    def racer(i):
+        barrier.wait()
+        for _ in range(50):
+            if breaker.allow():
+                with lock:
+                    admitted.append(i)
+
+    threads = [_threading.Thread(target=racer, args=(i,))
+               for i in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # 16 threads x 50 allow() calls in ONE probe window: exactly the
+    # quota got through
+    assert len(admitted) == 2, f"{len(admitted)} probes admitted"
+    # none of the probes ever reported a verdict (callers died): the
+    # breaker must NOT be wedged — a fresh window re-admits probes
+    now[0] = 20.0
+    assert breaker.allow(), "breaker wedged after vanished probes"
+    # ... still within quota in the new window
+    assert breaker.allow()
+    assert not breaker.allow()
+    # a success verdict closes it for good
+    breaker.record_success()
+    assert breaker.state == CircuitBreaker.CLOSED
+    assert breaker.allow()
+
+
+def test_half_open_failure_reopens_and_success_closes_under_race():
+    """Concurrent probes where one fails and one succeeds: the breaker
+    lands in a legal state either way (never a stuck intermediate) and
+    keeps serving verdicts."""
+    from zoo_tpu.util.resilience import CircuitBreaker
+
+    now = [0.0]
+    breaker = CircuitBreaker(failure_threshold=1, recovery_timeout=5.0,
+                             half_open_max=2, clock=lambda: now[0])
+    breaker.record_failure()
+    now[0] = 5.0
+    assert breaker.allow() and breaker.allow()
+    breaker.record_failure()   # probe 1 verdict: reopen
+    assert breaker.state == CircuitBreaker.OPEN
+    breaker.record_success()   # probe 2 verdict: close wins last
+    assert breaker.state == CircuitBreaker.CLOSED
+    assert breaker.allow()

@@ -1,0 +1,453 @@
+"""Wire-frame integrity (CRC trailers) on both planes, deadline-budget
+sharing across retries, and reconnect jitter.
+
+docs/fault_tolerance.md: a corrupt frame must surface as
+:class:`FrameCorrupt` (a ConnectionError — retried/failed-over like a
+reset) and NEVER reach a decoder; old peers that pre-date the trailer
+interoperate through negotiation on both planes.
+"""
+
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from zoo_tpu.util.integrity import (
+    FrameCorrupt,
+    corrupt_action,
+    flip_bit,
+    frame_crc,
+    verify_crc,
+)
+from zoo_tpu.util.resilience import (
+    DeadlineExceeded,
+    RetryPolicy,
+    clear_faults,
+    inject,
+)
+
+
+def _counter_value(name, **labels):
+    from zoo_tpu.obs.metrics import get_registry
+    total = 0.0
+    for c in get_registry().snapshot()["counters"]:
+        if c["name"] == name and all(
+                c["labels"].get(k) == v for k, v in labels.items()):
+            total += c["value"]
+    return total
+
+
+# ----------------------------------------------------------- primitives
+
+def test_verify_crc_raises_connectionerror_subclass_and_counts():
+    payload = b"hello frame"
+    verify_crc(payload, frame_crc(payload), "serving")  # clean: no-op
+    before = _counter_value("zoo_wire_corrupt_frames_total",
+                            plane="serving")
+    with pytest.raises(FrameCorrupt) as ei:
+        verify_crc(flip_bit(payload), frame_crc(payload), "serving",
+                   context="unit")
+    assert isinstance(ei.value, ConnectionError)  # retry/failover path
+    assert _counter_value("zoo_wire_corrupt_frames_total",
+                          plane="serving") == before + 1
+
+
+def test_flip_bit_changes_exactly_one_bit():
+    buf = bytes(range(16))
+    flipped = flip_bit(buf, bit=13)
+    assert len(flipped) == len(buf)
+    diff = [a ^ b for a, b in zip(buf, flipped)]
+    assert sum(bin(d).count("1") for d in diff) == 1
+
+
+# -------------------------------------------------- serving-plane frames
+
+class _MarkerModel:
+    """Counts executions per distinct input value (dedup proof)."""
+
+    def __init__(self):
+        self.calls = []
+        self._lock = threading.Lock()
+
+    def predict(self, x, batch_size=None):
+        with self._lock:
+            self.calls.append(np.asarray(x).ravel()[0])
+        return np.asarray(x) * 2.0
+
+    def seen(self, v):
+        with self._lock:
+            return sum(1 for c in self.calls if c == v)
+
+
+def test_serving_crc_negotiates_and_survives_reply_corruption():
+    """Happy path: first exchange upgrades the connection to CRC
+    frames; an injected in-transit bit flip on a reply raises
+    FrameCorrupt client-side, the retry replays from the dedup cache —
+    the answer stays exact and the model ran ONCE."""
+    from zoo_tpu.serving.server import ServingServer
+    from zoo_tpu.serving.tcp_client import TCPInputQueue
+
+    model = _MarkerModel()
+    srv = ServingServer(model, port=0, batch_size=2,
+                        max_wait_ms=1.0).start()
+    try:
+        q = TCPInputQueue(srv.host, srv.port)
+        out = q.predict(np.full((1, 4), 3.0, np.float32))
+        np.testing.assert_allclose(out, 6.0)
+        assert q._conn._crc_on, "connection never upgraded to CRC"
+        before = _counter_value("zoo_wire_corrupt_frames_total",
+                                plane="serving")
+        with inject("serving.wire.corrupt", action=corrupt_action,
+                    times=1) as armed:
+            out = q.predict(np.full((1, 4), 5.0, np.float32))
+            np.testing.assert_allclose(out, 10.0)
+            assert armed.fired == 1
+        assert _counter_value("zoo_wire_corrupt_frames_total",
+                              plane="serving") == before + 1
+        assert model.seen(5.0) == 1, \
+            "corrupt-reply retry re-executed the model"
+        q.close()
+    finally:
+        clear_faults()
+        srv.stop()
+
+
+def test_serving_crc_off_server_interop(monkeypatch):
+    """A server with ZOO_WIRE_CRC=0 (stand-in for a pre-CRC build)
+    ignores the client's ``crc`` ask and answers plain — the client
+    stays on the plain protocol and everything works."""
+    monkeypatch.setenv("ZOO_WIRE_CRC", "0")
+    from zoo_tpu.serving.ha import SyntheticModel
+    from zoo_tpu.serving.server import ServingServer
+    srv = ServingServer(SyntheticModel(), port=0, batch_size=2,
+                        max_wait_ms=1.0).start()
+    monkeypatch.setenv("ZOO_WIRE_CRC", "1")  # client side wants it
+    from zoo_tpu.serving.tcp_client import TCPInputQueue
+    try:
+        q = TCPInputQueue(srv.host, srv.port)
+        out = q.predict(np.full((1, 4), 2.0, np.float32))
+        np.testing.assert_allclose(out, 4.0)
+        assert not q._conn._crc_on
+        q.close()
+    finally:
+        srv.stop()
+
+
+def test_serving_plain_legacy_client_interop():
+    """A raw plain-protocol peer (no crc field, no CRC frames — the
+    pre-trailer wire exactly) gets plain replies from a CRC-enabled
+    server: old clients keep working unchanged."""
+    from zoo_tpu.serving.codec import dumps, loads
+    from zoo_tpu.serving.ha import SyntheticModel
+    from zoo_tpu.serving.server import ServingServer
+
+    srv = ServingServer(SyntheticModel(), port=0, batch_size=2,
+                        max_wait_ms=1.0).start()
+    try:
+        sock = socket.create_connection((srv.host, srv.port))
+        payload = dumps({"op": "predict", "uri": "u",
+                         "data": np.full((1, 4), 7.0, np.float32)})
+        sock.sendall(struct.pack(">I", len(payload)) + payload)
+        (word,) = struct.unpack(">I", sock.recv(4, socket.MSG_WAITALL))
+        assert not (word & 0x80000000), \
+            "server sent a CRC frame to a plain-protocol peer"
+        body = b""
+        while len(body) < word:
+            body += sock.recv(word - len(body))
+        resp = loads(body)
+        np.testing.assert_allclose(resp["result"], 14.0)
+        sock.close()
+    finally:
+        srv.stop()
+
+
+def test_corrupt_request_dropped_and_retry_is_idempotent():
+    """Client→server corruption: the server cannot trust a corrupt
+    frame, drops the connection (counted), and the client's retry —
+    same request id, fresh connection — executes exactly once."""
+    from zoo_tpu.serving.server import ServingServer
+    from zoo_tpu.serving.tcp_client import TCPInputQueue
+
+    model = _MarkerModel()
+    srv = ServingServer(model, port=0, batch_size=2,
+                        max_wait_ms=1.0).start()
+    try:
+        q = TCPInputQueue(srv.host, srv.port)
+        q.predict(np.full((1, 4), 1.0, np.float32))  # upgrade to CRC
+        # fire on the SECOND send this connection makes (the request),
+        # and never on the retry
+        with inject("serving.wire.corrupt", action=corrupt_action,
+                    times=1) as armed:
+            out = q.predict(np.full((1, 4), 9.0, np.float32))
+            np.testing.assert_allclose(out, 18.0)
+            assert armed.fired == 1
+        assert model.seen(9.0) == 1
+        q.close()
+    finally:
+        clear_faults()
+        srv.stop()
+
+
+# --------------------------------------------------- shard-plane frames
+
+def test_shard_crc_negotiated_and_corruption_refetched():
+    from zoo_tpu.orca.data.plane import (
+        ExchangeConfig,
+        ShardExchange,
+        _pool,
+        fetch_many,
+    )
+
+    shards = {0: {"x": np.arange(2048, dtype=np.float32),
+                  "y": np.arange(64, dtype=np.int64)}}
+    ex = ShardExchange(shards, bind="127.0.0.1")
+    cfg = ExchangeConfig()
+    assert cfg.crc, "ZOO_WIRE_CRC default should be on"
+    try:
+        before = _counter_value("zoo_wire_corrupt_frames_total",
+                                plane="shard")
+        with inject("shard.wire.corrupt", action=corrupt_action,
+                    times=1) as armed:
+            out = fetch_many(("127.0.0.1", ex.port), [0], config=cfg)
+            assert armed.fired == 1
+        np.testing.assert_array_equal(out[0]["x"], shards[0]["x"])
+        np.testing.assert_array_equal(out[0]["y"], shards[0]["y"])
+        assert _counter_value("zoo_wire_corrupt_frames_total",
+                              plane="shard") == before + 1
+    finally:
+        clear_faults()
+        ex.close()
+        _pool.clear()
+
+
+def test_shard_crc_on_shm_lane(monkeypatch):
+    """The trailer covers the SEGMENT bytes on the shm lane: a bit
+    flipped in the mapped payload is caught before decode and the
+    chunk refetches clean."""
+    monkeypatch.setenv("ZOO_SHARD_LANE", "shm")
+    from zoo_tpu.orca.data.plane import (
+        ExchangeConfig,
+        ShardExchange,
+        _pool,
+        fetch_many,
+    )
+
+    shards = {0: {"x": np.arange(4096, dtype=np.float32)}}
+    ex = ShardExchange(shards, bind="127.0.0.1")
+    cfg = ExchangeConfig()
+    try:
+        with inject("shard.wire.corrupt", action=corrupt_action,
+                    times=1) as armed:
+            out = fetch_many(("127.0.0.1", ex.port), [0], config=cfg)
+            assert armed.fired == 1
+        np.testing.assert_array_equal(out[0]["x"], shards[0]["x"])
+    finally:
+        clear_faults()
+        ex.close()
+        _pool.clear()
+
+
+def test_shard_legacy_peer_negotiates_crc_off():
+    """A ZSX2-only exchange (negotiate=False — the pre-negotiation
+    build) still serves a CRC-wanting client over the plain protocol."""
+    from zoo_tpu.orca.data.plane import (
+        ExchangeConfig,
+        ShardExchange,
+        _pool,
+        fetch_many,
+    )
+
+    shards = {0: {"x": np.arange(256, dtype=np.float32)}}
+    ex = ShardExchange(shards, bind="127.0.0.1", negotiate=False)
+    try:
+        out = fetch_many(("127.0.0.1", ex.port), [0],
+                         config=ExchangeConfig())
+        np.testing.assert_array_equal(out[0]["x"], shards[0]["x"])
+    finally:
+        ex.close()
+        _pool.clear()
+
+
+# ------------------------------------------- deadline budget is SHARED
+
+class _RecordingServer:
+    """Minimal ZSRV fake: records each request's stamped deadline_ms
+    and answers; can drop the first N connections after a delay."""
+
+    def __init__(self, drop_first: int = 0, drop_delay: float = 0.0):
+        self._listener = socket.socket()
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(8)
+        self.host, self.port = self._listener.getsockname()
+        self.deadlines = []
+        self._drop_first = drop_first
+        self._drop_delay = drop_delay
+        self._accepted = 0
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def _serve(self):
+        from zoo_tpu.serving.server import _recv_frame, _send_msg
+        while True:
+            try:
+                s, _ = self._listener.accept()
+            except OSError:
+                return
+            self._accepted += 1
+            if self._accepted <= self._drop_first:
+                time.sleep(self._drop_delay)
+                s.close()
+                continue
+
+            def handle(sock=s):
+                try:
+                    while True:
+                        msg, _crc = _recv_frame(sock)
+                        if msg is None:
+                            return
+                        self.deadlines.append(msg.get("deadline_ms"))
+                        _send_msg(sock, {
+                            "id": msg.get("id"),
+                            "result": np.zeros((1, 2), np.float32)})
+                except OSError:
+                    pass
+
+            threading.Thread(target=handle, daemon=True).start()
+
+    def close(self):
+        self._listener.close()
+
+
+def test_deadline_budget_shared_across_connection_retries():
+    """Regression (the deadline-propagation audit): a slow, failing
+    first attempt must leave the RETRY only the remaining budget — the
+    re-stamped deadline_ms shrinks by the time already burned, and the
+    whole call never outlives the original budget."""
+    from zoo_tpu.serving.tcp_client import _Connection
+
+    fake = _RecordingServer()
+    try:
+        conn = _Connection(fake.host, fake.port,
+                           retry=RetryPolicy(max_attempts=3,
+                                             base_delay=0.01,
+                                             max_delay=0.02))
+        # first attempt burns 400ms then fails at the transport
+        with inject("serving.request",
+                    exc=ConnectionResetError("slow then dead"),
+                    action=lambda **k: time.sleep(0.4), times=1):
+            t0 = time.monotonic()
+            from zoo_tpu.util.resilience import Deadline
+            resp = conn.rpc({"op": "predict", "uri": "u",
+                             "data": np.zeros((1, 2), np.float32)},
+                            deadline=Deadline(1.0))
+            wall = time.monotonic() - t0
+        assert "result" in resp
+        assert len(fake.deadlines) == 1
+        stamped = fake.deadlines[0]
+        # the retry rode the REMAINING budget: 1000ms minus the 400ms
+        # the slow attempt burned (plus backoff), never a fresh 1000
+        assert stamped is not None and stamped <= 600.0, stamped
+        assert stamped > 0
+        assert wall < 1.2
+        conn.close()
+    finally:
+        clear_faults()
+
+
+def test_deadline_expired_by_slow_attempt_is_terminal():
+    """When the first attempt burns the WHOLE budget, the retry raises
+    DeadlineExceeded before sending — it never resets to a fresh
+    budget and never hangs."""
+    from zoo_tpu.serving.tcp_client import _Connection
+    from zoo_tpu.util.resilience import Deadline
+
+    fake = _RecordingServer()
+    try:
+        conn = _Connection(fake.host, fake.port,
+                           retry=RetryPolicy(max_attempts=3,
+                                             base_delay=0.01,
+                                             max_delay=0.02))
+        with inject("serving.request",
+                    exc=ConnectionResetError("slow then dead"),
+                    action=lambda **k: time.sleep(0.35), times=1):
+            t0 = time.monotonic()
+            with pytest.raises(DeadlineExceeded):
+                conn.rpc({"op": "predict", "uri": "u",
+                          "data": np.zeros((1, 2), np.float32)},
+                         deadline=Deadline(0.3))
+            wall = time.monotonic() - t0
+        assert wall < 0.8, "expired budget still cost extra attempts"
+        assert fake.deadlines == [], "an expired request hit the wire"
+        conn.close()
+    finally:
+        clear_faults()
+
+
+def test_deadline_budget_shared_across_ha_failover():
+    """HA-level: the failover attempt after a dropped-slow seat stamps
+    the REMAINING budget onto the next replica's wire frame."""
+    from zoo_tpu.serving.ha_client import HAServingClient
+
+    dead = _RecordingServer(drop_first=99, drop_delay=0.4)
+    live = _RecordingServer()
+    try:
+        cli = HAServingClient(
+            [(dead.host, dead.port), (live.host, live.port)],
+            deadline_ms=2000, hedge=False, eject=False)
+        # force the plan to start at the dead seat
+        cli._rr = 0
+        resp = cli.rpc({"op": "predict", "uri": "u",
+                        "data": np.zeros((1, 2), np.float32)})
+        assert "result" in resp
+        assert len(live.deadlines) == 1
+        assert live.deadlines[0] <= 1700.0, live.deadlines
+        cli.close()
+    finally:
+        dead.close()
+        live.close()
+
+
+# ------------------------------------------------- reconnect jitter
+
+def test_reconnect_jitter_after_poisoned_drop_only():
+    from zoo_tpu.serving.ha import SyntheticModel
+    from zoo_tpu.serving.server import ServingServer
+    from zoo_tpu.serving.tcp_client import _Connection
+
+    srv = ServingServer(SyntheticModel(), port=0, batch_size=2,
+                        max_wait_ms=1.0).start()
+    try:
+        # deterministic jitter: rng pinned to 1.0 => full backoff
+        conn = _Connection(srv.host, srv.port,
+                           retry=RetryPolicy(max_attempts=1,
+                                             base_delay=0.2,
+                                             max_delay=0.5,
+                                             rng=lambda: 1.0))
+        msg = {"op": "predict", "uri": "u",
+               "data": np.ones((1, 2), np.float32)}
+        conn.rpc(dict(msg))
+        # a POISONED drop (server reset / corrupt frame) jitters the
+        # reconnect with RetryPolicy.backoff — here backoff(1)=0.2s
+        conn._drop()
+        t0 = time.monotonic()
+        conn.rpc(dict(msg))
+        assert time.monotonic() - t0 >= 0.2, \
+            "no jitter on reconnect after a poisoned drop"
+        # a CLEAN close (pool hygiene) reconnects immediately
+        conn.close()
+        t0 = time.monotonic()
+        conn.rpc(dict(msg))
+        assert time.monotonic() - t0 < 0.15, \
+            "clean reopen paid the respawn jitter"
+        # success reset the streak: the NEXT poisoned drop starts the
+        # ladder at backoff(1) again, not backoff(3)
+        conn._drop()
+        t0 = time.monotonic()
+        conn.rpc(dict(msg))
+        dt = time.monotonic() - t0
+        assert 0.2 <= dt < 0.45, dt
+        conn.close()
+    finally:
+        srv.stop()

@@ -132,11 +132,20 @@ class FlightRecorder:
         f = self._spill_f
         if f is not None:
             try:
+                # chaos seam: a full spill dir (disk-full gray failure)
+                # must degrade to dropped spill lines, never kill the
+                # instrumented operation — the storm arms this site
+                # with an OSError to prove it (lazy import: this module
+                # sits below resilience in the layering)
+                from zoo_tpu.util.resilience import fault_point
+                fault_point("flight.spill")
                 with self._lock:
                     f.write(json.dumps(ev, separators=(",", ":"),
                                        default=str) + "\n")
                     f.flush()
-            except (OSError, ValueError) as e:
+            except (OSError, ValueError, ImportError) as e:
+                # ImportError: interpreter teardown mid-record — the
+                # spill line is lost, the process must not care
                 logger.debug("flight spill write dropped: %s", e)
 
     def events(self) -> List[dict]:

@@ -65,6 +65,24 @@ _worker_restarts = counter(
 _workers_hung = counter(
     "zoo_worker_hung_total",
     "Supervised workers killed for a stale heartbeat")
+_worker_quarantines = counter(
+    "zoo_worker_quarantine_total",
+    "Quarantine-mode transitions performed by supervisors in this "
+    "process (quarantined = a worker exhausted its restart budget and "
+    "was parked instead of killing the group; probe = a backoff-timed "
+    "respawn attempt; readmitted = a probe survived the heal window "
+    "and the seat returned to normal supervision)",
+    labels=("event",))
+
+
+def _flight(kind: str, **fields):
+    """Flight-recorder event (lazy import — supervision must never fail
+    to load because the obs ring could not)."""
+    try:
+        from zoo_tpu.obs.flight import record_event
+        record_event(kind, **fields)
+    except Exception:  # noqa: BLE001 — telemetry never fails the op
+        pass
 
 _PR_SET_PDEATHSIG = 1
 
@@ -127,6 +145,15 @@ class WorkerProcess:
         self.restarts = 0
         self._log_fh = None
         self.heartbeat_spawn_mtime: Optional[float] = None
+        # quarantine-mode state (docs/fault_tolerance.md): set by a
+        # ProcessMonitor(quarantine=True) when this worker exhausts its
+        # restart budget — parked, probed on a backoff timer, readmitted
+        # after a probe survives the heal window
+        self.quarantined = False
+        self.quarantine_until = 0.0
+        self.quarantine_backoff = 0.0
+        self.quarantines = 0
+        self.last_spawn_monotonic: Optional[float] = None
 
     def spawn(self):
         if self._log_fh:  # restart: release the previous run's handle
@@ -155,6 +182,7 @@ class WorkerProcess:
         self.proc = subprocess.Popen(
             self.cmd, env=self.env, stdout=out, stderr=err,
             preexec_fn=_child_preexec)
+        self.last_spawn_monotonic = time.monotonic()
         return self.proc
 
     @property
@@ -195,16 +223,36 @@ class ProcessMonitor:
     SIGKILLed and charged against the restart budget when the file goes
     stale for longer than this many seconds — a worker stuck in a dead
     collective is a crash the same as one that exited nonzero.
+
+    ``quarantine``: what happens when ONE worker exhausts its restart
+    budget. ``False`` (default — training semantics): the whole group
+    is torn down and :meth:`wait` raises, because a gang-scheduled job
+    cannot run short a rank. ``True`` (serving semantics, what
+    :class:`~zoo_tpu.serving.ha.ReplicaGroup` passes): the crash-looping
+    worker is QUARANTINED — parked with a flight-ring event instead of
+    silently burning the group — while its siblings keep serving; a
+    probe respawn is attempted on an exponential-backoff timer
+    (``ZOO_QUARANTINE_PROBE_S`` base, ``ZOO_QUARANTINE_PROBE_MAX_S``
+    cap), and a probe that stays alive for ``ZOO_QUARANTINE_HEAL_S``
+    re-admits the seat with a fresh restart budget.
     """
 
     def __init__(self, workers: List[WorkerProcess], max_restarts: int = 0,
                  poll_interval: float = 0.2,
                  heartbeat_timeout: Optional[float] = None,
-                 heartbeat_boot_grace: float = 120.0):
+                 heartbeat_boot_grace: float = 120.0,
+                 quarantine: bool = False):
+        from zoo_tpu.util.resilience import env_float
         self.workers = workers
         self.max_restarts = int(max_restarts)
         self.poll_interval = poll_interval
         self.heartbeat_timeout = heartbeat_timeout
+        self.quarantine = bool(quarantine)
+        self.quarantine_probe_s = env_float("ZOO_QUARANTINE_PROBE_S",
+                                            5.0)
+        self.quarantine_probe_max_s = env_float(
+            "ZOO_QUARANTINE_PROBE_MAX_S", 60.0)
+        self.quarantine_heal_s = env_float("ZOO_QUARANTINE_HEAL_S", 30.0)
         # until a worker has beaten ON ITS OWN at least once it is
         # booting, not hung — a cold `import jax` alone can outlast a
         # tight heartbeat_timeout; the boot window gets the larger bound
@@ -259,9 +307,82 @@ class ProcessMonitor:
                         f"{limit}s limit)")
         return None
 
+    def _probe_beating(self, w: WorkerProcess) -> bool:
+        """Whether a live quarantine probe has proven PROGRESS, not
+        just liveness: with heartbeat monitoring armed, the probe must
+        have beaten on its own since the spawn and be fresh — a probe
+        wedged at boot must never read as healed (it would be
+        re-admitted with a fresh budget, hung-killed, re-quarantined,
+        and churn forever)."""
+        if not (self.heartbeat_timeout and w.heartbeat_file):
+            return True  # no heartbeat contract: alive is the bar
+        age = heartbeat_age(w.heartbeat_file)
+        try:
+            mtime = os.stat(w.heartbeat_file).st_mtime
+        except OSError:
+            return False
+        booted = (w.heartbeat_spawn_mtime is not None
+                  and mtime > w.heartbeat_spawn_mtime)
+        return booted and age is not None and \
+            age <= self.heartbeat_timeout
+
+    def _watch_quarantined(self, w: WorkerProcess):
+        """One poll of a quarantined seat: probe respawns on the
+        backoff timer, re-admission after a probe survives the heal
+        window. Never touches the group."""
+        now = time.monotonic()
+        if w.returncode is None and w.last_spawn_monotonic is not None:
+            if now - w.last_spawn_monotonic >= self.quarantine_heal_s:
+                if not self._probe_beating(w):
+                    # alive past the heal window but HUNG: the probe
+                    # failed — kill it; the dead-seat branch below
+                    # schedules the next (longer) backoff
+                    logger.warning(
+                        "%s quarantine probe is alive but not beating "
+                        "— hung probe killed, staying quarantined",
+                        w.name)
+                    w.kill()
+                    return
+                # the probe held AND made progress: the seat is a real
+                # replica again, with a fresh restart budget
+                w.quarantined = False
+                w.restarts = 0
+                w.quarantine_backoff = 0.0
+                _worker_quarantines.labels(event="readmitted").inc()
+                _flight("replica_unquarantined", worker=w.name,
+                        quarantines=w.quarantines)
+                logger.warning(
+                    "%s survived its quarantine probe for %.0fs; "
+                    "re-admitted with a fresh restart budget",
+                    w.name, self.quarantine_heal_s)
+            return
+        if w.returncode is None:
+            return  # probe still running inside the heal window
+        if now < w.quarantine_until:
+            return  # dead, waiting out the backoff
+        with self._lock:
+            if self._stop.is_set():
+                return
+            # each failed probe doubles the next wait (capped): a seat
+            # with a genuinely broken substrate converges to one cheap
+            # respawn a minute instead of a crash loop
+            w.quarantine_backoff = min(
+                max(self.quarantine_probe_s, 2 * w.quarantine_backoff),
+                self.quarantine_probe_max_s)
+            w.quarantine_until = now + w.quarantine_backoff
+            _worker_quarantines.labels(event="probe").inc()
+            _flight("replica_quarantine_probe", worker=w.name,
+                    next_backoff_s=w.quarantine_backoff)
+            logger.info("%s quarantine probe respawn (next backoff "
+                        "%.1fs)", w.name, w.quarantine_backoff)
+            w.spawn()
+
     def _watch(self):
         while not self._stop.is_set():
             for w in self.workers:
+                if w.quarantined:
+                    self._watch_quarantined(w)
+                    continue
                 reason = self._crash_reason(w)
                 if reason is None:
                     continue
@@ -275,6 +396,40 @@ class ProcessMonitor:
                             "%s %s; restart %d/%d", w.name, reason,
                             w.restarts, self.max_restarts)
                         w.spawn()
+                elif self.quarantine:
+                    # serving semantics: the seat exhausted its budget
+                    # — park it LOUDLY (flight event + counter; the
+                    # gauge rides ReplicaGroup.healthz) instead of the
+                    # old silent permanent death, and keep probing it
+                    # back on a backoff timer while the rest of the
+                    # group serves on
+                    with self._lock:
+                        if self._stop.is_set():
+                            return
+                        w.quarantined = True
+                        w.quarantines += 1
+                        # a RE-quarantine (a seat whose earlier probe
+                        # "healed" then failed again) continues the
+                        # backoff ladder instead of resetting to the
+                        # base — only a genuine readmission clears it
+                        w.quarantine_backoff = min(
+                            max(self.quarantine_probe_s,
+                                2 * w.quarantine_backoff),
+                            self.quarantine_probe_max_s)
+                        w.quarantine_until = (time.monotonic()
+                                              + w.quarantine_backoff)
+                        _worker_quarantines.labels(
+                            event="quarantined").inc()
+                        _flight("replica_quarantined", worker=w.name,
+                                reason=reason, restarts=w.restarts,
+                                probe_backoff_s=w.quarantine_backoff)
+                        logger.error(
+                            "%s %s with no restart budget left "
+                            "(%d/%d) — QUARANTINED; probing back every "
+                            "%.1fs (doubling, cap %.0fs)",
+                            w.name, reason, w.restarts,
+                            self.max_restarts, w.quarantine_backoff,
+                            self.quarantine_probe_max_s)
                 else:
                     with self._lock:
                         if self._stop.is_set():
@@ -335,6 +490,11 @@ class ProcessMonitor:
 
     def alive(self) -> List[str]:
         return [w.name for w in self.workers if w.returncode is None]
+
+    def quarantined(self) -> List[str]:
+        """Names of workers currently parked in quarantine — every
+        seat accounted for, none silently missing."""
+        return [w.name for w in self.workers if w.quarantined]
 
     def stop(self):
         with self._lock:  # no respawn may interleave with the kills

@@ -80,6 +80,7 @@ from zoo_tpu.obs.tracing import span
 from zoo_tpu.orca.data import shm as _shm
 from zoo_tpu.orca.data.wire_codec import (
     FLAG_COMPRESSED,
+    FLAG_CRC,
     FLAG_NARROWED,
     FLAG_SHM,
     WirePolicy,
@@ -88,6 +89,12 @@ from zoo_tpu.orca.data.wire_codec import (
     payload_view as _payload_view,
     supported_codecs,
     supported_wire_dtypes,
+)
+from zoo_tpu.util.integrity import (
+    corrupt_seam,
+    frame_crc,
+    verify_crc,
+    wire_crc_enabled,
 )
 from zoo_tpu.util.resilience import RetryPolicy, fault_point
 
@@ -172,8 +179,13 @@ class ExchangeConfig:
                  lane: Optional[str] = None,
                  wire_dtype: Optional[str] = None,
                  wire_compress: Optional[str] = None,
-                 readahead: Optional[str] = None):
+                 readahead: Optional[str] = None,
+                 crc: Optional[bool] = None):
         env = os.environ
+        # per-array payload CRC (ZOO_WIRE_CRC, default on): negotiated
+        # in the ZSXN hello like every other wire feature — a peer that
+        # pre-dates it simply never grants it
+        self.crc = bool(crc) if crc is not None else wire_crc_enabled()
         self.multiget = max(1, min(int(
             multiget if multiget is not None
             else env.get("ZOO_SHARD_MULTIGET", "32")), 0xFFFF))
@@ -215,16 +227,18 @@ class ExchangeConfig:
 
     def wants_negotiation(self) -> bool:
         """Whether a fresh connection should attempt the ZSXN hello:
-        any non-default wire feature, or the (default) auto lane whose
-        same-host probe IS the negotiation."""
+        any non-default wire feature, the (default) auto lane whose
+        same-host probe IS the negotiation, or the (default-on) CRC
+        integrity trailer."""
         return (self.lane != "tcp" or self.wire_dtype != "off"
-                or self.wire_compress != "off")
+                or self.wire_compress != "off" or self.crc)
 
     def clone(self) -> "ExchangeConfig":
         return ExchangeConfig(
             multiget=self.multiget, concurrency=self.concurrency,
             lane=self.lane, wire_dtype=self.wire_dtype,
-            wire_compress=self.wire_compress, readahead=self.readahead)
+            wire_compress=self.wire_compress, readahead=self.readahead,
+            crc=self.crc)
 
     def __repr__(self):
         return (f"ExchangeConfig(multiget={self.multiget}, "
@@ -401,7 +415,8 @@ class _Conn:
     after a successful ZSXN hello on THIS socket, so the state must
     travel with the socket through the pool)."""
 
-    __slots__ = ("sock", "negotiated", "policy", "lane", "shm_dir")
+    __slots__ = ("sock", "negotiated", "policy", "lane", "shm_dir",
+                 "crc")
 
     def __init__(self, sock: socket.socket):
         self.sock = sock
@@ -409,6 +424,7 @@ class _Conn:
         self.policy: Optional[WirePolicy] = None
         self.lane = "tcp"
         self.shm_dir: Optional[str] = None
+        self.crc = False  # peer granted per-array CRC trailers
 
     def close(self):
         try:
@@ -545,6 +561,7 @@ class _ServerConnState:
 
     def __init__(self):
         self.policy: Optional[WirePolicy] = None
+        self.crc = False  # client proposed + this build grants CRC
         self.shm_dir: Optional[str] = None
         self.probe_path: Optional[str] = None
         self.shm_pending = False
@@ -701,7 +718,12 @@ class ShardExchange:
         comp = next((c for c in prop.get("compress", [])
                      if c in supported_codecs()), "off")
         st.policy = WirePolicy(dtype, comp)
-        reply = {"v": 2, "dtype": dtype, "compress": comp, "shm": None}
+        # integrity trailer: granted only when the fetcher proposed it
+        # AND this server wants it (ZOO_WIRE_CRC) — old clients never
+        # propose, old servers never answer, either way it stays off
+        st.crc = bool(prop.get("crc")) and wire_crc_enabled()
+        reply = {"v": 2, "dtype": dtype, "compress": comp,
+                 "crc": st.crc, "shm": None}
         if prop.get("shm"):
             try:
                 d = _shm.shm_dir()
@@ -764,12 +786,21 @@ class ShardExchange:
         parts = [_array_header(name, arr)]
         if writer is not None:
             flags |= FLAG_SHM
+        if st.crc:
+            flags |= FLAG_CRC
         parts.append(struct.pack("!B", flags))
         if flags & FLAG_NARROWED:
             parts.append(struct.pack("!H", len(wdescr)) + wdescr +
                          struct.pack("!d", scale))
         if flags & (FLAG_NARROWED | FLAG_COMPRESSED):
             parts.append(struct.pack("!Q", pv.nbytes))
+        if flags & FLAG_CRC:
+            # CRC of the bytes as TRANSPORTED (narrowed/compressed for
+            # the socket, the segment bytes for shm) — computed before
+            # the corruption seam, so injected "in-transit" bit rot is
+            # caught on the receiving side exactly like the real thing
+            parts.append(struct.pack("!I", frame_crc(pv)))
+            pv = memoryview(corrupt_seam("shard.wire.corrupt", pv))
         if writer is not None:
             parts.append(struct.pack("!Q", writer.write(pv)))
             conn.sendall(b"".join(parts))
@@ -853,6 +884,7 @@ def _negotiate_conn(conn: _Conn, addr, cfg: ExchangeConfig,
     prop = {"v": 2, "dtype": cfg.wire_dtype,
             "compress": ([] if cfg.wire_compress == "off"
                          else [cfg.wire_compress]),
+            "crc": cfg.crc,
             "shm": cfg.lane in ("auto", "shm")}
     blob = json.dumps(prop).encode("utf-8")
     sock.sendall(_MAGIC_HELLO + struct.pack("!H", len(blob)) + blob)
@@ -869,6 +901,10 @@ def _negotiate_conn(conn: _Conn, addr, cfg: ExchangeConfig,
     reply = json.loads(bytes(_recv_exact(sock, ln)).decode("utf-8"))
     conn.policy = WirePolicy(reply.get("dtype", "off"),
                              reply.get("compress", "off"))
+    # a pre-CRC server's reply simply lacks the key → stays off; a
+    # frame-integrity downgrade is a soft loss (log once via the memo
+    # machinery), never a hard error — unlike the forced shm lane
+    conn.crc = bool(reply.get("crc"))
     conn.negotiated = True
     shm_info = reply.get("shm")
     ok = bool(shm_info) and _shm.check_probe(
@@ -910,9 +946,9 @@ def _conn_matches(conn: _Conn, addr, cfg: ExchangeConfig) -> bool:
     if not cfg.wants_negotiation():
         return False  # cfg wants bit-plain v2 framing; conn is extended
     pol = conn.policy or WirePolicy()
-    requested = (cfg.wire_dtype, cfg.wire_compress)
+    requested = (cfg.wire_dtype, cfg.wire_compress, cfg.crc)
     granted = _pool.granted_for(addr, requested)
-    if (pol.dtype, pol.compress) != (granted or requested):
+    if (pol.dtype, pol.compress, conn.crc) != (granted or requested):
         return False
     if cfg.lane == "shm" and conn.lane != "shm":
         return False
@@ -959,8 +995,8 @@ def _acquire_conn(addr, timeout: float, pool: bool,
             if _negotiate_conn(conn, addr, cfg, timeout):
                 pol = conn.policy or WirePolicy()
                 _pool.remember_outcome(
-                    addr, (cfg.wire_dtype, cfg.wire_compress),
-                    (pol.dtype, pol.compress))
+                    addr, (cfg.wire_dtype, cfg.wire_compress, cfg.crc),
+                    (pol.dtype, pol.compress, conn.crc))
                 return conn
         except ProtocolError:
             conn.close()
@@ -1046,7 +1082,7 @@ def _read_shard(conn: _Conn, seg: Optional[_shm.SegmentReader]
             wire += header_len + nbytes
             continue
         (flags,) = struct.unpack("!B", _recv_exact(sock, 1))
-        wdescr, scale, wn = None, 0.0, nbytes
+        wdescr, scale, wn, crc = None, 0.0, nbytes, None
         if flags & FLAG_NARROWED:
             (dlen,) = struct.unpack("!H", _recv_exact(sock, 2))
             wdescr = bytes(_recv_exact(sock, dlen)).decode("ascii")
@@ -1060,6 +1096,9 @@ def _read_shard(conn: _Conn, seg: Optional[_shm.SegmentReader]
                     f"array {name!r}: wire length {wn} exceeds logical "
                     f"{nbytes} — narrowing/compression can only shrink; "
                     "corrupt or desynchronized stream")
+        if flags & FLAG_CRC:
+            (crc,) = struct.unpack("!I", _recv_exact(sock, 4))
+            header_len += 4
         if flags & FLAG_SHM:
             (off,) = struct.unpack("!Q", _recv_exact(sock, 8))
             if seg is None:
@@ -1069,6 +1108,12 @@ def _read_shard(conn: _Conn, seg: Optional[_shm.SegmentReader]
             buf = seg.view(off, wn)
         else:
             buf = _recv_exact(sock, wn) if wn else b""
+        if crc is not None:
+            # integrity gate BEFORE any decode: a flipped bit (socket,
+            # NIC, or a torn shm read) raises FrameCorrupt — a
+            # ConnectionError, so the chunk is refetched on a fresh
+            # connection instead of np.frombuffer-ing garbage
+            verify_crc(buf, crc, "shard", context=f"array {name!r}")
         try:
             shard[name] = decode_payload(
                 buf, flags, dt, shape, wdescr, scale,

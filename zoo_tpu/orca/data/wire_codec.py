@@ -47,12 +47,18 @@ except ImportError:  # pragma: no cover - environment-dependent
 
 __all__ = ["WirePolicy", "encode_array", "decode_payload",
            "supported_codecs", "supported_wire_dtypes",
-           "FLAG_NARROWED", "FLAG_COMPRESSED", "FLAG_SHM",
+           "FLAG_NARROWED", "FLAG_COMPRESSED", "FLAG_SHM", "FLAG_CRC",
            "WIRE_DTYPES", "absmax_scale", "narrow_int8", "widen_int8"]
 
 FLAG_NARROWED = 0x01
 FLAG_COMPRESSED = 0x02
 FLAG_SHM = 0x04  # payload field is a segment offset, not inline bytes
+FLAG_CRC = 0x08  # a u32 CRC of the wire payload follows the headers
+#                  (negotiated via the ZSXN hello "crc" capability;
+#                  covers the bytes as transported — narrowed/compressed
+#                  for the TCP lane, the mapped segment bytes for shm —
+#                  so bit rot ANYWHERE between encode and decode is
+#                  caught before np.frombuffer ever runs)
 
 WIRE_DTYPES = ("off", "bf16", "int8")
 

@@ -45,6 +45,12 @@ _replicas_healthy = gauge(
 _replica_restarts = gauge(
     "zoo_serve_replica_restarts",
     "Total replica respawns performed by this ReplicaGroup's supervisor")
+_replicas_quarantined = gauge(
+    "zoo_serve_replicas_quarantined",
+    "Replica seats that exhausted their restart budget and are parked "
+    "in quarantine (probed back on an exponential-backoff timer) — a "
+    "nonzero value means the group is serving short-handed and a "
+    "postmortem bundle is waiting in the log dir")
 _rolling_updates = counter(
     "zoo_serve_rolling_update_total",
     "Rolling updates driven by this ReplicaGroup, by outcome "
@@ -248,9 +254,14 @@ class ReplicaGroup:
                      "--max-wait-ms", str(max_wait_ms)],
                 env=wenv, name=f"serving-replica-{i}", log_dir=log_dir,
                 heartbeat_file=hb))
+        # quarantine=True: a seat that exhausts max_restarts is parked
+        # (flight event + zoo_serve_replicas_quarantined gauge +
+        # backoff re-admission probes) instead of tearing down the
+        # whole group — its healthy siblings keep serving while the
+        # clients fail over around the empty seat
         self._monitor = ProcessMonitor(
             workers, max_restarts=max_restarts,
-            heartbeat_timeout=heartbeat_timeout)
+            heartbeat_timeout=heartbeat_timeout, quarantine=True)
         self._started = False
 
     # -- lifecycle ---------------------------------------------------------
@@ -320,21 +331,61 @@ class ReplicaGroup:
         except Exception:  # noqa: BLE001 — probing must never fail on
             pass           # a harvest hiccup
         out: List[Optional[Dict]] = []
-        for mport in self.metrics_ports:
+        for i, mport in enumerate(self.metrics_ports):
             try:
                 with urllib.request.urlopen(
                         f"http://{self.host}:{mport}/healthz",
                         timeout=timeout) as resp:
                     out.append(json.loads(resp.read().decode()))
             except Exception:  # noqa: BLE001 — a down replica is data
-                out.append(None)
+                w = self._monitor.workers[i]
+                # EVERY seat accounts: a quarantined one answers with
+                # an explicit verdict instead of a bare None, so the
+                # probe (and the postmortem reading it) can tell "seat
+                # parked after exhausting its restart budget" from
+                # "seat mid-respawn"
+                out.append({"ok": False, "quarantined": True,
+                            "restarts": w.restarts}
+                           if w.quarantined else None)
         _replicas_healthy.set(
             sum(1 for h in out if h is not None and h.get("ok")))
         _replica_restarts.set(self.restarts())
+        _replicas_quarantined.set(len(self._monitor.quarantined()))
         return out
 
     def restarts(self) -> int:
         return sum(w.restarts for w in self._monitor.workers)
+
+    def quarantined(self) -> List[str]:
+        """Seats currently parked in quarantine (also published as the
+        ``zoo_serve_replicas_quarantined`` gauge on every healthz
+        sweep)."""
+        return self._monitor.quarantined()
+
+    def chaos_rpc(self, i: int, site: str, delay_ms: float = None,
+                  error: str = None, p: float = 1.0, times: int = None,
+                  clear: bool = False, timeout: float = 5.0) -> Dict:
+        """Arm (or clear) a fault site INSIDE replica ``i`` over the
+        wire ``chaos`` op — the remote half of the deterministic chaos
+        harness (docs/fault_tolerance.md). The replica refuses unless
+        its env carries ``ZOO_CHAOS_ALLOW=1`` (pass it via ``env=`` at
+        group construction, as the chaos smokes do)."""
+        msg: Dict = {"op": "chaos", "site": site}
+        if clear:
+            msg["clear"] = 1
+        else:
+            if delay_ms is not None:
+                msg["delay_ms"] = float(delay_ms)
+            if error is not None:
+                msg["error"] = error
+            if times is not None:
+                msg["times"] = int(times)
+            msg["p"] = float(p)
+        resp = self._rpc(i, msg, timeout)
+        if not resp.get("ok"):
+            raise RuntimeError(
+                f"chaos op on replica {i} refused: {resp.get('error')}")
+        return resp
 
     # -- postmortem harvest (docs/observability.md) ------------------------
     def _flight_dir(self, i: int) -> Optional[str]:

@@ -51,6 +51,12 @@ import numpy as np
 
 from zoo_tpu.obs.metrics import counter, histogram
 from zoo_tpu.obs.tracing import emit_span, new_trace_id
+from zoo_tpu.serving.ejection import (
+    EJECTED,
+    PROBATION,
+    EjectionConfig,
+    EjectionController,
+)
 from zoo_tpu.serving.tcp_client import _Connection
 from zoo_tpu.util.resilience import (
     CircuitBreaker,
@@ -162,10 +168,14 @@ class _Endpoint:
     is blocked in recv, so connections are checked out per attempt)."""
 
     def __init__(self, host: str, port: int, tls: bool, cafile,
-                 verify: bool, breaker: CircuitBreaker):
+                 verify: bool, breaker: CircuitBreaker, score=None):
         self.host, self.port = host, int(port)
         self._tls, self._cafile, self._verify = tls, cafile, verify
         self.breaker = breaker
+        # gray-failure rolling score (docs/fault_tolerance.md): EWMA
+        # latency/error per seat, walked through probation/ejection by
+        # the client's EjectionController
+        self.score = score
         # the registry version this seat last echoed ("vN"); None until
         # a reply teaches us — steers version-pinned routing without
         # probe round-trips, and is only a HINT (the server enforces)
@@ -224,9 +234,19 @@ class HAServingClient:
                  verify: bool = True,
                  breaker_failures: int = 2,
                  breaker_recovery: Optional[float] = None,
-                 ab_split: Optional[Dict[str, float]] = None):
+                 ab_split: Optional[Dict[str, float]] = None,
+                 eject: Optional[bool] = None,
+                 ejection_config: Optional[EjectionConfig] = None):
+        """``eject`` toggles gray-failure ejection (default: the
+        ``ZOO_EJECT`` env, on) — per-seat latency/error scoring that
+        moves sustained outliers through probation → ejection →
+        backoff re-admission (docs/fault_tolerance.md);
+        ``ejection_config`` overrides the full ``ZOO_EJECT_*`` knob
+        set for tests/benches."""
         if not endpoints:
             raise ValueError("HAServingClient needs at least one endpoint")
+        self._ejector = EjectionController(
+            ejection_config or EjectionConfig(enabled=eject))
         if deadline_ms is None:
             deadline_ms = env_float("ZOO_SERVE_DEADLINE_MS", 30000.0)
         self.deadline_ms = deadline_ms if deadline_ms > 0 else None
@@ -262,7 +282,8 @@ class HAServingClient:
         return _Endpoint(
             host, port, self._tls, self._cafile, self._verify,
             CircuitBreaker(failure_threshold=self._breaker_failures,
-                           recovery_timeout=self._breaker_recovery))
+                           recovery_timeout=self._breaker_recovery),
+            score=self._ejector.new_score(f"{host}:{port}"))
 
     # -- topology / routing state -----------------------------------------
     def refresh_endpoints(self, endpoints: Sequence[Tuple[str, int]]):
@@ -397,10 +418,28 @@ class HAServingClient:
         chosen: Optional[Dict] = None
         last_err: Optional[BaseException] = None
 
+        def claim_conn(att):
+            """Take exclusive ownership of the attempt's connection
+            (None when the other side — releaser or killer — already
+            took it)."""
+            with att["conn_lock"]:
+                conn, att["conn"] = att["conn"], None
+            return conn
+
         def fire(ep: _Endpoint, is_hedge: bool = False):
             att = {"ep": ep, "stop": threading.Event(), "conn": None,
                    "hedge": is_hedge, "dead": False,
-                   "resume_from": received}
+                   "resume_from": received,
+                   "t0": time.perf_counter(),
+                   # exactly-once connection ownership: the attempt
+                   # thread RELEASES (pool) and kill() CLOSES — whoever
+                   # claims the conn under this lock first wins, so a
+                   # connection already handed back to the pool can
+                   # never be closed under a NEW request that checked
+                   # it out (the close would not even wake that
+                   # request's blocked recv — it would stall for its
+                   # whole deadline)
+                   "conn_lock": threading.Lock()}
             attempts.append(att)
 
             def run():
@@ -424,6 +463,7 @@ class HAServingClient:
                     conn = ep.acquire()
                 except OSError as e:
                     ep.breaker.record_failure()
+                    self._score_err(ep)
                     att_span("connect_error", False)
                     results.put(("err", att, e))
                     return
@@ -449,11 +489,16 @@ class HAServingClient:
                     if not (att["stop"].is_set()
                             or isinstance(e, DeadlineExceeded)):
                         ep.breaker.record_failure()
-                    ep.release(conn, healthy=False)
+                        self._score_err(ep)
+                    mine = claim_conn(att)
+                    if mine is not None:
+                        ep.release(mine, healthy=False)
                     att_span("transport_error", False)
                     results.put(("err", att, e))
                     return
-                ep.release(conn, healthy=not att["stop"].is_set())
+                mine = claim_conn(att)
+                if mine is not None:
+                    ep.release(mine, healthy=not att["stop"].is_set())
                 att_span("stopped" if att["stop"].is_set() else "ok",
                          True)
                 results.put(("end", att, None))
@@ -464,11 +509,16 @@ class HAServingClient:
 
         def kill(att):
             att["stop"].set()
-            conn = att.get("conn")
+            conn = claim_conn(att)
             if conn is not None:
                 conn.close()  # the server sees the drop; when this was
                 #               the last subscriber it cancels the
-                #               stream and frees its KV blocks
+                #               stream and frees its KV blocks.
+                # claim_conn: an attempt whose thread ALREADY released
+                # this connection (pool) must never have it closed here
+                # — a fresh request may have checked it out, and the
+                # close would stall that request's blocked recv for its
+                # whole deadline (the bug the chaos storm caught)
 
         def others_racing(att):
             return any(a is not att and not a["dead"]
@@ -576,6 +626,11 @@ class HAServingClient:
                                        or frame.get("done")):
                     chosen = att
                     att["ep"].breaker.record_success()
+                    # the gray-failure signal for a stream is its
+                    # time-to-first-content — a 50x-slow decoder shows
+                    # up here long before any transport error would
+                    self._score_ok(att["ep"],
+                                   time.perf_counter() - att["t0"])
                     if att["hedge"]:
                         _hedge.labels(event="won").inc()
                     for other in attempts:
@@ -619,6 +674,28 @@ class HAServingClient:
                       tokens=received, attempts=len(attempts),
                       hedged=hedged)
 
+    # -- gray-failure scoring (docs/fault_tolerance.md) --------------------
+    def _score_ok(self, ep: _Endpoint, dt: float):
+        if ep.score is not None:
+            ep.score.record(dt, self._ejector.cfg.alpha)
+
+    def _score_err(self, ep: _Endpoint):
+        if ep.score is not None:
+            ep.score.record_error(self._ejector.cfg.alpha)
+
+    def ejection_states(self) -> Dict[str, Dict]:
+        """Per-seat gray-failure snapshot — state, EWMA latency, error
+        rate (what the chaos storm and the bench assert on)."""
+        return {f"{ep.host}:{ep.port}": ep.score.snapshot()
+                for ep in self._eps if ep.score is not None}
+
+    def ejection_events(self) -> List[tuple]:
+        """The controller's bounded ``(ts, event, seat)`` transition
+        log (monotonic timestamps) — detect-to-eject latency reads
+        straight off it."""
+        with self._ejector._lock:
+            return list(self._ejector.events)
+
     def stats(self) -> List[Optional[Dict]]:
         """Per-replica stage-timer stats (None for a down replica)."""
         out = []
@@ -645,27 +722,53 @@ class HAServingClient:
     # -- the hedged failover core -----------------------------------------
     def _plan(self, version: Optional[str] = None) -> List[_Endpoint]:
         """Rotation for one request: every endpoint exactly once,
-        healthy (breaker-admitted) seats first, starting at the
-        round-robin cursor. Open-breaker seats stay at the tail as a
-        last resort so a fully-dark group still probes rather than
-        refusing outright. A pinned ``version`` additionally floats
-        seats KNOWN to serve it (or not yet known) ahead of seats last
-        seen on a different version — a hint only; mismatched seats
-        stay in the plan because a hot-swap may have moved them since."""
+        healthy (breaker-admitted, not gray-degraded) seats first,
+        starting at the round-robin cursor. Gray-failure states
+        (docs/fault_tolerance.md) order the tail: PROBATION seats ride
+        behind every active seat (failover/hedge traffic only) except
+        when their canary probe is due — then ONE probation seat is
+        deliberately planned FIRST so live traffic can prove its
+        recovery; open-breaker seats follow; EJECTED seats come dead
+        last, reached only when everything else failed. A pinned
+        ``version`` additionally floats seats KNOWN to serve it (or
+        not yet known) ahead of seats last seen on a different version
+        — a hint only; mismatched seats stay in the plan because a
+        hot-swap may have moved them since."""
         with self._rr_lock:
             eps = list(self._eps)
             start = self._rr
             self._rr = (self._rr + 1) % len(eps)
         order = [eps[(start + i) % len(eps)] for i in range(len(eps))]
-        healthy = [ep for ep in order if ep.breaker.allow()]
-        dark = [ep for ep in order if ep not in healthy]
+        self._ejector.evaluate([ep.score for ep in order])
+        canary: List[_Endpoint] = []
+        active: List[_Endpoint] = []
+        probation: List[_Endpoint] = []
+        dark: List[_Endpoint] = []
+        ejected: List[_Endpoint] = []
+        for ep in order:
+            state = self._ejector.state_of(ep.score)
+            if state == EJECTED:
+                ejected.append(ep)  # breaker probe not consumed: the
+                continue            # seat is out of rotation anyway
+            if state == PROBATION and not canary \
+                    and self._ejector.take_canary(ep.score):
+                canary.append(ep)
+                continue
+            if not ep.breaker.allow():
+                dark.append(ep)
+            elif state == PROBATION:
+                probation.append(ep)
+            else:
+                active.append(ep)
+        tiers = [t for t in (canary, active, probation, dark, ejected)
+                 if t]
         if version is None:
-            return healthy + dark
+            return [ep for tier in tiers for ep in tier]
         # version preference WITHIN each health tier: a dead seat last
         # seen on the pinned version must never outrank a healthy seat
         # that merely bounced us once (it may have been swapped since)
         out = []
-        for tier in (healthy, dark):
+        for tier in tiers:
             match = [ep for ep in tier
                      if ep.seen_version in (None, version)]
             out += match + [ep for ep in tier if ep not in match]
@@ -738,7 +841,13 @@ class HAServingClient:
                       want: Optional[str]) -> Dict:
         dl = Deadline.from_ms(
             deadline_ms if deadline_ms is not None else self.deadline_ms)
-        candidates = self._plan(version=want)
+        plan = self._plan(version=want)
+        # every seat may be tried twice (once pre-, once post-failure)
+        # before the request gives up — the same budget generate() has
+        # always had. One corrupt frame / reset per seat must not
+        # exhaust a 3-seat group: transient faults are per-CONNECTION,
+        # and the second pass rides a fresh one.
+        candidates = list(plan) + list(plan)
         results: "_queue.Queue" = _queue.Queue()
         in_flight = 0
         last_err: Optional[BaseException] = None
@@ -768,6 +877,7 @@ class HAServingClient:
                     conn = ep.acquire()
                 except OSError as e:
                     ep.breaker.record_failure()
+                    self._score_err(ep)
                     att_span("connect_error", False)
                     results.put(("err", ep, e))
                     return
@@ -783,6 +893,7 @@ class HAServingClient:
                         # RetryError wraps the underlying transport
                         # failure; either way the seat just failed
                         ep.breaker.record_failure()
+                        self._score_err(ep)
                     att_span("transport_error", False)
                     results.put(("err", ep, e))
                     return
@@ -844,6 +955,7 @@ class HAServingClient:
                         "error", "server reported deadline expired"))
                 ep.breaker.record_success()
                 self._lat.add(dt)
+                self._score_ok(ep, dt)
                 if ep is hedge_ep:
                     # the hedged DUPLICATE answered first (a failover
                     # attempt winning is not a hedge win)

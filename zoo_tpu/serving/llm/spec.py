@@ -28,6 +28,14 @@ import os
 from typing import Dict, Optional, Tuple
 
 LLM_PREFIX = "llama:"
+# jax-free deterministic engine (chaos smokes / transport benches):
+# synthllm:slots=2,block=4,blocks=64,tables=8 — the generate-path twin
+# of the predict path's synthetic:double (see llm/synthetic.py)
+SYNTH_LLM_PREFIX = "synthllm:"
+_SYNTH_KEYS = {"slots": "num_slots", "block": "block_size",
+               "blocks": "num_blocks", "tables": "max_blocks_per_seq",
+               "max_prompt": "max_prompt_len", "eos": "eos_id",
+               "chunk": "prefill_chunk"}
 
 _ARCH_KEYS = ("vocab", "hidden", "n_block", "n_head", "n_kv_head",
               "intermediate")
@@ -42,7 +50,8 @@ _STR_KEYS = {"kv": "kv_dtype", "prefill_impl": "prefill_impl"}
 
 
 def is_llm_spec(spec) -> bool:
-    return isinstance(spec, str) and spec.startswith(LLM_PREFIX)
+    return isinstance(spec, str) and spec.startswith(
+        (LLM_PREFIX, SYNTH_LLM_PREFIX))
 
 
 def _parse_kv(parts) -> Dict[str, str]:
@@ -119,11 +128,38 @@ def _env_engine_defaults() -> Dict:
     return out
 
 
+def build_synthetic_engine(spec: str, mode: Optional[str] = None,
+                           start: bool = True, **overrides):
+    """A jax-free :class:`LLMEngine` over a deterministic
+    :class:`~zoo_tpu.serving.llm.synthetic.SyntheticLLMModel` from a
+    ``synthllm:...`` spec — real allocator, scheduler, deadlines and
+    dedup; pure-function tokens."""
+    from zoo_tpu.serving.llm.engine import LLMEngine
+    from zoo_tpu.serving.llm.synthetic import SyntheticLLMModel
+
+    kvs = _parse_kv(spec[len(SYNTH_LLM_PREFIX):].split(":"))
+    kwargs = {}
+    for short, name in _SYNTH_KEYS.items():
+        if short in kvs:
+            kwargs[name] = int(kvs.pop(short))
+    if kvs:
+        raise ValueError(f"unknown synthllm spec keys {sorted(kvs)}")
+    kwargs.update({k: v for k, v in overrides.items()
+                   if k not in ("mode", "max_waiting")})
+    model = SyntheticLLMModel(**kwargs)
+    engine = LLMEngine(model, mode=mode or "continuous",
+                       max_waiting=overrides.get("max_waiting"))
+    return engine.start() if start else engine
+
+
 def build_llm_engine(spec: str, mode: Optional[str] = None,
                      start: bool = True, **overrides):
     """An :class:`LLMEngine` (started unless ``start=False``) from a
-    ``llama:...`` spec. ``overrides`` are engine/model kwargs that win
-    over both the spec and the env."""
+    ``llama:...`` or ``synthllm:...`` spec. ``overrides`` are
+    engine/model kwargs that win over both the spec and the env."""
+    if spec.startswith(SYNTH_LLM_PREFIX):
+        return build_synthetic_engine(spec, mode=mode, start=start,
+                                      **overrides)
     from zoo_tpu.models.llm.llama import LlamaConfig
     from zoo_tpu.serving.llm.engine import LLMEngine
     from zoo_tpu.serving.llm.model import PagedLlamaModel

@@ -18,6 +18,14 @@ import time
 
 
 def serve_replica(ns) -> int:
+    import faulthandler
+    import signal as _sig
+
+    # live stack dumps on demand: `kill -USR1 <replica pid>` writes
+    # every thread's Python stack to the replica log — the tool that
+    # localizes a GRAY stall (a camped handler thread, a wedged
+    # batcher) while it is happening, which no crash handler can see
+    faulthandler.register(_sig.SIGUSR1)
     from zoo_tpu.obs.exporters import MetricsExporter
     from zoo_tpu.obs.flight import flight_recorder, record_event
     from zoo_tpu.obs.slo import SLOWatchdog
@@ -73,7 +81,19 @@ def serve_replica(ns) -> int:
             version, inner = reg.model_spec(pinned)
             _mount(inner)
     else:
-        _mount(ns.model)
+        # "a+b" mounts several specs on ONE door (e.g.
+        # "synthetic:double:2+synthllm:slots=2" = predict AND the
+        # streaming generate op from the same replica — what the
+        # mixed-op chaos storm exercises). Split ONLY when every
+        # fragment bears a known spec prefix: a plain model PATH may
+        # legally contain '+' (ckpt+lora.zoo) and must load verbatim.
+        from zoo_tpu.serving.ha import SYNTHETIC_PREFIX
+        parts = ns.model.split("+")
+        combinable = len(parts) > 1 and all(
+            is_llm_spec(p) or p.startswith(SYNTHETIC_PREFIX)
+            for p in parts)
+        for part in (parts if combinable else [ns.model]):
+            _mount(part)
     server = ServingServer(
         model, host=ns.host, port=ns.port, batch_size=ns.batch_size,
         max_wait_ms=ns.max_wait_ms, llm_engine=engine,

@@ -35,9 +35,16 @@ import numpy as np
 from zoo_tpu.obs.flight import flight_recorder, record_event
 from zoo_tpu.obs.metrics import StatTimer, counter, gauge, histogram
 from zoo_tpu.obs.tracing import emit_event, emit_span, span
+from zoo_tpu.util.integrity import (
+    corrupt_seam,
+    frame_crc,
+    verify_crc,
+    wire_crc_enabled,
+)
 from zoo_tpu.util.resilience import (
     CircuitBreaker,
     Deadline,
+    FrameCorrupt,
     env_float,
     env_int,
     fault_point,
@@ -102,22 +109,57 @@ def drain_timeout() -> float:
     return env_float("ZOO_SERVE_DRAIN_TIMEOUT_S", 30.0)
 
 
-def _send_msg(sock: socket.socket, obj):
+# Frame layout (docs/serving_ha.md, integrity section): a u32 length
+# word, then the ZSRV codec payload. When the length word's HIGH BIT is
+# set, a u32 CRC of the payload follows it on the wire (the real length
+# is the low 31 bits) — self-describing per frame, so a receiver needs
+# no negotiation to VERIFY; negotiation (piggybacked: the client stamps
+# ``crc: 1`` into a request, a CRC-capable server answers with a
+# CRC-framed reply) only decides whether a sender may USE the bit
+# without breaking an old peer.
+_FRAME_CRC_BIT = 0x80000000
+
+
+def _send_msg(sock: socket.socket, obj, crc: bool = False):
     from zoo_tpu.serving.codec import dumps
 
     payload = dumps(obj)
-    sock.sendall(struct.pack(">I", len(payload)) + payload)
+    if not crc:
+        sock.sendall(struct.pack(">I", len(payload)) + payload)
+        return
+    trailer = frame_crc(payload)
+    # chaos seam: bit rot "in transit" — AFTER the CRC was computed, so
+    # the receiver's verify catches it exactly like real corruption
+    payload = corrupt_seam("serving.wire.corrupt", payload)
+    sock.sendall(struct.pack(">I", _FRAME_CRC_BIT | len(payload))
+                 + payload + struct.pack(">I", trailer))
 
 
-def _recv_msg(sock: socket.socket):
+def _recv_frame(sock: socket.socket):
+    """One frame off the wire → ``(msg | None, frame_had_crc)``.
+    A CRC-flagged frame whose trailer does not match its payload raises
+    :class:`FrameCorrupt` (counted + flight-ring event) — the bytes
+    never reach the codec."""
     from zoo_tpu.serving.codec import loads
 
     header = _recv_exact(sock, 4)
     if header is None:
-        return None
-    (length,) = struct.unpack(">I", header)
-    body = _recv_exact(sock, length)
-    return None if body is None else loads(body)
+        return None, False
+    (word,) = struct.unpack(">I", header)
+    has_crc = bool(word & _FRAME_CRC_BIT)
+    body = _recv_exact(sock, word & ~_FRAME_CRC_BIT)
+    if body is None:
+        return None, has_crc
+    if has_crc:
+        trailer = _recv_exact(sock, 4)
+        if trailer is None:
+            return None, True
+        verify_crc(body, struct.unpack(">I", trailer)[0], "serving")
+    return loads(body), has_crc
+
+
+def _recv_msg(sock: socket.socket):
+    return _recv_frame(sock)[0]
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytearray]:
@@ -283,6 +325,10 @@ class ServingServer:
         cap = dedup_cache if dedup_cache is not None else \
             env_int("ZOO_SERVE_DEDUP_CACHE", 1024)
         self._dedup_cache = _DedupCache(cap) if cap > 0 else None
+        # wire-frame integrity (ZOO_WIRE_CRC, default on): replies to
+        # CRC-speaking clients carry a CRC trailer; old clients that
+        # never stamp/send CRC frames get the plain protocol unchanged
+        self._wire_crc = wire_crc_enabled()
         self._replicas = list(models) if models else (
             [model] * max(1, int(num_replicas))
             if model is not None else [])
@@ -317,6 +363,11 @@ class ServingServer:
 
         class Handler(socketserver.BaseRequestHandler):
             def setup(self):
+                # wire-integrity state: flips True (sticky, per
+                # connection) once the peer proves it speaks CRC frames
+                # — either by sending one or by stamping ``crc: 1``
+                # into a request; replies then carry the trailer too
+                self._crc = False
                 # small request/response frames ping-pong on each
                 # connection: Nagle + delayed-ACK interactions add
                 # spurious tail latency under concurrent clients
@@ -365,7 +416,7 @@ class ServingServer:
                     # routing) without extra probe round-trips
                     out["version"] = outer.version
                 out.update(extra)
-                _send_msg(self.request, out)
+                _send_msg(self.request, out, crc=self._crc)
 
             def _note_reject(self, msg, reason):
                 """Door-rejection bookkeeping beyond the counters: the
@@ -746,11 +797,79 @@ class ServingServer:
                     return
                 self._reply(msg, {"ok": True, **info})
 
+            def _handle_chaos(self, msg):
+                """Arm (or clear) a fault site in THIS replica process —
+                the remote half of the deterministic chaos harness
+                (docs/fault_tolerance.md). Refused unless the operator
+                deliberately armed the door (``ZOO_CHAOS_ALLOW=1`` in
+                the replica env, which the chaos smokes set): a
+                production replica must never take fault commands off
+                an unauthenticated socket."""
+                if os.environ.get("ZOO_CHAOS_ALLOW") not in ("1", "true"):
+                    self._reply(msg, {
+                        "error": "chaos ops disabled on this replica "
+                                 "(set ZOO_CHAOS_ALLOW=1 in its env)"})
+                    return
+                from zoo_tpu.util.resilience import default_injector
+                site = msg.get("site")
+                if not site:
+                    self._reply(msg, {"error": "chaos needs a site"})
+                    return
+                if msg.get("clear"):
+                    default_injector.clear(site)
+                    record_event("chaos_clear", site=site)
+                    self._reply(msg, {"ok": True, "cleared": site})
+                    return
+                delay = float(msg.get("delay_ms") or 0.0) / 1000.0
+                err = msg.get("error")
+                exc = None
+                if err == "oserror":
+                    exc = OSError(f"injected fault at {site}")
+                elif err == "connection":
+                    exc = ConnectionResetError(
+                        f"injected fault at {site}")
+                elif err:
+                    self._reply(msg, {
+                        "error": f"unknown chaos error kind {err!r} "
+                                 "(oserror | connection)"})
+                    return
+                action = (lambda **_k: time.sleep(delay)) if delay \
+                    else None
+                if action is None and exc is None:
+                    self._reply(msg, {
+                        "error": "chaos needs delay_ms, error, or "
+                                 "clear"})
+                    return
+                default_injector.inject(
+                    site, exc=exc, action=action,
+                    times=(int(msg["times"]) if msg.get("times")
+                           is not None else None),
+                    p=float(msg.get("p", 1.0)))
+                record_event("chaos_arm", site=site,
+                             delay_ms=msg.get("delay_ms"),
+                             error=err, p=msg.get("p"))
+                self._reply(msg, {"ok": True, "site": site})
+
             def handle(self):
                 while True:
-                    msg = _recv_msg(self.request)
+                    try:
+                        msg, had_crc = _recv_frame(self.request)
+                    except FrameCorrupt:
+                        # a corrupt REQUEST cannot be trusted for a
+                        # reply (id/op unreadable): drop the connection
+                        # — the client's retry path redials and the
+                        # dedup cache keeps the retry idempotent
+                        record_event("corrupt_request_dropped")
+                        return
                     if msg is None:
                         return
+                    if outer._wire_crc and \
+                            (had_crc or msg.get("crc")):
+                        # the peer speaks CRC frames (sent one, or
+                        # asked via the piggybacked ``crc`` field):
+                        # every reply on this connection now carries
+                        # the trailer
+                        self._crc = True
                     if msg.get("op") == "predict":
                         self._handle_predict(msg)
                     elif msg.get("op") == "generate":
@@ -778,6 +897,8 @@ class ServingServer:
                             "ok": True,
                             "bundle": flight_recorder().snapshot_bundle(
                                 "debug_dump")})
+                    elif msg.get("op") == "chaos":
+                        self._handle_chaos(msg)
                     elif msg.get("op") == "ping":
                         self._reply(msg, {"ok": True})
 

@@ -15,8 +15,11 @@ from typing import Dict, Optional
 
 import numpy as np
 
+import time
+
 from zoo_tpu.obs.tracing import ambient_trace_id, current_span_id
-from zoo_tpu.serving.server import _recv_msg, _send_msg
+from zoo_tpu.serving.server import _recv_frame, _send_msg
+from zoo_tpu.util.integrity import wire_crc_enabled
 from zoo_tpu.util.resilience import (
     Deadline,
     DeadlineExceeded,
@@ -69,9 +72,35 @@ class _Connection:
                                            base_delay=0.05, max_delay=1.0)
         self._lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
+        # wire integrity (docs/serving_ha.md): whether we WANT CRC
+        # trailers (ZOO_WIRE_CRC) and whether this connection's peer
+        # has proven it speaks them (sticky per connection: the first
+        # CRC-framed reply flips it, and a reconnect resets it — the
+        # respawned peer may be an older build)
+        self._crc_want = wire_crc_enabled()
+        self._crc_on = False
+        # reconnect-after-respawn jitter: consecutive re-dials after a
+        # POISONED drop (reset, refused, corrupt frame — reset again on
+        # the first successful exchange) index into the retry policy's
+        # jittered backoff so N clients re-dialing a freshly respawned
+        # replica spread out instead of stampeding it. A deliberate
+        # close() (pool hygiene) never pays the jitter.
+        self._reopen_streak = 0
+        self._poisoned = False
         self._open()
 
-    def _open(self):
+    def _open(self, reconnect: bool = False):
+        if reconnect and self._poisoned:
+            # thundering-herd protection: every client of a respawned
+            # replica would otherwise re-dial the instant its socket
+            # died. The SAME backoff math the retry policy uses (full
+            # jitter, capped) desynchronizes them; the first dial of a
+            # fresh _Connection — and a reopen after a clean close —
+            # pays nothing.
+            self._reopen_streak += 1
+            delay = self._retry.backoff(min(self._reopen_streak, 6))
+            if delay > 0:
+                time.sleep(delay)
         sock = socket.create_connection((self._host, self._port))
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         if self._tls:
@@ -85,14 +114,17 @@ class _Connection:
                 ctx.verify_mode = ssl.CERT_NONE
             sock = ctx.wrap_socket(sock, server_hostname=self._host)
         self._sock = sock
+        self._crc_on = False  # re-learn: the peer may have changed
 
-    def _drop(self):
+    def _drop(self, poisoned: bool = True):
         if self._sock is not None:
             try:
                 self._sock.close()
             except OSError:
                 pass
             self._sock = None
+            if poisoned:
+                self._poisoned = True
 
     def _rpc_once(self, msg: Dict,
                   deadline: Optional[Deadline] = None) -> Dict:
@@ -104,7 +136,7 @@ class _Connection:
                 raise DeadlineExceeded(
                     "request deadline expired before send")
             if self._sock is None:
-                self._open()
+                self._open(reconnect=True)
             try:
                 if deadline is not None:
                     # re-stamp the REMAINING budget per attempt (a retry
@@ -116,12 +148,19 @@ class _Connection:
                     self._sock.settimeout(deadline.remaining() + 0.25)
                 else:
                     self._sock.settimeout(None)
-                _send_msg(self._sock, msg)
+                if self._crc_want and not self._crc_on:
+                    # piggybacked integrity negotiation: the field asks
+                    # a CRC-capable server to answer with CRC frames
+                    # (old servers ignore it and answer plain)
+                    msg["crc"] = 1
+                _send_msg(self._sock, msg, crc=self._crc_on)
                 # chaos seam: a reset AFTER the request reached the
                 # server (the retry must dedup, never double-execute)
                 fault_point("serving.client.recv", id=msg.get("id"))
                 while True:
-                    resp = _recv_msg(self._sock)
+                    resp, had_crc = _recv_frame(self._sock)
+                    if had_crc:
+                        self._crc_on = True  # peer speaks CRC: upgrade
                     if resp is None:
                         self._drop()
                         raise ConnectionError("serving connection closed")
@@ -132,6 +171,10 @@ class _Connection:
                         # out retry) still queued on this stream —
                         # discard, never hand it to the wrong caller
                         continue
+                    # the link is good again: no jitter on future
+                    # clean reopens
+                    self._reopen_streak = 0
+                    self._poisoned = False
                     return resp
             except OSError:
                 self._drop()  # poisoned stream: next attempt re-dials
@@ -153,20 +196,24 @@ class _Connection:
                 raise DeadlineExceeded(
                     "stream deadline expired before send")
             if self._sock is None:
-                self._open()
+                self._open(reconnect=True)
             try:
                 if deadline is not None:
                     msg["deadline_ms"] = deadline.remaining_ms()
                     self._sock.settimeout(deadline.remaining() + 0.25)
                 else:
                     self._sock.settimeout(idle_timeout)
-                _send_msg(self._sock, msg)
+                if self._crc_want and not self._crc_on:
+                    msg["crc"] = 1
+                _send_msg(self._sock, msg, crc=self._crc_on)
                 fault_point("serving.client.recv", id=msg.get("id"))
                 while True:
                     if deadline is not None:
                         self._sock.settimeout(
                             max(0.0, deadline.remaining()) + 0.25)
-                    resp = _recv_msg(self._sock)
+                    resp, had_crc = _recv_frame(self._sock)
+                    if had_crc:
+                        self._crc_on = True
                     if resp is None:
                         self._drop()
                         raise ConnectionError(
@@ -175,6 +222,8 @@ class _Connection:
                     if rid is not None and \
                             resp.get("id") not in (None, rid):
                         continue  # stale frame from a prior request
+                    self._reopen_streak = 0
+                    self._poisoned = False
                     yield resp
                     if resp.get("done") or resp.get("shed") or (
                             "error" in resp and "seq" not in resp):
@@ -195,7 +244,7 @@ class _Connection:
         return self._retry.call(self._rpc_once, msg, deadline)
 
     def close(self):
-        self._drop()
+        self._drop(poisoned=False)  # deliberate: no reconnect jitter
 
 
 class TCPInputQueue:

@@ -1,0 +1,153 @@
+"""Wire-frame integrity: CRC trailers + the one corruption exception.
+
+Gray hardware failures — a flipped bit in a NIC ring, a torn read off a
+tmpfs segment, a desynchronized stream after a partial write — do not
+announce themselves: without a checksum a corrupted length-prefixed
+frame either tears the connection somewhere confusing or, worse,
+*decodes* into plausible garbage that flows into a model. This module
+is the shared detection layer for both wire planes:
+
+* the serving TCP door (``zoo_tpu.serving.server`` ZSRV frames) and
+* the shard-exchange data plane (``zoo_tpu.orca.data.plane`` ZSXN
+  per-array payloads, shm-lane segments included).
+
+Both planes call :func:`frame_crc` on the exact bytes that cross the
+transport and :func:`verify_crc` on receipt. A mismatch raises
+:class:`FrameCorrupt` — a :class:`ConnectionError` subclass BY DESIGN:
+every existing retry / failover / pool-invalidation path already treats
+transport errors as transient, so a corrupt frame is retried on a fresh
+connection instead of ever reaching a decoder. Each detection also
+lands on the ``zoo_wire_corrupt_frames_total`` counter and in the crash
+flight-recorder ring (the first thing a gray-failure postmortem wants).
+
+The checksum is ``zlib.crc32`` (the CRC32C role; zlib's is the one the
+stdlib ships and it is plenty for bit-flip detection — this is an
+integrity check against faults, not an authenticity check against
+adversaries; TLS provides the latter on the serving door).
+
+``ZOO_WIRE_CRC`` (default on) is the kill switch; the trailer itself is
+negotiated per connection on both planes, so a peer from a build that
+pre-dates this module still interoperates on the plain protocol.
+
+Chaos seam: :func:`corrupt_seam` is the in-transit bit-flip injection
+point — production code passes the outbound payload through it AFTER
+computing the CRC, so an armed ``wire.corrupt`` fault site simulates
+corruption on the wire (CRC no longer matches) exactly like real bit
+rot would.
+"""
+
+from __future__ import annotations
+
+import os
+import zlib
+from typing import Optional
+
+__all__ = [
+    "FrameCorrupt", "frame_crc", "verify_crc", "wire_crc_enabled",
+    "corrupt_seam", "flip_bit", "WIRE_CRC_ENV",
+]
+
+WIRE_CRC_ENV = "ZOO_WIRE_CRC"
+
+# the metrics import is LAZY: obs.metrics (indirectly) imports
+# resilience, which re-exports FrameCorrupt from here — a module-level
+# import would make "import integrity first" a circular-import crash
+_corrupt_frames = None
+
+
+def _corrupt_counter():
+    global _corrupt_frames
+    if _corrupt_frames is None:
+        from zoo_tpu.obs.metrics import counter
+        _corrupt_frames = counter(
+            "zoo_wire_corrupt_frames_total",
+            "Frames whose CRC trailer failed verification, by wire "
+            "plane (serving = the ZSRV TCP door, shard = the ZSXN "
+            "data plane). Each one is a caught would-have-been "
+            "garbage decode: the frame was dropped and the transfer "
+            "retried on a fresh connection.",
+            labels=("plane",))
+    return _corrupt_frames
+
+
+def wire_crc_enabled() -> bool:
+    """Whether this process wants CRC trailers on its wire frames
+    (``ZOO_WIRE_CRC``, default on). Read at connection/negotiation
+    time, so a test can toggle it per server/client process."""
+    return os.environ.get(WIRE_CRC_ENV, "1") not in ("0", "false", "off")
+
+
+class FrameCorrupt(ConnectionError):
+    """A wire frame failed its CRC check.
+
+    A :class:`ConnectionError` on purpose: retry policies and the HA
+    failover path treat it exactly like a reset — drop the (possibly
+    desynchronized) connection, redial, re-send. It must NEVER be
+    swallowed into a decode attempt; the whole point is that corrupt
+    bytes are refused before any decoder sees them."""
+
+
+def frame_crc(buf) -> int:
+    """CRC of the exact bytes that cross the transport."""
+    return zlib.crc32(memoryview(buf)) & 0xFFFFFFFF
+
+
+def verify_crc(buf, expected: int, plane: str,
+               context: Optional[str] = None):
+    """Raise :class:`FrameCorrupt` (counting + flight-ring event) when
+    ``buf`` does not hash to ``expected``. ``plane`` labels the counter
+    (``serving`` / ``shard``); ``context`` names the frame for the
+    error message and the flight event."""
+    got = zlib.crc32(memoryview(buf)) & 0xFFFFFFFF
+    if got == (expected & 0xFFFFFFFF):
+        return
+    _corrupt_counter().labels(plane=plane).inc()
+    try:  # telemetry never masks the detection itself
+        from zoo_tpu.obs.flight import record_event
+        record_event("frame_corrupt", plane=plane,
+                     context=context or "", nbytes=len(buf))
+    except Exception:  # noqa: BLE001
+        pass
+    raise FrameCorrupt(
+        f"{plane} frame CRC mismatch"
+        + (f" ({context})" if context else "")
+        + f": got {got:#010x}, trailer says {expected & 0xFFFFFFFF:#010x}"
+        f" over {len(buf)} byte(s) — corrupt or desynchronized stream; "
+        "dropping the connection and retrying")
+
+
+def flip_bit(buf, bit: int = 0) -> bytes:
+    """``buf`` with one bit flipped — the canonical chaos corruption."""
+    out = bytearray(buf)
+    if out:
+        out[(bit // 8) % len(out)] ^= 1 << (bit % 8)
+    return bytes(out)
+
+
+def corrupt_action(holder=None, site=None, **_ctx):
+    """The ready-made fault ACTION chaos tests arm at a corruption
+    seam: replaces the outbound payload with a one-bit-flipped COPY
+    (never mutating in place — the payload may be a memoryview over
+    the sender's live arrays)::
+
+        inject("serving.wire.corrupt", action=corrupt_action, p=0.1)
+    """
+    if holder is not None:
+        holder["buf"] = flip_bit(holder["buf"])
+
+
+def corrupt_seam(site: str, payload):
+    """The in-transit corruption injection point.
+
+    Production senders pass the outbound payload through here AFTER
+    computing its CRC. Unarmed (the everyday case) this is one dict
+    check. An armed site's action (normally :func:`corrupt_action`)
+    receives ``holder`` and may swap ``holder["buf"]`` for corrupted
+    bytes — simulating bit rot in transit, which the receiver's CRC
+    check then catches."""
+    from zoo_tpu.util.resilience import default_injector
+    if not default_injector._sites:  # the everyday fast path
+        return payload
+    holder = {"buf": payload}
+    default_injector.fire(site, holder=holder)
+    return holder["buf"]

@@ -101,11 +101,13 @@ def collect_metrics(n_devices: int = N_DEVICES, verbose: bool = True
     import jax
 
     from zoo_tpu.parallel import build_mesh
-    from zoo_tpu.parallel.hlo_check import (
+    from zoo_tpu.analysis.hlo import (
         assert_collectives,
         assert_fsdp_sharded,
+        assert_llm_executable,
+        assert_plan_sharded,
     )
-    from zoo_tpu.parallel.plans import fsdp_lint_shapes
+    from zoo_tpu.parallel.plans import plan_lint_shapes
 
     devices = jax.devices()[:n_devices]
     assert len(devices) == n_devices, (
@@ -145,7 +147,7 @@ def collect_metrics(n_devices: int = N_DEVICES, verbose: bool = True
 
     # 2. the compiled step really is FSDP (HLO lint) ---------------------
     mesh = build_mesh(devices, axis_sizes={"fsdp": n_devices})
-    sharded_shapes, replicated_shapes, local_shapes = fsdp_lint_shapes(
+    sharded_shapes, replicated_shapes, local_shapes = plan_lint_shapes(
         model.params, mesh)
     counts = assert_collectives(
         hlo, require=["all-gather"],
@@ -213,6 +215,22 @@ def collect_metrics(n_devices: int = N_DEVICES, verbose: bool = True
         assert stats["blocks_used"] == 0, stats
         m["llm_tp_param_bytes_frac"] = round(
             _tree_bytes_frac(tp_eng.model.params), 4)
+        # plan-aware HLO lint on the compiled tp decode executable:
+        # megatron-sharded weights must enter at LOCAL (1/tp) shape —
+        # a full-global-shape entry parameter is "TP that isn't" — and
+        # the donated cache must stay aliased with the token outfeed
+        # at slots x 1 int32 (zoo-lint HLO-SHARDING / HLO-DONATION /
+        # HLO-HOST-TRANSFER, docs/static_analysis.md)
+        tp_model = tp_eng.model
+        tp_sh, tp_rep, tp_loc = plan_lint_shapes(
+            tp_model.params, tp_model.mesh)
+        tp_hlo = tp_model.compiled_hlo("decode")
+        assert_plan_sharded(tp_hlo, tp_sh, tp_rep,
+                            local_shapes=tp_loc, plan="tp",
+                            label="tp=2 decode executable")
+        assert_llm_executable(tp_model, "decode")
+        m["tp_hlo_lint"] = "pass"
+        m["llm_decode_artifact_lint"] = "pass"
     finally:
         ref_eng.stop()
         tp_eng.stop()

@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""zoo-lint — static contract checks over the tree.
+
+The build-time teeth behind the platform's conventions
+(docs/static_analysis.md): knob registration / parse-site discipline,
+jax-free import purity, lock-guarded attribute discipline, and the
+telemetry catalog. Compiled-HLO passes (donation, host-transfer,
+sharding plans) live in :mod:`zoo_tpu.analysis.hlo` and piggyback on
+executables the test suite already compiles — this CLI runs the
+sub-second AST/doc passes.
+
+    python scripts/zoo_lint.py                 # report findings
+    python scripts/zoo_lint.py --strict        # exit 1 on any active
+    python scripts/zoo_lint.py --json LINT.json
+    python scripts/zoo_lint.py --fix-docs      # rewrite generated
+                                               # knob tables in docs
+    python scripts/zoo_lint.py --passes knobs,purity
+
+The runner itself never imports jax (asserted at exit and by
+tests/test_zoo_lint.py): every pass is AST/text analysis, which is
+what keeps the whole suite under a second.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+
+def _git_rev(root: str) -> str:
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=root,
+            capture_output=True, text=True, timeout=5,
+        ).stdout.strip() or "unknown"
+    except Exception:  # noqa: BLE001 — stripped checkout
+        return "unknown"
+
+
+def fix_docs(ctx) -> int:
+    """Rewrite every marked ``zoo-knob-table`` region from the knob
+    registry; returns the number of pages changed."""
+    from zoo_tpu.analysis.knob_pass import render_doc_with_tables
+    from zoo_tpu.common import knobs
+
+    changed = 0
+    for doc_rel in knobs.TABLE_DOCS:
+        path = os.path.join(ctx.root, doc_rel)
+        if not os.path.exists(path):
+            continue
+        with open(path, "r", encoding="utf-8") as f:
+            text = f.read()
+        out = render_doc_with_tables(doc_rel, text)
+        if out != text:
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(out)
+            changed += 1
+            print(f"rewrote knob tables in {doc_rel}")
+    return changed
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="scripts/zoo_lint.py")
+    ap.add_argument("--root", default=_ROOT)
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 on active findings or stale "
+                         "allowlist entries")
+    ap.add_argument("--json", metavar="PATH", default=None,
+                    help="write the machine-readable findings report")
+    ap.add_argument("--passes", default=None,
+                    help="comma-separated subset (default: all AST "
+                         "passes)")
+    ap.add_argument("--fix-docs", action="store_true",
+                    help="rewrite the generated knob tables from the "
+                         "registry, then re-check")
+    ap.add_argument("--allowlist", default=None,
+                    help="override the allowlist path")
+    ap.add_argument("-q", "--quiet", action="store_true")
+    ns = ap.parse_args(argv)
+
+    from zoo_tpu.analysis import (
+        Context,
+        apply_allowlist,
+        findings_json,
+        load_allowlist,
+        run_passes,
+    )
+
+    ctx = Context(ns.root, allowlist_path=ns.allowlist)
+    if ns.fix_docs:
+        fix_docs(ctx)
+        ctx = Context(ns.root, allowlist_path=ns.allowlist)
+
+    names = ns.passes.split(",") if ns.passes else None
+    findings = run_passes(ctx, names)
+    entries = load_allowlist(ctx.allowlist_path)
+    active, suppressed = apply_allowlist(findings, entries)
+    stale = [e for e in entries if not e.used]
+
+    if ns.json:
+        meta = {"git_rev": _git_rev(ctx.root),
+                "passes": names or "all"}
+        with open(ns.json, "w", encoding="utf-8") as f:
+            f.write(findings_json(active, suppressed, meta))
+
+    if not ns.quiet:
+        for f in active:
+            print(f.format())
+        if suppressed:
+            print(f"({len(suppressed)} finding(s) allowlisted)")
+        for e in stale:
+            print(f"{ctx.allowlist_path}:{e.line}: stale allowlist "
+                  f"entry matches nothing: {e.rule} {e.file} "
+                  f"{e.detail}")
+    verdict = "clean" if not active else f"{len(active)} finding(s)"
+    if not ns.quiet:
+        print(f"zoo-lint: {verdict}, {len(suppressed)} allowlisted, "
+              f"{len(stale)} stale allowlist entr"
+              f"{'y' if len(stale) == 1 else 'ies'}")
+
+    # the purity contract applies to the linter itself
+    assert "jax" not in sys.modules, \
+        "zoo-lint imported jax — a lint-pass module lost its purity"
+
+    if ns.strict and (active or stale):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

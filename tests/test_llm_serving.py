@@ -841,6 +841,12 @@ def test_decode_compiles_exactly_one_executable(paged):
         pytest.skip("jit cache size API unavailable on this jax")
     assert counts["decode"] == 1, counts
     assert 0 < counts["prefill"] <= len(model.prefill_buckets), counts
+    # compiled-artifact contracts on the ONE decode executable: the
+    # donated cache is aliased in the HLO (a dropped donation doubles
+    # decode HBM) and the outfeed stays slots x 1 int32 ids, never
+    # slots x vocab logits (zoo-lint HLO-DONATION / HLO-HOST-TRANSFER)
+    from zoo_tpu.analysis.hlo import assert_llm_executable
+    assert_llm_executable(model, "decode")
 
 
 def _generate_all(model, prompts, n, sampling=None, rids=None,

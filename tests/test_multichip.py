@@ -37,3 +37,6 @@ def test_check_multichip_script_runs():
     assert m["llm_tp_token_identical"] is True
     assert m["llm_decode_compiles"] == 1
     assert m["llm_kv_blocks_leaked"] == 0
+    # the plan-aware compiled-artifact lints (zoo-lint HLO passes)
+    assert m["tp_hlo_lint"] == "pass"
+    assert m["llm_decode_artifact_lint"] == "pass"

@@ -319,6 +319,14 @@ class TestRealModelMatrix:
         assert c["verify"] == 1 and c["decode"] == 0, c
         assert c["prefill_chunk"] <= 1, c
         assert st["blocks_used"] == 0, st
+        if variant == "spec":
+            # compiled-artifact contracts on the ONE verify
+            # executable: donated cache aliased, outfeed stays
+            # slots x (k+1) int32 rows, never logits (zoo-lint
+            # HLO-DONATION / HLO-HOST-TRANSFER); one variant is
+            # enough — the census asserts the others share it
+            from zoo_tpu.analysis.hlo import assert_llm_executable
+            assert_llm_executable(model, "verify")
         assert st["spec_accepted_tokens"] > 0, (
             "the repetitive streams should accept some drafts")
         if "prefix" in variant:

@@ -1,0 +1,38 @@
+# zoo-lint: jax-free
+"""zoo-lint: static contract checks over the tree and its compiled
+artifacts.
+
+The platform's correctness rests on conventions — parse-once ``ZOO_*``
+configs, jax-free chaos-smoke modules, lock-guarded state, one
+telemetry vocabulary, donated caches and a ONE-executable compile
+census. Each convention here is a *pass* that turns silent rot into a
+build failure with a named offender (see docs/static_analysis.md).
+
+AST/import-graph passes (run by ``scripts/zoo_lint.py`` and the
+``lint``-marked suite): :mod:`~zoo_tpu.analysis.knob_pass`,
+:mod:`~zoo_tpu.analysis.purity`, :mod:`~zoo_tpu.analysis.locks`,
+:mod:`~zoo_tpu.analysis.telemetry`. Compiled-artifact checks
+(:mod:`~zoo_tpu.analysis.hlo`) piggyback on executables existing tests
+already compile.
+"""
+
+from zoo_tpu.analysis.framework import (  # noqa: F401
+    AllowEntry,
+    Context,
+    Finding,
+    LintError,
+    Pass,
+    all_passes,
+    apply_allowlist,
+    findings_json,
+    get_pass,
+    load_allowlist,
+    register_pass,
+    run_passes,
+)
+
+__all__ = [
+    "AllowEntry", "Context", "Finding", "LintError", "Pass",
+    "all_passes", "apply_allowlist", "findings_json", "get_pass",
+    "load_allowlist", "register_pass", "run_passes",
+]

@@ -1,0 +1,461 @@
+# zoo-lint: jax-free
+"""Compiled-HLO contract checks: collectives, sharding, donation,
+host transfer.
+
+The generalization of ``zoo_tpu/parallel/hlo_check.py`` (which now
+re-exports from here): PR 8's lint caught "FSDP that isn't" by reading
+the compiled module text instead of trusting the sharding spec; the
+same move covers the other compiled-artifact contracts the platform
+leans on:
+
+* ``HLO-DONATION`` — args marked donated must appear in the module's
+  ``input_output_alias`` table. A silently-dropped donation on the
+  decode executable doubles decode HBM (two resident KV caches) and
+  runs — the alias table is the only place the drop is visible.
+* ``HLO-HOST-TRANSFER`` — the decode/verify executables' token output
+  must stay ``slots x width`` int32 ids (width 1, or spec_k+1 for
+  verify), and no entry output may carry a vocab-sized dim: logits
+  crossing to host is the pre-PR-10 regression the
+  ``zoo_llm_host_transfer_bytes_total`` audit bounds dynamically and
+  this lint forbids statically.
+* ``HLO-SHARDING`` — plan-aware: FSDP steps must not carry
+  full-global-shape sharded params in entry *outputs* (PR 8's rule),
+  and megatron/tp serving executables must not carry them in entry
+  *parameters* either ("TP that isn't": every device holds the whole
+  model and the per-device-bytes win silently evaporates).
+
+All checks are pure text parsers over ``compiled.as_text()`` plus
+raising ``assert_*`` wrappers (for in-test use) and Finding-returning
+``*_findings`` forms (for the lint report). This module imports no
+jax; callers hand it text or objects with ``as_text()``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from zoo_tpu.analysis.framework import Finding
+
+__all__ = [
+    "CollectiveError", "HloContractError",
+    "collective_counts", "assert_collectives",
+    "entry_output_shapes", "shaped_ops", "assert_fsdp_sharded",
+    "input_output_aliases", "donation_findings", "assert_donated",
+    "entry_layout", "host_transfer_findings", "assert_host_transfer",
+    "sharding_findings", "assert_plan_sharded",
+]
+
+
+class CollectiveError(AssertionError):
+    """A compiled step's collective mix contradicts the intended plan."""
+
+
+class HloContractError(AssertionError):
+    """A compiled artifact violates a donation / host-transfer /
+    sharding contract."""
+
+
+# async pairs (all-reduce-start/-done) and channel-suffixed forms all
+# reduce to the base op name; "-start" lines carry the operands so count
+# only those plus the plain sync form
+_COLLECTIVE_RE = re.compile(
+    r"\b(all-reduce|all-gather|reduce-scatter|collective-permute|"
+    r"all-to-all)(-start)?\b")
+
+
+def _text_of(compiled) -> str:
+    if isinstance(compiled, str):
+        return compiled
+    return compiled.as_text()
+
+
+def collective_counts(hlo_text: str) -> Dict[str, int]:
+    """Count collective instructions in optimized HLO module text.
+
+    Counts instruction definitions (lines containing ``= <op>`` or the
+    fused/async start forms), merging async ``-start`` with sync forms.
+    """
+    counts: Dict[str, int] = {}
+    for line in hlo_text.splitlines():
+        # instruction lines look like  "%name = type op(...)"; skip
+        # metadata/backend-config mentions by requiring the op token to
+        # follow an "= " or " = " assignment on the line
+        if "=" not in line:
+            continue
+        rhs = line.split("=", 1)[1]
+        m = _COLLECTIVE_RE.search(rhs)
+        if not m:
+            continue
+        if m.group(2) is None and "-done" in rhs[:m.start() + 24]:
+            continue  # the -done half of an async pair
+        counts[m.group(1)] = counts.get(m.group(1), 0) + 1
+    return counts
+
+
+def assert_collectives(compiled, *, require: Iterable[str] = (),
+                       require_any: Optional[Iterable[str]] = None,
+                       forbid: Iterable[str] = (),
+                       label: str = "step") -> Dict[str, int]:
+    """Assert the collective mix of a compiled executable (or HLO text).
+
+    ``require``: ops that must each appear at least once.
+    ``require_any``: at least one op of this set must appear.
+    ``forbid``: ops that must not appear at all.
+    Returns the counts for further custom assertions.
+    """
+    counts = collective_counts(_text_of(compiled))
+    missing = [op for op in require if counts.get(op, 0) == 0]
+    if missing:
+        raise CollectiveError(
+            f"{label}: expected collective(s) {missing} absent from the "
+            f"compiled HLO (found {counts or 'none'}) — the sharding "
+            "spec did not produce the intended parallelism")
+    if require_any is not None:
+        opts = list(require_any)
+        if not any(counts.get(op, 0) for op in opts):
+            raise CollectiveError(
+                f"{label}: none of {opts} present in the compiled HLO "
+                f"(found {counts or 'none'}) — the sharding spec did "
+                "not produce the intended parallelism")
+    bad = {op: counts[op] for op in forbid if counts.get(op, 0)}
+    if bad:
+        raise CollectiveError(
+            f"{label}: forbidden collective(s) {bad} present in the "
+            "compiled HLO — under this plan they indicate accidental "
+            "resharding (e.g. a full-parameter all-gather in pure DP)")
+    return counts
+
+
+# -- shape parsers ----------------------------------------------------------
+# After SPMD partitioning every shape in the module text is the
+# PER-DEVICE local shape; these parsers read the entry computation's
+# signature and per-instruction output shapes from the text.
+
+_SHAPE_RE = re.compile(r"\b(?:[a-z]+\d*)\[([0-9,]*)\]")
+_TYPED_SHAPE_RE = re.compile(r"\b([a-z]+\d+|pred|token)\[([0-9,]*)\]")
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*(.*)$")
+_ENTRY_LAYOUT_RE = re.compile(
+    r"entry_computation_layout=\{\((.*?)\)->\((.*?)\)\}", re.S)
+_ALIAS_ENTRY_RE = re.compile(
+    r"\{([0-9, ]*)\}:\s*\((\d+)\s*,\s*\{[0-9, ]*\}")
+
+
+def _parse_dims(text: str):
+    """Every tensor shape in ``text`` as a tuple of ints (scalars = ())."""
+    out = []
+    for m in _SHAPE_RE.finditer(text):
+        dims = m.group(1)
+        out.append(tuple(int(d) for d in dims.split(",")) if dims else ())
+    return out
+
+
+def _parse_typed(text: str) -> List[Tuple[str, Tuple[int, ...]]]:
+    """``(dtype, shape)`` pairs, e.g. ``s32[4,1]`` → ("s32", (4, 1))."""
+    out = []
+    for m in _TYPED_SHAPE_RE.finditer(text):
+        dims = m.group(2)
+        shape = tuple(int(d) for d in dims.split(",")) if dims else ()
+        out.append((m.group(1), shape))
+    return out
+
+
+def entry_output_shapes(hlo_text: str):
+    """Per-device output shapes of the module's entry computation, from
+    the ``ENTRY ... -> (...)`` signature."""
+    for line in hlo_text.splitlines():
+        ls = line.strip()
+        if ls.startswith("ENTRY") and "->" in ls:
+            return _parse_dims(ls.split("->", 1)[1])
+    return []
+
+
+def entry_layout(hlo_text: str
+                 ) -> Tuple[List[Tuple[str, Tuple[int, ...]]],
+                            List[Tuple[str, Tuple[int, ...]]]]:
+    """``(parameters, outputs)`` of the entry computation as typed
+    ``(dtype, per-device shape)`` lists, parsed from the module
+    header's ``entry_computation_layout``."""
+    m = _ENTRY_LAYOUT_RE.search(hlo_text)
+    if not m:
+        return [], []
+    return _parse_typed(m.group(1)), _parse_typed(m.group(2))
+
+
+def shaped_ops(hlo_text: str, op: str):
+    """``(instruction_name, output_shape)`` for every instruction whose
+    opcode matches ``op`` (async ``-start`` forms included)."""
+    out = []
+    for line in hlo_text.splitlines():
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        rhs = m.group(2)
+        om = re.search(rf"\b{re.escape(op)}(-start)?\(", rhs)
+        if not om:
+            continue
+        shapes = _parse_dims(rhs[:om.start()])
+        out.append((m.group(1), shapes[-1] if shapes else ()))
+    return out
+
+
+# -- donation lint ----------------------------------------------------------
+
+def input_output_aliases(hlo_text: str
+                         ) -> List[Tuple[Tuple[int, ...], int]]:
+    """``(output index, parameter number)`` pairs from the module's
+    ``input_output_alias`` table (empty when XLA dropped or never had
+    donation)."""
+    start = hlo_text.find("input_output_alias={")
+    if start < 0:
+        return []
+    # the table nests braces ({ {0}: (1, {}, may-alias) }) — scan to
+    # the matching close instead of regexing non-greedily
+    i = start + len("input_output_alias=")
+    depth = 0
+    end = i
+    for end in range(i, len(hlo_text)):
+        c = hlo_text[end]
+        if c == "{":
+            depth += 1
+        elif c == "}":
+            depth -= 1
+            if depth == 0:
+                break
+    body = hlo_text[i + 1:end]
+    out = []
+    for em in _ALIAS_ENTRY_RE.finditer(body):
+        idx = tuple(int(p) for p in em.group(1).replace(" ", "")
+                    .split(",") if p != "")
+        out.append((idx, int(em.group(2))))
+    return out
+
+
+def donation_findings(compiled, expected_donated: int,
+                      label: str = "executable") -> List[Finding]:
+    """Check that at least ``expected_donated`` distinct parameters
+    are aliased into outputs — the count of leaves in the donated
+    pytree(s). Fewer means XLA dropped (part of) the donation and the
+    executable holds two copies of supposedly in-place state."""
+    text = _text_of(compiled)
+    aliased = {p for _, p in input_output_aliases(text)}
+    if len(aliased) >= expected_donated:
+        return []
+    return [Finding(
+        "HLO-DONATION", label, 0,
+        f"{len(aliased)} of {expected_donated} donated buffers appear "
+        "in input_output_alias — donation was (partly) dropped and "
+        "in-place state is double-buffered",
+        "check donate_argnums matches the arg position, that the "
+        "donated leaves' shardings match in/out, and that the "
+        "platform supports donation",
+        detail="donation")]
+
+
+def assert_donated(compiled, expected_donated: int,
+                   label: str = "executable") -> None:
+    fs = donation_findings(compiled, expected_donated, label)
+    if fs:
+        raise HloContractError(fs[0].message + f" ({label})")
+
+
+# -- host-transfer lint -----------------------------------------------------
+
+def host_transfer_findings(compiled, slots: int, vocab: int,
+                           token_width: int = 1,
+                           label: str = "decode executable"
+                           ) -> List[Finding]:
+    """The decode-path outfeed contract: some entry output is the
+    ``slots x token_width`` int32 token batch, and NO entry output
+    carries a vocab-sized dimension (logits crossing the device
+    boundary — at vocab 32k that is 32000x the bytes per tick the
+    roofline budgeted)."""
+    text = _text_of(compiled)
+    _params, outs = entry_layout(text)
+    findings: List[Finding] = []
+    want = (slots, token_width)
+    has_tokens = any(dt in ("s32", "u32") and
+                     (shape == want or
+                      (token_width == 1 and shape == (slots,)))
+                     for dt, shape in outs)
+    if not has_tokens:
+        findings.append(Finding(
+            "HLO-HOST-TRANSFER", label, 0,
+            f"no s32[{slots},{token_width}] token output in the entry "
+            f"computation (outputs: {outs}) — the host readback "
+            "cannot be the slots x width id batch",
+            "keep sampling on device; the executable must return "
+            "token ids, not logits",
+            detail="tokens"))
+    # a vocab-sized dim in any entry output = logits leaving the device
+    if vocab > max(slots, token_width, 1):
+        for i, (dt, shape) in enumerate(outs):
+            if vocab in shape:
+                findings.append(Finding(
+                    "HLO-HOST-TRANSFER", label, 0,
+                    f"entry output {i} is {dt}{list(shape)} — a "
+                    f"vocab-sized ({vocab}) tensor crosses to host; "
+                    "the decode outfeed must stay slots x width int32 "
+                    "ids",
+                    "sample on device and return ids; logits must "
+                    "never be an entry output",
+                    detail=f"output{i}"))
+    return findings
+
+
+def assert_host_transfer(compiled, slots: int, vocab: int,
+                         token_width: int = 1,
+                         label: str = "decode executable") -> None:
+    fs = host_transfer_findings(compiled, slots, vocab, token_width,
+                                label)
+    if fs:
+        raise HloContractError("; ".join(f.message for f in fs) +
+                               f" ({label})")
+
+
+# -- plan-aware sharding lint -----------------------------------------------
+# FSDP: a full-global-shape sharded tensor in the entry OUTPUTS means
+# the updated param/moment was gathered into a replicated tensor and
+# carried that way ("FSDP that isn't"). Megatron/TP: the same shape in
+# the entry PARAMETERS means the weights were fed replicated — every
+# device holds the whole model ("TP that isn't"). Both run fine and
+# produce correct numbers; only the module text shows the regression.
+
+def sharding_findings(compiled, sharded_shapes,
+                      replicated_shapes=(), *, local_shapes=(),
+                      check_params: bool = False,
+                      check_outputs: bool = True,
+                      label: str = "step") -> List[Finding]:
+    """Findings for full-global-shape appearances of plan-sharded
+    tensors in the entry signature. Shapes colliding with legitimately
+    replicated or per-device-local shapes are skipped — the text lint
+    cannot tell two same-shaped tensors apart.
+    ``zoo_tpu.parallel.plans.fsdp_lint_shapes`` builds all three lists
+    from a params pytree under any plan (fsdp and megatron alike)."""
+    text = _text_of(compiled)
+    skip = {tuple(s) for s in replicated_shapes} | \
+        {tuple(s) for s in local_shapes}
+    watch = {tuple(s) for s in sharded_shapes
+             if tuple(s) and tuple(s) not in skip}
+    if not watch:
+        return []
+    findings: List[Finding] = []
+    params, outs = entry_layout(text)
+    if check_outputs:
+        out_shapes = [s for _, s in outs] or entry_output_shapes(text)
+        bad_outs = [(i, s) for i, s in enumerate(out_shapes)
+                    if s in watch]
+        if bad_outs:
+            gathers = [(n, s) for n, s in shaped_ops(text, "all-gather")
+                       if s in {s for _, s in bad_outs}]
+            findings.append(Finding(
+                "HLO-SHARDING", label, 0,
+                f"{len(bad_outs)} entry output(s) carry FULL-shape "
+                f"supposedly-sharded tensors "
+                f"{sorted({s for _, s in bad_outs})} (output indices "
+                f"{[i for i, _ in bad_outs]}); full-parameter "
+                f"all-gather op(s): "
+                f"{[n for n, _ in gathers] or '(none found)'} — the "
+                "step gathered shards into replicated tensors "
+                "(\"FSDP that isn't\")",
+                "pin out_shardings to the plan's layout",
+                detail="outputs"))
+    if check_params:
+        bad_params = [(i, s) for i, (_dt, s) in enumerate(params)
+                      if s in watch]
+        if bad_params:
+            findings.append(Finding(
+                "HLO-SHARDING", label, 0,
+                f"{len(bad_params)} entry parameter(s) carry "
+                f"FULL-shape supposedly-sharded tensors "
+                f"{sorted({s for _, s in bad_params})} (parameter "
+                f"indices {[i for i, _ in bad_params]}) — the weights "
+                "were fed replicated (\"TP that isn't\"): per-device "
+                "bytes are back to the full model",
+                "pass in_shardings from the plan and place the "
+                "params before the call",
+                detail="params"))
+    return findings
+
+
+def assert_plan_sharded(compiled, sharded_shapes, replicated_shapes=(),
+                        *, local_shapes=(), plan: str = "fsdp",
+                        label: str = "step") -> None:
+    """Plan-aware raising form: ``plan="fsdp"`` checks entry outputs
+    (the PR 8 rule); ``plan="megatron"``/``"tp"`` checks entry
+    parameters AND outputs."""
+    check_params = plan in ("megatron", "tp")
+    fs = sharding_findings(compiled, sharded_shapes, replicated_shapes,
+                           local_shapes=local_shapes,
+                           check_params=check_params,
+                           check_outputs=True, label=label)
+    if fs:
+        raise CollectiveError(fs[0].message + f" ({label})")
+
+
+def assert_fsdp_sharded(compiled, sharded_shapes,
+                        replicated_shapes=(), *, local_shapes=(),
+                        label: str = "fsdp step") -> None:
+    """The PR 8 entry-output lint (back-compat name; see
+    :func:`assert_plan_sharded`)."""
+    assert_plan_sharded(compiled, sharded_shapes, replicated_shapes,
+                        local_shapes=local_shapes, plan="fsdp",
+                        label=label)
+
+
+# -- LLM executable wiring --------------------------------------------------
+
+def donation_supported() -> bool:
+    """Whether THIS process's default jax backend preserves buffer
+    donation (probed once with a 1-element executable; some CPU
+    toolchains drop donation at lowering with a warning, which is
+    exactly the silent state this lint exists to catch on devices)."""
+    global _DONATION_PROBE
+    if _DONATION_PROBE is None:
+        try:
+            import warnings
+
+            import jax
+            import jax.numpy as jnp
+
+            f = jax.jit(lambda a: a + 1, donate_argnums=(0,))
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                text = f.lower(
+                    jnp.zeros((1,), jnp.float32)).compile().as_text()
+            _DONATION_PROBE = bool(input_output_aliases(text))
+        except Exception:  # noqa: BLE001 — no jax / exotic backend
+            _DONATION_PROBE = False
+    return _DONATION_PROBE
+
+
+_DONATION_PROBE: Optional[bool] = None
+
+
+def llm_executable_findings(model, which: str = "decode"
+                            ) -> List[Finding]:
+    """Donation + host-transfer lint over one compiled LLM executable
+    (``decode`` or ``verify``) of a
+    :class:`~zoo_tpu.serving.llm.model.PagedLlamaModel`. Piggybacks on
+    the jit cache — lowering an already-run signature is cheap."""
+    text = model.compiled_hlo(which)
+    if text is None:
+        return []
+    label = f"llm {which} executable"
+    cache_leaves = model.donated_cache_leaves()
+    findings: List[Finding] = []
+    if donation_supported():
+        findings += donation_findings(text, cache_leaves, label)
+    width = 1 if which == "decode" else model.spec_k + 1
+    findings += host_transfer_findings(
+        text, slots=model.num_slots, vocab=model.cfg.vocab,
+        token_width=width, label=label)
+    return findings
+
+
+def assert_llm_executable(model, which: str = "decode") -> None:
+    fs = llm_executable_findings(model, which)
+    if fs:
+        raise HloContractError(
+            "; ".join(f"[{f.rule}] {f.message}" for f in fs))

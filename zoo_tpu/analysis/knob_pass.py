@@ -1,0 +1,515 @@
+# zoo-lint: jax-free
+"""Knob-contract pass: every ``ZOO_*`` read is registered, alive,
+documented, and parsed at a declared site.
+
+Rules:
+
+* ``KNOB-UNDECLARED`` — a ``ZOO_*`` environment name is read somewhere
+  but missing from :mod:`zoo_tpu.common.knobs`.
+* ``KNOB-DEAD`` — a registered knob no code reads (documented-but-dead
+  knobs are how doc tables rot).
+* ``KNOB-RAW-ENV`` — a raw ``os.environ`` / ``os.getenv`` read inside
+  ``zoo_tpu/`` outside a ``# zoo-lint: config-parse`` site. The PR 6
+  parse-once rule, enforced everywhere: scattered per-call env reads
+  make runtime adaptation impossible and turn knob precedence into
+  call-order trivia. ``env_int``/``env_float``
+  (:mod:`zoo_tpu.util.resilience`) and :func:`zoo_tpu.common.knobs.value`
+  are the blessed parsers and are exempt.
+* ``KNOB-UNDOCUMENTED`` — a non-internal knob whose name does not
+  appear in its owning doc page.
+* ``KNOB-DOC-DRIFT`` — a generated ``<!-- zoo-knob-table:... -->``
+  region disagrees with the registry (``scripts/zoo_lint.py
+  --fix-docs`` rewrites the regions).
+
+Name resolution is deliberately static but practical: literal strings,
+module-level ``*_ENV = "ZOO_..."`` constants (cross-module), local
+``env = os.environ`` aliases, and f-strings with a literal ``ZOO_``
+prefix (``f"ZOO_MESH_{name}"`` counts as a read of every registered
+knob with that prefix).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from zoo_tpu.analysis.framework import (
+    Context,
+    Finding,
+    Pass,
+    function_marked,
+    module_markers,
+    register_pass,
+)
+from zoo_tpu.common import knobs as knob_registry
+
+__all__ = ["KnobPass", "extract_reads", "KnobRead", "doc_table_regions",
+           "render_doc_with_tables"]
+
+_ENV_HELPERS = {"env_int", "env_float", "env_str", "env_bool",
+                "_env_int", "_env_float"}
+_REGISTRY_HELPERS = {"value"}  # + per-module import aliases of
+#                                zoo_tpu.common.knobs.value (resolved
+#                                in _registry_aliases)
+
+_TABLE_RE = re.compile(
+    r"<!--\s*zoo-knob-table:([A-Za-z0-9_-]+)\s+begin\s*-->")
+_TABLE_END_RE = re.compile(
+    r"<!--\s*zoo-knob-table:([A-Za-z0-9_-]+)\s+end\s*-->")
+
+
+class KnobRead:
+    """One static read of an environment knob."""
+
+    __slots__ = ("name", "file", "line", "raw", "prefix")
+
+    def __init__(self, name: Optional[str], file: str, line: int,
+                 raw: bool, prefix: Optional[str] = None):
+        self.name = name          # literal name, or None
+        self.file = file
+        self.line = line
+        self.raw = raw            # raw os.environ access (not a helper)
+        self.prefix = prefix      # f-string literal prefix, e.g. ZOO_MESH_
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _env_constants(ctx: Context, files: List[str]) -> Dict[str, str]:
+    """Module-level ``X_ENV = "ZOO_..."`` string constants across the
+    scanned tree (resolved by bare constant name — the convention is
+    unambiguous in this tree)."""
+    table: Dict[str, str] = {}
+    for rel in files:
+        tree = ctx.ast_of(rel)
+        if tree is None:
+            continue
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                val = _const_str(node.value)
+                name = node.targets[0].id
+                if val is not None and name.endswith("_ENV") \
+                        and val.startswith("ZOO_"):
+                    table[name] = val
+    return table
+
+
+def _registry_aliases(tree: ast.Module) -> Set[str]:
+    """Local names bound to ``zoo_tpu.common.knobs.value`` via
+    ``from ... import value as knob_value`` — the call style every
+    production site uses; without resolving it, an unregistered name
+    in exactly that style would escape the lint."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module and \
+                node.module.endswith("common.knobs"):
+            for a in node.names:
+                if a.name == "value":
+                    out.add(a.asname or a.name)
+    return out
+
+
+class _ReadVisitor(ast.NodeVisitor):
+    """Collects knob reads + raw-environ uses in one module."""
+
+    def __init__(self, rel: str, consts: Dict[str, str],
+                 registry_aliases: Set[str] = frozenset()):
+        self.rel = rel
+        self.consts = consts
+        self.registry_aliases = set(registry_aliases)
+        self.reads: List[KnobRead] = []
+        # (knob, literal default, line) at env_int/env_float calls —
+        # compared against the registry default (KNOB-DEFAULT-DRIFT)
+        self.default_sites: List[Tuple[str, float, int]] = []
+        self.raw_uses: List[Tuple[int, Optional[str], ast.AST]] = []
+        self._environ_aliases: Set[str] = set()
+        self._func_stack: List[ast.AST] = []
+
+    # -- helpers ------------------------------------------------------------
+    def _is_environ(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Attribute) and node.attr == "environ" \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "os":
+            return True
+        return isinstance(node, ast.Name) and \
+            node.id in self._environ_aliases
+
+    def _name_of(self, arg: ast.AST) -> Tuple[Optional[str],
+                                              Optional[str]]:
+        """``(literal name, fstring prefix)`` for a knob-name arg."""
+        lit = _const_str(arg)
+        if lit is not None:
+            return lit, None
+        if isinstance(arg, ast.Name) and arg.id in self.consts:
+            return self.consts[arg.id], None
+        if isinstance(arg, ast.JoinedStr) and arg.values:
+            head = arg.values[0]
+            lit = _const_str(head)
+            if lit and lit.startswith("ZOO_"):
+                return None, lit
+        return None, None
+
+    def _note_read(self, arg: Optional[ast.AST], line: int, raw: bool):
+        name = prefix = None
+        if arg is not None:
+            name, prefix = self._name_of(arg)
+        if name is not None and not name.startswith("ZOO_"):
+            return  # CONDA_*, XLA_* etc. are out of contract scope
+        self.reads.append(KnobRead(name, self.rel, line, raw, prefix))
+
+    # -- visitors -----------------------------------------------------------
+    def visit_FunctionDef(self, node):
+        self._func_stack.append(node)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Assign(self, node):
+        # local alias: env = os.environ
+        if len(node.targets) == 1 and isinstance(node.targets[0],
+                                                 ast.Name):
+            if isinstance(node.value, ast.Attribute) and \
+                    self._is_environ(node.value):
+                self._environ_aliases.add(node.targets[0].id)
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        fn = node.func
+        fname = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else None)
+        # os.getenv(...)
+        if isinstance(fn, ast.Attribute) and fn.attr == "getenv" \
+                and isinstance(fn.value, ast.Name) \
+                and fn.value.id == "os":
+            self._note_raw(node.args[0] if node.args else None,
+                           node.lineno)
+        # os.environ.get(...) / env.get(...)
+        elif isinstance(fn, ast.Attribute) and fn.attr == "get" \
+                and self._is_environ(fn.value):
+            self._note_raw(node.args[0] if node.args else None,
+                           node.lineno)
+        elif fname in _ENV_HELPERS or fname in _REGISTRY_HELPERS \
+                or fname in self.registry_aliases:
+            arg = node.args[0] if node.args else None
+            if arg is not None:
+                name, prefix = self._name_of(arg)
+                if (name and name.startswith("ZOO_")) or prefix:
+                    self.reads.append(KnobRead(name, self.rel,
+                                               node.lineno, False,
+                                               prefix))
+                if name and fname in _ENV_HELPERS and \
+                        len(node.args) > 1 and \
+                        isinstance(node.args[1], ast.Constant) and \
+                        isinstance(node.args[1].value, (int, float)):
+                    self.default_sites.append(
+                        (name, node.args[1].value, node.lineno))
+        self.generic_visit(node)
+
+    def _note_raw(self, arg: Optional[ast.AST], line: int):
+        """A raw environ read: record the knob usage, and record the
+        site for the parse-site rule unless it names a foreign
+        (non-``ZOO_``) variable — interop reads of e.g. ``XLA_FLAGS``
+        are outside the knob contract."""
+        self._note_read(arg, line, raw=True)
+        name, prefix = (None, None) if arg is None else \
+            self._name_of(arg)
+        if name is not None and not name.startswith("ZOO_"):
+            return
+        self.raw_uses.append((line, self._detail(arg), None))
+
+    def visit_Subscript(self, node):
+        # os.environ["ZOO_X"] — a read in Load context; Store/Del are
+        # env *wiring* for child processes and stay legal
+        if self._is_environ(node.value) and isinstance(node.ctx,
+                                                       ast.Load):
+            self._note_raw(node.slice, node.lineno)
+        self.generic_visit(node)
+
+    def _detail(self, arg: Optional[ast.AST]) -> Optional[str]:
+        if arg is None:
+            return None
+        name, prefix = self._name_of(arg)
+        return name or (prefix and prefix + "*")
+
+    def enclosing_funcs(self, node: ast.AST):  # pragma: no cover
+        return list(self._func_stack)
+
+
+def extract_reads(ctx: Context, files: List[str],
+                  consts: Dict[str, str]
+                  ) -> Tuple[List[KnobRead],
+                             List[Tuple[str, int, Optional[str]]],
+                             List[Tuple[str, str, float, int]]]:
+    """``(reads, raw sites, default sites)``: all knob reads, the
+    raw-environ use sites ``(file, line, detail)`` outside config-parse
+    markers, and the ``(file, knob, literal default, line)`` of every
+    env-helper call whose fallback is a literal."""
+    reads: List[KnobRead] = []
+    raw_sites: List[Tuple[str, int, Optional[str]]] = []
+    default_sites: List[Tuple[str, str, float, int]] = []
+    for rel in files:
+        tree = ctx.ast_of(rel)
+        if tree is None:
+            continue
+        src = ctx.source_of(rel)
+        markers = module_markers(src)
+        v = _ReadVisitor(rel, consts, _registry_aliases(tree))
+        v.visit(tree)
+        reads.extend(v.reads)
+        default_sites.extend((rel, *site) for site in v.default_sites)
+        if "config-parse" in markers:
+            continue  # whole module is a declared parse site
+        src_lines = src.splitlines()
+        # map line -> enclosing function nodes (cheap: re-walk defs)
+        funcs = [n for n in ast.walk(tree)
+                 if isinstance(n, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef))]
+        marked_spans = []
+        for fn in funcs:
+            if function_marked(src_lines, fn, "config-parse"):
+                marked_spans.append((fn.lineno, fn.end_lineno))
+        for line, detail, _node in v.raw_uses:
+            if any(lo <= line <= hi for lo, hi in marked_spans):
+                continue
+            raw_sites.append((rel, line, detail))
+    return reads, raw_sites, default_sites
+
+
+def literal_knob_mentions(ctx: Context, files: List[str]) -> Set[str]:
+    """Every ``ZOO_*`` string literal anywhere in the scanned ASTs —
+    the "greppable" usage net behind the dead-knob check (registry
+    declarations themselves excluded)."""
+    out: Set[str] = set()
+    for rel in files:
+        if rel == "zoo_tpu/common/knobs.py":
+            continue
+        tree = ctx.ast_of(rel)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str) and \
+                    node.value.startswith("ZOO_"):
+                out.add(node.value)
+    return out
+
+
+# -- doc tables -------------------------------------------------------------
+
+def doc_table_regions(text: str) -> List[Tuple[str, int, int]]:
+    """``(group, begin line, end line)`` for every marked knob-table
+    region (lines are 1-based and refer to the marker lines)."""
+    out = []
+    lines = text.splitlines()
+    open_group: Optional[Tuple[str, int]] = None
+    for i, line in enumerate(lines, 1):
+        m = _TABLE_RE.search(line)
+        if m:
+            open_group = (m.group(1), i)
+            continue
+        m = _TABLE_END_RE.search(line)
+        if m and open_group and open_group[0] == m.group(1):
+            out.append((open_group[0], open_group[1], i))
+            open_group = None
+    return out
+
+
+def _render_table(doc_rel: str, group: str, registry=None) -> str:
+    return knob_registry.render_table(doc_rel, group, registry)
+
+
+def render_doc_with_tables(doc_rel: str, text: str,
+                           registry=None) -> str:
+    """``text`` with every marked region's body replaced by the
+    registry rendering — what ``--fix-docs`` writes and what the
+    drift check compares against."""
+    lines = text.splitlines()
+    out: List[str] = []
+    regions = {begin: (group, end)
+               for group, begin, end in doc_table_regions(text)}
+    i = 1
+    n = len(lines)
+    while i <= n:
+        out.append(lines[i - 1])
+        if i in regions:
+            group, end = regions[i]
+            rendered = _render_table(doc_rel, group, registry)
+            if rendered:
+                out.append(rendered)
+            out.append(lines[end - 1])
+            i = end
+        i += 1
+    result = "\n".join(out)
+    if text.endswith("\n"):
+        result += "\n"
+    return result
+
+
+class KnobPass(Pass):
+    name = "knobs"
+    rules = ("KNOB-UNDECLARED", "KNOB-DEAD", "KNOB-RAW-ENV",
+             "KNOB-DEFAULT-DRIFT", "KNOB-UNDOCUMENTED",
+             "KNOB-DOC-DRIFT")
+    doc = "ZOO_* knob registration / liveness / parse-site / doc drift"
+
+    def run(self, ctx: Context) -> List[Finding]:
+        findings: List[Finding] = []
+        lib_files = ctx.py_files()
+        all_files = lib_files + ctx.aux_py_files()
+        consts = _env_constants(ctx, all_files)
+        reads, raw_sites, default_sites = extract_reads(
+            ctx, all_files, consts)
+        # fixture tests override the registry/table set on the ctx
+        registered = getattr(ctx, "knob_registry", None)
+        if registered is None:
+            registered = knob_registry.KNOBS
+        table_docs = getattr(ctx, "knob_table_docs", None)
+        if table_docs is None:
+            table_docs = knob_registry.TABLE_DOCS
+
+        # KNOB-UNDECLARED + usage tally
+        used: Set[str] = set()
+        for r in reads:
+            if r.prefix is not None:
+                hits = [k for k in registered if k.startswith(r.prefix)]
+                used.update(hits)
+                if not hits:
+                    findings.append(Finding(
+                        "KNOB-UNDECLARED", r.file, r.line,
+                        f"dynamic knob read with prefix {r.prefix!r} "
+                        "matches no registered knob",
+                        "register the family members in "
+                        "zoo_tpu/common/knobs.py",
+                        detail=r.prefix + "*"))
+                continue
+            if r.name is None:
+                continue
+            used.add(r.name)
+            if r.name not in registered:
+                findings.append(Finding(
+                    "KNOB-UNDECLARED", r.file, r.line,
+                    f"{r.name} is read here but not in the knob "
+                    "registry",
+                    "register it in zoo_tpu/common/knobs.py with "
+                    "type, default and owning doc",
+                    detail=r.name))
+
+        # KNOB-DEAD — registered but read nowhere. Usage is judged by
+        # the wide net ("greppable"): ANY literal mention in scanned
+        # code counts, which covers table-driven parse loops like
+        # spec.py's (env, kwarg) pairs where the read call's arg is a
+        # loop variable.
+        mentions = literal_knob_mentions(ctx, all_files)
+        knobs_rel = "zoo_tpu/common/knobs.py"
+        knobs_src = ctx.source_of(knobs_rel) if ctx.exists(knobs_rel) \
+            else ""
+        for name in registered:
+            if name in used or name in mentions:
+                continue
+            line = 1
+            for i, l in enumerate(knobs_src.splitlines(), 1):
+                if f'"{name}"' in l:
+                    line = i
+                    break
+            findings.append(Finding(
+                "KNOB-DEAD", knobs_rel, line,
+                f"{name} is registered (and documented) but no code "
+                "reads it",
+                "delete the registration and its doc rows, or wire "
+                "the knob back up",
+                detail=name))
+
+        # KNOB-RAW-ENV — zoo_tpu/ only
+        for rel, line, detail in raw_sites:
+            if not rel.startswith("zoo_tpu/"):
+                continue
+            findings.append(Finding(
+                "KNOB-RAW-ENV", rel, line,
+                "raw os.environ read outside a declared config-parse "
+                "site" + (f" ({detail})" if detail else ""),
+                "parse it in a '# zoo-lint: config-parse' constructor "
+                "(or via knobs.value / env_int / env_float)",
+                detail=detail or "<dynamic>"))
+
+        # KNOB-DEFAULT-DRIFT — an env_int/env_float fallback literal
+        # that disagrees with the registry default leaves the GENERATED
+        # doc tables confidently wrong about the real behavior
+        for rel, name, lit, line in default_sites:
+            knob = registered.get(name)
+            if knob is None or not isinstance(knob.default,
+                                              (int, float)):
+                continue
+            if float(lit) != float(knob.default):
+                findings.append(Finding(
+                    "KNOB-DEFAULT-DRIFT", rel, line,
+                    f"{name} falls back to {lit} here but the "
+                    f"registry (and the generated docs) say "
+                    f"{knob.default}",
+                    "make the call site and "
+                    "zoo_tpu/common/knobs.py agree (knobs.value "
+                    "avoids the duplicate entirely)",
+                    detail=name))
+
+        # KNOB-UNDOCUMENTED / KNOB-DOC-DRIFT
+        doc_cache: Dict[str, str] = {}
+        for knob in registered.values():
+            if knob.internal or knob.doc is None:
+                continue
+            if knob.doc not in doc_cache:
+                doc_cache[knob.doc] = ctx.source_of(knob.doc) \
+                    if ctx.exists(knob.doc) else ""
+            if knob.name not in doc_cache[knob.doc]:
+                findings.append(Finding(
+                    "KNOB-UNDOCUMENTED", knob.doc, 1,
+                    f"{knob.name} is registered with owning doc "
+                    f"{knob.doc} but never mentioned there",
+                    "add it to the page (generated tables: "
+                    "scripts/zoo_lint.py --fix-docs)",
+                    detail=knob.name))
+
+        for doc_rel in table_docs:
+            if not ctx.exists(doc_rel):
+                findings.append(Finding(
+                    "KNOB-DOC-DRIFT", doc_rel, 1,
+                    "doc page with generated knob tables is missing",
+                    "restore the page", detail="missing"))
+                continue
+            text = ctx.source_of(doc_rel)
+            regions = doc_table_regions(text)
+            groups_present = {g for g, _, _ in regions}
+            groups_expected = {
+                k.table for k in registered.values()
+                if k.doc == doc_rel and k.table} | {
+                e[1] for k in registered.values()
+                for e in k.also if e[0] == doc_rel}
+            for missing in sorted(groups_expected - groups_present):
+                findings.append(Finding(
+                    "KNOB-DOC-DRIFT", doc_rel, 1,
+                    f"no '<!-- zoo-knob-table:{missing} begin -->' "
+                    "region for a registered knob group",
+                    "add the marked region (scripts/zoo_lint.py "
+                    "--fix-docs fills it)",
+                    detail=missing))
+            regenerated = render_doc_with_tables(
+                doc_rel, text, registered)
+            if regenerated != text:
+                for group, begin, end in regions:
+                    body = "\n".join(text.splitlines()[begin:end - 1])
+                    want = _render_table(doc_rel, group, registered)
+                    if body != want:
+                        findings.append(Finding(
+                            "KNOB-DOC-DRIFT", doc_rel, begin,
+                            f"knob table '{group}' disagrees with the "
+                            "registry",
+                            "run scripts/zoo_lint.py --fix-docs",
+                            detail=group))
+        return findings
+
+
+register_pass(KnobPass)

@@ -1,0 +1,180 @@
+# zoo-lint: jax-free
+"""Lock-discipline pass (best-effort AST dataflow).
+
+Attributes annotated ``# guarded-by: _lock`` at their ``__init__``
+assignment may only be read or written while lexically inside a
+``with self._lock:`` block. This is exactly the bug class behind the
+PR 14 breaker half-open race and the PR 9 ``_note_warm_shape`` race:
+a dict/counter documented as lock-protected, mutated on one unlocked
+path nobody re-audited.
+
+Escapes, in decreasing order of preference:
+
+* methods whose name ends in ``_locked`` assert the *caller* holds
+  the lock (the annotation is the contract, the suffix is the
+  convention) — accesses inside them are allowed;
+* ``__init__``/``__del__`` run before/after the object is shared;
+* a trailing ``# zoo-lint: holds-lock`` comment on the access line
+  for call paths the AST cannot see (e.g. a helper only ever invoked
+  under the lock that does not follow the suffix convention);
+* the allowlist, with a justification.
+
+Best-effort means: the pass checks lexical containment in a ``with``
+whose context expression is ``self.<lock>`` (aliases and cross-object
+locking are out of scope), which is the discipline the annotated
+classes actually follow.
+
+Rule: ``LOCK-GUARD``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from zoo_tpu.analysis.framework import (
+    Context,
+    Finding,
+    Pass,
+    iter_comments,
+    register_pass,
+)
+
+__all__ = ["LockPass", "guarded_attrs"]
+
+_GUARD_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+_HOLDS_RE = re.compile(r"#\s*zoo-lint:\s*holds-lock\b")
+
+
+def guarded_attrs(src: str, tree: ast.Module
+                  ) -> Dict[str, Dict[str, Tuple[str, int]]]:
+    """``{class name: {attr: (lock attr, line)}}`` from
+    ``# guarded-by:`` comments attached to ``self.X = ...``
+    assignment lines anywhere in the class body."""
+    guard_lines: Dict[int, str] = {}
+    for line_no, comment in iter_comments(src):
+        m = _GUARD_RE.search(comment)
+        if m:
+            guard_lines[line_no] = m.group(1)
+    out: Dict[str, Dict[str, Tuple[str, int]]] = {}
+    if not guard_lines:
+        return out
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        attrs: Dict[str, Tuple[str, int]] = {}
+        for node in ast.walk(cls):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            lock = None
+            # trailing comment on any line of the assignment, or a
+            # comment-only line immediately above it
+            for ln in range(node.lineno - 1,
+                            (node.end_lineno or node.lineno) + 1):
+                if ln in guard_lines:
+                    lock = guard_lines[ln]
+                    break
+            if lock is None:
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self":
+                    attrs[t.attr] = (lock, node.lineno)
+        if attrs:
+            out[cls.name] = attrs
+    return out
+
+
+def _with_locks(node: ast.With) -> Set[str]:
+    """Names of ``self.<lock>`` attrs this with-statement acquires."""
+    out: Set[str] = set()
+    for item in node.items:
+        e = item.context_expr
+        if isinstance(e, ast.Attribute) and \
+                isinstance(e.value, ast.Name) and e.value.id == "self":
+            out.add(e.attr)
+        # `with self._lock:` via a Call like self._lock.acquire_timeout()
+        elif isinstance(e, ast.Call) and \
+                isinstance(e.func, ast.Attribute) and \
+                isinstance(e.func.value, ast.Attribute) and \
+                isinstance(e.func.value.value, ast.Name) and \
+                e.func.value.value.id == "self":
+            out.add(e.func.value.attr)
+    return out
+
+
+class LockPass(Pass):
+    name = "locks"
+    rules = ("LOCK-GUARD",)
+    doc = "attributes annotated '# guarded-by: <lock>' are only " \
+          "touched under `with self.<lock>`"
+
+    def run(self, ctx: Context) -> List[Finding]:
+        findings: List[Finding] = []
+        for rel in ctx.py_files():
+            tree = ctx.ast_of(rel)
+            if tree is None:
+                continue
+            src = ctx.source_of(rel)
+            by_class = guarded_attrs(src, tree)
+            if not by_class:
+                continue
+            holds = {ln for ln, c in iter_comments(src)
+                     if _HOLDS_RE.search(c)}
+            for cls in ast.walk(tree):
+                if not isinstance(cls, ast.ClassDef) or \
+                        cls.name not in by_class:
+                    continue
+                attrs = by_class[cls.name]
+                for meth in cls.body:
+                    if not isinstance(meth, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef)):
+                        continue
+                    if meth.name in ("__init__", "__del__") or \
+                            meth.name.endswith("_locked"):
+                        continue
+                    findings.extend(self._check_method(
+                        rel, cls.name, meth, attrs, holds))
+        return findings
+
+    def _check_method(self, rel: str, cls_name: str, meth: ast.AST,
+                      attrs: Dict[str, Tuple[str, int]],
+                      holds: Set[int]) -> List[Finding]:
+        findings: List[Finding] = []
+
+        def walk(node: ast.AST, held: Set[str]):
+            if isinstance(node, ast.With):
+                inner = held | _with_locks(node)
+                for child in node.body:
+                    walk(child, inner)
+                for item in node.items:
+                    walk(item.context_expr, held)
+                return
+            if isinstance(node, ast.Attribute) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == "self" and node.attr in attrs:
+                lock, _decl = attrs[node.attr]
+                if lock not in held and node.lineno not in holds:
+                    findings.append(Finding(
+                        "LOCK-GUARD", rel, node.lineno,
+                        f"{cls_name}.{node.attr} is guarded-by "
+                        f"self.{lock} but accessed here outside "
+                        f"`with self.{lock}` "
+                        f"(in {cls_name}.{meth.name})",
+                        "take the lock, rename the method with a "
+                        "_locked suffix if the caller holds it, or "
+                        "annotate the line '# zoo-lint: holds-lock'",
+                        detail=f"{cls_name}.{node.attr}"))
+            for child in ast.iter_child_nodes(node):
+                walk(child, held)
+
+        for stmt in meth.body:
+            walk(stmt, set())
+        return findings
+
+
+register_pass(LockPass)

@@ -1,0 +1,179 @@
+# zoo-lint: jax-free
+"""jax-free purity pass.
+
+Modules declared ``# zoo-lint: jax-free`` (the machine-readable form
+of the old "importable jax-free" docstring prose) must have no ``jax``
+or ``jaxlib`` anywhere in their *static import closure* — the chaos
+smokes import them in milliseconds, replica bootstrap relies on them,
+and a jax import dragged in transitively turns a 20 ms import into a
+multi-second one (and breaks the check_guard "jax never imported"
+assertion).
+
+Closure semantics: module-level imports only (an import inside a
+function body is lazy by construction and allowed — that is exactly
+how these modules reach jax on their device paths); imports under
+``if TYPE_CHECKING:`` never execute; importing ``zoo_tpu.a.b`` also
+executes ``zoo_tpu/__init__.py`` and ``zoo_tpu/a/__init__.py``, so
+package ``__init__`` chains are part of the closure. Non-``zoo_tpu``
+imports other than jax/jaxlib are out of scope.
+
+Rule: ``PURITY-JAX`` — reported at the declared module with the
+offending import chain in the message.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from zoo_tpu.analysis.framework import (
+    Context,
+    Finding,
+    Pass,
+    module_markers,
+    register_pass,
+)
+
+__all__ = ["PurityPass", "module_imports", "jax_free_modules",
+           "import_closure"]
+
+_JAX_ROOTS = ("jax", "jaxlib")
+
+
+def _is_type_checking_guard(node: ast.If) -> bool:
+    t = node.test
+    return (isinstance(t, ast.Name) and t.id == "TYPE_CHECKING") or \
+        (isinstance(t, ast.Attribute) and t.attr == "TYPE_CHECKING")
+
+
+def module_imports(tree: ast.Module, pkg: str
+                   ) -> List[Tuple[str, int]]:
+    """``(dotted module, line)`` for every module-level import,
+    descending into module-level ``if``/``try`` bodies (they execute
+    at import time) but not into functions/classes. Relative imports
+    are resolved against ``pkg`` (the importing module's package)."""
+    out: List[Tuple[str, int]] = []
+
+    def walk(body: Sequence[ast.stmt]):
+        for node in body:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    out.append((a.name, node.lineno))
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    parts = pkg.split(".") if pkg else []
+                    if node.level > 1:
+                        parts = parts[: -(node.level - 1)] \
+                            if node.level - 1 <= len(parts) else []
+                    base = ".".join(parts)
+                    mod = f"{base}.{node.module}" if node.module \
+                        else base
+                else:
+                    mod = node.module or ""
+                if mod:
+                    out.append((mod, node.lineno))
+                    # `from pkg import sub` may bind a submodule
+                    for a in node.names:
+                        out.append((f"{mod}.{a.name}", node.lineno))
+            elif isinstance(node, ast.If):
+                if not _is_type_checking_guard(node):
+                    walk(node.body)
+                    walk(node.orelse)
+            elif isinstance(node, ast.Try):
+                walk(node.body)
+                for h in node.handlers:
+                    walk(h.body)
+                walk(node.orelse)
+                walk(node.finalbody)
+            elif isinstance(node, (ast.With,)):
+                walk(node.body)
+    walk(tree.body)
+    return out
+
+
+def _pkg_of(ctx: Context, rel: str) -> str:
+    dotted = ctx.module_name(rel)
+    if rel.endswith("__init__.py"):
+        return dotted  # the package itself
+    return dotted.rsplit(".", 1)[0] if "." in dotted else ""
+
+
+def _init_chain(dotted: str) -> List[str]:
+    """Packages whose ``__init__`` executes when ``dotted`` is
+    imported."""
+    parts = dotted.split(".")
+    return [".".join(parts[:i]) for i in range(1, len(parts))]
+
+
+def jax_free_modules(ctx: Context) -> Dict[str, int]:
+    """``{repo-relative path: marker line}`` of declared modules."""
+    out: Dict[str, int] = {}
+    for rel in ctx.py_files():
+        markers = module_markers(ctx.source_of(rel))
+        if "jax-free" in markers:
+            out[rel] = markers["jax-free"]
+    return out
+
+
+def import_closure(ctx: Context, rel: str
+                   ) -> Tuple[Set[str], Dict[str, Tuple[str, int, str]]]:
+    """BFS the static import closure of ``rel`` inside ``zoo_tpu``.
+
+    Returns ``(visited module paths, first_jax)`` where ``first_jax``
+    maps a visited path to ``(importer chain string, line, imported
+    name)`` for every jax/jaxlib import found at module level."""
+    start = ctx.module_name(rel)
+    seen: Set[str] = set()
+    offenders: Dict[str, Tuple[str, int, str]] = {}
+    queue: List[Tuple[str, str]] = [(start, start)]
+    while queue:
+        dotted, chain = queue.pop(0)
+        for pkg_init in _init_chain(dotted):
+            path = ctx.module_path(pkg_init)
+            if path and pkg_init not in seen:
+                queue.append((pkg_init, f"{chain} -> {pkg_init}"))
+        if dotted in seen:
+            continue
+        seen.add(dotted)
+        path = ctx.module_path(dotted)
+        if path is None:
+            continue
+        tree = ctx.ast_of(path)
+        if tree is None:
+            continue
+        pkg = _pkg_of(ctx, path)
+        for mod, line in module_imports(tree, pkg):
+            root = mod.split(".")[0]
+            if root in _JAX_ROOTS:
+                offenders.setdefault(path, (chain, line, mod))
+            elif root == "zoo_tpu" and ctx.module_path(mod):
+                if mod not in seen:
+                    queue.append((mod, f"{chain} -> {mod}"))
+    return seen, offenders
+
+
+class PurityPass(Pass):
+    name = "purity"
+    rules = ("PURITY-JAX",)
+    doc = "declared jax-free modules have no jax in their static " \
+          "import closure"
+
+    def run(self, ctx: Context) -> List[Finding]:
+        findings: List[Finding] = []
+        for rel, marker_line in sorted(jax_free_modules(ctx).items()):
+            _seen, offenders = import_closure(ctx, rel)
+            for off_path, (chain, line, mod) in sorted(
+                    offenders.items()):
+                findings.append(Finding(
+                    "PURITY-JAX", rel, marker_line,
+                    f"declared jax-free, but its import closure "
+                    f"reaches `import {mod}` at {off_path}:{line} "
+                    f"(chain: {chain})",
+                    "make the offending import lazy (move it into "
+                    "the function that needs it) or drop the "
+                    "jax-free declaration",
+                    detail=off_path))
+        return findings
+
+
+register_pass(PurityPass)

@@ -1,0 +1,228 @@
+# zoo-lint: jax-free
+"""Telemetry-contract pass.
+
+Every ``zoo_*`` metric family created against the obs registry and
+every flight-ring event kind must be declared in
+:mod:`zoo_tpu.obs.catalog` with its label names. What this catches,
+statically:
+
+* a name typo splitting a time series (the scrape asserts a sample of
+  families, so a typo'd family just silently never joins);
+* a creation site whose label set disagrees with the declaration —
+  either a silent aggregation break or a label-cardinality bomb
+  (labels the aggregator treats as unbounded);
+* catalog entries nothing creates any more (docs drift — the
+  observability docs tables are written from the catalog's
+  vocabulary).
+
+Rules: ``TEL-UNDECLARED``, ``TEL-LABELS``, ``TEL-DEAD``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from zoo_tpu.analysis.framework import (
+    Context,
+    Finding,
+    Pass,
+    register_pass,
+)
+from zoo_tpu.obs import catalog
+
+__all__ = ["TelemetryPass", "metric_creations", "event_emissions"]
+
+_CTORS = {"counter": "counter", "gauge": "gauge",
+          "histogram": "histogram"}
+
+#: the FlightRecorder lives here; its ``.record`` method calls are
+#: event emissions (elsewhere ``.record`` is the StatTimer API)
+_FLIGHT_MODULE = "zoo_tpu/obs/flight.py"
+
+
+def _fname(fn: ast.AST) -> Optional[str]:
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def metric_creations(ctx: Context
+                     ) -> List[Tuple[str, int, str, str,
+                                     Optional[Tuple[str, ...]]]]:
+    """``(file, line, name, kind, labels)`` for every static metric
+    creation; ``labels`` is None when not statically a literal
+    tuple/list. Aliased imports (``counter as _obs_counter``) are
+    resolved by suffix: any callable whose (possibly aliased) name
+    ends with the ctor name counts when the first arg is a literal
+    ``zoo_*`` string."""
+    out = []
+    for rel in ctx.py_files():
+        tree = ctx.ast_of(rel)
+        if tree is None:
+            continue
+        # alias map from `from zoo_tpu.obs.metrics import counter as X`
+        aliases: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.module and \
+                    node.module.endswith("obs.metrics"):
+                for a in node.names:
+                    if a.name in _CTORS:
+                        aliases[a.asname or a.name] = a.name
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _fname(node.func)
+            kind = _CTORS.get(name) or _CTORS.get(aliases.get(name))
+            if kind is None:
+                continue
+            if not node.args:
+                continue
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)
+                    and arg.value.startswith("zoo_")):
+                continue
+            labels: Optional[Tuple[str, ...]] = ()
+            for kw in node.keywords:
+                if kw.arg == "labels":
+                    if isinstance(kw.value, (ast.Tuple, ast.List)):
+                        vals = [e.value for e in kw.value.elts
+                                if isinstance(e, ast.Constant)]
+                        labels = tuple(vals) if len(vals) == len(
+                            kw.value.elts) else None
+                    else:
+                        labels = None
+            out.append((rel, node.lineno, arg.value, kind, labels))
+    return out
+
+
+def event_emissions(ctx: Context) -> List[Tuple[str, int, str]]:
+    """``(file, line, kind)`` for every static flight-ring event
+    emission: ``record_event("...")`` anywhere, ``.record("...")``
+    inside the flight module itself."""
+    out = []
+    for rel in ctx.py_files():
+        tree = ctx.ast_of(rel)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            name = _fname(node.func)
+            is_emit = name == "record_event" or (
+                name == "record" and rel == _FLIGHT_MODULE
+                and isinstance(node.func, ast.Attribute))
+            if not is_emit:
+                continue
+            for kind in _const_branches(node.args[0]):
+                out.append((rel, node.lineno, kind))
+    return out
+
+
+def _const_branches(arg: ast.AST) -> List[str]:
+    """String constants an event-kind expression can evaluate to
+    (plain literal, or both arms of a conditional like
+    ``"slo_breach" if breached else "slo_clear"``)."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return [arg.value]
+    if isinstance(arg, ast.IfExp):
+        return _const_branches(arg.body) + _const_branches(arg.orelse)
+    return []
+
+
+class TelemetryPass(Pass):
+    name = "telemetry"
+    rules = ("TEL-UNDECLARED", "TEL-LABELS", "TEL-DEAD")
+    doc = "zoo_* metric families and flight event kinds match the " \
+          "obs catalog"
+
+    def run(self, ctx: Context) -> List[Finding]:
+        findings: List[Finding] = []
+        # fixture tests override the catalogs on the ctx
+        metrics_cat = getattr(ctx, "metrics_catalog", None)
+        if metrics_cat is None:
+            metrics_cat = catalog.METRICS
+        events_cat = getattr(ctx, "event_catalog", None)
+        if events_cat is None:
+            events_cat = catalog.EVENT_KINDS
+        cat_rel = "zoo_tpu/obs/catalog.py"
+        cat_src = ctx.source_of(cat_rel) if ctx.exists(cat_rel) else ""
+
+        def cat_line(token: str) -> int:
+            for i, l in enumerate(cat_src.splitlines(), 1):
+                if f'"{token}"' in l:
+                    return i
+            return 1
+
+        created: Set[str] = set()
+        for rel, line, name, kind, labels in metric_creations(ctx):
+            if rel == cat_rel:
+                continue
+            created.add(name)
+            decl = metrics_cat.get(name)
+            if decl is None:
+                findings.append(Finding(
+                    "TEL-UNDECLARED", rel, line,
+                    f"metric family {name} ({kind}) is not declared "
+                    "in the telemetry catalog",
+                    "declare it in zoo_tpu/obs/catalog.py with its "
+                    "kind and label names (typo? compare existing "
+                    "families)",
+                    detail=name))
+                continue
+            want_kind, want_labels = decl
+            if kind != want_kind:
+                findings.append(Finding(
+                    "TEL-LABELS", rel, line,
+                    f"{name} created as {kind} but declared "
+                    f"{want_kind}",
+                    "align the creation site with the catalog (or "
+                    "fix the catalog)",
+                    detail=name))
+            elif labels is not None and tuple(labels) != \
+                    tuple(want_labels):
+                findings.append(Finding(
+                    "TEL-LABELS", rel, line,
+                    f"{name} created with labels {tuple(labels)} but "
+                    f"declared {tuple(want_labels)}",
+                    "align the creation site with the catalog (or "
+                    "fix the catalog)",
+                    detail=name))
+
+        emitted: Set[str] = set()
+        for rel, line, kind in event_emissions(ctx):
+            if rel == cat_rel:
+                continue
+            emitted.add(kind)
+            if kind not in events_cat:
+                findings.append(Finding(
+                    "TEL-UNDECLARED", rel, line,
+                    f"flight-ring event kind {kind!r} is not "
+                    "declared in the telemetry catalog",
+                    "add it to EVENT_KINDS in zoo_tpu/obs/catalog.py",
+                    detail=f"event:{kind}"))
+
+        for name in metrics_cat:
+            if name not in created:
+                findings.append(Finding(
+                    "TEL-DEAD", cat_rel, cat_line(name),
+                    f"catalog declares {name} but no code creates it",
+                    "delete the stale declaration or restore the "
+                    "instrument",
+                    detail=name))
+        for kind in sorted(events_cat):
+            if kind not in emitted:
+                findings.append(Finding(
+                    "TEL-DEAD", cat_rel, cat_line(kind),
+                    f"catalog declares event kind {kind!r} but no "
+                    "code emits it",
+                    "delete the stale declaration or restore the "
+                    "emission",
+                    detail=f"event:{kind}"))
+        return findings
+
+
+register_pass(TelemetryPass)

@@ -195,7 +195,7 @@ def get_runtime_context(required: bool = True) -> Optional[RuntimeContext]:
     return _runtime_context
 
 
-def default_cores() -> int:
+def default_cores() -> int:  # zoo-lint: config-parse
     env = os.environ.get("ZOO_NUM_CORES")
     if env:
         return int(env)

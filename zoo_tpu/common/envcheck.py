@@ -19,7 +19,7 @@ import sys
 from typing import List, Tuple
 
 
-def collect() -> List[Tuple[str, bool, str]]:
+def collect() -> List[Tuple[str, bool, str]]:  # zoo-lint: config-parse
     """(name, ok, detail) triples; ok=False on required-item failure."""
     out: List[Tuple[str, bool, str]] = []
     out.append(("python", True, sys.version.split()[0]))

@@ -72,7 +72,7 @@ def llama_param_count(cfg: LlamaConfig) -> int:
     return total
 
 
-def resolve_attention_impl(impl: str, seq_len: int) -> str:
+def resolve_attention_impl(impl: str, seq_len: int) -> str:  # zoo-lint: config-parse
     """Concrete kernel for an ``attention_impl`` request at ``seq_len``.
 
     ``"auto"`` picks the Pallas flash kernel from

@@ -87,7 +87,7 @@ def _compile(src: str, out: str) -> bool:
         return False
 
 
-def load() -> Optional[ctypes.CDLL]:
+def load() -> Optional[ctypes.CDLL]:  # zoo-lint: config-parse
     """Return the native library, building it if needed; None on failure."""
     global _lib, _lib_tried
     if _lib is not None or _lib_tried:

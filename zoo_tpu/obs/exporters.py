@@ -34,6 +34,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
+from zoo_tpu.common.knobs import value as _knob_value
 from zoo_tpu.obs.metrics import MetricsRegistry, get_registry
 
 __all__ = [
@@ -44,7 +45,7 @@ __all__ = [
 logger = logging.getLogger(__name__)
 
 
-def _heartbeat_health(stale_after: Optional[float]) -> Dict:
+def _heartbeat_health(stale_after: Optional[float]) -> Dict:  # zoo-lint: config-parse
     """Liveness verdict from the resilience heartbeat file, when one is
     configured; a process with no heartbeat file is healthy by virtue of
     answering at all. Imported lazily — resilience imports our metrics
@@ -104,8 +105,8 @@ class MetricsExporter:
                         if slo is not None:
                             health["slo"] = slo
                             if not slo.get("ok", True) and \
-                                    os.environ.get(
-                                        "ZOO_SLO_FAIL_HEALTHZ") == "1":
+                                    _knob_value(
+                                        "ZOO_SLO_FAIL_HEALTHZ"):
                                 health["ok"] = False
                     except Exception:  # noqa: BLE001 — probe, not crash
                         pass

@@ -1,3 +1,4 @@
+# zoo-lint: jax-free
 """Crash flight recorder: a bounded ring of recent structured events
 plus a postmortem bundle dump.
 
@@ -74,7 +75,7 @@ def _config_snapshot() -> Dict[str, str]:
 class FlightRecorder:
     """One process's ring buffer + spill + bundle writer."""
 
-    def __init__(self, capacity: Optional[int] = None,
+    def __init__(self, capacity: Optional[int] = None,  # zoo-lint: config-parse
                  spill_dir: Optional[str] = None):
         if capacity is None:
             try:
@@ -175,7 +176,7 @@ class FlightRecorder:
                 "active_spans": active_spans(),
                 "slo": slo}
 
-    def dump(self, reason: str,
+    def dump(self, reason: str,  # zoo-lint: config-parse
              dir_path: Optional[str] = None) -> Optional[str]:
         """Write the bundle atomically (tmp + rename) into ``dir_path``
         (default: the spill dir / ``$ZOO_OBS_POSTMORTEM_DIR``). Returns

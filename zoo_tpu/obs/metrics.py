@@ -1,3 +1,4 @@
+# zoo-lint: jax-free
 """Process-global metrics registry: Counter / Gauge / Histogram.
 
 The reference platform scattered its instruments — a per-stage ``Timer``

@@ -124,7 +124,7 @@ def stop_tracing():
         _env_checked = True  # an explicit stop beats the env default
 
 
-def _active_sink() -> "Optional[_TraceLog]":
+def _active_sink() -> "Optional[_TraceLog]":  # zoo-lint: config-parse
     global _sink, _env_checked
     if _sink is not None:
         return _sink

@@ -28,7 +28,7 @@ LANES = 128       # VPU lane width; minor dim of every scratch carrier
 SUBLANES = 8      # f32 sublane count
 
 
-def on_tpu() -> bool:
+def on_tpu() -> bool:  # zoo-lint: config-parse
     """True when the default device is TPU hardware.
 
     Checks the device_kind, not just the backend name: experimental TPU

@@ -115,7 +115,7 @@ def _kernel(bt_ref, pos_ref, q_ref, k_ref, v_ref, *rest,
                                           l_ref.shape[3:])
 
 
-def resolve_num_splits(table_width: int,
+def resolve_num_splits(table_width: int,  # zoo-lint: config-parse
                        requested: Optional[int] = None) -> int:
     """Largest divisor of ``table_width`` not exceeding the request
     (``ZOO_LLM_DECODE_SPLITS``, default 4): splits must tile the table

@@ -85,7 +85,7 @@ def _dist_already_initialized() -> bool:
         return False
 
 
-def _maybe_init_distributed(cluster_mode: str, num_nodes: int = 1):
+def _maybe_init_distributed(cluster_mode: str, num_nodes: int = 1):  # zoo-lint: config-parse
     """Initialize jax.distributed for multi-host pods. If the launcher (or
     user code) initialized it already, that wins. A failed initialize is
     only tolerable on a single-host dev box — when the caller declared

@@ -380,7 +380,7 @@ class StagingBufferPool:
         self.rows = rows
 
     @staticmethod
-    def maybe_create(arrs, rows: int, nbufs: int = 4,
+    def maybe_create(arrs, rows: int, nbufs: int = 4,  # zoo-lint: config-parse
                      max_bytes: int = 2 << 30) -> Optional[
                          "StagingBufferPool"]:
         mode = os.environ.get("ZOO_FEED_STAGING", "auto").lower()

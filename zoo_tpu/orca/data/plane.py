@@ -174,7 +174,7 @@ class ExchangeConfig:
 
     LANES = ("auto", "tcp", "shm")
 
-    def __init__(self, multiget: Optional[int] = None,
+    def __init__(self, multiget: Optional[int] = None,  # zoo-lint: config-parse
                  concurrency: Optional[int] = None,
                  lane: Optional[str] = None,
                  wire_dtype: Optional[str] = None,
@@ -465,7 +465,7 @@ class _ConnPool:
         self._negotiated: Dict[tuple, tuple] = {}
 
     @property
-    def max_idle(self) -> int:
+    def max_idle(self) -> int:  # zoo-lint: config-parse
         if self._max_idle is not None:
             return self._max_idle
         return max(1, int(os.environ.get("ZOO_SHARD_POOL_SIZE", "4")))

@@ -69,7 +69,7 @@ _seq_lock = threading.Lock()
 _seq = 0
 
 
-def shm_dir() -> str:
+def shm_dir() -> str:  # zoo-lint: config-parse
     """Directory backing the lane: ``ZOO_SHARD_SHM_DIR`` > ``/dev/shm``
     (tmpfs — the real shared-memory path) > the tempdir (still mmap'd
     and kernel-socket-free, just disk-backed if dirty pages flush)."""

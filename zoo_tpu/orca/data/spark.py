@@ -110,7 +110,7 @@ def _partition_writer(columns: Sequence[str], staging_dir: str, run: str):
     return _PartitionWriter(columns, staging_dir, run)
 
 
-def spark_dataframe_to_shards(df, feature_cols: Sequence[str],
+def spark_dataframe_to_shards(df, feature_cols: Sequence[str],  # zoo-lint: config-parse
                               label_cols: Optional[Sequence[str]] = None,
                               staging_dir: Optional[str] = None,
                               process_index: Optional[int] = None,

@@ -1,3 +1,4 @@
+# zoo-lint: jax-free
 """Training guardian: step-level numeric health, rollback, preemption.
 
 The reference's only in-job recovery is retry-the-whole-job from the
@@ -120,7 +121,7 @@ class GuardConfig:
     """Escalation-ladder knobs; every field defaults from ``ZOO_GUARD_*``
     env so supervised workers configure through their launcher."""
 
-    def __init__(self, enabled: Optional[bool] = None,
+    def __init__(self, enabled: Optional[bool] = None,  # zoo-lint: config-parse
                  max_skips: Optional[int] = None,
                  spike_factor: Optional[float] = None,
                  window: Optional[int] = None,
@@ -209,7 +210,7 @@ class TrainingGuard:
 
     _seq = 0  # per-process fit counter; advances in SPMD lockstep
 
-    def __init__(self, config: Optional[GuardConfig] = None,
+    def __init__(self, config: Optional[GuardConfig] = None,  # zoo-lint: config-parse
                  save_fn: Optional[Callable[[], None]] = None,
                  restore_fn: Optional[Callable[[], Tuple[Any, Any]]] = None,
                  quarantine_path: Optional[str] = None,

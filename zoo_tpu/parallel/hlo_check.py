@@ -1,198 +1,32 @@
-"""Compiled-HLO sharding-quality checks.
+"""Compiled-HLO sharding-quality checks — moved.
 
-A sharding regression that silently replicates everything still *runs*
-and produces finite loss — the only place the difference is visible
-before you pay for 8 chips is the compiled HLO's collective mix. These
-helpers inspect the optimized module text of a compiled step and assert
-the collectives the intended parallelism plan implies:
-
-- pure DP: gradients all-reduce; **no** all-gather (a full-parameter
-  all-gather under DP means params were accidentally sharded or the
-  batch sharding leaked into the params);
-- FSDP/ZeRO: all-gather (weights into the consuming op) **and** a grad
-  reduction (reduce-scatter, or all-reduce on backends whose SPMD
-  partitioner didn't pattern-match the scatter form);
-- ring/sequence parallel: collective-permute (the ring hop).
-
-Reference semantics being checked: the slice-wise parameter-server
-update of ``Topology.scala:1204`` (reduce-scatter + apply + all-gather)
-is what XLA's SPMD partitioner emits for a ZeRO-sharded step.
+PR 8 shipped this module as the fsdp-only lint; the checks now live in
+:mod:`zoo_tpu.analysis.hlo`, generalized to plan-aware sharding
+(megatron/tp entry layouts), donation, and host-transfer contracts.
+This path keeps the original import surface working.
 """
 
-from __future__ import annotations
-
-import re
-from typing import Dict, Iterable, Optional
+from zoo_tpu.analysis.hlo import (  # noqa: F401
+    CollectiveError,
+    HloContractError,
+    assert_collectives,
+    assert_donated,
+    assert_fsdp_sharded,
+    assert_host_transfer,
+    assert_plan_sharded,
+    collective_counts,
+    donation_findings,
+    entry_layout,
+    entry_output_shapes,
+    host_transfer_findings,
+    input_output_aliases,
+    shaped_ops,
+    sharding_findings,
+)
 
 __all__ = ["collective_counts", "assert_collectives", "CollectiveError",
-           "entry_output_shapes", "shaped_ops", "assert_fsdp_sharded"]
-
-# async pairs (all-reduce-start/-done) and channel-suffixed forms all
-# reduce to the base op name; "-start" lines carry the operands so count
-# only those plus the plain sync form
-_COLLECTIVE_RE = re.compile(
-    r"\b(all-reduce|all-gather|reduce-scatter|collective-permute|"
-    r"all-to-all)(-start)?\b")
-
-
-class CollectiveError(AssertionError):
-    """A compiled step's collective mix contradicts the intended plan."""
-
-
-def collective_counts(hlo_text: str) -> Dict[str, int]:
-    """Count collective instructions in optimized HLO module text.
-
-    Counts instruction definitions (lines containing ``= <op>`` or the
-    fused/async start forms), merging async ``-start`` with sync forms.
-    """
-    counts: Dict[str, int] = {}
-    for line in hlo_text.splitlines():
-        # instruction lines look like  "%name = type op(...)"; skip
-        # metadata/backend-config mentions by requiring the op token to
-        # follow an "= " or " = " assignment on the line
-        if "=" not in line:
-            continue
-        rhs = line.split("=", 1)[1]
-        m = _COLLECTIVE_RE.search(rhs)
-        if not m:
-            continue
-        if m.group(2) is None and "-done" in rhs[:m.start() + 24]:
-            continue  # the -done half of an async pair
-        counts[m.group(1)] = counts.get(m.group(1), 0) + 1
-    return counts
-
-
-def _text_of(compiled) -> str:
-    if isinstance(compiled, str):
-        return compiled
-    return compiled.as_text()
-
-
-def assert_collectives(compiled, *, require: Iterable[str] = (),
-                       require_any: Optional[Iterable[str]] = None,
-                       forbid: Iterable[str] = (),
-                       label: str = "step") -> Dict[str, int]:
-    """Assert the collective mix of a compiled executable (or HLO text).
-
-    ``require``: ops that must each appear at least once.
-    ``require_any``: at least one op of this set must appear.
-    ``forbid``: ops that must not appear at all.
-    Returns the counts for further custom assertions.
-    """
-    counts = collective_counts(_text_of(compiled))
-    missing = [op for op in require if counts.get(op, 0) == 0]
-    if missing:
-        raise CollectiveError(
-            f"{label}: expected collective(s) {missing} absent from the "
-            f"compiled HLO (found {counts or 'none'}) — the sharding "
-            "spec did not produce the intended parallelism")
-    if require_any is not None:
-        opts = list(require_any)
-        if not any(counts.get(op, 0) for op in opts):
-            raise CollectiveError(
-                f"{label}: none of {opts} present in the compiled HLO "
-                f"(found {counts or 'none'}) — the sharding spec did "
-                "not produce the intended parallelism")
-    bad = {op: counts[op] for op in forbid if counts.get(op, 0)}
-    if bad:
-        raise CollectiveError(
-            f"{label}: forbidden collective(s) {bad} present in the "
-            "compiled HLO — under this plan they indicate accidental "
-            "resharding (e.g. a full-parameter all-gather in pure DP)")
-    return counts
-
-
-# -- FSDP output lint -------------------------------------------------------
-# After SPMD partitioning every shape in the module text is the PER-DEVICE
-# local shape. A ZeRO-sharded parameter therefore never appears at its
-# full global shape in the entry computation's *outputs*: transient
-# full-shape all-gathers feeding a matmul are the plan working as
-# intended, but a full-shape entry OUTPUT means the updated parameter (or
-# its optimizer moment) was gathered into a replicated tensor and carried
-# that way — "FSDP that isn't": it runs, the loss is finite, and every
-# device holds (and re-gathers) the whole model.
-
-_SHAPE_RE = re.compile(r"\b(?:[a-z]+\d*)\[([0-9,]*)\]")
-_INSTR_RE = re.compile(
-    r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*(.*)$")
-
-
-def _parse_dims(text: str):
-    """Every tensor shape in ``text`` as a tuple of ints (scalars = ())."""
-    out = []
-    for m in _SHAPE_RE.finditer(text):
-        dims = m.group(1)
-        out.append(tuple(int(d) for d in dims.split(",")) if dims else ())
-    return out
-
-
-def entry_output_shapes(hlo_text: str):
-    """Per-device output shapes of the module's entry computation, from
-    the ``ENTRY ... -> (...)`` signature."""
-    for line in hlo_text.splitlines():
-        ls = line.strip()
-        if ls.startswith("ENTRY") and "->" in ls:
-            return _parse_dims(ls.split("->", 1)[1])
-    return []
-
-
-def shaped_ops(hlo_text: str, op: str):
-    """``(instruction_name, output_shape)`` for every instruction whose
-    opcode matches ``op`` (async ``-start`` forms included)."""
-    out = []
-    for line in hlo_text.splitlines():
-        m = _INSTR_RE.match(line)
-        if not m:
-            continue
-        rhs = m.group(2)
-        om = re.search(rf"\b{re.escape(op)}(-start)?\(", rhs)
-        if not om:
-            continue
-        shapes = _parse_dims(rhs[:om.start()])
-        out.append((m.group(1), shapes[-1] if shapes else ()))
-    return out
-
-
-def assert_fsdp_sharded(compiled, sharded_shapes,
-                        replicated_shapes=(), *, local_shapes=(),
-                        label: str = "fsdp step") -> None:
-    """Assert the compiled FSDP step keeps its sharded parameters
-    sharded end to end.
-
-    ``sharded_shapes``: global shapes of params/moments the plan shards.
-    ``replicated_shapes``: global shapes the plan deliberately
-    replicates. ``local_shapes``: the per-device shard shapes the
-    partitioned module legitimately carries. A sharded global shape
-    that collides with either set is skipped — the text lint cannot
-    tell two same-shaped tensors apart (e.g. a global ``(8,)`` bias vs
-    the per-device half of a ``(16,)`` one).
-    ``zoo_tpu.parallel.plans.fsdp_lint_shapes`` builds all three lists
-    from a params pytree.
-
-    Fails with :class:`CollectiveError` naming (a) the entry outputs
-    that came back at full global shape and (b) the all-gather
-    instructions that produce tensors of those shapes — together, the
-    classic silent "FSDP that isn't" signature.
-    """
-    text = _text_of(compiled)
-    skip = {tuple(s) for s in replicated_shapes} | \
-        {tuple(s) for s in local_shapes}
-    watch = {tuple(s) for s in sharded_shapes
-             if tuple(s) and tuple(s) not in skip}
-    if not watch:
-        return
-    outs = entry_output_shapes(text)
-    bad_outs = [(i, s) for i, s in enumerate(outs) if s in watch]
-    if not bad_outs:
-        return
-    gathers = [(name, s) for name, s in shaped_ops(text, "all-gather")
-               if s in {s for _, s in bad_outs}]
-    raise CollectiveError(
-        f"{label}: {len(bad_outs)} entry output(s) carry FULL-shape "
-        f"supposedly-FSDP-sharded tensors {sorted({s for _, s in bad_outs})} "
-        f"(output indices {[i for i, _ in bad_outs]}); full-parameter "
-        f"all-gather op(s): "
-        f"{[n for n, _ in gathers] or '(produced without all-gather)'} "
-        "— the step gathered ZeRO shards into replicated tensors "
-        "(\"FSDP that isn't\"): per-device memory is back to the full "
-        "model and every step re-gathers it")
+           "entry_output_shapes", "shaped_ops", "assert_fsdp_sharded",
+           "HloContractError", "assert_donated", "assert_host_transfer",
+           "assert_plan_sharded", "donation_findings", "entry_layout",
+           "host_transfer_findings", "input_output_aliases",
+           "sharding_findings"]

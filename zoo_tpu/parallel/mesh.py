@@ -34,7 +34,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 DEFAULT_AXES = ("data", "fsdp", "model", "seq", "expert", "pipe")
 
 
-def mesh_axes_from_env() -> Optional[Dict[str, int]]:
+def mesh_axes_from_env() -> Optional[Dict[str, int]]:  # zoo-lint: config-parse
     """Mesh layout from the ``ZOO_MESH_<AXIS>`` env knobs (e.g.
     ``ZOO_MESH_FSDP=8``, ``ZOO_MESH_DATA=-1``) — the deployment-wide
     default ``init_orca_context`` applies when the caller passes no

@@ -208,12 +208,15 @@ def ensure_placed(tree, mesh: Mesh):
     return jax.tree_util.tree_map(fix, tree)
 
 
-def fsdp_lint_shapes(params, mesh: Mesh, plan: str = "auto"):
+def plan_lint_shapes(params, mesh: Mesh, plan: str = "auto"):
     """``(sharded, replicated, local)`` global/per-device shape lists
-    for :func:`zoo_tpu.parallel.hlo_check.assert_fsdp_sharded`:
+    for the compiled-HLO sharding lint
+    (:func:`zoo_tpu.analysis.hlo.assert_plan_sharded`):
     ``sharded``/``replicated`` are the plan's global shapes, ``local``
     the per-device shard shapes the partitioned module legitimately
-    carries (the lint skips collisions against both)."""
+    carries (the lint skips collisions against both). Plan-agnostic —
+    any leaf the plan shards on ANY mesh axis (fsdp ZeRO shards and
+    megatron column/row shards alike) lands in ``sharded``."""
     sharded, replicated, local = [], [], []
     for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
         shape = tuple(np.shape(leaf))
@@ -224,6 +227,10 @@ def fsdp_lint_shapes(params, mesh: Mesh, plan: str = "auto"):
         else:
             replicated.append(shape)
     return sharded, replicated, local
+
+
+#: back-compat name (PR 8 shipped the fsdp-only lint)
+fsdp_lint_shapes = plan_lint_shapes
 
 
 def estimate_collective_bytes(params, mesh: Mesh,

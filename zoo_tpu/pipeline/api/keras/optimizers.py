@@ -79,7 +79,7 @@ class SGD(Optimizer):
 
 
 class Adam(Optimizer):
-    def __init__(self, lr: float = 0.001, beta_1: float = 0.9,
+    def __init__(self, lr: float = 0.001, beta_1: float = 0.9,  # zoo-lint: config-parse
                  beta_2: float = 0.999, epsilon: float = 1e-8,
                  decay: float = 0.0, learningrate_schedule=None):
         tx, plateau = _resolve(optax.adam, lr, decay, learningrate_schedule,
@@ -105,7 +105,7 @@ class AdamWeightDecay(Optimizer):
     either way the fallback is clean (``bench_fused_optim`` measures the
     A/B)."""
 
-    def __init__(self, lr: float = 0.001, beta_1: float = 0.9,
+    def __init__(self, lr: float = 0.001, beta_1: float = 0.9,  # zoo-lint: config-parse
                  beta_2: float = 0.999, epsilon: float = 1e-6,
                  weight_decay: float = 0.01, total_steps: int = 0,
                  warmup_ratio: float = 0.1, learningrate_schedule=None,

@@ -46,7 +46,7 @@ class InferenceModel:
                               batch_size=batch_size)  # warm compile
         return self
 
-    def load(self, path: str, batch_size: Optional[int] = None,
+    def load(self, path: str, batch_size: Optional[int] = None,  # zoo-lint: config-parse
              quantize: bool = False):
         """Load a full serialized zoo model (reference: ``doLoadBigDL``;
         ``quantize=True`` is the int8 path, reference
@@ -75,7 +75,7 @@ class InferenceModel:
         return self.load_keras(load_onnx(path_or_bytes),
                                batch_size=batch_size)
 
-    def load_encrypted(self, path: str, secret: str, salt: str,
+    def load_encrypted(self, path: str, secret: str, salt: str,  # zoo-lint: config-parse
                        key_len: int = 128, mode: str = "cbc",
                        batch_size: Optional[int] = None,
                        quantize: bool = False):
@@ -231,7 +231,7 @@ def _apply_int8(model):
     model._quantized = True  # inference-only: fit() refuses cleanly
 
 
-def quantize_model(model, mode: Optional[str] = None,
+def quantize_model(model, mode: Optional[str] = None,  # zoo-lint: config-parse
                    min_speedup: float = INT8_MIN_SPEEDUP,
                    sample_batch: int = 8):
     """Post-training int8 quantization of every Dense and Conv2D weight

@@ -1,3 +1,4 @@
+# zoo-lint: jax-free
 """Gray-failure ejection: latency/error outlier scoring for replicas.
 
 A replica can be *alive* — passing ``/healthz``, accepting connections,
@@ -88,7 +89,7 @@ class EjectionConfig:
     """Every ejection knob, parsed once (constructor args win over
     ``ZOO_EJECT_*`` env)."""
 
-    def __init__(self, enabled: Optional[bool] = None,
+    def __init__(self, enabled: Optional[bool] = None,  # zoo-lint: config-parse
                  factor: Optional[float] = None,
                  min_ms: Optional[float] = None,
                  min_samples: Optional[int] = None,

@@ -226,7 +226,7 @@ class HAServingClient:
     default 1 s — a dead replica is re-probed quickly because its
     supervisor is respawning it on the same port)."""
 
-    def __init__(self, endpoints: Sequence[Tuple[str, int]],
+    def __init__(self, endpoints: Sequence[Tuple[str, int]],  # zoo-lint: config-parse
                  deadline_ms: Optional[float] = None,
                  hedge: Optional[bool] = None,
                  hedge_delay_ms: Optional[float] = None,

@@ -94,6 +94,7 @@ from zoo_tpu.serving.llm.kv_cache import (
     prefix_block_hashes,
 )
 from zoo_tpu.serving.llm.speculative import PromptLookup, accept_length
+from zoo_tpu.common.knobs import value as knob_value
 from zoo_tpu.util.resilience import Deadline, env_int
 
 _tokens = counter(
@@ -211,7 +212,9 @@ def parse_sampling(spec, rid: str) -> Tuple[float, int, float, int]:
     string ``"temperature=0.8,top_k=40,top_p=0.95,seed=7"``. A missing
     seed derives from the request id (:func:`stream_seed`)."""
     merged: Dict[str, float] = {}
-    env = os.environ.get("ZOO_LLM_SAMPLING", "")
+    # env < spec precedence, default owned by the knob registry
+    # (the engine and the docs promise ONE definition site)
+    env = knob_value("ZOO_LLM_SAMPLING")
     for source in (env, spec):
         if not source:
             continue
@@ -466,14 +469,12 @@ class LLMEngine:
         self._spec_proposed_n = 0
         self._spec_accepted_n = 0
         if overlap is None:
-            overlap = os.environ.get("ZOO_LLM_OVERLAP", "1") not in (
-                "0", "false", "off")
+            overlap = knob_value("ZOO_LLM_OVERLAP")
         self.overlap = bool(overlap) and mode == "continuous" and \
             hasattr(model, "decode_step") and hasattr(model,
                                                      "read_tokens")
         if prefix_cache is None:
-            prefix_cache = os.environ.get(
-                "ZOO_LLM_PREFIX_CACHE", "0") in ("1", "true", "on")
+            prefix_cache = knob_value("ZOO_LLM_PREFIX_CACHE")
         self.prefix_cache = bool(prefix_cache)
         self.max_waiting = max_waiting if max_waiting is not None else \
             env_int("ZOO_LLM_MAX_WAITING", 256)
@@ -488,7 +489,7 @@ class LLMEngine:
         if self._kv_bpt:
             _kv_bytes_per_token.set(float(self._kv_bpt))
         self._slots = [_Slot() for _ in range(model.num_slots)]
-        self._wait: Deque[GenHandle] = collections.deque()
+        self._wait: Deque[GenHandle] = collections.deque()  # guarded-by: _lock
         # ONE reentrant state lock: the scheduler holds it across each
         # pass, the readback thread holds it while applying a batch —
         # slot/queue state is never observed half-mutated by either
@@ -500,6 +501,7 @@ class LLMEngine:
         # id → handle for every live stream plus an LRU of finished
         # ones: a duplicate id (retry / same-replica hedge) REPLAYS the
         # stream instead of re-decoding it
+        # guarded-by: _lock
         self._by_id: "collections.OrderedDict[str, GenHandle]" = \
             collections.OrderedDict()
         self._finished_cap = env_int("ZOO_LLM_FINISHED_CACHE", 256)
@@ -607,7 +609,7 @@ class LLMEngine:
                           int(spec_k),
                           trace_id=trace_id, parent_span=parent_span)
             self._by_id[rid] = h
-            self._trim_finished()
+            self._trim_finished_locked()
             self._wait.append(h)
             _waiting.set(len(self._wait))
         self._wake.set()
@@ -625,8 +627,8 @@ class LLMEngine:
         self._wake.set()
         return True
 
-    def _trim_finished(self):
-        # under self._lock. Finished handles age out of the dedup map
+    def _trim_finished_locked(self):
+        # caller holds self._lock. Finished handles age out of the dedup map
         # oldest-first; live handles are never evicted.
         while len(self._by_id) > self._finished_cap:
             for k, h in self._by_id.items():

@@ -1,3 +1,4 @@
+# zoo-lint: jax-free
 """Paged KV-cache block allocator (the PagedAttention memory model)
 with content-hash prefix sharing and copy-on-write.
 

@@ -86,6 +86,7 @@ from zoo_tpu.models.llm.llama import (
     resolve_attention_impl,
     rope_frequencies,
 )
+from zoo_tpu.common.knobs import value as knob_value
 from zoo_tpu.obs.metrics import counter
 from zoo_tpu.ops.attention import dot_product_attention
 from zoo_tpu.util.quantize import absmax_scale, narrow_int8
@@ -120,7 +121,7 @@ def resolve_decode_impl(impl: Optional[str] = "auto") -> str:
     A/B runs and for asserting token identity on CPU
     (``dense`` / ``flash``)."""
     if impl in (None, "auto"):
-        impl = os.environ.get("ZOO_LLM_DECODE_IMPL", "") or "auto"
+        impl = knob_value("ZOO_LLM_DECODE_IMPL") or "auto"
     if impl != "auto":
         if impl not in ("dense", "flash"):
             raise ValueError(f"unknown decode impl {impl!r} "
@@ -144,7 +145,7 @@ def resolve_prefill_impl(impl: Optional[str] = "auto") -> str:
     executable; the bucketed whole-prompt prefill keeps the training
     attention stack (:func:`resolve_attention_impl`)."""
     if impl in (None, "auto"):
-        impl = os.environ.get("ZOO_LLM_PREFILL_IMPL", "") or "auto"
+        impl = knob_value("ZOO_LLM_PREFILL_IMPL") or "auto"
     if impl != "auto":
         if impl not in ("dense", "flash"):
             raise ValueError(f"unknown prefill impl {impl!r} "
@@ -167,7 +168,7 @@ def resolve_kv_dtype(dtype: Optional[str] = None) -> str:
     wall and the reference numerics are worth keeping. The selection is
     recorded (model attr, engine stats, bench line), never silent."""
     if dtype in (None, ""):
-        dtype = os.environ.get("ZOO_LLM_KV_DTYPE", "") or "f32"
+        dtype = knob_value("ZOO_LLM_KV_DTYPE") or "f32"
     dtype = {"fp32": "f32", "float32": "f32",
              "bfloat16": "bf16"}.get(dtype, dtype)
     if dtype == "auto":
@@ -297,8 +298,7 @@ class PagedLlamaModel:
         self.prefill_buckets = tuple(sorted(int(b) for b in
                                             prefill_buckets))
         if prefill_chunk is None:
-            prefill_chunk = int(os.environ.get("ZOO_LLM_PREFILL_CHUNK",
-                                               "0") or 0)
+            prefill_chunk = int(knob_value("ZOO_LLM_PREFILL_CHUNK"))
         self.prefill_chunk_size = int(prefill_chunk)
         self.decode_attention_impl = resolve_decode_impl(decode_impl)
         self.prefill_attention_impl = resolve_prefill_impl(prefill_impl)
@@ -307,7 +307,9 @@ class PagedLlamaModel:
         # drafted continuations); 0 = no verify path, the engine runs
         # plain 1-token decode
         if spec_k is None:
-            spec_k = int(os.environ.get("ZOO_LLM_SPEC_K", "0") or 0)
+            # default owned by the knob registry: spec.py, the
+            # engine and this model resolve the SAME definition
+            spec_k = int(knob_value("ZOO_LLM_SPEC_K"))
         self.spec_k = int(spec_k)
         if self.spec_k < 0:
             raise ValueError("spec_k must be >= 0 (0 = off)")
@@ -316,8 +318,7 @@ class PagedLlamaModel:
         # scales (half again). Both the requested and resolved values
         # are recorded so an `auto` pick is visible in stats/bench.
         self.kv_cache_dtype_requested = kv_dtype if kv_dtype not in (
-            None, "") else (os.environ.get("ZOO_LLM_KV_DTYPE", "")
-                            or "f32")
+            None, "") else (knob_value("ZOO_LLM_KV_DTYPE") or "f32")
         self.kv_cache_dtype = resolve_kv_dtype(kv_dtype)
         self.eos_id = eos_id
         if self.num_slots < 1 or self.num_blocks < 2:
@@ -1059,6 +1060,50 @@ class PagedLlamaModel:
         batch = self.decode_step(None, tokens, np.ones(S, bool),
                                  block_tables, positions, sampling_lanes)
         return self.read_tokens(batch)
+
+    def donated_cache_leaves(self) -> int:
+        """Leaves of the donated cache pytree — every one must appear
+        in a compiled executable's ``input_output_alias`` table (the
+        zoo-lint HLO-DONATION contract: a dropped donation doubles
+        resident KV bytes and is invisible at runtime)."""
+        return len(jax.tree_util.tree_leaves(self._cache))
+
+    def compiled_hlo(self, which: str = "decode") -> Optional[str]:
+        """Optimized HLO text of the ``decode`` or ``verify``
+        executable, lowered with this model's exact census signature
+        (and explicit shardings under tp=N) — the input to the
+        zoo-lint donation / host-transfer / sharding checks. Returns
+        None when the executable does not exist (``verify`` with
+        spec_k=0)."""
+        S = self.num_slots
+
+        def sds(shape, dt):
+            return jax.ShapeDtypeStruct(shape, dt)
+
+        def avals(tree):
+            return jax.tree_util.tree_map(
+                lambda x: sds(jnp.shape(x), x.dtype), tree)
+
+        lanes = (sds((S,), jnp.float32), sds((S,), jnp.int32),
+                 sds((S,), jnp.float32), sds((S,), jnp.uint32))
+        tables = sds((S, self.max_blocks_per_seq), jnp.int32)
+        positions = sds((S,), jnp.int32)
+        if which == "decode":
+            args = (avals(self.params), avals(self._cache),
+                    sds((S,), jnp.int32), sds((S,), jnp.int32),
+                    sds((S,), jnp.bool_), tables, positions, *lanes)
+            fn = self._decode
+        elif which == "verify":
+            if self.spec_k < 1:
+                return None
+            args = (avals(self.params), avals(self._cache),
+                    sds((S, self.spec_k + 1), jnp.int32), tables,
+                    positions, *lanes)
+            fn = self._verify
+        else:
+            raise ValueError(f"unknown executable {which!r} "
+                             "(decode / verify)")
+        return fn.lower(*args).compile().as_text()
 
     def compile_counts(self) -> dict:
         """Executable counts per compiled function — the no-recompile

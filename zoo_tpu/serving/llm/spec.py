@@ -1,3 +1,4 @@
+# zoo-lint: jax-free
 """``llama:...`` model specs: mount the LLM engine behind a replica.
 
 The HA layer launches replicas from a model STRING (``ReplicaGroup``
@@ -106,7 +107,7 @@ def parse_llm_spec(spec: str) -> Tuple[Dict, Dict]:
     return cfg_kwargs, eng
 
 
-def _env_engine_defaults() -> Dict:
+def _env_engine_defaults() -> Dict:  # zoo-lint: config-parse
     """ZOO_LLM_* env knobs (the per-replica deployment surface — a
     ReplicaGroup passes env to every replica it spawns)."""
     out: Dict = {}
@@ -200,7 +201,8 @@ def build_llm_engine(spec: str, mode: Optional[str] = None,
                 "local device(s) are visible")
         merged["mesh"] = build_mesh(devs[:tp], axis_sizes={"model": tp})
     model = PagedLlamaModel(cfg, **merged)
-    mode = mode or os.environ.get("ZOO_LLM_MODE", "continuous")
+    from zoo_tpu.common.knobs import value as knob_value
+    mode = mode or knob_value("ZOO_LLM_MODE")
     engine = LLMEngine(model, mode=mode,
                        max_waiting=overrides.get("max_waiting"),
                        overlap=overlap, prefix_cache=prefix_cache,

@@ -1,3 +1,4 @@
+# zoo-lint: jax-free
 """Model-free n-gram (prompt-lookup) drafter for speculative decoding.
 
 Speculative decoding amortizes the decode roofline: instead of one HBM

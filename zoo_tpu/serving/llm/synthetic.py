@@ -1,3 +1,4 @@
+# zoo-lint: jax-free
 """jax-free deterministic LLM stand-in for chaos smokes and benches.
 
 The same role ``synthetic:double`` plays for the predict path

@@ -1,3 +1,4 @@
+# zoo-lint: jax-free
 """Versioned model registry — the append-only store the serving
 lifecycle promotes through (docs/model_lifecycle.md).
 

@@ -14,6 +14,8 @@ import signal
 import sys
 import threading
 
+from zoo_tpu.common.knobs import value as knob_value
+
 
 def _load_config(path):
     """Minimal config.yaml reader (flat ``key: value`` pairs under the
@@ -182,13 +184,13 @@ def main(argv=None) -> int:
         # in production), never in the model file's directory. Plaintext
         # models are NEVER rerouted here by a stray env var — the branch
         # needs the explicit --encrypted/--model-secret opt-in.
-        secret = ns.model_secret or os.environ.get("ZOO_MODEL_SECRET")
-        salt = ns.model_salt or os.environ.get("ZOO_MODEL_SALT")
+        secret = ns.model_secret or knob_value("ZOO_MODEL_SECRET")
+        salt = ns.model_salt or knob_value("ZOO_MODEL_SALT")
         if not secret:
             ap.error("--encrypted needs --model-secret or "
                      "ZOO_MODEL_SECRET")
         mode = (ns.model_enc_mode
-                or os.environ.get("ZOO_MODEL_ENC_MODE", "cbc"))
+                or knob_value("ZOO_MODEL_ENC_MODE"))
         if mode not in ("cbc", "gcm"):
             ap.error(f"invalid cipher mode {mode!r} (cbc|gcm)")
         im.load_encrypted(ns.model, secret, salt or "", mode=mode,
@@ -216,7 +218,7 @@ def main(argv=None) -> int:
     # request tallies survive the process (docs/fault_tolerance.md)
     fe.stop()
     serving.stop()
-    snap = os.environ.get("ZOO_OBS_SNAPSHOT")
+    snap = knob_value("ZOO_OBS_SNAPSHOT")
     if snap:
         try:
             from zoo_tpu.obs.exporters import write_snapshot

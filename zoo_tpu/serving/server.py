@@ -32,6 +32,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from zoo_tpu.common.knobs import value as knob_value
 from zoo_tpu.obs.flight import flight_recorder, record_event
 from zoo_tpu.obs.metrics import StatTimer, counter, gauge, histogram
 from zoo_tpu.obs.tracing import emit_event, emit_span, span
@@ -308,6 +309,7 @@ class ServingServer:
         # None, insertion-ordered): reload warms the incoming model with
         # one padded-batch inference per signature so the flip never
         # pays a live request's first XLA compile
+        # guarded-by: _swap_lock
         self._warm_shapes: "collections.OrderedDict" = \
             collections.OrderedDict()
         if version is not None:
@@ -1170,7 +1172,7 @@ class ServingServer:
         _drain_seconds.observe(time.monotonic() - t0)
         record_event("drain", drained=drained,
                      seconds=round(time.monotonic() - t0, 3))
-        path = snapshot_path or os.environ.get("ZOO_OBS_SNAPSHOT")
+        path = snapshot_path or knob_value("ZOO_OBS_SNAPSHOT")
         if path:
             try:
                 from zoo_tpu.obs.exporters import write_snapshot

@@ -1,3 +1,4 @@
+# zoo-lint: jax-free
 """Wire-frame integrity: CRC trailers + the one corruption exception.
 
 Gray hardware failures — a flipped bit in a NIC ring, a torn read off a
@@ -70,7 +71,7 @@ def _corrupt_counter():
     return _corrupt_frames
 
 
-def wire_crc_enabled() -> bool:
+def wire_crc_enabled() -> bool:  # zoo-lint: config-parse
     """Whether this process wants CRC trailers on its wire frames
     (``ZOO_WIRE_CRC``, default on). Read at connection/negotiation
     time, so a test can toggle it per server/client process."""

@@ -1,3 +1,4 @@
+# zoo-lint: jax-free
 """Verified-manifest directory format + bounded retention, shared by
 checkpoints and the model registry.
 

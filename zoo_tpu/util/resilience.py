@@ -1,3 +1,4 @@
+# zoo-lint: jax-free
 """Shared resilience core: retries, circuit breaking, fault injection.
 
 The reference system leans on Spark for fault tolerance — task retry,
@@ -86,7 +87,7 @@ __all__ = [
 ]
 
 
-def env_float(name: str, default: float) -> float:
+def env_float(name: str, default: float) -> float:  # zoo-lint: config-parse
     """``$name`` as a float, falling back to ``default`` on unset, empty,
     or malformed values (with a warning for malformed ones) — the one
     shared parser behind every ``ZOO_*`` numeric knob."""
@@ -279,19 +280,19 @@ class CircuitBreaker:
         self.half_open_max = int(half_open_max)
         self._clock = clock
         self._lock = threading.Lock()
-        self._state = self.CLOSED
-        self._failures = 0
-        self._opened_at = 0.0
-        self._probes = 0
-        self._half_open_at = 0.0
+        self._state = self.CLOSED          # guarded-by: _lock
+        self._failures = 0                 # guarded-by: _lock
+        self._opened_at = 0.0              # guarded-by: _lock
+        self._probes = 0                   # guarded-by: _lock
+        self._half_open_at = 0.0           # guarded-by: _lock
 
     @property
     def state(self) -> str:
         with self._lock:
-            self._maybe_half_open()
+            self._maybe_half_open_locked()
             return self._state
 
-    def _maybe_half_open(self):
+    def _maybe_half_open_locked(self):
         now = self._clock()
         if self._state == self.OPEN and \
                 now - self._opened_at >= self.recovery_timeout:
@@ -311,7 +312,7 @@ class CircuitBreaker:
     def allow(self) -> bool:
         """May a call proceed right now? (HALF_OPEN admits probes.)"""
         with self._lock:
-            self._maybe_half_open()
+            self._maybe_half_open_locked()
             if self._state == self.CLOSED:
                 return True
             if self._state == self.HALF_OPEN and \
@@ -353,8 +354,10 @@ class CircuitBreaker:
 
     def call(self, fn: Callable[..., Any], *args, **kwargs) -> Any:
         if not self.allow():
+            with self._lock:  # snapshot for the message, not a race
+                failures = self._failures
             raise CircuitOpenError(
-                f"circuit open ({self._failures} consecutive failures); "
+                f"circuit open ({failures} consecutive failures); "
                 f"retry after {self.recovery_timeout}s")
         try:
             out = fn(*args, **kwargs)
@@ -406,7 +409,7 @@ class FaultInjector:
     keep fresh entropy per process, like before.
     """
 
-    def __init__(self, seed: Optional[int] = None):
+    def __init__(self, seed: Optional[int] = None):  # zoo-lint: config-parse
         self._lock = threading.Lock()
         self._sites: Dict[str, _Fault] = {}
         if seed is None:
@@ -415,7 +418,7 @@ class FaultInjector:
         self.fault_seed = seed
         self._rng = random.Random(seed)
 
-    def reseed(self, seed: Optional[int] = None):
+    def reseed(self, seed: Optional[int] = None):  # zoo-lint: config-parse
         """Restart the fault schedule (``seed=None`` re-reads
         ``$ZOO_FAULT_SEED``, falling back to fresh entropy)."""
         if seed is None:
@@ -572,7 +575,7 @@ class ChaosSchedule:
     bit-flip via ``integrity.corrupt_action``, a spill-dir disk-full
     via the ``flight.spill`` site."""
 
-    def __init__(self, spec: Optional[str] = None,
+    def __init__(self, spec: Optional[str] = None,  # zoo-lint: config-parse
                  seed: Optional[int] = None,
                  replicas: Optional[int] = None):
         if spec is None:
@@ -715,7 +718,7 @@ HEARTBEAT_FILE_ENV = "ZOO_HEARTBEAT_FILE"
 HEARTBEAT_INTERVAL_ENV = "ZOO_HEARTBEAT_INTERVAL"
 
 
-def touch_heartbeat(path: Optional[str] = None):
+def touch_heartbeat(path: Optional[str] = None):  # zoo-lint: config-parse
     """Stamp the heartbeat file (mtime + a ``time.monotonic()`` payload).
     ``path`` defaults to ``$ZOO_HEARTBEAT_FILE``; silently a no-op when
     neither is set, so worker code can call it unconditionally.
@@ -758,7 +761,7 @@ def heartbeat_age(path: str) -> Optional[float]:
         return None
 
 
-def start_heartbeat_thread(path: Optional[str] = None,
+def start_heartbeat_thread(path: Optional[str] = None,  # zoo-lint: config-parse
                            interval: Optional[float] = None
                            ) -> Optional[threading.Thread]:
     """Background daemon stamping the heartbeat file every ``interval``

@@ -1626,7 +1626,201 @@ def bench_lifecycle(extra, clients=6, feat=16):
     assert versions.count(versions[0]) == len(versions), versions
 
 
-_BENCH_PR = 14  # bump alongside CHANGES.md when bench semantics move
+def bench_disagg(extra, live_streams=4, live_tokens=240,
+                 ingest_prompt=18, ingest_tokens=4, prefill_ms=25.0,
+                 tick_ms=2.0, affinity_prompts=4, affinity_reps=6):
+    """Disaggregated-serving A/B (docs/disaggregated_serving.md): the
+    SAME bimodal workload — a handful of long-lived live decode
+    streams plus a sustained long-prompt ingestion storm — over a
+    3-replica pool split into 1 prefill + 2 decode roles (long prompts
+    ride the two-leg ``kv_migrate`` handoff) vs the uniform mixed pool
+    (long prompts prefill wherever round-robin lands them). A chaos
+    delay on the ``llm.prefill`` seam stands in for real prefill
+    compute (the synthetic model's prefill is otherwise free on CPU),
+    so prefill/decode interference — the thing disaggregation removes
+    — is actually present to measure. Reports live-stream inter-token
+    p99 (the acceptance bar: strictly better on the split pool),
+    long-prompt ingestion throughput, and aggregate tokens/s; every
+    stream is verified against the fault-free ``reference()``.
+
+    A second phase measures the ROUTING half of the PR: the same
+    repeated-long-prompt workload through the default prefix-affinity
+    client vs a hash-blind round-robin client (routing weights zeroed)
+    on a fresh split pool — adopted-prefix routing must raise the
+    fleet prefix-cache hit rate (``zoo_llm_prefix_cache_hit_tokens_
+    total`` across all seats' /metrics) over blind rotation."""
+    import tempfile
+    import threading
+
+    from zoo_tpu.serving.ha import ReplicaGroup
+    from zoo_tpu.serving.ha_client import HAServingClient
+    from zoo_tpu.serving.llm.synthetic import reference
+
+    model = "synthllm:slots=4,block=8,blocks=96,tables=32,max_prompt=40"
+    rs = np.random.RandomState(17)
+    live_prompts = [[int(t) for t in rs.randint(0, 97, size=3)]
+                    for _ in range(live_streams)]
+    ingest_pool = [[int(t) for t in rs.randint(0, 97, size=ingest_prompt)]
+                   for _ in range(256)]
+
+    def boot(roles):
+        group = ReplicaGroup(
+            model, num_replicas=3, roles=roles, max_restarts=1,
+            batch_size=4, max_wait_ms=1.0,
+            log_dir=tempfile.mkdtemp(prefix="zoo-bench-disagg-"),
+            env={"ZOO_CHAOS_ALLOW": "1", "ZOO_LLM_PREFIX_CACHE": "1"})
+        group.start(timeout=60)
+        cli = HAServingClient(group.endpoints(), deadline_ms=60000,
+                              hedge=False, migrate_min_tokens=16)
+        cli.update_topology()
+        return group, cli
+
+    def hit_miss(group):
+        hit = sum(sum(group._metrics_counter(
+            i, "zoo_llm_prefix_cache_hit_tokens_total").values())
+            for i in range(3))
+        miss = sum(sum(group._metrics_counter(
+            i, "zoo_llm_prefix_cache_miss_tokens_total").values())
+            for i in range(3))
+        return hit, miss
+
+    def run_pool(roles):
+        group, cli = boot(roles)
+        gaps, errors = [], []
+        tokens, long_done = [0], [0]
+        lock = threading.Lock()
+        drained = threading.Event()
+        try:
+            for i in range(3):
+                group.chaos_rpc(i, "llm.prefill", delay_ms=prefill_ms)
+                group.chaos_rpc(i, "llm.decode", delay_ms=tick_ms)
+
+            def live(k):
+                prompt = live_prompts[k]
+                got, my_gaps, t_prev = [], [], None
+                try:
+                    for tok in cli.generate(prompt, live_tokens):
+                        now = time.perf_counter()
+                        if t_prev is not None:
+                            my_gaps.append(now - t_prev)
+                        t_prev = now
+                        got.append(tok)
+                    if got != reference(prompt, live_tokens):
+                        raise AssertionError("live stream diverged")
+                except Exception as e:  # noqa: BLE001 — tally
+                    with lock:
+                        errors.append(f"live[{k}]: {e!r}")
+                    return
+                with lock:
+                    # drop each stream's first gaps: startup prefills
+                    # stall every seat in BOTH pools and would smear
+                    # the steady-state tail being compared
+                    gaps.extend(my_gaps[5:])
+                    tokens[0] += len(got)
+
+            def ingest(k):
+                j = k
+                while not drained.is_set():
+                    p = ingest_pool[j % len(ingest_pool)]
+                    j += 2
+                    try:
+                        toks = list(cli.generate(p, ingest_tokens))
+                        if toks != reference(p, ingest_tokens):
+                            raise AssertionError("ingest diverged")
+                    except Exception as e:  # noqa: BLE001
+                        with lock:
+                            errors.append(f"ingest[{k}]: {e!r}")
+                        continue
+                    with lock:
+                        long_done[0] += 1
+                        tokens[0] += len(toks)
+
+            lives = [threading.Thread(target=live, args=(k,))
+                     for k in range(live_streams)]
+            ingests = [threading.Thread(target=ingest, args=(k,))
+                       for k in range(2)]
+            t0 = time.perf_counter()
+            for t in lives + ingests:
+                t.start()
+            for t in lives:
+                t.join()
+            wall = time.perf_counter() - t0
+            drained.set()
+            for t in ingests:
+                t.join()
+            assert not errors, errors[:5]
+            gaps_ms = np.asarray(sorted(gaps)) * 1e3
+            return {
+                "p50": float(np.percentile(gaps_ms, 50)),
+                "p99": float(np.percentile(gaps_ms, 99)),
+                "long_per_sec": long_done[0] / wall,
+                "tok_per_sec": tokens[0] / wall,
+            }
+        finally:
+            drained.set()
+            cli.close()
+            group.stop()
+
+    split = run_pool(["prefill", "decode", "decode"])
+    uniform = run_pool(None)
+    extra["disagg_split_intertoken_p50_ms"] = round(split["p50"], 2)
+    extra["disagg_split_intertoken_p99_ms"] = round(split["p99"], 2)
+    extra["disagg_uniform_intertoken_p50_ms"] = round(uniform["p50"], 2)
+    extra["disagg_uniform_intertoken_p99_ms"] = round(uniform["p99"], 2)
+    extra["disagg_split_long_prompts_per_sec"] = round(
+        split["long_per_sec"], 1)
+    extra["disagg_uniform_long_prompts_per_sec"] = round(
+        uniform["long_per_sec"], 1)
+    extra["disagg_split_tok_per_sec"] = round(split["tok_per_sec"], 1)
+    extra["disagg_uniform_tok_per_sec"] = round(uniform["tok_per_sec"], 1)
+    ratio = split["p99"] / max(uniform["p99"], 1e-9)
+    extra["disagg_intertoken_p99_ratio"] = round(ratio, 3)
+    # the acceptance bar: isolating long prefills on a dedicated seat
+    # must strictly improve the live streams' tail cadence
+    assert ratio < 1.0, (
+        f"split-pool inter-token p99 {split['p99']:.2f}ms not better "
+        f"than uniform {uniform['p99']:.2f}ms")
+
+    # ---- adopted-prefix routing vs hash-blind round-robin -----------
+    group, cli_aff = boot(["prefill", "decode", "decode"])
+    cli_rr = None
+    try:
+        cli_rr = HAServingClient(
+            group.endpoints(), deadline_ms=60000, hedge=False,
+            migrate_min_tokens=16, route_prefix_weight=0.0,
+            route_occ_weight=0.0)
+        cli_rr.update_topology()
+
+        def drive(cli, base):
+            prompts = [[(base + 7 * j + 3 * i) % 97
+                        for i in range(ingest_prompt)]
+                       for j in range(affinity_prompts)]
+            h0, m0 = hit_miss(group)
+            for _ in range(affinity_reps):
+                for p in prompts:
+                    toks = list(cli.generate(p, ingest_tokens))
+                    assert toks == reference(p, ingest_tokens)
+            h1, m1 = hit_miss(group)
+            dh, dm = h1 - h0, m1 - m0
+            return dh, dh / max(dh + dm, 1.0)
+
+        rr_hits, rr_rate = drive(cli_rr, 0)
+        aff_hits, aff_rate = drive(cli_aff, 31)
+    finally:
+        if cli_rr is not None:
+            cli_rr.close()
+        cli_aff.close()
+        group.stop()
+    extra["disagg_rr_prefix_hit_rate"] = round(rr_rate, 3)
+    extra["disagg_affinity_prefix_hit_rate"] = round(aff_rate, 3)
+    extra["disagg_rr_prefix_hit_tokens"] = int(rr_hits)
+    extra["disagg_affinity_prefix_hit_tokens"] = int(aff_hits)
+    assert aff_rate > rr_rate, (
+        f"affinity routing hit rate {aff_rate:.3f} not above "
+        f"round-robin {rr_rate:.3f}")
+
+
+_BENCH_PR = 17  # bump alongside CHANGES.md when bench semantics move
 
 
 def _bench_meta():
@@ -1718,6 +1912,10 @@ def main():
             bench_llm_serving(extra)
         except Exception as e:  # noqa: BLE001
             extra["llm_serving_error"] = repr(e)
+        try:
+            bench_disagg(extra)
+        except Exception as e:  # noqa: BLE001
+            extra["disagg_error"] = repr(e)
         try:
             bench_shard_exchange(extra)
         except Exception as e:  # noqa: BLE001

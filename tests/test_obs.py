@@ -506,6 +506,43 @@ def test_metrics_end_to_end_serving_fit_checkpoint(orca_ctx, tmp_path):
     finally:
         llm_eng.stop()
 
+    # 4c-bis. disaggregated serving (docs/disaggregated_serving.md):
+    # one long prompt through a prefill+decode pair drives the whole
+    # two-leg kv_migrate handoff — the prefill seat's push populates
+    # zoo_llm_kv_migrated_bytes_total + zoo_llm_handoff_seconds, the
+    # decode seat's adoption populates zoo_llm_kv_migrated_blocks_total,
+    # and the client's routing plan stamps zoo_serve_route_affinity_total.
+    # Runs BEFORE the 4d allocator probe for the same reason 4c does:
+    # these engines' allocators republish the process-global
+    # zoo_llm_kv_blocks_* gauges on every mutation.
+    from zoo_tpu.serving.ha_client import HAServingClient
+    from zoo_tpu.serving.llm.synthetic import SyntheticLLMModel, reference
+    from zoo_tpu.serving.server import ServingServer
+
+    mk = dict(num_slots=2, block_size=4, num_blocks=32,
+              max_blocks_per_seq=8, max_prompt_len=48)
+    pre_eng = LLMEngine(SyntheticLLMModel(**mk), role="prefill").start()
+    dec_eng = LLMEngine(SyntheticLLMModel(**mk), role="decode").start()
+    pre_srv = ServingServer(None, llm_engine=pre_eng, port=0,
+                            batch_size=2, max_wait_ms=1.0).start()
+    dec_srv = ServingServer(None, llm_engine=dec_eng, port=0,
+                            batch_size=2, max_wait_ms=1.0).start()
+    disagg_cli = HAServingClient(
+        [(pre_srv.host, pre_srv.port), (dec_srv.host, dec_srv.port)],
+        hedge=False, migrate_min_tokens=16)
+    try:
+        disagg_cli.update_topology()
+        long_prompt = [(3 * i + 1) % 50 for i in range(18)]
+        assert list(disagg_cli.generate(long_prompt, 6)) == \
+            reference(long_prompt, 6)
+        assert dec_eng.stats()["handoffs_in"] == 1
+    finally:
+        disagg_cli.close()
+        pre_srv.stop()
+        dec_srv.stop()
+        pre_eng.stop()
+        dec_eng.stop()
+
     # 4d. the paged-KV gauges: a jax-free allocator round-trip leaves
     # zoo_llm_kv_blocks_{used,free} at the pool's live accounting
     from zoo_tpu.serving.llm.kv_cache import BlockAllocator
@@ -580,6 +617,14 @@ def test_metrics_end_to_end_serving_fit_checkpoint(orca_ctx, tmp_path):
             # carry real observations
             "zoo_llm_inter_token_seconds_bucket",
             'zoo_llm_stream_ttft_seconds_bucket{outcome="ok"',
+            # disaggregated serving (this PR): the kv_migrate handoff
+            # volume counters, the push-to-adopt latency histogram,
+            # and the client's routing-decision tally — populated by
+            # the 4c-bis two-leg handoff above
+            "zoo_llm_kv_migrated_blocks_total",
+            "zoo_llm_kv_migrated_bytes_total",
+            "zoo_llm_handoff_seconds_bucket",
+            'zoo_serve_route_affinity_total{reason="handoff"}',
             # the SLO watchdog's published verdict (4e above) and the
             # flight recorder's event tally
             'zoo_slo_burn_rate{slo="error_rate"}',

@@ -48,7 +48,8 @@ _TYPES = ("int", "float", "bool", "str")
 #: docs whose ZOO_* knob tables are generated from this registry (the
 #: marked regions ``<!-- zoo-knob-table:<group> begin/end -->``)
 TABLE_DOCS = ("docs/data_plane.md", "docs/serving_ha.md",
-              "docs/llm_serving.md", "docs/fault_tolerance.md")
+              "docs/llm_serving.md", "docs/fault_tolerance.md",
+              "docs/disaggregated_serving.md")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -191,6 +192,7 @@ _FT = "docs/fault_tolerance.md"
 _OBS = "docs/observability.md"
 _LC = "docs/model_lifecycle.md"
 _MC = "docs/multichip.md"
+_DISAGG = "docs/disaggregated_serving.md"
 
 # -- data plane (docs/data_plane.md, generated table "data-plane") ----------
 _k("ZOO_SHARD_FETCH_CONCURRENCY", "int", 4,
@@ -317,6 +319,31 @@ _k("ZOO_LLAMA_FLASH_MIN_SEQ", "int", 512,
 _k("ZOO_LLAMA_ATTN_IMPL", "str", "",
    "force `dense`/`flash`/`ring` for A/B runs", _LLM, "llm",
    show="unset")
+
+# -- disaggregated serving (docs/disaggregated_serving.md, table "disagg") --
+_k("ZOO_LLM_ROLE", "str", "mixed",
+   "replica role (spec: `role=`): `prefill` parks finished prompts "
+   "for `kv_migrate` handoff instead of decoding, `decode` adopts "
+   "migrated KV, `mixed` does both", _DISAGG, "disagg",
+   show="`mixed`")
+_k("ZOO_KV_MIGRATE_TTL_MS", "float", 2000.0,
+   "how long a parked handoff (prefill side) or a staged adoption "
+   "payload (decode side) survives before the sweep frees its blocks",
+   _DISAGG, "disagg")
+_k("ZOO_KV_MIGRATE_MIN_TOKENS", "int", 16,
+   "prompts shorter than this skip the handoff path and run "
+   "mixed/decode-local prefill (migration overhead isn't worth it)",
+   _DISAGG, "disagg")
+_k("ZOO_KV_MIGRATE_CHUNK_BLOCKS", "int", 4,
+   "KV blocks packed per `kv_migrate` block frame on the wire",
+   _DISAGG, "disagg")
+_k("ZOO_ROUTE_PREFIX_WEIGHT", "float", 1.0,
+   "routing weight of the prefix-affinity signal (estimated cached "
+   "prefix fraction at the seat) in the HA client's plan order",
+   _DISAGG, "disagg")
+_k("ZOO_ROUTE_OCC_WEIGHT", "float", 0.5,
+   "routing weight of decode occupancy (busy slots / total slots "
+   "from `llm_stats`) — penalizes loaded seats", _DISAGG, "disagg")
 
 # -- training guard (docs/fault_tolerance.md, generated table "guard") ------
 _k("ZOO_GUARD", "bool", True,

@@ -128,6 +128,11 @@ METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "zoo_llm_spec_accepted_tokens_total": ("counter", ()),
     "zoo_llm_spec_accept_len": ("histogram", ()),
     "zoo_llm_spec_draft_hit_rate": ("gauge", ()),
+    # -- disaggregated serving (prefill/decode pools + kv_migrate) ----------
+    "zoo_llm_kv_migrated_blocks_total": ("counter", ()),
+    "zoo_llm_kv_migrated_bytes_total": ("counter", ()),
+    "zoo_llm_handoff_seconds": ("histogram", ()),
+    "zoo_serve_route_affinity_total": ("counter", ("reason",)),
     # -- flight recorder / SLO watchdog ------------------------------------
     "zoo_flight_events_total": ("counter", ("kind",)),
     "zoo_flight_dumps_total": ("counter", ("reason",)),
@@ -149,6 +154,9 @@ EVENT_KINDS: FrozenSet[str] = frozenset({
     "corrupt_request_dropped",
     "chaos_arm",
     "chaos_clear",
+    "kv_migrate_out",
+    "kv_migrate_in",
+    "kv_handoff_abort",
     "slo_breach",
     "slo_clear",
     "preempt_exit",

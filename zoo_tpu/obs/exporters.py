@@ -110,6 +110,14 @@ class MetricsExporter:
                                 health["ok"] = False
                     except Exception:  # noqa: BLE001 — probe, not crash
                         pass
+                    # disaggregation role (ZOO_LLM_ROLE — the knob a
+                    # ReplicaGroup injects per seat): external probes
+                    # and routing see the pool topology on the same
+                    # door that says the seat is alive
+                    try:
+                        health["role"] = _knob_value("ZOO_LLM_ROLE")
+                    except Exception:  # noqa: BLE001 — probe, not crash
+                        pass
                     self._reply(200 if health.get("ok") else 503,
                                 json.dumps(health).encode(),
                                 "application/json")

@@ -132,7 +132,8 @@ class ReplicaScore:
     + the membership state the controller walks it through."""
 
     __slots__ = ("name", "ewma_ms", "err", "n", "state", "state_since",
-                 "last_probe", "eject_count", "readmit_at", "_lock")
+                 "last_probe", "eject_count", "readmit_at", "role",
+                 "occupancy", "_lock")
 
     def __init__(self, name: str, clock: Callable[[], float] =
                  time.monotonic):
@@ -145,6 +146,13 @@ class ReplicaScore:
         self.last_probe = 0.0
         self.eject_count = 0
         self.readmit_at = 0.0
+        # disaggregation routing signals (docs/disaggregated_serving.md):
+        # the seat's advertised role (prefill/decode/mixed, learned from
+        # reply frames) and its decode occupancy — busy slots / total
+        # slots from llm_stats, EWMA-smoothed so one poll of a
+        # momentarily full seat doesn't starve it
+        self.role: Optional[str] = None
+        self.occupancy: Optional[float] = None
         self._lock = threading.Lock()
 
     def record(self, dt_s: float, alpha: float = 0.35):
@@ -165,10 +173,27 @@ class ReplicaScore:
             self.err = (1.0 - alpha) * self.err + alpha
             self.n += 1
 
+    def note_role(self, role: Optional[str]):
+        """Learn the seat's advertised replica role (every reply frame
+        carries it once the server knows its engine's role)."""
+        if role is not None:
+            with self._lock:
+                self.role = str(role)
+
+    def note_occupancy(self, frac: float, alpha: float = 0.5):
+        """One decode-occupancy observation (busy/total slots, 0..1)."""
+        frac = min(1.0, max(0.0, float(frac)))
+        with self._lock:
+            self.occupancy = frac if self.occupancy is None else \
+                (1.0 - alpha) * self.occupancy + alpha * frac
+
     def snapshot(self) -> Dict:
         return {"name": self.name, "state": self.state,
                 "ewma_ms": self.ewma_ms, "err": round(self.err, 4),
-                "n": self.n, "eject_count": self.eject_count}
+                "n": self.n, "eject_count": self.eject_count,
+                "role": self.role,
+                "occupancy": None if self.occupancy is None
+                else round(self.occupancy, 3)}
 
 
 class EjectionController:

@@ -193,11 +193,22 @@ class ReplicaGroup:
                  batch_size: int = 8, max_wait_ms: float = 5.0,
                  max_restarts: int = 3, log_dir: Optional[str] = None,
                  env: Optional[Dict[str, str]] = None,
-                 heartbeat_timeout: Optional[float] = None):
+                 heartbeat_timeout: Optional[float] = None,
+                 roles: Optional[Sequence[str]] = None):
+        """``roles``: per-seat disaggregation roles for llm groups —
+        e.g. ``["prefill", "decode", "decode"]`` builds a mixed-role
+        pool (docs/disaggregated_serving.md). Injected as each
+        replica's ``ZOO_LLM_ROLE`` env, so a respawned seat keeps its
+        role. ``None`` = every seat ``mixed`` (the uniform pool)."""
         from zoo_tpu.orca.bootstrap import ProcessMonitor, WorkerProcess
 
         if num_replicas < 1:
             raise ValueError("num_replicas must be >= 1")
+        if roles is not None and len(roles) != num_replicas:
+            raise ValueError(
+                f"roles has {len(roles)} entries for "
+                f"{num_replicas} replicas")
+        self.roles = list(roles) if roles is not None else None
         self.model = model
         self.host = host
         # registry-backed groups know their root + alias, which is what
@@ -235,6 +246,8 @@ class ReplicaGroup:
             wenv.update(env or {})
             wenv["PYTHONPATH"] = root + os.pathsep + \
                 wenv.get("PYTHONPATH", "")
+            if self.roles is not None:
+                wenv["ZOO_LLM_ROLE"] = self.roles[i]
             hb = os.path.join(log_dir, f"replica-{i}.hb") if log_dir \
                 else None
             if log_dir:

@@ -38,6 +38,7 @@ a mid-stream replica kill.
 
 from __future__ import annotations
 
+import hashlib
 import os
 import queue as _queue
 import random
@@ -45,10 +46,12 @@ import sys
 import threading
 import time
 import uuid
+from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from zoo_tpu.common.knobs import value as _knob_value
 from zoo_tpu.obs.metrics import counter, histogram
 from zoo_tpu.obs.tracing import emit_span, new_trace_id
 from zoo_tpu.serving.ejection import (
@@ -93,6 +96,22 @@ _ab_latency = histogram(
     "zoo_serve_ab_latency_seconds",
     "End-to-end client-observed request latency by pinned model "
     "version (includes failover/hedging)", labels=("version",))
+# Disaggregated routing (docs/disaggregated_serving.md): one sample per
+# generate plan, labelled with the decisive reason — prefix = the
+# affinity cache fronted a seat that served this prompt prefix before,
+# occupancy = decode load differentiated the seats, role = prefill
+# seats were demoted to the back, handoff = a prefill→decode pair was
+# fired, rr = plain round-robin (no signal differentiated anything)
+_route_affinity = counter(
+    "zoo_serve_route_affinity_total",
+    "Generate routing decisions by decisive reason (prefix affinity, "
+    "decode occupancy, replica role, disaggregated handoff, or plain "
+    "round-robin)", labels=("reason",))
+
+#: prompt tokens hashed into the routing prefix signature — long enough
+#: to cover several KV blocks at common block sizes, short enough that
+#: prompts sharing a system preamble map to one affinity entry
+_AFFINITY_PREFIX_TOKENS = 16
 
 
 def _parse_ab_split(text: str) -> Dict[str, float]:
@@ -180,6 +199,11 @@ class _Endpoint:
         # a reply teaches us — steers version-pinned routing without
         # probe round-trips, and is only a HINT (the server enforces)
         self.seen_version: Optional[str] = None
+        # the replica role this seat last advertised (prefill/decode/
+        # mixed, docs/disaggregated_serving.md) — learned from reply
+        # frames exactly like seen_version; a prefill seat sheds plain
+        # generates, so the planner keeps it out of the front
+        self.seen_role: Optional[str] = None
         self._idle: List[_Connection] = []
         self._lock = threading.Lock()
 
@@ -236,13 +260,24 @@ class HAServingClient:
                  breaker_recovery: Optional[float] = None,
                  ab_split: Optional[Dict[str, float]] = None,
                  eject: Optional[bool] = None,
-                 ejection_config: Optional[EjectionConfig] = None):
+                 ejection_config: Optional[EjectionConfig] = None,
+                 migrate_min_tokens: Optional[int] = None,
+                 route_prefix_weight: Optional[float] = None,
+                 route_occ_weight: Optional[float] = None):
         """``eject`` toggles gray-failure ejection (default: the
         ``ZOO_EJECT`` env, on) — per-seat latency/error scoring that
         moves sustained outliers through probation → ejection →
         backoff re-admission (docs/fault_tolerance.md);
         ``ejection_config`` overrides the full ``ZOO_EJECT_*`` knob
-        set for tests/benches."""
+        set for tests/benches.
+
+        ``migrate_min_tokens`` / ``route_prefix_weight`` /
+        ``route_occ_weight`` override the disaggregated-serving knobs
+        (``ZOO_KV_MIGRATE_MIN_TOKENS``, ``ZOO_ROUTE_PREFIX_WEIGHT``,
+        ``ZOO_ROUTE_OCC_WEIGHT``, docs/disaggregated_serving.md):
+        the prompt length below which no prefill→decode handoff is
+        attempted, and the plan re-ranking weights for prefix
+        affinity and decode occupancy (0 disables a signal)."""
         if not endpoints:
             raise ValueError("HAServingClient needs at least one endpoint")
         self._ejector = EjectionController(
@@ -277,6 +312,23 @@ class HAServingClient:
         self._ab_split = dict(ab_split or {})
         _validate_ab_split(self._ab_split)
         self._ab_rng = random.Random()
+        # disaggregated routing state (docs/disaggregated_serving.md):
+        # a bounded LRU of prompt-prefix signature → the seat that last
+        # streamed a prompt with that prefix (its KV prefix cache —
+        # local or adopted via kv_migrate — likely still holds the
+        # blocks), plus the knob-weighted re-ranking parameters
+        self._migrate_min = int(
+            migrate_min_tokens if migrate_min_tokens is not None
+            else _knob_value("ZOO_KV_MIGRATE_MIN_TOKENS"))
+        self._route_prefix_w = float(
+            route_prefix_weight if route_prefix_weight is not None
+            else _knob_value("ZOO_ROUTE_PREFIX_WEIGHT"))
+        self._route_occ_w = float(
+            route_occ_weight if route_occ_weight is not None
+            else _knob_value("ZOO_ROUTE_OCC_WEIGHT"))
+        self._affinity: "OrderedDict[bytes, Tuple[str, int]]" = \
+            OrderedDict()
+        self._affinity_lock = threading.Lock()
 
     def _make_endpoint(self, host: str, port: int) -> _Endpoint:
         return _Endpoint(
@@ -410,7 +462,14 @@ class HAServingClient:
         received = 0
         results: "_queue.Queue" = _queue.Queue()
         attempts: List[Dict] = []
-        order = self._plan()
+        order, sig = self._plan_generate(prompt)
+        # disaggregation: when the fleet has a known prefill seat and
+        # the prompt is long enough, leg 1 goes there with the decode
+        # target's address riding the frame (``handoff``); the seat
+        # prefills, parks the KV, pushes it via kv_migrate, and
+        # terminates with outcome=handoff — the arbiter then fires
+        # leg 2 at the decode target
+        pair = self._handoff_pair(order, int(prompt.size))
         # every endpoint may be tried twice (once pre-, once post-
         # failure) before the stream gives up
         budget = 2 * len(order)
@@ -426,10 +485,12 @@ class HAServingClient:
                 conn, att["conn"] = att["conn"], None
             return conn
 
-        def fire(ep: _Endpoint, is_hedge: bool = False):
+        def fire(ep: _Endpoint, is_hedge: bool = False,
+                 handoff_to: Optional[_Endpoint] = None):
             att = {"ep": ep, "stop": threading.Event(), "conn": None,
                    "hedge": is_hedge, "dead": False,
                    "resume_from": received,
+                   "handoff_to": handoff_to,
                    "t0": time.perf_counter(),
                    # exactly-once connection ownership: the attempt
                    # thread RELEASES (pool) and kill() CLOSES — whoever
@@ -478,6 +539,8 @@ class HAServingClient:
                                  ("seed", seed), ("spec_k", spec_k)):
                     if val is not None:
                         msg[key] = val
+                if handoff_to is not None:
+                    msg["handoff"] = [handoff_to.host, handoff_to.port]
                 try:
                     for frame in conn.stream(dict(msg), deadline=dl):
                         results.put(("frame", att, frame))
@@ -530,7 +593,11 @@ class HAServingClient:
 
         in_flight = 1
         budget -= 1
-        fire(candidates.pop(0))
+        if pair is not None:
+            _route_affinity.labels(reason="handoff").inc()
+            fire(pair[0], handoff_to=pair[1])
+        else:
+            fire(candidates.pop(0))
         hedged = False
         try:
             while in_flight:
@@ -575,10 +642,16 @@ class HAServingClient:
                         in_flight += 1
                         fire(candidates.pop(0))
                     continue
+                frame = payload
+                # every reply frame advertises the seat's replica role
+                # (docs/disaggregated_serving.md) — learn it passively,
+                # shed bounces included, so the NEXT plan keeps prefill
+                # seats out of the plain-generate front
+                if frame.get("role") is not None:
+                    self._learn_role(att["ep"], frame["role"])
                 if att["stop"].is_set() or (chosen is not None
                                             and att is not chosen):
                     continue
-                frame = payload
                 if frame.get("shed") and frame.get("retryable"):
                     kill(att)
                     last_err = NoReplicaAvailable(
@@ -622,6 +695,50 @@ class HAServingClient:
                         in_flight += 1
                         fire(candidates.pop(0))
                     continue
+                if frame.get("done") and \
+                        frame.get("outcome") == "handoff":
+                    # leg 1 of a disaggregated stream: the prefill seat
+                    # parked this sequence's KV and — when ``migrated``
+                    # — pushed it to the decode target, which now holds
+                    # an adoption staged under this rid. Kill every
+                    # racer (their resume_from predates this), then
+                    # fire leg 2: a plain generate, same id and
+                    # sampling, at the decode target. The target either
+                    # adopts the KV (zero prefill device steps) or — if
+                    # the push failed, the staging expired, or the
+                    # target died — any seat re-prefills from scratch;
+                    # deterministic decoding makes every path
+                    # byte-identical, so the caller never sees which
+                    # one happened.
+                    att["ep"].breaker.record_success()
+                    self._score_ok(att["ep"],
+                                   time.perf_counter() - att["t0"])
+                    for other in attempts:
+                        if other is not att and not other["dead"] \
+                                and not other["stop"].is_set():
+                            kill(other)
+                    kill(att)
+                    if att is chosen:
+                        chosen = None
+                    target = att.get("handoff_to") \
+                        if frame.get("migrated") else None
+                    if target is not None and budget > 0 and \
+                            (dl is None or not dl.expired()):
+                        budget -= 1
+                        in_flight += 1
+                        fire(target)
+                    elif can_fire():
+                        # handoff died (push failed / no target):
+                        # plain failover re-prefills elsewhere
+                        _failover.inc()
+                        budget -= 1
+                        in_flight += 1
+                        fire(candidates.pop(0))
+                    else:
+                        last_err = NoReplicaAvailable(
+                            "handoff leg 1 finished but no seat "
+                            "available for the decode leg", None)
+                    continue
                 if chosen is None and (frame.get("tokens")
                                        or frame.get("done")):
                     chosen = att
@@ -631,6 +748,10 @@ class HAServingClient:
                     # up here long before any transport error would
                     self._score_ok(att["ep"],
                                    time.perf_counter() - att["t0"])
+                    # remember which seat streams this prompt prefix —
+                    # the NEXT same-prefix generate plans it first and
+                    # rides its (local or adopted) KV prefix cache
+                    self._note_affinity(sig, att["ep"])
                     if att["hedge"]:
                         _hedge.labels(event="won").inc()
                     for other in attempts:
@@ -772,6 +893,125 @@ class HAServingClient:
             match = [ep for ep in tier
                      if ep.seen_version in (None, version)]
             out += match + [ep for ep in tier if ep not in match]
+        return out
+
+    # -- disaggregated routing (docs/disaggregated_serving.md) -------------
+    def _learn_role(self, ep: _Endpoint, role):
+        """A reply frame advertised the seat's replica role — remember
+        it on the endpoint (planning) and its gray-failure score
+        (snapshots/postmortems)."""
+        ep.seen_role = str(role)
+        if ep.score is not None:
+            ep.score.note_role(role)
+
+    def _prompt_sig(self, prompt) -> bytes:
+        """Routing prefix signature: a stable hash of the prompt's
+        first ``_AFFINITY_PREFIX_TOKENS`` tokens, so prompts sharing a
+        preamble (the prefix-cache win) map to one affinity entry."""
+        toks = np.asarray(prompt).reshape(-1)[:_AFFINITY_PREFIX_TOKENS]
+        h = hashlib.blake2b(b"zoo-route-affinity-v1", digest_size=16)
+        for t in toks:
+            h.update(int(t).to_bytes(8, "little", signed=True))
+        return h.digest()
+
+    def _note_affinity(self, sig: bytes, ep: _Endpoint):
+        with self._affinity_lock:
+            self._affinity[sig] = (ep.host, ep.port)
+            self._affinity.move_to_end(sig)
+            while len(self._affinity) > 512:
+                self._affinity.popitem(last=False)
+
+    def _plan_generate(self, prompt) -> Tuple[List[_Endpoint], bytes]:
+        """Plan for one generate stream: the health-tiered ``_plan()``
+        rotation, re-ranked for disaggregation —
+
+        * seats last seen as ``role=prefill`` sink to the back: they
+          shed plain generates, so fronting one burns a failover;
+        * the rest rank by ``ZOO_ROUTE_PREFIX_WEIGHT`` × prefix
+          affinity (this client streamed a same-prefix prompt there
+          before) minus ``ZOO_ROUTE_OCC_WEIGHT`` × decode occupancy
+          (EWMA busy/total slots from ``llm_stats``), stable-sorted so
+          round-robin still breaks ties.
+
+        Emits one ``zoo_serve_route_affinity_total`` sample with the
+        decisive reason, and returns the plan plus the prompt's
+        affinity signature."""
+        order = self._plan()
+        sig = self._prompt_sig(prompt)
+        with self._affinity_lock:
+            aff_seat = self._affinity.get(sig)
+        pw, ow = self._route_prefix_w, self._route_occ_w
+
+        def occ(ep: _Endpoint) -> float:
+            s = ep.score
+            return s.occupancy if s is not None \
+                and s.occupancy is not None else 0.0
+
+        serve = [ep for ep in order if ep.seen_role != "prefill"]
+        prefill = [ep for ep in order if ep.seen_role == "prefill"]
+        serve.sort(key=lambda ep: -(
+            pw * (1.0 if (ep.host, ep.port) == aff_seat else 0.0)
+            - ow * occ(ep)))
+        reason = "rr"
+        if serve:
+            if pw > 0 and (serve[0].host, serve[0].port) == aff_seat:
+                reason = "prefix"
+            elif ow > 0 and len({round(occ(ep), 3)
+                                 for ep in serve}) > 1:
+                reason = "occupancy"
+            elif prefill:
+                reason = "role"
+        _route_affinity.labels(reason=reason).inc()
+        return serve + prefill, sig
+
+    def _handoff_pair(self, order: List[_Endpoint], n_prompt: int
+                      ) -> Optional[Tuple[_Endpoint, _Endpoint]]:
+        """``(prefill_seat, decode_target)`` when a disaggregated
+        prefill→decode handoff should carry this stream: the prompt
+        clears ``ZOO_KV_MIGRATE_MIN_TOKENS`` and the plan knows both a
+        prefill-role seat and a decode-capable one. ``order`` comes
+        from :meth:`_plan_generate`, so the front is the best decode
+        target and prefill seats ride the back."""
+        if n_prompt < self._migrate_min:
+            return None
+        prefill = [ep for ep in order if ep.seen_role == "prefill"]
+        serve = [ep for ep in order if ep.seen_role != "prefill"]
+        if not prefill or not serve:
+            return None
+        return prefill[0], serve[0]
+
+    def update_topology(self, deadline_ms: float = 2000.0
+                        ) -> Dict[str, Optional[Dict]]:
+        """Poll every seat's ``llm_stats`` once and refresh the routing
+        signals: advertised role and decode occupancy (busy/total
+        slots, EWMA-smoothed onto the seat's score). Optional — roles
+        are also learned passively from reply frames (a prefill seat
+        teaches its role with its first shed) — but one poll primes
+        the planner before any traffic has bounced. Returns the raw
+        stats per seat (None for a seat that didn't answer)."""
+        out: Dict[str, Optional[Dict]] = {}
+        for ep in list(self._eps):
+            conn = None
+            try:
+                conn = ep.acquire()
+                resp = conn.rpc({"op": "llm_stats"},
+                                deadline=Deadline.from_ms(deadline_ms))
+                ep.release(conn, healthy=True)
+            except (OSError, RetryError):
+                if conn is not None:
+                    ep.release(conn, healthy=False)
+                out[f"{ep.host}:{ep.port}"] = None
+                continue
+            if resp.get("role") is not None:
+                self._learn_role(ep, resp["role"])
+            st = resp.get("stats") or {}
+            if st.get("role") is not None:
+                self._learn_role(ep, st["role"])
+            slots = st.get("slots") or 0
+            if slots and ep.score is not None:
+                ep.score.note_occupancy(
+                    float(st.get("active") or 0) / float(slots))
+            out[f"{ep.host}:{ep.port}"] = st
         return out
 
     def _hedge_delay(self) -> float:
@@ -941,6 +1181,8 @@ class HAServingClient:
                     # version-mismatch bounces included, so the NEXT
                     # pinned request plans around it
                     ep.seen_version = resp["version"]
+                if resp.get("role") is not None:
+                    self._learn_role(ep, resp["role"])
                 if resp.get("shed") and resp.get("retryable"):
                     # overload shed: the replica is alive but full —
                     # fail over without charging its breaker

@@ -308,6 +308,13 @@ class GenHandle:
         self.block_hashes: list = []
         self.hashed_len = -1
         self.cache_hit_tokens = 0
+        # disaggregation (docs/disaggregated_serving.md): hold_handoff
+        # parks the stream after prefill (outcome "handoff") instead of
+        # decoding; adopt carries an incoming kv_migrate payload so
+        # admission binds the migrated blocks and enters decode with
+        # ZERO local prefill work
+        self.hold_handoff = False
+        self.adopt: Optional[Dict] = None
 
     @property
     def done(self) -> bool:
@@ -441,11 +448,23 @@ class LLMEngine:
                  overlap: Optional[bool] = None,
                  prefix_cache: Optional[bool] = None,
                  spec_k: Optional[int] = None,
-                 spec_ngram: Optional[int] = None):
+                 spec_ngram: Optional[int] = None,
+                 role: Optional[str] = None):
         if mode not in ("continuous", "oneshot"):
             raise ValueError(f"unknown scheduling mode {mode!r}")
         self.model = model
         self.mode = mode
+        # disaggregated serving (docs/disaggregated_serving.md): the
+        # replica's role in a mixed pool. "prefill" parks finished
+        # prompts for kv_migrate handoff instead of decoding them,
+        # "decode" adopts migrated KV, "mixed" (default) does both.
+        if role is None:
+            role = knob_value("ZOO_LLM_ROLE")
+        if role not in ("prefill", "decode", "mixed"):
+            raise ValueError(
+                f"unknown replica role {role!r} (expected prefill, "
+                "decode, or mixed)")
+        self.role = role
         # speculative decoding: the engine drafts with the n-gram
         # prompt-lookup drafter and scores through the model's VERIFY
         # executable; the budget can never exceed the model's fixed
@@ -522,6 +541,21 @@ class LLMEngine:
         # on-device token chain references a failed computation and
         # must be re-seeded from host state before the next dispatch
         self._chain_broken = False
+        # disaggregation state (guarded-by: _lock). _handoffs parks a
+        # prefilled sequence's payload (blocks still OWNED by the
+        # allocator) until the server pushes it to the decode replica
+        # and releases it; _adopted stages incoming kv_migrate payloads
+        # until the matching generate arrives. Both age out on the
+        # migrate TTL so a dead peer can never pin KV blocks forever.
+        self._handoffs: "collections.OrderedDict[str, Dict]" = \
+            collections.OrderedDict()
+        self._adopted: "collections.OrderedDict[str, Dict]" = \
+            collections.OrderedDict()
+        self._handoff_ttl = max(
+            0.05, float(knob_value("ZOO_KV_MIGRATE_TTL_MS")) / 1000.0)
+        self._adopted_cap = 64
+        self._handoffs_out = 0
+        self._handoffs_in = 0
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "LLMEngine":
@@ -547,6 +581,14 @@ class LLMEngine:
         for h in live:
             self.allocator.free(h.id)
             h.finish("cancelled", "engine stopped")
+        # parked handoffs hold blocks with no slot: free them too —
+        # the pool must account to zero on shutdown
+        with self._lock:
+            parked = list(self._handoffs)
+            self._handoffs.clear()
+            self._adopted.clear()
+        for rid in parked:
+            self.allocator.free(rid)
         self._publish()
 
     # -- submission --------------------------------------------------------
@@ -555,7 +597,9 @@ class LLMEngine:
                deadline: Optional[Deadline] = None,
                sampling=None, spec_k: Optional[int] = None,
                trace_id: Optional[str] = None,
-               parent_span: Optional[str] = None) -> GenHandle:
+               parent_span: Optional[str] = None,
+               handoff: bool = False,
+               adopt: Optional[Dict] = None) -> GenHandle:
         """Queue one generation. ``sampling``: None (greedy, or the
         ``ZOO_LLM_SAMPLING`` deployment default), or a dict/string with
         ``temperature``/``top_k``/``top_p``/``seed`` — a missing seed
@@ -567,7 +611,12 @@ class LLMEngine:
         lifecycle event for this stream with the request's wire trace
         (docs/observability.md). Raises :class:`AdmissionError` when
         the waiting queue is full (retryable shed), ``ValueError`` for
-        a prompt no prefill path can hold."""
+        a prompt no prefill path can hold.
+
+        ``handoff=True`` prefills only: the stream parks with outcome
+        ``"handoff"`` and its KV blocks held for :meth:`take_handoff`.
+        ``adopt`` binds an incoming kv_migrate payload instead of
+        prefilling (docs/disaggregated_serving.md)."""
         if spec_k is not None and int(spec_k) < 0:
             raise ValueError("spec_k must be >= 0")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -608,6 +657,8 @@ class LLMEngine:
                           spec_k=None if spec_k is None else
                           int(spec_k),
                           trace_id=trace_id, parent_span=parent_span)
+            h.hold_handoff = bool(handoff)
+            h.adopt = adopt
             self._by_id[rid] = h
             self._trim_finished_locked()
             self._wait.append(h)
@@ -675,7 +726,9 @@ class LLMEngine:
 
     def _sweep(self):
         """Free slots whose stream is done for out-of-band reasons
-        (client cancel, deadline expiry)."""
+        (client cancel, deadline expiry), and expire parked handoff /
+        staged adoption state past the migrate TTL — a dead peer can
+        never pin KV blocks forever."""
         for slot in self._slots:
             h = slot.handle
             if h is None:
@@ -687,6 +740,16 @@ class LLMEngine:
                     slot, "expired",
                     "deadline expired mid-stream (generation stopped, "
                     f"{h.gen_count} tokens emitted)")
+        now = time.perf_counter()
+        for rid in [r for r, p in self._handoffs.items()
+                    if not p.get("taken")
+                    and now - p["t0"] > self._handoff_ttl]:
+            self._handoffs.pop(rid, None)
+            self.allocator.free(rid)
+            record_event("kv_handoff_abort", rid=rid, reason="ttl")
+        for rid in [r for r, p in self._adopted.items()
+                    if now - p["staged_at"] > self._handoff_ttl]:
+            self._adopted.pop(rid, None)
 
     def _admit_ready(self) -> bool:
         if self.mode == "oneshot":
@@ -722,6 +785,14 @@ class LLMEngine:
                 h.finish("error",
                          f"resumed context of {len(prompt)} tokens "
                          "exceeds the whole KV pool")
+                continue
+            if h.adopt is not None:
+                # migrated stream: bind the adopted table and enter
+                # decode directly — no prefill work at all
+                if not self._bind_adopted(slot, h, prompt):
+                    with self._lock:
+                        self._wait.appendleft(h)
+                    break
                 continue
             # prefix cache: hash the prompt's full blocks and probe for
             # the longest cached run. At least the LAST prompt token is
@@ -828,6 +899,9 @@ class LLMEngine:
                       prompt_len: int):
         """Prompt fully prefilled: push the first generated token and
         arm the slot for the decode chain (first tick host-fed)."""
+        if h.hold_handoff:
+            self._park_handoff(slot, h, first, prompt_len)
+            return
         # publish the prompt's full blocks under their content hashes —
         # every later stream carrying the same prefix binds them
         # instead of re-prefilling (first writer wins, so a CoW fork
@@ -849,6 +923,155 @@ class LLMEngine:
         if h.gen_count >= h.max_new or \
                 (eos is not None and first == eos):
             self._finish_slot(slot, "ok")
+
+    # -- disaggregated handoff (docs/disaggregated_serving.md) -------------
+    def _park_handoff(self, slot: _Slot, h: GenHandle, first: int,
+                      prompt_len: int):
+        """Prompt fully prefilled on a handoff stream: publish the
+        prefix locally, park the migration payload with the KV blocks
+        still OWNED, release the slot, and finish the stream with
+        outcome ``"handoff"`` — the server then pushes the payload to
+        the decode replica and calls :meth:`release_handoff`. Under
+        self._lock (the _apply_prefill path)."""
+        self.allocator.register_blocks(h.id, h.block_hashes)
+        prompt = h.effective_prompt if h.effective_prompt is not None \
+            else h.prompt
+        payload = {
+            "rid": h.id,
+            "prompt": [int(t) for t in prompt],
+            "first": int(first),
+            "sampling": list(h.sampling),
+            "hashes": list(h.block_hashes),
+            "blocks": self.allocator.blocks_of(h.id),
+            "block_size": self.allocator.block_size,
+            "aux": self.allocator.get_aux(h.id),
+            "max_new": h.max_new,
+            "t0": time.perf_counter(),
+        }
+        self._handoffs[h.id] = payload
+        # the SLOT frees now; the BLOCKS stay owned until
+        # release_handoff (or the TTL sweep) frees them — hashed
+        # blocks then park on the prefix LRU, so the prefill replica
+        # keeps serving the prefix locally too
+        slot.handle = None
+        slot.epoch += 1
+        self._handoffs_out += 1
+        record_event("kv_migrate_out", rid=h.id,
+                     blocks=len(payload["blocks"]),
+                     prompt_tokens=int(prompt_len))
+        h.finish("handoff")
+        self._publish()
+
+    def take_handoff(self, rid: str) -> Optional[Dict]:
+        """The parked payload for ``rid``, marked in-push so the TTL
+        sweep leaves its blocks alone until :meth:`release_handoff`;
+        None when nothing is parked (expired, already released)."""
+        with self._lock:
+            payload = self._handoffs.get(rid)
+            if payload is not None:
+                payload["taken"] = True
+            return payload
+
+    def release_handoff(self, rid: str) -> bool:
+        """Free a parked handoff's blocks (pushed to the decode
+        replica — or the push died and the client will fall back to a
+        plain re-prefill elsewhere)."""
+        with self._lock:
+            payload = self._handoffs.pop(rid, None)
+        if payload is None:
+            return False
+        self.allocator.free(rid)
+        return True
+
+    def offer_adopted(self, payload: Dict) -> bool:
+        """Stage an incoming kv_migrate payload until its generate
+        arrives (bounded LRU; ages out on the migrate TTL). The
+        allocator is untouched here, so a peer that dies after commit
+        but before the generate lands leaks nothing. Refused (False)
+        when the payload cannot be decoded faithfully here — block
+        geometry mismatch, or this model holds real KV state and the
+        payload carries none."""
+        if int(payload.get("block_size") or 0) != \
+                self.allocator.block_size:
+            return False
+        if hasattr(self.model, "import_kv_blocks") and \
+                payload.get("kv") is None:
+            return False
+        payload = dict(payload)
+        payload["staged_at"] = time.perf_counter()
+        with self._lock:
+            self._adopted[str(payload["rid"])] = payload
+            while len(self._adopted) > self._adopted_cap:
+                self._adopted.popitem(last=False)
+        return True
+
+    def pop_adopted(self, rid: str) -> Optional[Dict]:
+        """Claim the staged payload for ``rid`` (None = never staged /
+        aged out — the caller submits a plain re-prefill, which by
+        determinism yields the identical stream)."""
+        with self._lock:
+            payload = self._adopted.pop(rid, None)
+        if payload is None:
+            return None
+        if time.perf_counter() - payload["staged_at"] > \
+                self._handoff_ttl:
+            return None
+        return payload
+
+    def _bind_adopted(self, slot: _Slot, h: GenHandle,
+                      prompt: np.ndarray) -> bool:
+        """Admission for a migrated stream: bind the adopted block
+        table (aliasing any locally-matchable prefix run), import the
+        wire KV bytes into the fresh blocks, and enter decode DIRECTLY
+        with the prefill replica's first token — zero prefill device
+        calls, so a pure-decode replica's compile census stays at the
+        one decode executable. Returns False when the pool cannot fund
+        the table yet (requeue, same contract as can_admit). Under
+        self._lock."""
+        payload = h.adopt
+        hashes = [bytes(x) for x in payload.get("hashes") or ()]
+        n_blocks = self.allocator.blocks_for_tokens(len(prompt) + 1)
+        got = self.allocator.adopt_blocks(h.id, hashes, n_blocks)
+        if got is None:
+            return False
+        table, n_reused = got
+        h.adopt = None
+        h.block_hashes = hashes
+        h.hashed_len = len(prompt)
+        kv = payload.get("kv")
+        fn = getattr(self.model, "import_kv_blocks", None)
+        if kv is not None and fn is not None:
+            # fresh rows only: locally-aliased prefix blocks already
+            # hold byte-identical K/V (the hash-match guarantee)
+            fn(table[n_reused:], kv, start=n_reused)
+        bs = self.allocator.block_size
+        local_hit = min(n_reused * bs, len(prompt) - 1)
+        h.cache_hit_tokens = local_hit
+        if self.prefix_cache and local_hit:
+            # aliased rows are genuine prefix-cache hits; the migrated
+            # remainder is neither hit nor miss — no prefill ran
+            self._hit_tokens += local_hit
+            _prefix_hits.inc(local_hit)
+        self.allocator.set_aux(h.id, seed=h.sampling[3],
+                               resumed_at=len(prompt))
+        slot.handle = h
+        slot.epoch += 1
+        slot.spec_inflight = False
+        slot.pending_copy = None
+        self._admit_counter += 1
+        h.admit_seq = self._admit_counter
+        h.admitted_at = time.perf_counter()
+        self._handoffs_in += 1
+        emit_event("llm.admit", trace=h.trace_id,
+                   parent=h.parent_span, rid=h.id,
+                   queue_wait_s=round(h.admitted_at - h.created, 6),
+                   prompt_tokens=int(len(prompt)),
+                   cache_hit_tokens=int(local_hit),
+                   cow_fork=False, resumed=False, adopted=True)
+        record_event("kv_migrate_in", rid=h.id,
+                     blocks=len(table) - n_reused, reused=n_reused)
+        self._enter_decode(slot, h, int(payload["first"]), len(prompt))
+        return True
 
     def _select_prefill(self) -> List[tuple]:
         """Under the lock: claim this tick's prefill work — whole
@@ -1577,6 +1800,14 @@ class LLMEngine:
                "spec_draft_hit_rate": (
                    self._spec_drafted_lanes / self._spec_lanes
                    if self._spec_lanes else 0.0),
+               # disaggregation (docs/disaggregated_serving.md): the
+               # replica's role and kv_migrate traffic both ways —
+               # llm_stats publishes these, and the HA client's
+               # role/occupancy routing reads them
+               "role": self.role,
+               "handoffs_out": self._handoffs_out,
+               "handoffs_in": self._handoffs_in,
+               "parked_handoffs": len(self._handoffs),
                "active": sum(1 for s in self._slots if s.handle),
                "waiting": len(self._wait),
                "decode_steps": self._decode_steps,

@@ -66,8 +66,13 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from zoo_tpu.obs.metrics import gauge
+from zoo_tpu.obs.metrics import counter, gauge
 
+_migrated_blocks = counter(
+    "zoo_llm_kv_migrated_blocks_total",
+    "KV blocks adopted from another replica's prefill via kv_migrate "
+    "(fresh blocks materialized for wire payloads; locally-matched "
+    "prefix blocks are counted as prefix-cache hits instead)")
 _blocks_used = gauge(
     "zoo_llm_kv_blocks_used",
     "KV-cache blocks currently owned by live sequences")
@@ -350,6 +355,88 @@ class BlockAllocator:
                     continue
                 self._hash_of[blk] = h
                 self._by_hash[h] = blk
+
+    def adopt_blocks(self, seq_id: str, hashes: Sequence[bytes],
+                     n_blocks: int) -> Optional[Tuple[List[int], int]]:
+        """Bind an incoming migrated sequence (docs/
+        disaggregated_serving.md): the prefill replica streamed
+        ``seq_id``'s KV over ``op=kv_migrate`` and this allocator must
+        now hold an ``n_blocks``-long table for it. Leading ``hashes``
+        already matchable HERE are aliased exactly like
+        :meth:`acquire_prefix` (refcount bump, off the cached-free
+        LRU) — the wire payload for those blocks is redundant with
+        local bytes; the remainder comes fresh off the free list and is
+        REGISTERED under the incoming hashes, which is what converges N
+        per-replica prefix caches into one logical cache: the next
+        local prompt sharing the migrated prefix hits it.
+
+        Returns ``(block_table, n_reused)`` — the full ordered table
+        and how many leading blocks were locally aliased (the caller
+        only copies wire bytes into ``block_table[n_reused:]``) — or
+        None when the pool cannot fund the fresh remainder
+        (all-or-nothing: aliased refs are rolled back; the caller
+        queues or falls back to a plain re-prefill). The LAST block is
+        never aliased even on a full hash match: it is the sequence's
+        private write frontier (decode appends there), mirroring the
+        aligned-full-hit copy-on-write rule of the local admission
+        path without needing a device-side fork."""
+        if n_blocks <= 0:
+            raise ValueError("n_blocks must be positive")
+        with self._lock:
+            if self._owners.get(seq_id):
+                raise ValueError(
+                    f"adopt_blocks must run before {seq_id!r} owns "
+                    "blocks (the adopted table is rows 0..n)")
+            reused: List[int] = []
+            if self.prefix_cache:
+                for h in hashes[:n_blocks - 1]:
+                    blk = self._by_hash.get(h)
+                    if blk is None:
+                        break
+                    # bump BEFORE _take_free below: a matched block
+                    # parked on the cached-free LRU must not be
+                    # evicted out from under this adoption while the
+                    # fresh remainder is funded
+                    self._ref[blk] = self._ref.get(blk, 0) + 1
+                    self._cached.pop(blk, None)
+                    reused.append(blk)
+            fresh = self._take_free(n_blocks - len(reused))
+            if fresh is None:
+                # roll back the aliased refs exactly as free() would
+                for b in reversed(reused):
+                    r = self._ref.get(b, 1) - 1
+                    if r > 0:
+                        self._ref[b] = r
+                        continue
+                    self._ref.pop(b, None)
+                    if b in self._hash_of:
+                        self._cached[b] = None
+                        self._cached.move_to_end(b)   # MRU end
+                    else:
+                        self._free.append(b)
+                self._publish()
+                return None
+            for b in fresh:
+                self._ref[b] = 1
+            table = reused + fresh
+            self._owners[seq_id] = list(table)
+            if self.prefix_cache:
+                # publish the incoming hashes over the adopted table
+                # (first writer wins, same rule as register_blocks) —
+                # fresh blocks only: reused rows are already published
+                for i, h in enumerate(hashes):
+                    if i >= len(table):
+                        break
+                    if h in self._by_hash:
+                        continue
+                    blk = table[i]
+                    if blk in self._hash_of:
+                        continue
+                    self._hash_of[blk] = h
+                    self._by_hash[h] = blk
+            self._publish()
+            _migrated_blocks.inc(len(fresh))
+            return table, len(reused)
 
     def make_writable(self, seq_id: str,
                       index: int) -> Optional[Tuple[int, int]]:

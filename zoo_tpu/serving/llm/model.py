@@ -978,6 +978,47 @@ class PagedLlamaModel:
             self._cache = self._copy(self._cache, jnp.int32(src),
                                      jnp.int32(dst))
 
+    # -- KV migration (docs/disaggregated_serving.md) ----------------------
+    def export_kv_blocks(self, blocks) -> dict:
+        """Host copies of the cache rows for ``blocks``, keyed like the
+        cache pytree (``k``/``v`` and the int8 scale rows), block axis
+        at position 1 in the order given — exactly the bytes a decode
+        replica's :meth:`import_kv_blocks` writes back, so a migrated
+        sequence decodes from bit-identical cache state. Under int8 the
+        wire pays 1 byte/row-element + the f32 scales (the on-device
+        quantization IS the wire compression). The gather runs under
+        the dispatch lock (the donated-cache arrays must not be
+        consumed by a concurrent tick mid-read); the returned arrays
+        are detached host copies."""
+        idx = jnp.asarray(list(blocks), jnp.int32)
+        with self._lock:
+            parts = {name: arr[:, idx] for name, arr in
+                     self._cache.items()}
+        return {name: np.asarray(part) for name, part in parts.items()}
+
+    def import_kv_blocks(self, blocks, data: dict, start: int = 0):
+        """Write exported cache rows into local ``blocks``:
+        ``data[name][:, start : start + len(blocks)]`` lands in block
+        ``blocks[i]`` — the adopting engine skips ``start`` leading
+        blocks it aliased from its own prefix cache instead. Runs
+        eagerly (plain scatters), so a pure-decode replica's traced
+        executable census is untouched."""
+        blocks = list(blocks)
+        if not blocks:
+            return
+        missing = set(self._cache) - set(data)
+        if missing:
+            raise ValueError(
+                f"kv payload is missing cache planes {sorted(missing)} "
+                f"(this cache is {self.kv_cache_dtype})")
+        idx = jnp.asarray(blocks, jnp.int32)
+        stop = start + len(blocks)
+        with self._lock:
+            for name, arr in self._cache.items():
+                rows = jnp.asarray(np.asarray(data[name])[:, start:stop],
+                                   arr.dtype)
+                self._cache[name] = arr.at[:, idx].set(rows)
+
     def decode_step(self, prev_batch, host_tokens: np.ndarray,
                     use_host: np.ndarray, block_tables: np.ndarray,
                     positions: np.ndarray, sampling_lanes):

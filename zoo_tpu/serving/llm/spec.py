@@ -47,7 +47,8 @@ _ENGINE_KEYS = {"slots": "num_slots", "block": "block_size",
                 "prefix_cache": "prefix_cache",
                 "spec_k": "spec_k", "spec_ngram": "spec_ngram"}
 # string-valued engine/model keys (everything in _ENGINE_KEYS is int)
-_STR_KEYS = {"kv": "kv_dtype", "prefill_impl": "prefill_impl"}
+_STR_KEYS = {"kv": "kv_dtype", "prefill_impl": "prefill_impl",
+             "role": "role"}
 
 
 def is_llm_spec(spec) -> bool:
@@ -143,13 +144,15 @@ def build_synthetic_engine(spec: str, mode: Optional[str] = None,
     for short, name in _SYNTH_KEYS.items():
         if short in kvs:
             kwargs[name] = int(kvs.pop(short))
+    role = kvs.pop("role", None) or overrides.pop("role", None)
     if kvs:
         raise ValueError(f"unknown synthllm spec keys {sorted(kvs)}")
     kwargs.update({k: v for k, v in overrides.items()
                    if k not in ("mode", "max_waiting")})
     model = SyntheticLLMModel(**kwargs)
     engine = LLMEngine(model, mode=mode or "continuous",
-                       max_waiting=overrides.get("max_waiting"))
+                       max_waiting=overrides.get("max_waiting"),
+                       role=role)
     return engine.start() if start else engine
 
 
@@ -185,6 +188,9 @@ def build_llm_engine(spec: str, mode: Optional[str] = None,
     spec_ngram = merged.pop("spec_ngram", None)
     if spec_ngram is not None:
         spec_ngram = int(spec_ngram)
+    # role is a SCHEDULER policy (prefill parks / decode adopts), not a
+    # model shape: spec `role=` < ZOO_LLM_ROLE env in the engine
+    role = merged.pop("role", None)
     cfg = LlamaConfig(**cfg_kwargs)
     # tensor-parallel serving: `tp=N` (spec) / ZOO_LLM_TP (env) / a
     # `mesh=` override span ONE model over N local devices instead of
@@ -206,5 +212,5 @@ def build_llm_engine(spec: str, mode: Optional[str] = None,
     engine = LLMEngine(model, mode=mode,
                        max_waiting=overrides.get("max_waiting"),
                        overlap=overlap, prefix_cache=prefix_cache,
-                       spec_ngram=spec_ngram)
+                       spec_ngram=spec_ngram, role=role)
     return engine.start() if start else engine

@@ -100,6 +100,18 @@ _drain_seconds = histogram(
     "zoo_serve_drain_seconds",
     "Graceful-drain wall time (raise the ZOO_SERVE_DRAIN_TIMEOUT_S "
     "budget when this nears it)")
+# disaggregated serving (docs/disaggregated_serving.md): what the
+# prefill replica pays to push a parked sequence's KV to its decode
+# replica, and how many cache bytes crossed the wire doing it
+_migrated_bytes = counter(
+    "zoo_llm_kv_migrated_bytes_total",
+    "KV cache bytes pushed to decode replicas over kv_migrate (int8 "
+    "rows + scale planes; 0 for stateless-decodable models)")
+_handoff_seconds = histogram(
+    "zoo_llm_handoff_seconds",
+    "Prefill-side kv_migrate push wall time (export + begin/block/"
+    "commit round trip), successful pushes only",
+    buckets=(.001, .005, .01, .025, .05, .1, .25, .5, 1, 2.5, 5))
 
 
 def drain_timeout() -> float:
@@ -299,6 +311,10 @@ class ServingServer:
         :func:`zoo_tpu.serving.ha.resolve_model_spec`)."""
         self.model = model
         self.llm_engine = llm_engine
+        # disaggregation role, advertised on every reply frame (like
+        # version) so the HA client learns the pool topology passively;
+        # predict-only replicas have none
+        self.role = getattr(llm_engine, "role", None)
         self.version = version
         self.model_spec = model_spec
         self.model_loader = model_loader
@@ -370,6 +386,11 @@ class ServingServer:
                 # — either by sending one or by stamping ``crc: 1``
                 # into a request; replies then carry the trailer too
                 self._crc = False
+                # kv_migrate staging, PER CONNECTION: begin/block
+                # frames accumulate here and commit hands the engine
+                # the assembled payload — a pusher that dies mid-stream
+                # takes its half-received state down with the socket
+                self._migrate: Dict[str, Dict] = {}
                 # small request/response frames ping-pong on each
                 # connection: Nagle + delayed-ACK interactions add
                 # spurious tail latency under concurrent clients
@@ -417,6 +438,11 @@ class ServingServer:
                     # learns which version each endpoint serves (A/B
                     # routing) without extra probe round-trips
                     out["version"] = outer.version
+                if outer.role is not None:
+                    # disaggregation role on every frame, same passive
+                    # learning: routing needs to know which seats are
+                    # prefill/decode before it can pair a handoff
+                    out["role"] = outer.role
                 out.update(extra)
                 _send_msg(self.request, out, crc=self._crc)
 
@@ -662,7 +688,38 @@ class ServingServer:
                         "expired": True,
                         "error": "deadline expired before admission"})
                     return
+                # disaggregation (docs/disaggregated_serving.md): a
+                # ``handoff: [host, port]`` request asks THIS replica
+                # to prefill only and push the KV to the decode target;
+                # a prefill-role seat sheds everything else retryable
+                # so plain streams land on decode/mixed seats
+                handoff = msg.get("handoff")
+                if eng.role == "prefill" and not handoff:
+                    _requests.labels(outcome="shed").inc()
+                    _shed.labels(reason="role").inc()
+                    self._note_reject(msg, "role")
+                    self._reply(msg, {
+                        "shed": True, "retryable": True,
+                        "error": "role=prefill replica serves handoff "
+                                 "generates only; retry a decode/mixed "
+                                 "replica"})
+                    return
+                if handoff and eng.role == "decode":
+                    # a decode seat never prefills-for-export; run the
+                    # request as a plain local generate instead
+                    handoff = None
                 from zoo_tpu.serving.llm.engine import AdmissionError
+                # adoption: a staged kv_migrate payload under this rid
+                # means the prompt is already prefilled here — decode
+                # starts immediately. A prompt mismatch (id collision)
+                # discards the payload; determinism makes the plain
+                # re-prefill fallback byte-identical either way.
+                adopt = None
+                if rid is not None:
+                    adopt = eng.pop_adopted(rid)
+                    if adopt is not None and adopt.get("prompt") != \
+                            [int(t) for t in msg["prompt"]]:
+                        adopt = None
                 # per-stream sampling params ride the wire; a missing
                 # seed derives from the request id server-side, so a
                 # failover resume (same rid, another replica) replays
@@ -682,7 +739,8 @@ class ServingServer:
                         sampling=sampling or None,
                         spec_k=None if spec_k is None else int(spec_k),
                         trace_id=trace_id,
-                        parent_span=msg.get("pspan"))
+                        parent_span=msg.get("pspan"),
+                        handoff=bool(handoff), adopt=adopt)
                 except AdmissionError as e:
                     _requests.labels(outcome="shed").inc()
                     _shed.labels(reason="queue_full").inc()
@@ -718,6 +776,23 @@ class ServingServer:
                                 seq += 1
                                 continue
                         if done:
+                            if h.outcome == "handoff":
+                                # prefill parked: push the KV payload
+                                # to the decode target BEFORE the
+                                # terminal frame, so the client's
+                                # second leg always finds the staged
+                                # adoption (or learns the push failed
+                                # and re-prefills elsewhere)
+                                migrated = self._push_handoff(
+                                    eng, rid, handoff, deadline, msg)
+                                _requests.labels(outcome="ok").inc()
+                                self._reply(msg, {
+                                    "seq": seq, "done": True,
+                                    "outcome": "handoff",
+                                    "migrated": migrated,
+                                    "tokens": [], "n_tokens": 0})
+                                completed = True
+                                return
                             out = {"seq": seq, "done": True,
                                    "outcome": h.outcome,
                                    "tokens": toks,
@@ -777,6 +852,182 @@ class ServingServer:
                                   sent_tokens=cursor - resume_from,
                                   outcome=h.outcome if completed
                                   else "disconnected")
+
+            def _push_handoff(self, eng, rid, target, deadline, msg):
+                """Prefill side of a disaggregated generate: take the
+                parked payload, export its KV bytes, and stream them
+                to the decode target as ``kv_migrate`` begin/block/
+                commit frames (begin/block unacknowledged; the commit
+                reply says whether the peer staged the adoption). The
+                parked blocks are ALWAYS released before returning —
+                on any failure the client falls back to a plain
+                re-prefill, which determinism makes byte-identical."""
+                t0 = time.perf_counter()
+                payload = eng.take_handoff(rid)
+                if payload is None or not target:
+                    if payload is not None:
+                        eng.release_handoff(rid)
+                    record_event("kv_handoff_abort", rid=rid,
+                                 reason="expired" if payload is None
+                                 else "no_target")
+                    return False
+                ok = False
+                err = None
+                nbytes = 0
+                try:
+                    host, port = str(target[0]), int(target[1])
+                    # the chaos harness arms this seam to stall the
+                    # push so a SIGKILL lands mid-handoff
+                    fault_point("serving.kv_migrate.push", rid=rid,
+                                blocks=len(payload["blocks"]))
+                    exp = getattr(eng.model, "export_kv_blocks", None)
+                    kv = None if exp is None else exp(
+                        payload["blocks"])
+                    sock = socket.create_connection((host, port),
+                                                    timeout=5.0)
+                    try:
+                        try:
+                            sock.setsockopt(socket.IPPROTO_TCP,
+                                            socket.TCP_NODELAY, 1)
+                        except OSError:
+                            pass
+                        crc = outer._wire_crc
+                        begin = {
+                            "op": "kv_migrate", "phase": "begin",
+                            "id": rid, "crc": 1 if crc else 0,
+                            "prompt": payload["prompt"],
+                            "first": payload["first"],
+                            "sampling": payload["sampling"],
+                            "hashes": [h.hex()
+                                       for h in payload["hashes"]],
+                            "max_new": payload["max_new"],
+                            "aux": payload["aux"],
+                            "block_size": payload["block_size"],
+                            "n_blocks": len(payload["blocks"])}
+                        if msg.get("trace") is not None:
+                            begin["trace"] = msg["trace"]
+                        _send_msg(sock, begin, crc=crc)
+                        if kv is not None:
+                            step = max(1, int(knob_value(
+                                "ZOO_KV_MIGRATE_CHUNK_BLOCKS")))
+                            for i in range(0, len(payload["blocks"]),
+                                           step):
+                                part = {name: a[:, i:i + step]
+                                        for name, a in kv.items()}
+                                nbytes += sum(int(a.nbytes)
+                                              for a in part.values())
+                                _send_msg(sock, {
+                                    "op": "kv_migrate",
+                                    "phase": "block", "id": rid,
+                                    "index": i, "kv": part}, crc=crc)
+                        commit = {"op": "kv_migrate",
+                                  "phase": "commit", "id": rid}
+                        if deadline is not None:
+                            # deadline propagation: what is left of
+                            # the request budget bounds the adoption
+                            commit["deadline_ms"] = int(1000 * max(
+                                0.0, deadline.remaining()))
+                        _send_msg(sock, commit, crc=crc)
+                        resp = _recv_msg(sock)
+                        ok = bool(resp and resp.get("ok")
+                                  and resp.get("adopted"))
+                    finally:
+                        sock.close()
+                except (OSError, FrameCorrupt) as e:
+                    err = repr(e)
+                finally:
+                    eng.release_handoff(rid)
+                if ok:
+                    _migrated_bytes.inc(nbytes)
+                    _handoff_seconds.observe(time.perf_counter() - t0)
+                    return True
+                record_event("kv_handoff_abort", rid=rid,
+                             reason=err or "peer_refused")
+                return False
+
+            def _handle_kv_migrate(self, msg):
+                """Decode side of the handoff wire: ``begin`` stages a
+                sequence's metadata on this connection, ``block``
+                frames append its exported KV chunks, ``commit`` hands
+                the assembled payload to the engine (the only
+                acknowledged phase). The allocator is untouched until
+                the matching generate arrives — a pusher that dies
+                after commit leaks nothing here."""
+                eng = outer.llm_engine
+                phase = msg.get("phase")
+                rid = msg.get("id")
+                if eng is None or not rid:
+                    if phase == "commit":
+                        self._reply(msg, {
+                            "ok": False, "adopted": False,
+                            "error": "no llm engine mounted"
+                                     if eng is None else
+                                     "kv_migrate needs an id"})
+                    return
+                if phase == "begin":
+                    self._migrate[rid] = {"msg": msg, "chunks": []}
+                    return
+                st = self._migrate.get(rid)
+                if phase == "block":
+                    if st is not None:
+                        st["chunks"].append(
+                            (int(msg.get("index") or 0),
+                             msg.get("kv") or {}))
+                    return
+                if phase != "commit":
+                    self._reply(msg, {
+                        "ok": False, "adopted": False,
+                        "error": f"unknown kv_migrate phase {phase!r}"})
+                    return
+                st = self._migrate.pop(rid, None)
+                if st is None:
+                    self._reply(msg, {
+                        "ok": False, "adopted": False,
+                        "error": "commit without a begin on this "
+                                 "connection"})
+                    return
+                deadline = Deadline.from_ms(msg.get("deadline_ms"))
+                if deadline is not None and deadline.expired():
+                    _deadline_expired.labels(stage="admission").inc()
+                    self._reply(msg, {"ok": False, "adopted": False,
+                                      "expired": True})
+                    return
+                b = st["msg"]
+                kv = None
+                if st["chunks"]:
+                    st["chunks"].sort(key=lambda t: t[0])
+                    names = sorted(st["chunks"][0][1])
+                    try:
+                        kv = {name: np.concatenate(
+                            [np.asarray(c[1][name])
+                             for c in st["chunks"]], axis=1)
+                            for name in names}
+                    except (KeyError, ValueError) as e:
+                        self._reply(msg, {"ok": False,
+                                          "adopted": False,
+                                          "error": repr(e)})
+                        return
+                try:
+                    payload = {
+                        "rid": rid,
+                        "prompt": [int(t)
+                                   for t in b.get("prompt") or ()],
+                        "first": int(b.get("first") or 0),
+                        "sampling": b.get("sampling"),
+                        "hashes": [bytes.fromhex(h)
+                                   for h in b.get("hashes") or ()],
+                        "block_size": int(b.get("block_size") or 0),
+                        "aux": b.get("aux") or {},
+                        "max_new": int(b.get("max_new") or 0),
+                        "kv": kv,
+                    }
+                except (TypeError, ValueError) as e:
+                    self._reply(msg, {"ok": False, "adopted": False,
+                                      "error": repr(e)})
+                    return
+                adopted = eng.offer_adopted(payload)
+                self._reply(msg, {"ok": True,
+                                  "adopted": bool(adopted)})
 
             def _handle_reload(self, msg):
                 """Wire half of :meth:`ServingServer.reload_model`.
@@ -876,6 +1127,8 @@ class ServingServer:
                         self._handle_predict(msg)
                     elif msg.get("op") == "generate":
                         self._handle_generate(msg)
+                    elif msg.get("op") == "kv_migrate":
+                        self._handle_kv_migrate(msg)
                     elif msg.get("op") == "reload":
                         self._handle_reload(msg)
                     elif msg.get("op") == "version":

@@ -254,11 +254,17 @@ def bench_conv_roofline(extra, batch=128, depth=8, reps=8):
         "1x1_c256_56": (56, 56, 256, 1),
         "1x1_c512_28": (28, 28, 512, 1),
     }
+    from zoo_tpu.ops.pallas import resolve_conv_impl
+
     roof = {}
     for name, (h, w, c, k) in shapes.items():
         p50, spread = chain_tf(h, w, c, k)
         roof[name + "_tflops"] = round(p50, 1)
         roof[name + "_spread"] = round(spread, 3)
+        # which backend the model's conv dispatch point would pick for
+        # this shape on this backend (ops/pallas/conv.py; the roofline
+        # above is the XLA ceiling either impl is judged against)
+        roof[name + "_impl"] = resolve_conv_impl(kernel=(k, k))
     extra["conv_roofline"] = roof
     # FLOP-weighted conv ceiling as an MFU bound: ResNet-50's conv FLOPs
     # split ~45% 3x3 / ~52% 1x1 / ~3% stem (per-layer analytic count);
@@ -272,6 +278,54 @@ def bench_conv_roofline(extra, batch=128, depth=8, reps=8):
                        roof["1x1_c512_28_tflops"]])
         blend = 1.0 / (0.47 / t33 + 0.53 / t11)
         extra["conv_roofline_mfu"] = round(blend * 1e12 / peak, 4)
+
+
+def bench_int8_matmul(extra, m=512, k=1024, n=1024, reps=5):
+    """Fused int8 MXU GEMM (quantize -> int8 dot -> dequant in ONE
+    pallas_call, ``ops/pallas/quant.py``) vs the bf16 XLA matmul at a
+    serving-scale shape. Records which backend ``resolve_int8_matmul``
+    picks and the measured speedup — ``quantize_model(mode="auto")``
+    keeps int8 only when this kind of ratio clears INT8_MIN_SPEEDUP, so
+    the bench row is the fleet-visible record of the decision's raw
+    material (never a silent path choice)."""
+    import jax
+    import jax.numpy as jnp
+
+    from zoo_tpu.ops.pallas import (
+        fused_quantized_matmul,
+        quantize_int8,
+        resolve_int8_matmul,
+    )
+
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(m, k).astype(np.float32))
+    w = jnp.asarray(rs.randn(k, n).astype(np.float32))
+    w_q, w_s = quantize_int8(w, axis=0)
+    extra["int8_matmul_impl"] = resolve_int8_matmul()
+
+    wb = w.astype(jnp.bfloat16)
+    # reduce to a scalar so _sync sees one value and XLA still has to
+    # produce every output element
+    bf16 = jax.jit(
+        lambda a: (a.astype(jnp.bfloat16) @ wb).astype(jnp.float32).sum())
+    fused = jax.jit(lambda a: fused_quantized_matmul(a, w_q, w_s).sum())
+    flops = 2 * m * k * n
+
+    def rate(f):
+        _sync(f(x))
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            _sync(f(x))
+            ts.append(flops / (time.perf_counter() - t0) / 1e12)
+        return _stats(ts)
+
+    b50, bsp = rate(bf16)
+    i50, isp = rate(fused)
+    extra["int8_matmul_bf16_tflops"] = round(b50, 3)
+    extra["int8_matmul_fused_tflops"] = round(i50, 3)
+    extra["int8_matmul_spread"] = round(max(bsp, isp), 3)
+    extra["int8_matmul_speedup"] = round(i50 / b50, 3) if b50 else None
 
 
 def bench_ncf(batch_size=8192, steps_per_epoch=96, epochs=7):
@@ -1820,7 +1874,7 @@ def bench_disagg(extra, live_streams=4, live_tokens=240,
         f"round-robin {rr_rate:.3f}")
 
 
-_BENCH_PR = 17  # bump alongside CHANGES.md when bench semantics move
+_BENCH_PR = 18  # bump alongside CHANGES.md when bench semantics move
 
 
 def _bench_meta():
@@ -1884,6 +1938,10 @@ def main():
             bench_conv_roofline(extra)
         except Exception as e:  # noqa: BLE001
             extra["conv_roofline_error"] = repr(e)
+        try:
+            bench_int8_matmul(extra)
+        except Exception as e:  # noqa: BLE001
+            extra["int8_matmul_error"] = repr(e)
         try:
             bench_serving(extra)
         except Exception as e:  # noqa: BLE001

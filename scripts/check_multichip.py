@@ -40,9 +40,12 @@ N_DEVICES = 8
 LOSS_TOL = 1e-5
 
 
-def _fit_losses(mesh_axes, devices, batch_size=32, seed=0):
+def _fit_losses(mesh_axes, devices, batch_size=32, seed=0, plan=None,
+                body_layers=0):
     """Train the probe model under a fresh orca context; returns
-    (losses, model, placed-params, step-HLO)."""
+    (losses, model, placed-params, step-HLO). ``body_layers`` inserts a
+    homogeneous Dense run (the pipeline plan's stackable body);
+    ``plan`` is forwarded to ``compile``."""
     import numpy as np
 
     from zoo_tpu.orca import init_orca_context, stop_orca_context
@@ -58,8 +61,10 @@ def _fit_losses(mesh_axes, devices, batch_size=32, seed=0):
     try:
         m = Sequential()
         m.add(Dense(16, activation="relu", input_shape=(8,)))
+        for _ in range(body_layers):
+            m.add(Dense(16, activation="relu"))
         m.add(Dense(1))
-        m.compile(optimizer=Adam(lr=0.01), loss="mse")
+        m.compile(optimizer=Adam(lr=0.01), loss="mse", plan=plan)
         losses = m.fit(x, y, batch_size=batch_size, nb_epoch=3,
                        verbose=0)["loss"]
         hlo = m.lower_train_hlo(x, y, batch_size=batch_size)
@@ -105,6 +110,7 @@ def collect_metrics(n_devices: int = N_DEVICES, verbose: bool = True
         assert_collectives,
         assert_fsdp_sharded,
         assert_llm_executable,
+        assert_pipeline_sharded,
         assert_plan_sharded,
     )
     from zoo_tpu.parallel.plans import plan_lint_shapes
@@ -235,6 +241,78 @@ def collect_metrics(n_devices: int = N_DEVICES, verbose: bool = True
         ref_eng.stop()
         tp_eng.stop()
 
+    # 5. pipeline plan: GPipe microbatch schedule == plain dp ------------
+    # same model/seed/data with a 4-layer homogeneous body trained once
+    # without a plan (per-layer scan) and once under plan="pipeline" on
+    # a data x pipe mesh; the loss curves must agree (on XLA CPU they
+    # are bit-exact), the stacked body must ACTUALLY shard over the pipe
+    # axis (~1/stages of its bytes per device), and the compiled step
+    # must carry collective-permute — the "pipeline that isn't" lint
+    from zoo_tpu.parallel.plans import PIPE_BODY_KEY
+
+    pipe = 4 if n_devices % 4 == 0 else 2
+    ref_p, _, _, _ = _fit_losses(None, devices[:1], batch_size=bs,
+                                 body_layers=pipe)
+    pshd, pmodel, pplaced, phlo = _fit_losses(
+        {"data": n_devices // pipe, "pipe": pipe}, devices,
+        batch_size=bs, plan="pipeline", body_layers=pipe)
+    pdiff = max(abs(a - b) for a, b in zip(ref_p, pshd))
+    m["pipeline_loss_max_abs_diff"] = pdiff
+    assert pdiff <= LOSS_TOL, (
+        f"pipeline loss curve diverged from dp by {pdiff} "
+        f"(> {LOSS_TOL}): {pshd} vs {ref_p}")
+    body_frac = _tree_bytes_frac(pplaced[PIPE_BODY_KEY])
+    m["pipeline_body_bytes_frac"] = round(body_frac, 4)
+    assert body_frac <= 1.0 / pipe + 0.05, (
+        f"per-device stacked-body bytes {body_frac:.3f} of replicated — "
+        "the body is not actually pipe-sharded")
+    mesh_p = build_mesh(devices, axis_sizes={"data": n_devices // pipe,
+                                             "pipe": pipe})
+    psh, prep, ploc = plan_lint_shapes(pmodel.params, mesh_p, "pipeline")
+    assert_pipeline_sharded(phlo, psh, prep, local_shapes=ploc,
+                            label="pipeline train step")
+    m["pipeline_collectives"] = assert_collectives(
+        phlo, require=["collective-permute"],
+        label="pipeline train step")
+    m["pipeline_hlo_lint"] = "pass"
+
+    # 6. moe plan: expert-sharded FFN == replicated reference ------------
+    from zoo_tpu.ops.moe import init_moe_params, moe_ffn
+    from zoo_tpu.parallel.plans import place_params
+
+    mesh_e = build_mesh(devices, axis_sizes={"expert": n_devices})
+    mp = init_moe_params(jax.random.PRNGKey(0), hidden=16,
+                         intermediate=32, n_experts=n_devices)
+    xt = np.asarray(np.random.RandomState(1).randn(2, 64, 16),
+                    np.float32)
+    moe_step = jax.jit(lambda p, t: moe_ffn(p, t, top_k=2,
+                                            capacity_factor=1.25))
+    y_ref, aux_ref = jax.tree_util.tree_map(
+        np.asarray, moe_step(mp, xt))
+    eplaced = place_params(mp, mesh_e, "moe")
+    y_sh, aux_sh = jax.tree_util.tree_map(
+        np.asarray, moe_step(eplaced, xt))
+    mdiff = max(float(np.abs(y_ref - y_sh).max()),
+                float(np.abs(aux_ref - aux_sh).max()))
+    m["moe_out_max_abs_diff"] = mdiff
+    assert mdiff <= LOSS_TOL, (
+        f"expert-sharded moe_ffn diverged from replicated by {mdiff}")
+    efrac = _tree_bytes_frac(
+        {k: eplaced[k] for k in ("w_gate", "w_up", "w_down")})
+    m["moe_expert_bytes_frac"] = round(efrac, 4)
+    assert efrac <= 1.0 / n_devices + 0.05, (
+        f"per-device expert-weight bytes {efrac:.3f} of replicated — "
+        "experts are not actually sharded")
+    moe_compiled = jax.jit(
+        lambda p, t: moe_ffn(p, t, top_k=2, capacity_factor=1.25)
+    ).lower(eplaced, xt).compile()
+    m["moe_collectives"] = assert_collectives(
+        moe_compiled,
+        require_any=["all-to-all", "all-gather", "all-reduce",
+                     "reduce-scatter", "collective-permute"],
+        label="moe ffn")
+    m["moe_hlo_lint"] = "pass"
+
     if verbose:
         print("ok: sharded fit matches 1-device within "
               f"{LOSS_TOL} (diff {diff:.3g}), per-device param bytes "
@@ -243,6 +321,11 @@ def collect_metrics(n_devices: int = N_DEVICES, verbose: bool = True
         print("ok: save@8 -> restore@4/restore@1 bit-exact")
         print("ok: tp=2 paged decode token-identical, decode "
               "compiles == 1, 0 leaked KV blocks")
+        print(f"ok: pipeline plan matches dp (diff {pdiff:.3g}), body "
+              f"bytes {body_frac:.3f} of replicated, collective-permute "
+              "present")
+        print(f"ok: moe plan matches replicated (diff {mdiff:.3g}), "
+              f"expert bytes {efrac:.3f} of replicated")
     return m
 
 

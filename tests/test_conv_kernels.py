@@ -1,0 +1,105 @@
+"""Implicit-GEMM Pallas conv vs ``lax.conv_general_dilated`` (interpret
+mode on the hermetic CPU rig — the same kernels compile via Mosaic on
+TPU) plus the ``resolve_conv_impl`` dispatch contract (docs/kernels.md).
+
+The 1x1 path is a pure strided GEMM and the int8 path dequantizes on
+the same integer values as the reference, so both are exactly equal;
+the 3x3 f32 path differs only by summation order."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from zoo_tpu.ops.pallas import conv2d, conv2d_int8, resolve_conv_impl
+from zoo_tpu.ops.pallas.conv import pallas_conv_supported
+from zoo_tpu.ops.pallas.quant import quantize_conv_weights, quantized_conv2d
+
+
+def _xw(h=8, w=8, c=8, k=3, o=24, n=2, seed=0):
+    rs = np.random.RandomState(seed)
+    x = jnp.asarray(rs.randn(n, h, w, c), jnp.float32)
+    wts = jnp.asarray(rs.randn(k, k, c, o), jnp.float32)
+    return x, wts
+
+
+@pytest.mark.parametrize("h,w,c,k,stride,padding", [
+    (8, 8, 8, 1, 1, "SAME"),
+    (8, 8, 8, 1, 2, "SAME"),
+    (9, 9, 16, 1, 2, "VALID"),
+    (8, 8, 8, 3, 1, "SAME"),
+    (8, 8, 16, 3, 1, "VALID"),
+    (7, 7, 130, 3, 1, "SAME"),     # channels past one lane tile
+])
+def test_conv2d_pallas_matches_lax(h, w, c, k, stride, padding):
+    x, wts = _xw(h, w, c, k)
+    out = conv2d(x, wts, strides=(stride, stride), padding=padding,
+                 impl="pallas")
+    ref = conv2d(x, wts, strides=(stride, stride), padding=padding,
+                 impl="reference")
+    assert out.shape == ref.shape
+    # f32 sum-order differs (register accumulation vs XLA's schedule);
+    # error grows with the 9*C reduction length, ~5e-5 at C=130
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=2e-4)
+
+
+@pytest.mark.parametrize("k,stride,padding", [
+    (1, 1, "SAME"), (1, 2, "VALID"), (3, 1, "SAME"), (3, 1, "VALID"),
+])
+def test_conv2d_int8_pallas_matches_reference_exactly(k, stride, padding):
+    """Same quantized integers in, same dequant math out: the int8
+    Pallas conv and the XLA reference agree bit for bit off-TPU."""
+    x, wts = _xw(k=k)
+    w_q, w_scale = quantize_conv_weights(wts)
+    amax = jnp.max(jnp.abs(x), axis=(1, 2, 3), keepdims=True)
+    x_scale = jnp.where(amax == 0, 1.0, amax / 127.0)
+    x_q = jnp.clip(jnp.round(x / x_scale), -127, 127)
+    out = conv2d_int8(x_q, w_q, x_scale, w_scale.astype(jnp.float32),
+                      strides=(stride, stride), padding=padding,
+                      impl="pallas")
+    ref = conv2d_int8(x_q, w_q, x_scale, w_scale.astype(jnp.float32),
+                      strides=(stride, stride), padding=padding,
+                      impl="reference")
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_quantized_conv2d_impl_agnostic():
+    """The quantize_model serving path (quantized_conv2d) produces the
+    same activations whichever backend the dispatch picks."""
+    x, wts = _xw(k=3)
+    w_q, w_scale = quantize_conv_weights(wts)
+    y_p = quantized_conv2d(x, w_q, w_scale, impl="pallas")
+    y_r = quantized_conv2d(x, w_q, w_scale, impl="reference")
+    np.testing.assert_array_equal(np.asarray(y_p), np.asarray(y_r))
+    # and the int8 conv tracks the float conv to quantization noise
+    ref = conv2d(x, wts, impl="reference")
+    rel = (np.abs(np.asarray(y_p - ref)).mean()
+           / np.abs(np.asarray(ref)).mean())
+    assert rel < 0.03, rel
+
+
+def test_pallas_conv_supported_matrix():
+    assert pallas_conv_supported((1, 1), (1, 1), (1, 1))
+    assert pallas_conv_supported((1, 1), (2, 2), (1, 1))
+    assert pallas_conv_supported((3, 3), (1, 1), (1, 1))
+    assert not pallas_conv_supported((3, 3), (2, 2), (1, 1))
+    assert not pallas_conv_supported((5, 5), (1, 1), (1, 1))
+    assert not pallas_conv_supported((3, 3), (1, 1), (2, 2))
+
+
+def test_resolve_conv_impl_dispatch(monkeypatch):
+    # auto off-TPU -> the XLA reference (bit-identical, no interpret tax)
+    assert resolve_conv_impl(kernel=(3, 3)) == "reference"
+    # env knob overrides auto at the single dispatch point
+    monkeypatch.setenv("ZOO_CONV_IMPL", "pallas")
+    assert resolve_conv_impl(kernel=(3, 3)) == "pallas"
+    monkeypatch.setenv("ZOO_CONV_IMPL", "reference")
+    assert resolve_conv_impl(kernel=(1, 1)) == "reference"
+    monkeypatch.delenv("ZOO_CONV_IMPL")
+    # a pallas request on an unsupported shape fails loudly, never
+    # silently falls back
+    with pytest.raises(ValueError, match="envelope"):
+        resolve_conv_impl("pallas", kernel=(5, 5))
+    with pytest.raises(ValueError):
+        resolve_conv_impl("no-such-impl", kernel=(1, 1))

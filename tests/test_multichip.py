@@ -4,7 +4,10 @@ subprocess with the 8-device virtual CPU platform forced
 (``XLA_FLAGS=--xla_force_host_platform_device_count=8``) — sharded fit
 matches the single-device loss curve, save@8 -> restore@4 -> restore@1
 is bit-exact, sharded paged decode is token-identical to the unsharded
-reference, and the FSDP HLO lint passes."""
+reference, the FSDP HLO lint passes, the pipeline plan's GPipe
+schedule matches plain dp with the stacked body pipe-sharded and
+collective-permute in the compiled step, and the moe plan's
+expert-sharded FFN matches the replicated reference."""
 
 import json
 import os
@@ -15,12 +18,12 @@ import pytest
 
 
 @pytest.mark.multichip
-@pytest.mark.timeout(300)
+@pytest.mark.timeout(480)
 def test_check_multichip_script_runs():
     r = subprocess.run(
         [sys.executable,
          os.path.join("scripts", "check_multichip.py")],
-        capture_output=True, text=True, timeout=290, cwd=os.getcwd())
+        capture_output=True, text=True, timeout=470, cwd=os.getcwd())
     assert r.returncode == 0, r.stdout + r.stderr
     line = [ln for ln in r.stdout.splitlines()
             if ln.startswith("MULTICHIP_METRICS ")]
@@ -40,3 +43,13 @@ def test_check_multichip_script_runs():
     # the plan-aware compiled-artifact lints (zoo-lint HLO passes)
     assert m["tp_hlo_lint"] == "pass"
     assert m["llm_decode_artifact_lint"] == "pass"
+    # pipeline plan: GPipe schedule == dp, body really pipe-sharded,
+    # collective-permute present (the "pipeline that isn't" lint)
+    assert m["pipeline_loss_max_abs_diff"] <= 1e-5
+    assert m["pipeline_body_bytes_frac"] <= 0.25 + 0.05
+    assert m["pipeline_collectives"].get("collective-permute", 0) > 0
+    assert m["pipeline_hlo_lint"] == "pass"
+    # moe plan: expert-sharded FFN == replicated reference
+    assert m["moe_out_max_abs_diff"] <= 1e-5
+    assert m["moe_expert_bytes_frac"] <= 1.0 / m["n_devices"] + 0.05
+    assert m["moe_hlo_lint"] == "pass"

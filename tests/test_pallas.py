@@ -266,3 +266,60 @@ def test_fused_bottleneck_custom_vjp_matches_xla_grads():
     for a, b in zip(g1, g2):
         scale = float(jnp.max(jnp.abs(b)))
         assert float(jnp.max(jnp.abs(a - b))) < 0.01 * scale + 0.05
+
+
+def test_fused_quantized_matmul_matches_two_pass():
+    """The fused quantize->int8-dot->dequant kernel reproduces the
+    two-pass reference (quantize_int8 + quantized_matmul) up to
+    borderline activation rounding: XLA rewrites x/scale as
+    x * (1/scale), which can flip a round() by one int8 step, so the
+    bound is one dequantized ULP — not bit-exactness."""
+    from zoo_tpu.ops.pallas import fused_quantized_matmul
+
+    rs = np.random.RandomState(3)
+    x = jnp.asarray(rs.randn(24, 96), jnp.float32)
+    w = jnp.asarray(rs.randn(96, 40), jnp.float32)
+    w_q, w_s = quantize_int8(w, axis=0)
+    x_q, x_s = quantize_int8(x, axis=-1)
+    ref = quantized_matmul(x_q, w_q, x_s, w_s, block_m=32, block_n=32,
+                          block_k=32)
+    got = fused_quantized_matmul(x, w_q, w_s, block_m=32, block_n=32,
+                                 block_k=32)
+    assert got.dtype == x.dtype
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-4, rtol=0)
+    # and it tracks the f32 matmul to quantization noise
+    rel = (np.abs(np.asarray(got) - np.asarray(x @ w)).mean()
+           / np.abs(np.asarray(x @ w)).mean())
+    assert rel < 0.02, rel
+
+
+def test_fused_quantized_dense_paths_agree():
+    """quantized_dense(impl=...) is the one int8 GEMM dispatch point:
+    fused and unfused backends agree (1-ULP rounding tolerance) with
+    bias and leading batch dims."""
+    from zoo_tpu.ops.pallas import quantized_dense as qd
+
+    rs = np.random.RandomState(4)
+    x = jnp.asarray(rs.randn(4, 6, 64), jnp.float32)
+    w = jnp.asarray(rs.randn(64, 32), jnp.float32)
+    b = jnp.asarray(rs.randn(32), jnp.float32)
+    w_q, w_s = quantize_int8(w, axis=0)
+    y_f = qd(x, w_q, w_s, bias=b, impl="fused")
+    y_u = qd(x, w_q, w_s, bias=b, impl="unfused")
+    assert y_f.shape == y_u.shape == (4, 6, 32)
+    np.testing.assert_allclose(np.asarray(y_f), np.asarray(y_u),
+                               atol=1e-4, rtol=0)
+
+
+def test_resolve_int8_matmul_dispatch(monkeypatch):
+    from zoo_tpu.ops.pallas import resolve_int8_matmul
+
+    assert resolve_int8_matmul() == "fused"          # auto default
+    assert resolve_int8_matmul("unfused") == "unfused"
+    monkeypatch.setenv("ZOO_INT8_MATMUL", "unfused")
+    assert resolve_int8_matmul() == "unfused"
+    assert resolve_int8_matmul("fused") == "fused"   # arg beats env
+    monkeypatch.delenv("ZOO_INT8_MATMUL")
+    with pytest.raises(ValueError):
+        resolve_int8_matmul("no-such-impl")

@@ -23,6 +23,13 @@ leans on:
   and megatron/tp serving executables must not carry them in entry
   *parameters* either ("TP that isn't": every device holds the whole
   model and the per-device-bytes win silently evaporates).
+* ``HLO-PIPELINE`` — a pipeline-plan train step must actually
+  pipeline: the stage-stacked body must not enter at full shape on
+  every device (that is the HLO-SHARDING parameter rule applied to
+  the stacked shapes) AND the compiled text must contain
+  ``collective-permute`` — without the microbatch ring hand-off the
+  "pipeline" is a replicated layer scan that silently pays full-model
+  memory on every device ("pipeline that isn't").
 
 All checks are pure text parsers over ``compiled.as_text()`` plus
 raising ``assert_*`` wrappers (for in-test use) and Finding-returning
@@ -44,6 +51,7 @@ __all__ = [
     "input_output_aliases", "donation_findings", "assert_donated",
     "entry_layout", "host_transfer_findings", "assert_host_transfer",
     "sharding_findings", "assert_plan_sharded",
+    "pipeline_findings", "assert_pipeline_sharded",
 ]
 
 
@@ -402,6 +410,47 @@ def assert_fsdp_sharded(compiled, sharded_shapes,
     assert_plan_sharded(compiled, sharded_shapes, replicated_shapes,
                         local_shapes=local_shapes, plan="fsdp",
                         label=label)
+
+
+def pipeline_findings(compiled, stage_shapes, replicated_shapes=(), *,
+                      local_shapes=(),
+                      label: str = "pipeline step") -> List[Finding]:
+    """The "pipeline that isn't" contract, two failure modes:
+
+    * the stage-stacked body enters (or leaves) the step at its FULL
+      global shape on every device — the HLO-SHARDING parameter rule
+      applied to ``stage_shapes`` (the stacked body's global shapes;
+      ``local_shapes`` are the per-stage shard shapes the partitioned
+      module legitimately carries);
+    * no ``collective-permute`` anywhere in the compiled text — no
+      microbatch ever crossed a stage boundary, so the "pipeline" is a
+      replicated layer scan (``HLO-PIPELINE``).
+    """
+    findings = sharding_findings(
+        compiled, stage_shapes, replicated_shapes,
+        local_shapes=local_shapes, check_params=True,
+        check_outputs=True, label=label)
+    counts = collective_counts(_text_of(compiled))
+    if not counts.get("collective-permute"):
+        findings.append(Finding(
+            "HLO-PIPELINE", label, 0,
+            "no collective-permute in the compiled step: stage "
+            "hand-offs never happen, so the pipeline plan degenerated "
+            "to a replicated layer scan (\"pipeline that isn't\")",
+            "shard the stacked body over the pipe axis and run the "
+            "microbatch schedule (pipeline_apply) in the forward",
+            detail="ppermute"))
+    return findings
+
+
+def assert_pipeline_sharded(compiled, stage_shapes,
+                            replicated_shapes=(), *, local_shapes=(),
+                            label: str = "pipeline step") -> None:
+    """Raising form of :func:`pipeline_findings`."""
+    fs = pipeline_findings(compiled, stage_shapes, replicated_shapes,
+                           local_shapes=local_shapes, label=label)
+    if fs:
+        raise CollectiveError(fs[0].message + f" ({label})")
 
 
 # -- LLM executable wiring --------------------------------------------------

@@ -490,6 +490,16 @@ _k("ZOO_FUSED_OPTIM", "bool", False,
    "AdamW takes the fused direct-apply path", _MC)
 _k("ZOO_LLM_TP", "int", 1,
    "tensor-parallel ways for `llama:*` serving specs", _MC)
+_k("ZOO_PLAN", "str", "auto",
+   "default sharding plan for `compile()` when no `plan=` is passed "
+   "(`auto`, or a registered plan: `transformer`, `pipeline`, `moe`, "
+   "...)", _MC)
+_k("ZOO_PIPE_MICROBATCHES", "int", 0,
+   "GPipe microbatch count for the `pipeline` plan (`0` = one per "
+   "pipeline stage)", _MC, show="0 (pipe size)")
+_k("ZOO_MOE_CAPACITY", "float", 1.25,
+   "default expert capacity factor for MoE dispatch "
+   "(`ops/moe.py`; capacity = factor * tokens / experts)", _MC)
 
 # -- serving misc (docs/serving.md / docs/orca.md prose) --------------------
 _k("ZOO_MODEL_SECRET", "str", None,
@@ -516,6 +526,14 @@ _k("ZOO_NUM_CORES", "int", None,
 _k("ZOO_PALLAS_FORCE_INTERPRET", "bool", False,
    "run every Pallas kernel under the interpreter (CPU correctness "
    "tests of TPU kernels)", "docs/parallelism.md")
+_KN = "docs/kernels.md"
+_k("ZOO_CONV_IMPL", "str", "auto",
+   "conv2d backend: `auto` (implicit-GEMM Pallas kernel on TPU for "
+   "supported shapes, XLA reference elsewhere), `pallas`, `reference`",
+   _KN)
+_k("ZOO_INT8_MATMUL", "str", "auto",
+   "int8 GEMM backend: `auto`/`fused` (one-kernel quantize+dot+"
+   "dequant), `unfused` (XLA quantize pass + dequant matmul)", _KN)
 
 # -- internal coordination (set by the platform itself, not operators) ------
 _k("ZOO_PROCESS_ID", "int", None, internal=True,

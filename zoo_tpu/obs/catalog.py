@@ -79,6 +79,7 @@ METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "zoo_serve_reload_total": ("counter", ("outcome",)),
     "zoo_serve_drain_seconds": ("histogram", ()),
     "zoo_registry_version_info": ("gauge", ("version",)),
+    "zoo_quant_path_info": ("gauge", ("path", "speedup")),
     # -- serving HA (replica group / client) -------------------------------
     "zoo_serve_replicas_healthy": ("gauge", ()),
     "zoo_serve_replica_restarts": ("gauge", ()),

@@ -59,7 +59,7 @@ def moe_param_specs(n_experts: int) -> Dict[str, Tuple]:
 
 
 def moe_ffn(params: Dict, x: jnp.ndarray, *, top_k: int = 2,
-            capacity_factor: float = 1.25,
+            capacity_factor: Optional[float] = None,
             aux_loss_weight: float = 0.01, group_size: int = 512
             ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """MoE SwiGLU feed-forward over tokens.
@@ -68,6 +68,7 @@ def moe_ffn(params: Dict, x: jnp.ndarray, *, top_k: int = 2,
     Switch-style load-balancing term (already weighted); add it to the
     task loss. Tokens routed past an expert's capacity are dropped
     (standard GShard semantics — the residual connection carries them).
+    ``capacity_factor=None`` reads ``ZOO_MOE_CAPACITY`` (default 1.25).
 
     Tokens are routed within fixed ``group_size`` GROUPS (GShard's 2-D
     dispatch): the dispatch/combine tensors are (g, G, E, C_g) with
@@ -75,6 +76,9 @@ def moe_ffn(params: Dict, x: jnp.ndarray, *, top_k: int = 2,
     dispatch would be O(N²) and OOM at real sequence lengths. Capacity
     (and therefore dropping) is per-group.
     """
+    if capacity_factor is None:
+        from zoo_tpu.common import knobs
+        capacity_factor = float(knobs.value("ZOO_MOE_CAPACITY"))
     B, T, H = x.shape
     E = params["router"].shape[1]
     N = B * T

@@ -72,14 +72,19 @@ from zoo_tpu.ops.pallas.paged_decode import paged_flash_decode  # noqa: E402
 from zoo_tpu.ops.pallas.paged_prefill import paged_flash_prefill  # noqa: E402
 from zoo_tpu.ops.pallas.quant import (  # noqa: E402
     quantize_int8, quantized_matmul, quantized_dense,
+    fused_quantized_matmul, resolve_int8_matmul,
     quantize_conv_weights, quantized_conv2d)
+from zoo_tpu.ops.pallas.conv import (  # noqa: E402
+    conv2d, conv2d_int8, resolve_conv_impl)
 from zoo_tpu.ops.pallas.fused_optim import (  # noqa: E402
     fused_apply_sgd, fused_apply_adam)
 from zoo_tpu.ops.pallas.fused_block import fused_bottleneck  # noqa: E402
 
 __all__ = ["flash_attention", "paged_flash_decode",
            "paged_flash_prefill", "quantize_int8",
-           "quantized_matmul",
+           "quantized_matmul", "fused_quantized_matmul",
+           "resolve_int8_matmul",
            "quantized_dense", "quantize_conv_weights", "quantized_conv2d",
+           "conv2d", "conv2d_int8", "resolve_conv_impl",
            "fused_apply_sgd", "fused_apply_adam", "fused_bottleneck",
            "on_tpu", "resolve_interpret"]

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from zoo_tpu.common import knobs
 from zoo_tpu.ops.pallas import LANES as _LANES
 from zoo_tpu.ops.pallas import SUBLANES as _SUBLANES
 from zoo_tpu.ops.pallas import pad_dim as _pad_dim
@@ -111,17 +112,152 @@ def quantized_matmul(x_q: jnp.ndarray, w_q: jnp.ndarray,
     return out[:m, :n]
 
 
+# Past this K extent the fused kernel's VMEM-resident activation row
+# block (f32 copy + int8 copy per 128-row block, ~5 bytes/element) would
+# crowd out the weight/accumulator tiles; the two-pass path takes over.
+# Also keeps the int32 accumulator exact: 127*127*8192 ≈ 1.3e8 << 2^31.
+_FUSED_MAX_K = 8192
+
+
+def _fqmm_kernel(x_ref, w_ref, ws_ref, o_ref, acc_scr, xq_scr, xs_scr,
+                 *, num_k, block_k):
+    """Fused quantize→int8-MXU-dot→dequant. The float activation row
+    block rides in VMEM across the whole (j, k) inner grid (constant
+    index map); on first touch of a row block it is quantized ONCE into
+    int8/scale scratch, every k step then feeds the MXU from scratch,
+    and the epilogue applies per-row × per-column scales in-register —
+    the paged-kernel in-register dequant idiom applied to the GEMM."""
+    ki = pl.program_id(2)
+
+    @pl.when((pl.program_id(1) == 0) & (ki == 0))
+    def _quantize():
+        # Per-row dynamic symmetric quantization over the FULL K extent
+        # (grid pads K with zeros, which never move a row's absmax).
+        xf = x_ref[...].astype(jnp.float32)
+        amax = jnp.max(jnp.abs(xf), axis=1, keepdims=True)
+        scale = jnp.where(amax == 0, 1.0, amax / 127.0).astype(
+            jnp.float32)
+        xs_scr[...] = jnp.broadcast_to(scale, xs_scr.shape)
+        xq_scr[...] = jnp.clip(jnp.round(xf / scale), -127, 127
+                               ).astype(jnp.int8)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    acc_scr[...] += jax.lax.dot_general(
+        xq_scr[:, pl.ds(ki * block_k, block_k)], w_ref[...],
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32)
+
+    @pl.when(ki == num_k - 1)
+    def _finish():
+        xs = xs_scr[:, :1]          # (bm, 1) per-row activation scale
+        ws = ws_ref[:1, :]          # (1, bn) per-column weight scale
+        o_ref[...] = (acc_scr[...].astype(jnp.float32) * xs * ws
+                      ).astype(o_ref.dtype)
+
+
+def fused_quantized_matmul(x: jnp.ndarray, w_q: jnp.ndarray,
+                           w_scale: jnp.ndarray,
+                           out_dtype=None,
+                           block_m: int = 128, block_n: int = 128,
+                           block_k: int = 128,
+                           interpret: Optional[bool] = None
+                           ) -> jnp.ndarray:
+    """(M,K)float @ (K,N)int8 → (M,N) in ONE ``pallas_call``: per-row
+    activation quantization, int8×int8→int32 MXU K-loop, and the
+    per-row×per-channel dequant epilogue all fused — no separate XLA
+    quantize pass materializing an int8 activation copy in HBM.
+
+    Matches the two-pass reference ``quantize_int8(x, -1)`` +
+    :func:`quantized_matmul` exactly up to borderline activation
+    rounding (XLA may rewrite ``x / scale`` as ``x * (1/scale)``,
+    flipping ties by one int8 step; the int32 accumulation and f32
+    epilogue are otherwise identical — measured max diff is one
+    dequantized ULP). Falls back to the two-pass path when K exceeds
+    ``_FUSED_MAX_K`` (the activation row block must fit VMEM)."""
+    m, k = x.shape
+    k2, n = w_q.shape
+    assert k == k2, (x.shape, w_q.shape)
+    out_dtype = out_dtype or x.dtype
+    if k > _FUSED_MAX_K:
+        x_q, x_scale = quantize_int8(x, axis=-1)
+        return quantized_matmul(
+            x_q, w_q, x_scale, w_scale, out_dtype=out_dtype,
+            block_m=block_m, block_n=block_n, block_k=block_k,
+            interpret=interpret).astype(out_dtype)
+    interpret = _resolve_interpret(interpret)
+
+    w_scale = w_scale.reshape(n).astype(jnp.float32)
+    xp = _pad_dim(_pad_dim(x, 0, block_m), 1, block_k)
+    wp = _pad_dim(_pad_dim(w_q, 0, block_k), 1, block_n)
+    mp, kp = xp.shape
+    np_ = wp.shape[1]
+    ws = jnp.broadcast_to(_pad_dim(w_scale, 0, block_n)[None, :],
+                          (_SUBLANES, np_))
+
+    num_k = kp // block_k
+    out = pl.pallas_call(
+        functools.partial(_fqmm_kernel, num_k=num_k, block_k=block_k),
+        grid=(mp // block_m, np_ // block_n, num_k),
+        in_specs=[
+            # full-K activation row block; constant in (j, k) so it
+            # stays VMEM-resident while its quantization is reused
+            pl.BlockSpec((block_m, kp), lambda i, j, kk: (i, 0)),
+            pl.BlockSpec((block_k, block_n), lambda i, j, kk: (kk, j)),
+            pl.BlockSpec((_SUBLANES, block_n), lambda i, j, kk: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((block_m, block_n),
+                               lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((mp, np_), out_dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_m, block_n), jnp.int32),
+            pltpu.VMEM((block_m, kp), jnp.int8),
+            pltpu.VMEM((block_m, _LANES), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * mp * np_ * kp,
+            bytes_accessed=mp * kp * x.dtype.itemsize + kp * np_
+            + mp * np_ * 4,
+            transcendentals=0),
+        interpret=interpret,
+    )(xp, wp, ws)
+    return out[:m, :n]
+
+
+def resolve_int8_matmul(impl: Optional[str] = None) -> str:
+    """The one int8-GEMM dispatch rule: ``"fused"`` (one-pallas_call
+    quantize+dot+dequant) or ``"unfused"`` (XLA quantize pass +
+    :func:`quantized_matmul`). ``impl=None`` reads ``ZOO_INT8_MATMUL``
+    (``auto`` → fused)."""
+    impl = impl or knobs.value("ZOO_INT8_MATMUL")
+    if impl == "auto":
+        return "fused"
+    if impl not in ("fused", "unfused"):
+        raise ValueError(f"unknown int8 matmul impl {impl!r} "
+                         "(expected auto|fused|unfused)")
+    return impl
+
+
 def quantized_dense(x: jnp.ndarray, w_q: jnp.ndarray,
                     w_scale: jnp.ndarray,
                     bias: Optional[jnp.ndarray] = None,
+                    impl: Optional[str] = None,
                     interpret: Optional[bool] = None) -> jnp.ndarray:
     """f32/bf16 activations × int8 weights: dynamic per-row activation
     quantization + int8 MXU matmul. The InferenceModel int8 path calls
-    this for Dense layers after ``quantize()``."""
+    this for Dense layers after ``quantize()``. Backend selected by
+    :func:`resolve_int8_matmul` (default: the fused single-kernel
+    path)."""
     x2 = x.reshape(-1, x.shape[-1])
-    x_q, x_scale = quantize_int8(x2, axis=-1)
-    y = quantized_matmul(x_q, w_q, x_scale, w_scale,
-                         out_dtype=x.dtype, interpret=interpret)
+    if resolve_int8_matmul(impl) == "fused":
+        y = fused_quantized_matmul(x2, w_q, w_scale,
+                                   out_dtype=x.dtype,
+                                   interpret=interpret)
+    else:
+        x_q, x_scale = quantize_int8(x2, axis=-1)
+        y = quantized_matmul(x_q, w_q, x_scale, w_scale,
+                             out_dtype=x.dtype, interpret=interpret)
     if bias is not None:
         y = y + bias
     return y.reshape(*x.shape[:-1], w_q.shape[1])
@@ -137,31 +273,33 @@ def quantize_conv_weights(w: jnp.ndarray):
 def quantized_conv2d(x: jnp.ndarray, w_q: jnp.ndarray,
                      w_scale: jnp.ndarray, strides=(1, 1),
                      padding: str = "SAME",
-                     bias: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                     bias: Optional[jnp.ndarray] = None,
+                     impl: Optional[str] = None,
+                     interpret: Optional[bool] = None) -> jnp.ndarray:
     """f32/bf16 NHWC activations × int8 HWIO weights: per-image dynamic
     activation quantization + int8 conv with int32 accumulation, dequant
     fused into the epilogue. Extends the int8 inference story from Dense
     to conv nets — the reference's headline int8 use (SSD/VGG inference,
     ``wp-bigdl.md:192-196``).
 
-    Off-TPU the integer conv runs in f32 on the SAME quantized integer
-    values (bit-identical inputs; only the accumulator differs), so the
-    CPU test mesh exercises the true quantization error."""
+    The integer conv itself routes through the one conv dispatch point
+    (:func:`zoo_tpu.ops.pallas.conv.resolve_conv_impl`): the implicit-
+    GEMM Pallas kernel on supported shapes on TPU, the XLA reference
+    conv otherwise — so int8 and conv-impl selection compose instead of
+    bypassing each other. Off-TPU the reference runs in f32 on the SAME
+    quantized integer values (bit-identical inputs; only the
+    accumulator differs), so the CPU test mesh exercises the true
+    quantization error."""
+    from zoo_tpu.ops.pallas.conv import conv2d_int8
+
     amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=(1, 2, 3),
                    keepdims=True)
     x_scale = jnp.where(amax == 0, 1.0, amax / 127.0)
     x_q = jnp.clip(jnp.round(x.astype(jnp.float32) / x_scale),
                    -127, 127)
-    if jax.default_backend() == "tpu":
-        y = jax.lax.conv_general_dilated(
-            x_q.astype(jnp.int8), w_q, tuple(strides), padding,
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            preferred_element_type=jnp.int32).astype(jnp.float32)
-    else:
-        y = jax.lax.conv_general_dilated(
-            x_q, w_q.astype(jnp.float32), tuple(strides), padding,
-            dimension_numbers=("NHWC", "HWIO", "NHWC"))
-    y = y * x_scale * w_scale.astype(jnp.float32)
+    y = conv2d_int8(x_q, w_q, x_scale, w_scale.astype(jnp.float32),
+                    strides=tuple(strides), padding=padding,
+                    impl=impl, interpret=interpret)
     if bias is not None:
         y = y + bias.astype(jnp.float32)
     return y.astype(x.dtype)

@@ -99,6 +99,16 @@ class TrainingDiverged(RuntimeError):
     the loss is still not trainable."""
 
 
+class EpochRolledBack(RuntimeError):
+    """A mid-epoch guard rollback wiped every step of the epoch: the
+    restored state has made no progress and there is no honest loss to
+    report. The Estimator's retry perimeter treats this like any other
+    recoverable failure — restore the latest verified checkpoint and
+    retrain the lost epoch (the epoch counter did not advance) —
+    while bare ``model.fit`` callers see a loud typed failure instead
+    of a fabricated loss value."""
+
+
 class Preempted(SystemExit):
     """Raised after a preemption-triggered checkpoint. Subclasses
     ``SystemExit`` with :data:`PREEMPT_EXIT_CODE`, so a worker script

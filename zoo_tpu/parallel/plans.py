@@ -137,6 +137,54 @@ def _default_rule(mesh: Mesh, name: str, shape) -> NamedSharding:
     return leaf_sharding(mesh, shape)
 
 
+#: the params key the keras seam stacks a homogeneous run of layers
+#: under when ``plan="pipeline"`` (leaves gain a leading layer dim the
+#: pipeline rule shards over the ``pipe`` axis)
+PIPE_BODY_KEY = "__pipe_body__"
+
+#: expert-stacked FFN leaf names (``ops/moe.py`` ``init_moe_params``
+#: layout: E-leading stacks; the router stays replicated so every
+#: device computes identical routing decisions)
+_MOE_EXPERT_LEAVES = ("w_gate", "w_up", "w_down")
+
+
+@register_plan("pipeline")
+def _pipeline_rule(mesh: Mesh, name: str,
+                   shape) -> Optional[NamedSharding]:
+    """GPipe plan: stage-stacked body leaves (leading layer dim, under
+    ``PIPE_BODY_KEY``) shard dim 0 over the ``pipe`` axis — contiguous
+    stage-major ownership, exactly the ``stack_stages`` split the
+    microbatch schedule consumes — with fsdp filling a remaining dim.
+    Head/tail leaves decline and fall through to :func:`leaf_sharding`
+    (replicated over ``pipe``, fsdp/model-sharded as usual)."""
+    pipe = _axis_size(mesh, "pipe")
+    if pipe <= 1 or not shape or PIPE_BODY_KEY not in name:
+        return None
+    if shape[0] % pipe != 0:
+        return None
+    spec = [None] * len(shape)
+    spec[0] = "pipe"
+    return _fill_fsdp(mesh, list(shape), spec)
+
+
+@register_plan("moe")
+def _moe_rule(mesh: Mesh, name: str, shape) -> Optional[NamedSharding]:
+    """Expert-parallel plan: E-leading expert FFN stacks shard dim 0
+    over the ``expert`` axis (each device holds its experts only; the
+    capacity-bounded dispatch/combine collectives move tokens, not
+    weights). Router and every non-expert leaf decline to
+    :func:`leaf_sharding`."""
+    ep = _axis_size(mesh, "expert")
+    if ep <= 1 or len(shape) < 3:
+        return None
+    leaf = name.rsplit("/", 1)[-1].rsplit(".", 1)[-1]
+    if leaf not in _MOE_EXPERT_LEAVES or shape[0] % ep != 0:
+        return None
+    spec = [None] * len(shape)
+    spec[0] = "expert"
+    return _fill_fsdp(mesh, list(shape), spec)
+
+
 def named_leaf_sharding(mesh: Mesh, name: str, shape,
                         plan: str = "auto") -> NamedSharding:
     """Sharding for one named parameter leaf under ``plan``.
@@ -234,7 +282,10 @@ fsdp_lint_shapes = plan_lint_shapes
 
 
 def estimate_collective_bytes(params, mesh: Mesh,
-                              plan: str = "auto") -> Dict[str, int]:
+                              plan: str = "auto", *,
+                              activation_bytes: int = 0,
+                              n_microbatch: Optional[int] = None
+                              ) -> Dict[str, int]:
     """Per-STEP collective traffic the plan implies, in bytes (the
     static estimate behind ``zoo_mesh_collective_bytes_total``; actual
     traffic is XLA's business, but the plan's lower bound is what
@@ -244,11 +295,21 @@ def estimate_collective_bytes(params, mesh: Mesh,
       forward AND backward (2x full bytes x (n-1)/n) and its grad
       reduce-scattered once (1x);
     - data: every replicated-trainable grad is all-reduced — ring cost
-      2 x bytes x (n-1)/n.
+      2 x bytes x (n-1)/n;
+    - pipe/expert: stage/expert-sharded leaves never move — their bytes
+      drop to the per-device shard before the data-axis terms apply.
+      The *activation* traffic those axes add instead (microbatch
+      hand-offs over the GPipe ring; capacity-bounded MoE
+      dispatch+combine) is estimated from ``activation_bytes`` — the
+      full-batch activation bytes at the cut — when the caller can
+      supply it (0 ⇒ those terms stay 0; the keys are always present).
     """
     fsdp = _axis_size(mesh, "fsdp")
     data = _axis_size(mesh, "data")
-    out = {"all_gather": 0, "reduce_scatter": 0, "all_reduce": 0}
+    pipe = _axis_size(mesh, "pipe")
+    expert = _axis_size(mesh, "expert")
+    out = {"all_gather": 0, "reduce_scatter": 0, "all_reduce": 0,
+           "ppermute": 0, "all_to_all": 0}
     for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
         nbytes = int(np.prod(np.shape(leaf), dtype=np.int64)) * \
             np.dtype(getattr(leaf, "dtype", np.float32)).itemsize
@@ -256,10 +317,24 @@ def estimate_collective_bytes(params, mesh: Mesh,
                                    np.shape(leaf), plan).spec
         axes = [a for s in spec if s is not None
                 for a in ((s,) if isinstance(s, str) else s)]
+        if "pipe" in axes and pipe > 1:
+            nbytes //= pipe
+        if "expert" in axes and expert > 1:
+            nbytes //= expert
         if "fsdp" in axes and fsdp > 1:
             frac = (fsdp - 1) / fsdp
             out["all_gather"] += int(2 * nbytes * frac)
             out["reduce_scatter"] += int(nbytes * frac)
         elif data > 1:
             out["all_reduce"] += int(2 * nbytes * (data - 1) / data)
+    if activation_bytes:
+        if pipe > 1:
+            # fill/drain ring: (n_mb + S - 1) scan steps each ppermute
+            # one microbatch activation, forward and backward
+            n_mb = n_microbatch or pipe
+            out["ppermute"] += int(
+                2 * (n_mb + pipe - 1) * activation_bytes // max(n_mb, 1))
+        if expert > 1:
+            # dispatch + combine all_to_all, forward and backward
+            out["all_to_all"] += int(4 * activation_bytes)
     return out

@@ -55,6 +55,11 @@ def _merge_state(trainable: Dict, state: Dict) -> Dict:
     return out
 
 
+#: params key the pipeline plan stacks the homogeneous layer run under
+#: (same literal as ``zoo_tpu.parallel.plans.PIPE_BODY_KEY``; kept
+#: inline so _forward tracing never imports the plans module)
+_PIPE_BODY_KEY = "__pipe_body__"
+
 # Event-file-backed summaries (own writer + disk read-back) live in
 # zoo_tpu.tensorboard; re-exported here for the keras facade.
 from zoo_tpu.tensorboard import TrainSummary  # noqa: E402
@@ -140,7 +145,8 @@ class KerasNet:
 
     # -- public API (keras-1 names, reference Topology.scala) -------------
     def compile(self, optimizer, loss, metrics=None,
-                loss_weights=None, dtype_policy: str = "float32"):
+                loss_weights=None, dtype_policy: str = "float32",
+                plan: Optional[str] = None):
         """reference: ``KerasNet.compile`` ``Topology.scala:139``.
 
         ``loss_weights``: optional per-output scalar weights for
@@ -149,9 +155,26 @@ class KerasNet:
         ``dtype_policy``: "float32" (default) or "mixed_bfloat16" — params
         and optimizer state stay f32, forward/backward compute runs in
         bf16 on the MXU with f32 islands in the normalizations/softmax
-        (net-new: the reference's fabric is f32-only CPU)."""
+        (net-new: the reference's fabric is f32-only CPU).
+
+        ``plan``: sharding plan for every placement/step this model
+        makes (``zoo_tpu.parallel.plans`` registry; default env
+        ``ZOO_PLAN`` → ``"auto"``). ``"pipeline"`` additionally
+        restructures the params tree: the longest homogeneous layer run
+        stacks into one stage-stacked body the GPipe microbatch
+        schedule consumes (guard counters / rng / loss stay replicated
+        exactly as every other plan, so guard/checkpoint/preemption
+        inherit unchanged)."""
         if dtype_policy not in ("float32", "mixed_bfloat16"):
             raise ValueError(f"unknown dtype_policy: {dtype_policy}")
+        from zoo_tpu.common import knobs as _knobs
+        plan = plan or _knobs.value("ZOO_PLAN")
+        if plan != "auto":
+            from zoo_tpu.parallel.plans import get_plan
+            get_plan(plan)  # unknown plan names fail here, not mid-fit
+        self._plan = plan
+        if plan == "pipeline" and self.params is not None:
+            self.params = self._stack_pipe_body(self.params)
         n_out = len(getattr(self, "outputs", [None]))
         if n_out > 1 and not isinstance(loss, (list, tuple)):
             raise ValueError(
@@ -311,6 +334,8 @@ class KerasNet:
                 "the first layer or call build(input_shapes=...)")
         self._built_shapes = [tuple(s) for s in shapes]
         self.params = self._init_params(rng, shapes)
+        if self._plan_name() == "pipeline":
+            self.params = self._stack_pipe_body(self.params)
         return self.params
 
     def _n_inputs(self) -> int:
@@ -322,12 +347,61 @@ class KerasNet:
         ctx = get_runtime_context(required=False)
         return ctx.mesh if ctx is not None else None
 
+    def _plan_name(self) -> str:
+        """The sharding plan ``compile(plan=...)`` pinned (``"auto"``
+        before compile / on models from old pickles)."""
+        return getattr(self, "_plan", "auto")
+
     def _place(self, params):
         """Place params per the mesh plan: replicated across ``data``,
-        ZeRO-sharded across ``fsdp``, tensor-parallel across ``model``
-        (see ``zoo_tpu.parallel.plans``)."""
+        ZeRO-sharded across ``fsdp``, tensor-parallel across ``model``,
+        stage/expert-sharded across ``pipe``/``expert`` under the
+        pipeline/moe plans (see ``zoo_tpu.parallel.plans``)."""
         from zoo_tpu.parallel.plans import place_params
-        return place_params(params, self._mesh())
+        return place_params(params, self._mesh(), self._plan_name())
+
+    # -- pipeline plan (GPipe body) ---------------------------------------
+    def _stack_pipe_body(self, params):
+        raise ValueError(
+            "plan='pipeline' needs a Sequential model (got "
+            f"{type(self).__name__}: no unambiguous layer chain to "
+            "stage)")
+
+    def _pipe_microbatches(self, stages: int) -> int:
+        """GPipe microbatch count: ``ZOO_PIPE_MICROBATCHES`` (> 0) or
+        one microbatch per stage."""
+        from zoo_tpu.common import knobs as _knobs
+        m = int(_knobs.value("ZOO_PIPE_MICROBATCHES") or 0)
+        return m if m > 0 else stages
+
+    def _apply_pipe_body(self, body, h, *, training):
+        """Apply the stage-stacked homogeneous body: the GPipe
+        microbatch schedule over the ``pipe`` mesh axis when training on
+        one, a plain ``lax.scan`` over the layer stack otherwise — the
+        same layer-by-layer math either way."""
+        tmpl = self._pipe_template
+
+        def step(carry, leaf_slice):
+            return tmpl.call(leaf_slice, carry, training=training,
+                             rng=None), None
+
+        mesh = self._mesh()
+        pipe = mesh.shape.get("pipe", 1) if mesh is not None else 1
+        if training and pipe > 1:
+            from zoo_tpu.parallel.pipeline import (
+                pipeline_apply,
+                stack_stages,
+            )
+            stages = stack_stages(body, pipe)
+
+            def stage_fn(p_slice, hh):
+                out, _ = jax.lax.scan(step, hh, p_slice)
+                return out
+
+            return pipeline_apply(stage_fn, stages, h, mesh,
+                                  self._pipe_microbatches(pipe))
+        out, _ = jax.lax.scan(step, h, body)
+        return out
 
     def _put_batch(self, arrs: List[np.ndarray]):
         mesh = self._mesh()
@@ -678,6 +752,20 @@ class KerasNet:
             self.optimizer.init_fused(trainable)
             if getattr(self.optimizer, "fused", False) else
             tx.init(trainable))
+        if (self._opt_state is not None and mesh is not None
+                and mesh.size > 1 and self._plan_name() != "auto"):
+            # reshard-on-restore for plan-sharded moments: a checkpoint
+            # restore places leaves mesh-generically (replicated for a
+            # pipe/expert-sharded shape), but a previously compiled step
+            # expects the plan layout; pin every moment back onto the
+            # shardings a fresh init of the placed params carries
+            from zoo_tpu.parallel.plans import shardings_of
+            tmpl = (self.optimizer.init_fused(trainable)
+                    if getattr(self.optimizer, "fused", False)
+                    else tx.init(trainable))
+            opt_state = jax.tree_util.tree_map(
+                lambda s, a: jax.device_put(a, s),
+                shardings_of(tmpl, mesh), opt_state)
 
         guard = self._active_guard()
         if guard is not None:
@@ -697,8 +785,19 @@ class KerasNet:
             )
             opt_state = ensure_placed(opt_state, mesh)
             _shard = self._state_shardings(params, opt_state)
+            _plan = self._plan_name()
+            _act_bytes = 0
+            if _plan in ("pipeline", "moe"):
+                # activation proxy at the stage/expert cut: one local
+                # batch of input rows (the static estimate only needs
+                # the order of magnitude the ring/all_to_all moves)
+                _act_bytes = local_bs * sum(
+                    int(np.prod(a.shape[1:], dtype=np.int64))
+                    * a.dtype.itemsize for a in xs)
             _coll_est = {k: v for k, v in estimate_collective_bytes(
-                trainable, mesh).items() if v}
+                trainable, mesh, _plan, activation_bytes=_act_bytes,
+                n_microbatch=self._pipe_microbatches(
+                    mesh.shape.get("pipe", 1))).items() if v}
         # boundary bookkeeping: per-epoch cumulative baselines so each
         # superbatch boundary sees window deltas (reset at epoch start)
         gb = {"loss": 0.0, "steps": 0, "bad": 0, "bad0": 0, "idx": None,
@@ -1064,6 +1163,18 @@ class KerasNet:
                 denom = max(n_steps - max(0, gb["bad"] - gb["bad0"]), 1)
             else:
                 denom = max(n_steps, 1)
+            if guard is not None and loss_sum is None:
+                # a mid-epoch rollback wiped every step of this epoch:
+                # the epoch effectively did not run and there is no
+                # honest loss to report. Raise the typed error the
+                # Estimator's retry perimeter turns into "restore the
+                # verified checkpoint and retrain the lost epoch" —
+                # the guard ladder's designed endWhen semantics
+                from zoo_tpu.orca.learn.guard import EpochRolledBack
+                raise EpochRolledBack(
+                    f"{self.name}: guard rollback wiped every step of "
+                    f"epoch {epoch + 1}; retrain it from the restored "
+                    "checkpoint")
             epoch_loss = float(np.asarray(loss_sum)) / denom
             from zoo_tpu.common.context import ZooContext
             if ZooContext.debug_nans and not np.isfinite(epoch_loss):
@@ -1411,14 +1522,88 @@ class Sequential(KerasNet):
 
     def _forward(self, params, inputs: List, *, training, rng, collect):
         h = inputs[0] if len(inputs) == 1 else inputs
+        body = params.get(_PIPE_BODY_KEY) \
+            if isinstance(params, dict) else None
+        body_keys = set(getattr(self, "_pipe_body_keys", ()) or ())
+        body_done = False
         for layer in self._layers:
             key = self._key_of(layer)
+            if body is not None and key in body_keys:
+                # the stacked homogeneous run applies as one unit (GPipe
+                # schedule / scan) at the position of its first layer
+                if not body_done:
+                    h = self._apply_pipe_body(body, h, training=training)
+                    body_done = True
+                continue
             p = params.get(key, {})
             if collect is not None and hasattr(layer, "updated_stats") \
                     and training:
                 collect[key] = {"stats": layer.updated_stats(p, h)}
             h = layer.call(p, h, training=training, rng=rng)
         return h
+
+    # -- pipeline plan: body detection + stacking -------------------------
+    def _find_pipe_body(self, params):
+        """The longest contiguous run of layers with identical type,
+        config, and param-tree signature — the candidate pipeline body.
+        Returns ``(keys, template_layer)``; loud when no run exists."""
+        def cfg_sig(layer):
+            out = []
+            for k, v in sorted(vars(layer).items()):
+                if k.startswith("_") or k == "name":
+                    continue
+                if callable(v):
+                    out.append((k, getattr(v, "__name__", str(type(v)))))
+                elif isinstance(v, (int, float, str, bool, tuple)):
+                    out.append((k, v))
+                elif isinstance(v, list):
+                    out.append((k, tuple(str(e) for e in v)))
+            return tuple(out)
+
+        best, cur, prev_sig = [], [], object()
+        for layer in self._layers:
+            p = params.get(self._key_of(layer), {})
+            leaves = jax.tree_util.tree_flatten_with_path(p)[0]
+            sig = None if not leaves else (
+                type(layer).__name__, cfg_sig(layer),
+                tuple((jax.tree_util.keystr(kp), tuple(np.shape(leaf)),
+                       str(getattr(leaf, "dtype", "")))
+                      for kp, leaf in leaves))
+            if sig is not None and sig == prev_sig:
+                cur.append(layer)
+            else:
+                cur = [layer] if sig is not None else []
+            prev_sig = sig
+            if len(cur) > len(best):
+                best = list(cur)
+        if len(best) < 2:
+            raise ValueError(
+                "plan='pipeline' needs a contiguous run of >= 2 "
+                "identical layers (same type, config, and param "
+                "shapes) to stage; this model has none")
+        return [self._key_of(layer) for layer in best], best[0]
+
+    def _stack_pipe_body(self, params):
+        """Stack the body run's per-layer param dicts into one
+        ``__pipe_body__`` entry with a leading layer dim — the tensor
+        layout ``stack_stages`` splits and the pipeline plan shards
+        over the ``pipe`` mesh axis."""
+        if not isinstance(params, dict) or _PIPE_BODY_KEY in params:
+            return params  # already stacked (compile-after-build)
+        keys, template = self._find_pipe_body(params)
+        body = [params[k] for k in keys]
+        if any("stats" in p for p in body if isinstance(p, dict)):
+            raise ValueError(
+                "plan='pipeline' body layers must be stateless (the "
+                "stacked stage scan cannot collect per-layer running "
+                "stats); move BatchNorm-style layers out of the run")
+        stacked = jax.tree_util.tree_map(
+            lambda *leaves: jnp.stack(leaves), *body)
+        out = {k: v for k, v in params.items() if k not in set(keys)}
+        out[_PIPE_BODY_KEY] = stacked
+        self._pipe_body_keys = tuple(keys)
+        self._pipe_template = template
+        return out
 
     def get_output_shape(self):
         shapes = self._input_shapes()

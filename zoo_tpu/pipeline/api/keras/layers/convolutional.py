@@ -90,10 +90,12 @@ class Convolution2D(Layer):
             if self.dim_ordering == "th":
                 y = jnp.transpose(y, (0, 3, 1, 2))
             return y
-        y = jax.lax.conv_general_dilated(
-            x, params["W"], window_strides=self.subsample,
-            padding=self.border_mode.upper(),
-            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        # the one conv dispatch point (ops/pallas/conv.py): implicit-GEMM
+        # Pallas kernel on TPU for supported shapes, the identical XLA
+        # reference conv everywhere else (ZOO_CONV_IMPL overrides)
+        from zoo_tpu.ops.pallas.conv import conv2d
+        y = conv2d(x, params["W"], strides=self.subsample,
+                   padding=self.border_mode.upper())
         if self.bias:
             y = y + params["b"]
         if self.activation:

@@ -179,6 +179,45 @@ def _copy_tree(tree):
     return tree
 
 
+#: (architecture fingerprint, sample shapes, sample_batch) -> (path,
+#: speedup). An auto verdict is a property of the architecture and the
+#: backend, not the weight values — re-quantizing the same topology
+#: (rolling reloads, A/B replicas, per-request model copies) reuses the
+#: measured verdict instead of paying the microbench again.
+_AUTO_VERDICT_CACHE: dict = {}
+
+
+def _model_fingerprint(model) -> tuple:
+    """Architecture identity for the auto-verdict cache: layer types in
+    order plus every param leaf's path/shape/dtype (values excluded)."""
+    import jax
+
+    layers = tuple(type(l).__name__ for l in getattr(model, "layers", ()))
+    leaves = tuple(
+        (jax.tree_util.keystr(kp), tuple(v.shape), str(v.dtype))
+        for kp, v in jax.tree_util.tree_leaves_with_path(model.params))
+    return (layers, leaves)
+
+
+def _publish_quant_path(path: str, speedup: Optional[float]) -> None:
+    """Record every quantize_model decision in the scrape — the chosen
+    path is never silent. Prior verdicts flip to 0 (info-gauge style,
+    like ``zoo_registry_version_info``) so exactly one series is 1."""
+    from zoo_tpu.obs.metrics import gauge
+
+    fam = gauge(
+        "zoo_quant_path_info",
+        "int8 quantization path chosen by quantize_model (1 = current "
+        "verdict) with the measured int8/float speedup as a label "
+        "(\"-\" when the mode skipped the microbench)",
+        labels=("path", "speedup"))
+    for child in fam.children():
+        child.set(0.0)
+    fam.labels(path=path,
+               speedup="-" if speedup is None else f"{speedup:.3f}"
+               ).set(1.0)
+
+
 def _time_forward(model, xs, reps: int = 3) -> float:
     """Samples/s of the jitted forward over device-warm inputs (compile
     excluded by a warm-up call). Module-level so tests can stub it."""
@@ -268,12 +307,14 @@ def quantize_model(model, mode: Optional[str] = None,  # zoo-lint: config-parse
                          "(expected auto|force|off)")
     if mode == "off":
         model._quant_path = "bf16"
+        _publish_quant_path("bf16", None)
         return model
     if model.params is None:
         raise ValueError("model must be built before quantization")
     if mode == "force":
         _apply_int8(model)
         model._quant_path = "int8"
+        _publish_quant_path("int8", None)
         return model
 
     # auto: measure int8 against float on this backend, fall back when
@@ -291,6 +332,20 @@ def quantize_model(model, mode: Optional[str] = None,  # zoo-lint: config-parse
         # nothing to measure with: behave like force (documented)
         _apply_int8(model)
         model._quant_path = "int8"
+        _publish_quant_path("int8", None)
+        return model
+    key = (_model_fingerprint(model),
+           tuple(tuple(x.shape) for x in xs), float(min_speedup))
+    cached = _AUTO_VERDICT_CACHE.get(key)
+    if cached is not None:
+        # same architecture + sample shapes on this backend: replay the
+        # verdict instead of re-benching (common under rolling reloads)
+        path, speedup = cached
+        model._quant_speedup = speedup
+        model._quant_path = path
+        if path == "int8":
+            _apply_int8(model)
+        _publish_quant_path(path, speedup)
         return model
     float_rate = _time_forward(model, xs)
     saved = _copy_tree(model.params)
@@ -300,12 +355,16 @@ def quantize_model(model, mode: Optional[str] = None,  # zoo-lint: config-parse
     model._quant_speedup = speedup
     if speedup >= min_speedup:
         model._quant_path = "int8"
+        _AUTO_VERDICT_CACHE[key] = ("int8", speedup)
+        _publish_quant_path("int8", speedup)
         return model
     # int8 loses on this backend: restore the float weights
     model.params = saved
     model._jit_pred = model._jit_eval = model._jit_train = None
     model._quantized = False
     model._quant_path = "bf16-fallback"
+    _AUTO_VERDICT_CACHE[key] = ("bf16-fallback", speedup)
+    _publish_quant_path("bf16-fallback", speedup)
     logging.getLogger(__name__).info(
         "int8 quantization measured %.3fx the float forward (< %.2fx "
         "threshold) on this backend — serving the bf16 path instead",

@@ -1874,7 +1874,222 @@ def bench_disagg(extra, live_streams=4, live_tokens=240,
         f"round-robin {rr_rate:.3f}")
 
 
-_BENCH_PR = 18  # bump alongside CHANGES.md when bench semantics move
+def bench_tenancy(extra, storm_s=5.0, victim_tokens=8,
+                  greedy_workers=3, fair_s=4.0, decode_ms=2.0,
+                  prefill_ms=10.0):
+    """Multi-tenant QoS A/B (docs/multitenancy.md): the SAME
+    adversarial mix — an unpaced greedy flood against a paced,
+    higher-class victim — over one replica with QoS ON (tenant config
+    armed: victim class 0 / weight 4, greedy rate-limited with slot+KV
+    quotas) vs OFF (no tenant config: the pre-tenancy FIFO pool).
+    Chaos delays on the ``llm.prefill``/``llm.decode`` seams stand in
+    for real compute, so slot contention — the thing QoS arbitrates —
+    is actually present to measure.
+
+    Reports the victim's stream p50/p99 and inter-token p99 against an
+    unloaded baseline measured on the same booted pool (the acceptance
+    bar: with QoS on the victim rides through the flood within 2x its
+    unloaded p99 while the QoS-off run shows the pathology), the
+    greedy throttle rate off the ``zoo_tenant_shed_total`` /
+    ``zoo_tenant_admitted_total`` doors, and — in a second both-flood
+    phase — the weighted-fair share: served-tokens/weight between a
+    4:1-weighted tenant pair, normalized to ~1.0 when the deficit
+    scheduler holds. Every stream is verified against the fault-free
+    ``reference()``."""
+    import tempfile
+    import threading
+
+    from zoo_tpu.serving.ha import ReplicaGroup
+    from zoo_tpu.serving.ha_client import HAServingClient
+    from zoo_tpu.serving.llm.synthetic import reference
+    from zoo_tpu.serving.tcp_client import _Connection
+
+    model = "synthllm:slots=2,block=4,blocks=96,tables=8,max_prompt=24"
+    qos_cfg = ("victim:class=0,weight=4,rate=0;"
+               "greedy:class=1,weight=1,rate=8,burst=4,slots=1,kv=32")
+    # 13 tokens (block=4): NOT aligned, so repeat cache hits recompute
+    # in the partial tail block (synthllm has no copy_block for CoW)
+    victim_prompt = list(range(1, 14))
+
+    def boot(cfg):
+        env = {"ZOO_CHAOS_ALLOW": "1", "ZOO_LLM_PREFIX_CACHE": "1"}
+        if cfg:
+            env["ZOO_TENANT_CONFIG"] = cfg
+        group = ReplicaGroup(
+            model, num_replicas=1, max_restarts=1,
+            batch_size=4, max_wait_ms=1.0,
+            log_dir=tempfile.mkdtemp(prefix="zoo-bench-tenancy-"),
+            env=env)
+        group.start(timeout=60)
+        group.chaos_rpc(0, "llm.prefill", delay_ms=prefill_ms)
+        group.chaos_rpc(0, "llm.decode", delay_ms=decode_ms)
+        cli = HAServingClient(group.endpoints(), deadline_ms=60000,
+                              hedge=False)
+        return group, cli
+
+    def tenant_counter(group, name, tenant):
+        return sum(v for sig, v in
+                   group._metrics_counter(0, name).items()
+                   if f'tenant="{tenant}"' in sig)
+
+    def victim_stream(cli):
+        t0 = time.perf_counter()
+        got, gaps, prev = [], [], None
+        for tok in cli.generate(victim_prompt, victim_tokens,
+                                tenant="victim"):
+            now = time.perf_counter()
+            if prev is not None:
+                gaps.append(now - prev)
+            prev = now
+            got.append(tok)
+        wall = time.perf_counter() - t0
+        assert got == reference(victim_prompt, victim_tokens), \
+            "victim stream diverged"
+        return wall, gaps
+
+    def run_arm(cfg):
+        group, cli = boot(cfg)
+        lock = threading.Lock()
+        walls, gaps = [], []
+        greedy_done, greedy_throttled, errors = [0], [0], []
+        try:
+            # unloaded baseline on the SAME pool (same chaos delays)
+            base = [victim_stream(cli)[0] for _ in range(8)]
+            stop_at = time.monotonic() + storm_s
+
+            def victim_worker():
+                while time.monotonic() < stop_at:
+                    try:
+                        w, g = victim_stream(cli)
+                    except Exception as e:  # noqa: BLE001 — tally
+                        with lock:
+                            errors.append(f"victim: {e!r}")
+                        continue
+                    with lock:
+                        walls.append(w)
+                        gaps.extend(g)
+                    time.sleep(0.05)
+
+            def greedy_worker(cid):
+                from zoo_tpu.serving.ha_client import (
+                    NoReplicaAvailable,
+                )
+                rs = np.random.RandomState(23 + cid)
+                while time.monotonic() < stop_at:
+                    p = [int(t) for t in rs.randint(0, 97, size=6)]
+                    try:
+                        toks = list(cli.generate(p, victim_tokens,
+                                                 tenant="greedy"))
+                        assert toks == reference(p, victim_tokens)
+                        with lock:
+                            greedy_done[0] += 1
+                    except NoReplicaAvailable:
+                        with lock:
+                            greedy_throttled[0] += 1
+                    except Exception as e:  # noqa: BLE001
+                        with lock:
+                            errors.append(f"greedy[{cid}]: {e!r}")
+
+            threads = [threading.Thread(target=victim_worker)]
+            threads += [threading.Thread(target=greedy_worker, args=(c,))
+                        for c in range(greedy_workers)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errors, errors[:5]
+            assert len(walls) >= 5 and greedy_done[0] > 0
+            sheds = tenant_counter(group, "zoo_tenant_shed_total",
+                                   "greedy")
+            admitted = tenant_counter(
+                group, "zoo_tenant_admitted_total", "greedy")
+            walls_ms = np.asarray(sorted(walls)) * 1e3
+            gaps_ms = np.asarray(sorted(gaps)) * 1e3
+            return {
+                "base_p99": float(np.percentile(
+                    np.asarray(base) * 1e3, 99)),
+                "p50": float(np.percentile(walls_ms, 50)),
+                "p99": float(np.percentile(walls_ms, 99)),
+                "intertoken_p99": float(np.percentile(gaps_ms, 99)),
+                "throttle_rate": sheds / max(sheds + admitted, 1.0),
+            }
+        finally:
+            cli.close()
+            group.stop()
+
+    on = run_arm(qos_cfg)
+    off = run_arm(None)
+    extra["tenancy_victim_base_p99_ms"] = round(on["base_p99"], 2)
+    extra["tenancy_qos_victim_p50_ms"] = round(on["p50"], 2)
+    extra["tenancy_qos_victim_p99_ms"] = round(on["p99"], 2)
+    extra["tenancy_noqos_victim_p50_ms"] = round(off["p50"], 2)
+    extra["tenancy_noqos_victim_p99_ms"] = round(off["p99"], 2)
+    extra["tenancy_qos_intertoken_p99_ms"] = round(
+        on["intertoken_p99"], 2)
+    extra["tenancy_noqos_intertoken_p99_ms"] = round(
+        off["intertoken_p99"], 2)
+    extra["tenancy_greedy_throttle_rate"] = round(
+        on["throttle_rate"], 3)
+    ratio = on["p99"] / max(off["p99"], 1e-9)
+    extra["tenancy_victim_p99_ratio"] = round(ratio, 3)
+    # the acceptance bars: QoS holds the victim's tail within 2x its
+    # unloaded baseline THROUGH the flood, the throttle visibly bit,
+    # and the QoS-off A/B shows the pathology being prevented
+    assert on["p99"] <= 2.0 * on["base_p99"], (
+        f"QoS-on victim p99 {on['p99']:.1f}ms above 2x unloaded "
+        f"baseline {on['base_p99']:.1f}ms")
+    assert on["throttle_rate"] > 0, "greedy tenant was never throttled"
+    assert on["p99"] < off["p99"], (
+        f"QoS-on victim p99 {on['p99']:.1f}ms not better than "
+        f"QoS-off {off['p99']:.1f}ms")
+
+    # ---- weighted-fair share: both tenants flood, weights 4:1 -------
+    group, cli = boot("a:weight=4,rate=0;b:weight=1,rate=0")
+    try:
+        stop_at = time.monotonic() + fair_s
+        errors = []
+        lock = threading.Lock()
+
+        def flood(tenant, cid):
+            rs = np.random.RandomState(57 + cid)
+            while time.monotonic() < stop_at:
+                p = [int(t) for t in rs.randint(0, 97, size=6)]
+                try:
+                    toks = list(cli.generate(p, victim_tokens,
+                                             tenant=tenant))
+                    assert toks == reference(p, victim_tokens)
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        errors.append(f"{tenant}[{cid}]: {e!r}")
+
+        threads = [threading.Thread(target=flood, args=(t, c))
+                   for c, t in enumerate(["a", "a", "b", "b"])]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors[:5]
+        conn = _Connection(group.host, group.ports[0])
+        try:
+            tenants = conn.rpc({"op": "llm_stats"})["stats"]["tenants"]
+        finally:
+            conn.close()
+        served_a = tenants["a"]["served_tokens"]
+        served_b = tenants["b"]["served_tokens"]
+    finally:
+        cli.close()
+        group.stop()
+    raw = served_a / max(served_b, 1.0)
+    extra["tenancy_fair_share_ratio"] = round(raw, 2)
+    extra["tenancy_fair_share_normalized"] = round(raw / 4.0, 3)
+    # 4:1 weights -> ~4:1 served tokens under saturation; generous
+    # bounds because stream granularity quantizes the split
+    assert 2.0 <= raw <= 8.0, (
+        f"4:1-weighted tenants served {served_a}:{served_b} tokens "
+        f"(ratio {raw:.2f}) — weighted-fair share not holding")
+
+
+_BENCH_PR = 20  # bump alongside CHANGES.md when bench semantics move
 
 
 def _bench_meta():
@@ -1974,6 +2189,10 @@ def main():
             bench_disagg(extra)
         except Exception as e:  # noqa: BLE001
             extra["disagg_error"] = repr(e)
+        try:
+            bench_tenancy(extra)
+        except Exception as e:  # noqa: BLE001
+            extra["tenancy_error"] = repr(e)
         try:
             bench_shard_exchange(extra)
         except Exception as e:  # noqa: BLE001

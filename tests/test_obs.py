@@ -122,17 +122,21 @@ def test_stat_timer_unifies_stage_and_phase_timers():
 
 def test_disabled_registry_under_1us():
     """Acceptance bound: a disabled registry's record hot path costs
-    < 1 µs (it is one attribute check + early return)."""
+    < 1 µs (it is one attribute check + early return). Measured on the
+    CHILD metric — labels() documents "cache the returned child on hot
+    paths", so the family proxy's __getattr__ dispatch is deliberately
+    outside the bound."""
     r = MetricsRegistry()
-    c = r.counter("t_hot_total", "x")
-    h = r.histogram("t_hot_seconds", "x")
+    fam = r.counter("t_hot_total", "x")
+    c, c_inc = fam.labels(), fam.labels().inc
+    h_obs = r.histogram("t_hot_seconds", "x").labels().observe
     r.disable()
     n = 100_000
     best = float("inf")
     for _ in range(3):  # best-of-3 shields against CI scheduler noise
         t0 = time.perf_counter()
         for _ in range(n):
-            c.inc()
+            c_inc()
         best = min(best, time.perf_counter() - t0)
     assert c.value == 0  # nothing recorded
     assert best / n < 1e-6, f"disabled inc cost {best / n * 1e9:.0f} ns"
@@ -140,11 +144,11 @@ def test_disabled_registry_under_1us():
     for _ in range(3):
         t0 = time.perf_counter()
         for _ in range(n):
-            h.observe(0.5)
+            h_obs(0.5)
         best = min(best, time.perf_counter() - t0)
     assert best / n < 1e-6, f"disabled observe cost {best / n * 1e9:.0f} ns"
     r.enable()
-    c.inc()
+    c_inc()
     assert c.value == 1
 
 
@@ -506,6 +510,48 @@ def test_metrics_end_to_end_serving_fit_checkpoint(orca_ctx, tmp_path):
     finally:
         llm_eng.stop()
 
+    # 4c-ter. multi-tenant QoS (docs/multitenancy.md): a tenancy-armed
+    # engine drives the zoo_tenant_* families — an admitted stream and
+    # a rate shed off the free tier's dry bucket, a class-0 preemption
+    # of the youngest best-effort stream, and the per-tenant slot/KV
+    # gauges the scheduler loop republishes
+    from zoo_tpu.serving.llm.engine import AdmissionError
+    from zoo_tpu.serving.tenancy import TenantRegistry
+
+    # ticked WHITE-BOX (never .start()ed) so the preemption is
+    # deterministic: a live engine loop finishes the best-effort
+    # streams before the paid submit could ever contend for a slot
+    qos_eng = LLMEngine(
+        _TickModel(), overlap=False, prefix_cache=False,
+        tenancy=TenantRegistry(
+            spec="gold:class=0,rate=0;brz:class=1,rate=0;"
+                 "free:class=1,rate=0.001,burst=1",
+            qos=True))
+
+    def _qtick(handles=(), ticks=1):
+        for _ in range(ticks):
+            if handles and all(h.done for h in handles):
+                return
+            qos_eng._sweep()
+            qos_eng._admit()
+            qos_eng._prefill_tick()
+            qos_eng._grow_or_preempt()
+            qos_eng._decode_tick()
+
+    f1 = qos_eng.submit([1, 2, 3], 4, rid="ten-f1", tenant="free")
+    with pytest.raises(AdmissionError):   # burst of 1 is spent
+        qos_eng.submit([1, 2, 3], 4, rid="ten-f2", tenant="free")
+    _qtick([f1], ticks=50)
+    assert f1.done and f1.outcome == "ok"
+    b1 = qos_eng.submit([1, 2, 3], 6, rid="ten-b1", tenant="brz")
+    b2 = qos_eng.submit([2, 3, 1], 6, rid="ten-b2", tenant="brz")
+    _qtick(ticks=2)                       # both brz slots live
+    assert qos_eng.stats()["active"] == 2
+    g1 = qos_eng.submit([3, 1, 2], 4, rid="ten-g1", tenant="gold")
+    _qtick(ticks=2)                       # evict youngest brz, admit
+    _qtick([b1, b2, g1], ticks=100)       # resume + drain everything
+    assert all(h.done and h.outcome == "ok" for h in (b1, b2, g1))
+
     # 4c-bis. disaggregated serving (docs/disaggregated_serving.md):
     # one long prompt through a prefill+decode pair drives the whole
     # two-leg kv_migrate handoff — the prefill seat's push populates
@@ -545,7 +591,24 @@ def test_metrics_end_to_end_serving_fit_checkpoint(orca_ctx, tmp_path):
 
     # 4d. the paged-KV gauges: a jax-free allocator round-trip leaves
     # zoo_llm_kv_blocks_{used,free} at the pool's live accounting
-    from zoo_tpu.serving.llm.kv_cache import BlockAllocator
+    from zoo_tpu.serving.llm.kv_cache import (BlockAllocator,
+                                              prefix_block_hashes)
+    # first, a last-resort cross-tenant eviction: a 3-usable-block
+    # pool where gold's ask can only be covered by reclaiming victim's
+    # parked cache block (own + shared partitions both empty) bumps
+    # zoo_tenant_kv_cross_evictions_total{tenant="gold"}
+    t_alloc = BlockAllocator(num_blocks=4, block_size=4,
+                             prefix_cache=True)
+    t_alloc.set_tenant("t-v", "victim")
+    t_alloc.allocate("t-v", 1)
+    t_alloc.register_blocks(
+        "t-v", prefix_block_hashes([1, 2, 3, 4], 4,
+                                   salt=b"tenant:victim"))
+    t_alloc.free("t-v")
+    t_alloc.set_tenant("t-g", "gold")
+    assert t_alloc.allocate("t-g", 3) is not None
+    # ... then the plain probe LAST — the used/free gauges are
+    # process-global, so the final _publish() is the scraped value
     alloc = BlockAllocator(num_blocks=17, block_size=8)
     alloc.allocate("scrape-seq", 4)
 
@@ -557,10 +620,15 @@ def test_metrics_end_to_end_serving_fit_checkpoint(orca_ctx, tmp_path):
     watchdog = SLOWatchdog(
         rules=[SLORule("error_rate", _error_rate, 0.99)],
         window_s=60.0, interval_s=60.0)
+    watchdog.tenant_shed_objective = 0.5   # arm the per-tenant burn
     watchdog.evaluate()
     # traffic must flow INSIDE the window for a burn-rate verdict
     _counter("zoo_serving_requests_total", labels=("outcome",)) \
         .labels(outcome="ok").inc()
+    _counter("zoo_tenant_admitted_total", labels=("tenant",)) \
+        .labels(tenant="gold").inc()
+    _counter("zoo_tenant_shed_total", labels=("tenant", "reason")) \
+        .labels(tenant="gold", reason="rate").inc()
     watchdog.evaluate()
 
     # 5. one scrape sees all of it
@@ -636,6 +704,19 @@ def test_metrics_end_to_end_serving_fit_checkpoint(orca_ctx, tmp_path):
             # accumulated per executed step
             'zoo_mesh_axis_size{axis="data"}',
             'zoo_mesh_collective_bytes_total{op="all_reduce"}',
+            # multi-tenant QoS (this PR): the 4c-ter engine's admit /
+            # rate-shed / class-preempt tallies, the per-tenant
+            # slot/KV gauges its scheduler loop republishes, the 4d
+            # cross-partition eviction counter, and the 4e watchdog's
+            # per-tenant shed burn verdict (family-prefix needles for
+            # the multi-label families)
+            'zoo_tenant_admitted_total{tenant="free"}',
+            'zoo_tenant_shed_total{',
+            'zoo_tenant_preempted_total{',
+            'zoo_tenant_decode_slots{tenant="brz"}',
+            'zoo_tenant_kv_blocks{tenant="gold"}',
+            'zoo_tenant_kv_cross_evictions_total{tenant="gold"} 1',
+            'zoo_tenant_burn_rate{',
     ):
         assert needle in text, f"/metrics is missing {needle}"
     # the fit really recorded step phases (count > 0, not just a family)

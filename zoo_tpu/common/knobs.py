@@ -49,7 +49,7 @@ _TYPES = ("int", "float", "bool", "str")
 #: marked regions ``<!-- zoo-knob-table:<group> begin/end -->``)
 TABLE_DOCS = ("docs/data_plane.md", "docs/serving_ha.md",
               "docs/llm_serving.md", "docs/fault_tolerance.md",
-              "docs/disaggregated_serving.md")
+              "docs/disaggregated_serving.md", "docs/multitenancy.md")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -193,6 +193,7 @@ _OBS = "docs/observability.md"
 _LC = "docs/model_lifecycle.md"
 _MC = "docs/multichip.md"
 _DISAGG = "docs/disaggregated_serving.md"
+_TEN = "docs/multitenancy.md"
 
 # -- data plane (docs/data_plane.md, generated table "data-plane") ----------
 _k("ZOO_SHARD_FETCH_CONCURRENCY", "int", 4,
@@ -344,6 +345,40 @@ _k("ZOO_ROUTE_PREFIX_WEIGHT", "float", 1.0,
 _k("ZOO_ROUTE_OCC_WEIGHT", "float", 0.5,
    "routing weight of decode occupancy (busy slots / total slots "
    "from `llm_stats`) — penalizes loaded seats", _DISAGG, "disagg")
+
+# -- multi-tenant QoS (docs/multitenancy.md, table "tenancy") ---------------
+_k("ZOO_QOS", "bool", True,
+   "`0` disables the whole tenancy layer even with a tenant config — "
+   "admission, fairness, preemption, and cache partitioning all fall "
+   "back to the anonymous single-pool behavior", _TEN, "tenancy")
+_k("ZOO_TENANT_CONFIG", "str", "",
+   "tenant spec: `name:field=..,..;name2:..` with fields `weight` "
+   "(fair-share), `class` (priority, lower preempts higher), `rate` "
+   "(req/s token bucket, 0 = unlimited), `burst` (bucket depth), `kv` "
+   "(live KV-block quota), `slots` (decode-slot quota); empty = "
+   "tenancy off", _TEN, "tenancy", show="— (tenancy off)")
+_k("ZOO_TENANT_DEFAULT_WEIGHT", "float", 1.0,
+   "fair-share weight for unlisted/unlabeled tenants", _TEN, "tenancy")
+_k("ZOO_TENANT_DEFAULT_CLASS", "int", 1,
+   "priority class for unlisted/unlabeled tenants (lower = more "
+   "important)", _TEN, "tenancy")
+_k("ZOO_TENANT_DEFAULT_RATE", "float", 0.0,
+   "admission rate (req/s) for unlisted/unlabeled tenants (0 = "
+   "unlimited)", _TEN, "tenancy")
+_k("ZOO_TENANT", "str", None,
+   "the tenant id `HAServingClient` stamps on every request it sends "
+   "(per-call `tenant=` overrides)", _TEN, "tenancy", show="unset")
+_k("ZOO_TENANT_AB_PINS", "str", "",
+   "per-tenant version pins for the HA client, `gold=v2,free=v1` — a "
+   "pinned tenant's traffic bypasses the fractional "
+   "`ZOO_SERVE_AB_SPLIT`", _TEN, "tenancy", show="—")
+_k("ZOO_TENANT_BACKOFF_CAP_MS", "float", 2000.0,
+   "ceiling on how long the HA client honors a rate-shed "
+   "`retry_after_ms` hint before retrying", _TEN, "tenancy")
+_k("ZOO_SLO_TENANT_SHED_RATE", "float", None,
+   "per-tenant shed-rate ceiling (0..1) the SLO watchdog evaluates "
+   "each window, published as `zoo_tenant_burn_rate`", _TEN, "tenancy",
+   show="off")
 
 # -- training guard (docs/fault_tolerance.md, generated table "guard") ------
 _k("ZOO_GUARD", "bool", True,

@@ -134,6 +134,14 @@ METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "zoo_llm_kv_migrated_bytes_total": ("counter", ()),
     "zoo_llm_handoff_seconds": ("histogram", ()),
     "zoo_serve_route_affinity_total": ("counter", ("reason",)),
+    # -- multi-tenant QoS (docs/multitenancy.md) ---------------------------
+    "zoo_tenant_admitted_total": ("counter", ("tenant",)),
+    "zoo_tenant_shed_total": ("counter", ("tenant", "reason")),
+    "zoo_tenant_preempted_total": ("counter", ("tenant", "reason")),
+    "zoo_tenant_kv_blocks": ("gauge", ("tenant",)),
+    "zoo_tenant_decode_slots": ("gauge", ("tenant",)),
+    "zoo_tenant_kv_cross_evictions_total": ("counter", ("tenant",)),
+    "zoo_tenant_burn_rate": ("gauge", ("tenant", "slo")),
     # -- flight recorder / SLO watchdog ------------------------------------
     "zoo_flight_events_total": ("counter", ("kind",)),
     "zoo_flight_dumps_total": ("counter", ("reason",)),
@@ -147,6 +155,7 @@ METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
 EVENT_KINDS: FrozenSet[str] = frozenset({
     "replica_boot",
     "shed",
+    "tenant_shed",
     "drain",
     "engine_tick",
     "llm_preempt",

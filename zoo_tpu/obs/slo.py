@@ -28,6 +28,8 @@ the watchdog costs nothing it wasn't asked for):
 ``ZOO_SLO_INTER_TOKEN_P99_S``  p99 inter-token gap ceiling (seconds)
 ``ZOO_SLO_ERROR_RATE``         served-request error-rate ceiling (0..1)
 ``ZOO_SLO_SHED_RATE``          admission shed-rate ceiling (0..1)
+``ZOO_SLO_TENANT_SHED_RATE``   PER-TENANT shed-rate ceiling (0..1) —
+                               publishes ``zoo_tenant_burn_rate``
 ``ZOO_SLO_KV_UTIL``            KV-block pool utilization ceiling (0..1)
 ``ZOO_SLO_SPEC_ACCEPT_FLOOR``  speculative accept-rate FLOOR (0..1)
 ``ZOO_SLO_WINDOW_S``           rolling window (default 60 s)
@@ -70,6 +72,14 @@ _breach = gauge(
     "window, else 0", labels=("slo",))
 _evals = gauge(
     "zoo_slo_rules_armed", "SLO rules the watchdog is evaluating")
+# multi-tenant QoS (docs/multitenancy.md): tenant-scoped burn rates,
+# one series per tenant seen in the window — a greedy tenant burning
+# its own shed budget shows up HERE without moving the fleet gauge
+_tenant_burn = gauge(
+    "zoo_tenant_burn_rate",
+    "Per-tenant burn rate (measured / objective) for tenant-scoped "
+    "SLOs over the rolling window; > 1 = that tenant's error budget "
+    "is burning", labels=("tenant", "slo"))
 
 
 def quantile_from_counts(bounds: List[float], counts: List[int],
@@ -280,7 +290,13 @@ class SLOWatchdog:
         self._breached: Dict[str, bool] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        _evals.set(len(self.rules))
+        # tenant-scoped shed-rate ceiling (docs/multitenancy.md):
+        # evaluated per tenant over the window, published as
+        # zoo_tenant_burn_rate{tenant, slo="shed_rate"}
+        self.tenant_shed_objective = _res.env_float(
+            "ZOO_SLO_TENANT_SHED_RATE", 0.0)
+        _evals.set(len(self.rules) +
+                   (1 if self.tenant_shed_objective > 0 else 0))
 
     def evaluate(self) -> Dict:
         """One evaluation pass: snapshot, window-delta, every rule.
@@ -323,8 +339,53 @@ class SLOWatchdog:
                     "SLO %s %s: measured=%r objective=%r",
                     rule.name, "BREACHED" if breached else "cleared",
                     measured, rule.objective)
+        if self.tenant_shed_objective > 0:
+            self._evaluate_tenants(delta, status)
         _set_status(status)
         return status
+
+    def _evaluate_tenants(self, delta: Dict, status: Dict):
+        """Per-tenant shed-rate burn over the window delta: one
+        verdict per tenant that admitted or shed anything, with the
+        same breach edge events (``slo_breach`` with the tenant-keyed
+        rule name) the fleet rules record."""
+        status["tenants"] = {}
+        tenants = sorted({
+            e.get("labels", {}).get("tenant")
+            for e in delta.get("counters", ())
+            if e.get("name") in ("zoo_tenant_shed_total",
+                                 "zoo_tenant_admitted_total")
+            and e.get("labels", {}).get("tenant")})
+        for t in tenants:
+            sheds = _counter_sum(delta, "zoo_tenant_shed_total",
+                                 tenant=t)
+            admitted = _counter_sum(delta, "zoo_tenant_admitted_total",
+                                    tenant=t)
+            total = sheds + admitted
+            if total <= 0:
+                continue
+            measured = sheds / total
+            burn = measured / self.tenant_shed_objective
+            _tenant_burn.labels(tenant=t, slo="shed_rate").set(burn)
+            breached = burn > 1.0
+            status["tenants"][t] = {
+                "shed_rate": measured, "burn_rate": burn,
+                "objective": self.tenant_shed_objective,
+                "breached": breached}
+            name = f"tenant_shed_rate[{t}]"
+            if breached:
+                status["breaches"].append(name)
+                status["ok"] = False
+            was = self._breached.get(name, False)
+            if breached != was:
+                self._breached[name] = breached
+                record_event("slo_breach" if breached else "slo_clear",
+                             slo=name, measured=measured,
+                             objective=self.tenant_shed_objective)
+                (logger.warning if breached else logger.info)(
+                    "SLO %s %s: measured=%r objective=%r",
+                    name, "BREACHED" if breached else "cleared",
+                    measured, self.tenant_shed_objective)
 
     def _loop(self):
         while not self._stop.wait(self.interval_s):
@@ -335,7 +396,8 @@ class SLOWatchdog:
                 logger.warning("slo evaluation failed: %s", e)
 
     def start(self) -> "SLOWatchdog":
-        if not self.rules or self._thread is not None:
+        if (not self.rules and self.tenant_shed_objective <= 0) \
+                or self._thread is not None:
             return self
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="zoo-slo-watchdog")

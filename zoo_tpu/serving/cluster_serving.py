@@ -27,7 +27,12 @@ from zoo_tpu.serving.client import (
 )
 from zoo_tpu.obs.tracing import emit_span, trace_context
 from zoo_tpu.serving.resp import RedisClient, RedisError
-from zoo_tpu.serving.server import StageTimer, _deadline_expired
+from zoo_tpu.serving.server import (
+    StageTimer,
+    _deadline_expired,
+    _tenant_shed,
+)
+from zoo_tpu.serving.tenancy import registry as tenant_registry
 from zoo_tpu.util.resilience import Deadline
 
 
@@ -145,6 +150,7 @@ class FrontEnd:
 
             def do_GET(self):
                 self._trace = None  # never echo a prior POST's trace
+                self._tenant = None
                 if self.path.rstrip("/") in ("", "/"):
                     self._reply(200, {"status": "ok"})
                 elif self.path.startswith("/metrics"):
@@ -163,6 +169,11 @@ class FrontEnd:
                 # context) and is echoed on EVERY reply — the expired
                 # 504 included, so rejected requests stay traceable
                 self._trace = self.headers.get("X-Zoo-Trace")
+                # tenant identity over HTTP (docs/multitenancy.md):
+                # X-Zoo-Tenant rides in, is echoed on EVERY reply
+                # (sheds and 504s included), and is charged to the
+                # tenant's token bucket before any instance computes
+                self._tenant = self.headers.get("X-Zoo-Tenant")
                 pspan = self.headers.get("X-Zoo-Parent-Span")
                 with trace_context(self._trace, pspan):
                     t0 = time.time()
@@ -186,6 +197,19 @@ class FrontEnd:
                     self._reply(400, {"error": "malformed "
                                                "X-Zoo-Deadline-Ms"})
                     return
+                reg = tenant_registry()
+                if reg.enabled:
+                    ok, hint = reg.admit(self._tenant)
+                    if not ok:
+                        _tenant_shed.labels(
+                            tenant=self._tenant or "default",
+                            reason="rate").inc()
+                        self._reply(429, {
+                            "error": "tenant rate limited",
+                            "shed": True, "retryable": True,
+                            "reason": "rate",
+                            "retry_after_ms": hint})
+                        return
                 n = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(n).decode()
                 try:
@@ -222,6 +246,9 @@ class FrontEnd:
                 trace = getattr(self, "_trace", None)
                 if trace is not None:
                     self.send_header("X-Zoo-Trace", trace)
+                tenant = getattr(self, "_tenant", None)
+                if tenant is not None:
+                    self.send_header("X-Zoo-Tenant", tenant)
                 self.end_headers()
                 self.wfile.write(payload)
 

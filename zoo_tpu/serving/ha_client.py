@@ -141,6 +141,24 @@ def _validate_ab_split(split: Dict[str, float]):
         raise ValueError(f"A/B fractions sum past 1.0: {split}")
 
 
+def _parse_tenant_pins(text: str) -> Dict[str, str]:
+    """``ZOO_TENANT_AB_PINS="gold=v2,free=v1"`` → per-tenant version
+    pins (docs/multitenancy.md): the named tenant's traffic is pinned
+    to that registry version ahead of the fractional A/B split."""
+    out: Dict[str, str] = {}
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        tenant, sep, version = part.partition("=")
+        if not sep or not tenant.strip() or not version.strip():
+            raise ValueError(
+                f"malformed ZOO_TENANT_AB_PINS entry {part!r} "
+                "(expected e.g. \"gold=v2,free=v1\")")
+        out[tenant.strip()] = version.strip()
+    return out
+
+
 class NoReplicaAvailable(ConnectionError):
     """Every replica in the group failed or shed this request inside its
     budget; ``__cause__`` / ``last_error`` is the final failure.
@@ -263,7 +281,9 @@ class HAServingClient:
                  ejection_config: Optional[EjectionConfig] = None,
                  migrate_min_tokens: Optional[int] = None,
                  route_prefix_weight: Optional[float] = None,
-                 route_occ_weight: Optional[float] = None):
+                 route_occ_weight: Optional[float] = None,
+                 tenant: Optional[str] = None,
+                 tenant_pins: Optional[Dict[str, str]] = None):
         """``eject`` toggles gray-failure ejection (default: the
         ``ZOO_EJECT`` env, on) — per-seat latency/error scoring that
         moves sustained outliers through probation → ejection →
@@ -312,6 +332,23 @@ class HAServingClient:
         self._ab_split = dict(ab_split or {})
         _validate_ab_split(self._ab_split)
         self._ab_rng = random.Random()
+        # multi-tenant QoS (docs/multitenancy.md): the tenant this
+        # client stamps on every request (ZOO_TENANT; per-call tenant=
+        # overrides), per-tenant version pins consulted ahead of the
+        # fractional split, and the per-tenant backoff clock a
+        # rate-shed's retry_after_ms hint arms — subsequent attempts
+        # for THAT tenant wait out its own bucket refill instead of
+        # hammering the next seat, while other tenants fire untouched
+        self.tenant = tenant if tenant is not None \
+            else (os.environ.get("ZOO_TENANT") or None)
+        if tenant_pins is None:
+            tenant_pins = _parse_tenant_pins(
+                os.environ.get("ZOO_TENANT_AB_PINS", ""))
+        self._ab_pins: Dict[str, str] = dict(tenant_pins or {})
+        self._tenant_backoff_cap_s = env_float(
+            "ZOO_TENANT_BACKOFF_CAP_MS", 2000.0) / 1000.0
+        self._tenant_retry_at: Dict[str, float] = {}
+        self._tenant_lock = threading.Lock()
         # disaggregated routing state (docs/disaggregated_serving.md):
         # a bounded LRU of prompt-prefix signature → the seat that last
         # streamed a prompt with that prefix (its KV prefix cache —
@@ -366,14 +403,29 @@ class HAServingClient:
         with self._ab_lock:
             self._ab_split = split
 
-    def pin_version(self, version: Optional[str], fraction: float = 1.0):
+    def pin_version(self, version: Optional[str], fraction: float = 1.0,
+                    tenant: Optional[str] = None):
         """Shorthand: route ``fraction`` of traffic to ``version``
-        (1.0 = everything; ``None`` clears the split)."""
+        (1.0 = everything; ``None`` clears the split). With
+        ``tenant=``, pin (or clear) that ONE tenant's traffic instead
+        — a per-tenant pin wins over the fractional split, so a gold
+        tier can ride the stable version while the split canaries
+        everyone else (docs/multitenancy.md)."""
+        if tenant is not None:
+            with self._ab_lock:
+                if version is None:
+                    self._ab_pins.pop(tenant, None)
+                else:
+                    self._ab_pins[tenant] = version
+            return
         self.set_ab_split(
             {version: float(fraction)} if version is not None else {})
 
-    def _draw_version(self) -> Optional[str]:
+    def _draw_version(self, tenant: Optional[str] = None
+                      ) -> Optional[str]:
         with self._ab_lock:
+            if tenant and tenant in self._ab_pins:
+                return self._ab_pins[tenant]
             if not self._ab_split:
                 return None
             split = list(self._ab_split.items())
@@ -384,6 +436,40 @@ class HAServingClient:
             if r < acc:
                 return version
         return None
+
+    # -- per-tenant shed backoff (docs/multitenancy.md) --------------------
+    def _note_tenant_backoff(self, tenant: Optional[str], frame: Dict):
+        """A rate shed carries the SHEDDING tenant's own bucket-refill
+        hint; arm that tenant's backoff clock with it (capped by
+        ZOO_TENANT_BACKOFF_CAP_MS). Queue/breaker sheds don't arm it —
+        another seat may well have room, so failover should try."""
+        if frame.get("reason") != "rate":
+            return
+        hint_ms = frame.get("retry_after_ms")
+        if not hint_ms:
+            return
+        until = time.monotonic() + min(
+            float(hint_ms) / 1000.0, self._tenant_backoff_cap_s)
+        key = tenant or ""
+        with self._tenant_lock:
+            if until > self._tenant_retry_at.get(key, 0.0):
+                self._tenant_retry_at[key] = until
+
+    def _tenant_backoff_wait(self, tenant: Optional[str], dl):
+        """Wait out the tenant's armed backoff (never past the
+        request's deadline) before firing an attempt. A no-op for
+        tenants that were never rate-shed — one flooding tenant's
+        backoff never delays anyone else's requests."""
+        key = tenant or ""
+        with self._tenant_lock:
+            until = self._tenant_retry_at.get(key, 0.0)
+        wait = until - time.monotonic()
+        if wait <= 0:
+            return
+        if dl is not None:
+            wait = min(wait, max(0.0, dl.remaining()))
+        if wait > 0:
+            time.sleep(wait)
 
     # -- public API --------------------------------------------------------
     def predict(self, x, deadline_ms: Optional[float] = None,
@@ -409,7 +495,8 @@ class HAServingClient:
                  top_p: Optional[float] = None,
                  seed: Optional[int] = None,
                  spec_k: Optional[int] = None,
-                 trace_id: Optional[str] = None):
+                 trace_id: Optional[str] = None,
+                 tenant: Optional[str] = None):
         """Stream one generation over the replica group: yields tokens
         (ints) as frames arrive. ``temperature``/``top_k``/``top_p``/
         ``seed`` select on-device sampling (unset = greedy, or the
@@ -458,6 +545,9 @@ class HAServingClient:
         dl = Deadline.from_ms(
             deadline_ms if deadline_ms is not None else self.deadline_ms)
         use_hedge = self.hedge if hedge is None else bool(hedge)
+        # tenant identity for QoS (docs/multitenancy.md): per-call
+        # override, else the client-wide tenant (ZOO_TENANT)
+        ten = tenant if tenant is not None else self.tenant
         prompt = _np.asarray(prompt)
         received = 0
         results: "_queue.Queue" = _queue.Queue()
@@ -534,6 +624,8 @@ class HAServingClient:
                        "max_new_tokens": int(max_new_tokens),
                        "resume_from": received,
                        "trace": tid, "pspan": root_sid}
+                if ten is not None:
+                    msg["tenant"] = ten
                 for key, val in (("temperature", temperature),
                                  ("top_k", top_k), ("top_p", top_p),
                                  ("seed", seed), ("spec_k", spec_k)):
@@ -593,6 +685,10 @@ class HAServingClient:
 
         in_flight = 1
         budget -= 1
+        # an earlier rate shed for THIS tenant armed its backoff
+        # clock; wait it out before the first attempt so a flooding
+        # tenant paces itself on its own bucket refill
+        self._tenant_backoff_wait(ten, dl)
         if pair is not None:
             _route_affinity.labels(reason="handoff").inc()
             fire(pair[0], handoff_to=pair[1])
@@ -654,6 +750,10 @@ class HAServingClient:
                     continue
                 if frame.get("shed") and frame.get("retryable"):
                     kill(att)
+                    # a rate shed means OUR bucket is dry fleet-wide
+                    # (config is shared): honor its refill hint before
+                    # the next attempt instead of hammering the pool
+                    self._note_tenant_backoff(ten, frame)
                     last_err = NoReplicaAvailable(
                         frame.get("error", "shed"), None)
                     if att is chosen:
@@ -662,6 +762,7 @@ class HAServingClient:
                         _failover.inc()
                         budget -= 1
                         in_flight += 1
+                        self._tenant_backoff_wait(ten, dl)
                         fire(candidates.pop(0))
                     continue
                 if frame.get("done") and \
@@ -1027,12 +1128,16 @@ class HAServingClient:
         # dedup replay)
         msg = dict(msg)
         msg.setdefault("id", uuid.uuid4().hex)
+        # tenant identity rides every op (the server's predict door
+        # charges its bucket; stats probes just echo it back)
+        if self.tenant is not None and "tenant" not in msg:
+            msg["tenant"] = self.tenant
         # A/B: an explicitly pinned request keeps its pin; otherwise
-        # the split draws one. The pin (or its absence) holds across
-        # every attempt of this logical request.
+        # the tenant's pin, then the split, draws one. The pin (or its
+        # absence) holds across every attempt of this logical request.
         is_predict = msg.get("op") == "predict"
         if is_predict and "model_version" not in msg:
-            drawn = self._draw_version()
+            drawn = self._draw_version(msg.get("tenant"))
             if drawn is not None:
                 msg["model_version"] = drawn
         want = msg.get("model_version")
@@ -1092,6 +1197,10 @@ class HAServingClient:
         in_flight = 0
         last_err: Optional[BaseException] = None
         hedge_ep: Optional[_Endpoint] = None  # who got the duplicate
+        ten = msg.get("tenant")
+        # wait out this tenant's armed rate backoff before the first
+        # attempt (a no-op for everyone who was never rate-shed)
+        self._tenant_backoff_wait(ten, dl)
 
         def fire(ep: _Endpoint):
             nonlocal in_flight
@@ -1185,11 +1294,15 @@ class HAServingClient:
                     self._learn_role(ep, resp["role"])
                 if resp.get("shed") and resp.get("retryable"):
                     # overload shed: the replica is alive but full —
-                    # fail over without charging its breaker
+                    # fail over without charging its breaker. A rate
+                    # shed additionally arms this tenant's backoff
+                    # clock (its own bucket is dry fleet-wide)
+                    self._note_tenant_backoff(ten, resp)
                     last_err = NoReplicaAvailable(
                         resp.get("error", "shed"), None)
                     if candidates and (dl is None or not dl.expired()):
                         _failover.inc()
+                        self._tenant_backoff_wait(ten, dl)
                         fire(candidates.pop(0))
                     continue
                 if resp.get("expired"):

@@ -94,6 +94,7 @@ from zoo_tpu.serving.llm.kv_cache import (
     prefix_block_hashes,
 )
 from zoo_tpu.serving.llm.speculative import PromptLookup, accept_length
+from zoo_tpu.serving.tenancy import registry as tenant_registry
 from zoo_tpu.common.knobs import value as knob_value
 from zoo_tpu.util.resilience import Deadline, env_int
 
@@ -186,15 +187,45 @@ _spec_hit_rate = gauge(
     "Fraction of decode lanes the prompt-lookup drafter produced at "
     "least one proposal for (cumulative, republished from "
     "engine.stats())")
+# multitenancy families (docs/multitenancy.md): per-tenant admission,
+# shedding, preemption, and live resource occupancy — the isolation
+# the QoS layer exists to make observable
+_tenant_admitted = counter(
+    "zoo_tenant_admitted_total",
+    "Requests admitted past the tenant token bucket, per tenant",
+    labels=("tenant",))
+_tenant_shed = counter(
+    "zoo_tenant_shed_total",
+    "Requests shed per tenant and reason (rate = the tenant's own "
+    "token bucket ran dry, queue_full = the shared waiting queue was "
+    "at bound, slots/kv = per-tenant quota)", labels=("tenant", "reason"))
+_tenant_preempted = counter(
+    "zoo_tenant_preempted_total",
+    "Streams preempted per OWNING tenant and reason (kv = pool "
+    "pressure, class = displaced by a higher-priority tenant)",
+    labels=("tenant", "reason"))
+_tenant_kv = gauge(
+    "zoo_tenant_kv_blocks",
+    "Live KV blocks owned per tenant partition",
+    labels=("tenant",))
+_tenant_slots = gauge(
+    "zoo_tenant_decode_slots",
+    "Decode slots held per tenant right now", labels=("tenant",))
 
 
 class AdmissionError(RuntimeError):
-    """Retryable door rejection (waiting queue full); mirrors the
-    predict path's shed contract."""
+    """Retryable door rejection (waiting queue full, or the tenant's
+    admission bucket ran dry); mirrors the predict path's shed
+    contract. ``retry_after_ms`` is computed from the SHEDDING
+    tenant's own bucket refill when tenancy is on — one tenant's
+    flood never inflates another tenant's hint."""
 
-    def __init__(self, msg: str, retry_after_ms: int = 100):
+    def __init__(self, msg: str, retry_after_ms: int = 100,
+                 tenant: str = "", reason: str = "queue_full"):
         super().__init__(msg)
         self.retry_after_ms = retry_after_ms
+        self.tenant = tenant
+        self.reason = reason
 
 
 def stream_seed(rid: str) -> int:
@@ -257,11 +288,17 @@ class GenHandle:
                  sampling: Tuple[float, int, float, int] = None,
                  spec_k: Optional[int] = None,
                  trace_id: Optional[str] = None,
-                 parent_span: Optional[str] = None):
+                 parent_span: Optional[str] = None,
+                 tenant: str = ""):
         self.id = rid
         self.prompt = np.asarray(prompt, np.int32)
         self.max_new = int(max_new)
         self.deadline = deadline
+        # QoS identity (docs/multitenancy.md): which tenant's bucket
+        # admitted this stream, whose quota its slot/KV count against,
+        # and whose priority class the preemption order reads. Empty =
+        # the unlabeled default tenant (the pre-tenancy behavior).
+        self.tenant = tenant or ""
         # request-scoped trace identity (rides the wire from the HA
         # client): every engine lifecycle event for this stream is
         # stamped with it, so the timeline merger can join this
@@ -354,11 +391,12 @@ class GenHandle:
             (self.first_token_at or now) - self.created)
         record_event("llm_stream_end", rid=self.id, outcome=outcome,
                      tokens=len(self.tokens), preempts=self.preempts,
-                     error=error)
+                     tenant=self.tenant or None, error=error)
         emit_span("llm.stream", self.created_wall, now - self.created,
                   trace=self.trace_id, parent=self.parent_span,
                   ok=outcome == "ok", rid=self.id, outcome=outcome,
-                  tokens=len(self.tokens), preempts=self.preempts)
+                  tokens=len(self.tokens), preempts=self.preempts,
+                  tenant=self.tenant or None)
 
     def cancel(self):
         """Client-side abort (connection dropped, caller gone): the
@@ -449,7 +487,8 @@ class LLMEngine:
                  prefix_cache: Optional[bool] = None,
                  spec_k: Optional[int] = None,
                  spec_ngram: Optional[int] = None,
-                 role: Optional[str] = None):
+                 role: Optional[str] = None,
+                 tenancy=None):
         if mode not in ("continuous", "oneshot"):
             raise ValueError(f"unknown scheduling mode {mode!r}")
         self.model = model
@@ -497,6 +536,17 @@ class LLMEngine:
         self.prefix_cache = bool(prefix_cache)
         self.max_waiting = max_waiting if max_waiting is not None else \
             env_int("ZOO_LLM_MAX_WAITING", 256)
+        # multitenancy (docs/multitenancy.md): the QoS registry every
+        # admission/scheduling decision consults. Disabled (no tenant
+        # config) it is inert and the scheduler below is bit-identical
+        # to the pre-tenancy FIFO / youngest-first machinery.
+        self.tenancy = tenancy if tenancy is not None \
+            else tenant_registry()
+        # served decode+prefill tokens per tenant — the weighted-fair
+        # scheduler admits the eligible tenant with the lowest
+        # served/weight ratio (guarded-by: _lock)
+        self._tenant_served: Dict[str, int] = {}
+        self._tenant_gauged: set = set()
         self.allocator = BlockAllocator(model.num_blocks,
                                         model.block_size,
                                         prefix_cache=self.prefix_cache)
@@ -599,7 +649,8 @@ class LLMEngine:
                trace_id: Optional[str] = None,
                parent_span: Optional[str] = None,
                handoff: bool = False,
-               adopt: Optional[Dict] = None) -> GenHandle:
+               adopt: Optional[Dict] = None,
+               tenant: Optional[str] = None) -> GenHandle:
         """Queue one generation. ``sampling``: None (greedy, or the
         ``ZOO_LLM_SAMPLING`` deployment default), or a dict/string with
         ``temperature``/``top_k``/``top_p``/``seed`` — a missing seed
@@ -641,22 +692,53 @@ class LLMEngine:
             import uuid
             rid = uuid.uuid4().hex
         params = parse_sampling(sampling, rid)
+        tenant = tenant or ""
         with self._lock:
             prior = self._by_id.get(rid)
             if prior is not None:
+                # a duplicate id joins the live stream — never charged
+                # to the tenant bucket (retries and failover resumes
+                # must not be double-billed)
                 _dedup.inc()
                 return prior
+            if self.tenancy.enabled:
+                ok, hint = self.tenancy.admit(tenant)
+                if not ok:
+                    label = tenant or "default"
+                    _tenant_shed.labels(tenant=label,
+                                        reason="rate").inc()
+                    record_event("tenant_shed", rid=rid, tenant=label,
+                                 reason="rate", retry_after_ms=hint)
+                    raise AdmissionError(
+                        f"tenant {label!r} rate limited "
+                        f"(refill in {hint}ms)",
+                        retry_after_ms=hint, tenant=tenant,
+                        reason="rate")
             if len(self._wait) >= self.max_waiting:
+                hint = 200
+                if self.tenancy.enabled:
+                    # the hint is THIS tenant's bucket refill, never
+                    # the flooding tenant's backlog: a rate-limited
+                    # flooder backs off on its own refill while a
+                    # within-rate tenant retries on the generic hint
+                    own = self.tenancy.bucket(tenant).retry_after_ms()
+                    hint = own if own > 1 else 200
+                    _tenant_shed.labels(tenant=tenant or "default",
+                                        reason="queue_full").inc()
                 raise AdmissionError(
                     f"llm waiting queue full ({len(self._wait)} "
                     f"streams, bound {self.max_waiting}); retry "
                     "another replica",
-                    retry_after_ms=200)
+                    retry_after_ms=hint, tenant=tenant)
+            if self.tenancy.enabled:
+                _tenant_admitted.labels(
+                    tenant=tenant or "default").inc()
             h = GenHandle(rid, prompt, max_new_tokens, deadline,
                           sampling=params,
                           spec_k=None if spec_k is None else
                           int(spec_k),
-                          trace_id=trace_id, parent_span=parent_span)
+                          trace_id=trace_id, parent_span=parent_span,
+                          tenant=tenant)
             h.hold_handoff = bool(handoff)
             h.adopt = adopt
             self._by_id[rid] = h
@@ -706,6 +788,23 @@ class LLMEngine:
         with self._lock:
             _occupancy.set(sum(1 for s in self._slots if s.handle))
             _waiting.set(len(self._wait))
+            if self.tenancy.enabled:
+                slots_by: Dict[str, int] = {}
+                for t, n in self._slots_by_tenant().items():
+                    k = t or "default"
+                    slots_by[k] = slots_by.get(k, 0) + n
+                kv_by: Dict[str, int] = {}
+                for t, n in self.allocator.used_by_tenant().items():
+                    k = t or "default"
+                    kv_by[k] = kv_by.get(k, 0) + n
+                live = set(slots_by) | set(kv_by)
+                # include previously-gauged tenants at 0 so the gauges
+                # never hold a stale occupancy after a tenant drains
+                for t in self._tenant_gauged | live:
+                    _tenant_slots.labels(tenant=t).set(
+                        slots_by.get(t, 0))
+                    _tenant_kv.labels(tenant=t).set(kv_by.get(t, 0))
+                self._tenant_gauged |= live
         # republished on every scheduler mutation so the ACTIVELY
         # serving engine owns the process-global gauge — a second
         # engine constructed in the same process (bench A/B rigs,
@@ -758,6 +857,57 @@ class LLMEngine:
             return all(s.handle is None for s in self._slots)
         return True
 
+    def _slots_by_tenant(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for s in self._slots:
+            if s.handle is not None:
+                t = s.handle.tenant
+                out[t] = out.get(t, 0) + 1
+        return out
+
+    def _pop_next_waiter(self) -> Optional[GenHandle]:
+        """Under self._lock: the next stream to admit. Tenancy off =
+        plain FIFO (``popleft`` — the exact pre-tenancy order).
+        Tenancy on = weighted-fair deficit pick: among tenants whose
+        slot/KV quotas have headroom, the lowest priority-class number
+        wins, then the lowest served-work/weight ratio; within a
+        tenant, its oldest waiter (per-tenant FIFO). Tenants over
+        quota are skipped entirely, so one tenant's backlog never
+        parks the queue head in front of everyone else."""
+        if not self._wait:
+            return None
+        reg = self.tenancy
+        if not reg.enabled:
+            return self._wait.popleft()
+        slots_by = self._slots_by_tenant()
+        kv_by = self.allocator.used_by_tenant()
+        best = None
+        best_key = None
+        for h in self._wait:
+            cfg = reg.config(h.tenant)
+            if h.cancelled.is_set() or self._expired(h):
+                # dead anyway — let it through so the admission loop
+                # finishes it and frees the queue entry
+                best = h
+                break
+            if cfg.max_slots and \
+                    slots_by.get(h.tenant, 0) >= cfg.max_slots:
+                continue
+            if cfg.max_kv_blocks:
+                prompt = h.effective_prompt \
+                    if h.effective_prompt is not None else h.prompt
+                need = self.allocator.blocks_for_tokens(
+                    len(prompt) + 1)
+                if kv_by.get(h.tenant, 0) + need > cfg.max_kv_blocks:
+                    continue
+            key = (cfg.priority,
+                   self._tenant_served.get(h.tenant, 0) / cfg.weight)
+            if best_key is None or key < best_key:
+                best, best_key = h, key
+        if best is not None:
+            self._wait.remove(best)
+        return best
+
     def _admit(self):
         if not self._admit_ready():
             return
@@ -765,7 +915,7 @@ class LLMEngine:
             if slot.handle is not None:
                 continue
             with self._lock:
-                h = self._wait.popleft() if self._wait else None
+                h = self._pop_next_waiter()
             if h is None:
                 break
             if h.cancelled.is_set():
@@ -786,6 +936,11 @@ class LLMEngine:
                          f"resumed context of {len(prompt)} tokens "
                          "exceeds the whole KV pool")
                 continue
+            if self.tenancy.enabled and h.tenant:
+                # tag the sequence's tenant partition BEFORE any block
+                # moves: its freed prefix blocks park there and its
+                # allocations evict from it first
+                self.allocator.set_tenant(h.id, h.tenant)
             if h.adopt is not None:
                 # migrated stream: bind the adopted table and enter
                 # decode directly — no prefill work at all
@@ -808,8 +963,12 @@ class LLMEngine:
                 if h.block_hashes and h.hashed_len == len(prompt):
                     hashes = h.block_hashes
                 else:
+                    # tenant-salted chain: distinct tenants can never
+                    # match each other's cache entries (empty salt for
+                    # unlabeled traffic — the pre-tenancy hashes)
                     hashes = prefix_block_hashes(
-                        prompt, self.allocator.block_size)
+                        prompt, self.allocator.block_size,
+                        salt=self.tenancy.salt(h.tenant))
                     h.block_hashes = hashes
                     h.hashed_len = len(prompt)
             matched = self.allocator.match_prefix(hashes)
@@ -843,13 +1002,15 @@ class LLMEngine:
             self._admit_counter += 1
             h.admit_seq = self._admit_counter
             h.admitted_at = time.perf_counter()
+            self._note_served(h, len(prompt) - h.cache_hit_tokens)
             emit_event("llm.admit", trace=h.trace_id,
                        parent=h.parent_span, rid=h.id,
                        queue_wait_s=round(h.admitted_at - h.created, 6),
                        prompt_tokens=int(len(prompt)),
                        cache_hit_tokens=int(h.cache_hit_tokens),
                        cow_fork=slot.pending_copy is not None,
-                       resumed=h.effective_prompt is not None)
+                       resumed=h.effective_prompt is not None,
+                       tenant=h.tenant or None)
             # admission only BINDS the slot and blocks; the device
             # prefill itself (whole prompt, suffix past the cached
             # prefix, or chunks across ticks) runs in _prefill_tick
@@ -858,7 +1019,59 @@ class LLMEngine:
             slot.phase = "prefill"
             slot.prefill_pos = h.cache_hit_tokens
             slot.position = 0
+        if self.tenancy.enabled:
+            self._preempt_for_class()
         self._publish()
+
+    def _note_served(self, h: GenHandle, n: int):
+        """Charge ``n`` tokens of service to the stream's tenant — the
+        denominator the weighted-fair pick normalizes by weight."""
+        if n > 0 and self.tenancy.enabled:
+            self._tenant_served[h.tenant] = \
+                self._tenant_served.get(h.tenant, 0) + int(n)
+
+    def _preempt_for_class(self):
+        """Cross-class preemption (docs/multitenancy.md): when every
+        slot is held and a waiter of a strictly HIGHER priority class
+        (lower number) is eligible (within its own quotas), evict the
+        lowest-class youngest running stream to make room — a paid
+        tier displaces best-effort streams, never a peer. One victim
+        per pass keeps the churn bounded; the freed slot admits the
+        high-class waiter on the very next scheduler pass, and the
+        victim resumes byte-identically via the ordinary re-prefill
+        path."""
+        reg = self.tenancy
+        with self._lock:
+            if not self._wait or \
+                    any(s.handle is None for s in self._slots):
+                return
+            slots_by = self._slots_by_tenant()
+            best_cls = None
+            for h in self._wait:
+                if h.cancelled.is_set() or self._expired(h):
+                    continue
+                cfg = reg.config(h.tenant)
+                if cfg.max_slots and \
+                        slots_by.get(h.tenant, 0) >= cfg.max_slots:
+                    continue
+                if best_cls is None or cfg.priority < best_cls:
+                    best_cls = cfg.priority
+            if best_cls is None:
+                return
+            victim = None
+            victim_key = None
+            for slot in self._slots:
+                hh = slot.handle
+                if hh is None:
+                    continue
+                c = reg.config(hh.tenant).priority
+                if c <= best_cls:
+                    continue   # same or higher priority: never evicted
+                key = (c, hh.admit_seq)
+                if victim_key is None or key > victim_key:
+                    victim, victim_key = slot, key
+            if victim is not None:
+                self._preempt(victim, reason="class")
 
     def _bind_blocks(self, slot: _Slot, h: GenHandle,
                      prompt: np.ndarray, hashes: list) -> bool:
@@ -918,6 +1131,7 @@ class LLMEngine:
         h.gen_count += 1
         h.sched_count += 1
         self._generated += 1
+        self._note_served(h, 1)
         _tokens.labels(kind="decode").inc()
         eos = getattr(self.model, "eos_id", None)
         if h.gen_count >= h.max_new or \
@@ -946,6 +1160,7 @@ class LLMEngine:
             "block_size": self.allocator.block_size,
             "aux": self.allocator.get_aux(h.id),
             "max_new": h.max_new,
+            "tenant": h.tenant,
             "t0": time.perf_counter(),
         }
         self._handoffs[h.id] = payload
@@ -1067,7 +1282,8 @@ class LLMEngine:
                    queue_wait_s=round(h.admitted_at - h.created, 6),
                    prompt_tokens=int(len(prompt)),
                    cache_hit_tokens=int(local_hit),
-                   cow_fork=False, resumed=False, adopted=True)
+                   cow_fork=False, resumed=False, adopted=True,
+                   tenant=h.tenant or None)
         record_event("kv_migrate_in", rid=h.id,
                      blocks=len(table) - n_reused, reused=n_reused)
         self._enter_decode(slot, h, int(payload["first"]), len(prompt))
@@ -1232,6 +1448,15 @@ class LLMEngine:
                     continue
                 victim = self._pick_victim(exclude=h)
                 if victim is None:
+                    if self.tenancy.enabled and any(
+                            s.handle is not None and s.handle is not h
+                            for s in self._slots):
+                        # every other live stream outranks h: requeue
+                        # h itself (byte-identical resume) rather than
+                        # evict a higher-priority tenant's KV — or end
+                        # h with an error it did nothing to earn
+                        self._preempt(slot)
+                        break
                     self._finish_slot(
                         slot, "error",
                         "kv cache exhausted: sequence cannot grow and "
@@ -1240,16 +1465,32 @@ class LLMEngine:
                 self._preempt(victim)
 
     def _pick_victim(self, exclude: GenHandle) -> Optional[_Slot]:
+        """The stream to evict when ``exclude`` needs a block the pool
+        cannot fund: youngest-admitted WITHIN the lowest priority
+        class (tenancy on — and never a class that outranks
+        ``exclude``'s own); plain youngest-first when tenancy is off
+        (every key ties at class 0, leaving exactly the pre-tenancy
+        order)."""
+        reg = self.tenancy
+        ex_cls = reg.config(exclude.tenant).priority \
+            if reg.enabled else 0
         best = None
+        best_key = None
         for slot in self._slots:
             if slot.handle is None or slot.handle is exclude:
                 continue
-            if best is None or slot.handle.admit_seq > \
-                    best.handle.admit_seq:
-                best = slot
+            if reg.enabled:
+                c = reg.config(slot.handle.tenant).priority
+                if c < ex_cls:
+                    continue   # outranks the grower: never its victim
+                key = (c, slot.handle.admit_seq)
+            else:
+                key = (0, slot.handle.admit_seq)
+            if best_key is None or key > best_key:
+                best, best_key = slot, key
         return best
 
-    def _preempt(self, slot: _Slot):
+    def _preempt(self, slot: _Slot, reason: str = "kv"):
         """Evict a running stream: free its blocks and requeue it with
         prompt := original prompt + everything generated so far.
         Decode (greedy or seeded sampling — the PRNG key is a pure
@@ -1280,11 +1521,16 @@ class LLMEngine:
         slot.epoch += 1
         self.allocator.free(h.id)
         _preempts.inc()
+        if self.tenancy.enabled:
+            _tenant_preempted.labels(tenant=h.tenant or "default",
+                                     reason=reason).inc()
         emit_event("llm.preempt", trace=h.trace_id,
                    parent=h.parent_span, rid=h.id,
-                   generated=int(h.gen_count))
+                   generated=int(h.gen_count), reason=reason,
+                   tenant=h.tenant or None)
         record_event("llm_preempt", rid=h.id,
-                     generated=int(h.gen_count))
+                     generated=int(h.gen_count), reason=reason,
+                     tenant=h.tenant or None)
         with self._lock:
             self._wait.appendleft(h)
 
@@ -1368,6 +1614,7 @@ class LLMEngine:
             h.push(tok)
             h.gen_count += 1
             self._generated += 1
+            self._note_served(h, 1)
             _tokens.labels(kind="decode").inc()
             if h.gen_count >= h.max_new or \
                     (eos is not None and tok == eos):
@@ -1484,6 +1731,7 @@ class LLMEngine:
                 h.gen_count += 1
                 h.sched_count = h.gen_count
                 self._generated += 1
+                self._note_served(h, 1)
                 _tokens.labels(kind="decode").inc()
                 if h.gen_count >= h.max_new or \
                         (eos is not None and tok == eos):
@@ -1812,7 +2060,24 @@ class LLMEngine:
                "waiting": len(self._wait),
                "decode_steps": self._decode_steps,
                "overlap_ratio": self._window_ratio() or 0.0,
-               "generated_tokens": self._generated}
+               "generated_tokens": self._generated,
+               "qos": self.tenancy.enabled}
+        if self.tenancy.enabled:
+            with self._lock:
+                slots_by = self._slots_by_tenant()
+                kv_by = self.allocator.used_by_tenant()
+                waiting_by: Dict[str, int] = {}
+                for w in self._wait:
+                    waiting_by[w.tenant] = waiting_by.get(w.tenant, 0) + 1
+                names = set(slots_by) | set(kv_by) | set(waiting_by) \
+                    | set(self._tenant_served)
+                out["tenants"] = {
+                    (t or "default"): {
+                        "slots": slots_by.get(t, 0),
+                        "kv_blocks": kv_by.get(t, 0),
+                        "waiting": waiting_by.get(t, 0),
+                        "served_tokens": self._tenant_served.get(t, 0),
+                    } for t in sorted(names)}
         out.update(self.allocator.stats())
         if hasattr(self.model, "compile_counts"):
             out["compiles"] = self.model.compile_counts()

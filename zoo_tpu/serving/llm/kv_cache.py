@@ -87,17 +87,30 @@ _blocks_cached = gauge(
     "zoo_llm_kv_blocks_cached",
     "Refcount-0 blocks parked on the prefix-cache LRU (matchable, "
     "reclaimed lazily)")
+_cross_evictions = counter(
+    "zoo_tenant_kv_cross_evictions_total",
+    "Cached-free blocks evicted ACROSS tenant partitions (last-resort "
+    "reclaim when the requester's own and the shared partitions are "
+    "both empty) — the multitenancy isolation contract keeps this 0 "
+    "under configured quotas", labels=("tenant",))
 
 
 def prefix_block_hashes(tokens: Sequence[int],
-                        block_size: int) -> List[bytes]:
+                        block_size: int,
+                        salt: bytes = b"") -> List[bytes]:
     """Rolling content hash per FULL block of ``tokens``: block ``i``'s
     key digests (key of block ``i-1``, the block's token ids), so equal
     keys imply the ENTIRE prefix through block ``i`` is identical —
     the property that makes a hash hit safe to alias. Partial trailing
-    tokens produce no hash (partial blocks are never shared)."""
+    tokens produce no hash (partial blocks are never shared).
+
+    ``salt`` folds an extra namespace into the chain seed — the
+    multitenancy layer passes the tenant id so distinct tenants can
+    never match (or collide with) each other's cache entries; the
+    default empty salt keeps unlabeled traffic's hashes byte-identical
+    to the pre-tenancy chain."""
     out: List[bytes] = []
-    prev = b"zoo-kv-prefix-v1"
+    prev = b"zoo-kv-prefix-v1" + salt
     n_full = len(tokens) // block_size
     if not n_full:
         return out
@@ -147,6 +160,15 @@ class BlockAllocator:
         self._hash_of: Dict[int, bytes] = {}
         self._by_hash: Dict[bytes, int] = {}
         self._cached: "OrderedDict[int, None]" = OrderedDict()
+        # multitenancy (docs/multitenancy.md): parked cached-free
+        # blocks carry their owner tenant's partition tag, and
+        # eviction reclaims the requester's own partition (then the
+        # shared "" partition) before ever crossing tenants — one
+        # tenant's churn cannot evict another's hot prompt. Empty
+        # everywhere when tenancy is off: eviction degenerates to the
+        # single global LRU below.
+        self._part_of: Dict[int, str] = {}
+        self._tenant_of: Dict[str, str] = {}
         # per-sequence aux state riding the block-table entry (e.g. the
         # sampling PRNG seed): whoever resumes the sequence replays
         # from exactly what was checkpointed here. KEYED BY SEQUENCE,
@@ -193,6 +215,30 @@ class BlockAllocator:
         """Blocks a sequence of ``n_tokens`` occupies."""
         return max(1, -(-int(n_tokens) // self.block_size))
 
+    def set_tenant(self, seq_id: str, tenant: str):
+        """Tag ``seq_id`` with its tenant partition BEFORE it acquires
+        blocks: its freed cached blocks park in that partition and its
+        allocations evict from it first. The engine only calls this
+        when the tenancy layer is enabled; untagged sequences live in
+        the shared ``\"\"`` partition, which is the whole pool when
+        tenancy is off."""
+        with self._lock:
+            if tenant:
+                self._tenant_of[seq_id] = str(tenant)
+            else:
+                self._tenant_of.pop(seq_id, None)
+
+    def used_by_tenant(self) -> Dict[str, int]:
+        """Physical blocks currently owned per tenant partition (a
+        block shared by two of a tenant's sequences counts once —
+        tenant-salted hashes mean sharing never crosses tenants)."""
+        with self._lock:
+            seen: Dict[str, set] = {}
+            for seq, blocks in self._owners.items():
+                t = self._tenant_of.get(seq, "")
+                seen.setdefault(t, set()).update(blocks)
+            return {t: len(s) for t, s in seen.items()}
+
     def set_aux(self, seq_id: str, **aux):
         """Checkpoint per-sequence state alongside the block-table
         entry (the engine stores the sampling PRNG seed here, so a
@@ -228,22 +274,47 @@ class BlockAllocator:
             evictable = max(0, len(self._cached) - int(cached_blocks))
             return len(self._free) + evictable >= need
 
-    def _evict_one(self):
-        """Under the lock: reclaim the LRU cached-free block onto the
-        raw free list, deregistering its hash. Only ever sees
-        refcount-0 blocks (the LRU holds nothing else)."""
-        blk, _ = self._cached.popitem(last=False)   # LRU end
+    def _evict_one(self, tenant: str = ""):
+        """Under the lock: reclaim one cached-free block onto the raw
+        free list, deregistering its hash. Only ever sees refcount-0
+        blocks (the LRU holds nothing else).
+
+        Partition order for a tenant-tagged requester: LRU of its OWN
+        partition, then LRU of the shared ``\"\"`` partition, and only
+        as a last resort (both empty) the global LRU head — a
+        cross-tenant eviction, counted so the isolation contract is
+        observable. An untagged requester pops the global LRU head,
+        which is the entire pre-tenancy behavior."""
+        blk = None
+        if tenant:
+            for b in self._cached:                  # LRU -> MRU order
+                if self._part_of.get(b, "") == tenant:
+                    blk = b
+                    break
+            if blk is None:
+                for b in self._cached:
+                    if not self._part_of.get(b, ""):
+                        blk = b
+                        break
+            if blk is None:
+                blk = next(iter(self._cached))      # cross-tenant
+                _cross_evictions.labels(tenant=tenant).inc()
+            self._cached.pop(blk)
+        else:
+            blk, _ = self._cached.popitem(last=False)   # LRU end
+        self._part_of.pop(blk, None)
         h = self._hash_of.pop(blk, None)
         if h is not None:
             self._by_hash.pop(h, None)
         self._free.append(blk)
 
-    def _take_free(self, n: int) -> Optional[List[int]]:
+    def _take_free(self, n: int, tenant: str = "") -> Optional[List[int]]:
         """Under the lock: pop ``n`` blocks, evicting LRU cached-free
-        blocks when the raw free list runs short. Refcounted blocks
-        are NEVER evicted."""
+        blocks when the raw free list runs short (the requester's own
+        tenant partition first — see :meth:`_evict_one`). Refcounted
+        blocks are NEVER evicted."""
         while len(self._free) < n and self._cached:
-            self._evict_one()
+            self._evict_one(tenant)
         if len(self._free) < n:
             return None
         return [self._free.pop() for _ in range(n)]
@@ -256,7 +327,8 @@ class BlockAllocator:
         if n_blocks <= 0:
             raise ValueError("n_blocks must be positive")
         with self._lock:
-            got = self._take_free(n_blocks)
+            got = self._take_free(n_blocks,
+                                  self._tenant_of.get(seq_id, ""))
             if got is None:
                 return None
             for b in got:
@@ -283,8 +355,9 @@ class BlockAllocator:
             if blocks is None:
                 return 0
             want = self.blocks_for_tokens(n_tokens)
+            tenant = self._tenant_of.get(seq_id, "")
             while len(blocks) < want:
-                got = self._take_free(1)
+                got = self._take_free(1, tenant)
                 if got is None:
                     break
                 self._ref[got[0]] = 1
@@ -329,6 +402,7 @@ class BlockAllocator:
                     break
                 self._ref[blk] = self._ref.get(blk, 0) + 1
                 self._cached.pop(blk, None)
+                self._part_of.pop(blk, None)
                 got.append(blk)
             if got:
                 self._owners.setdefault(seq_id, []).extend(got)
@@ -387,6 +461,7 @@ class BlockAllocator:
                 raise ValueError(
                     f"adopt_blocks must run before {seq_id!r} owns "
                     "blocks (the adopted table is rows 0..n)")
+            tenant = self._tenant_of.get(seq_id, "")
             reused: List[int] = []
             if self.prefix_cache:
                 for h in hashes[:n_blocks - 1]:
@@ -399,8 +474,9 @@ class BlockAllocator:
                     # fresh remainder is funded
                     self._ref[blk] = self._ref.get(blk, 0) + 1
                     self._cached.pop(blk, None)
+                    self._part_of.pop(blk, None)
                     reused.append(blk)
-            fresh = self._take_free(n_blocks - len(reused))
+            fresh = self._take_free(n_blocks - len(reused), tenant)
             if fresh is None:
                 # roll back the aliased refs exactly as free() would
                 for b in reversed(reused):
@@ -412,6 +488,8 @@ class BlockAllocator:
                     if b in self._hash_of:
                         self._cached[b] = None
                         self._cached.move_to_end(b)   # MRU end
+                        if tenant:
+                            self._part_of[b] = tenant
                     else:
                         self._free.append(b)
                 self._publish()
@@ -456,7 +534,7 @@ class BlockAllocator:
             src = blocks[index]
             if self._ref.get(src, 1) <= 1:
                 return None
-            got = self._take_free(1)
+            got = self._take_free(1, self._tenant_of.get(seq_id, ""))
             if got is None:
                 raise MemoryError(
                     "copy-on-write fork needs a free block and the "
@@ -479,6 +557,7 @@ class BlockAllocator:
         with self._lock:
             blocks = self._owners.pop(seq_id, None)
             self._aux.pop(seq_id, None)
+            tenant = self._tenant_of.pop(seq_id, "")
             if not blocks:
                 return 0
             for b in reversed(blocks):
@@ -490,6 +569,8 @@ class BlockAllocator:
                 if b in self._hash_of:
                     self._cached[b] = None
                     self._cached.move_to_end(b)   # MRU end
+                    if tenant:
+                        self._part_of[b] = tenant
                 else:
                     self._free.append(b)
             self._publish()

@@ -42,6 +42,7 @@ from zoo_tpu.util.integrity import (
     verify_crc,
     wire_crc_enabled,
 )
+from zoo_tpu.serving.tenancy import registry as tenant_registry
 from zoo_tpu.util.resilience import (
     CircuitBreaker,
     Deadline,
@@ -84,6 +85,18 @@ _dedup = counter(
     "zoo_serve_dedup_total", "Duplicate request ids absorbed without "
     "re-executing (inflight = joined a pending request, replay = served "
     "from the completed-request cache)", labels=("kind",))
+# multi-tenant QoS (docs/multitenancy.md): the predict door keeps the
+# same per-tenant admission tallies the LLM engine keeps for generate —
+# the registry dedupes, so both creation sites share one family
+_tenant_admitted = counter(
+    "zoo_tenant_admitted_total",
+    "Requests admitted past the tenant token bucket, per tenant",
+    labels=("tenant",))
+_tenant_shed = counter(
+    "zoo_tenant_shed_total",
+    "Requests shed per tenant and reason (rate = the tenant's own "
+    "token bucket ran dry, queue_full = the shared waiting queue was "
+    "at bound, slots/kv = per-tenant quota)", labels=("tenant", "reason"))
 # model-lifecycle families (docs/model_lifecycle.md): which registry
 # version this replica is serving (1 = current, 0 = a version it served
 # before a hot-swap), hot-swap outcomes, and the measured drain time the
@@ -270,7 +283,8 @@ class ServingServer:
                  llm_engine=None,
                  version: Optional[str] = None,
                  model_spec: Optional[str] = None,
-                 model_loader=None):
+                 model_loader=None,
+                 tenancy=None):
         """``certfile``/``keyfile``: serve over TLS — the trusted-
         serving door of the reference's PPML trusted-realtime-ml story
         (``ppml/trusted-realtime-ml/``: encrypted transport in front of
@@ -334,6 +348,12 @@ class ServingServer:
             raise ValueError("ServingServer needs a model, an "
                              "llm_engine, or both")
         self.breaker = breaker
+        # multi-tenant QoS (docs/multitenancy.md): the tenant registry
+        # the predict door gates admission on; inert (enabled=False)
+        # without ZOO_TENANT_CONFIG, so unlabeled single-tenant
+        # traffic behaves exactly as before tenancy existed
+        self.tenancy = tenancy if tenancy is not None \
+            else tenant_registry()
         self.max_queue = max_queue if max_queue is not None else \
             env_int("ZOO_SERVE_MAX_QUEUE", 1024)
         self.request_timeout = request_timeout if request_timeout \
@@ -433,6 +453,11 @@ class ServingServer:
                     out["id"] = msg["id"]
                 if msg.get("trace") is not None:
                     out["trace"] = msg["trace"]
+                if msg.get("tenant") is not None:
+                    # tenant echoed on EVERY reply, sheds included —
+                    # the client's per-tenant backoff and A/B pinning
+                    # key on it without guessing which request this was
+                    out["tenant"] = msg["tenant"]
                 if outer.version is not None:
                     # lifecycle identity on every frame: the HA client
                     # learns which version each endpoint serves (A/B
@@ -452,12 +477,15 @@ class ServingServer:
                 thing a postmortem wants), and the request's trace gets
                 an instant event so rejected requests reconstruct in
                 the timeline too."""
+                kw = {}
+                if msg.get("tenant"):
+                    kw["tenant"] = msg["tenant"]
                 record_event("shed", op=msg.get("op", "predict"),
-                             reason=reason)
+                             reason=reason, **kw)
                 if msg.get("trace") is not None:
                     emit_event("server.shed", trace=msg["trace"],
                                parent=msg.get("pspan"), reason=reason,
-                               rid=msg.get("id"))
+                               rid=msg.get("id"), **kw)
 
             def _await_and_reply(self, msg, req, deadline):
                 """Reply stage: wait for the batcher to resolve ``req``
@@ -577,7 +605,32 @@ class ServingServer:
                         "error": "deadline expired before admission "
                                  "(budget exhausted upstream)"})
                     return
-                # 5. admission control: early rejection at the bounded
+                # 5. tenant admission (docs/multitenancy.md): charge
+                # the request to ITS tenant's token bucket before it
+                # can touch the shared queue. The retry hint is that
+                # bucket's own refill time — a flooding tenant backs
+                # off on its own clock while everyone else's hints
+                # stay untouched. Inert without tenant config.
+                tenant = msg.get("tenant") or ""
+                if outer.tenancy.enabled:
+                    ok, t_hint = outer.tenancy.admit(tenant)
+                    if not ok:
+                        label = tenant or "default"
+                        _requests.labels(outcome="shed").inc()
+                        _shed.labels(reason="tenant_rate").inc()
+                        _tenant_shed.labels(tenant=label,
+                                            reason="rate").inc()
+                        self._note_reject(msg, "tenant_rate")
+                        self._reply(msg, {
+                            "shed": True, "retryable": True,
+                            "retry_after_ms": t_hint,
+                            "reason": "rate",
+                            "error": f"tenant {label!r} rate limited; "
+                                     f"retry after ~{t_hint}ms"})
+                        return
+                    _tenant_admitted.labels(
+                        tenant=tenant or "default").inc()
+                # 6. admission control: early rejection at the bounded
                 # queue, with a retry-after hint sized to the backlog —
                 # overload sheds at the door, not after a timeout
                 depth = outer._queue.qsize()
@@ -587,6 +640,16 @@ class ServingServer:
                     self._note_reject(msg, "queue_full")
                     hint = int(outer.max_wait_ms * max(
                         1, depth // max(1, outer.batch_size)))
+                    if outer.tenancy.enabled:
+                        # rate-limited tenants wait out their OWN
+                        # refill when it is the longer bound — the
+                        # backlog estimate stays for everyone else
+                        own = outer.tenancy.bucket(
+                            tenant).retry_after_ms()
+                        hint = max(hint, own)
+                        _tenant_shed.labels(
+                            tenant=tenant or "default",
+                            reason="queue_full").inc()
                     self._reply(msg, {
                         "shed": True, "retryable": True,
                         "retry_after_ms": hint,
@@ -740,14 +803,23 @@ class ServingServer:
                         spec_k=None if spec_k is None else int(spec_k),
                         trace_id=trace_id,
                         parent_span=msg.get("pspan"),
-                        handoff=bool(handoff), adopt=adopt)
+                        handoff=bool(handoff), adopt=adopt,
+                        tenant=msg.get("tenant"))
                 except AdmissionError as e:
+                    # the engine computed retry_after_ms from the
+                    # SHEDDING tenant's own bucket (and stamps which
+                    # quota tripped); relay both so the client backs
+                    # off per-tenant instead of hammering the pool
+                    reason = getattr(e, "reason", "queue_full")
+                    door = "tenant_rate" if reason == "rate" \
+                        else "queue_full"
                     _requests.labels(outcome="shed").inc()
-                    _shed.labels(reason="queue_full").inc()
-                    self._note_reject(msg, "queue_full")
+                    _shed.labels(reason=door).inc()
+                    self._note_reject(msg, door)
                     self._reply(msg, {
                         "shed": True, "retryable": True,
                         "retry_after_ms": e.retry_after_ms,
+                        "reason": reason,
                         "error": str(e)})
                     return
                 except (ValueError, KeyError) as e:
